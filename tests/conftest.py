@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Multi-chip sharding logic (tp/pp/dp/sp) is validated on a virtual CPU mesh
+exactly as the driver's dryrun does; real-TPU runs come from bench.py.
+"""
+import asyncio
+import inspect
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+  os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("XOT_SKIP_JAX_PROBE", "1")
+
+# The image's sitecustomize force-registers the remote-TPU ("axon") backend
+# and overrides JAX_PLATFORMS; pin the selection back to CPU after import so
+# tests never touch (or wait on) the tunneled TPU claim.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+def pytest_configure(config):
+  config.addinivalue_line("markers", "asyncio: run the test inside a fresh asyncio event loop")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+  """Run coroutine tests with asyncio.run (no pytest-asyncio in this image)."""
+  fn = pyfuncitem.obj
+  if inspect.iscoroutinefunction(fn):
+    kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+    asyncio.run(fn(**kwargs))
+    return True
+  return None

@@ -1,0 +1,93 @@
+"""Foundation tests: async callback system, ports, node identity, NIC priority.
+
+Mirrors the reference's test_callbacks.py:7-50 scenarios as real pytest-asyncio
+cases.
+"""
+import asyncio
+
+import pytest
+
+from xotorch_tpu.utils.helpers import (
+  AsyncCallback,
+  AsyncCallbackSystem,
+  PrefixDict,
+  find_available_port,
+  get_interface_priority_and_type,
+  get_or_create_node_id,
+  is_port_available,
+  pretty_bytes,
+)
+
+
+@pytest.mark.asyncio
+async def test_callback_observers_and_wait():
+  cb: AsyncCallback[int] = AsyncCallback()
+  seen = []
+  cb.on_next(lambda *a: seen.append(a))
+
+  async def fire():
+    await asyncio.sleep(0.01)
+    cb.set(42, "hello")
+
+  task = asyncio.create_task(fire())
+  result = await cb.wait(lambda n, s: n == 42, timeout=2)
+  await task
+  assert result == (42, "hello")
+  assert seen == [(42, "hello")]
+
+
+@pytest.mark.asyncio
+async def test_callback_wait_timeout():
+  cb: AsyncCallback[int] = AsyncCallback()
+  with pytest.raises(asyncio.TimeoutError):
+    await cb.wait(lambda n: n == 1, timeout=0.05)
+
+
+@pytest.mark.asyncio
+async def test_callback_system_trigger_all():
+  system: AsyncCallbackSystem[str, int] = AsyncCallbackSystem()
+  a = system.register("a")
+  b = system.register("b")
+  got = []
+  a.on_next(lambda v: got.append(("a", v)))
+  b.on_next(lambda v: got.append(("b", v)))
+  system.trigger_all(7)
+  assert sorted(got) == [("a", 7), ("b", 7)]
+  system.trigger("a", 9)
+  assert got[-1] == ("a", 9)
+  system.deregister("a")
+  system.trigger("a", 11)  # no-op after deregister
+  assert got[-1] == ("a", 9)
+
+
+def test_find_available_port():
+  port = find_available_port()
+  assert 49152 <= port <= 65535
+  assert is_port_available(port)
+
+
+def test_node_id_persistent():
+  a = get_or_create_node_id()
+  b = get_or_create_node_id()
+  assert a == b
+  assert len(a) >= 8
+
+
+def test_interface_priority_ordering():
+  assert get_interface_priority_and_type("docker0")[0] > get_interface_priority_and_type("lo")[0]
+  assert get_interface_priority_and_type("lo")[0] > get_interface_priority_and_type("eth0")[0]
+  assert get_interface_priority_and_type("eth0")[0] > get_interface_priority_and_type("wlan0")[0]
+  assert get_interface_priority_and_type("wlan0")[0] > get_interface_priority_and_type("tun0")[0]
+
+
+def test_prefix_dict():
+  d: PrefixDict[str, int] = PrefixDict()
+  d.add("llama", 1)
+  d.add("llama-3.2", 2)
+  assert d.find_longest_prefix("llama-3.2-1b") == ("llama-3.2", 2)
+  assert d.find_longest_prefix("qwen") is None
+
+
+def test_pretty_bytes():
+  assert pretty_bytes(512) == "512 B"
+  assert pretty_bytes(2 * 1024 * 1024) == "2.00 MB"

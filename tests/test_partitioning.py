@@ -1,0 +1,118 @@
+"""Partitioning math tests.
+
+Mirrors the reference's test_map_partitions.py:8-44 (coverage/contiguity edge
+cases) and test_ring_memory_weighted_partitioning_strategy.py:9-44 (memory
+weighting over a 3-node topology), reweighted to HBM.
+"""
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from xotorch_tpu.topology.partitioning import (
+  Partition,
+  RingMemoryWeightedPartitioningStrategy,
+  map_partitions_to_shards,
+)
+from xotorch_tpu.topology.topology import Topology
+
+
+def _caps(mem_mb: int) -> DeviceCapabilities:
+  return DeviceCapabilities(model="m", chip="c", memory=mem_mb, flops=DeviceFlops(0, 0, 0))
+
+
+def _check_cover(shards, n_layers):
+  assert shards[0].start_layer == 0
+  assert shards[-1].end_layer == n_layers - 1
+  for prev, cur in zip(shards, shards[1:]):
+    assert cur.start_layer == prev.end_layer + 1
+
+
+def test_map_partitions_even():
+  parts = [Partition("a", 0.0, 0.5), Partition("b", 0.5, 1.0)]
+  shards = map_partitions_to_shards(parts, 32, "m")
+  assert shards == [Shard("m", 0, 15, 32), Shard("m", 16, 31, 32)]
+
+
+def test_map_partitions_rounding_coverage():
+  parts = [Partition("a", 0.0, 0.42857), Partition("b", 0.42857, 0.71428), Partition("c", 0.71428, 1.0)]
+  shards = map_partitions_to_shards(parts, 32, "m")
+  _check_cover(shards, 32)
+
+
+def test_map_partitions_uneven_three():
+  parts = [Partition("a", 0.0, 0.1), Partition("b", 0.1, 0.2), Partition("c", 0.2, 1.0)]
+  shards = map_partitions_to_shards(parts, 10, "m")
+  _check_cover(shards, 10)
+
+
+def test_map_partitions_single():
+  shards = map_partitions_to_shards([Partition("a", 0.0, 1.0)], 16, "m")
+  assert shards == [Shard("m", 0, 15, 16)]
+
+
+def test_map_partitions_tiny_fractions_still_get_a_layer():
+  parts = [Partition("a", 0.0, 0.3), Partition("b", 0.3, 0.35), Partition("c", 0.35, 1.0)]
+  shards = map_partitions_to_shards(parts, 3, "m")
+  _check_cover(shards, 3)
+  assert all(s.get_layer_count() == 1 for s in shards)
+
+
+def test_map_partitions_more_peers_than_layers_rejected():
+  import pytest
+  parts = [Partition(str(i), i / 5, (i + 1) / 5) for i in range(5)]
+  with pytest.raises(ValueError):
+    map_partitions_to_shards(parts, 3, "m")
+
+
+def test_map_partitions_no_duplicate_ownership():
+  # Every layer owned exactly once for a spread of ring shapes.
+  for n_peers, n_layers in [(2, 3), (3, 7), (4, 32), (7, 8), (8, 80)]:
+    parts = [Partition(str(i), i / n_peers, (i + 1) / n_peers) for i in range(n_peers)]
+    shards = map_partitions_to_shards(parts, n_layers, "m")
+    owned = [l for s in shards for l in range(s.start_layer, s.end_layer + 1)]
+    assert owned == list(range(n_layers)), (n_peers, n_layers, shards)
+
+
+def test_ring_memory_weighted_strategy():
+  topo = Topology()
+  topo.update_node("n1", _caps(16000))
+  topo.update_node("n2", _caps(16000))
+  topo.update_node("n3", _caps(32000))
+  partitions = RingMemoryWeightedPartitioningStrategy().partition(topo)
+  assert len(partitions) == 3
+  # Largest memory first; deterministic tie-break by id descending.
+  assert partitions[0].node_id == "n3"
+  assert abs((partitions[0].end - partitions[0].start) - 0.5) < 1e-4
+  assert partitions[-1].end == 1.0
+  # Deterministic across peers: a second independent computation agrees.
+  assert RingMemoryWeightedPartitioningStrategy().partition(topo) == partitions
+
+
+def test_ring_strategy_zero_memory_falls_back_to_equal():
+  topo = Topology()
+  topo.update_node("a", _caps(0))
+  topo.update_node("b", _caps(0))
+  partitions = RingMemoryWeightedPartitioningStrategy().partition(topo)
+  assert len(partitions) == 2
+  assert abs((partitions[0].end - partitions[0].start) - 0.5) < 1e-6
+
+
+def test_shard_algebra():
+  s = Shard("m", 0, 15, 32)
+  assert s.is_first_layer and not s.is_last_layer
+  assert s.get_layer_count() == 16
+  assert s.overlaps(Shard("m", 10, 20, 32))
+  assert not s.overlaps(Shard("m", 16, 31, 32))
+  assert not s.overlaps(Shard("other", 0, 15, 32))
+  assert Shard.from_dict(s.to_dict()) == s
+
+
+def test_topology_merge_only_accepts_peer_origin():
+  topo = Topology()
+  other = Topology()
+  other.update_node("p", _caps(1))
+  other.update_node("q", _caps(2))  # not p's own info — must be rejected
+  other.add_edge("p", "q")
+  other.add_edge("q", "p")  # not originating from p — must be rejected
+  topo.merge("p", other)
+  assert set(dict(topo.all_nodes())) == {"p"}
+  assert topo.get_neighbors("p") == {"q"}
+  assert topo.get_neighbors("q") == set()

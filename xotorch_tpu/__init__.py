@@ -1,0 +1,15 @@
+"""xotorch_tpu — a TPU-native distributed LLM inference & training framework.
+
+Re-designed from scratch on JAX/XLA/Pallas/pjit with the capabilities of the
+reference runtime (shamantechnology/xotorch, an exo-v1 fork): a cluster of
+identical peers discovers itself, gossips a device-capability topology,
+partitions a model's layers into a memory-weighted ring (pipeline
+parallelism), and serves an OpenAI-compatible API — with each layer-range
+shard JIT-compiled to XLA, KV caches resident in HBM, and intra-slice hops
+over ICI collectives instead of gRPC.
+
+Reference parity anchor: /root/reference/xotorch/__init__.py:1.
+"""
+
+VERSION = "0.1.0"
+__version__ = VERSION

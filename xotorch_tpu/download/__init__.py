@@ -1,0 +1,3 @@
+from xotorch_tpu.download.shard_download import NoopShardDownloader, ShardDownloader
+
+__all__ = ["ShardDownloader", "NoopShardDownloader"]

@@ -1,0 +1,48 @@
+"""ShardDownloader ABC + Noop fake.
+
+Parity: /root/reference/xotorch/download/shard_download.py:9-50. Engines ask
+the downloader to materialise a shard's weight files locally; the downloader
+is layer-aware so each peer fetches only the safetensors files its layer
+range needs.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from pathlib import Path
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.utils.helpers import AsyncCallbackSystem
+
+
+class ShardDownloader(ABC):
+  @abstractmethod
+  async def ensure_shard(self, shard: Shard, inference_engine_name: str) -> Path:
+    """Make the weight files for `shard` available locally, returning the
+    model directory. Must dedupe concurrent calls for the same shard."""
+    ...
+
+  @property
+  @abstractmethod
+  def on_progress(self) -> AsyncCallbackSystem:
+    ...
+
+  async def get_shard_download_status(self, inference_engine_name: str) -> AsyncIterator[tuple]:
+    if False:
+      yield  # pragma: no cover
+
+
+class NoopShardDownloader(ShardDownloader):
+  def __init__(self) -> None:
+    self._on_progress: AsyncCallbackSystem = AsyncCallbackSystem()
+
+  async def ensure_shard(self, shard: Shard, inference_engine_name: str) -> Path:
+    return Path("/tmp/noop_shard")
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem:
+    return self._on_progress
+
+  async def get_shard_download_status(self, inference_engine_name: str) -> AsyncIterator[tuple]:
+    if False:
+      yield

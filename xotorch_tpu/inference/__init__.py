@@ -1,0 +1,4 @@
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.inference.engine import InferenceEngine, get_inference_engine
+
+__all__ = ["Shard", "InferenceEngine", "get_inference_engine"]

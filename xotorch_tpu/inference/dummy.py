@@ -1,0 +1,53 @@
+"""Deterministic fake engine for orchestration/networking tests and CLI dry runs.
+
+Parity: /root/reference/xotorch/inference/dummy_inference_engine.py:7-38 —
+identity forward (+1 on the last shard), EOS after 10 sampled tokens. The
+orchestration and transport layers are tested entirely against this fake so
+the distributed logic needs no accelerator (SURVEY §4 pattern).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.inference.engine import InferenceEngine
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.inference.tokenizers import DummyTokenizer
+
+
+class DummyInferenceEngine(InferenceEngine):
+  def __init__(self) -> None:
+    self.session = {}
+    self.shard: Optional[Shard] = None
+    self.tokenizer = DummyTokenizer()
+    self.num_generate_dummy_tokens = 10
+    self._count = 0
+
+  async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
+    await self.ensure_shard(shard)
+    return np.array(self.tokenizer.encode(prompt), dtype=np.int64)
+
+  async def sample(self, x: np.ndarray, temp: float = 0.0, top_k: int = 0) -> np.ndarray:
+    # Count-based EOS so ring tests terminate deterministically.
+    self._count += 1
+    if self._count >= self.num_generate_dummy_tokens:
+      self._count = 0
+      return np.array([self.tokenizer.eos_token_id])
+    return np.array([np.argmax(x[0, -1]) % self.tokenizer.vocab_size if x.ndim == 3 else 1])
+
+  async def decode(self, shard: Shard, tokens: np.ndarray) -> str:
+    await self.ensure_shard(shard)
+    return self.tokenizer.decode(tokens)
+
+  async def infer_tensor(self, request_id: str, shard: Shard, input_data: np.ndarray, inference_state=None) -> Tuple[np.ndarray, Optional[dict]]:
+    await self.ensure_shard(shard)
+    if input_data.ndim == 2:  # token ids -> fake hidden state
+      x = input_data[..., None].astype(np.float32) * np.ones((1, 1, 8), dtype=np.float32)
+    else:
+      x = input_data.astype(np.float32)
+    out = x + 1 if shard.is_last_layer else x
+    return out, inference_state
+
+  async def ensure_shard(self, shard: Shard) -> None:
+    self.shard = shard

@@ -1,0 +1,100 @@
+"""InferenceEngine ABC + factory.
+
+Parity: /root/reference/xotorch/inference/inference_engine.py:11-74, extended
+with the train/evaluate leaves the reference declared but never implemented
+(node.py:317,324,333 call them; no engine defines them — SURVEY §0). Engines
+work on numpy at the boundary: the orchestration/wire layers never see device
+arrays, so the same Node drives the JAX engine on TPU and the dummy engine in
+tests.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.inference.shard import Shard
+
+
+class InferenceEngine(ABC):
+  """One peer's compute backend for a layer-range shard."""
+
+  session: Dict[str, Any]
+
+  @abstractmethod
+  async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
+    ...
+
+  @abstractmethod
+  async def sample(self, x: np.ndarray, temp: float = 0.0, top_k: int = 0) -> np.ndarray:
+    ...
+
+  @abstractmethod
+  async def decode(self, shard: Shard, tokens: np.ndarray) -> str:
+    ...
+
+  @abstractmethod
+  async def infer_tensor(
+    self, request_id: str, shard: Shard, input_data: np.ndarray, inference_state: Optional[dict] = None
+  ) -> Tuple[np.ndarray, Optional[dict]]:
+    """Run this shard's layers. 2-D int input = token ids (first shard);
+    3-D float input = hidden state from the previous shard in the ring.
+    Dispatch-on-ndim parity: sharded_inference_engine.py:254-263."""
+    ...
+
+  @abstractmethod
+  async def ensure_shard(self, shard: Shard) -> None:
+    ...
+
+  async def infer_prompt(
+    self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None
+  ) -> Tuple[np.ndarray, Optional[dict]]:
+    tokens = await self.encode(shard, prompt)
+    x = tokens.reshape(1, -1)
+    return await self.infer_tensor(request_id, shard, x, inference_state)
+
+  async def load_checkpoint(self, shard: Shard, path: str) -> None:
+    pass
+
+  async def save_checkpoint(self, shard: Shard, path: str) -> None:
+    pass
+
+  async def save_session(self, key: str, value: Any) -> None:
+    self.session[key] = value
+
+  async def clear_session(self) -> None:
+    self.session.clear()
+
+  async def train(
+    self, request_id: str, shard: Shard, inputs: np.ndarray, targets: np.ndarray, lengths: np.ndarray, loss: str = "sparse_ce"
+  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Full train leaf (loss, grad-wrt-input). Implemented by the JAX engine;
+    the reference declared this but never implemented it (SURVEY §0)."""
+    raise NotImplementedError(f"{type(self).__name__} does not support training")
+
+  async def evaluate(self, request_id: str, shard: Shard, inputs: np.ndarray, targets: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    raise NotImplementedError(f"{type(self).__name__} does not support evaluation")
+
+
+# Engine registry: every alias -> canonical classname. The model registry keys
+# HF repos by engine classname (mirroring models.py:4-192 in the reference),
+# and the factory below drives off this same table.
+inference_engine_classes: Dict[str, str] = {
+  "jax": "JAXShardInferenceEngine",
+  "tpu": "JAXShardInferenceEngine",
+  "JAXShardInferenceEngine": "JAXShardInferenceEngine",
+  "dummy": "DummyInferenceEngine",
+  "DummyInferenceEngine": "DummyInferenceEngine",
+}
+
+
+def get_inference_engine(inference_engine_name: str, shard_downloader=None) -> InferenceEngine:
+  classname = inference_engine_classes.get(inference_engine_name)
+  if classname == "JAXShardInferenceEngine":
+    from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+    return JAXShardInferenceEngine(shard_downloader)
+  if classname == "DummyInferenceEngine":
+    from xotorch_tpu.inference.dummy import DummyInferenceEngine
+    return DummyInferenceEngine()
+  raise ValueError(f"Unsupported inference engine: {inference_engine_name}")

@@ -1,0 +1,59 @@
+"""Tokenizer resolution: local dir first, HF AutoProcessor/AutoTokenizer fallback.
+
+Parity: /root/reference/xotorch/inference/tokenizers.py:11-63. The processor
+patching (eos/encode/decode surface) is preserved so vision-capable models
+expose the plain-tokenizer interface the rest of the stack expects.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Union
+
+from xotorch_tpu.utils.helpers import DEBUG
+
+
+class DummyTokenizer:
+  """Fixed-vocab fake (parity: tokenizers.py:11-23)."""
+
+  def __init__(self) -> None:
+    self.eos_token_id = 69
+    self.vocab_size = 1000
+
+  def apply_chat_template(self, messages, tokenize: bool = True, add_generation_prompt: bool = True, tools=None) -> str:
+    return "dummy_tokenized_prompt"
+
+  def encode(self, text: str) -> List[int]:
+    return [1] * max(1, len(text.split()))
+
+  def decode(self, tokens) -> str:
+    return "dummy" + " dummy" * (len(tokens) - 1) if len(tokens) else ""
+
+
+async def resolve_tokenizer(model_id_or_path: Union[str, "os.PathLike"], allow_dummy: bool = True):
+  if str(model_id_or_path) in ("dummy", "dummy-model") and allow_dummy:
+    return DummyTokenizer()
+  return await _resolve_hf_tokenizer(str(model_id_or_path))
+
+
+async def _resolve_hf_tokenizer(repo_or_path: str):
+  from transformers import AutoProcessor, AutoTokenizer
+
+  try:
+    if DEBUG >= 4:
+      print(f"Trying AutoProcessor for {repo_or_path}")
+    processor = AutoProcessor.from_pretrained(repo_or_path, use_fast=True, trust_remote_code=True)
+    inner = getattr(processor, "tokenizer", None)
+    if inner is not None:
+      # Surface the plain-tokenizer API on the processor (parity :44-50).
+      if not hasattr(processor, "eos_token_id") or processor.eos_token_id is None:
+        processor.eos_token_id = inner.eos_token_id
+      if not hasattr(processor, "encode"):
+        processor.encode = inner.encode
+      if not hasattr(processor, "decode"):
+        processor.decode = inner.decode
+    return processor
+  except Exception as e:
+    if DEBUG >= 4:
+      print(f"AutoProcessor failed for {repo_or_path}: {e!r}; falling back to AutoTokenizer")
+
+  return AutoTokenizer.from_pretrained(repo_or_path, trust_remote_code=True)

@@ -1,0 +1,26 @@
+from xotorch_tpu.topology.device_capabilities import (
+  DeviceCapabilities,
+  DeviceFlops,
+  UNKNOWN_DEVICE_CAPABILITIES,
+  device_capabilities,
+)
+from xotorch_tpu.topology.topology import PeerConnection, Topology
+from xotorch_tpu.topology.partitioning import (
+  Partition,
+  PartitioningStrategy,
+  RingMemoryWeightedPartitioningStrategy,
+  map_partitions_to_shards,
+)
+
+__all__ = [
+  "DeviceCapabilities",
+  "DeviceFlops",
+  "UNKNOWN_DEVICE_CAPABILITIES",
+  "device_capabilities",
+  "PeerConnection",
+  "Topology",
+  "Partition",
+  "PartitioningStrategy",
+  "RingMemoryWeightedPartitioningStrategy",
+  "map_partitions_to_shards",
+]

@@ -1,0 +1,233 @@
+"""Device capability probing — TPU-first.
+
+Parity: /root/reference/xotorch/topology/device_capabilities.py:22-164, which
+carries a static TFLOPS table for ~80 GPU/Apple chips and probes via
+system_profiler/pynvml. This build inverts the priority: the primary probe is
+the JAX runtime (`jax.devices()`) reporting TPU generation, per-chip HBM and
+ICI coordinates; CUDA-through-torch and psutil CPU probes are the fallbacks so
+mixed TPU+CPU dev rings still partition sensibly (SURVEY §7.4.7).
+
+Memory is reported in MB of *accelerator* memory (HBM on TPU) because the ring
+partitioning strategy weights by it — the TPU analogue of the reference's
+RAM weighting.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional
+
+from xotorch_tpu.utils.helpers import DEBUG
+
+TFLOPS = 1.00
+
+
+@dataclass(frozen=True)
+class DeviceFlops:
+  # units of TFLOPS
+  fp32: float
+  fp16: float  # bf16 on TPU
+  int8: float
+
+  def to_dict(self) -> Dict[str, float]:
+    return asdict(self)
+
+
+@dataclass
+class DeviceCapabilities:
+  model: str
+  chip: str
+  memory: int  # MB of accelerator (HBM) or host memory
+  flops: DeviceFlops
+  num_devices: int = 1
+  ici_topology: Optional[List[int]] = None  # e.g. [2, 2] mesh shape within slice
+
+  def __str__(self) -> str:
+    return (
+      f"Model: {self.model}. Chip: {self.chip}. Memory: {self.memory}MB. "
+      f"Flops: fp32 {self.flops.fp32:.2f} TFLOPS, fp16/bf16 {self.flops.fp16:.2f} TFLOPS, int8 {self.flops.int8:.2f} TFLOPS"
+    )
+
+  def model_dump(self) -> Dict[str, Any]:
+    d = asdict(self)
+    d["flops"] = self.flops.to_dict()
+    return d
+
+  def to_dict(self) -> Dict[str, Any]:
+    return self.model_dump()
+
+  @classmethod
+  def from_dict(cls, data: Dict[str, Any]) -> "DeviceCapabilities":
+    flops = data.get("flops", {})
+    return cls(
+      model=data.get("model", "Unknown Model"),
+      chip=data.get("chip", "Unknown Chip"),
+      memory=int(data.get("memory", 0)),
+      flops=DeviceFlops(
+        fp32=float(flops.get("fp32", 0)), fp16=float(flops.get("fp16", 0)), int8=float(flops.get("int8", 0))
+      ),
+      num_devices=int(data.get("num_devices", 1)),
+      ici_topology=data.get("ici_topology"),
+    )
+
+
+UNKNOWN_DEVICE_CAPABILITIES = DeviceCapabilities(
+  model="Unknown Model", chip="Unknown Chip", memory=0, flops=DeviceFlops(fp32=0, fp16=0, int8=0)
+)
+
+# Public per-chip peak numbers (bf16 dense TFLOP/s, HBM GB).
+# fp32 on TPU ≈ bf16/2 via the MXU's fp32-accumulate path; int8 2× bf16 where
+# supported. This is the TPU analogue of the reference's CHIP_FLOPS table
+# (device_capabilities.py:54-164).
+TPU_CHIP_SPECS: Dict[str, Dict[str, float]] = {
+  "v2": {"bf16": 22.5, "hbm_gb": 8},
+  "v3": {"bf16": 61.5, "hbm_gb": 16},
+  "v4": {"bf16": 137.5, "hbm_gb": 16},  # per-core reporting; a v4 chip = 2 cores = 275
+  "v5e": {"bf16": 197.0, "hbm_gb": 16},
+  "v5p": {"bf16": 229.5, "hbm_gb": 47.5},
+  "v6e": {"bf16": 918.0, "hbm_gb": 32},
+}
+
+# Minimal GPU table for mixed dev rings (fallback path only).
+GPU_CHIP_FLOPS: Dict[str, DeviceFlops] = {
+  "NVIDIA H100": DeviceFlops(fp32=67.0 * TFLOPS, fp16=989.0 * TFLOPS, int8=1979.0 * TFLOPS),
+  "NVIDIA A100": DeviceFlops(fp32=19.5 * TFLOPS, fp16=312.0 * TFLOPS, int8=624.0 * TFLOPS),
+  "NVIDIA RTX 4090": DeviceFlops(fp32=82.58 * TFLOPS, fp16=165.16 * TFLOPS, int8=330.32 * TFLOPS),
+  "NVIDIA RTX 3060": DeviceFlops(fp32=12.74 * TFLOPS, fp16=25.48 * TFLOPS, int8=50.96 * TFLOPS),
+}
+
+
+def _tpu_kind_to_key(kind: str) -> Optional[str]:
+  kind = kind.lower().replace(" ", "")
+  for key in ("v6e", "v5p", "v5e", "v5litepod", "v4", "v3", "v2"):
+    if key in kind:
+      return "v5e" if key == "v5litepod" else key
+  return None
+
+
+def _probe_jax_sync() -> Optional[DeviceCapabilities]:
+  """Probe the local JAX runtime. Returns None when JAX has no accelerators."""
+  try:
+    import jax
+    devices = jax.local_devices()
+  except Exception as e:
+    if DEBUG >= 2:
+      print(f"JAX probe failed: {e!r}")
+    return None
+  if not devices:
+    return None
+  d0 = devices[0]
+  platform = d0.platform
+  if platform == "tpu":
+    kind = getattr(d0, "device_kind", "tpu")
+    key = _tpu_kind_to_key(str(kind)) or "v5e"
+    spec = TPU_CHIP_SPECS.get(key, TPU_CHIP_SPECS["v5e"])
+    per_chip_hbm_mb = int(spec["hbm_gb"] * 1024)
+    try:
+      stats = d0.memory_stats()
+      if stats and "bytes_limit" in stats:
+        per_chip_hbm_mb = int(stats["bytes_limit"] / (1024 * 1024))
+    except Exception:
+      pass
+    n = len(devices)
+    coords = sorted({getattr(d, "coords", None) for d in devices if getattr(d, "coords", None)})
+    ici = None
+    if coords and all(c is not None for c in coords):
+      dims = len(coords[0])
+      ici = [len({c[i] for c in coords}) for i in range(dims)]
+    bf16 = spec["bf16"]
+    return DeviceCapabilities(
+      model=f"Google TPU {key} x{n}",
+      chip=f"TPU {key}",
+      memory=per_chip_hbm_mb * n,
+      flops=DeviceFlops(fp32=bf16 / 2 * n, fp16=bf16 * n, int8=bf16 * 2 * n),
+      num_devices=n,
+      ici_topology=ici,
+    )
+  if platform == "gpu":
+    name = str(getattr(d0, "device_kind", "Unknown GPU"))
+    flops = next((f for k, f in GPU_CHIP_FLOPS.items() if k.lower() in name.lower() or name.lower() in k.lower()),
+                 DeviceFlops(fp32=10.0, fp16=20.0, int8=40.0))
+    mem_mb = 8 * 1024
+    try:
+      stats = d0.memory_stats()
+      if stats and "bytes_limit" in stats:
+        mem_mb = int(stats["bytes_limit"] / (1024 * 1024))
+    except Exception:
+      pass
+    n = len(devices)
+    return DeviceCapabilities(
+      model=f"{name} x{n}", chip=name, memory=mem_mb * n,
+      flops=DeviceFlops(fp32=flops.fp32 * n, fp16=flops.fp16 * n, int8=flops.int8 * n),
+      num_devices=n,
+    )
+  return None  # cpu platform -> use the host probe for better memory numbers
+
+
+def _probe_host_sync() -> DeviceCapabilities:
+  import platform as _platform
+  try:
+    import psutil
+    mem_mb = psutil.virtual_memory().total // (1024 * 1024)
+    cores = psutil.cpu_count(logical=False) or os.cpu_count() or 1
+  except Exception:
+    mem_mb, cores = 8 * 1024, os.cpu_count() or 1
+  # ~50 GFLOPS fp32/core is a serviceable planning number for modern x86/arm.
+  per_core = 0.05
+  return DeviceCapabilities(
+    model=f"{_platform.system()} CPU ({_platform.machine()})",
+    chip=_platform.processor() or _platform.machine() or "CPU",
+    memory=int(mem_mb),
+    flops=DeviceFlops(fp32=per_core * cores, fp16=per_core * cores * 2, int8=per_core * cores * 4),
+    num_devices=1,
+  )
+
+
+_cached_capabilities: Optional[DeviceCapabilities] = None
+_probe_future: Optional["asyncio.Future"] = None
+
+
+async def device_capabilities() -> DeviceCapabilities:
+  """Async probe with caching and a timeout.
+
+  The JAX backend init can take tens of seconds on a remote/tunneled TPU; if
+  it exceeds XOT_PROBE_TIMEOUT (default 120 s) the host fallback is reported
+  so a node still joins the ring, and the probe keeps running to upgrade the
+  cached value when it eventually lands.
+  """
+  global _cached_capabilities, _probe_future
+  if _cached_capabilities is not None:
+    return _cached_capabilities
+  timeout = float(os.getenv("XOT_PROBE_TIMEOUT", "120"))
+  loop = asyncio.get_running_loop()
+  if _probe_future is None:
+    # Single in-flight probe: JAX backend init is not thread-safe and slow
+    # on tunneled TPUs, so repeat callers (topology gossip) share the future.
+    _probe_future = loop.run_in_executor(None, device_capabilities_sync)
+
+    def _store(fut) -> None:
+      global _cached_capabilities, _probe_future
+      if fut.cancelled() or fut.exception() is not None:
+        _probe_future = None
+        return
+      _cached_capabilities = fut.result()
+
+    _probe_future.add_done_callback(_store)
+  try:
+    return await asyncio.wait_for(asyncio.shield(_probe_future), timeout)
+  except asyncio.TimeoutError:
+    if DEBUG >= 1:
+      print(f"Device probe exceeded {timeout}s; reporting host capabilities for now")
+    return _probe_host_sync()
+
+
+def device_capabilities_sync() -> DeviceCapabilities:
+  caps = None
+  if os.getenv("XOT_SKIP_JAX_PROBE", "0") != "1":
+    caps = _probe_jax_sync()
+  if caps is None:
+    caps = _probe_host_sync()
+  if DEBUG >= 1:
+    print(f"Device capabilities: {caps}")
+  return caps

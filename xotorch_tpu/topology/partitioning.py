@@ -1,0 +1,73 @@
+"""Layer partitioning: [0,1) fractions -> contiguous layer ranges per peer.
+
+Parity: /root/reference/xotorch/topology/partitioning_strategy.py:18-42 and
+ring_memory_weighted_partitioning_strategy.py:8-18, with the weighting moved
+from host RAM to *accelerator memory* (HBM on TPU peers) — the reference's
+RAM proxy is wrong on TPU hosts where model residency is bounded by HBM.
+
+The strategy is deterministic given a topology, so every peer computes the
+identical ring without any coordination round — the property the whole
+masterless design rests on.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.topology.topology import Topology
+
+
+@dataclass(frozen=True)
+class Partition:
+  node_id: str
+  start: float  # inclusive, in [0, 1)
+  end: float  # exclusive
+
+
+class PartitioningStrategy(ABC):
+  @abstractmethod
+  def partition(self, topology: Topology) -> List[Partition]:
+    ...
+
+
+def map_partitions_to_shards(partitions: List[Partition], num_layers: int, model_id: str) -> List[Shard]:
+  """Convert float partitions into contiguous integer layer ranges covering
+  exactly [0, num_layers). Rounding fix-ups (parity :24-42): the last shard
+  absorbs the tail; empty middle shards are avoided by end>=start clamping."""
+  if not partitions:
+    return []
+  if len(partitions) > num_layers:
+    # A Shard is a non-empty contiguous range, so a ring with more peers
+    # than layers is unrepresentable; callers must shrink the ring first.
+    raise ValueError(f"Cannot partition {num_layers} layers across {len(partitions)} peers")
+  shards: List[Shard] = []
+  for i, partition in enumerate(partitions):
+    start_layer = shards[-1].end_layer + 1 if shards else 0
+    end_layer = num_layers - 1 if i == len(partitions) - 1 else int(round(partition.end * num_layers)) - 1
+    # Every peer gets >=1 layer; leave enough tail layers for later peers.
+    end_layer = min(max(end_layer, start_layer), num_layers - (len(partitions) - i))
+    shards.append(Shard(model_id, start_layer, end_layer, num_layers))
+  return shards
+
+
+class RingMemoryWeightedPartitioningStrategy(PartitioningStrategy):
+  """Allocate [0,1) fractions proportional to each node's accelerator memory,
+  nodes ordered by (memory desc, id) so the ring is identical on every peer.
+  Parity: ring_memory_weighted_partitioning_strategy.py:8-18 (RAM -> HBM)."""
+
+  def partition(self, topology: Topology) -> List[Partition]:
+    nodes = sorted(topology.all_nodes(), key=lambda x: (x[1].memory, x[0]), reverse=True)
+    total_memory = sum(caps.memory for _, caps in nodes)
+    if total_memory == 0:
+      # All-unknown ring: equal split keeps dev clusters functional.
+      n = max(1, len(nodes))
+      return [Partition(node_id, i / n, (i + 1) / n) for i, (node_id, _) in enumerate(nodes)]
+    partitions: List[Partition] = []
+    start = 0.0
+    for node_id, caps in nodes:
+      end = round(start + caps.memory / total_memory, 5)
+      partitions.append(Partition(node_id, start, end))
+      start = end
+    return partitions

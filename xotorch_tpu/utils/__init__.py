@@ -1,0 +1,29 @@
+from xotorch_tpu.utils.helpers import (
+  DEBUG,
+  DEBUG_DISCOVERY,
+  AsyncCallback,
+  AsyncCallbackSystem,
+  PrefixDict,
+  find_available_port,
+  get_all_ip_addresses_and_interfaces,
+  get_interface_priority_and_type,
+  get_or_create_node_id,
+  is_port_available,
+  pretty_bytes,
+  shutdown,
+)
+
+__all__ = [
+  "DEBUG",
+  "DEBUG_DISCOVERY",
+  "AsyncCallback",
+  "AsyncCallbackSystem",
+  "PrefixDict",
+  "find_available_port",
+  "get_all_ip_addresses_and_interfaces",
+  "get_interface_priority_and_type",
+  "get_or_create_node_id",
+  "is_port_available",
+  "pretty_bytes",
+  "shutdown",
+]

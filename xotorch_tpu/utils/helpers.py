@@ -1,0 +1,227 @@
+"""Foundation utilities: debug flags, async pub/sub, ports, node identity, NICs.
+
+Capability parity with the reference foundation layer
+(/root/reference/xotorch/helpers.py:19-389) re-implemented for this runtime:
+psutil-based NIC enumeration (the reference shells out to scapy/system_profiler),
+asyncio-native callback conditions, and tmp-dir persisted node identity.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import socket
+import tempfile
+import uuid
+from typing import Awaitable, Callable, Dict, Generic, List, Optional, Tuple, TypeVar
+
+DEBUG = int(os.getenv("DEBUG", "0"))
+DEBUG_DISCOVERY = int(os.getenv("DEBUG_DISCOVERY", "0"))
+
+T = TypeVar("T")
+K = TypeVar("K")
+
+
+class AsyncCallback(Generic[T]):
+  """A single awaitable event stream: observers plus a predicate-gated wait.
+
+  Parity: AsyncCallback (/root/reference/xotorch/helpers.py:104-133).
+  """
+
+  def __init__(self) -> None:
+    self.condition: asyncio.Condition = asyncio.Condition()
+    self.result: Optional[Tuple[T, ...]] = None
+    self.observers: List[Callable[..., None]] = []
+
+  async def wait(self, check_condition: Callable[..., bool], timeout: Optional[float] = None) -> Tuple[T, ...]:
+    async with self.condition:
+      await asyncio.wait_for(
+        self.condition.wait_for(lambda: self.result is not None and check_condition(*self.result)),
+        timeout,
+      )
+      assert self.result is not None
+      return self.result
+
+  def on_next(self, callback: Callable[..., None]) -> None:
+    self.observers.append(callback)
+
+  def set(self, *args: T) -> None:
+    self.result = args
+    for observer in self.observers:
+      observer(*args)
+    asyncio.create_task(self._notify())
+
+  async def _notify(self) -> None:
+    async with self.condition:
+      self.condition.notify_all()
+
+
+class AsyncCallbackSystem(Generic[K, T]):
+  """Named registry of AsyncCallbacks with broadcast trigger.
+
+  Parity: AsyncCallbackSystem (/root/reference/xotorch/helpers.py:136-149).
+  """
+
+  def __init__(self) -> None:
+    self.callbacks: Dict[K, AsyncCallback[T]] = {}
+
+  def register(self, name: K) -> AsyncCallback[T]:
+    if name not in self.callbacks:
+      self.callbacks[name] = AsyncCallback[T]()
+    return self.callbacks[name]
+
+  def deregister(self, name: K) -> None:
+    self.callbacks.pop(name, None)
+
+  def trigger(self, name: K, *args: T) -> None:
+    if name in self.callbacks:
+      self.callbacks[name].set(*args)
+
+  def trigger_all(self, *args: T) -> None:
+    for callback in list(self.callbacks.values()):
+      callback.set(*args)
+
+
+class PrefixDict(Generic[K, T]):
+  """Dict queryable by key prefix (parity: helpers.py:329-343)."""
+
+  def __init__(self) -> None:
+    self._data: Dict[str, T] = {}
+
+  def add(self, key: str, value: T) -> None:
+    self._data[key] = value
+
+  def find_prefix(self, argument: str) -> List[Tuple[str, T]]:
+    return [(key, value) for key, value in self._data.items() if argument.startswith(key)]
+
+  def find_longest_prefix(self, argument: str) -> Optional[Tuple[str, T]]:
+    matches = self.find_prefix(argument)
+    if not matches:
+      return None
+    return max(matches, key=lambda x: len(x[0]))
+
+
+def is_port_available(port: int, host: str = "") -> bool:
+  with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+      s.bind((host, port))
+      return True
+    except OSError:
+      return False
+
+
+def _used_ports_file() -> str:
+  return os.path.join(tempfile.gettempdir(), "xot_tpu_used_ports")
+
+
+def find_available_port(host: str = "", min_port: int = 49152, max_port: int = 65535) -> int:
+  """Random free port, avoiding ports this host's processes recently claimed.
+
+  The cross-process used-ports file mirrors the reference behavior
+  (/root/reference/xotorch/helpers.py:47-76) so several peers starting at
+  once on one machine don't race for the same port.
+  """
+  used: List[int] = []
+  try:
+    with open(_used_ports_file(), "r") as f:
+      used = [int(line) for line in f.read().split() if line.strip().isdigit()]
+  except OSError:
+    pass
+  used = used[-100:]
+  for _ in range(200):
+    port = random.randint(min_port, max_port)
+    if port not in used and is_port_available(port, host):
+      try:
+        with open(_used_ports_file(), "w") as f:
+          f.write("\n".join(str(p) for p in used + [port]))
+      except OSError:
+        pass
+      return port
+  raise RuntimeError("No available ports in range")
+
+
+def get_or_create_node_id() -> str:
+  """Persistent per-machine node UUID (parity: helpers.py:182-205)."""
+  if os.getenv("XOT_UUID"):
+    return os.environ["XOT_UUID"]
+  id_file = os.path.join(tempfile.gettempdir(), ".xot_tpu_node_id")
+  try:
+    if os.path.isfile(id_file):
+      with open(id_file, "r") as f:
+        stored = f.read().strip()
+      if stored:
+        return stored
+    node_id = str(uuid.uuid4())
+    with open(id_file, "w") as f:
+      f.write(node_id)
+    return node_id
+  except OSError:
+    return str(uuid.uuid4())
+
+
+def get_all_ip_addresses_and_interfaces() -> List[Tuple[str, str]]:
+  """All (ipv4, interface) pairs on this host, loopback last.
+
+  psutil-based (the reference used scapy, helpers.py:234-248); falls back to
+  a loopback entry so single-machine dev always works.
+  """
+  try:
+    import psutil
+    pairs: List[Tuple[str, str]] = []
+    for ifname, addrs in psutil.net_if_addrs().items():
+      for addr in addrs:
+        if addr.family == socket.AF_INET and addr.address:
+          pairs.append((addr.address, ifname))
+    pairs.sort(key=lambda p: p[0].startswith("127."))
+    if pairs:
+      return pairs
+  except Exception:
+    pass
+  return [("127.0.0.1", "lo")]
+
+
+def get_interface_priority_and_type(ifname: str) -> Tuple[int, str]:
+  """Rank an interface for peer-address conflict resolution.
+
+  Same ordering intent as the reference (helpers.py:280-315): container >
+  loopback > point-to-point fabric > ethernet > wifi > other > vpn.
+  """
+  name = ifname.lower()
+  if name.startswith(("docker", "br-", "veth", "cni", "flannel", "calico")):
+    return (7, "Container Virtual")
+  if name.startswith("lo"):
+    return (6, "Loopback")
+  if name.startswith(("ib", "bond", "thunderbolt")):
+    return (5, "Fabric")
+  if name.startswith(("eth", "en", "eno", "ens", "enp")):
+    return (4, "Ethernet")
+  if name.startswith(("wl", "wifi", "wlan")):
+    return (3, "WiFi")
+  if name.startswith(("tun", "tap", "vpn", "wg", "utun", "zt", "ts")):
+    return (1, "VPN")
+  return (2, "Other")
+
+
+def pretty_bytes(size_in_bytes: float) -> str:
+  for unit, divisor in (("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+    if size_in_bytes >= divisor:
+      return f"{size_in_bytes / divisor:.2f} {unit}"
+  return f"{int(size_in_bytes)} B"
+
+
+async def shutdown(signal_or_none, loop: asyncio.AbstractEventLoop, server) -> None:
+  """Cancel outstanding tasks and stop the node (parity: helpers.py:318-326)."""
+  if DEBUG >= 1:
+    print(f"Received exit signal {signal_or_none}; shutting down")
+  tasks = [t for t in asyncio.all_tasks(loop) if t is not asyncio.current_task()]
+  for task in tasks:
+    task.cancel()
+  await asyncio.gather(*tasks, return_exceptions=True)
+  if server is not None:
+    stop = getattr(server, "stop", None)
+    if stop is not None:
+      result = stop()
+      if isinstance(result, Awaitable):
+        await result
+  loop.stop()
