@@ -1,0 +1,134 @@
+"""Benchmark: single-chip greedy decode throughput on the flagship model.
+
+Measures the reference's own two native metrics (BASELINE.md): aggregate
+output tokens/sec at the sampler (the chat-TUI method, chat_tui.py:121-128)
+and per-token latency, plus TTFT for the prefill path. Config #1 of
+BASELINE.json: Llama-3.2-1B-shaped model, greedy decode, one device.
+
+Zero-egress environment: weights are synthetic (same shapes/dtype as
+Llama-3.2-1B, bf16); throughput is compute-bound so tok/s is representative.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+vs_baseline compares against BENCH_BASELINE.json (written on first run, so
+round 1 establishes the baseline the reference never published).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).parent
+
+
+def log(msg: str) -> None:
+  print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+  prefill_len = int(os.getenv("BENCH_PREFILL", "128"))
+  decode_tokens = int(os.getenv("BENCH_DECODE", "128"))
+  model_id = os.getenv("BENCH_MODEL", "synthetic-llama-1b")
+
+  t0 = time.time()
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  if os.getenv("BENCH_CPU", "0") == "1":
+    jax.config.update("jax_platforms", "cpu")
+  devices = jax.devices()
+  log(f"devices: {devices} (init {time.time()-t0:.1f}s)")
+
+  from functools import partial
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.models.transformer import forward_shard, init_kv_cache, init_random_params
+
+  cfg = config_from_hf_dict(model_cards[model_id]["synthetic_config"])
+  n = cfg.num_layers
+  cache_len = int(os.getenv("BENCH_CACHE_LEN", "1024"))
+
+  t0 = time.time()
+  params = init_random_params(cfg, n, True, True, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+  params = jax.tree.map(lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, params)
+  log(f"params built ({time.time()-t0:.1f}s)")
+
+  fwd = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True), donate_argnums=(2,))
+
+  cache = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
+  prompt = jnp.asarray(np.random.randint(0, cfg.vocab_size, (1, prefill_len)), jnp.int32)
+
+  # --- prefill (TTFT) ---
+  t0 = time.time()
+  logits, cache = fwd(params, prompt, cache, jnp.int32(0))
+  logits.block_until_ready()
+  ttft_compile = time.time() - t0
+  log(f"prefill compile+run: {ttft_compile:.2f}s")
+
+  # warm decode compile
+  tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+  t0 = time.time()
+  logits, cache = fwd(params, tok, cache, jnp.int32(prefill_len))
+  logits.block_until_ready()
+  log(f"decode compile+run: {time.time()-t0:.2f}s")
+
+  # steady-state TTFT (cached executable)
+  cache2 = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
+  t0 = time.time()
+  lg, cache2 = fwd(params, prompt, cache2, jnp.int32(0))
+  lg.block_until_ready()
+  ttft = time.time() - t0
+  del cache2
+
+  # --- decode loop (sampler-side tok/s, chat-TUI method) ---
+  pos = prefill_len + 1
+  tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+  t0 = time.time()
+  for i in range(decode_tokens):
+    logits, cache = fwd(params, tok, cache, jnp.int32(pos + i))
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+  tok.block_until_ready()
+  elapsed = time.time() - t0
+  toks_per_sec = decode_tokens / elapsed
+  per_token_ms = 1000 * elapsed / decode_tokens
+  log(f"decode: {decode_tokens} tokens in {elapsed:.2f}s -> {toks_per_sec:.1f} tok/s, {per_token_ms:.2f} ms/tok, TTFT {ttft*1000:.1f} ms")
+
+  # Baselines are per-platform (a CPU smoke run must not become the TPU bar).
+  platform = devices[0].platform
+  baseline_file = REPO / "BENCH_BASELINE.json"
+  baselines = {}
+  if baseline_file.exists():
+    try:
+      baselines = json.loads(baseline_file.read_text())
+    except json.JSONDecodeError:
+      baselines = {}
+  key = f"{model_id}:{platform}"
+  baseline = baselines.get(key, {}).get("tok_s")
+  if baseline is None:
+    baseline = toks_per_sec
+    baselines[key] = {
+      "tok_s": toks_per_sec, "per_token_ms": per_token_ms, "ttft_ms": ttft * 1000,
+      "recorded": time.strftime("%Y-%m-%d"),
+    }
+    try:
+      baseline_file.write_text(json.dumps(baselines, indent=2))
+    except OSError:
+      pass
+
+  print(json.dumps({
+    "metric": f"decode_tok_s_{model_id.replace('-', '_')}_bf16_1chip",
+    "value": round(toks_per_sec, 2),
+    "unit": "tok/s",
+    "vs_baseline": round(toks_per_sec / baseline, 3) if baseline else 1.0,
+    "per_token_ms": round(per_token_ms, 2),
+    "ttft_ms": round(ttft * 1000, 1),
+    "platform": platform,
+  }))
+
+
+if __name__ == "__main__":
+  main()

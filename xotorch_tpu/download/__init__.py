@@ -1,3 +1,3 @@
-from xotorch_tpu.download.shard_download import NoopShardDownloader, ShardDownloader
+from xotorch_tpu.download.shard_download import LocalShardDownloader, NoopShardDownloader, ShardDownloader
 
-__all__ = ["ShardDownloader", "NoopShardDownloader"]
+__all__ = ["ShardDownloader", "NoopShardDownloader", "LocalShardDownloader"]

@@ -32,6 +32,31 @@ class ShardDownloader(ABC):
       yield  # pragma: no cover
 
 
+class LocalShardDownloader(ShardDownloader):
+  """Serve model dirs already on disk (offline clusters, tests).
+
+  Resolution order: explicit mapping passed to the constructor, then
+  `$XOT_MODEL_DIR/<model_id>` if it exists.
+  """
+
+  def __init__(self, mapping: Optional[Dict[str, Path]] = None) -> None:
+    self.mapping = {k: Path(v) for k, v in (mapping or {}).items()}
+    self._on_progress: AsyncCallbackSystem = AsyncCallbackSystem()
+
+  async def ensure_shard(self, shard: Shard, inference_engine_name: str) -> Path:
+    if shard.model_id in self.mapping:
+      return self.mapping[shard.model_id]
+    import os
+    root = os.getenv("XOT_MODEL_DIR")
+    if root and (Path(root) / shard.model_id).exists():
+      return Path(root) / shard.model_id
+    raise FileNotFoundError(f"No local model dir for {shard.model_id}")
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem:
+    return self._on_progress
+
+
 class NoopShardDownloader(ShardDownloader):
   def __init__(self) -> None:
     self._on_progress: AsyncCallbackSystem = AsyncCallbackSystem()

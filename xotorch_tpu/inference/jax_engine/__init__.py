@@ -1,0 +1,3 @@
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+__all__ = ["JAXShardInferenceEngine"]

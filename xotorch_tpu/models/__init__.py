@@ -1,0 +1,22 @@
+from xotorch_tpu.models.config import ModelConfig, load_model_config
+from xotorch_tpu.models.registry import (
+  build_base_shard,
+  build_full_shard,
+  get_model_card,
+  get_repo,
+  get_supported_models,
+  model_cards,
+  pretty_name,
+)
+
+__all__ = [
+  "ModelConfig",
+  "load_model_config",
+  "model_cards",
+  "get_model_card",
+  "get_repo",
+  "build_base_shard",
+  "build_full_shard",
+  "get_supported_models",
+  "pretty_name",
+]

@@ -1,0 +1,127 @@
+"""Model configuration: HF config.json -> a static, hashable ModelConfig.
+
+Parity: the reference's HF-config translation (llm_utils.py:79-126). Static
+because jit caches key on it: every field that shapes the compiled program is
+a plain python value, so two requests with the same config hit the same XLA
+executable.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+  """Llama-3 style frequency scaling (rope_type 'llama3' in HF configs)."""
+  factor: float = 32.0
+  low_freq_factor: float = 1.0
+  high_freq_factor: float = 4.0
+  original_max_position_embeddings: int = 8192
+  rope_type: str = "llama3"
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+  model_family: str  # llama | qwen2 | qwen3 | mistral | phi3 | generic
+  vocab_size: int
+  hidden_size: int
+  num_layers: int
+  num_heads: int
+  num_kv_heads: int
+  head_dim: int
+  intermediate_size: int
+  rms_norm_eps: float = 1e-5
+  rope_theta: float = 10000.0
+  rope_scaling: Optional[RopeScaling] = None
+  max_seq_len: int = 8192
+  tie_word_embeddings: bool = False
+  attention_bias: bool = False  # qwen2-style q/k/v bias
+  qk_norm: bool = False  # qwen3-style per-head RMSNorm on q/k
+  # MoE (0 experts = dense). The reference shipped only dead MoE stubs
+  # (llm_utils.py:502-590); here MoE is a first-class config.
+  num_experts: int = 0
+  num_experts_per_tok: int = 0
+  moe_intermediate_size: int = 0
+  norm_topk_prob: bool = False
+  eos_token_ids: Tuple[int, ...] = ()
+
+  @property
+  def is_moe(self) -> bool:
+    return self.num_experts > 0
+
+
+def config_from_hf_dict(cfg: dict) -> ModelConfig:
+  model_type = cfg.get("model_type", "llama")
+  # Multimodal configs nest the decoder under text_config (llava et al).
+  if "text_config" in cfg:
+    inner = dict(cfg["text_config"])
+    inner.setdefault("model_type", inner.get("model_type", model_type))
+    cfg = inner
+    model_type = cfg.get("model_type", "llama")
+  family = {
+    "llama": "llama",
+    "mistral": "mistral",
+    "qwen2": "qwen2",
+    "qwen3": "qwen3",
+    "qwen3_moe": "qwen3",
+    "phi3": "phi3",
+  }.get(model_type, "generic")
+
+  num_heads = int(cfg.get("num_attention_heads", 32))
+  hidden = int(cfg.get("hidden_size", 4096))
+  head_dim = int(cfg.get("head_dim") or hidden // num_heads)
+  rope_scaling = None
+  rs = cfg.get("rope_scaling")
+  if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+    rope_scaling = RopeScaling(
+      factor=float(rs.get("factor", 32.0)),
+      low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+      high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+      original_max_position_embeddings=int(rs.get("original_max_position_embeddings", 8192)),
+    )
+
+  eos = cfg.get("eos_token_id", ())
+  if isinstance(eos, int):
+    eos = (eos,)
+  elif eos is None:
+    eos = ()
+  else:
+    eos = tuple(int(e) for e in eos)
+
+  return ModelConfig(
+    model_family=family,
+    vocab_size=int(cfg.get("vocab_size", 32000)),
+    hidden_size=hidden,
+    num_layers=int(cfg.get("num_hidden_layers", 32)),
+    num_heads=num_heads,
+    num_kv_heads=int(cfg.get("num_key_value_heads", num_heads)),
+    head_dim=head_dim,
+    intermediate_size=int(cfg.get("intermediate_size", 11008)),
+    rms_norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+    rope_theta=float(cfg.get("rope_theta", 10000.0)),
+    rope_scaling=rope_scaling,
+    max_seq_len=int(cfg.get("max_position_embeddings", 8192)),
+    tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+    attention_bias=bool(cfg.get("attention_bias", model_type == "qwen2")),
+    qk_norm=model_type in ("qwen3", "qwen3_moe"),
+    num_experts=int(cfg.get("num_experts", cfg.get("num_local_experts", 0)) or 0),
+    num_experts_per_tok=int(cfg.get("num_experts_per_tok", 0) or 0),
+    moe_intermediate_size=int(cfg.get("moe_intermediate_size", 0) or 0),
+    norm_topk_prob=bool(cfg.get("norm_topk_prob", False)),
+    eos_token_ids=eos,
+  )
+
+
+def load_model_config(model_dir: Path, max_seq_len_override: Optional[int] = None) -> ModelConfig:
+  """Read config.json from a local model dir (XOT_MAX_SEQ_LEN-style override
+  parity: llm_utils.py:120-122)."""
+  with open(Path(model_dir) / "config.json") as f:
+    cfg = config_from_hf_dict(json.load(f))
+  import os
+  override = max_seq_len_override or (int(os.environ["XOT_MAX_SEQ_LEN"]) if os.getenv("XOT_MAX_SEQ_LEN") else None)
+  if override:
+    cfg = replace(cfg, max_seq_len=min(cfg.max_seq_len, override))
+  return cfg

@@ -1,0 +1,142 @@
+"""Model registry: short name -> layer count + HF repo per engine classname.
+
+Parity: /root/reference/xotorch/models.py:4-278 — same catalogue breadth
+(Llama 3/3.1/3.2/3.3, Mistral, DeepSeek R1 distills, Qwen 2.5 family, Qwen3
+incl. the 30B MoE, LLaVA, Nemotron, Phi-4-mini, dummy) keyed by engine
+classname so heterogeneous rings can negotiate a common engine. MoE cards
+here load through the real MoE builder (the reference routed them through a
+dense builder and would be numerically wrong — SURVEY §0).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from xotorch_tpu.inference.shard import Shard
+
+JAX = "JAXShardInferenceEngine"
+DUMMY = "DummyInferenceEngine"
+
+model_cards: Dict[str, Dict] = {
+  ### llama 3 family
+  "llama-3.3-70b": {"layers": 80, "repo": {JAX: "unsloth/Llama-3.3-70B-Instruct"}},
+  "llama-3.2-1b": {"layers": 16, "repo": {JAX: "unsloth/Llama-3.2-1B-Instruct"}},
+  "llama-3.2-3b": {"layers": 28, "repo": {JAX: "unsloth/Llama-3.2-3B-Instruct"}},
+  "llama-3.1-8b": {"layers": 32, "repo": {JAX: "mlx-community/Meta-Llama-3.1-8B-Instruct-bf16"}},
+  "llama-3.1-70b": {"layers": 80, "repo": {JAX: "mlx-community/Meta-Llama-3.1-70B-Instruct-bf16"}},
+  "llama-3.1-405b": {"layers": 126, "repo": {JAX: "mlx-community/Meta-Llama-3.1-405B-bf16"}},
+  "llama-3-8b": {"layers": 32, "repo": {JAX: "mlx-community/Meta-Llama-3-8B-Instruct-bf16"}},
+  "llama-3-70b": {"layers": 80, "repo": {JAX: "mlx-community/Meta-Llama-3-70B-Instruct-bf16"}},
+  ### mistral
+  "mistral-nemo": {"layers": 40, "repo": {JAX: "unsloth/Mistral-Nemo-Instruct-2407"}},
+  "mistral-large": {"layers": 88, "repo": {JAX: "mistralai/Mistral-Large-Instruct-2407"}},
+  ### deepseek r1 distills
+  "deepseek-r1-distill-qwen-1.5b": {"layers": 28, "repo": {JAX: "deepseek-ai/DeepSeek-R1-Distill-Qwen-1.5B"}},
+  "deepseek-r1-distill-qwen-7b": {"layers": 28, "repo": {JAX: "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B"}},
+  "deepseek-r1-distill-qwen-14b": {"layers": 48, "repo": {JAX: "deepseek-ai/DeepSeek-R1-Distill-Qwen-14B"}},
+  "deepseek-r1-distill-qwen-32b": {"layers": 64, "repo": {JAX: "deepseek-ai/DeepSeek-R1-Distill-Qwen-32B"}},
+  "deepseek-r1-distill-llama-8b": {"layers": 32, "repo": {JAX: "deepseek-ai/DeepSeek-R1-Distill-Llama-8B"}},
+  "deepseek-r1-distill-llama-70b": {"layers": 80, "repo": {JAX: "deepseek-ai/DeepSeek-R1-Distill-Llama-70B"}},
+  ### qwen 2.5
+  "qwen-2.5-0.5b": {"layers": 24, "repo": {JAX: "Qwen/Qwen2.5-0.5B-Instruct"}},
+  "qwen-2.5-1.5b": {"layers": 28, "repo": {JAX: "Qwen/Qwen2.5-1.5B-Instruct"}},
+  "qwen-2.5-coder-1.5b": {"layers": 28, "repo": {JAX: "Qwen/Qwen2.5-Coder-1.5B-Instruct"}},
+  "qwen-2.5-3b": {"layers": 36, "repo": {JAX: "Qwen/Qwen2.5-3B-Instruct"}},
+  "qwen-2.5-coder-3b": {"layers": 36, "repo": {JAX: "Qwen/Qwen2.5-Coder-3B-Instruct"}},
+  "qwen-2.5-7b": {"layers": 28, "repo": {JAX: "Qwen/Qwen2.5-7B-Instruct"}},
+  "qwen-2.5-coder-7b": {"layers": 28, "repo": {JAX: "Qwen/Qwen2.5-Coder-7B-Instruct"}},
+  "qwen-2.5-math-7b": {"layers": 28, "repo": {JAX: "Qwen/Qwen2.5-Math-7B-Instruct"}},
+  "qwen-2.5-14b": {"layers": 48, "repo": {JAX: "Qwen/Qwen2.5-14B-Instruct"}},
+  "qwen-2.5-coder-14b": {"layers": 48, "repo": {JAX: "Qwen/Qwen2.5-Coder-14B-Instruct"}},
+  "qwen-2.5-32b": {"layers": 64, "repo": {JAX: "Qwen/Qwen2.5-32B-Instruct"}},
+  "qwen-2.5-coder-32b": {"layers": 64, "repo": {JAX: "Qwen/Qwen2.5-Coder-32B-Instruct"}},
+  "qwen-2.5-72b": {"layers": 80, "repo": {JAX: "Qwen/Qwen2.5-72B-Instruct"}},
+  "qwen-2.5-math-72b": {"layers": 80, "repo": {JAX: "Qwen/Qwen2.5-Math-72B-Instruct"}},
+  ### qwen 3 (dense + MoE)
+  "qwen-3-32b": {"layers": 64, "repo": {JAX: "Qwen/Qwen3-32B"}},
+  "qwen-3-30b-a3b": {"layers": 48, "repo": {JAX: "Qwen/Qwen3-30B-A3B"}, "moe": True},
+  ### vision
+  "llava-1.5-7b-hf": {"layers": 32, "repo": {JAX: "llava-hf/llava-1.5-7b-hf"}, "vision": True},
+  ### nemotron
+  "nemotron-70b": {"layers": 80, "repo": {JAX: "nvidia/Llama-3.1-Nemotron-70B-Instruct-HF"}},
+  ### phi
+  "phi-4-mini": {"layers": 32, "repo": {JAX: "microsoft/Phi-4-mini-instruct"}},
+  ### dummy
+  "dummy": {"layers": 8, "repo": {DUMMY: "dummy"}},
+  ### synthetic (random weights, no download — benchmarking/zero-egress dev;
+  ### shapes match the corresponding real models)
+  "synthetic-llama-1b": {
+    "layers": 16, "repo": {JAX: "synthetic"},
+    "synthetic_config": {
+      "model_type": "llama", "hidden_size": 2048, "intermediate_size": 8192,
+      "num_attention_heads": 32, "num_key_value_heads": 8, "head_dim": 64,
+      "num_hidden_layers": 16, "vocab_size": 128256, "max_position_embeddings": 131072,
+      "rope_theta": 500000.0, "tie_word_embeddings": True, "eos_token_id": 128001,
+    },
+  },
+  "synthetic-llama-8b": {
+    "layers": 32, "repo": {JAX: "synthetic"},
+    "synthetic_config": {
+      "model_type": "llama", "hidden_size": 4096, "intermediate_size": 14336,
+      "num_attention_heads": 32, "num_key_value_heads": 8,
+      "num_hidden_layers": 32, "vocab_size": 128256, "max_position_embeddings": 131072,
+      "rope_theta": 500000.0, "tie_word_embeddings": False, "eos_token_id": 128001,
+    },
+  },
+  "synthetic-tiny": {
+    "layers": 4, "repo": {JAX: "synthetic"},
+    "synthetic_config": {
+      "model_type": "llama", "hidden_size": 64, "intermediate_size": 128,
+      "num_attention_heads": 4, "num_key_value_heads": 2,
+      "num_hidden_layers": 4, "vocab_size": 256, "max_position_embeddings": 2048,
+      "rope_theta": 10000.0, "tie_word_embeddings": False, "eos_token_id": 2,
+    },
+  },
+}
+
+pretty_names: Dict[str, str] = {
+  "llama-3.3-70b": "Llama 3.3 70B",
+  "llama-3.2-1b": "Llama 3.2 1B",
+  "llama-3.1-8b": "Llama 3.1 8B",
+  "qwen-3-30b-a3b": "Qwen 3 30B A3B (MoE)",
+}
+
+
+def get_model_card(model_id: str) -> Optional[Dict]:
+  return model_cards.get(model_id)
+
+
+def get_repo(model_id: str, inference_engine_classname: str) -> Optional[str]:
+  return model_cards.get(model_id, {}).get("repo", {}).get(inference_engine_classname)
+
+
+def build_base_shard(model_id: str, inference_engine_classname: str) -> Optional[Shard]:
+  """start=end=0 sentinel shard used to address a model before the ring is
+  known (parity: models.py:252-257)."""
+  n_layers = model_cards.get(model_id, {}).get("layers", 0)
+  if n_layers < 1 or get_repo(model_id, inference_engine_classname) is None:
+    return None
+  return Shard(model_id, 0, 0, n_layers)
+
+
+def build_full_shard(model_id: str, inference_engine_classname: str) -> Optional[Shard]:
+  base = build_base_shard(model_id, inference_engine_classname)
+  return Shard(model_id, 0, base.n_layers - 1, base.n_layers) if base else None
+
+
+def get_supported_models(supported_inference_engine_lists: Optional[List[List[str]]] = None) -> List[str]:
+  """Models runnable by EVERY peer: intersection over per-peer engine lists
+  (parity: models.py:264-278)."""
+  if not supported_inference_engine_lists:
+    return list(model_cards.keys())
+  from xotorch_tpu.inference.engine import inference_engine_classes
+  engine_sets = [
+    {inference_engine_classes.get(e, e) for e in engines} for engines in supported_inference_engine_lists
+  ]
+  return [
+    model_id for model_id, card in model_cards.items()
+    if all(any(engine in card.get("repo", {}) for engine in engine_set) for engine_set in engine_sets)
+  ]
+
+
+def pretty_name(model_id: str) -> str:
+  return pretty_names.get(model_id, model_id)

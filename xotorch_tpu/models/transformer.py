@@ -1,0 +1,193 @@
+"""The shard transformer: a pure function over a stacked-layer pytree.
+
+TPU-first redesign of the reference's per-layer python module loop
+(ShardTransformerDecoder, llm_utils.py:416-489; GeneralMHA,
+general_mha.py:72-122):
+
+- A shard's layers are STACKED along a leading axis and traversed with
+  `lax.scan`, so XLA compiles ONE layer body regardless of shard depth —
+  compile time is O(1) in layers and the whole shard is a single fused
+  computation (no python in the hot loop).
+- The KV cache is a static-shape [L, B, S, Hkv, D] buffer carried through the
+  scan and kept resident in HBM by the engine; positions are integers and the
+  causal mask is computed on device (nothing resized per request).
+- First/last-shard special cases (embedding, final norm + lm_head) mirror the
+  reference's `(hidden, None) | (None, logits)` contract
+  (general_mha.py:246-249) as `is_first/is_last` static flags.
+
+Dense and MoE blocks share the attention path; MoE is implemented for real
+(the reference's MoE was dead stubs that mis-loaded through a dense builder,
+llm_utils.py:502-590).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from xotorch_tpu.models.config import ModelConfig
+from xotorch_tpu.ops.attention import gqa_attention
+from xotorch_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+  x32 = x.astype(jnp.float32)
+  norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+  return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def init_kv_cache(cfg: ModelConfig, num_layers: int, batch: int, max_seq: int, dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+  shape = (num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+  return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _attention_block(
+  layer: Params, x: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+  positions: jnp.ndarray, kv_valid_len: jnp.ndarray, start_pos: jnp.ndarray,
+  cfg: ModelConfig, inv_freq: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+  B, T, H = x.shape
+  h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+  q = h @ layer["wq"]
+  k = h @ layer["wk"]
+  v = h @ layer["wv"]
+  if "bq" in layer:
+    q = q + layer["bq"]
+    k = k + layer["bk"]
+    v = v + layer["bv"]
+  q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+  k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+  v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+  if cfg.qk_norm:
+    q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+    k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+  q = apply_rope(q, positions, inv_freq)
+  k = apply_rope(k, positions, inv_freq)
+  k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start_pos, 0, 0))
+  v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start_pos, 0, 0))
+  attn = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), positions, kv_valid_len)
+  out = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ layer["wo"]
+  return out, k_cache, v_cache
+
+
+def _dense_mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
+  gate = jax.nn.silu(h @ layer["w_gate"])
+  return (gate * (h @ layer["w_up"])) @ layer["w_down"]
+
+
+def _moe_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+  """Correct top-k MoE (qwen3-moe style). Baseline formulation computes every
+  expert and combines with router weights — exact, simple, and fine for the
+  modest expert counts on a single shard; expert-parallel sharding over the
+  mesh replaces the einsum layout, not the math."""
+  B, T, H = h.shape
+  router_logits = (h.astype(jnp.float32) @ layer["router"].astype(jnp.float32))  # [B,T,E]
+  probs = jax.nn.softmax(router_logits, axis=-1)
+  top_vals, top_idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+  if cfg.norm_topk_prob:
+    top_vals = top_vals / top_vals.sum(axis=-1, keepdims=True)
+  combine = jnp.zeros_like(probs)
+  combine = jnp.put_along_axis(combine, top_idx, top_vals, axis=-1, inplace=False)  # [B,T,E]
+  gate = jax.nn.silu(jnp.einsum("bth,ehi->ebti", h, layer["we_gate"]))
+  up = jnp.einsum("bth,ehi->ebti", h, layer["we_up"])
+  expert_out = jnp.einsum("ebti,eih->ebth", gate * up, layer["we_down"])
+  return jnp.einsum("ebth,bte->bth", expert_out, combine.astype(h.dtype))
+
+
+def forward_shard(
+  params: Params,
+  x: jnp.ndarray,  # [B, T] int32 tokens (first shard) or [B, T, H] hidden
+  cache: Dict[str, jnp.ndarray],
+  start_pos: jnp.ndarray,  # scalar int32: absolute position of x[:, 0]
+  cfg: ModelConfig,
+  is_first: bool,
+  is_last: bool,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+  """Run one shard. Returns (hidden or fp32 logits, updated cache).
+
+  cfg/is_first/is_last must be static under jit; start_pos is traced so one
+  executable serves every decode step.
+  """
+  if is_first:
+    h = jnp.take(params["embed"]["embedding"], x, axis=0)
+  else:
+    h = x
+  B, T = h.shape[0], h.shape[1]
+  positions = (start_pos + jnp.arange(T, dtype=jnp.int32))[None, :].repeat(B, axis=0)
+  kv_valid_len = jnp.full((B,), start_pos + T, dtype=jnp.int32)
+  inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+  def layer_body(h, xs):
+    layer, k_cache, v_cache = xs
+    attn_out, k_cache, v_cache = _attention_block(
+      layer, h, k_cache, v_cache, positions, kv_valid_len, start_pos, cfg, inv_freq
+    )
+    h = h + attn_out
+    mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+    mlp_out = _moe_mlp(layer, mlp_in, cfg) if cfg.is_moe else _dense_mlp(layer, mlp_in)
+    return h + mlp_out, (k_cache, v_cache)
+
+  h, (new_k, new_v) = jax.lax.scan(layer_body, h, (params["layers"], cache["k"], cache["v"]))
+  new_cache = {"k": new_k, "v": new_v}
+
+  if not is_last:
+    return h, new_cache
+  h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+  if cfg.tie_word_embeddings and "lm_head" not in params:
+    logits = h @ params["embed"]["embedding"].T
+  else:
+    logits = h @ params["lm_head"]
+  return logits.astype(jnp.float32), new_cache
+
+
+def init_random_params(
+  cfg: ModelConfig, num_local_layers: int, is_first: bool, is_last: bool,
+  key: jax.Array, dtype=jnp.float32, scale: float = 0.02,
+) -> Params:
+  """Random-initialised shard params in the stacked layout (tests, benches,
+  and training-from-scratch)."""
+  keys = iter(jax.random.split(key, 32))
+  L, H, D = num_local_layers, cfg.hidden_size, cfg.head_dim
+  I = cfg.intermediate_size
+
+  def rnd(*shape):
+    return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+
+  layers: Params = {
+    "attn_norm": jnp.ones((L, H), dtype),
+    "mlp_norm": jnp.ones((L, H), dtype),
+    "wq": rnd(L, H, cfg.num_heads * D),
+    "wk": rnd(L, H, cfg.num_kv_heads * D),
+    "wv": rnd(L, H, cfg.num_kv_heads * D),
+    "wo": rnd(L, cfg.num_heads * D, H),
+  }
+  if cfg.attention_bias:
+    layers["bq"] = jnp.zeros((L, cfg.num_heads * D), dtype)
+    layers["bk"] = jnp.zeros((L, cfg.num_kv_heads * D), dtype)
+    layers["bv"] = jnp.zeros((L, cfg.num_kv_heads * D), dtype)
+  if cfg.qk_norm:
+    layers["q_norm"] = jnp.ones((L, D), dtype)
+    layers["k_norm"] = jnp.ones((L, D), dtype)
+  if cfg.is_moe:
+    E, MI = cfg.num_experts, cfg.moe_intermediate_size or I
+    layers["router"] = rnd(L, H, E)
+    layers["we_gate"] = rnd(L, E, H, MI)
+    layers["we_up"] = rnd(L, E, H, MI)
+    layers["we_down"] = rnd(L, E, MI, H)
+  else:
+    layers["w_gate"] = rnd(L, H, I)
+    layers["w_up"] = rnd(L, H, I)
+    layers["w_down"] = rnd(L, I, H)
+
+  params: Params = {"layers": layers}
+  if is_first or cfg.tie_word_embeddings:
+    params["embed"] = {"embedding": rnd(cfg.vocab_size, H)}
+  if is_last:
+    params["final_norm"] = jnp.ones((H,), dtype)
+    if not cfg.tie_word_embeddings:
+      params["lm_head"] = rnd(H, cfg.vocab_size)
+  return params

@@ -1,0 +1,237 @@
+"""HF safetensors checkpoint -> stacked shard pytree.
+
+Replaces the reference's HF->torchtune remapping (llm_utils.py:185-333) with a
+direct HF-layout load: because RoPE here uses the HF rotate-half convention
+(ops/rope.py), q/k weights load untouched — no permutation pass. Linear
+weights are transposed once at load ([out,in] -> [in,out]) so the forward is
+plain `x @ w` on the MXU.
+
+Layer filtering: only tensors for layers in [shard.start_layer,
+shard.end_layer] are read, then stacked along a leading axis to match the
+scan layout (models/transformer.py). Embeddings load on the first shard (and
+on the last for tied-embedding models); final norm + lm_head on the last.
+"""
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.models.config import ModelConfig
+from xotorch_tpu.utils.helpers import DEBUG
+
+_LAYER_RE = re.compile(r"(?:^|\.)layers\.(\d+)\.")
+
+
+def layer_of(tensor_name: str) -> Optional[int]:
+  m = _LAYER_RE.search(tensor_name)
+  return int(m.group(1)) if m else None
+
+
+def tensor_names_for_shard(all_names: List[str], shard: Shard, tie_word_embeddings: bool) -> List[str]:
+  """Which checkpoint tensors a shard needs (drives both loading and the
+  downloader's layer-aware file filtering, parity: hf_helpers.py:74-98)."""
+  wanted = []
+  for name in all_names:
+    layer = layer_of(name)
+    if layer is not None:
+      if shard.start_layer <= layer <= shard.end_layer:
+        wanted.append(name)
+      continue
+    is_embed = "embed_tokens" in name
+    is_head = name.startswith("lm_head") or ".lm_head" in name
+    is_final_norm = re.search(r"(?:^|\.)norm\.weight$", name) is not None
+    if is_embed and (shard.is_first_layer or (tie_word_embeddings and shard.is_last_layer)):
+      wanted.append(name)
+    elif (is_head or is_final_norm) and shard.is_last_layer:
+      wanted.append(name)
+    elif not (is_embed or is_head or is_final_norm):
+      # Vision towers / projectors etc.: load with the first shard.
+      if shard.is_first_layer:
+        wanted.append(name)
+  return wanted
+
+
+def _index_for(model_dir: Path) -> Dict[str, str]:
+  """tensor name -> file name."""
+  index_file = model_dir / "model.safetensors.index.json"
+  if index_file.exists():
+    with open(index_file) as f:
+      return json.load(f)["weight_map"]
+  single = model_dir / "model.safetensors"
+  if single.exists():
+    from safetensors import safe_open
+    with safe_open(single, framework="np") as f:
+      return {name: "model.safetensors" for name in f.keys()}
+  raise FileNotFoundError(f"No safetensors checkpoint in {model_dir}")
+
+
+def _read_tensors(model_dir: Path, names: List[str], index: Dict[str, str]) -> Dict[str, jnp.ndarray]:
+  """Read tensors grouped by file (one pass per file, bf16-safe via the flax
+  framework adapter)."""
+  from safetensors import safe_open
+
+  by_file: Dict[str, List[str]] = {}
+  for name in names:
+    by_file.setdefault(index[name], []).append(name)
+  out: Dict[str, jnp.ndarray] = {}
+  for file_name, file_tensors in by_file.items():
+    with safe_open(model_dir / file_name, framework="flax") as f:
+      for name in file_tensors:
+        out[name] = f.get_tensor(name)
+  return out
+
+
+def _split_fused_projections(t: Dict[str, jnp.ndarray], cfg: ModelConfig) -> None:
+  """Phi-3-family checkpoints fuse qkv_proj and gate_up_proj; split them into
+  the canonical per-projection names (HF [out, in] layout: split along out)."""
+  q_rows = cfg.num_heads * cfg.head_dim
+  kv_rows = cfg.num_kv_heads * cfg.head_dim
+  for name in [n for n in list(t.keys()) if n.endswith("self_attn.qkv_proj.weight")]:
+    base = name[: -len("qkv_proj.weight")]
+    fused = t.pop(name)
+    t[base + "q_proj.weight"] = fused[:q_rows]
+    t[base + "k_proj.weight"] = fused[q_rows:q_rows + kv_rows]
+    t[base + "v_proj.weight"] = fused[q_rows + kv_rows:]
+  for name in [n for n in list(t.keys()) if n.endswith("mlp.gate_up_proj.weight")]:
+    base = name[: -len("gate_up_proj.weight")]
+    fused = t.pop(name)
+    half = fused.shape[0] // 2
+    t[base + "gate_proj.weight"] = fused[:half]
+    t[base + "up_proj.weight"] = fused[half:]
+
+
+_HF_PREFIXES = ("model.", "language_model.model.", "language_model.")
+
+
+def _strip_prefix(name: str) -> str:
+  for prefix in _HF_PREFIXES:
+    if name.startswith(prefix):
+      return name[len(prefix):]
+  return name
+
+
+def load_shard_params(
+  model_dir: Path, cfg: ModelConfig, shard: Shard, dtype=jnp.bfloat16
+) -> Dict[str, Any]:
+  """Load a shard's params in the stacked layout used by forward_shard."""
+  model_dir = Path(model_dir)
+  index = _index_for(model_dir)
+  names = tensor_names_for_shard(list(index.keys()), shard, cfg.tie_word_embeddings)
+  raw = _read_tensors(model_dir, names, index)
+  t = {_strip_prefix(k): v for k, v in raw.items()}
+  _split_fused_projections(t, cfg)
+
+  def get(name: str) -> Optional[jnp.ndarray]:
+    return t.get(name)
+
+  def linear(name: str) -> jnp.ndarray:
+    w = t[name]
+    return w.T.astype(dtype)  # [out,in] -> [in,out]
+
+  L = shard.get_layer_count()
+  layer_ids = range(shard.start_layer, shard.end_layer + 1)
+
+  def stack(fn) -> jnp.ndarray:
+    return jnp.stack([fn(i) for i in layer_ids])
+
+  layers: Dict[str, jnp.ndarray] = {
+    "attn_norm": stack(lambda i: t[f"layers.{i}.input_layernorm.weight"].astype(dtype)),
+    "mlp_norm": stack(lambda i: t[f"layers.{i}.post_attention_layernorm.weight"].astype(dtype)),
+    "wq": stack(lambda i: linear(f"layers.{i}.self_attn.q_proj.weight")),
+    "wk": stack(lambda i: linear(f"layers.{i}.self_attn.k_proj.weight")),
+    "wv": stack(lambda i: linear(f"layers.{i}.self_attn.v_proj.weight")),
+    "wo": stack(lambda i: linear(f"layers.{i}.self_attn.o_proj.weight")),
+  }
+  if cfg.attention_bias and get(f"layers.{shard.start_layer}.self_attn.q_proj.bias") is not None:
+    layers["bq"] = stack(lambda i: t[f"layers.{i}.self_attn.q_proj.bias"].astype(dtype))
+    layers["bk"] = stack(lambda i: t[f"layers.{i}.self_attn.k_proj.bias"].astype(dtype))
+    layers["bv"] = stack(lambda i: t[f"layers.{i}.self_attn.v_proj.bias"].astype(dtype))
+  if cfg.qk_norm:
+    layers["q_norm"] = stack(lambda i: t[f"layers.{i}.self_attn.q_norm.weight"].astype(dtype))
+    layers["k_norm"] = stack(lambda i: t[f"layers.{i}.self_attn.k_norm.weight"].astype(dtype))
+  if cfg.is_moe:
+    E = cfg.num_experts
+    layers["router"] = stack(lambda i: linear(f"layers.{i}.mlp.gate.weight"))
+    layers["we_gate"] = stack(
+      lambda i: jnp.stack([linear(f"layers.{i}.mlp.experts.{e}.gate_proj.weight") for e in range(E)])
+    )
+    layers["we_up"] = stack(
+      lambda i: jnp.stack([linear(f"layers.{i}.mlp.experts.{e}.up_proj.weight") for e in range(E)])
+    )
+    layers["we_down"] = stack(
+      lambda i: jnp.stack([linear(f"layers.{i}.mlp.experts.{e}.down_proj.weight") for e in range(E)])
+    )
+  else:
+    layers["w_gate"] = stack(lambda i: linear(f"layers.{i}.mlp.gate_proj.weight"))
+    layers["w_up"] = stack(lambda i: linear(f"layers.{i}.mlp.up_proj.weight"))
+    layers["w_down"] = stack(lambda i: linear(f"layers.{i}.mlp.down_proj.weight"))
+
+  params: Dict[str, Any] = {"layers": layers}
+  embed = get("embed_tokens.weight")
+  if embed is not None:
+    params["embed"] = {"embedding": embed.astype(dtype)}
+  if shard.is_last_layer:
+    params["final_norm"] = t["norm.weight"].astype(dtype)
+    head = t.get("lm_head.weight")
+    if head is not None and not cfg.tie_word_embeddings:
+      params["lm_head"] = head.T.astype(dtype)
+  if DEBUG >= 2:
+    n_params = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params))
+    print(f"Loaded shard {shard}: {n_params/1e6:.1f}M params from {model_dir}")
+  return params
+
+
+def save_shard_params(params: Dict[str, Any], cfg: ModelConfig, shard: Shard, out_path: Path) -> None:
+  """Write a shard's params back to HF-layout safetensors (checkpoint save
+  path; parity intent: node.py:230-252 shard-hash save naming)."""
+  from safetensors.flax import save_file
+
+  flat: Dict[str, jnp.ndarray] = {}
+  layers = params["layers"]
+
+  def put_linear(name: str, w: jnp.ndarray) -> None:
+    flat[name] = w.T
+
+  for idx, i in enumerate(range(shard.start_layer, shard.end_layer + 1)):
+    prefix = f"model.layers.{i}."
+    flat[prefix + "input_layernorm.weight"] = layers["attn_norm"][idx]
+    flat[prefix + "post_attention_layernorm.weight"] = layers["mlp_norm"][idx]
+    put_linear(prefix + "self_attn.q_proj.weight", layers["wq"][idx])
+    put_linear(prefix + "self_attn.k_proj.weight", layers["wk"][idx])
+    put_linear(prefix + "self_attn.v_proj.weight", layers["wv"][idx])
+    put_linear(prefix + "self_attn.o_proj.weight", layers["wo"][idx])
+    if "bq" in layers:
+      flat[prefix + "self_attn.q_proj.bias"] = layers["bq"][idx]
+      flat[prefix + "self_attn.k_proj.bias"] = layers["bk"][idx]
+      flat[prefix + "self_attn.v_proj.bias"] = layers["bv"][idx]
+    if "q_norm" in layers:
+      flat[prefix + "self_attn.q_norm.weight"] = layers["q_norm"][idx]
+      flat[prefix + "self_attn.k_norm.weight"] = layers["k_norm"][idx]
+    if "router" in layers:
+      put_linear(prefix + "mlp.gate.weight", layers["router"][idx])
+      for e in range(layers["we_gate"].shape[1]):
+        put_linear(prefix + f"mlp.experts.{e}.gate_proj.weight", layers["we_gate"][idx, e])
+        put_linear(prefix + f"mlp.experts.{e}.up_proj.weight", layers["we_up"][idx, e])
+        put_linear(prefix + f"mlp.experts.{e}.down_proj.weight", layers["we_down"][idx, e])
+    else:
+      put_linear(prefix + "mlp.gate_proj.weight", layers["w_gate"][idx])
+      put_linear(prefix + "mlp.up_proj.weight", layers["w_up"][idx])
+      put_linear(prefix + "mlp.down_proj.weight", layers["w_down"][idx])
+
+  if "embed" in params:
+    flat["model.embed_tokens.weight"] = params["embed"]["embedding"]
+  if "final_norm" in params:
+    flat["model.norm.weight"] = params["final_norm"]
+  if "lm_head" in params:
+    put_linear("lm_head.weight", params["lm_head"])
+
+  out_path = Path(out_path)
+  out_path.parent.mkdir(parents=True, exist_ok=True)
+  save_file({k: jnp.asarray(v) for k, v in flat.items()}, str(out_path))

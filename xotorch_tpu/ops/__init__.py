@@ -1,0 +1,5 @@
+from xotorch_tpu.ops.rope import apply_rope, rope_frequencies
+from xotorch_tpu.ops.attention import gqa_attention
+from xotorch_tpu.ops.sampling import sample_logits
+
+__all__ = ["apply_rope", "rope_frequencies", "gqa_attention", "sample_logits"]

@@ -1,0 +1,48 @@
+"""Attention core: GQA with an on-device causal mask over a static KV cache.
+
+Replaces the reference's host-built dense boolean mask that is re-serialized
+across the wire every hop (sharded_inference_engine.py:144-186,
+llm_utils.py:617-623) with a mask computed from integer positions inside the
+compiled program — nothing but (hidden, pos) ever leaves the device.
+
+This is the XLA-fused baseline path; ops/flash_attention.py provides the
+Pallas kernel for long-context and is selected by the engine when profitable.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def gqa_attention(
+  q: jnp.ndarray,  # [B, T, Hq, D]
+  k: jnp.ndarray,  # [B, S, Hkv, D]  (full cache buffer)
+  v: jnp.ndarray,  # [B, S, Hkv, D]
+  q_positions: jnp.ndarray,  # [B, T] int32 absolute positions of the queries
+  kv_valid_len: Optional[jnp.ndarray] = None,  # [B] int32: entries >= this are invalid
+) -> jnp.ndarray:
+  """Grouped-query causal attention. Returns [B, T, Hq, D].
+
+  Causality: key position s is visible to query position p iff s <= p.
+  A static-size cache buffer is always passed; positions beyond the written
+  region are masked by s <= p (decode) and optionally kv_valid_len (batch).
+  """
+  B, T, Hq, D = q.shape
+  S, Hkv = k.shape[1], k.shape[2]
+  groups = Hq // Hkv
+
+  q_ = q.reshape(B, T, Hkv, groups, D)
+  scores = jnp.einsum("btkgd,bskd->bkgts", q_, k, preferred_element_type=jnp.float32)
+  scores = scores / jnp.sqrt(jnp.float32(D))
+
+  kv_pos = jnp.arange(S, dtype=jnp.int32)
+  visible = kv_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
+  if kv_valid_len is not None:
+    visible = visible & (kv_pos[None, None, :] < kv_valid_len[:, None, None])
+  scores = jnp.where(visible[:, None, None, :, :], scores, jnp.float32(-1e30))
+
+  probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+  probs = probs / probs.sum(axis=-1, keepdims=True)
+  out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32)
+  return out.reshape(B, T, Hq, D).astype(q.dtype)

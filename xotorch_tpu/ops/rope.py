@@ -1,0 +1,56 @@
+"""Rotary position embeddings, HF rotate-half convention.
+
+Covers the reference's three RoPE variants (general_mha.py:33-63): plain
+rotary (generic/qwen), Llama-3 scaled rotary (low/high-frequency band
+rescale), and bias'd-attention models — the q/k layout here follows the HF
+checkpoint convention directly, so no torchtune-style q/k weight permutation
+is needed at load time (contrast llm_utils.py:175-183).
+
+Frequencies are computed on the fly from integer positions inside the jitted
+program (no host-side tables), fp32 throughout for TPU-stable sin/cos.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from xotorch_tpu.models.config import RopeScaling
+
+
+def rope_frequencies(head_dim: int, theta: float, scaling: Optional[RopeScaling] = None) -> jnp.ndarray:
+  """Per-pair inverse frequencies [head_dim // 2], with optional llama3 band
+  scaling (matches transformers' _compute_llama3_parameters)."""
+  exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+  inv_freq = 1.0 / (theta ** exponents)
+  if scaling is None or scaling.rope_type != "llama3":
+    return inv_freq
+  low_freq_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
+  high_freq_wavelen = scaling.original_max_position_embeddings / scaling.high_freq_factor
+  wavelen = 2 * jnp.pi / inv_freq
+  # Low-frequency bands are divided by `factor`; a smooth ramp interpolates
+  # between the two regimes for medium frequencies.
+  scaled = inv_freq / scaling.factor
+  smooth = (scaling.original_max_position_embeddings / wavelen - scaling.low_freq_factor) / (
+    scaling.high_freq_factor - scaling.low_freq_factor
+  )
+  smoothed = (1 - smooth) * scaled + smooth * inv_freq
+  is_low = wavelen > low_freq_wavelen
+  is_medium = (~is_low) & (wavelen > high_freq_wavelen)
+  out = jnp.where(is_low, scaled, inv_freq)
+  out = jnp.where(is_medium, smoothed, out)
+  return out
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
+  """Rotate q or k. x: [B, T, H, D]; positions: [B, T] int32; inv_freq [D//2].
+
+  HF rotate-half convention: the head dim is split into two halves (not
+  interleaved pairs), matching safetensors checkpoints as stored.
+  """
+  angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, D//2]
+  cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, D//2]
+  sin = jnp.sin(angles)[:, :, None, :]
+  x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+  rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+  return rotated.astype(x.dtype)

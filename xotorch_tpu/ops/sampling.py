@@ -1,0 +1,44 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-compatible.
+
+Defaults TEMP=0.6, TOP_K=35 match the reference's serving defaults
+(sharded_inference_engine.py:32-35). Sampling runs on device under jit — the
+reference's exponential-noise trick (Gumbel-max via torch.empty_like
+.exponential_) becomes jax.random.gumbel, which is the same estimator.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_TEMP = 0.6
+DEFAULT_TOP_K = 35
+
+
+@partial(jax.jit, static_argnames=("temp", "top_k", "top_p"))
+def sample_logits(
+  logits: jnp.ndarray,  # [B, V] fp32
+  key: jax.Array,
+  temp: float = DEFAULT_TEMP,
+  top_k: int = DEFAULT_TOP_K,
+  top_p: float = 0.0,
+) -> jnp.ndarray:
+  """Returns [B] int32 sampled token ids."""
+  if temp == 0.0:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+  logits = logits.astype(jnp.float32) / temp
+  if top_k and top_k > 0 and top_k < logits.shape[-1]:
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+  if top_p and 0.0 < top_p < 1.0:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep the smallest prefix with cumulative mass >= top_p (always >= 1 tok).
+    cutoff_idx = jnp.sum(cumulative < top_p, axis=-1, keepdims=True)
+    cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+  # Gumbel-max sampling (same estimator as the reference's exponential trick).
+  gumbel = jax.random.gumbel(key, logits.shape, dtype=jnp.float32)
+  return jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)

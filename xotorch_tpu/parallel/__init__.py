@@ -1,0 +1,10 @@
+from xotorch_tpu.parallel.mesh import (
+  make_mesh,
+  param_specs_like,
+  shard_batch,
+  shard_cache,
+  shard_params,
+  spec_for_param,
+)
+
+__all__ = ["make_mesh", "shard_params", "shard_batch", "shard_cache", "param_specs_like", "spec_for_param"]

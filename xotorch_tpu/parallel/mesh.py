@@ -1,0 +1,117 @@
+"""Device mesh + sharding rules: the intra-peer parallelism layer.
+
+The reference has NO collectives at all (SURVEY §2.9/§5 — gRPC unicast ring
+only); this module is the TPU-native depth the north-star asks for. A peer
+that owns several TPU chips runs its layer-range shard SPMD over a local
+`jax.sharding.Mesh`; XLA inserts the all-reduces (over ICI) implied by the
+parameter shardings below — the scaling-book recipe: pick a mesh, annotate
+shardings, let the compiler place collectives.
+
+Axes:
+  dp — data parallel (batch)
+  tp — tensor parallel (attention heads / ffn columns, Megatron-style)
+  sp — sequence parallel (ring attention over the KV sequence; ops/ring_attention)
+  ep — expert parallel (MoE experts)
+
+Pipeline parallelism stays at the Node/ring layer (topology partitioning),
+exactly as in the reference design; within a pipeline stage these axes give
+the second dimension of scaling the reference lacks.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
+  """Build a Mesh with named axes from {axis: size}. Axes of size 1 are kept
+  (harmless, simplifies downstream specs)."""
+  import jax
+  from jax.sharding import Mesh
+
+  devices = list(devices if devices is not None else jax.devices())
+  names = tuple(axis_sizes.keys())
+  sizes = tuple(axis_sizes.values())
+  total = int(np.prod(sizes))
+  if total > len(devices):
+    raise ValueError(f"Mesh {axis_sizes} needs {total} devices, have {len(devices)}")
+  mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+  return Mesh(mesh_devices, names)
+
+
+def spec_for_param(name: str):
+  """PartitionSpec for a single named parameter in the stacked layout
+  (transformer.py). Megatron layout: qkv/gate/up column-parallel over tp,
+  o/down row-parallel (their matmul output implies an XLA all-reduce over
+  tp); norms replicated; MoE experts shard over ep."""
+  from jax.sharding import PartitionSpec as P
+
+  rules = {
+    "attn_norm": P(None, None), "mlp_norm": P(None, None),
+    "wq": P(None, None, "tp"), "wk": P(None, None, "tp"), "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "w_gate": P(None, None, "tp"), "w_up": P(None, None, "tp"), "w_down": P(None, "tp", None),
+    "bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp"),
+    "q_norm": P(None, None), "k_norm": P(None, None),
+    "router": P(None, None, None),
+    "we_gate": P(None, "ep", None, "tp"),
+    "we_up": P(None, "ep", None, "tp"),
+    "we_down": P(None, "ep", "tp", None),
+    "embedding": P(None, "tp"),
+    "final_norm": P(None),
+    "lm_head": P(None, "tp"),
+  }
+  return rules.get(name)
+
+
+def param_specs_like(params: Dict[str, Any]) -> Dict[str, Any]:
+  """A spec pytree mirroring the param tree exactly (path-keyed)."""
+  import jax
+  from jax.sharding import PartitionSpec as P
+
+  def spec(path, leaf):
+    name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+    s = spec_for_param(name)
+    return s if s is not None else P()
+
+  return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_params(params: Dict[str, Any], mesh) -> Dict[str, Any]:
+  """Place a param pytree onto the mesh per the partition rules. XLA derives
+  the matching collectives inside jit from these placements."""
+  import jax
+  from jax.sharding import NamedSharding
+
+  def place(path, leaf):
+    name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+    s = spec_for_param(name)
+    from jax.sharding import PartitionSpec as P
+    return jax.device_put(leaf, NamedSharding(mesh, s if s is not None else P()))
+
+  return jax.tree_util.tree_map_with_path(place, params)
+
+
+def batch_spec(rank: int = 2):
+  """Batch leaves shard along dp on their leading axis, whatever their rank."""
+  from jax.sharding import PartitionSpec as P
+  return P("dp", *([None] * (rank - 1)))
+
+
+def shard_batch(batch, mesh):
+  import jax
+  from jax.sharding import NamedSharding
+  return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, batch_spec(x.ndim))), batch)
+
+
+def cache_spec():
+  # [L, B, S, Hkv, D]: batch over dp, kv heads over tp.
+  from jax.sharding import PartitionSpec as P
+  return P(None, "dp", None, "tp", None)
+
+
+def shard_cache(cache, mesh):
+  import jax
+  from jax.sharding import NamedSharding
+  return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, cache_spec())), cache)

@@ -1,0 +1,3 @@
+from xotorch_tpu.train.step import make_eval_step, make_train_step
+
+__all__ = ["make_train_step", "make_eval_step"]

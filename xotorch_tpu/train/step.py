@@ -1,0 +1,106 @@
+"""Optax training step for shard transformers.
+
+Completes the training leaf the reference declared but never implemented
+(node.py:317,324,333 call engine.train/evaluate; no engine defines them —
+SURVEY §0). The step is a pure jitted function: under a mesh with the
+parallel/mesh.py shardings, XLA turns the same code into dp gradient
+all-reduces + tp partial-sum reductions over ICI.
+
+Loss: next-token sparse cross-entropy with a length mask (the dataset
+batcher pads; positions >= length contribute nothing, matching the
+reference's mlx-derived dataset semantics, train/dataset.py:9-23).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from xotorch_tpu.models.config import ModelConfig
+from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
+
+
+def masked_ce_loss(logits: jnp.ndarray, targets: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+  """logits [B,T,V] fp32, targets [B,T] int32, lengths [B] int32."""
+  T = logits.shape[1]
+  mask = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+  ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+  return (ce * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def full_model_loss(params, batch: Dict[str, jnp.ndarray], cfg: ModelConfig) -> jnp.ndarray:
+  """Loss when one shard holds the whole model (single-peer training)."""
+  inputs, targets, lengths = batch["inputs"], batch["targets"], batch["lengths"]
+  B, T = inputs.shape
+  cache = init_kv_cache(cfg, cfg.num_layers, B, T, jnp.float32)
+  logits, _ = forward_shard(params, inputs, cache, jnp.int32(0), cfg, True, True)
+  return masked_ce_loss(logits, targets, lengths)
+
+
+def make_train_step(
+  cfg: ModelConfig,
+  optimizer: optax.GradientTransformation,
+  loss_fn: Optional[Callable] = None,
+) -> Callable:
+  """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss)."""
+  loss_fn = loss_fn or partial(full_model_loss, cfg=cfg)
+
+  @jax.jit
+  def train_step(params, opt_state, batch):
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+  return train_step
+
+
+def make_eval_step(cfg: ModelConfig, loss_fn: Optional[Callable] = None) -> Callable:
+  loss_fn = loss_fn or partial(full_model_loss, cfg=cfg)
+
+  @jax.jit
+  def eval_step(params, batch):
+    return loss_fn(params, batch)
+
+  return eval_step
+
+
+def shard_loss_and_grads(
+  params, cfg: ModelConfig, x: jnp.ndarray, back_grad_or_targets, lengths, is_first: bool, is_last: bool
+):
+  """Pipelined training over the ring (parity with the reference's
+  forward-activation / backward-gradient chaining, node.py:299-345 +
+  Loss{loss,grads} wire design, node_service.proto:45-48).
+
+  Last shard: returns (loss, grad_wrt_input, param_grads) from targets.
+  Other shards: returns (loss_passthrough, grad_wrt_input, param_grads) by
+  chaining the downstream shard's input-gradient through this shard's vjp.
+  """
+  B, T = x.shape[0], x.shape[1]
+  cache = init_kv_cache(cfg, params["layers"]["attn_norm"].shape[0], B, T, jnp.float32)
+
+  def fwd(p, xin):
+    out, _ = forward_shard(p, xin, cache, jnp.int32(0), cfg, is_first, is_last)
+    return out
+
+  # Token inputs (first shard) are not differentiable; close over x there.
+  if is_last:
+    def loss_of(p, xin):
+      return masked_ce_loss(fwd(p, xin), back_grad_or_targets, lengths)
+    if is_first:
+      loss, param_grads = jax.value_and_grad(lambda p: loss_of(p, x))(params)
+      x_grad = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
+    else:
+      loss, (param_grads, x_grad) = jax.value_and_grad(loss_of, argnums=(0, 1))(params, x)
+    return loss, x_grad, param_grads
+  if is_first:
+    out, vjp_fn = jax.vjp(lambda p: fwd(p, x), params)
+    (param_grads,) = vjp_fn(back_grad_or_targets.astype(out.dtype))
+    x_grad = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
+  else:
+    out, vjp_fn = jax.vjp(fwd, params, x)
+    param_grads, x_grad = vjp_fn(back_grad_or_targets.astype(out.dtype))
+  return jnp.float32(0.0), x_grad, param_grads
