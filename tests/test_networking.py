@@ -1,0 +1,262 @@
+"""Networking tests, mirroring the reference's strategy (SURVEY §4):
+multi-node in ONE process on localhost — codec roundtrips, real gRPC
+server+client around a mocked Node, two UDP discovery instances with crossed
+ports and AsyncMock peer handles, manual discovery over fixture configs.
+"""
+import asyncio
+import json
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.networking.codec import decode_message, encode_message
+from xotorch_tpu.utils.helpers import find_available_port
+
+
+# ------------------------------------------------------------------- codec
+
+def test_codec_roundtrip_scalars_and_tensors():
+  import ml_dtypes
+  fields = {"request_id": "r1", "nested": {"a": [1, 2, 3]}, "flag": True}
+  tensors = {
+    "hidden": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+    "bf16": np.full((4, 8), 1.5, dtype=ml_dtypes.bfloat16),
+    "tokens": np.array([[1, 2, 3]], dtype=np.int64),
+  }
+  data = encode_message(fields, tensors)
+  out_fields, out_tensors = decode_message(data)
+  assert out_fields == fields
+  np.testing.assert_array_equal(out_tensors["hidden"], tensors["hidden"])
+  np.testing.assert_array_equal(out_tensors["tokens"], tensors["tokens"])
+  assert out_tensors["bf16"].dtype == np.dtype(ml_dtypes.bfloat16)
+  np.testing.assert_array_equal(out_tensors["bf16"].astype(np.float32), np.full((4, 8), 1.5, np.float32))
+
+
+def test_codec_rejects_garbage():
+  with pytest.raises(ValueError):
+    decode_message(b"NOPE" + b"\x00" * 16)
+
+
+def test_codec_bf16_is_2_bytes_per_element():
+  import ml_dtypes
+  arr = np.zeros((100,), dtype=ml_dtypes.bfloat16)
+  frame = encode_message({}, {"x": arr})
+  assert len(frame) < 100 * 4  # the reference upcast to fp32; we must not
+
+
+# ------------------------------------------------------------------- gRPC
+
+def _mock_node():
+  node = mock.MagicMock()
+  node.process_prompt = mock.AsyncMock(return_value=None)
+  node.process_tensor = mock.AsyncMock(return_value=None)
+  node.process_example = mock.AsyncMock(return_value=(0.5, np.ones((1, 2, 4), np.float32)))
+  from xotorch_tpu.topology.topology import Topology
+  topo = Topology()
+  node.collect_topology = mock.AsyncMock(return_value=topo)
+  node.on_token = mock.MagicMock()
+  node.on_opaque_status = mock.MagicMock()
+  return node
+
+
+async def test_grpc_server_and_peer_handle_roundtrip():
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
+  from xotorch_tpu.networking.grpc.server import GRPCServer
+  from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES
+
+  node = _mock_node()
+  port = find_available_port()
+  server = GRPCServer(node, "localhost", port)
+  await server.start()
+  try:
+    peer = GRPCPeerHandle("peer1", f"localhost:{port}", "test", UNKNOWN_DEVICE_CAPABILITIES)
+    assert await peer.health_check()
+
+    shard = Shard("m", 0, 3, 8)
+    await peer.send_prompt(shard, "hello", "req-1")
+    node.process_prompt.assert_awaited_once()
+    assert node.process_prompt.call_args.args[1] == "hello"
+
+    import ml_dtypes
+    hidden = np.ones((1, 4, 16), dtype=ml_dtypes.bfloat16)
+    await peer.send_tensor(shard, hidden, "req-1", {"pos": 4})
+    sent = node.process_tensor.call_args.args[1]
+    assert sent.dtype == np.dtype(ml_dtypes.bfloat16) and sent.shape == (1, 4, 16)
+    assert node.process_tensor.call_args.args[3] == {"pos": 4}
+
+    loss, grads = await peer.send_example(
+      shard, np.ones((1, 4), np.int32), np.ones((1, 4), np.int32), np.array([4], np.int32), True, "req-t"
+    )
+    assert loss == 0.5 and grads.shape == (1, 2, 4)
+
+    topo = await peer.collect_topology(set(), max_depth=2)
+    assert topo.nodes == {}
+
+    await peer.send_result("req-1", [1, 2, 3], False)
+    node.on_token.trigger_all.assert_called_once()
+    await peer.send_opaque_status("req-1", json.dumps({"type": "node_status"}))
+    node.on_opaque_status.trigger_all.assert_called_once()
+
+    await peer.disconnect()
+  finally:
+    await server.stop()
+
+
+async def test_grpc_health_check_fails_after_server_stop():
+  from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
+  from xotorch_tpu.networking.grpc.server import GRPCServer
+  from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES
+
+  node = _mock_node()
+  port = find_available_port()
+  server = GRPCServer(node, "localhost", port)
+  await server.start()
+  peer = GRPCPeerHandle("peer1", f"localhost:{port}", "test", UNKNOWN_DEVICE_CAPABILITIES)
+  assert await peer.health_check()
+  await server.stop()
+  assert not await peer.health_check()
+  await peer.disconnect()
+
+
+# ------------------------------------------------------------ UDP discovery
+
+async def test_udp_discovery_two_instances():
+  from xotorch_tpu.networking.udp.discovery import UDPDiscovery
+  from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+
+  caps = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  port1, port2 = find_available_port(), find_available_port()
+
+  def handle_factory(healthy=True):
+    def create(peer_id, addr, desc, caps):
+      handle = mock.MagicMock()
+      handle.id.return_value = peer_id
+      handle.addr.return_value = addr
+      handle.health_check = mock.AsyncMock(return_value=healthy)
+      return handle
+    return create
+
+  # Crossed listen/broadcast ports, as in the reference's test (:10-77).
+  d1 = UDPDiscovery("node1", 50051, port1, port2, handle_factory(), broadcast_interval=0.2, device_capabilities=caps)
+  d2 = UDPDiscovery("node2", 50052, port2, port1, handle_factory(), broadcast_interval=0.2, device_capabilities=caps)
+  await d1.start()
+  await d2.start()
+  try:
+    peers1 = await asyncio.wait_for(d1.discover_peers(wait_for_peers=1), timeout=10)
+    peers2 = await asyncio.wait_for(d2.discover_peers(wait_for_peers=1), timeout=10)
+    assert peers1[0].id() == "node2"
+    assert peers2[0].id() == "node1"
+  finally:
+    await d1.stop()
+    await d2.stop()
+
+
+async def test_udp_discovery_rejects_unhealthy_peer():
+  from xotorch_tpu.networking.udp.discovery import UDPDiscovery
+  from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+
+  caps = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  port1, port2 = find_available_port(), find_available_port()
+
+  def unhealthy_factory(peer_id, addr, desc, c):
+    handle = mock.MagicMock()
+    handle.id.return_value = peer_id
+    handle.health_check = mock.AsyncMock(return_value=False)
+    return handle
+
+  d1 = UDPDiscovery("node1", 50051, port1, port2, unhealthy_factory, broadcast_interval=0.2, device_capabilities=caps)
+  d2 = UDPDiscovery(
+    "node2", 50052, port2, port1,
+    lambda *a: mock.MagicMock(health_check=mock.AsyncMock(return_value=True)),
+    broadcast_interval=0.2, device_capabilities=caps,
+  )
+  await d1.start()
+  await d2.start()
+  try:
+    await asyncio.sleep(1.0)
+    assert len(await d1.discover_peers()) == 0  # node2 seen but unhealthy
+  finally:
+    await d1.stop()
+    await d2.stop()
+
+
+# --------------------------------------------------------- manual discovery
+
+def _manual_config(tmp_path, peers):
+  cfg = {"peers": peers}
+  path = tmp_path / "topology.json"
+  path.write_text(json.dumps(cfg))
+  return str(path)
+
+
+def _caps_json():
+  return {"model": "m", "chip": "c", "memory": 1024, "flops": {"fp32": 1, "fp16": 2, "int8": 4}}
+
+
+async def test_manual_discovery_finds_healthy_peers(tmp_path):
+  from xotorch_tpu.networking.manual.discovery import ManualDiscovery
+
+  path = _manual_config(tmp_path, {
+    "node-a": {"address": "1.2.3.4", "port": 1, "device_capabilities": _caps_json()},
+    "node-b": {"address": "5.6.7.8", "port": 2, "device_capabilities": _caps_json()},
+  })
+
+  def create(peer_id, addr, desc, caps):
+    handle = mock.MagicMock()
+    handle.id.return_value = peer_id
+    handle.health_check = mock.AsyncMock(return_value=peer_id == "node-b")
+    return handle
+
+  d = ManualDiscovery(path, "node-a", create, poll_interval=0.1)
+  await d.start()
+  try:
+    peers = await asyncio.wait_for(d.discover_peers(wait_for_peers=1), timeout=5)
+    # node-a is self; node-b healthy -> exactly one peer.
+    assert [p.id() for p in peers] == ["node-b"]
+  finally:
+    await d.stop()
+
+
+def test_manual_config_validation_errors(tmp_path):
+  from xotorch_tpu.networking.manual.network_topology_config import NetworkTopology
+
+  bad = tmp_path / "bad.json"
+  bad.write_text(json.dumps({"peers": {"x": {"address": "1.2.3.4"}}}))  # missing port/caps
+  with pytest.raises(ValueError):
+    NetworkTopology.from_path(str(bad))
+
+  notjson = tmp_path / "notjson.json"
+  notjson.write_text("{nope")
+  with pytest.raises(ValueError):
+    NetworkTopology.from_path(str(notjson))
+
+  with pytest.raises(FileNotFoundError):
+    NetworkTopology.from_path(str(tmp_path / "missing.json"))
+
+
+async def test_manual_discovery_keeps_last_good_config(tmp_path):
+  from xotorch_tpu.networking.manual.discovery import ManualDiscovery
+
+  path = _manual_config(tmp_path, {
+    "node-b": {"address": "5.6.7.8", "port": 2, "device_capabilities": _caps_json()},
+  })
+
+  def create(peer_id, addr, desc, caps):
+    handle = mock.MagicMock()
+    handle.id.return_value = peer_id
+    handle.health_check = mock.AsyncMock(return_value=True)
+    return handle
+
+  d = ManualDiscovery(path, "node-a", create, poll_interval=0.05)
+  await d.start()
+  try:
+    await asyncio.wait_for(d.discover_peers(wait_for_peers=1), timeout=5)
+    # Corrupt the file: discovery must keep serving the last good config.
+    with open(path, "w") as f:
+      f.write("{broken")
+    await asyncio.sleep(0.2)
+    assert len(await d.discover_peers()) == 1
+  finally:
+    await d.stop()

@@ -1,0 +1,238 @@
+"""Orchestration tests: the token ring end-to-end, in one process.
+
+The reference's gate for this layer is a 2-3 process localhost ring with the
+dummy engine, then engine-on-CPU bit-parity vs a single node (SURVEY §7.2.5).
+Here both live in one process: real GRPCServers + real Nodes on localhost
+ports, static discovery, dummy engine for the ring mechanics and the real
+JAX engine (synthetic-tiny) for numerical parity.
+"""
+import asyncio
+import json
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.inference.dummy import DummyInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking.discovery import Discovery
+from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
+from xotorch_tpu.networking.grpc.server import GRPCServer
+from xotorch_tpu.orchestration.node import Node
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from xotorch_tpu.utils.helpers import find_available_port
+
+
+class StaticDiscovery(Discovery):
+  def __init__(self, peers):
+    self._peers = peers
+
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+  async def discover_peers(self, wait_for_peers: int = 0):
+    return list(self._peers)
+
+
+class NullServer:
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+
+def _caps(mem=1024):
+  return DeviceCapabilities("test", "chip", mem, DeviceFlops(1, 2, 4))
+
+
+async def _make_node(node_id, engine, peers=(), port=None, **kw):
+  server = GRPCServer(None, "localhost", port) if port else NullServer()
+  node = Node(
+    node_id, server, engine, StaticDiscovery(list(peers)), None,
+    RingMemoryWeightedPartitioningStrategy(), **kw,
+  )
+  if port:
+    server.node = node
+  node.device_capabilities = _caps()
+  return node
+
+
+async def test_single_node_ring_generates_until_eos():
+  engine = DummyInferenceEngine()
+  node = await _make_node("solo", engine)
+  node.topology.update_node("solo", _caps())
+
+  done = asyncio.Event()
+  seen = {}
+
+  def on_token(request_id, tokens, is_finished):
+    seen[request_id] = (list(tokens), is_finished)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("test").on_next(on_token)
+  shard = Shard("dummy", 0, 0, 8)
+  await node.process_prompt(shard, "hello world", "req-1")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  tokens, finished = seen["req-1"]
+  assert finished
+  assert tokens[-1] == engine.tokenizer.eos_token_id
+  assert len(tokens) == engine.num_generate_dummy_tokens
+
+
+async def test_single_node_respects_max_generate_tokens():
+  engine = DummyInferenceEngine()
+  engine.num_generate_dummy_tokens = 10_000  # never EOS on its own
+  node = await _make_node("solo", engine, max_generate_tokens=7)
+  node.topology.update_node("solo", _caps())
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  await node.process_prompt(Shard("dummy", 0, 0, 8), "hi", "req-2")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  assert len(out["tokens"]) == 7
+
+
+async def _two_node_ring(engine_a, engine_b, **node_kw):
+  """Two real Nodes with real gRPC servers on localhost."""
+  port_a, port_b = find_available_port(), find_available_port()
+  peer_to_a = lambda: GRPCPeerHandle("node-a", f"localhost:{port_a}", "test", _caps())
+  peer_to_b = lambda: GRPCPeerHandle("node-b", f"localhost:{port_b}", "test", _caps())
+
+  node_a = await _make_node("node-a", engine_a, peers=[peer_to_b()], port=port_a, **node_kw)
+  node_b = await _make_node("node-b", engine_b, peers=[peer_to_a()], port=port_b, **node_kw)
+  await node_a.server.start()
+  await node_b.server.start()
+  await node_a.update_peers()
+  await node_b.update_peers()
+  await node_a.collect_topology(set())
+  await node_b.collect_topology(set())
+  return node_a, node_b
+
+
+async def _stop_ring(*nodes):
+  for n in nodes:
+    await n.server.stop()
+
+
+async def test_two_node_gossip_topology():
+  node_a, node_b = await _two_node_ring(DummyInferenceEngine(), DummyInferenceEngine())
+  try:
+    assert set(node_a.topology.nodes) == {"node-a", "node-b"}
+    assert set(node_b.topology.nodes) == {"node-a", "node-b"}
+    # Both derive the SAME partition table (masterless consensus).
+    parts_a = node_a.partitioning_strategy.partition(node_a.topology)
+    parts_b = node_b.partitioning_strategy.partition(node_b.topology)
+    assert [p.node_id for p in parts_a] == [p.node_id for p in parts_b]
+  finally:
+    await _stop_ring(node_a, node_b)
+
+
+async def test_two_node_ring_dummy_generation():
+  engine_a, engine_b = DummyInferenceEngine(), DummyInferenceEngine()
+  node_a, node_b = await _two_node_ring(engine_a, engine_b)
+  try:
+    done = asyncio.Event()
+    result = {}
+
+    def on_token(request_id, tokens, is_finished):
+      result["tokens"] = list(tokens)
+      if is_finished:
+        done.set()
+
+    # The ring broadcasts results to every peer: watch on node_a even though
+    # the sampler may live on node_b.
+    node_a.on_token.register("t").on_next(on_token)
+    node_b.on_token.register("t").on_next(on_token)
+
+    await node_a.process_prompt(Shard("dummy", 0, 0, 8), "hello", "ring-req")
+    await asyncio.wait_for(done.wait(), timeout=15)
+    assert len(result["tokens"]) >= 1
+  finally:
+    await _stop_ring(node_a, node_b)
+
+
+async def test_two_node_jax_ring_matches_single_node():
+  """Numerical gate: a 2-peer ring over gRPC must produce the same greedy
+  tokens as one node holding the whole model (reference invariant, §4)."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  gen_tokens = 5
+  # Single node reference.
+  solo_engine = JAXShardInferenceEngine(dtype="float32")
+  solo = await _make_node("solo", solo_engine, max_generate_tokens=gen_tokens, default_sample_temp=0.0)
+  solo.topology.update_node("solo", _caps())
+  done = asyncio.Event()
+  solo_out = {}
+
+  def on_token_solo(request_id, tokens, is_finished):
+    solo_out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  solo.on_token.register("t").on_next(on_token_solo)
+  await solo.process_prompt(Shard("synthetic-tiny", 0, 0, 4), "hello world test prompt", "solo-req")
+  await asyncio.wait_for(done.wait(), timeout=60)
+
+  # Two-node ring, same model split across two engines.
+  engine_a = JAXShardInferenceEngine(dtype="float32")
+  engine_b = JAXShardInferenceEngine(dtype="float32")
+  node_a, node_b = await _two_node_ring(
+    engine_a, engine_b, max_generate_tokens=gen_tokens, default_sample_temp=0.0
+  )
+  try:
+    ring_done = asyncio.Event()
+    ring_out = {}
+
+    def on_token_ring(request_id, tokens, is_finished):
+      ring_out["tokens"] = list(tokens)
+      if is_finished:
+        ring_done.set()
+
+    node_a.on_token.register("t").on_next(on_token_ring)
+    node_b.on_token.register("t").on_next(on_token_ring)
+    await node_a.process_prompt(Shard("synthetic-tiny", 0, 0, 4), "hello world test prompt", "ring-req")
+    await asyncio.wait_for(ring_done.wait(), timeout=60)
+    assert ring_out["tokens"] == solo_out["tokens"]
+  finally:
+    await _stop_ring(node_a, node_b)
+
+
+async def test_two_node_training_ring():
+  """Pipelined training over the ring with the dummy engine: loss comes back
+  from the last shard through the chain."""
+  node_a, node_b = await _two_node_ring(DummyInferenceEngine(), DummyInferenceEngine())
+  try:
+    example = np.ones((1, 4), dtype=np.int64)
+    target = np.ones((1, 4), dtype=np.int64)
+    length = np.array([4], dtype=np.int64)
+    loss, grads = await node_a.enqueue_example(Shard("dummy", 0, 0, 8), example, target, length, train=True)
+    assert loss == 0.42
+  finally:
+    await _stop_ring(node_a, node_b)
+
+
+async def test_opaque_status_bus_and_active_node_tracking():
+  node_a, node_b = await _two_node_ring(DummyInferenceEngine(), DummyInferenceEngine())
+  try:
+    status = json.dumps({"type": "node_status", "node_id": "node-a", "status": "start_process_prompt"})
+    await node_a.broadcast_opaque_status("req-x", status)
+    await asyncio.sleep(0.2)
+    assert node_b.topology.active_node_id == "node-a"
+    end = json.dumps({"type": "node_status", "node_id": "node-a", "status": "end_process_prompt"})
+    await node_a.broadcast_opaque_status("req-x", end)
+    await asyncio.sleep(0.2)
+    assert node_b.topology.active_node_id is None
+  finally:
+    await _stop_ring(node_a, node_b)

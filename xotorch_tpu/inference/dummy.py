@@ -51,3 +51,20 @@ class DummyInferenceEngine(InferenceEngine):
 
   async def ensure_shard(self, shard: Shard) -> None:
     self.shard = shard
+
+  async def train_example(self, request_id, shard, example, target, lengths, forward_fn=None):
+    await self.ensure_shard(shard)
+    if shard.is_last_layer:
+      return 0.42, np.zeros_like(np.asarray(example, dtype=np.float32))
+    assert forward_fn is not None
+    activations = np.asarray(example, dtype=np.float32)
+    loss, _ = await forward_fn(activations, target, lengths, True)
+    return loss, np.zeros_like(activations)
+
+  async def evaluate_example(self, request_id, shard, example, target, lengths, forward_fn=None) -> float:
+    await self.ensure_shard(shard)
+    if shard.is_last_layer:
+      return 0.42
+    assert forward_fn is not None
+    loss, _ = await forward_fn(np.asarray(example, dtype=np.float32), target, lengths, False)
+    return loss

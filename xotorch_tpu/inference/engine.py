@@ -66,14 +66,20 @@ class InferenceEngine(ABC):
   async def clear_session(self) -> None:
     self.session.clear()
 
-  async def train(
-    self, request_id: str, shard: Shard, inputs: np.ndarray, targets: np.ndarray, lengths: np.ndarray, loss: str = "sparse_ce"
-  ) -> Tuple[np.ndarray, np.ndarray]:
-    """Full train leaf (loss, grad-wrt-input). Implemented by the JAX engine;
-    the reference declared this but never implemented it (SURVEY §0)."""
+  async def train_example(
+    self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
+    lengths: np.ndarray, forward_fn=None,
+  ) -> Tuple[float, Optional[np.ndarray]]:
+    """Pipelined train leaf: run this shard's slice, chain downstream via
+    `forward_fn(activations, target, lengths, train=True) -> (loss, grad)`,
+    apply the local optimizer, return (loss, grad_wrt_input). The reference
+    declared engine.train but never implemented it (SURVEY §0)."""
     raise NotImplementedError(f"{type(self).__name__} does not support training")
 
-  async def evaluate(self, request_id: str, shard: Shard, inputs: np.ndarray, targets: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+  async def evaluate_example(
+    self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
+    lengths: np.ndarray, forward_fn=None,
+  ) -> float:
     raise NotImplementedError(f"{type(self).__name__} does not support evaluation")
 
 

@@ -210,9 +210,12 @@ class JAXShardInferenceEngine(InferenceEngine):
 
       if synthetic_cfg is not None:
         cfg = config_from_hf_dict(synthetic_cfg)
+        # Per-layer key folding makes this shard's weights bit-identical to
+        # the same layer range of a full-model init — ring peers agree on
+        # synthetic weights while allocating only shard-sized HBM.
         params = init_random_params(
           cfg, shard.get_layer_count(), shard.is_first_layer, shard.is_last_layer,
-          jax.random.PRNGKey(0), dtype=self._dtype(),
+          jax.random.PRNGKey(0), dtype=self._dtype(), start_layer=shard.start_layer,
         )
       else:
         cfg = load_model_config(model_dir)
@@ -225,6 +228,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       return cfg, params, forward_jit
 
     self.cfg, self.params, self._forward_jit = await self._run(_load)
+    self._opt_state = None  # optimizer state is invalid for a new param tree
     self.cache_len = min(self._configured_cache_len, self.cfg.max_seq_len)
     self._model_dir = model_dir
     self._synthetic = synthetic_cfg is not None
@@ -266,6 +270,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       return load_shard_params(model_dir, self.cfg, self.shard, dtype=self._dtype())
 
     self.params = await self._run(_load)
+    self._opt_state = None  # optimizer state is invalid for reloaded weights
 
   async def save_checkpoint(self, shard: Shard, path: str) -> None:
     await self.ensure_shard(shard)
@@ -277,6 +282,112 @@ class JAXShardInferenceEngine(InferenceEngine):
     await self._run(_save)
 
   # -------------------------------------------------------------- training
+
+  def _ensure_optimizer(self):
+    """Optimizer state is tied to the current param tree; _load_shard and
+    load_checkpoint reset it (stale Adam moments must never be applied to a
+    different tree)."""
+    if getattr(self, "_optimizer", None) is None or getattr(self, "_opt_state", None) is None:
+      import optax
+      lr = float(os.getenv("XOT_LR", "1e-5"))
+      self._optimizer = optax.adamw(lr)
+      self._opt_state = self._optimizer.init(self.params)
+    return self._optimizer
+
+  async def train_example(self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
+                          lengths: np.ndarray, forward_fn=None):
+    """Pipelined training over the ring: forward my slice (keeping the vjp
+    residuals), chain downstream through forward_fn, pull the gradient back
+    through the saved vjp, apply AdamW locally, hand the input-gradient
+    upstream. Completes node.py:299-345's missing engine leaf. Every device
+    op (including host<->device transfers) runs on the single executor."""
+    await self.ensure_shard(shard)
+    if not shard.is_last_layer and forward_fn is None:
+      raise ValueError("Non-last shard requires forward_fn to chain the ring")
+    optimizer = self._ensure_optimizer()
+
+    if shard.is_last_layer:
+      def _last():
+        import jax.numpy as jnp
+        import optax
+        from xotorch_tpu.train.step import shard_loss_and_grads
+        x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
+        tgt = jnp.asarray(np.asarray(target).astype(np.int32))
+        lens = jnp.asarray(np.asarray(lengths).reshape(-1).astype(np.int32))
+        loss, x_grad, param_grads = shard_loss_and_grads(
+          self.params, self.cfg, x, tgt, lens, shard.is_first_layer, True
+        )
+        updates, self._opt_state = optimizer.update(param_grads, self._opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        return float(loss), np.asarray(x_grad)
+      return await self._run(_last)
+
+    # Mid/first shard: one forward with saved residuals, then backward later.
+    def _fwd_vjp():
+      import jax
+      import jax.numpy as jnp
+      from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
+      x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
+      B, T = x.shape[0], x.shape[1]
+      cache = init_kv_cache(self.cfg, shard.get_layer_count(), B, T, jnp.float32)
+
+      def fwd(p, xin):
+        return forward_shard(p, xin, cache, jnp.int32(0), self.cfg, shard.is_first_layer, False)[0]
+
+      if shard.is_first_layer:
+        out, vjp_fn = jax.vjp(lambda p: fwd(p, x), self.params)
+      else:
+        out, vjp_fn = jax.vjp(fwd, self.params, x)
+      return np.asarray(out), vjp_fn, out.dtype
+
+    activations, vjp_fn, out_dtype = await self._run(_fwd_vjp)
+    loss, down_grad = await forward_fn(activations, np.asarray(target), np.asarray(lengths), True)
+    if down_grad is None:
+      raise RuntimeError(f"Downstream shard returned no gradient for {request_id}")
+
+    def _bwd_apply():
+      import jax.numpy as jnp
+      import optax
+      down = jnp.asarray(np.asarray(down_grad)).astype(out_dtype)
+      if shard.is_first_layer:
+        (param_grads,) = vjp_fn(down)
+        x_grad = np.zeros((1,), np.float32)  # token inputs are not differentiable
+      else:
+        param_grads, xg = vjp_fn(down)
+        x_grad = np.asarray(xg)
+      updates, self._opt_state = optimizer.update(param_grads, self._opt_state, self.params)
+      self.params = optax.apply_updates(self.params, updates)
+      return x_grad
+
+    x_grad = await self._run(_bwd_apply)
+    return float(loss), x_grad
+
+  async def evaluate_example(self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
+                             lengths: np.ndarray, forward_fn=None) -> float:
+    await self.ensure_shard(shard)
+    if not shard.is_last_layer and forward_fn is None:
+      raise ValueError("Non-last shard requires forward_fn to chain the ring")
+
+    def _fwd():
+      import jax.numpy as jnp
+      from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
+      x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
+      B, T = x.shape[0], x.shape[1]
+      cache = init_kv_cache(self.cfg, shard.get_layer_count(), B, T, jnp.float32)
+      out = forward_shard(self.params, x, cache, jnp.int32(0), self.cfg,
+                          shard.is_first_layer, shard.is_last_layer)[0]
+      if shard.is_last_layer:
+        from xotorch_tpu.train.step import masked_ce_loss
+        tgt = jnp.asarray(np.asarray(target).astype(np.int32))
+        lens = jnp.asarray(np.asarray(lengths).reshape(-1).astype(np.int32))
+        return float(masked_ce_loss(out, tgt, lens))
+      return np.asarray(out)
+
+    out = await self._run(_fwd)
+    if shard.is_last_layer:
+      return out
+    loss, _ = await forward_fn(out, np.asarray(target), np.asarray(lengths), False)
+    return loss
 
   async def clear_request(self, request_id: str) -> None:
     self.states.pop(request_id, None)

@@ -146,48 +146,61 @@ def forward_shard(
 
 def init_random_params(
   cfg: ModelConfig, num_local_layers: int, is_first: bool, is_last: bool,
-  key: jax.Array, dtype=jnp.float32, scale: float = 0.02,
+  key: jax.Array, dtype=jnp.float32, scale: float = 0.02, start_layer: int = 0,
 ) -> Params:
   """Random-initialised shard params in the stacked layout (tests, benches,
-  and training-from-scratch)."""
-  keys = iter(jax.random.split(key, 32))
-  L, H, D = num_local_layers, cfg.hidden_size, cfg.head_dim
+  and training-from-scratch).
+
+  Per-tensor keys are folded from (absolute layer index, tensor slot), so a
+  shard generating layers [a, b] gets bit-identical weights to the same
+  layers of a full-model init — ring peers agree on synthetic weights without
+  ever materialising the whole model (HBM stays shard-sized).
+  """
+  H, D = cfg.hidden_size, cfg.head_dim
   I = cfg.intermediate_size
+  E, MI = cfg.num_experts, cfg.moe_intermediate_size or I
 
-  def rnd(*shape):
-    return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dtype)
+  def rnd(k, *shape):
+    return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
-  layers: Params = {
-    "attn_norm": jnp.ones((L, H), dtype),
-    "mlp_norm": jnp.ones((L, H), dtype),
-    "wq": rnd(L, H, cfg.num_heads * D),
-    "wk": rnd(L, H, cfg.num_kv_heads * D),
-    "wv": rnd(L, H, cfg.num_kv_heads * D),
-    "wo": rnd(L, cfg.num_heads * D, H),
-  }
-  if cfg.attention_bias:
-    layers["bq"] = jnp.zeros((L, cfg.num_heads * D), dtype)
-    layers["bk"] = jnp.zeros((L, cfg.num_kv_heads * D), dtype)
-    layers["bv"] = jnp.zeros((L, cfg.num_kv_heads * D), dtype)
-  if cfg.qk_norm:
-    layers["q_norm"] = jnp.ones((L, D), dtype)
-    layers["k_norm"] = jnp.ones((L, D), dtype)
-  if cfg.is_moe:
-    E, MI = cfg.num_experts, cfg.moe_intermediate_size or I
-    layers["router"] = rnd(L, H, E)
-    layers["we_gate"] = rnd(L, E, H, MI)
-    layers["we_up"] = rnd(L, E, H, MI)
-    layers["we_down"] = rnd(L, E, MI, H)
-  else:
-    layers["w_gate"] = rnd(L, H, I)
-    layers["w_up"] = rnd(L, H, I)
-    layers["w_down"] = rnd(L, I, H)
+  def layer_params(abs_idx: int) -> Params:
+    def lk(slot: int):
+      return jax.random.fold_in(jax.random.fold_in(key, abs_idx), slot)
+    p: Params = {
+      "attn_norm": jnp.ones((H,), dtype),
+      "mlp_norm": jnp.ones((H,), dtype),
+      "wq": rnd(lk(0), H, cfg.num_heads * D),
+      "wk": rnd(lk(1), H, cfg.num_kv_heads * D),
+      "wv": rnd(lk(2), H, cfg.num_kv_heads * D),
+      "wo": rnd(lk(3), cfg.num_heads * D, H),
+    }
+    if cfg.attention_bias:
+      p["bq"] = jnp.zeros((cfg.num_heads * D,), dtype)
+      p["bk"] = jnp.zeros((cfg.num_kv_heads * D,), dtype)
+      p["bv"] = jnp.zeros((cfg.num_kv_heads * D,), dtype)
+    if cfg.qk_norm:
+      p["q_norm"] = jnp.ones((D,), dtype)
+      p["k_norm"] = jnp.ones((D,), dtype)
+    if cfg.is_moe:
+      p["router"] = rnd(lk(4), H, E)
+      p["we_gate"] = rnd(lk(5), E, H, MI)
+      p["we_up"] = rnd(lk(6), E, H, MI)
+      p["we_down"] = rnd(lk(7), E, MI, H)
+    else:
+      p["w_gate"] = rnd(lk(4), H, I)
+      p["w_up"] = rnd(lk(5), H, I)
+      p["w_down"] = rnd(lk(6), I, H)
+    return p
+
+  per_layer = [layer_params(start_layer + i) for i in range(num_local_layers)]
+  layers = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
 
   params: Params = {"layers": layers}
+  embed_key = jax.random.fold_in(key, 1_000_000)
   if is_first or cfg.tie_word_embeddings:
-    params["embed"] = {"embedding": rnd(cfg.vocab_size, H)}
+    params["embed"] = {"embedding": rnd(embed_key, cfg.vocab_size, H)}
   if is_last:
     params["final_norm"] = jnp.ones((H,), dtype)
     if not cfg.tie_word_embeddings:
-      params["lm_head"] = rnd(H, cfg.vocab_size)
+      params["lm_head"] = rnd(jax.random.fold_in(key, 1_000_001), H, cfg.vocab_size)
   return params

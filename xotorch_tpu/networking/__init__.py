@@ -1,0 +1,5 @@
+from xotorch_tpu.networking.discovery import Discovery
+from xotorch_tpu.networking.peer_handle import PeerHandle
+from xotorch_tpu.networking.server import Server
+
+__all__ = ["Discovery", "PeerHandle", "Server"]

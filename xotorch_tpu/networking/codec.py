@@ -1,0 +1,76 @@
+"""Binary wire codec: JSON header + raw tensor payload, bf16-native.
+
+Replaces the reference's protobuf `Tensor{bytes,shape,dtype}` +
+`InferenceState` proto maps + JSON side-channel (node_service.proto:50-64,
+grpc_peer_handle.py:203-224) with one self-describing frame:
+
+  magic 'XOT1' | u32 header_len | header JSON | tensor payload
+
+The header carries all scalar fields plus tensor descriptors (shape/dtype/
+offset); tensor bytes are appended raw — hidden states cross the wire as
+bf16 (ml_dtypes), fixing the reference's fp32 upcast at every hop
+(sharded_inference_engine.py:352). No codegen step, no proto toolchain.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"XOT1"
+
+_DTYPES: Dict[str, Any] = {}
+
+
+def _dtype(name: str):
+  if not _DTYPES:
+    import ml_dtypes
+    _DTYPES.update({
+      "bfloat16": np.dtype(ml_dtypes.bfloat16),
+      "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+      "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+    })
+  if name in _DTYPES:
+    return _DTYPES[name]
+  return np.dtype(name)
+
+
+def dtype_name(arr: np.ndarray) -> str:
+  name = arr.dtype.name
+  return name
+
+
+def encode_message(fields: Dict[str, Any], tensors: Optional[Dict[str, np.ndarray]] = None) -> bytes:
+  tensors = tensors or {}
+  descriptors = {}
+  payload_parts = []
+  offset = 0
+  for name, arr in tensors.items():
+    arr = np.ascontiguousarray(arr)
+    raw = arr.tobytes()
+    descriptors[name] = {
+      "shape": list(arr.shape),
+      "dtype": dtype_name(arr),
+      "offset": offset,
+      "nbytes": len(raw),
+    }
+    payload_parts.append(raw)
+    offset += len(raw)
+  header = json.dumps({"fields": fields, "tensors": descriptors}).encode("utf-8")
+  return MAGIC + struct.pack(">I", len(header)) + header + b"".join(payload_parts)
+
+
+def decode_message(data: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+  if data[:4] != MAGIC:
+    raise ValueError("Bad frame magic")
+  (header_len,) = struct.unpack(">I", data[4:8])
+  header = json.loads(data[8:8 + header_len].decode("utf-8"))
+  payload = memoryview(data)[8 + header_len:]
+  tensors: Dict[str, np.ndarray] = {}
+  for name, desc in header["tensors"].items():
+    dt = _dtype(desc["dtype"])
+    raw = payload[desc["offset"]:desc["offset"] + desc["nbytes"]]
+    tensors[name] = np.frombuffer(raw, dtype=dt).reshape(desc["shape"])
+  return header["fields"], tensors

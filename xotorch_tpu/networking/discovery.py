@@ -1,0 +1,21 @@
+"""Discovery ABC (parity: /root/reference/xotorch/networking/discovery.py:6-18)."""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List
+
+from xotorch_tpu.networking.peer_handle import PeerHandle
+
+
+class Discovery(ABC):
+  @abstractmethod
+  async def start(self) -> None:
+    ...
+
+  @abstractmethod
+  async def stop(self) -> None:
+    ...
+
+  @abstractmethod
+  async def discover_peers(self, wait_for_peers: int = 0) -> List[PeerHandle]:
+    ...

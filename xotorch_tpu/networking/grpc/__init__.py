@@ -1,0 +1,4 @@
+from xotorch_tpu.networking.grpc.server import GRPCServer
+from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
+
+__all__ = ["GRPCServer", "GRPCPeerHandle"]

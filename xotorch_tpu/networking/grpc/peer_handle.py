@@ -1,0 +1,119 @@
+"""gRPC peer handle: client side of every RPC.
+
+Parity: /root/reference/xotorch/networking/grpc/grpc_peer_handle.py:27-224 —
+lazy connect with timeout, gzip channel compression, 5 s health checks —
+with tensors framed by the XOT1 codec (bf16 stays bf16 on the wire).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple
+
+import grpc
+import numpy as np
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking.codec import decode_message, encode_message
+from xotorch_tpu.networking.grpc.service import CHANNEL_OPTIONS, method_path
+from xotorch_tpu.networking.peer_handle import PeerHandle
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
+from xotorch_tpu.topology.topology import Topology
+from xotorch_tpu.utils.helpers import DEBUG
+
+
+class GRPCPeerHandle(PeerHandle):
+  def __init__(self, _id: str, address: str, desc: str, device_capabilities: DeviceCapabilities):
+    self._id = _id
+    self.address = address
+    self.desc = desc
+    self._device_capabilities = device_capabilities
+    self.channel: Optional[grpc.aio.Channel] = None
+    self._stubs = {}
+
+  def id(self) -> str:
+    return self._id
+
+  def addr(self) -> str:
+    return self.address
+
+  def description(self) -> str:
+    return self.desc
+
+  def device_capabilities(self) -> DeviceCapabilities:
+    return self._device_capabilities
+
+  async def connect(self) -> None:
+    if self.channel is None:
+      self.channel = grpc.aio.insecure_channel(
+        self.address, options=CHANNEL_OPTIONS, compression=grpc.Compression.Gzip
+      )
+      self._stubs = {}
+    await asyncio.wait_for(self.channel.channel_ready(), timeout=10.0)
+
+  async def _ensure_connected(self) -> None:
+    if self.channel is None or self.channel.get_state() != grpc.ChannelConnectivity.READY:
+      await self.connect()
+
+  def _stub(self, method: str):
+    if method not in self._stubs:
+      self._stubs[method] = self.channel.unary_unary(method_path(method))
+    return self._stubs[method]
+
+  async def _call(self, method: str, fields: dict, tensors: Optional[dict] = None, timeout: float = 15.0):
+    await self._ensure_connected()
+    payload = encode_message(fields, tensors)
+    response = await self._stub(method)(payload, timeout=timeout)
+    return decode_message(bytes(response))
+
+  async def is_connected(self) -> bool:
+    return self.channel is not None and self.channel.get_state() == grpc.ChannelConnectivity.READY
+
+  async def disconnect(self) -> None:
+    if self.channel is not None:
+      await self.channel.close()
+    self.channel = None
+    self._stubs = {}
+
+  async def health_check(self) -> bool:
+    try:
+      fields, _ = await asyncio.wait_for(self._call("HealthCheck", {}, timeout=5.0), timeout=5.0)
+      return bool(fields.get("is_healthy"))
+    except Exception as e:
+      if DEBUG >= 4:
+        print(f"Health check failed for {self._id}@{self.address}: {e!r}")
+      return False
+
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None) -> None:
+    await self._call("SendPrompt", {"shard": shard.to_dict(), "prompt": prompt, "request_id": request_id})
+
+  async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
+                        inference_state: Optional[dict] = None) -> None:
+    await self._call(
+      "SendTensor",
+      {"shard": shard.to_dict(), "request_id": request_id, "inference_state": inference_state},
+      {"tensor": tensor},
+    )
+
+  async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
+                         train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
+    fields, tensors = await self._call(
+      "SendExample",
+      {"shard": shard.to_dict(), "train": train, "request_id": request_id},
+      {"example": example, "target": target, "length": length},
+      timeout=600.0,
+    )
+    loss = fields.get("loss")
+    return (loss, tensors.get("grads")) if loss is not None else None
+
+  async def send_result(self, request_id: str, result, is_finished: bool) -> None:
+    if isinstance(result, np.ndarray):
+      await self._call("SendResult", {"request_id": request_id, "is_finished": is_finished}, {"result": result})
+    else:
+      await self._call("SendResult", {"request_id": request_id, "result": list(result), "is_finished": is_finished})
+
+  async def send_opaque_status(self, request_id: str, status: str) -> None:
+    await self._call("SendOpaqueStatus", {"request_id": request_id, "status": status})
+
+  async def collect_topology(self, visited: set, max_depth: int) -> Topology:
+    fields, _ = await self._call("CollectTopology", {"visited": list(visited), "max_depth": max_depth}, timeout=10.0)
+    return Topology.from_json(fields["topology"])

@@ -1,0 +1,103 @@
+"""gRPC server: the process-boundary face of a Node.
+
+Parity: /root/reference/xotorch/networking/grpc/grpc_server.py:17-169 — each
+RPC decodes the XOT1 frame and calls the local Node; SendExample returns
+(loss, grads) for pipelined training; SendResult re-triggers local on_token.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking.codec import decode_message, encode_message
+from xotorch_tpu.networking.grpc.service import CHANNEL_OPTIONS, METHODS, SERVICE_NAME
+from xotorch_tpu.networking.server import Server
+from xotorch_tpu.topology.topology import Topology
+from xotorch_tpu.utils.helpers import DEBUG
+
+
+class GRPCServer(Server):
+  def __init__(self, node, host: str, port: int):
+    self.node = node
+    self.host = host
+    self.port = port
+    self.server: Optional[grpc.aio.Server] = None
+
+  async def start(self) -> None:
+    self.server = grpc.aio.server(options=CHANNEL_OPTIONS)
+    handlers = {
+      name: grpc.unary_unary_rpc_method_handler(getattr(self, f"_rpc_{_snake(name)}"))
+      for name in METHODS
+    }
+    self.server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+    listen_addr = f"{self.host}:{self.port}"
+    self.server.add_insecure_port(listen_addr)
+    await self.server.start()
+    if DEBUG >= 1:
+      print(f"gRPC server listening on {listen_addr}")
+
+  async def stop(self) -> None:
+    if self.server is not None:
+      await self.server.stop(grace=5)
+      await self.server.wait_for_termination()
+      self.server = None
+      if DEBUG >= 1:
+        print("gRPC server stopped")
+
+  # ------------------------------------------------------------------ RPCs
+
+  async def _rpc_send_prompt(self, request: bytes, context) -> bytes:
+    fields, _ = decode_message(request)
+    shard = Shard.from_dict(fields["shard"])
+    await self.node.process_prompt(shard, fields["prompt"], fields.get("request_id"))
+    return encode_message({"ok": True})
+
+  async def _rpc_send_tensor(self, request: bytes, context) -> bytes:
+    fields, tensors = decode_message(request)
+    shard = Shard.from_dict(fields["shard"])
+    await self.node.process_tensor(
+      shard, tensors["tensor"], fields.get("request_id"), fields.get("inference_state")
+    )
+    return encode_message({"ok": True})
+
+  async def _rpc_send_example(self, request: bytes, context) -> bytes:
+    fields, tensors = decode_message(request)
+    shard = Shard.from_dict(fields["shard"])
+    loss, grads = await self.node.process_example(
+      shard, tensors["example"], tensors["target"], tensors["length"], fields["train"], fields.get("request_id")
+    )
+    if grads is None:
+      return encode_message({"loss": float(loss)})
+    return encode_message({"loss": float(loss)}, {"grads": np.asarray(grads)})
+
+  async def _rpc_collect_topology(self, request: bytes, context) -> bytes:
+    fields, _ = decode_message(request)
+    topology = await self.node.collect_topology(set(fields.get("visited", [])), fields.get("max_depth", 4))
+    return encode_message({"topology": topology.to_json()})
+
+  async def _rpc_send_result(self, request: bytes, context) -> bytes:
+    fields, tensors = decode_message(request)
+    result = tensors["result"] if "result" in tensors else fields.get("result", [])
+    self.node.on_token.trigger_all(fields["request_id"], result, fields["is_finished"])
+    return encode_message({"ok": True})
+
+  async def _rpc_send_opaque_status(self, request: bytes, context) -> bytes:
+    fields, _ = decode_message(request)
+    self.node.on_opaque_status.trigger_all(fields["request_id"], fields["status"])
+    return encode_message({"ok": True})
+
+  async def _rpc_health_check(self, request: bytes, context) -> bytes:
+    return encode_message({"is_healthy": True})
+
+
+def _snake(name: str) -> str:
+  out = []
+  for i, ch in enumerate(name):
+    if ch.isupper() and i > 0:
+      out.append("_")
+    out.append(ch.lower())
+  return "".join(out)

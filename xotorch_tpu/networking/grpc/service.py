@@ -1,0 +1,42 @@
+"""Wire schema without codegen.
+
+The reference ships generated protobuf stubs (node_service.proto:5-13,
+node_service_pb2*.py, 445 LoC of codegen). This build keeps gRPC/HTTP2 as
+the cross-host transport but frames messages with the XOT1 codec
+(networking/codec.py) registered through grpc's generic-handler API — same
+RPC surface, no proto toolchain, bf16 tensors native on the wire.
+
+RPC surface parity (node_service.proto):
+  SendPrompt, SendTensor, SendExample, CollectTopology, SendResult,
+  SendOpaqueStatus, HealthCheck
+(The proto's `SendLoss` client existed without a server RPC — dead, dropped.)
+"""
+
+SERVICE_NAME = "xotorch.NodeService"
+
+METHODS = (
+  "SendPrompt",
+  "SendTensor",
+  "SendExample",
+  "CollectTopology",
+  "SendResult",
+  "SendOpaqueStatus",
+  "HealthCheck",
+)
+
+# Channel tuning parity: grpc_server.py:25-42 / grpc_peer_handle.py:27-40.
+CHANNEL_OPTIONS = [
+  ("grpc.max_metadata_size", 32 * 1024 * 1024),
+  ("grpc.max_send_message_length", 256 * 1024 * 1024),
+  ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+  ("grpc.keepalive_time_ms", 10000),
+  ("grpc.keepalive_timeout_ms", 5000),
+  ("grpc.http2.max_pings_without_data", 0),
+  ("grpc.max_concurrent_streams", -1),
+  ("grpc.tcp_nodelay", 1),
+  ("grpc.optimization_target", "throughput"),
+]
+
+
+def method_path(method: str) -> str:
+  return f"/{SERVICE_NAME}/{method}"

@@ -1,0 +1,4 @@
+from xotorch_tpu.networking.manual.discovery import ManualDiscovery
+from xotorch_tpu.networking.manual.network_topology_config import NetworkTopology, PeerConfig
+
+__all__ = ["ManualDiscovery", "NetworkTopology", "PeerConfig"]

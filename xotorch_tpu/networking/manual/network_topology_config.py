@@ -1,0 +1,52 @@
+"""Pydantic-validated manual topology config.
+
+Parity: /root/reference/xotorch/networking/manual/network_topology_config.py:7-31.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from pydantic import BaseModel, ValidationError
+
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+
+
+class DeviceFlopsModel(BaseModel):
+  fp32: float
+  fp16: float
+  int8: float
+
+
+class DeviceCapabilitiesModel(BaseModel):
+  model: str
+  chip: str
+  memory: int
+  flops: DeviceFlopsModel
+
+  def to_caps(self) -> DeviceCapabilities:
+    return DeviceCapabilities(
+      model=self.model, chip=self.chip, memory=self.memory,
+      flops=DeviceFlops(fp32=self.flops.fp32, fp16=self.flops.fp16, int8=self.flops.int8),
+    )
+
+
+class PeerConfig(BaseModel):
+  address: str
+  port: int
+  device_capabilities: DeviceCapabilitiesModel
+
+
+class NetworkTopology(BaseModel):
+  peers: Dict[str, PeerConfig]
+
+  @classmethod
+  def from_path(cls, path: str) -> "NetworkTopology":
+    try:
+      with open(path, "r") as f:
+        config_data = f.read()
+    except FileNotFoundError as e:
+      raise FileNotFoundError(f"Config file not found at {path}") from e
+    try:
+      return cls.model_validate_json(config_data)
+    except ValidationError as e:
+      raise ValueError(f"Error validating network topology config from {path}: {e}") from e

@@ -1,0 +1,76 @@
+"""PeerHandle ABC — one peer's view of another peer.
+
+Parity: /root/reference/xotorch/networking/peer_handle.py:9-56. The tensor
+methods speak numpy at this boundary (bf16 via ml_dtypes on the wire); the
+orchestration layer never sees transport details.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
+from xotorch_tpu.topology.topology import Topology
+
+
+class PeerHandle(ABC):
+  @abstractmethod
+  def id(self) -> str:
+    ...
+
+  @abstractmethod
+  def addr(self) -> str:
+    ...
+
+  @abstractmethod
+  def description(self) -> str:
+    ...
+
+  @abstractmethod
+  def device_capabilities(self) -> DeviceCapabilities:
+    ...
+
+  @abstractmethod
+  async def connect(self) -> None:
+    ...
+
+  @abstractmethod
+  async def is_connected(self) -> bool:
+    ...
+
+  @abstractmethod
+  async def disconnect(self) -> None:
+    ...
+
+  @abstractmethod
+  async def health_check(self) -> bool:
+    ...
+
+  @abstractmethod
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None) -> None:
+    ...
+
+  @abstractmethod
+  async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
+                        inference_state: Optional[dict] = None) -> None:
+    ...
+
+  @abstractmethod
+  async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
+                         train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
+    ...
+
+  @abstractmethod
+  async def send_result(self, request_id: str, result, is_finished: bool) -> None:
+    ...
+
+  @abstractmethod
+  async def send_opaque_status(self, request_id: str, status: str) -> None:
+    ...
+
+  @abstractmethod
+  async def collect_topology(self, visited: set, max_depth: int) -> Topology:
+    ...

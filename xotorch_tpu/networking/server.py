@@ -1,0 +1,14 @@
+"""Server ABC (parity: /root/reference/xotorch/networking/server.py)."""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class Server(ABC):
+  @abstractmethod
+  async def start(self) -> None:
+    ...
+
+  @abstractmethod
+  async def stop(self) -> None:
+    ...

@@ -1,0 +1,3 @@
+from xotorch_tpu.networking.udp.discovery import UDPDiscovery
+
+__all__ = ["UDPDiscovery"]

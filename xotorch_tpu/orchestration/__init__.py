@@ -1,0 +1,3 @@
+from xotorch_tpu.orchestration.node import Node
+
+__all__ = ["Node"]

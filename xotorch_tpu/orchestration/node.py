@@ -1,0 +1,488 @@
+"""Node: the masterless peer orchestrating the token ring.
+
+Parity: /root/reference/xotorch/orchestration/node.py:22-620 — same public
+surface (start/stop, process_prompt/process_tensor, enqueue_example/
+process_example, coordinate_save, collect_topology, on_token,
+on_opaque_status) and the same deterministic-ring design:
+
+- every peer derives the identical partition table from the gossiped topology
+  (RingMemoryWeightedPartitioningStrategy), so routing needs no coordination;
+- the token ring: the last-layer peer samples, broadcasts the token list to
+  all peers, and feeds the token back to partition 0; everyone else forwards
+  hidden state to the next partition (bf16 on the wire here — the reference
+  upcast to fp32 every hop);
+- peers reconcile membership every `topology_interval` seconds and re-gossip
+  the topology with a visited-set BFS capped at max_depth.
+
+Training rides the same ring: forward activations down, gradients chained
+back (process_example), with the engine-leaf train/evaluate implemented for
+real in the JAX engine (the reference's engines never implemented them).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.inference.engine import InferenceEngine, inference_engine_classes
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.models.registry import get_supported_models
+from xotorch_tpu.networking.discovery import Discovery
+from xotorch_tpu.networking.peer_handle import PeerHandle
+from xotorch_tpu.networking.server import Server
+from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES, device_capabilities
+from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitions_to_shards
+from xotorch_tpu.topology.topology import Topology
+from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
+
+
+class Node:
+  def __init__(
+    self,
+    _id: str,
+    server: Server,
+    inference_engine: InferenceEngine,
+    discovery: Discovery,
+    shard_downloader,
+    partitioning_strategy: PartitioningStrategy,
+    max_generate_tokens: int = 1024,
+    default_sample_temp: float = 0.6,
+    default_sample_top_k: int = 35,
+    topology_viz=None,
+  ):
+    self.id = _id
+    self.server = server
+    self.inference_engine = inference_engine
+    self.discovery = discovery
+    self.shard_downloader = shard_downloader
+    self.partitioning_strategy = partitioning_strategy
+    self.max_generate_tokens = max_generate_tokens
+    self.default_sample_temp = default_sample_temp
+    self.default_sample_top_k = default_sample_top_k
+    self.topology_viz = topology_viz
+
+    self.peers: List[PeerHandle] = []
+    self.topology = Topology()
+    self.device_capabilities = UNKNOWN_DEVICE_CAPABILITIES
+    self.buffered_token_output: Dict[str, Tuple[List[int], bool]] = {}
+    self.checkpoints: Dict[str, Dict[str, int]] = {}
+    self.topology_inference_engines_pool: List[List[str]] = []
+    self.node_download_progress: Dict[str, Any] = {}
+
+    self.on_token: AsyncCallbackSystem = AsyncCallbackSystem()
+    self.on_opaque_status: AsyncCallbackSystem = AsyncCallbackSystem()
+    self.on_opaque_status.register("node_status").on_next(self.on_node_status)
+
+    self._topology_task: Optional[asyncio.Task] = None
+    self.outstanding_requests: Dict[str, str] = {}
+
+  # ------------------------------------------------------------- lifecycle
+
+  async def start(self, wait_for_peers: int = 0, topology_interval: float = 2.0) -> None:
+    self.device_capabilities = await device_capabilities()
+    await self.server.start()
+    await self.discovery.start()
+    await self.update_peers(wait_for_peers)
+    await self.collect_topology(set())
+    self._topology_task = asyncio.create_task(self.periodic_topology_collection(topology_interval))
+    if DEBUG >= 1:
+      print(f"Node {self.id} started; topology: {self.topology}")
+
+  async def stop(self) -> None:
+    if self._topology_task is not None:
+      self._topology_task.cancel()
+      try:
+        await self._topology_task
+      except asyncio.CancelledError:
+        pass
+    await self.discovery.stop()
+    await self.server.stop()
+
+  # ----------------------------------------------------------- status bus
+
+  def on_node_status(self, request_id, opaque_status) -> None:
+    """Ingest cluster-wide opaque status (parity node.py:73-98): track which
+    node is actively serving, download progress, engine pools — feeds viz."""
+    try:
+      status = json.loads(opaque_status)
+      status_type = status.get("type", "")
+      if status_type == "supported_inference_engines":
+        self.topology_inference_engines_pool.append(status.get("engines", []))
+      elif status_type == "download_progress":
+        self.node_download_progress[status.get("node_id")] = status.get("progress")
+      elif status_type == "node_status":
+        if status.get("status", "").startswith("start_"):
+          self.topology.active_node_id = status.get("node_id")
+        elif status.get("status", "").startswith("end_"):
+          if status.get("node_id") == self.topology.active_node_id:
+            self.topology.active_node_id = None
+      if self.topology_viz is not None:
+        self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
+    except Exception as e:
+      if DEBUG >= 2:
+        print(f"on_node_status error: {e!r}")
+
+  # ------------------------------------------------------------ inference
+
+  async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None) -> None:
+    shard = self.get_current_shard(base_shard)
+    if request_id is None:
+      request_id = str(uuid.uuid4())
+    start_ns = time.perf_counter_ns()
+    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+      "type": "node_status", "node_id": self.id, "status": "start_process_prompt",
+      "base_shard": base_shard.to_dict(), "shard": shard.to_dict(),
+      "prompt": prompt, "request_id": request_id,
+    })))
+    await self._process_prompt(base_shard, prompt, request_id)
+    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+      "type": "node_status", "node_id": self.id, "status": "end_process_prompt",
+      "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
+    })))
+
+  async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str) -> None:
+    shard = self.get_current_shard(base_shard)
+    if not shard.is_first_layer:
+      # Not our turn: hand the prompt to the partition-0 owner and stop.
+      await self.forward_prompt(base_shard, prompt, request_id, 0)
+      return
+    self.outstanding_requests[request_id] = "processing prompt"
+    result, inference_state = await self.inference_engine.infer_prompt(request_id, shard, prompt)
+    await self.process_inference_result(base_shard, result, request_id, inference_state)
+
+  async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
+                           inference_state: Optional[dict] = None) -> None:
+    shard = self.get_current_shard(base_shard)
+    if request_id is None:
+      request_id = str(uuid.uuid4())
+    start_ns = time.perf_counter_ns()
+    self.outstanding_requests[request_id] = "processing tensor"
+    try:
+      result, inference_state = await self.inference_engine.infer_tensor(
+        request_id, shard, tensor, inference_state
+      )
+      await self.process_inference_result(base_shard, result, request_id, inference_state)
+    except Exception as e:
+      self.outstanding_requests.pop(request_id, None)
+      print(f"Error processing tensor for shard {shard}: {e!r}")
+      if DEBUG >= 2:
+        import traceback
+        traceback.print_exc()
+    finally:
+      if DEBUG >= 3:
+        print(f"process_tensor elapsed {(time.perf_counter_ns()-start_ns)/1e6:.1f}ms")
+
+  async def process_inference_result(self, base_shard: Shard, result: np.ndarray, request_id: str,
+                                     inference_state: Optional[dict] = None) -> None:
+    """The token-ring decode driver (parity node.py:109-147)."""
+    shard = self.get_current_shard(base_shard)
+    if not shard.is_last_layer:
+      # Mid-ring: forward the hidden state (bf16 numpy) to the next partition.
+      self.outstanding_requests[request_id] = "waiting"
+      await self.forward_tensor(base_shard, result, request_id, self.get_partition_index(offset=1), inference_state)
+      return
+
+    # Last layer: sample, buffer, broadcast, and either stop or loop.
+    if request_id not in self.buffered_token_output:
+      self.buffered_token_output[request_id] = ([], False)
+    buffered, _ = self.buffered_token_output[request_id]
+
+    token = await self.inference_engine.sample(
+      result, temp=self.default_sample_temp, top_k=self.default_sample_top_k
+    )
+    token_int = int(np.asarray(token).reshape(-1)[0])
+    buffered.append(token_int)
+    is_finished = (
+      token_int in self._eos_token_ids()
+      or len(buffered) >= self.max_generate_tokens
+    )
+    self.buffered_token_output[request_id] = (buffered, is_finished)
+    if DEBUG >= 2:
+      print(f"[{request_id}] token {token_int} ({len(buffered)} so far, finished={is_finished})")
+
+    self.trigger_on_token_callbacks(request_id, buffered, is_finished)
+    asyncio.create_task(self.broadcast_result(request_id, buffered, is_finished))
+
+    if is_finished:
+      self.outstanding_requests.pop(request_id, None)
+      clear = getattr(self.inference_engine, "clear_request", None)
+      if clear is not None:
+        await clear(request_id)
+      return
+
+    # Feed the sampled token back to partition 0 for the next decode step.
+    self.outstanding_requests[request_id] = "waiting"
+    await self.forward_tensor(
+      base_shard, np.asarray([[token_int]], dtype=np.int64), request_id,
+      self.get_partition_index_of_first_layer(), inference_state,
+    )
+
+  def _eos_token_ids(self) -> Tuple[int, ...]:
+    tokenizer = getattr(self.inference_engine, "tokenizer", None)
+    eos = getattr(tokenizer, "eos_token_id", None) if tokenizer else None
+    cfg = getattr(self.inference_engine, "cfg", None)
+    from_cfg = tuple(getattr(cfg, "eos_token_ids", ()) or ()) if cfg else ()
+    return tuple(e for e in ((eos,) if eos is not None else ()) + from_cfg)
+
+  # -------------------------------------------------------------- routing
+
+  def get_partition_index(self, offset: int = 0) -> int:
+    if not self.partitioning_strategy:
+      return 0
+    partitions = self.partitioning_strategy.partition(self.topology)
+    current = next((i for i, p in enumerate(partitions) if p.node_id == self.id), None)
+    if current is None:
+      raise ValueError(f"No partition found for node {self.id}")
+    return (current + offset) % len(partitions)
+
+  def get_partition_index_of_first_layer(self) -> int:
+    # map_partitions_to_shards assigns layer 0 to partitions[0] by
+    # construction, so the first-layer owner is always ring index 0.
+    return 0
+
+  def get_current_shard(self, base_shard: Shard, index: Optional[int] = None) -> Shard:
+    if index is None:
+      index = self.get_partition_index()
+    partitions = self.partitioning_strategy.partition(self.topology)
+    shards = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)
+    return shards[index]
+
+  async def forward_prompt(self, base_shard: Shard, prompt: str, request_id: str, target_index: int) -> None:
+    if DEBUG >= 1:
+      print(f"Forwarding prompt [{request_id}] to partition {target_index}")
+    partitions = self.partitioning_strategy.partition(self.topology)
+    target_id = partitions[target_index].node_id
+    next_shard = self.get_current_shard(base_shard, target_index)
+    if target_id == self.id:
+      await self._process_prompt(base_shard, prompt, request_id)
+      return
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if peer is None:
+      raise ValueError(f"Peer for {target_index} ({target_id}) not found")
+    await peer.send_prompt(next_shard, prompt, request_id)
+
+  async def forward_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, target_index: int,
+                           inference_state: Optional[dict] = None) -> None:
+    partitions = self.partitioning_strategy.partition(self.topology)
+    target_id = partitions[target_index].node_id
+    next_shard = self.get_current_shard(base_shard, target_index)
+    if target_id == self.id:
+      await self.process_tensor(base_shard, tensor, request_id, inference_state)
+      return
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if peer is None:
+      raise ValueError(f"Peer for {target_index} ({target_id}) not found")
+    await peer.send_tensor(next_shard, tensor, request_id, inference_state)
+
+  # ------------------------------------------------------------- training
+
+  async def enqueue_example(self, base_shard: Shard, example: np.ndarray, target: np.ndarray,
+                            length: np.ndarray, train: bool = False,
+                            request_id: Optional[str] = None) -> Tuple[float, Optional[np.ndarray]]:
+    """Route an example to the partition-0 owner (parity node.py:210-228)."""
+    shard = self.get_current_shard(base_shard)
+    if shard.is_first_layer:
+      return await self.process_example(base_shard, example, target, length, train, request_id)
+    index = self.get_partition_index_of_first_layer()
+    partitions = self.partitioning_strategy.partition(self.topology)
+    target_id = partitions[index].node_id
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if peer is None:
+      raise ValueError(f"No peer for first-layer partition {index}")
+    result = await peer.send_example(self.get_current_shard(base_shard, index), example, target, length, train, request_id)
+    if result is None:
+      raise RuntimeError(f"Peer {target_id} returned no loss for example {request_id}")
+    return result
+
+  async def process_example(self, base_shard: Shard, example: np.ndarray, target: np.ndarray,
+                            length: np.ndarray, train: bool = False,
+                            request_id: Optional[str] = None) -> Tuple[float, Optional[np.ndarray]]:
+    """Run this shard's slice of a training/eval example; recurse down the
+    ring and chain gradients back up (parity node.py:254-345)."""
+    shard = self.get_current_shard(base_shard)
+    if request_id is None:
+      request_id = str(uuid.uuid4())
+    start_ns = time.perf_counter_ns()
+    status_kind = "train_example" if train else "eval_example"
+    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+      "type": "node_status", "node_id": self.id, "status": f"start_{status_kind}",
+      "request_id": request_id,
+    })))
+    try:
+      if train:
+        loss, grads = await self.inference_engine.train_example(
+          request_id, shard, example, target, length,
+          forward_fn=self._forward_example_fn(base_shard, request_id),
+        )
+        return loss, grads
+      else:
+        loss = await self.inference_engine.evaluate_example(
+          request_id, shard, example, target, length,
+          forward_fn=self._forward_example_fn(base_shard, request_id),
+        )
+        return loss, None
+    finally:
+      asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+        "type": "node_status", "node_id": self.id, "status": f"end_{status_kind}",
+        "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
+      })))
+
+  def _forward_example_fn(self, base_shard: Shard, request_id: str):
+    """Downstream hop for pipelined training: ships activations to the next
+    partition, returns (loss, grad_wrt_activations)."""
+    async def forward(activations: np.ndarray, target: np.ndarray, length: np.ndarray, train: bool):
+      next_index = self.get_partition_index(offset=1)
+      partitions = self.partitioning_strategy.partition(self.topology)
+      target_id = partitions[next_index].node_id
+      next_shard = self.get_current_shard(base_shard, next_index)
+      if target_id == self.id:
+        return await self.process_example(base_shard, activations, target, length, train, request_id)
+      peer = next((p for p in self.peers if p.id() == target_id), None)
+      if peer is None:
+        raise ValueError(f"No peer for partition {next_index}")
+      result = await peer.send_example(next_shard, activations, target, length, train, request_id)
+      if result is None:
+        raise RuntimeError(f"Peer {target_id} returned no loss for example {request_id}")
+      return result
+    return forward
+
+  async def coordinate_save(self, base_shard: Shard, iteration: int, destination: str) -> None:
+    """Ask every peer('s engine) to save its shard (parity node.py:230-252)."""
+    shard = self.get_current_shard(base_shard)
+    model = base_shard.model_id
+    sid = f"{shard.start_layer}-{shard.end_layer}"
+    self.checkpoints.setdefault(model, {})
+    if self.checkpoints[model].get(sid) == iteration:
+      return
+    self.checkpoints[model][sid] = iteration
+    path = f"{destination}/{model}/{sid}-{iteration}.safetensors"
+    await self.inference_engine.save_checkpoint(shard, path)
+    if DEBUG >= 1:
+      print(f"Saved checkpoint {path}")
+
+  # ------------------------------------------------------------- topology
+
+  async def update_peers(self, wait_for_peers: int = 0) -> bool:
+    """Reconcile the peer set against discovery (parity node.py:462-511)."""
+    next_peers = await self.discovery.discover_peers(wait_for_peers)
+    current_ids = {p.id() for p in self.peers}
+    next_ids = {p.id() for p in next_peers}
+    peers_added = [p for p in next_peers if p.id() not in current_ids]
+    peers_removed = [p for p in self.peers if p.id() not in next_ids]
+    peers_kept = [p for p in self.peers if p.id() in next_ids]
+
+    async def _connect(peer):
+      try:
+        await asyncio.wait_for(peer.connect(), timeout=5.0)
+        return True
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"Failed to connect {peer.id()}: {e!r}")
+        return False
+
+    async def _disconnect(peer):
+      try:
+        await asyncio.wait_for(peer.disconnect(), timeout=5.0)
+      except Exception as e:
+        if DEBUG >= 2:
+          print(f"Failed to disconnect {peer.id()}: {e!r}")
+
+    connected = await asyncio.gather(*(_connect(p) for p in peers_added))
+    await asyncio.gather(*(_disconnect(p) for p in peers_removed))
+    self.peers = peers_kept + [p for p, ok in zip(peers_added, connected) if ok]
+    return bool(peers_added or peers_removed)
+
+  async def periodic_topology_collection(self, interval: float) -> None:
+    while True:
+      await asyncio.sleep(interval)
+      try:
+        changed = await self.update_peers()
+        if changed:
+          await self.collect_topology(set())
+          await self.select_best_inference_engine()
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"Topology collection error: {e!r}")
+
+  async def collect_topology(self, visited: set, max_depth: int = 4) -> Topology:
+    """Visited-set BFS gossip crawl (parity node.py:533-566)."""
+    prev_visited = set(visited)
+    next_topology = Topology()
+    next_topology.update_node(self.id, self.device_capabilities)
+    visited.add(self.id)
+    visited.update(p.id() for p in self.peers)
+
+    for peer in self.peers:
+      next_topology.update_node(peer.id(), peer.device_capabilities())
+      next_topology.add_edge(self.id, peer.id(), peer.description())
+      if peer.id() in prev_visited or max_depth <= 0:
+        continue  # someone up the crawl already asked this peer
+      try:
+        other = await asyncio.wait_for(peer.collect_topology(set(visited), max_depth - 1), timeout=5.0)
+        visited.update(other.nodes.keys())
+        # Origin-filtered merge takes the peer's own observations; transitive
+        # nodes it learned about are added if we don't know them yet.
+        next_topology.merge(peer.id(), other)
+        for node_id, caps in other.nodes.items():
+          if node_id not in next_topology.nodes:
+            next_topology.update_node(node_id, caps)
+        for from_id, conns in other.peer_graph.items():
+          for conn in conns:
+            next_topology.add_edge(conn.from_id, conn.to_id, conn.description)
+      except Exception as e:
+        if DEBUG >= 2:
+          print(f"collect_topology from {peer.id()} failed: {e!r}")
+
+    next_topology.active_node_id = self.topology.active_node_id
+    self.topology = next_topology
+    if self.topology_viz is not None:
+      try:
+        self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
+      except Exception:
+        pass
+    return next_topology
+
+  async def select_best_inference_engine(self) -> None:
+    """Broadcast which engines this node supports so the cluster can settle
+    on an intersection (parity node.py:513-518)."""
+    supported = [type(self.inference_engine).__name__]
+    await self.broadcast_opaque_status("", json.dumps({
+      "type": "supported_inference_engines", "node_id": self.id, "engines": supported,
+    }))
+
+  def get_supported_models_for_cluster(self) -> List[str]:
+    pools = self.topology_inference_engines_pool or [[type(self.inference_engine).__name__]]
+    return get_supported_models(pools)
+
+  # ------------------------------------------------------------ broadcast
+
+  def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
+    self.on_token.trigger_all(request_id, tokens, is_finished)
+
+  async def broadcast_result(self, request_id: str, result: List[int], is_finished: bool) -> None:
+    async def send(peer):
+      try:
+        await asyncio.wait_for(peer.send_result(request_id, result, is_finished), timeout=15.0)
+      except Exception as e:
+        if DEBUG >= 2:
+          print(f"broadcast_result to {peer.id()} failed: {e!r}")
+    await asyncio.gather(*(send(p) for p in self.peers), return_exceptions=True)
+
+  async def broadcast_opaque_status(self, request_id: str, status: str) -> None:
+    async def send(peer):
+      try:
+        await asyncio.wait_for(peer.send_opaque_status(request_id, status), timeout=15.0)
+      except Exception as e:
+        if DEBUG >= 2:
+          print(f"broadcast_status to {peer.id()} failed: {e!r}")
+    await asyncio.gather(*(send(p) for p in self.peers), return_exceptions=True)
+    # Local delivery too (parity: the reference triggers locally as well).
+    self.on_opaque_status.trigger_all(request_id, status)
+
+  @property
+  def current_topology(self) -> Topology:
+    return self.topology
