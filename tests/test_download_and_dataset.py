@@ -1,0 +1,88 @@
+"""Offline-testable parts of the download subsystem + the train dataset."""
+import json
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.hf_shard_download import get_allow_patterns, _matches
+from xotorch_tpu.download.download_progress import RepoFileProgressEvent, RepoProgressEvent
+from xotorch_tpu.inference.shard import Shard
+
+
+WEIGHT_MAP = {
+  "model.embed_tokens.weight": "model-00001.safetensors",
+  "model.layers.0.self_attn.q_proj.weight": "model-00001.safetensors",
+  "model.layers.1.self_attn.q_proj.weight": "model-00001.safetensors",
+  "model.layers.2.self_attn.q_proj.weight": "model-00002.safetensors",
+  "model.layers.3.self_attn.q_proj.weight": "model-00002.safetensors",
+  "model.norm.weight": "model-00002.safetensors",
+  "lm_head.weight": "model-00002.safetensors",
+}
+
+
+def test_allow_patterns_first_shard():
+  patterns = get_allow_patterns(WEIGHT_MAP, Shard("m", 0, 1, 4))
+  assert "model-00001.safetensors" in patterns
+  assert "model-00002.safetensors" not in patterns
+  assert "*.json" in patterns  # config always fetched
+
+
+def test_allow_patterns_last_shard():
+  patterns = get_allow_patterns(WEIGHT_MAP, Shard("m", 2, 3, 4))
+  assert "model-00002.safetensors" in patterns
+  assert "model-00001.safetensors" not in patterns
+
+
+def test_allow_patterns_full_model():
+  patterns = get_allow_patterns(WEIGHT_MAP, Shard("m", 0, 3, 4))
+  assert "model-00001.safetensors" in patterns and "model-00002.safetensors" in patterns
+
+
+def test_matches_basename_and_glob():
+  assert _matches("subdir/config.json", ["*.json"])
+  assert _matches("model-00001.safetensors", ["model-00001.safetensors"])
+  assert not _matches("model-00001.safetensors", ["*.json"])
+
+
+def test_progress_event_math():
+  event = RepoProgressEvent("repo", 1, 2, 50, 200, 10.0, "in_progress")
+  assert event.percentage == 25.0
+  assert event.eta_seconds == 15.0
+  assert not event.is_complete
+  d = event.to_dict()
+  assert d["percentage"] == 25.0
+
+
+def test_dataset_load_and_batching(tmp_path):
+  from xotorch_tpu.train.dataset import batch_with_lengths, iterate_batches, load_dataset
+
+  for name, n in [("train", 6), ("valid", 2), ("test", 2)]:
+    with open(tmp_path / f"{name}.jsonl", "w") as f:
+      for i in range(n):
+        f.write(json.dumps({"text": f"example number {i} with words"}) + "\n")
+  train, valid, test = load_dataset(str(tmp_path))
+  assert len(train) == 6 and len(valid) == 2 and len(test) == 2
+
+  class Tok:
+    def encode(self, text):
+      return [1] * (len(text.split()) + 1)
+
+  batches = list(iterate_batches(train, Tok(), batch_size=2, max_seq_len=16))
+  assert len(batches) == 3
+  inputs, targets, lengths = batches[0]
+  assert inputs.shape == targets.shape
+  assert inputs.shape[1] == targets.shape[1]
+  # next-token alignment: targets are inputs shifted by one
+  assert (lengths >= 1).all()
+
+
+def test_dataset_missing_train_raises(tmp_path):
+  from xotorch_tpu.train.dataset import load_dataset
+  with pytest.raises(ValueError):
+    load_dataset(str(tmp_path))
+
+
+def test_bundled_lora_corpus_loads():
+  from xotorch_tpu.train.dataset import load_dataset
+  train, valid, test = load_dataset("xotorch_tpu/train/data/lora")
+  assert len(train) >= 32

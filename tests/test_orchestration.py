@@ -104,6 +104,27 @@ async def test_single_node_respects_max_generate_tokens():
   assert len(out["tokens"]) == 7
 
 
+async def test_long_generation_no_recursion_blowup():
+  """A 600-token decode must not build a 600-deep coroutine chain (the ring
+  schedules each hop as a fresh task)."""
+  engine = DummyInferenceEngine()
+  engine.num_generate_dummy_tokens = 10_000
+  node = await _make_node("solo", engine, max_generate_tokens=600)
+  node.topology.update_node("solo", _caps())
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  await node.process_prompt(Shard("dummy", 0, 0, 8), "hi", "long-req")
+  await asyncio.wait_for(done.wait(), timeout=60)
+  assert len(out["tokens"]) == 600
+
+
 async def _two_node_ring(engine_a, engine_b, **node_kw):
   """Two real Nodes with real gRPC servers on localhost."""
   port_a, port_b = find_available_port(), find_available_port()

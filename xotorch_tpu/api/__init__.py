@@ -1,0 +1,3 @@
+from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+
+__all__ = ["ChatGPTAPI"]

@@ -1,0 +1,355 @@
+"""OpenAI-compatible HTTP API over a Node.
+
+Parity: /root/reference/xotorch/api/chatgpt_api.py:175-607 — same route
+surface (/v1/chat/completions with SSE streaming, /v1/models, /modelpool,
+/v1/topology, /v1/download/progress, /healthcheck, /quit, model delete /
+download), per-request asyncio token queues fed by node.on_token, gpt-*
+aliasing, optional injected system prompt, timeout middleware, permissive
+CORS, and the bundled web UI served at /.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+from aiohttp import web
+
+from xotorch_tpu.inference.engine import inference_engine_classes
+from xotorch_tpu.inference.tokenizers import resolve_tokenizer
+from xotorch_tpu.models.registry import build_base_shard, get_model_card, get_repo, model_cards, pretty_name
+from xotorch_tpu.utils.helpers import DEBUG
+
+WEB_DIR = Path(__file__).parent.parent / "tinychat"
+
+
+class PromptSession:
+  def __init__(self, request_id: str, timestamp: int, prompt: str):
+    self.request_id = request_id
+    self.timestamp = timestamp
+    self.prompt = prompt
+
+
+def build_prompt(tokenizer, messages: List[dict], tools: Optional[list] = None) -> str:
+  """Chat-template prompt build with UTF-8 fallback (parity :131-150)."""
+  chat = []
+  for m in messages:
+    content = m.get("content", "")
+    if isinstance(content, list):  # multi-part content: join text parts
+      content = "\n".join(part.get("text", "") for part in content if isinstance(part, dict) and part.get("type") == "text")
+    chat.append({"role": m.get("role", "user"), "content": content})
+  try:
+    kwargs = {"tokenize": False, "add_generation_prompt": True}
+    if tools:
+      kwargs["tools"] = tools
+    return tokenizer.apply_chat_template(chat, **kwargs)
+  except Exception:
+    return "\n".join(f"{m['role']}: {m['content']}" for m in chat) + "\nassistant:"
+
+
+class ChatGPTAPI:
+  def __init__(
+    self,
+    node,
+    inference_engine_classname: str,
+    response_timeout: int = 90,
+    on_chat_completion_request: Optional[Callable[[str, dict, str], None]] = None,
+    default_model: Optional[str] = None,
+    system_prompt: Optional[str] = None,
+  ):
+    self.node = node
+    self.inference_engine_classname = inference_engine_classname
+    self.response_timeout = response_timeout
+    self.on_chat_completion_request = on_chat_completion_request
+    self.default_model = default_model or "llama-3.2-1b"
+    self.system_prompt = system_prompt
+    self.token_queues: Dict[str, asyncio.Queue] = {}
+    self.prev_token_lens: Dict[str, int] = {}
+
+    self.app = web.Application(client_max_size=100 * 1024 * 1024)
+    self.app.middlewares.append(self._timeout_middleware)
+    self.app.middlewares.append(self._cors_middleware)
+    r = self.app.router
+    r.add_post("/v1/chat/completions", self.handle_post_chat_completions)
+    r.add_post("/chat/completions", self.handle_post_chat_completions)
+    r.add_get("/v1/models", self.handle_get_models)
+    r.add_get("/models", self.handle_get_models)
+    r.add_get("/modelpool", self.handle_model_support)
+    r.add_get("/v1/topology", self.handle_get_topology)
+    r.add_get("/topology", self.handle_get_topology)
+    r.add_get("/healthcheck", self.handle_healthcheck)
+    r.add_get("/v1/download/progress", self.handle_get_download_progress)
+    r.add_delete("/models/{model_name}", self.handle_delete_model)
+    r.add_post("/download", self.handle_post_download)
+    r.add_get("/initial_models", self.handle_get_initial_models)
+    r.add_get("/quit", self.handle_quit)
+    r.add_get("/", self.handle_root)
+    if WEB_DIR.exists():
+      r.add_static("/static", WEB_DIR, name="static")
+
+    # Feed per-request queues from the node's token bus (parity :194-198).
+    self.node.on_token.register("chatgpt-api-token-handler").on_next(self._enqueue_tokens)
+
+  def _enqueue_tokens(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
+    queue = self.token_queues.get(request_id)
+    if queue is not None:
+      queue.put_nowait((list(tokens), is_finished))
+
+  # ---------------------------------------------------------- middlewares
+
+  @web.middleware
+  async def _timeout_middleware(self, request, handler):
+    try:
+      return await asyncio.wait_for(handler(request), timeout=self.response_timeout * 10)
+    except asyncio.TimeoutError:
+      return web.json_response({"detail": "Request timed out"}, status=408)
+
+  @web.middleware
+  async def _cors_middleware(self, request, handler):
+    if request.method == "OPTIONS":
+      response = web.Response()
+    else:
+      try:
+        response = await handler(request)
+      except web.HTTPException as e:
+        response = e
+    response.headers["Access-Control-Allow-Origin"] = "*"
+    response.headers["Access-Control-Allow-Methods"] = "*"
+    response.headers["Access-Control-Allow-Headers"] = "*"
+    return response
+
+  # --------------------------------------------------------------- routes
+
+  async def handle_root(self, request):
+    index = WEB_DIR / "index.html"
+    if index.exists():
+      return web.FileResponse(index)
+    return web.json_response({"name": "xotorch_tpu", "endpoints": ["/v1/chat/completions", "/v1/models", "/v1/topology"]})
+
+  async def handle_healthcheck(self, request):
+    return web.json_response({"status": "ok"})
+
+  async def handle_get_models(self, request):
+    models = [
+      {"id": model_id, "object": "model", "owned_by": "xotorch", "ready": True}
+      for model_id, card in model_cards.items()
+      if self.inference_engine_classname in card.get("repo", {})
+    ]
+    return web.json_response({"object": "list", "data": models})
+
+  async def handle_model_support(self, request):
+    models = {}
+    for model_id in self.node.get_supported_models_for_cluster():
+      card = get_model_card(model_id) or {}
+      if self.inference_engine_classname not in card.get("repo", {}):
+        continue
+      models[model_id] = {"name": pretty_name(model_id), "layers": card.get("layers")}
+    return web.json_response({"model pool": models})
+
+  async def handle_get_initial_models(self, request):
+    data = {
+      model_id: {"name": pretty_name(model_id), "downloaded": None, "download_percentage": None,
+                 "total_size": None, "total_downloaded": None}
+      for model_id, card in model_cards.items()
+      if self.inference_engine_classname in card.get("repo", {})
+    }
+    return web.json_response(data)
+
+  async def handle_get_topology(self, request):
+    return web.json_response(self.node.current_topology.to_json())
+
+  async def handle_get_download_progress(self, request):
+    progress = {}
+    for node_id, p in self.node.node_download_progress.items():
+      progress[node_id] = p
+    return web.json_response(progress)
+
+  async def handle_delete_model(self, request):
+    model_name = request.match_info["model_name"]
+    if self.node.shard_downloader is None:
+      return web.json_response({"detail": "No downloader"}, status=400)
+    delete = getattr(self.node.shard_downloader, "delete_model", None)
+    if delete is None:
+      return web.json_response({"detail": "Downloader cannot delete"}, status=400)
+    deleted = await delete(model_name, self.inference_engine_classname)
+    if deleted:
+      return web.json_response({"status": "success", "message": f"Model {model_name} deleted"})
+    return web.json_response({"detail": f"Model {model_name} not found"}, status=404)
+
+  async def handle_post_download(self, request):
+    data = await request.json()
+    model_id = data.get("model")
+    card = get_model_card(model_id)
+    if not card or self.inference_engine_classname not in card.get("repo", {}):
+      return web.json_response({"detail": f"Invalid model: {model_id}"}, status=400)
+    shard = build_base_shard(model_id, self.inference_engine_classname)
+    asyncio.create_task(self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname))
+    return web.json_response({"status": "success", "message": f"Download started: {model_id}"})
+
+  async def handle_quit(self, request):
+    response = web.json_response({"detail": "Quit signal received"})
+    await response.prepare(request)
+    await response.write_eof()
+    import os
+    import signal
+    os.kill(os.getpid(), signal.SIGINT)
+    return response
+
+  # ----------------------------------------------------- chat completions
+
+  def _resolve_model(self, model: Optional[str]) -> str:
+    if not model or model.startswith("gpt-"):  # alias gpt-* (parity :322-323)
+      return self.default_model
+    return model
+
+  async def handle_post_chat_completions(self, request):
+    data = await request.json()
+    if DEBUG >= 2:
+      print(f"chat completions request: {json.dumps(data)[:500]}")
+    stream = bool(data.get("stream", False))
+    model = self._resolve_model(data.get("model"))
+    messages = data.get("messages", [])
+    tools = data.get("tools")
+
+    shard = build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      supported = [m for m, c in model_cards.items() if self.inference_engine_classname in c.get("repo", {})]
+      return web.json_response(
+        {"detail": f"Invalid model: {model}. Supported: {supported}"}, status=400
+      )
+
+    if self.system_prompt and not any(m.get("role") == "system" for m in messages):
+      messages = [{"role": "system", "content": self.system_prompt}] + messages
+
+    tokenizer = await self._tokenizer_for(model, shard)
+    prompt = build_prompt(tokenizer, messages, tools)
+    request_id = str(uuid.uuid4())
+    if self.on_chat_completion_request:
+      try:
+        self.on_chat_completion_request(request_id, data, prompt)
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"on_chat_completion_request callback error: {e!r}")
+
+    self.token_queues[request_id] = asyncio.Queue()
+    try:
+      await self.node.process_prompt(shard, prompt, request_id)
+      if stream:
+        return await self._stream_response(request, request_id, model, tokenizer)
+      return await self._full_response(request_id, model, tokenizer, prompt)
+    finally:
+      self.token_queues.pop(request_id, None)
+      self.prev_token_lens.pop(request_id, None)
+
+  async def _tokenizer_for(self, model: str, shard):
+    if model.startswith("synthetic") or model == "dummy":
+      from xotorch_tpu.inference.tokenizers import DummyTokenizer
+      return DummyTokenizer()
+    target = get_repo(model, self.inference_engine_classname)
+    if self.node.shard_downloader is not None:
+      try:
+        local = await self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname)
+        return await resolve_tokenizer(local)
+      except Exception:
+        pass
+    return await resolve_tokenizer(target)
+
+  def _delta_tokens(self, request_id: str, tokens: List[int]) -> List[int]:
+    prev = self.prev_token_lens.get(request_id, 0)
+    self.prev_token_lens[request_id] = len(tokens)
+    return tokens[prev:]
+
+  def _chunk(self, request_id: str, model: str, content: str, finish_reason: Optional[str]) -> dict:
+    return {
+      "id": f"chatcmpl-{request_id}",
+      "object": "chat.completion.chunk",
+      "created": int(time.time()),
+      "model": model,
+      "choices": [{
+        "index": 0,
+        "delta": {"role": "assistant", "content": content} if content else {},
+        "finish_reason": finish_reason,
+      }],
+    }
+
+  def _eos_ids(self, tokenizer) -> set:
+    eos = getattr(tokenizer, "eos_token_id", None)
+    return {eos} if eos is not None else set()
+
+  async def _stream_response(self, request, request_id: str, model: str, tokenizer):
+    response = web.StreamResponse(status=200, headers={
+      "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+    })
+    await response.prepare(request)
+    eos_ids = self._eos_ids(tokenizer)
+    try:
+      deadline = time.monotonic() + self.response_timeout
+      finished = False
+      while not finished:
+        timeout = max(0.1, deadline - time.monotonic())
+        tokens, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
+        delta = self._delta_tokens(request_id, tokens)
+        new_tokens = [t for t in delta if t not in eos_ids]
+        finish_reason = None
+        if finished:
+          finish_reason = "stop" if (delta and delta[-1] in eos_ids) else "length"
+        content = tokenizer.decode(new_tokens) if new_tokens else ""
+        chunk = self._chunk(request_id, model, content, finish_reason)
+        await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        deadline = time.monotonic() + self.response_timeout
+      await response.write(b"data: [DONE]\n\n")
+      await response.write_eof()
+      return response
+    except asyncio.TimeoutError:
+      chunk = self._chunk(request_id, model, "", "length")
+      await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+      await response.write(b"data: [DONE]\n\n")
+      await response.write_eof()
+      return response
+
+  async def _full_response(self, request_id: str, model: str, tokenizer, prompt: str):
+    eos_ids = self._eos_ids(tokenizer)
+    tokens: List[int] = []
+    finished = False
+    deadline = time.monotonic() + self.response_timeout
+    while not finished:
+      timeout = max(0.1, deadline - time.monotonic())
+      try:
+        tokens, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
+      except asyncio.TimeoutError:
+        return web.json_response({"detail": "Response timed out"}, status=408)
+      deadline = time.monotonic() + self.response_timeout
+    finish_reason = "stop" if (tokens and tokens[-1] in eos_ids) else "length"
+    content_tokens = [t for t in tokens if t not in eos_ids]
+    content = tokenizer.decode(content_tokens) if content_tokens else ""
+    prompt_tokens = len(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else 0
+    return web.json_response({
+      "id": f"chatcmpl-{request_id}",
+      "object": "chat.completion",
+      "created": int(time.time()),
+      "model": model,
+      "choices": [{
+        "index": 0,
+        "message": {"role": "assistant", "content": content},
+        "finish_reason": finish_reason,
+      }],
+      "usage": {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": len(content_tokens),
+        "total_tokens": prompt_tokens + len(content_tokens),
+      },
+    })
+
+  # ------------------------------------------------------------ lifecycle
+
+  async def run(self, host: str = "0.0.0.0", port: int = 52415):
+    runner = web.AppRunner(self.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    if DEBUG >= 0:
+      print(f"ChatGPT-compatible API on http://{host}:{port}")
+    return runner

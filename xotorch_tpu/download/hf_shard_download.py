@@ -1,0 +1,278 @@
+"""HF shard downloader: layer-filtered, resumable, hash-verified.
+
+Parity: /root/reference/xotorch/download/new_shard_download.py:24-308 +
+hf/hf_helpers.py:14-98 — XOT_HOME dir management, HF tree API listing with
+retry+cache, resumable range downloads with etag sha verification, LAYER-
+AWARE allow patterns derived from the safetensors index weight map (each
+peer fetches only its layer range's files), parallel fetch under a
+semaphore, in-flight dedupe + path cache, delete/seed.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+import aiohttp
+
+from xotorch_tpu.download.download_progress import RepoFileProgressEvent, RepoProgressEvent
+from xotorch_tpu.download.shard_download import ShardDownloader
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.models.registry import get_model_card, get_repo
+from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
+
+
+def xot_home() -> Path:
+  return Path(os.getenv("XOT_HOME", Path.home() / ".xot_tpu"))
+
+
+def models_dir() -> Path:
+  return xot_home() / "models"
+
+
+def hf_endpoint() -> str:
+  return os.getenv("HF_ENDPOINT", "https://huggingface.co")
+
+
+def _auth_headers() -> Dict[str, str]:
+  token = os.getenv("HF_TOKEN")
+  if not token:
+    token_file = Path(os.getenv("HF_HOME", Path.home() / ".cache/huggingface")) / "token"
+    if token_file.exists():
+      token = token_file.read_text().strip()
+  return {"Authorization": f"Bearer {token}"} if token else {}
+
+
+async def fetch_file_list(session: aiohttp.ClientSession, repo_id: str, revision: str = "main",
+                          path: str = "") -> List[Dict]:
+  """Recursive HF tree API listing with on-disk cache (parity :72-107)."""
+  cache_file = xot_home() / "file_lists" / f"{repo_id.replace('/', '--')}--{revision}.json"
+  if cache_file.exists():
+    try:
+      return json.loads(cache_file.read_text())
+    except json.JSONDecodeError:
+      pass
+  url = f"{hf_endpoint()}/api/models/{repo_id}/tree/{revision}"
+  files: List[Dict] = []
+
+  async def walk(subpath: str) -> None:
+    async with session.get(f"{url}/{subpath}" if subpath else url, headers=_auth_headers()) as resp:
+      resp.raise_for_status()
+      for entry in await resp.json():
+        if entry["type"] == "file":
+          files.append({"path": entry["path"], "size": entry["size"]})
+        elif entry["type"] == "directory":
+          await walk(entry["path"])
+
+  for attempt in range(3):
+    try:
+      files.clear()
+      await walk(path)
+      break
+    except Exception:
+      if attempt == 2:
+        raise
+      await asyncio.sleep(1.5 ** attempt)
+  cache_file.parent.mkdir(parents=True, exist_ok=True)
+  cache_file.write_text(json.dumps(files))
+  return files
+
+
+def get_allow_patterns(weight_map: Dict[str, str], shard: Shard) -> List[str]:
+  """Files needed for a layer range (parity hf_helpers.py:74-98): shard
+  layers' weight files + always config/tokenizer + first/last extras."""
+  import re
+  default = ["*.json", "*.py", "tokenizer.model", "*.tiktoken", "*.txt", "*.jinja"]
+  shard_files = set()
+  for tensor_name, file_name in weight_map.items():
+    m = re.search(r"(?:^|\.)layers\.(\d+)\.", tensor_name)
+    if m is not None:
+      if shard.start_layer <= int(m.group(1)) <= shard.end_layer:
+        shard_files.add(file_name)
+      continue
+    is_embed = "embed" in tensor_name
+    is_tail = "lm_head" in tensor_name or re.search(r"(?:^|\.)norm\.weight", tensor_name)
+    if is_embed and shard.is_first_layer:
+      shard_files.add(file_name)
+    elif is_tail and shard.is_last_layer:
+      shard_files.add(file_name)
+    elif not (is_embed or is_tail):
+      if shard.is_first_layer:
+        shard_files.add(file_name)
+  return default + sorted(shard_files)
+
+
+def _matches(path: str, patterns: List[str]) -> bool:
+  import fnmatch
+  return any(fnmatch.fnmatch(path, p) or fnmatch.fnmatch(os.path.basename(path), p) for p in patterns)
+
+
+class HFShardDownloader(ShardDownloader):
+  def __init__(self, max_parallel_downloads: int = 8):
+    self.max_parallel_downloads = max_parallel_downloads
+    self._on_progress: AsyncCallbackSystem = AsyncCallbackSystem()
+    self.active_downloads: Dict[Tuple[str, str], asyncio.Task] = {}
+    self.completed: Dict[Tuple[str, str], Path] = {}
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem:
+    return self._on_progress
+
+  async def ensure_shard(self, shard: Shard, inference_engine_name: str) -> Path:
+    """In-flight dedupe + completed-path cache (parity decorator stack
+    Singleton(Cached(New)), :243-285)."""
+    key = (shard.model_id, f"{shard.start_layer}-{shard.end_layer}")
+    if key in self.completed:
+      return self.completed[key]
+    if key in self.active_downloads:
+      return await asyncio.shield(self.active_downloads[key])
+    task = asyncio.create_task(self._download_shard(shard, inference_engine_name))
+    self.active_downloads[key] = task
+    try:
+      path = await asyncio.shield(task)
+      self.completed[key] = path
+      return path
+    finally:
+      self.active_downloads.pop(key, None)
+
+  async def _download_shard(self, shard: Shard, inference_engine_name: str) -> Path:
+    repo_id = get_repo(shard.model_id, inference_engine_name)
+    if repo_id is None or repo_id in ("synthetic", "dummy"):
+      raise ValueError(f"No repo for {shard.model_id} under {inference_engine_name}")
+    target_dir = models_dir() / repo_id.replace("/", "--")
+    target_dir.mkdir(parents=True, exist_ok=True)
+
+    timeout = aiohttp.ClientTimeout(total=3600, connect=30)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+      file_list = await fetch_file_list(session, repo_id)
+      # Layer-aware filtering via the safetensors index (parity :181-194).
+      weight_map = await self._weight_map(session, repo_id, target_dir, file_list)
+      if weight_map:
+        patterns = get_allow_patterns(weight_map, shard)
+      else:
+        patterns = ["*"]
+      wanted = [f for f in file_list if _matches(f["path"], patterns)]
+      if DEBUG >= 2:
+        print(f"Downloading {len(wanted)}/{len(file_list)} files for {shard}")
+
+      semaphore = asyncio.Semaphore(self.max_parallel_downloads)
+      progress: Dict[str, RepoFileProgressEvent] = {}
+      started = time.monotonic()
+
+      async def fetch(f):
+        async with semaphore:
+          await self._download_file(session, repo_id, f["path"], f["size"], target_dir, progress, shard, started)
+
+      await asyncio.gather(*(fetch(f) for f in wanted))
+    return target_dir
+
+  async def _weight_map(self, session, repo_id: str, target_dir: Path, file_list: List[Dict]) -> Optional[Dict[str, str]]:
+    index_name = "model.safetensors.index.json"
+    if not any(f["path"] == index_name for f in file_list):
+      return None
+    index_path = target_dir / index_name
+    if not index_path.exists():
+      url = f"{hf_endpoint()}/{repo_id}/resolve/main/{index_name}"
+      async with session.get(url, headers=_auth_headers()) as resp:
+        resp.raise_for_status()
+        index_path.write_bytes(await resp.read())
+    try:
+      return json.loads(index_path.read_text()).get("weight_map", {})
+    except json.JSONDecodeError:
+      return None
+
+  async def _download_file(self, session, repo_id: str, file_path: str, total: int, target_dir: Path,
+                           progress: Dict, shard: Shard, started: float) -> None:
+    """Resumable range download with hash verification (parity :109-168)."""
+    out_path = target_dir / file_path
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    if out_path.exists() and out_path.stat().st_size == total:
+      progress[file_path] = RepoFileProgressEvent(repo_id, file_path, total, total, 0, "complete")
+      self._emit(repo_id, progress, shard, started, total_files=None)
+      return
+
+    partial_path = out_path.with_suffix(out_path.suffix + ".partial")
+    downloaded = partial_path.stat().st_size if partial_path.exists() else 0
+    url = f"{hf_endpoint()}/{repo_id}/resolve/main/{file_path}"
+    headers = {**_auth_headers()}
+    if downloaded:
+      headers["Range"] = f"bytes={downloaded}-"
+    t0 = time.monotonic()
+    async with session.get(url, headers=headers) as resp:
+      if resp.status == 416:  # already fully downloaded
+        pass
+      else:
+        resp.raise_for_status()
+        etag = (resp.headers.get("X-Linked-ETag") or resp.headers.get("ETag") or "").strip('"')
+        mode = "ab" if downloaded and resp.status == 206 else "wb"
+        if mode == "wb":
+          downloaded = 0
+        with open(partial_path, mode) as f:
+          async for chunk in resp.content.iter_chunked(1024 * 1024):
+            f.write(chunk)
+            downloaded += len(chunk)
+            speed = downloaded / max(time.monotonic() - t0, 1e-9)
+            progress[file_path] = RepoFileProgressEvent(repo_id, file_path, downloaded, total, speed, "in_progress")
+            self._emit(repo_id, progress, shard, started, total_files=None)
+        # Hash-verify when the etag is a content hash (parity :141-168).
+        if etag and len(etag) in (40, 64) and all(c in "0123456789abcdef" for c in etag.lower()):
+          algo = hashlib.sha1 if len(etag) == 40 else hashlib.sha256
+          h = algo()
+          if len(etag) == 40:  # git blob sha1
+            h.update(f"blob {partial_path.stat().st_size}\0".encode())
+          with open(partial_path, "rb") as f:
+            for block in iter(lambda: f.read(1024 * 1024), b""):
+              h.update(block)
+          if h.hexdigest() != etag:
+            partial_path.unlink(missing_ok=True)
+            raise ValueError(f"Hash mismatch for {file_path}: {h.hexdigest()} != {etag}")
+    if partial_path.exists():
+      partial_path.rename(out_path)
+    progress[file_path] = RepoFileProgressEvent(repo_id, file_path, total, total, 0, "complete")
+    self._emit(repo_id, progress, shard, started, total_files=None)
+
+  def _emit(self, repo_id: str, progress: Dict, shard: Shard, started: float, total_files) -> None:
+    files = list(progress.values())
+    downloaded = sum(f.downloaded for f in files)
+    total = sum(f.total for f in files)
+    completed = sum(1 for f in files if f.status == "complete")
+    elapsed = max(time.monotonic() - started, 1e-9)
+    event = RepoProgressEvent(
+      repo_id, completed, len(files), downloaded, total, downloaded / elapsed,
+      "complete" if completed == len(files) else "in_progress",
+      {f.file_path: f for f in files},
+    )
+    self._on_progress.trigger_all(shard, event)
+
+  async def get_shard_download_status(self, inference_engine_name: str) -> AsyncIterator[tuple]:
+    for (model_id, layers), path in self.completed.items():
+      yield (path, RepoProgressEvent(model_id, 1, 1, 0, 0, 0, "complete"))
+
+  async def delete_model(self, model_id: str, inference_engine_name: str) -> bool:
+    repo_id = get_repo(model_id, inference_engine_name)
+    if repo_id is None:
+      return False
+    target = models_dir() / repo_id.replace("/", "--")
+    if target.exists():
+      shutil.rmtree(target)
+      self.completed = {k: v for k, v in self.completed.items() if k[0] != model_id}
+      return True
+    return False
+
+
+async def seed_models(seed_dir: str) -> None:
+  """Move pre-seeded model dirs into XOT_HOME (parity :51-70)."""
+  source = Path(seed_dir)
+  if not source.exists():
+    return
+  models_dir().mkdir(parents=True, exist_ok=True)
+  for entry in source.iterdir():
+    if entry.is_dir():
+      dest = models_dir() / entry.name
+      if not dest.exists():
+        shutil.move(str(entry), str(dest))

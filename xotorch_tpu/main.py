@@ -1,0 +1,315 @@
+"""The `xot` CLI: construct the object graph and run a peer.
+
+Parity: /root/reference/xotorch/main.py:73-402 — subcommands run|eval|train,
+discovery module selection (udp|manual), node/API wiring, event plumbing
+(preemptive shard load on remote prompt-start, throttled download-progress
+broadcast), signal handling, one-shot run/train/eval flows.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+import uuid
+from functools import partial
+from pathlib import Path
+
+from xotorch_tpu import VERSION
+from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+from xotorch_tpu.inference.engine import get_inference_engine, inference_engine_classes
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.inference.tokenizers import resolve_tokenizer
+from xotorch_tpu.models.registry import build_base_shard, get_repo, model_cards
+from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
+from xotorch_tpu.networking.grpc.server import GRPCServer
+from xotorch_tpu.orchestration.node import Node
+from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from xotorch_tpu.utils.helpers import (
+  DEBUG,
+  find_available_port,
+  get_all_ip_addresses_and_interfaces,
+  get_or_create_node_id,
+  shutdown,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+  parser = argparse.ArgumentParser(prog="xot", description="xotorch_tpu: TPU-native distributed LLM runtime")
+  parser.add_argument("command", nargs="?", choices=["run", "eval", "train"], help="one-shot command")
+  parser.add_argument("model_name", nargs="?", help="model id (see models registry)")
+  parser.add_argument("--version", action="version", version=f"xot {VERSION}")
+  parser.add_argument("--node-id", type=str, default=None)
+  parser.add_argument("--node-host", type=str, default="0.0.0.0")
+  parser.add_argument("--node-port", type=int, default=None)
+  parser.add_argument("--listen-port", type=int, default=5678, help="UDP discovery listen port")
+  parser.add_argument("--broadcast-port", type=int, default=5678)
+  parser.add_argument("--discovery-module", type=str, choices=["udp", "manual"], default="udp")
+  parser.add_argument("--discovery-timeout", type=int, default=30)
+  parser.add_argument("--discovery-config-path", type=str, default=None)
+  parser.add_argument("--wait-for-peers", type=int, default=0)
+  parser.add_argument("--inference-engine", type=str, default="jax", help="jax | dummy")
+  parser.add_argument("--chatgpt-api-port", type=int, default=52415)
+  parser.add_argument("--chatgpt-api-response-timeout", type=int, default=90)
+  parser.add_argument("--max-generate-tokens", type=int, default=1024)
+  parser.add_argument("--default-temp", type=float, default=0.6)
+  parser.add_argument("--default-top-k", type=int, default=35)
+  parser.add_argument("--system-prompt", type=str, default=None)
+  parser.add_argument("--default-model", type=str, default=None)
+  parser.add_argument("--disable-tui", action="store_true")
+  parser.add_argument("--prompt", type=str, default="Who are you?")
+  parser.add_argument("--run-gc", action="store_true", help="run garbage collection after each request")
+  parser.add_argument("--models-seed-dir", type=str, default=None)
+  # train flags (parity main.py:78-82)
+  parser.add_argument("--data", type=str, default="xotorch_tpu/train/data/lora")
+  parser.add_argument("--iters", type=int, default=100)
+  parser.add_argument("--batch-size", type=int, default=1)
+  parser.add_argument("--sequence-length", type=int, default=512)
+  parser.add_argument("--save-every", type=int, default=5)
+  parser.add_argument("--save-checkpoint-dir", type=str, default="checkpoints")
+  parser.add_argument("--resume-checkpoint", type=str, default=None)
+  return parser
+
+
+def build_node(args) -> tuple:
+  node_id = args.node_id or get_or_create_node_id()
+  node_port = args.node_port or find_available_port()
+
+  from xotorch_tpu.download import NoopShardDownloader
+  from xotorch_tpu.download.hf_shard_download import HFShardDownloader
+
+  engine_name = args.inference_engine
+  if engine_name == "dummy":
+    downloader = NoopShardDownloader()
+    # A dummy peer has no use for accelerator capabilities; skip the (slow on
+    # tunneled TPUs) JAX probe so CLI dry runs start instantly.
+    os.environ.setdefault("XOT_SKIP_JAX_PROBE", "1")
+  else:
+    downloader = HFShardDownloader()
+  engine = get_inference_engine(engine_name, downloader)
+  engine_classname = type(engine).__name__
+
+  def create_peer_handle(peer_id, addr, desc, caps):
+    return GRPCPeerHandle(peer_id, addr, desc, caps)
+
+  if args.discovery_module == "udp":
+    from xotorch_tpu.networking.udp.discovery import UDPDiscovery
+    discovery = UDPDiscovery(
+      node_id, node_port, args.listen_port, args.broadcast_port,
+      create_peer_handle, discovery_timeout=args.discovery_timeout,
+    )
+  else:
+    from xotorch_tpu.networking.manual.discovery import ManualDiscovery
+    if not args.discovery_config_path:
+      raise SystemExit("--discovery-config-path is required with --discovery-module manual")
+    discovery = ManualDiscovery(args.discovery_config_path, node_id, create_peer_handle)
+
+  topology_viz = None
+  if not args.disable_tui:
+    from xotorch_tpu.viz.topology_viz import TopologyViz
+    api_endpoints = [f"http://{ip}:{args.chatgpt_api_port}/v1/chat/completions"
+                     for ip, _ in get_all_ip_addresses_and_interfaces()][:2]
+    web_urls = [f"http://{ip}:{args.chatgpt_api_port}" for ip, _ in get_all_ip_addresses_and_interfaces()][:2]
+    topology_viz = TopologyViz(chatgpt_api_endpoints=api_endpoints, web_chat_urls=web_urls)
+
+  node = Node(
+    node_id, None, engine, discovery, downloader,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=args.max_generate_tokens,
+    default_sample_temp=args.default_temp,
+    default_sample_top_k=args.default_top_k,
+    topology_viz=topology_viz,
+  )
+  node.server = GRPCServer(node, args.node_host, node_port)
+
+  api = ChatGPTAPI(
+    node, engine_classname,
+    response_timeout=args.chatgpt_api_response_timeout,
+    default_model=args.default_model,
+    system_prompt=args.system_prompt,
+  )
+  if topology_viz is not None:
+    api.on_chat_completion_request = lambda req_id, _req, prompt: topology_viz.update_prompt(req_id, prompt)
+
+  _wire_events(node, engine, engine_classname, topology_viz, downloader)
+  return node, engine, engine_classname, api, topology_viz
+
+
+def _wire_events(node: Node, engine, engine_classname: str, topology_viz, downloader) -> None:
+  """Event plumbing (parity main.py:180-224)."""
+  # Preemptive shard load: when a remote peer starts a prompt, every peer
+  # warms its own layer range immediately (parity main.py:201-212).
+  def on_opaque_status(request_id: str, status: str) -> None:
+    try:
+      data = json.loads(status)
+      if data.get("type") == "node_status" and data.get("status") == "start_process_prompt":
+        base_shard = Shard.from_dict(data.get("base_shard", {}))
+        if data.get("node_id") != node.id:
+          current = node.get_current_shard(base_shard)
+          asyncio.create_task(engine.ensure_shard(current))
+    except Exception as e:
+      if DEBUG >= 2:
+        print(f"preemptive load error: {e!r}")
+
+  node.on_opaque_status.register("main-preemptive-load").on_next(on_opaque_status)
+
+  # Throttled download-progress broadcast at <= 5 Hz (parity main.py:214-224).
+  last_broadcast = {"t": 0.0}
+
+  def on_progress(shard, event):
+    now = time.monotonic()
+    if now - last_broadcast["t"] < 0.2 and not getattr(event, "is_complete", False):
+      return
+    last_broadcast["t"] = now
+    payload = event.to_dict() if hasattr(event, "to_dict") else dict(event)
+    asyncio.create_task(node.broadcast_opaque_status("", json.dumps({
+      "type": "download_progress", "node_id": node.id, "progress": payload,
+    })))
+
+  if downloader is not None:
+    downloader.on_progress.register("main-progress").on_next(on_progress)
+
+
+async def run_model_cli(node: Node, engine_classname: str, model_name: str, prompt: str) -> None:
+  """One-shot generate (parity main.py:226-256)."""
+  shard = build_base_shard(model_name, engine_classname)
+  if shard is None:
+    print(f"Error: unsupported model '{model_name}' for engine {engine_classname}")
+    return
+  if model_name.startswith("synthetic") or model_name == "dummy":
+    from xotorch_tpu.inference.tokenizers import DummyTokenizer
+    tokenizer = DummyTokenizer()
+    final_prompt = prompt
+  else:
+    repo = get_repo(model_name, engine_classname)
+    tokenizer = await resolve_tokenizer(repo)
+    final_prompt = tokenizer.apply_chat_template(
+      [{"role": "user", "content": prompt}], tokenize=False, add_generation_prompt=True
+    )
+  request_id = str(uuid.uuid4())
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(req_id, tokens, is_finished):
+    if req_id != request_id:
+      return
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("cli-wait-response").on_next(on_token)
+  started = time.monotonic()
+  await node.process_prompt(shard, final_prompt, request_id)
+  try:
+    await asyncio.wait_for(done.wait(), timeout=300)
+  except asyncio.TimeoutError:
+    print("Generation timed out")
+    return
+  elapsed = time.monotonic() - started
+  tokens = out.get("tokens", [])
+  eos = getattr(tokenizer, "eos_token_id", None)
+  text = tokenizer.decode([t for t in tokens if t != eos])
+  print(text)
+  print(f"\n[{len(tokens)} tokens in {elapsed:.1f}s = {len(tokens)/max(elapsed,1e-9):.1f} tok/s]", file=sys.stderr)
+
+
+async def train_model_cli(node: Node, engine_classname: str, model_name: str, args) -> None:
+  """Distributed train loop (parity main.py:272-315) — engine leaves exist
+  here, unlike the reference."""
+  from xotorch_tpu.train.dataset import iterate_batches, load_dataset
+  shard = build_base_shard(model_name, engine_classname)
+  if shard is None:
+    print(f"Error: unsupported model '{model_name}'")
+    return
+  train_set, valid_set, test_set = load_dataset(args.data)
+  if model_name.startswith("synthetic") or model_name == "dummy":
+    from xotorch_tpu.inference.tokenizers import DummyTokenizer
+    tokenizer = DummyTokenizer()
+  else:
+    tokenizer = await resolve_tokenizer(get_repo(model_name, engine_classname))
+  losses = []
+  for it, batch in enumerate(iterate_batches(train_set, tokenizer, args.batch_size, args.sequence_length)):
+    if it >= args.iters:
+      break
+    inputs, targets, lengths = batch
+    loss, _ = await node.enqueue_example(shard, inputs, targets, lengths, train=True)
+    losses.append(loss)
+    print(f"iter {it}: loss={loss:.4f}")
+    if args.save_every > 0 and (it + 1) % args.save_every == 0:
+      await node.coordinate_save(shard, it + 1, args.save_checkpoint_dir)
+
+
+async def eval_model_cli(node: Node, engine_classname: str, model_name: str, args) -> None:
+  from xotorch_tpu.train.dataset import iterate_batches, load_dataset
+  shard = build_base_shard(model_name, engine_classname)
+  _, _, test_set = load_dataset(args.data)
+  if model_name.startswith("synthetic") or model_name == "dummy":
+    from xotorch_tpu.inference.tokenizers import DummyTokenizer
+    tokenizer = DummyTokenizer()
+  else:
+    tokenizer = await resolve_tokenizer(get_repo(model_name, engine_classname))
+  losses = []
+  for batch in iterate_batches(test_set, tokenizer, args.batch_size, args.sequence_length):
+    inputs, targets, lengths = batch
+    loss, _ = await node.enqueue_example(shard, inputs, targets, lengths, train=False)
+    losses.append(loss)
+  if losses:
+    print(f"eval loss: {sum(losses)/len(losses):.4f} over {len(losses)} batches")
+
+
+async def async_main(args) -> None:
+  node, engine, engine_classname, api, topology_viz = build_node(args)
+  loop = asyncio.get_running_loop()
+  for sig in (signal.SIGINT, signal.SIGTERM):
+    try:
+      loop.add_signal_handler(sig, lambda s=sig: asyncio.create_task(shutdown(s, loop, node.server)))
+    except NotImplementedError:
+      pass
+
+  await node.start(wait_for_peers=args.wait_for_peers)
+  if topology_viz is not None:
+    topology_viz.start()
+
+  if args.command == "run":
+    model = args.model_name or args.default_model or "llama-3.2-1b"
+    await run_model_cli(node, engine_classname, model, args.prompt)
+    await node.stop()
+    return
+  if args.command == "train":
+    model = args.model_name or "synthetic-tiny"
+    await train_model_cli(node, engine_classname, model, args)
+    await node.stop()
+    return
+  if args.command == "eval":
+    model = args.model_name or "synthetic-tiny"
+    await eval_model_cli(node, engine_classname, model, args)
+    await node.stop()
+    return
+
+  runner = await api.run(port=args.chatgpt_api_port)
+  try:
+    await asyncio.Event().wait()
+  finally:
+    await runner.cleanup()
+    await node.stop()
+
+
+def run() -> None:
+  # XOT_PLATFORM=cpu|tpu pins the JAX platform even when a site hook
+  # pre-registered another backend (env JAX_PLATFORMS can be overridden by
+  # such hooks; the config update after import cannot).
+  if os.getenv("XOT_PLATFORM"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["XOT_PLATFORM"])
+  args = build_parser().parse_args()
+  try:
+    asyncio.run(async_main(args))
+  except KeyboardInterrupt:
+    pass
+
+
+if __name__ == "__main__":
+  run()

@@ -51,17 +51,20 @@ class GRPCServer(Server):
   # ------------------------------------------------------------------ RPCs
 
   async def _rpc_send_prompt(self, request: bytes, context) -> bytes:
+    # Ack immediately and process in the background: a ring hop's RPC must
+    # not stay open for the remainder of the generation (the chain would
+    # otherwise exceed any sane deadline and couple peer lifetimes).
     fields, _ = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
-    await self.node.process_prompt(shard, fields["prompt"], fields.get("request_id"))
+    asyncio.create_task(self.node.process_prompt(shard, fields["prompt"], fields.get("request_id")))
     return encode_message({"ok": True})
 
   async def _rpc_send_tensor(self, request: bytes, context) -> bytes:
     fields, tensors = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
-    await self.node.process_tensor(
+    asyncio.create_task(self.node.process_tensor(
       shard, tensors["tensor"], fields.get("request_id"), fields.get("inference_state")
-    )
+    ))
     return encode_message({"ok": True})
 
   async def _rpc_send_example(self, request: bytes, context) -> bytes:

@@ -83,6 +83,8 @@ class UDPDiscovery(Discovery):
     self.allowed_interface_types = allowed_interface_types
     self.known_peers: Dict[str, _PeerEntry] = {}
     self._tasks: List[asyncio.Task] = []
+    self._listen_transport = None
+    self._admitting: set = set()
 
   async def start(self) -> None:
     if self.device_capabilities is None:
@@ -99,6 +101,9 @@ class UDPDiscovery(Discovery):
       task.cancel()
     await asyncio.gather(*self._tasks, return_exceptions=True)
     self._tasks = []
+    if self._listen_transport is not None:
+      self._listen_transport.close()
+      self._listen_transport = None
 
   async def discover_peers(self, wait_for_peers: int = 0) -> List[PeerHandle]:
     if wait_for_peers > 0:
@@ -148,7 +153,7 @@ class UDPDiscovery(Discovery):
     except (AttributeError, OSError):
       pass
     sock.bind(("", self.listen_port))
-    await asyncio.get_event_loop().create_datagram_endpoint(
+    self._listen_transport, _ = await asyncio.get_event_loop().create_datagram_endpoint(
       lambda: ListenProtocol(self._on_listen_message), sock=sock
     )
     if DEBUG_DISCOVERY >= 1:
@@ -201,6 +206,15 @@ class UDPDiscovery(Discovery):
     await self._admit_peer(peer_id, peer_host, peer_port, message, caps, peer_prio)
 
   async def _admit_peer(self, peer_id, host, port, message, caps, priority, replacing=None) -> None:
+    if peer_id in self._admitting:
+      return  # an admission (with its health check) is already in flight
+    self._admitting.add(peer_id)
+    try:
+      await self._admit_peer_inner(peer_id, host, port, message, caps, priority, replacing)
+    finally:
+      self._admitting.discard(peer_id)
+
+  async def _admit_peer_inner(self, peer_id, host, port, message, caps, priority, replacing=None) -> None:
     handle = self.create_peer_handle(
       peer_id, f"{host}:{port}", f"{message.get('interface_name')} ({message.get('interface_type')})", caps
     )

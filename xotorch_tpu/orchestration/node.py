@@ -209,6 +209,7 @@ class Node:
 
     if is_finished:
       self.outstanding_requests.pop(request_id, None)
+      self.buffered_token_output.pop(request_id, None)  # callbacks/broadcast hold the list
       clear = getattr(self.inference_engine, "clear_request", None)
       if clear is not None:
         await clear(request_id)
@@ -271,7 +272,9 @@ class Node:
     target_id = partitions[target_index].node_id
     next_shard = self.get_current_shard(base_shard, target_index)
     if target_id == self.id:
-      await self.process_tensor(base_shard, tensor, request_id, inference_state)
+      # Schedule rather than await: a direct call would grow one coroutine
+      # chain per token and blow the recursion limit on long generations.
+      asyncio.create_task(self.process_tensor(base_shard, tensor, request_id, inference_state))
       return
     peer = next((p for p in self.peers if p.id() == target_id), None)
     if peer is None:
@@ -424,15 +427,13 @@ class Node:
       try:
         other = await asyncio.wait_for(peer.collect_topology(set(visited), max_depth - 1), timeout=5.0)
         visited.update(other.nodes.keys())
-        # Origin-filtered merge takes the peer's own observations; transitive
+        # Origin-filtered merge takes only the peer's OWN edges/caps (a stale
+        # or malicious peer cannot rewrite the rest of the graph); transitive
         # nodes it learned about are added if we don't know them yet.
         next_topology.merge(peer.id(), other)
         for node_id, caps in other.nodes.items():
           if node_id not in next_topology.nodes:
             next_topology.update_node(node_id, caps)
-        for from_id, conns in other.peer_graph.items():
-          for conn in conns:
-            next_topology.add_edge(conn.from_id, conn.to_id, conn.description)
       except Exception as e:
         if DEBUG >= 2:
           print(f"collect_topology from {peer.id()} failed: {e!r}")

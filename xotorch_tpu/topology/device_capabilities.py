@@ -202,18 +202,33 @@ async def device_capabilities() -> DeviceCapabilities:
   timeout = float(os.getenv("XOT_PROBE_TIMEOUT", "120"))
   loop = asyncio.get_running_loop()
   if _probe_future is None:
-    # Single in-flight probe: JAX backend init is not thread-safe and slow
-    # on tunneled TPUs, so repeat callers (topology gossip) share the future.
-    _probe_future = loop.run_in_executor(None, device_capabilities_sync)
+    # Single in-flight probe on a DAEMON thread: JAX backend init is not
+    # thread-safe (so repeat callers share the future) and can hang for
+    # minutes on a tunneled TPU — a daemon thread never blocks process exit.
+    import threading
 
-    def _store(fut) -> None:
+    _probe_future = loop.create_future()
+
+    def _worker(fut, target_loop) -> None:
       global _cached_capabilities, _probe_future
-      if fut.cancelled() or fut.exception() is not None:
-        _probe_future = None
+      try:
+        caps = device_capabilities_sync()
+      except Exception as e:
+        _probe_future = None  # let a later caller re-probe
+        try:
+          target_loop.call_soon_threadsafe(lambda: fut.set_exception(e) if not fut.done() else None)
+        except RuntimeError:
+          pass  # loop already closed
         return
-      _cached_capabilities = fut.result()
+      # Plain assignment is thread-safe; record the result even if the loop
+      # that started the probe has exited (a later asyncio.run sees the cache).
+      _cached_capabilities = caps
+      try:
+        target_loop.call_soon_threadsafe(lambda: fut.set_result(caps) if not fut.done() else None)
+      except RuntimeError:
+        _probe_future = None
 
-    _probe_future.add_done_callback(_store)
+    threading.Thread(target=_worker, args=(_probe_future, loop), daemon=True, name="xot-probe").start()
   try:
     return await asyncio.wait_for(asyncio.shield(_probe_future), timeout)
   except asyncio.TimeoutError:

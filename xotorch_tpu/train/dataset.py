@@ -1,0 +1,71 @@
+"""JSONL dataset + batcher for the train/eval CLI.
+
+Parity: /root/reference/xotorch/train/dataset.py:1-80 (itself from
+mlx-examples): loads {dir}/train.jsonl, valid.jsonl, test.jsonl with a
+"text" field per line; batches are padded token arrays with next-token
+targets and true lengths.
+"""
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+
+class Dataset:
+  def __init__(self, path: Path):
+    self.entries: List[str] = []
+    if path.exists():
+      with open(path) as f:
+        for line in f:
+          line = line.strip()
+          if line:
+            self.entries.append(json.loads(line).get("text", ""))
+
+  def __len__(self) -> int:
+    return len(self.entries)
+
+  def __getitem__(self, idx: int) -> str:
+    return self.entries[idx]
+
+
+def load_dataset(data_dir: str) -> Tuple[Dataset, Dataset, Dataset]:
+  base = Path(data_dir)
+  names = ("train", "valid", "test")
+  train, valid, test = (Dataset(base / f"{n}.jsonl") for n in names)
+  if len(train) == 0:
+    raise ValueError(f"No training data found in {base} (need train.jsonl with 'text' entries)")
+  return train, valid, test
+
+
+def batch_with_lengths(tokens_batch: List[List[int]], max_seq_len: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+  """Pad to batch max, produce next-token targets and true lengths
+  (parity :9-23)."""
+  lengths = [min(len(t), max_seq_len) for t in tokens_batch]
+  width = max(lengths)
+  batch = np.zeros((len(tokens_batch), width), dtype=np.int64)
+  for i, tokens in enumerate(tokens_batch):
+    batch[i, : lengths[i]] = tokens[: lengths[i]]
+  inputs = batch[:, :-1]
+  targets = batch[:, 1:]
+  return inputs, targets, np.asarray([max(l - 1, 1) for l in lengths], dtype=np.int64)
+
+
+def iterate_batches(
+  dataset: Dataset, tokenizer, batch_size: int, max_seq_len: int, train: bool = True, seed: int = 0
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+  """Shuffled epoch iterator (parity :29-44). Warns on >max_seq_len examples
+  (the reference warned at 2048, :55-57)."""
+  indices = list(range(len(dataset)))
+  if train:
+    random.Random(seed).shuffle(indices)
+  for i in range(0, len(indices) - batch_size + 1, batch_size):
+    chunk = [dataset[j] for j in indices[i: i + batch_size]]
+    tokens = [tokenizer.encode(text) for text in chunk]
+    for t in tokens:
+      if len(t) > max_seq_len:
+        print(f"Warning: example of length {len(t)} truncated to {max_seq_len}")
+    yield batch_with_lengths(tokens, max_seq_len)

@@ -1,0 +1,3 @@
+from xotorch_tpu.viz.topology_viz import TopologyViz
+
+__all__ = ["TopologyViz"]

@@ -1,0 +1,149 @@
+"""Topology TUI: live ring visualization with per-partition layer ranges.
+
+Parity: /root/reference/xotorch/viz/topology_viz.py:20-378 — an ASCII ring of
+nodes (ellipse layout), per-node capability lines, active-node highlighting,
+a cluster bf16-TFLOPS gauge, recent prompt/output panel and per-node download
+progress — rendered with rich.Live.
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+from rich.console import Console, Group
+from rich.layout import Layout
+from rich.live import Live
+from rich.panel import Panel
+from rich.table import Table
+from rich.text import Text
+
+from xotorch_tpu.topology.partitioning import Partition
+from xotorch_tpu.topology.topology import Topology
+from xotorch_tpu.utils.helpers import pretty_bytes
+
+
+class TopologyViz:
+  def __init__(self, chatgpt_api_endpoints: Optional[List[str]] = None, web_chat_urls: Optional[List[str]] = None):
+    self.chatgpt_api_endpoints = chatgpt_api_endpoints or []
+    self.web_chat_urls = web_chat_urls or []
+    self.topology = Topology()
+    self.partitions: List[Partition] = []
+    self.node_id: Optional[str] = None
+    self.prompts: "OrderedDict[str, str]" = OrderedDict()
+    self.outputs: "OrderedDict[str, str]" = OrderedDict()
+    self.node_download_progress = {}
+    self.console = Console()
+    self.layout = Layout()
+    self.layout.split_column(Layout(name="main", ratio=3), Layout(name="chat", ratio=2))
+    self.live: Optional[Live] = None
+
+  # ------------------------------------------------------------- updates
+
+  def start(self) -> None:
+    if self.live is None:
+      self.live = Live(self.layout, console=self.console, refresh_per_second=4, transient=False)
+      self.live.start()
+
+  def stop(self) -> None:
+    if self.live is not None:
+      self.live.stop()
+      self.live = None
+
+  def update_visualization(self, topology: Topology, partitions: List[Partition], node_id: Optional[str] = None,
+                           node_download_progress=None) -> None:
+    self.topology = topology
+    self.partitions = partitions
+    self.node_id = node_id
+    if node_download_progress is not None:
+      self.node_download_progress = node_download_progress
+    self.refresh()
+
+  def update_prompt(self, request_id: str, prompt: str) -> None:
+    self.prompts[request_id] = prompt
+    while len(self.prompts) > 3:
+      self.prompts.popitem(last=False)
+    self.refresh()
+
+  def update_prompt_output(self, request_id: str, output: str) -> None:
+    self.outputs[request_id] = output
+    while len(self.outputs) > 3:
+      self.outputs.popitem(last=False)
+    self.refresh()
+
+  def refresh(self) -> None:
+    if self.live is None:
+      return
+    self.layout["main"].update(Panel(self._render_ring(), title="xot cluster", border_style="blue"))
+    self.layout["chat"].update(Panel(self._render_chat(), title="chat", border_style="magenta"))
+    self.live.refresh()
+
+  # ------------------------------------------------------------ renderers
+
+  def _flops_gauge(self) -> Text:
+    total_tflops = sum(caps.flops.fp16 for _, caps in self.topology.all_nodes())
+    # tanh-scaled "GPU poor/rich" gauge (parity :219-249), recalibrated to TPU
+    # scale: 1 v5e chip ~ 197 bf16 TFLOPS.
+    frac = math.tanh(total_tflops / 800.0)
+    width = 30
+    filled = int(frac * width)
+    bar = "█" * filled + "░" * (width - filled)
+    label = "TPU rich" if frac > 0.5 else "TPU poor"
+    return Text.assemble(
+      (f"{total_tflops:.0f} bf16 TFLOPS ", "bold"),
+      (bar, "green" if frac > 0.5 else "yellow"),
+      (f" {label}", "dim"),
+    )
+
+  def _render_ring(self) -> Group:
+    lines: List[Text] = [self._flops_gauge(), Text("")]
+    n_layers = None
+    shard_ranges = {}
+    if self.partitions:
+      from xotorch_tpu.topology.partitioning import map_partitions_to_shards
+      try:
+        n_layers = 32
+        shards = map_partitions_to_shards(self.partitions, n_layers, "model")
+        shard_ranges = {p.node_id: (s.start_layer, s.end_layer) for p, s in zip(self.partitions, shards)}
+      except ValueError:
+        shard_ranges = {}
+    order = [p.node_id for p in self.partitions] or [nid for nid, _ in self.topology.all_nodes()]
+    for i, nid in enumerate(order):
+      caps = self.topology.get_node(nid)
+      if caps is None:
+        continue
+      is_self = nid == self.node_id
+      is_active = nid == self.topology.active_node_id
+      arrow = " ─▶ " if i < len(order) - 1 else " ─▶ (ring wraps)"
+      marker = "●" if is_active else "○"
+      style = "bold green" if is_active else ("bold cyan" if is_self else "white")
+      range_txt = ""
+      if nid in shard_ranges:
+        lo, hi = shard_ranges[nid]
+        range_txt = f" layers[{lo}..{hi}]"
+      lines.append(Text.assemble(
+        (f" {marker} ", style),
+        (f"{nid[:12]:<14}", style),
+        (f"{caps.chip} {pretty_bytes(caps.memory * 1024 * 1024)}", "dim"),
+        (range_txt, "yellow"),
+        (arrow, "dim"),
+      ))
+    if self.node_download_progress:
+      lines.append(Text(""))
+      for nid, progress in self.node_download_progress.items():
+        pct = progress.get("percentage", 0) if isinstance(progress, dict) else 0
+        lines.append(Text(f" ↓ {nid[:12]}: {pct:.0f}%", style="dim"))
+    for url in self.web_chat_urls:
+      lines.append(Text(f"\n web chat: {url}", style="blue underline"))
+    for ep in self.chatgpt_api_endpoints:
+      lines.append(Text(f" api: {ep}", style="dim"))
+    return Group(*lines)
+
+  def _render_chat(self) -> Group:
+    rows = []
+    for request_id in list(self.prompts.keys())[-3:]:
+      rows.append(Text.assemble(("prompt: ", "bold yellow"), (self.prompts[request_id][-200:], "")))
+      if request_id in self.outputs:
+        rows.append(Text.assemble(("output: ", "bold green"), (self.outputs[request_id][-400:], "")))
+      rows.append(Text(""))
+    return Group(*rows) if rows else Group(Text("no requests yet", style="dim"))
