@@ -92,6 +92,14 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax.numpy as jnp
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[self._dtype_name]
 
+  def _flash_enabled(self) -> bool:
+    """XOT_FLASH_ATTENTION: 1 = force on (interpret mode off-TPU), 0 = off,
+    unset = on when running on real TPU."""
+    env = os.getenv("XOT_FLASH_ATTENTION")
+    if env is not None:
+      return env == "1"
+    return self._jax().default_backend() == "tpu"
+
   async def _run(self, fn, *args):
     return await asyncio.get_running_loop().run_in_executor(self.executor, fn, *args)
 
@@ -170,7 +178,13 @@ class JAXShardInferenceEngine(InferenceEngine):
       pad = [(0, 0), (0, bucket - true_t)] + [(0, 0)] * (x.ndim - 2)
       x = jnp.pad(x, pad)
 
-    out, new_cache = self._forward_jit(self.params, x, state.cache, jnp.int32(state.pos))
+    # Pallas flash prefill: only valid for a fresh request (whole visible
+    # context is the incoming segment). Decode steps and any pos>0 segment
+    # use the XLA-fused baseline over the resident cache.
+    forward = self._forward_jit
+    if true_t > 1 and state.pos == 0 and self._flash_enabled():
+      forward = self._forward_flash_jit
+    out, new_cache = forward(self.params, x, state.cache, jnp.int32(state.pos))
     state.cache = new_cache
     state.pos += true_t
     state.last_used = time.monotonic()
@@ -225,9 +239,10 @@ class JAXShardInferenceEngine(InferenceEngine):
         forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=shard.is_last_layer
       )
       forward_jit = jax.jit(fwd, donate_argnums=(2,))
-      return cfg, params, forward_jit
+      forward_flash_jit = jax.jit(partial(fwd, use_flash=True), donate_argnums=(2,))
+      return cfg, params, forward_jit, forward_flash_jit
 
-    self.cfg, self.params, self._forward_jit = await self._run(_load)
+    self.cfg, self.params, self._forward_jit, self._forward_flash_jit = await self._run(_load)
     self._opt_state = None  # optimizer state is invalid for a new param tree
     self.cache_len = min(self._configured_cache_len, self.cfg.max_seq_len)
     self._model_dir = model_dir

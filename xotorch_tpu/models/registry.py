@@ -91,6 +91,17 @@ model_cards: Dict[str, Dict] = {
       "rope_theta": 10000.0, "tie_word_embeddings": False, "eos_token_id": 2,
     },
   },
+  "synthetic-tiny-moe": {
+    "layers": 4, "repo": {JAX: "synthetic"}, "moe": True,
+    "synthetic_config": {
+      "model_type": "qwen3_moe", "hidden_size": 64, "intermediate_size": 128,
+      "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+      "num_hidden_layers": 4, "vocab_size": 256, "max_position_embeddings": 2048,
+      "rope_theta": 10000.0, "tie_word_embeddings": False, "eos_token_id": 2,
+      "num_experts": 4, "num_experts_per_tok": 2, "moe_intermediate_size": 64,
+      "norm_topk_prob": True,
+    },
+  },
 }
 
 pretty_names: Dict[str, str] = {

@@ -48,7 +48,8 @@ def init_kv_cache(cfg: ModelConfig, num_layers: int, batch: int, max_seq: int, d
 def _attention_block(
   layer: Params, x: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
   positions: jnp.ndarray, kv_valid_len: jnp.ndarray, start_pos: jnp.ndarray,
-  cfg: ModelConfig, inv_freq: jnp.ndarray,
+  cfg: ModelConfig, inv_freq: jnp.ndarray, use_flash: bool = False,
+  ring_mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
@@ -69,7 +70,20 @@ def _attention_block(
   k = apply_rope(k, positions, inv_freq)
   k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start_pos, 0, 0))
   v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start_pos, 0, 0))
-  attn = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), positions, kv_valid_len)
+  if use_flash:
+    # Prefill-from-zero fast path (engine guarantees start_pos == 0): the
+    # fresh segment IS the whole visible context, and relative == absolute
+    # positions, so the Pallas kernel's in-segment causal mask is exact.
+    from xotorch_tpu.ops.flash_attention import flash_attention
+    attn = flash_attention(q, k, v)
+  elif ring_mesh is not None:
+    # Sequence-parallel training path (start_pos == 0, T sharded over 'sp'):
+    # ring attention rotates KV chunks over ICI instead of materialising the
+    # full sequence on every device.
+    from xotorch_tpu.ops.ring_attention import ring_attention_sharded
+    attn = ring_attention_sharded(q, k, v, ring_mesh)
+  else:
+    attn = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), positions, kv_valid_len)
   out = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ layer["wo"]
   return out, k_cache, v_cache
 
@@ -106,11 +120,15 @@ def forward_shard(
   cfg: ModelConfig,
   is_first: bool,
   is_last: bool,
+  use_flash: bool = False,
+  ring_mesh=None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
 
-  cfg/is_first/is_last must be static under jit; start_pos is traced so one
-  executable serves every decode step.
+  cfg/is_first/is_last/use_flash must be static under jit; start_pos is
+  traced so one executable serves every decode step. use_flash selects the
+  Pallas prefill kernel (ops/flash_attention.py) and is only valid when
+  start_pos == 0 — the engine picks the right executable per call.
   """
   if is_first:
     h = jnp.take(params["embed"]["embedding"], x, axis=0)
@@ -124,7 +142,8 @@ def forward_shard(
   def layer_body(h, xs):
     layer, k_cache, v_cache = xs
     attn_out, k_cache, v_cache = _attention_block(
-      layer, h, k_cache, v_cache, positions, kv_valid_len, start_pos, cfg, inv_freq
+      layer, h, k_cache, v_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
+      ring_mesh,
     )
     h = h + attn_out
     mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)

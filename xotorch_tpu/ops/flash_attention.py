@@ -1,0 +1,133 @@
+"""Pallas TPU flash attention (causal, GQA) for the prefill hot path.
+
+The reference materialises a full [T, S] boolean mask on the host and runs
+torch SDPA over it per shard (sharded_inference_engine.py:144-186); here the
+prefill attention is a single Pallas kernel: tiled over (batch, q-head,
+q-block, kv-block) with the online-softmax recurrence, scores never leave
+VMEM, and fully-masked kv blocks above the causal diagonal are skipped.
+
+Scope: self-attention over the freshly projected K/V of the prefill segment
+(positions [0, T)), which is exactly the engine's prefill call — decode steps
+(T == 1) and any resumed-from-nonzero-position path use the XLA-fused
+baseline in ops/attention.py instead (engine._infer_sync picks per call).
+
+On CPU (tests, dev laptops) the kernel runs in Pallas interpret mode so the
+same code path is exercised without a TPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q, block_k, groups, scale):
+  """Grid = (B, Hq, nQ, nK); nK innermost so the scratch accumulators carry
+  the online-softmax state across kv blocks of one (b, h, i) triple."""
+  i = pl.program_id(2)
+  j = pl.program_id(3)
+  n_k = pl.num_programs(3)
+
+  @pl.when(j == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  # Causal block skip: kv block j is visible to q block i iff its first key
+  # position <= the last query position of block i.
+  q_last = (i + 1) * block_q - 1
+
+  @pl.when(j * block_k <= q_last)
+  def _compute():
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+
+    s = jax.lax.dot_general(
+      q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [block_q, block_k]
+
+    # Elementwise causal mask (only the diagonal blocks actually cut).
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]  # [block_q, 1] (lane-replicated scratch, col 0)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+  @pl.when(j == n_k - 1)
+  def _finalize():
+    l = l_ref[:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows cannot occur under causality; belt+braces
+    o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_attention(
+  q: jnp.ndarray,  # [B, T, Hq, D]
+  k: jnp.ndarray,  # [B, T, Hkv, D]
+  v: jnp.ndarray,  # [B, T, Hkv, D]
+  block_q: int = 128,
+  block_k: int = 128,
+  interpret: bool | None = None,
+) -> jnp.ndarray:
+  """Causal grouped-query flash attention over one contiguous segment.
+
+  Query position t attends keys [0, t]. Returns [B, T, Hq, D] in q.dtype.
+  T must be a multiple of the (possibly clamped) block sizes — the engine's
+  power-of-two prefill buckets guarantee this.
+  """
+  B, T, Hq, D = q.shape
+  Hkv = k.shape[2]
+  groups = Hq // Hkv
+  block_q = min(block_q, T)
+  block_k = min(block_k, T)
+  if T % block_q or T % block_k:
+    raise ValueError(f"T={T} must be a multiple of block_q={block_q}, block_k={block_k}")
+  if interpret is None:
+    interpret = jax.default_backend() != "tpu"
+
+  scale = 1.0 / math.sqrt(D)
+  # [B, H, T, D] layout: the kernel tiles the last two dims.
+  qt = q.transpose(0, 2, 1, 3)
+  kt = k.transpose(0, 2, 1, 3)
+  vt = v.transpose(0, 2, 1, 3)
+
+  grid = (B, Hq, T // block_q, T // block_k)
+
+  out = pl.pallas_call(
+    functools.partial(_flash_kernel, block_q=block_q, block_k=block_k, groups=groups, scale=scale),
+    grid=grid,
+    in_specs=[
+      pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+      pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // groups, j, 0)),
+      pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // groups, j, 0)),
+    ],
+    out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+    out_shape=jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
+    scratch_shapes=[
+      pltpu.VMEM((block_q, D), jnp.float32),
+      pltpu.VMEM((block_q, 128), jnp.float32),
+      pltpu.VMEM((block_q, 128), jnp.float32),
+    ],
+    interpret=interpret,
+  )(qt, kt, vt)
+
+  return out.transpose(0, 2, 1, 3)

@@ -65,14 +65,28 @@ def spec_for_param(name: str):
   return rules.get(name)
 
 
-def param_specs_like(params: Dict[str, Any]) -> Dict[str, Any]:
-  """A spec pytree mirroring the param tree exactly (path-keyed)."""
+def _restrict_spec(spec, mesh):
+  """Drop axis names the mesh doesn't have (e.g. tp rules on a dp×ep mesh):
+  an absent axis simply means replicated there."""
+  from jax.sharding import PartitionSpec as P
+
+  if spec is None:
+    return P()
+  names = set(mesh.axis_names)
+  return P(*[(ax if ax in names else None) for ax in spec])
+
+
+def param_specs_like(params: Dict[str, Any], mesh=None) -> Dict[str, Any]:
+  """A spec pytree mirroring the param tree exactly (path-keyed). Pass the
+  mesh to drop rule axes it doesn't have (same semantics as shard_params)."""
   import jax
   from jax.sharding import PartitionSpec as P
 
   def spec(path, leaf):
     name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
     s = spec_for_param(name)
+    if mesh is not None:
+      return _restrict_spec(s, mesh)
     return s if s is not None else P()
 
   return jax.tree_util.tree_map_with_path(spec, params)
@@ -86,23 +100,26 @@ def shard_params(params: Dict[str, Any], mesh) -> Dict[str, Any]:
 
   def place(path, leaf):
     name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-    s = spec_for_param(name)
-    from jax.sharding import PartitionSpec as P
-    return jax.device_put(leaf, NamedSharding(mesh, s if s is not None else P()))
+    return jax.device_put(leaf, NamedSharding(mesh, _restrict_spec(spec_for_param(name), mesh)))
 
   return jax.tree_util.tree_map_with_path(place, params)
 
 
 def batch_spec(rank: int = 2):
-  """Batch leaves shard along dp on their leading axis, whatever their rank."""
+  """Batch leaves shard along dp on their leading axis and (rank >= 2) the
+  sequence axis over sp when those axes exist in the mesh."""
   from jax.sharding import PartitionSpec as P
-  return P("dp", *([None] * (rank - 1)))
+  if rank >= 2:
+    return P("dp", "sp", *([None] * (rank - 2)))
+  return P("dp")
 
 
 def shard_batch(batch, mesh):
   import jax
   from jax.sharding import NamedSharding
-  return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, batch_spec(x.ndim))), batch)
+  return jax.tree.map(
+    lambda x: jax.device_put(x, NamedSharding(mesh, _restrict_spec(batch_spec(x.ndim), mesh))), batch
+  )
 
 
 def cache_spec():
@@ -114,4 +131,6 @@ def cache_spec():
 def shard_cache(cache, mesh):
   import jax
   from jax.sharding import NamedSharding
-  return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, cache_spec())), cache)
+  return jax.tree.map(
+    lambda x: jax.device_put(x, NamedSharding(mesh, _restrict_spec(cache_spec(), mesh))), cache
+  )

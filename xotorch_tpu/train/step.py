@@ -31,12 +31,16 @@ def masked_ce_loss(logits: jnp.ndarray, targets: jnp.ndarray, lengths: jnp.ndarr
   return (ce * mask).sum() / jnp.maximum(mask.sum(), 1)
 
 
-def full_model_loss(params, batch: Dict[str, jnp.ndarray], cfg: ModelConfig) -> jnp.ndarray:
-  """Loss when one shard holds the whole model (single-peer training)."""
+def full_model_loss(params, batch: Dict[str, jnp.ndarray], cfg: ModelConfig, ring_mesh=None) -> jnp.ndarray:
+  """Loss when one shard holds the whole model (single-peer training).
+
+  ring_mesh: pass the mesh to train sequence-parallel — attention rotates KV
+  chunks over the 'sp' axis (ops/ring_attention.py) instead of gathering the
+  full sequence per device."""
   inputs, targets, lengths = batch["inputs"], batch["targets"], batch["lengths"]
   B, T = inputs.shape
   cache = init_kv_cache(cfg, cfg.num_layers, B, T, jnp.float32)
-  logits, _ = forward_shard(params, inputs, cache, jnp.int32(0), cfg, True, True)
+  logits, _ = forward_shard(params, inputs, cache, jnp.int32(0), cfg, True, True, ring_mesh=ring_mesh)
   return masked_ce_loss(logits, targets, lengths)
 
 
@@ -44,9 +48,10 @@ def make_train_step(
   cfg: ModelConfig,
   optimizer: optax.GradientTransformation,
   loss_fn: Optional[Callable] = None,
+  ring_mesh=None,
 ) -> Callable:
   """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss)."""
-  loss_fn = loss_fn or partial(full_model_loss, cfg=cfg)
+  loss_fn = loss_fn or partial(full_model_loss, cfg=cfg, ring_mesh=ring_mesh)
 
   @jax.jit
   def train_step(params, opt_state, batch):
@@ -58,8 +63,8 @@ def make_train_step(
   return train_step
 
 
-def make_eval_step(cfg: ModelConfig, loss_fn: Optional[Callable] = None) -> Callable:
-  loss_fn = loss_fn or partial(full_model_loss, cfg=cfg)
+def make_eval_step(cfg: ModelConfig, loss_fn: Optional[Callable] = None, ring_mesh=None) -> Callable:
+  loss_fn = loss_fn or partial(full_model_loss, cfg=cfg, ring_mesh=ring_mesh)
 
   @jax.jit
   def eval_step(params, batch):
