@@ -1,0 +1,279 @@
+// Minimal JSON parser/writer for the sidecar protocol and safetensors headers.
+// Hand-rolled (no third-party deps in the image); supports the subset the
+// framing + HF config.json + safetensors headers need: objects, arrays,
+// strings (with \u escapes), numbers, bools, null.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace xot {
+
+class Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Type type = Type::Null;
+  bool bool_v = false;
+  double num_v = 0.0;
+  std::string str_v;
+  std::vector<JsonPtr> arr_v;
+  std::map<std::string, JsonPtr> obj_v;
+
+  static JsonPtr make(Type t) {
+    auto j = std::make_shared<Json>();
+    j->type = t;
+    return j;
+  }
+  static JsonPtr of(double v) { auto j = make(Type::Number); j->num_v = v; return j; }
+  static JsonPtr of(int64_t v) { return of(static_cast<double>(v)); }
+  static JsonPtr of(const std::string& v) { auto j = make(Type::String); j->str_v = v; return j; }
+  static JsonPtr of(bool v) { auto j = make(Type::Bool); j->bool_v = v; return j; }
+
+  bool is_object() const { return type == Type::Object; }
+  bool is_array() const { return type == Type::Array; }
+  bool has(const std::string& k) const { return is_object() && obj_v.count(k) > 0; }
+
+  JsonPtr at(const std::string& k) const {
+    auto it = obj_v.find(k);
+    if (it == obj_v.end()) throw std::runtime_error("json: missing key " + k);
+    return it->second;
+  }
+  // Typed getters with defaults (config.json fields are frequently absent).
+  double num(const std::string& k, double dflt) const {
+    auto it = obj_v.find(k);
+    return (it == obj_v.end() || it->second->type != Type::Number) ? dflt : it->second->num_v;
+  }
+  int64_t integer(const std::string& k, int64_t dflt) const {
+    return static_cast<int64_t>(num(k, static_cast<double>(dflt)));
+  }
+  std::string str(const std::string& k, const std::string& dflt) const {
+    auto it = obj_v.find(k);
+    return (it == obj_v.end() || it->second->type != Type::String) ? dflt : it->second->str_v;
+  }
+  bool boolean(const std::string& k, bool dflt) const {
+    auto it = obj_v.find(k);
+    return (it == obj_v.end() || it->second->type != Type::Bool) ? dflt : it->second->bool_v;
+  }
+
+  void set(const std::string& k, JsonPtr v) { type = Type::Object; obj_v[k] = v; }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+ private:
+  static void write_escaped(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  void write(std::ostringstream& os) const {
+    switch (type) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_v ? "true" : "false"); break;
+      case Type::Number: {
+        if (num_v == static_cast<int64_t>(num_v)) os << static_cast<int64_t>(num_v);
+        else os << num_v;
+        break;
+      }
+      case Type::String: write_escaped(os, str_v); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_v.size(); ++i) {
+          if (i) os << ',';
+          arr_v[i]->write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (auto& kv : obj_v) {
+          if (!first) os << ',';
+          first = false;
+          write_escaped(os, kv.first);
+          os << ':';
+          kv.second->write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+};
+
+class JsonParser {
+ public:
+  static JsonPtr parse(const std::string& text) {
+    JsonParser p(text);
+    JsonPtr v = p.value();
+    p.skip_ws();
+    if (p.pos_ != p.text_.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+ private:
+  explicit JsonParser(const std::string& text) : text_(text) {}
+
+  const std::string& text_;
+  size_t pos_ = 0;
+
+  void skip_ws() {
+    while (pos_ < text_.size() && (text_[pos_] == ' ' || text_[pos_] == '\t' || text_[pos_] == '\n' || text_[pos_] == '\r'))
+      ++pos_;
+  }
+  char peek() {
+    if (pos_ >= text_.size()) throw std::runtime_error("json: unexpected end");
+    return text_[pos_];
+  }
+  char next() {
+    char c = peek();
+    ++pos_;
+    return c;
+  }
+  void expect(char c) {
+    if (next() != c) throw std::runtime_error(std::string("json: expected '") + c + "'");
+  }
+
+  JsonPtr value() {
+    skip_ws();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') return Json::of(string_lit());
+    if (c == 't' || c == 'f') return boolean();
+    if (c == 'n') { literal("null"); return Json::make(Json::Type::Null); }
+    return number();
+  }
+
+  void literal(const char* lit) {
+    for (const char* p = lit; *p; ++p) expect(*p);
+  }
+
+  JsonPtr boolean() {
+    if (peek() == 't') { literal("true"); return Json::of(true); }
+    literal("false");
+    return Json::of(false);
+  }
+
+  JsonPtr number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    while (pos_ < text_.size() && (isdigit(text_[pos_]) || text_[pos_] == '.' || text_[pos_] == 'e' || text_[pos_] == 'E' || text_[pos_] == '+' || text_[pos_] == '-'))
+      ++pos_;
+    return Json::of(std::stod(text_.substr(start, pos_ - start)));
+  }
+
+  std::string string_lit() {
+    expect('"');
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') break;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            unsigned code = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = next();
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else throw std::runtime_error("json: bad \\u escape");
+            }
+            // UTF-8 encode (BMP only — enough for config/tokenizer metadata).
+            if (code < 0x80) out += static_cast<char>(code);
+            else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("json: bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  JsonPtr array() {
+    expect('[');
+    auto j = Json::make(Json::Type::Array);
+    skip_ws();
+    if (peek() == ']') { ++pos_; return j; }
+    while (true) {
+      j->arr_v.push_back(value());
+      skip_ws();
+      char c = next();
+      if (c == ']') break;
+      if (c != ',') throw std::runtime_error("json: expected , or ]");
+    }
+    return j;
+  }
+
+  JsonPtr object() {
+    expect('{');
+    auto j = Json::make(Json::Type::Object);
+    skip_ws();
+    if (peek() == '}') { ++pos_; return j; }
+    while (true) {
+      skip_ws();
+      std::string key = string_lit();
+      skip_ws();
+      expect(':');
+      j->obj_v[key] = value();
+      skip_ws();
+      char c = next();
+      if (c == '}') break;
+      if (c != ',') throw std::runtime_error("json: expected , or }");
+    }
+    return j;
+  }
+};
+
+}  // namespace xot

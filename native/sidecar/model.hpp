@@ -1,0 +1,483 @@
+// Sharded transformer forward in plain C++ (fp32 compute, threaded GEMV).
+//
+// This is the compute core of the native sidecar — the TPU build's equivalent
+// of the reference's out-of-process "cheetah" C++ engine
+// (xotorch/inference/cheetah/sharded_inference_engine.py describes only the
+// client; the service itself lived out of repo — SURVEY §2.6.3). Here the
+// service is IN-repo: it loads an HF-layout safetensors checkpoint filtered
+// to a layer-range Shard, keeps a per-session KV cache resident across calls
+// (the wire carries only (tokens|hidden, pos) — never masks or token
+// history), and serves dense llama / mistral / qwen2 / qwen3 families.
+//
+// Numerics match the JAX engine's model (xotorch_tpu/models/transformer.py):
+// RMSNorm, HF rotate-half RoPE with optional llama3 frequency scaling, GQA
+// attention, SwiGLU MLP, optional qwen2 attention bias and qwen3 per-head
+// q/k RMSNorm — so the split-vs-full logits-equivalence invariant
+// (test_inference_engine.py:43-44 in the reference) holds across engines.
+#pragma once
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstring>
+#include <fstream>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.hpp"
+#include "safetensors.hpp"
+
+namespace xot {
+
+// ------------------------------------------------------------- thread pool
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n_threads) {
+    if (n_threads <= 0) n_threads = 1;
+    for (int i = 0; i < n_threads; ++i) {
+      workers_.emplace_back([this] { worker(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  // Blocking parallel for over [0, n) in contiguous chunks.
+  void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& fn) {
+    int64_t n_workers = static_cast<int64_t>(workers_.size());
+    if (n <= 1 || n_workers <= 1) {
+      fn(0, n);
+      return;
+    }
+    int64_t chunks = std::min(n, n_workers);
+    int64_t chunk = (n + chunks - 1) / chunks;
+    std::atomic<int64_t> remaining{chunks};
+    std::mutex done_mu;
+    std::condition_variable done_cv;
+    for (int64_t c = 0; c < chunks; ++c) {
+      int64_t begin = c * chunk, end = std::min(n, begin + chunk);
+      enqueue([&, begin, end] {
+        fn(begin, end);
+        if (remaining.fetch_sub(1) == 1) {
+          std::lock_guard<std::mutex> lk(done_mu);
+          done_cv.notify_one();
+        }
+      });
+    }
+    std::unique_lock<std::mutex> lk(done_mu);
+    done_cv.wait(lk, [&] { return remaining.load() == 0; });
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+ private:
+  void enqueue(std::function<void()> task) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tasks_.push_back(std::move(task));
+    }
+    cv_.notify_one();
+  }
+
+  void worker() {
+    while (true) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        task = std::move(tasks_.front());
+        tasks_.erase(tasks_.begin());
+      }
+      task();
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::vector<std::function<void()>> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+// ------------------------------------------------------------------ config
+
+struct ModelConfig {
+  std::string family = "llama";  // llama | mistral | qwen2 | qwen3
+  int64_t vocab_size = 32000;
+  int64_t hidden_size = 4096;
+  int64_t num_layers = 32;
+  int64_t num_heads = 32;
+  int64_t num_kv_heads = 32;
+  int64_t head_dim = 128;
+  int64_t intermediate_size = 11008;
+  float rms_norm_eps = 1e-5f;
+  float rope_theta = 10000.0f;
+  bool rope_llama3 = false;
+  float rope_factor = 32.0f;
+  float rope_low_freq_factor = 1.0f;
+  float rope_high_freq_factor = 4.0f;
+  int64_t rope_original_max_pos = 8192;
+  int64_t max_seq_len = 8192;
+  bool tie_word_embeddings = false;
+  bool attention_bias = false;
+  bool qk_norm = false;
+
+  static ModelConfig from_file(const std::string& path) {
+    std::ifstream in(path);
+    if (!in) throw std::runtime_error("config: cannot open " + path);
+    std::stringstream ss;
+    ss << in.rdbuf();
+    JsonPtr root = JsonParser::parse(ss.str());
+    // Multimodal configs nest the decoder under text_config (config.py:59-63).
+    JsonPtr j = root->has("text_config") ? root->at("text_config") : root;
+
+    ModelConfig c;
+    std::string model_type = j->str("model_type", "llama");
+    if (model_type == "mistral") c.family = "mistral";
+    else if (model_type == "qwen2") c.family = "qwen2";
+    else if (model_type == "qwen3" || model_type == "qwen3_moe") c.family = "qwen3";
+    else c.family = "llama";
+
+    c.num_heads = j->integer("num_attention_heads", 32);
+    c.hidden_size = j->integer("hidden_size", 4096);
+    c.head_dim = j->integer("head_dim", c.hidden_size / c.num_heads);
+    if (c.head_dim == 0) c.head_dim = c.hidden_size / c.num_heads;
+    c.vocab_size = j->integer("vocab_size", 32000);
+    c.num_layers = j->integer("num_hidden_layers", 32);
+    c.num_kv_heads = j->integer("num_key_value_heads", c.num_heads);
+    c.intermediate_size = j->integer("intermediate_size", 11008);
+    c.rms_norm_eps = static_cast<float>(j->num("rms_norm_eps", 1e-5));
+    c.rope_theta = static_cast<float>(j->num("rope_theta", 10000.0));
+    c.max_seq_len = j->integer("max_position_embeddings", 8192);
+    c.tie_word_embeddings = j->boolean("tie_word_embeddings", false);
+    c.attention_bias = j->boolean("attention_bias", model_type == "qwen2");
+    c.qk_norm = (c.family == "qwen3");
+    if (j->has("rope_scaling") && j->at("rope_scaling")->is_object()) {
+      auto rs = j->at("rope_scaling");
+      std::string rt = rs->str("rope_type", rs->str("type", ""));
+      if (rt == "llama3") {
+        c.rope_llama3 = true;
+        c.rope_factor = static_cast<float>(rs->num("factor", 32.0));
+        c.rope_low_freq_factor = static_cast<float>(rs->num("low_freq_factor", 1.0));
+        c.rope_high_freq_factor = static_cast<float>(rs->num("high_freq_factor", 4.0));
+        c.rope_original_max_pos = rs->integer("original_max_position_embeddings", 8192);
+      }
+    }
+    return c;
+  }
+};
+
+// ----------------------------------------------------------------- weights
+
+struct LayerWeights {
+  // Linears kept in HF [out, in] row-major: GEMV walks rows contiguously.
+  std::vector<float> wq, wk, wv, wo;          // [out, hidden]
+  std::vector<float> bq, bk, bv;              // optional qwen2 bias
+  std::vector<float> attn_norm, mlp_norm;     // [hidden]
+  std::vector<float> q_norm, k_norm;          // optional qwen3 [head_dim]
+  std::vector<float> w_gate, w_up, w_down;    // SwiGLU
+};
+
+struct ShardWeights {
+  std::vector<LayerWeights> layers;
+  std::vector<float> embed;       // [vocab, hidden] (first shard, or tied last)
+  std::vector<float> final_norm;  // [hidden] (last shard)
+  std::vector<float> lm_head;     // [vocab, hidden] (last shard; = embed if tied)
+  bool has_embed = false, has_head = false;
+};
+
+// -------------------------------------------------------------- kv session
+
+struct Session {
+  // cache[l] is [max_len, n_kv*head_dim] for k and v.
+  std::vector<std::vector<float>> k, v;
+  int64_t pos = 0;
+  int64_t last_used_ns = 0;
+};
+
+// ------------------------------------------------------------------- model
+
+class ShardModel {
+ public:
+  ShardModel(const std::string& model_dir, int64_t start_layer, int64_t end_layer,
+             int64_t cache_len, ThreadPool* pool)
+      : cfg_(ModelConfig::from_file(model_dir + "/config.json")),
+        start_layer_(start_layer),
+        end_layer_(end_layer),
+        pool_(pool) {
+    cache_len_ = std::min(cache_len, cfg_.max_seq_len);
+    is_first_ = start_layer_ == 0;
+    is_last_ = end_layer_ == cfg_.num_layers - 1;
+    load_weights(model_dir);
+  }
+
+  const ModelConfig& config() const { return cfg_; }
+  bool is_first() const { return is_first_; }
+  bool is_last() const { return is_last_; }
+  int64_t cache_len() const { return cache_len_; }
+  int64_t n_layers() const { return end_layer_ - start_layer_ + 1; }
+
+  Session new_session() const {
+    Session s;
+    int64_t kv_dim = cfg_.num_kv_heads * cfg_.head_dim;
+    s.k.resize(n_layers());
+    s.v.resize(n_layers());
+    for (int64_t l = 0; l < n_layers(); ++l) {
+      s.k[l].assign(static_cast<size_t>(cache_len_ * kv_dim), 0.0f);
+      s.v[l].assign(static_cast<size_t>(cache_len_ * kv_dim), 0.0f);
+    }
+    return s;
+  }
+
+  // tokens path (first shard): [T] ids -> hidden or logits [T, out_dim]
+  // hidden path (mid/last shard): [T, hidden] -> hidden or logits.
+  // Returns [T, hidden] (not last) or [T, vocab] (last).
+  std::vector<float> forward_tokens(Session& s, const std::vector<int32_t>& tokens) {
+    int64_t T = static_cast<int64_t>(tokens.size());
+    std::vector<float> x(static_cast<size_t>(T * cfg_.hidden_size));
+    for (int64_t t = 0; t < T; ++t) {
+      int64_t id = tokens[static_cast<size_t>(t)];
+      if (id < 0 || id >= cfg_.vocab_size) throw std::runtime_error("token id out of range");
+      std::memcpy(&x[t * cfg_.hidden_size], &w_.embed[id * cfg_.hidden_size], cfg_.hidden_size * 4);
+    }
+    return forward_hidden(s, x, T);
+  }
+
+  std::vector<float> forward_hidden(Session& s, std::vector<float> x, int64_t T) {
+    if (s.pos + T > cache_len_)
+      throw std::runtime_error("kv cache overflow: pos " + std::to_string(s.pos) + " + " + std::to_string(T) + " > " + std::to_string(cache_len_));
+    for (int64_t l = 0; l < n_layers(); ++l) layer_forward(s, l, x, T);
+    s.pos += T;
+    if (!is_last_) return x;
+
+    // Final norm + LM head.
+    int64_t H = cfg_.hidden_size, V = cfg_.vocab_size;
+    std::vector<float> normed = x;
+    for (int64_t t = 0; t < T; ++t) rmsnorm(&normed[t * H], w_.final_norm.data(), H);
+    std::vector<float> logits(static_cast<size_t>(T * V));
+    const std::vector<float>& head = w_.has_head ? w_.lm_head : w_.embed;
+    for (int64_t t = 0; t < T; ++t)
+      gemv(head.data(), &normed[t * H], &logits[t * V], V, H, nullptr);
+    return logits;
+  }
+
+ private:
+  // y[o] = w[o,:] . x  (+bias), threaded over output rows.
+  void gemv(const float* w, const float* x, float* y, int64_t out_dim, int64_t in_dim,
+            const float* bias) {
+    pool_->parallel_for(out_dim, [&](int64_t begin, int64_t end) {
+      for (int64_t o = begin; o < end; ++o) {
+        const float* row = w + o * in_dim;
+        float acc = 0.0f;
+        for (int64_t i = 0; i < in_dim; ++i) acc += row[i] * x[i];
+        y[o] = bias ? acc + bias[o] : acc;
+      }
+    });
+  }
+
+  void rmsnorm(float* x, const float* weight, int64_t n) const {
+    float ss = 0.0f;
+    for (int64_t i = 0; i < n; ++i) ss += x[i] * x[i];
+    float inv = 1.0f / std::sqrt(ss / static_cast<float>(n) + cfg_.rms_norm_eps);
+    for (int64_t i = 0; i < n; ++i) x[i] = x[i] * inv * weight[i];
+  }
+
+  // HF rotate-half RoPE with optional llama3 scaling (ops/rope.py parity).
+  float scaled_inv_freq(int64_t i) const {
+    int64_t D = cfg_.head_dim;
+    float inv_freq = std::pow(cfg_.rope_theta, -2.0f * static_cast<float>(i) / static_cast<float>(D));
+    if (!cfg_.rope_llama3) return inv_freq;
+    const float two_pi = 6.283185307179586f;
+    float wavelen = two_pi / inv_freq;
+    float low_wavelen = static_cast<float>(cfg_.rope_original_max_pos) / cfg_.rope_low_freq_factor;
+    float high_wavelen = static_cast<float>(cfg_.rope_original_max_pos) / cfg_.rope_high_freq_factor;
+    if (wavelen > low_wavelen) return inv_freq / cfg_.rope_factor;
+    if (wavelen < high_wavelen) return inv_freq;
+    float smooth = (static_cast<float>(cfg_.rope_original_max_pos) / wavelen - cfg_.rope_low_freq_factor) /
+                   (cfg_.rope_high_freq_factor - cfg_.rope_low_freq_factor);
+    return (1.0f - smooth) * inv_freq / cfg_.rope_factor + smooth * inv_freq;
+  }
+
+  void rope(float* vec, int64_t pos) const {
+    int64_t D = cfg_.head_dim, half = D / 2;
+    for (int64_t i = 0; i < half; ++i) {
+      float angle = static_cast<float>(pos) * scaled_inv_freq(i);
+      float c = std::cos(angle), sn = std::sin(angle);
+      float a = vec[i], b = vec[i + half];
+      vec[i] = a * c - b * sn;
+      vec[i + half] = b * c + a * sn;
+    }
+  }
+
+  void layer_forward(Session& s, int64_t l, std::vector<float>& x, int64_t T) {
+    const LayerWeights& lw = w_.layers[static_cast<size_t>(l)];
+    int64_t H = cfg_.hidden_size, D = cfg_.head_dim;
+    int64_t NH = cfg_.num_heads, NKV = cfg_.num_kv_heads;
+    int64_t q_dim = NH * D, kv_dim = NKV * D;
+    int64_t group = NH / NKV;
+    float scale = 1.0f / std::sqrt(static_cast<float>(D));
+
+    std::vector<float> q(static_cast<size_t>(T * q_dim));
+    std::vector<float> attn_out(static_cast<size_t>(T * q_dim));
+
+    for (int64_t t = 0; t < T; ++t) {
+      int64_t pos = s.pos + t;
+      std::vector<float> normed(static_cast<size_t>(H));
+      std::memcpy(normed.data(), &x[t * H], H * 4);
+      rmsnorm(normed.data(), lw.attn_norm.data(), H);
+
+      float* qt = &q[t * q_dim];
+      float* kt = &s.k[l][pos * kv_dim];
+      float* vt = &s.v[l][pos * kv_dim];
+      gemv(lw.wq.data(), normed.data(), qt, q_dim, H, lw.bq.empty() ? nullptr : lw.bq.data());
+      gemv(lw.wk.data(), normed.data(), kt, kv_dim, H, lw.bk.empty() ? nullptr : lw.bk.data());
+      gemv(lw.wv.data(), normed.data(), vt, kv_dim, H, lw.bv.empty() ? nullptr : lw.bv.data());
+
+      for (int64_t h = 0; h < NH; ++h) {
+        if (cfg_.qk_norm) rmsnorm(qt + h * D, lw.q_norm.data(), D);
+        rope(qt + h * D, pos);
+      }
+      for (int64_t h = 0; h < NKV; ++h) {
+        if (cfg_.qk_norm) rmsnorm(kt + h * D, lw.k_norm.data(), D);
+        rope(kt + h * D, pos);
+      }
+    }
+
+    // Causal attention against the resident cache, threaded over heads.
+    pool_->parallel_for(NH, [&](int64_t h_begin, int64_t h_end) {
+      std::vector<float> scores;
+      for (int64_t h = h_begin; h < h_end; ++h) {
+        int64_t kvh = h / group;
+        for (int64_t t = 0; t < T; ++t) {
+          int64_t n_keys = s.pos + t + 1;
+          scores.resize(static_cast<size_t>(n_keys));
+          const float* qh = &q[t * q_dim + h * D];
+          float max_s = -1e30f;
+          for (int64_t j = 0; j < n_keys; ++j) {
+            const float* kh = &s.k[l][j * kv_dim + kvh * D];
+            float acc = 0.0f;
+            for (int64_t d = 0; d < D; ++d) acc += qh[d] * kh[d];
+            scores[j] = acc * scale;
+            if (scores[j] > max_s) max_s = scores[j];
+          }
+          float denom = 0.0f;
+          for (int64_t j = 0; j < n_keys; ++j) {
+            scores[j] = std::exp(scores[j] - max_s);
+            denom += scores[j];
+          }
+          float* out = &attn_out[t * q_dim + h * D];
+          std::fill(out, out + D, 0.0f);
+          float inv_denom = 1.0f / denom;
+          for (int64_t j = 0; j < n_keys; ++j) {
+            const float* vh = &s.v[l][j * kv_dim + kvh * D];
+            float wgt = scores[j] * inv_denom;
+            for (int64_t d = 0; d < D; ++d) out[d] += wgt * vh[d];
+          }
+        }
+      }
+    });
+
+    // o-proj + residual, then SwiGLU MLP + residual.
+    int64_t I = cfg_.intermediate_size;
+    std::vector<float> proj(static_cast<size_t>(H));
+    std::vector<float> gate(static_cast<size_t>(I)), up(static_cast<size_t>(I));
+    for (int64_t t = 0; t < T; ++t) {
+      gemv(lw.wo.data(), &attn_out[t * q_dim], proj.data(), H, q_dim, nullptr);
+      for (int64_t i = 0; i < H; ++i) x[t * H + i] += proj[i];
+
+      std::vector<float> normed(static_cast<size_t>(H));
+      std::memcpy(normed.data(), &x[t * H], H * 4);
+      rmsnorm(normed.data(), lw.mlp_norm.data(), H);
+      gemv(lw.w_gate.data(), normed.data(), gate.data(), I, H, nullptr);
+      gemv(lw.w_up.data(), normed.data(), up.data(), I, H, nullptr);
+      for (int64_t i = 0; i < I; ++i) {
+        float g = gate[i];
+        gate[i] = (g / (1.0f + std::exp(-g))) * up[i];  // silu(g) * up
+      }
+      gemv(lw.w_down.data(), gate.data(), proj.data(), H, I, nullptr);
+      for (int64_t i = 0; i < H; ++i) x[t * H + i] += proj[i];
+    }
+  }
+
+  void load_weights(const std::string& model_dir) {
+    CheckpointDir ckpt(model_dir);
+    // HF checkpoints prefix decoder tensors with "model." (weights.py:110-117).
+    auto resolve = [&](const std::string& name) -> std::string {
+      for (const char* prefix : {"", "model.", "language_model.model.", "language_model."}) {
+        std::string full = std::string(prefix) + name;
+        if (ckpt.has(full)) return full;
+      }
+      throw std::runtime_error("checkpoint: tensor not found under any prefix: " + name);
+    };
+    auto load = [&](const std::string& name) { return SafetensorsFile::to_f32(ckpt.at(resolve(name))); };
+    auto maybe_load = [&](const std::string& name, std::vector<float>& dst) {
+      for (const char* prefix : {"", "model.", "language_model.model.", "language_model."}) {
+        std::string full = std::string(prefix) + name;
+        if (ckpt.has(full)) {
+          dst = SafetensorsFile::to_f32(ckpt.at(full));
+          return true;
+        }
+      }
+      return false;
+    };
+
+    w_.layers.resize(static_cast<size_t>(n_layers()));
+    for (int64_t li = start_layer_; li <= end_layer_; ++li) {
+      LayerWeights& lw = w_.layers[static_cast<size_t>(li - start_layer_)];
+      std::string p = "layers." + std::to_string(li) + ".";
+      lw.attn_norm = load(p + "input_layernorm.weight");
+      lw.mlp_norm = load(p + "post_attention_layernorm.weight");
+      lw.wq = load(p + "self_attn.q_proj.weight");
+      lw.wk = load(p + "self_attn.k_proj.weight");
+      lw.wv = load(p + "self_attn.v_proj.weight");
+      lw.wo = load(p + "self_attn.o_proj.weight");
+      if (cfg_.attention_bias) {
+        maybe_load(p + "self_attn.q_proj.bias", lw.bq);
+        maybe_load(p + "self_attn.k_proj.bias", lw.bk);
+        maybe_load(p + "self_attn.v_proj.bias", lw.bv);
+      }
+      if (cfg_.qk_norm) {
+        maybe_load(p + "self_attn.q_norm.weight", lw.q_norm);
+        maybe_load(p + "self_attn.k_norm.weight", lw.k_norm);
+      }
+      lw.w_gate = load(p + "mlp.gate_proj.weight");
+      lw.w_up = load(p + "mlp.up_proj.weight");
+      lw.w_down = load(p + "mlp.down_proj.weight");
+    }
+    if (is_first_ || (cfg_.tie_word_embeddings && is_last_)) {
+      w_.has_embed = maybe_load("embed_tokens.weight", w_.embed);
+      if (!w_.has_embed) throw std::runtime_error("checkpoint: embed_tokens.weight missing");
+    }
+    if (is_last_) {
+      w_.final_norm = load("norm.weight");
+      if (!cfg_.tie_word_embeddings) {
+        w_.has_head = maybe_load("lm_head.weight", w_.lm_head);
+        if (!w_.has_head && !w_.has_embed)
+          throw std::runtime_error("checkpoint: neither lm_head nor tied embeddings present");
+      }
+    }
+  }
+
+  ModelConfig cfg_;
+  int64_t start_layer_, end_layer_;
+  int64_t cache_len_;
+  bool is_first_ = false, is_last_ = false;
+  ShardWeights w_;
+  ThreadPool* pool_;
+};
+
+}  // namespace xot

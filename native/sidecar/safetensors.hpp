@@ -1,0 +1,206 @@
+// mmap-based safetensors reader.
+//
+// Format: 8-byte little-endian u64 header length, JSON header mapping tensor
+// name -> {dtype, shape, data_offsets:[begin,end]} (offsets relative to the
+// byte after the header), then the raw data region. Zero-copy: tensors are
+// served as pointers into the mapping; dtype conversion happens at the
+// consumer (model load), mirroring the Python side's one-pass-per-file read
+// (xotorch_tpu/models/weights.py).
+#pragma once
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+
+namespace xot {
+
+struct TensorView {
+  std::string dtype;  // "F32" | "BF16" | "F16" | "I64" | ...
+  std::vector<int64_t> shape;
+  const uint8_t* data = nullptr;
+  size_t nbytes = 0;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+};
+
+inline float bf16_to_f32(uint16_t v) {
+  // Same <<16 widening the reference's client used on the wire
+  // (cheetah/sharded_inference_engine.py:436-439).
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, sizeof(bits));
+  // Round-to-nearest-even, matching XLA's convert semantics.
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal: renormalize
+      int shift = 0;
+      while (!(mant & 0x400)) { mant <<= 1; ++shift; }
+      mant &= 0x3FF;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+class SafetensorsFile {
+ public:
+  explicit SafetensorsFile(const std::string& path) : path_(path) {
+    fd_ = ::open(path.c_str(), O_RDONLY);
+    if (fd_ < 0) throw std::runtime_error("safetensors: cannot open " + path);
+    struct stat st;
+    if (fstat(fd_, &st) != 0) throw std::runtime_error("safetensors: fstat failed for " + path);
+    size_ = static_cast<size_t>(st.st_size);
+    base_ = static_cast<const uint8_t*>(mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd_, 0));
+    if (base_ == MAP_FAILED) throw std::runtime_error("safetensors: mmap failed for " + path);
+
+    uint64_t header_len = 0;
+    std::memcpy(&header_len, base_, 8);  // little-endian per spec; x86/arm LE hosts
+    if (8 + header_len > size_) throw std::runtime_error("safetensors: truncated header in " + path);
+    std::string header(reinterpret_cast<const char*>(base_ + 8), header_len);
+    JsonPtr j = JsonParser::parse(header);
+    const uint8_t* data_region = base_ + 8 + header_len;
+    for (auto& kv : j->obj_v) {
+      if (kv.first == "__metadata__") continue;
+      TensorView t;
+      t.dtype = kv.second->str("dtype", "F32");
+      for (auto& d : kv.second->at("shape")->arr_v) t.shape.push_back(static_cast<int64_t>(d->num_v));
+      auto offs = kv.second->at("data_offsets");
+      size_t begin = static_cast<size_t>(offs->arr_v[0]->num_v);
+      size_t end = static_cast<size_t>(offs->arr_v[1]->num_v);
+      t.data = data_region + begin;
+      t.nbytes = end - begin;
+      tensors_[kv.first] = t;
+    }
+  }
+
+  ~SafetensorsFile() {
+    if (base_ && base_ != MAP_FAILED) munmap(const_cast<uint8_t*>(base_), size_);
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  SafetensorsFile(const SafetensorsFile&) = delete;
+  SafetensorsFile& operator=(const SafetensorsFile&) = delete;
+
+  bool has(const std::string& name) const { return tensors_.count(name) > 0; }
+  const TensorView& at(const std::string& name) const {
+    auto it = tensors_.find(name);
+    if (it == tensors_.end()) throw std::runtime_error("safetensors: no tensor " + name + " in " + path_);
+    return it->second;
+  }
+  const std::map<std::string, TensorView>& tensors() const { return tensors_; }
+
+  // Convert any supported dtype to a contiguous f32 buffer.
+  static std::vector<float> to_f32(const TensorView& t) {
+    int64_t n = t.numel();
+    std::vector<float> out(static_cast<size_t>(n));
+    if (t.dtype == "F32") {
+      std::memcpy(out.data(), t.data, n * 4);
+    } else if (t.dtype == "BF16") {
+      const uint16_t* src = reinterpret_cast<const uint16_t*>(t.data);
+      for (int64_t i = 0; i < n; ++i) out[i] = bf16_to_f32(src[i]);
+    } else if (t.dtype == "F16") {
+      const uint16_t* src = reinterpret_cast<const uint16_t*>(t.data);
+      for (int64_t i = 0; i < n; ++i) out[i] = f16_to_f32(src[i]);
+    } else if (t.dtype == "F64") {
+      const double* src = reinterpret_cast<const double*>(t.data);
+      for (int64_t i = 0; i < n; ++i) out[i] = static_cast<float>(src[i]);
+    } else {
+      throw std::runtime_error("safetensors: unsupported dtype " + t.dtype);
+    }
+    return out;
+  }
+
+ private:
+  std::string path_;
+  int fd_ = -1;
+  size_t size_ = 0;
+  const uint8_t* base_ = nullptr;
+  std::map<std::string, TensorView> tensors_;
+};
+
+// A model directory: resolves tensor name -> file via model.safetensors.index.json
+// (sharded checkpoints) or a single model.safetensors, like weights.py:_index_for.
+class CheckpointDir {
+ public:
+  explicit CheckpointDir(const std::string& dir) : dir_(dir) {
+    std::string index_path = dir + "/model.safetensors.index.json";
+    if (FILE* f = fopen(index_path.c_str(), "rb")) {
+      std::string text = read_all(f);
+      fclose(f);
+      JsonPtr j = JsonParser::parse(text);
+      for (auto& kv : j->at("weight_map")->obj_v) name_to_file_[kv.first] = kv.second->str_v;
+    } else {
+      std::string single = dir + "/model.safetensors";
+      auto file = std::make_shared<SafetensorsFile>(single);
+      files_["model.safetensors"] = file;
+      for (auto& kv : file->tensors()) name_to_file_[kv.first] = "model.safetensors";
+    }
+  }
+
+  bool has(const std::string& name) const { return name_to_file_.count(name) > 0; }
+
+  const TensorView& at(const std::string& name) {
+    auto it = name_to_file_.find(name);
+    if (it == name_to_file_.end()) throw std::runtime_error("checkpoint: no tensor " + name);
+    auto& file = files_[it->second];
+    if (!file) file = std::make_shared<SafetensorsFile>(dir_ + "/" + it->second);
+    return file->at(name);
+  }
+
+  std::vector<std::string> names() const {
+    std::vector<std::string> out;
+    out.reserve(name_to_file_.size());
+    for (auto& kv : name_to_file_) out.push_back(kv.first);
+    return out;
+  }
+
+ private:
+  static std::string read_all(FILE* f) {
+    std::string out;
+    char buf[65536];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+    return out;
+  }
+
+  std::string dir_;
+  std::map<std::string, std::string> name_to_file_;
+  std::map<std::string, std::shared_ptr<SafetensorsFile>> files_;
+};
+
+}  // namespace xot

@@ -1,0 +1,311 @@
+// xot-sidecar — the native (C++) out-of-process inference service.
+//
+// Fills the reference's "cheetah" slot (SURVEY §2.6.3): a Unix-domain-socket
+// service speaking the same length-prefixed framing the reference's client
+// used (cheetah/sharded_inference_engine.py:331-457) —
+//
+//   request:  4-byte BIG-ENDIAN header length ("!I") | UTF-8 JSON header |
+//             raw concatenated tensor payload
+//   response: identical framing
+//
+// — but with the service itself in-repo and the wire made bf16-clean: hidden
+// states cross the socket as bf16 (uint16), not the reference's fp32 upcast
+// (sharded_inference_engine.py:352). The KV cache stays resident per
+// (session_id); each call carries only the new tokens or the incoming hidden
+// segment. Commands:
+//
+//   {"cmd":"ping"}                                     -> {"status":"ok", ...}
+//   {"cmd":"load","model_path":...,"layer_start":N,
+//    "layer_end":N,"layer_total":N,"cache_len":N}      -> model + shard info
+//   {"cmd":"infer","session_id":...,"input":
+//    {"shape":[..],"dtype":"int32"|"float32"|"bfloat16"}} + payload
+//                                                      -> output tensor
+//   {"cmd":"reset","session_id":...}                   -> drop a session
+//   {"cmd":"quit"}                                     -> shut down
+//
+// Build: `make -C native` (g++ -O3 -pthread, no external deps).
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "model.hpp"
+
+namespace xot {
+
+static int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static bool read_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool write_exact(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Server {
+ public:
+  Server(std::string socket_path, int n_threads, int max_sessions)
+      : socket_path_(std::move(socket_path)),
+        pool_(n_threads),
+        max_sessions_(max_sessions) {}
+
+  int run() {
+    ::unlink(socket_path_.c_str());
+    listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) {
+      perror("socket");
+      return 1;
+    }
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    std::strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      perror("bind");
+      return 1;
+    }
+    if (::listen(listen_fd_, 16) != 0) {
+      perror("listen");
+      return 1;
+    }
+    fprintf(stderr, "xot-sidecar: listening on %s (%d compute threads)\n",
+            socket_path_.c_str(), pool_.size());
+    fflush(stderr);
+
+    while (!quit_) {
+      int client = ::accept(listen_fd_, nullptr, nullptr);
+      if (client < 0) {
+        if (quit_) break;
+        continue;
+      }
+      serve_client(client);
+      ::close(client);
+    }
+    ::close(listen_fd_);
+    ::unlink(socket_path_.c_str());
+    return 0;
+  }
+
+ private:
+  void serve_client(int fd) {
+    while (!quit_) {
+      uint32_t be_len = 0;
+      if (!read_exact(fd, &be_len, 4)) return;
+      uint32_t header_len = ntohl(be_len);
+      if (header_len > (1u << 26)) return;  // 64 MB header cap
+      std::string header(header_len, '\0');
+      if (!read_exact(fd, header.data(), header_len)) return;
+
+      JsonPtr req;
+      try {
+        req = JsonParser::parse(header);
+      } catch (const std::exception& e) {
+        send_error(fd, std::string("bad header: ") + e.what());
+        return;
+      }
+
+      std::string cmd = req->str("cmd", "");
+      std::vector<uint8_t> payload;
+      if (req->has("input")) {
+        size_t nbytes = static_cast<size_t>(req->at("input")->integer("nbytes", 0));
+        payload.resize(nbytes);
+        if (nbytes > 0 && !read_exact(fd, payload.data(), nbytes)) return;
+      }
+
+      try {
+        if (cmd == "ping") {
+          auto resp = Json::make(Json::Type::Object);
+          resp->set("status", Json::of(std::string("ok")));
+          resp->set("loaded", Json::of(model_ != nullptr));
+          send_response(fd, resp, nullptr, 0);
+        } else if (cmd == "load") {
+          handle_load(fd, req);
+        } else if (cmd == "infer") {
+          handle_infer(fd, req, payload);
+        } else if (cmd == "reset") {
+          sessions_.erase(req->str("session_id", ""));
+          auto resp = Json::make(Json::Type::Object);
+          resp->set("status", Json::of(std::string("ok")));
+          send_response(fd, resp, nullptr, 0);
+        } else if (cmd == "quit") {
+          auto resp = Json::make(Json::Type::Object);
+          resp->set("status", Json::of(std::string("ok")));
+          send_response(fd, resp, nullptr, 0);
+          quit_ = true;
+          return;
+        } else {
+          send_error(fd, "unknown cmd: " + cmd);
+        }
+      } catch (const std::exception& e) {
+        send_error(fd, e.what());
+      }
+    }
+  }
+
+  void handle_load(int fd, const JsonPtr& req) {
+    std::string model_path = req->str("model_path", "");
+    int64_t start = req->integer("layer_start", 0);
+    int64_t end = req->integer("layer_end", 0);
+    int64_t cache_len = req->integer("cache_len", 2048);
+    int64_t t0 = now_ns();
+    model_ = std::make_unique<ShardModel>(model_path, start, end, cache_len, &pool_);
+    sessions_.clear();
+    auto resp = Json::make(Json::Type::Object);
+    resp->set("status", Json::of(std::string("ok")));
+    resp->set("family", Json::of(model_->config().family));
+    resp->set("vocab_size", Json::of(model_->config().vocab_size));
+    resp->set("hidden_size", Json::of(model_->config().hidden_size));
+    resp->set("is_first", Json::of(model_->is_first()));
+    resp->set("is_last", Json::of(model_->is_last()));
+    resp->set("cache_len", Json::of(model_->cache_len()));
+    resp->set("load_ns", Json::of(now_ns() - t0));
+    send_response(fd, resp, nullptr, 0);
+  }
+
+  void handle_infer(int fd, const JsonPtr& req, const std::vector<uint8_t>& payload) {
+    if (!model_) throw std::runtime_error("no model loaded");
+    std::string session_id = req->str("session_id", "default");
+    auto input = req->at("input");
+    std::string dtype = input->str("dtype", "float32");
+    std::vector<int64_t> shape;
+    for (auto& d : input->at("shape")->arr_v) shape.push_back(static_cast<int64_t>(d->num_v));
+
+    auto it = sessions_.find(session_id);
+    if (it == sessions_.end()) {
+      if (static_cast<int>(sessions_.size()) >= max_sessions_) evict_lru();
+      it = sessions_.emplace(session_id, model_->new_session()).first;
+    }
+    Session& sess = it->second;
+    sess.last_used_ns = now_ns();
+
+    int64_t t0 = now_ns();
+    std::vector<float> out;
+    int64_t T;
+    if (dtype == "int32") {
+      // [B=1, T] token ids — first-shard path (2-D dispatch parity:
+      // sharded_inference_engine.py:254-263).
+      if (shape.size() != 2 || shape[0] != 1) throw std::runtime_error("expected token shape [1, T]");
+      T = shape[1];
+      std::vector<int32_t> tokens(static_cast<size_t>(T));
+      std::memcpy(tokens.data(), payload.data(), static_cast<size_t>(T) * 4);
+      out = model_->forward_tokens(sess, tokens);
+    } else {
+      // [B=1, T, H] hidden state from the previous ring partition.
+      if (shape.size() != 3 || shape[0] != 1) throw std::runtime_error("expected hidden shape [1, T, H]");
+      T = shape[1];
+      int64_t H = shape[2];
+      if (H != model_->config().hidden_size) throw std::runtime_error("hidden dim mismatch");
+      std::vector<float> x(static_cast<size_t>(T * H));
+      if (dtype == "float32") {
+        std::memcpy(x.data(), payload.data(), x.size() * 4);
+      } else if (dtype == "bfloat16") {
+        const uint16_t* src = reinterpret_cast<const uint16_t*>(payload.data());
+        for (size_t i = 0; i < x.size(); ++i) x[i] = bf16_to_f32(src[i]);
+      } else {
+        throw std::runtime_error("unsupported input dtype " + dtype);
+      }
+      out = model_->forward_hidden(sess, std::move(x), T);
+    }
+
+    int64_t out_dim = model_->is_last() ? model_->config().vocab_size : model_->config().hidden_size;
+    auto resp = Json::make(Json::Type::Object);
+    resp->set("status", Json::of(std::string("ok")));
+    resp->set("pos", Json::of(sess.pos));
+    resp->set("elapsed_ns", Json::of(now_ns() - t0));
+    auto out_meta = Json::make(Json::Type::Object);
+    auto out_shape = Json::make(Json::Type::Array);
+    out_shape->arr_v = {Json::of(static_cast<int64_t>(1)), Json::of(T), Json::of(out_dim)};
+    out_meta->set("shape", out_shape);
+
+    if (model_->is_last()) {
+      // Logits go back fp32 (sampling wants full precision).
+      out_meta->set("dtype", Json::of(std::string("float32")));
+      out_meta->set("nbytes", Json::of(static_cast<int64_t>(out.size() * 4)));
+      resp->set("output", out_meta);
+      send_response(fd, resp, out.data(), out.size() * 4);
+    } else {
+      // Hidden states go back bf16 — the wire stays bf16-clean end to end.
+      std::vector<uint16_t> bf(out.size());
+      for (size_t i = 0; i < out.size(); ++i) bf[i] = f32_to_bf16(out[i]);
+      out_meta->set("dtype", Json::of(std::string("bfloat16")));
+      out_meta->set("nbytes", Json::of(static_cast<int64_t>(bf.size() * 2)));
+      resp->set("output", out_meta);
+      send_response(fd, resp, bf.data(), bf.size() * 2);
+    }
+  }
+
+  void evict_lru() {
+    auto victim = sessions_.begin();
+    for (auto it = sessions_.begin(); it != sessions_.end(); ++it)
+      if (it->second.last_used_ns < victim->second.last_used_ns) victim = it;
+    if (victim != sessions_.end()) sessions_.erase(victim);
+  }
+
+  void send_response(int fd, const JsonPtr& resp, const void* payload, size_t payload_bytes) {
+    std::string header = resp->dump();
+    uint32_t be_len = htonl(static_cast<uint32_t>(header.size()));
+    write_exact(fd, &be_len, 4);
+    write_exact(fd, header.data(), header.size());
+    if (payload_bytes > 0) write_exact(fd, payload, payload_bytes);
+  }
+
+  void send_error(int fd, const std::string& message) {
+    auto resp = Json::make(Json::Type::Object);
+    resp->set("status", Json::of(std::string("error")));
+    resp->set("error", Json::of(message));
+    send_response(fd, resp, nullptr, 0);
+  }
+
+  std::string socket_path_;
+  ThreadPool pool_;
+  int max_sessions_;
+  int listen_fd_ = -1;
+  bool quit_ = false;
+  std::unique_ptr<ShardModel> model_;
+  std::map<std::string, Session> sessions_;
+};
+
+}  // namespace xot
+
+int main(int argc, char** argv) {
+  std::string socket_path = "/tmp/xot_sidecar.sock";
+  int n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  int max_sessions = 8;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "--socket" && i + 1 < argc) socket_path = argv[++i];
+    else if (arg == "--threads" && i + 1 < argc) n_threads = std::atoi(argv[++i]);
+    else if (arg == "--max-sessions" && i + 1 < argc) max_sessions = std::atoi(argv[++i]);
+    else if (arg == "--help") {
+      printf("usage: xot-sidecar [--socket PATH] [--threads N] [--max-sessions N]\n");
+      return 0;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);  // client disconnects must not kill the service
+  xot::Server server(socket_path, n_threads, max_sessions);
+  return server.run();
+}

@@ -50,9 +50,9 @@ def test_flash_bfloat16():
   with jax.default_matmul_precision("highest"):
     q, k, v = _inputs(1, 64, 4, 4, 64, dtype=jnp.bfloat16, seed=3)
     ref = _baseline(q, k, v).astype(jnp.float32)
-    out = flash_attention(q, k, v).astype(jnp.float32)
-    assert out.dtype == jnp.float32
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2)
+    raw = flash_attention(q, k, v)
+    assert raw.dtype == jnp.bfloat16  # kernel returns q.dtype
+    np.testing.assert_allclose(np.asarray(raw.astype(jnp.float32)), np.asarray(ref), atol=2e-2, rtol=2e-2)
 
 
 def test_flash_causality():

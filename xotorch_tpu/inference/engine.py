@@ -92,6 +92,10 @@ inference_engine_classes: Dict[str, str] = {
   "JAXShardInferenceEngine": "JAXShardInferenceEngine",
   "dummy": "DummyInferenceEngine",
   "DummyInferenceEngine": "DummyInferenceEngine",
+  # The native C++ sidecar (the reference's "cheetah" slot, SURVEY §2.6.3).
+  "native": "NativeSidecarInferenceEngine",
+  "sidecar": "NativeSidecarInferenceEngine",
+  "NativeSidecarInferenceEngine": "NativeSidecarInferenceEngine",
 }
 
 
@@ -103,4 +107,7 @@ def get_inference_engine(inference_engine_name: str, shard_downloader=None) -> I
   if classname == "DummyInferenceEngine":
     from xotorch_tpu.inference.dummy import DummyInferenceEngine
     return DummyInferenceEngine()
+  if classname == "NativeSidecarInferenceEngine":
+    from xotorch_tpu.inference.native.engine import NativeSidecarInferenceEngine
+    return NativeSidecarInferenceEngine(shard_downloader)
   raise ValueError(f"Unsupported inference engine: {inference_engine_name}")

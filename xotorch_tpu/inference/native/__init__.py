@@ -1,0 +1,3 @@
+from xotorch_tpu.inference.native.engine import NativeSidecarInferenceEngine
+
+__all__ = ["NativeSidecarInferenceEngine"]

@@ -1,0 +1,272 @@
+"""NativeSidecarInferenceEngine — client for the in-repo C++ sidecar.
+
+Fills the reference's cheetah engine slot
+(/root/reference/xotorch/inference/cheetah/sharded_inference_engine.py:33-457):
+the transformer forward runs in an external native process reached over a
+Unix domain socket with length-prefixed ("!I" big-endian 4-byte header
+length) JSON + raw-tensor framing (:331-457). Differences by design:
+
+- The C++ service itself ships in-repo (native/sidecar/) and is spawned and
+  supervised by this engine — the reference assumed an already-running
+  out-of-repo service at a fixed socket path (:343-349).
+- Hidden states cross the socket as bf16 both ways (decoded with the same
+  uint16<<16 widening the reference used, :436-439) instead of fp32 one way.
+- The sidecar keeps the KV cache resident per session; the wire never carries
+  masks or the token history (the reference re-sent tokens/mask/input_pos on
+  every call, :377-395).
+
+Sampling stays host-side like the reference (:313-319), but over the real
+logits the sidecar returns rather than a local embedding stub.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.download.shard_download import NoopShardDownloader, ShardDownloader
+from xotorch_tpu.inference.engine import InferenceEngine
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.inference.tokenizers import DummyTokenizer, resolve_tokenizer
+from xotorch_tpu.ops.sampling import DEFAULT_TEMP, DEFAULT_TOP_K
+from xotorch_tpu.utils.helpers import DEBUG
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+_DEFAULT_BINARY = _REPO_ROOT / "native" / "build" / "xot-sidecar"
+
+
+def ensure_sidecar_binary() -> Path:
+  """Locate (or build via make) the sidecar binary."""
+  env = os.getenv("XOT_SIDECAR_BIN")
+  if env:
+    p = Path(env)
+    if not p.exists():
+      raise FileNotFoundError(f"XOT_SIDECAR_BIN={env} does not exist")
+    return p
+  if _DEFAULT_BINARY.exists():
+    return _DEFAULT_BINARY
+  native_dir = _REPO_ROOT / "native"
+  if (native_dir / "Makefile").exists():
+    subprocess.run(["make", "-C", str(native_dir)], check=True, capture_output=True)
+    if _DEFAULT_BINARY.exists():
+      return _DEFAULT_BINARY
+  raise FileNotFoundError(
+    f"sidecar binary not found at {_DEFAULT_BINARY}; run `make -C native` or set XOT_SIDECAR_BIN"
+  )
+
+
+def _decode_payload(meta: dict, payload: bytes) -> np.ndarray:
+  shape = tuple(meta["shape"])
+  dtype = meta["dtype"]
+  if dtype == "float32":
+    return np.frombuffer(payload, dtype=np.float32).reshape(shape).copy()
+  if dtype == "bfloat16":
+    # uint16 << 16 widening — parity: cheetah/...:436-439.
+    u16 = np.frombuffer(payload, dtype=np.uint16).astype(np.uint32)
+    return (u16 << 16).view(np.float32).reshape(shape).copy()
+  if dtype == "int32":
+    return np.frombuffer(payload, dtype=np.int32).reshape(shape).copy()
+  raise ValueError(f"unsupported wire dtype {dtype}")
+
+
+class SidecarClient:
+  """One connection to a sidecar process; owns the process if it spawned it."""
+
+  def __init__(self, socket_path: str, proc: Optional[subprocess.Popen] = None):
+    self.socket_path = socket_path
+    self.proc = proc
+    self._reader: Optional[asyncio.StreamReader] = None
+    self._writer: Optional[asyncio.StreamWriter] = None
+    self._lock = asyncio.Lock()
+
+  @classmethod
+  async def spawn(cls, threads: Optional[int] = None) -> "SidecarClient":
+    binary = ensure_sidecar_binary()
+    socket_path = f"/tmp/xot_sidecar_{os.getpid()}_{uuid.uuid4().hex[:8]}.sock"
+    cmd = [str(binary), "--socket", socket_path]
+    if threads:
+      cmd += ["--threads", str(threads)]
+    proc = subprocess.Popen(cmd, stderr=subprocess.DEVNULL if DEBUG < 2 else None)
+    client = cls(socket_path, proc)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+      if proc.poll() is not None:
+        raise RuntimeError(f"sidecar exited early with code {proc.returncode}")
+      if os.path.exists(socket_path):
+        try:
+          await client.connect()
+          await client.request({"cmd": "ping"})
+          return client
+        except (ConnectionError, OSError):
+          await client.close_connection()
+      await asyncio.sleep(0.05)
+    raise TimeoutError(f"sidecar did not come up on {socket_path}")
+
+  async def connect(self) -> None:
+    self._reader, self._writer = await asyncio.open_unix_connection(self.socket_path)
+
+  async def close_connection(self) -> None:
+    if self._writer is not None:
+      self._writer.close()
+      try:
+        await self._writer.wait_closed()
+      except Exception:
+        pass
+    self._reader = self._writer = None
+
+  async def request(self, header: dict, payload: bytes = b"") -> Tuple[dict, bytes]:
+    """Length-prefixed exchange: !I header length | JSON | raw payload."""
+    async with self._lock:
+      if self._writer is None:
+        await self.connect()
+      raw = json.dumps(header).encode("utf-8")
+      self._writer.write(struct.pack("!I", len(raw)) + raw + payload)
+      await self._writer.drain()
+      (resp_len,) = struct.unpack("!I", await self._reader.readexactly(4))
+      resp = json.loads(await self._reader.readexactly(resp_len))
+      body = b""
+      nbytes = int(resp.get("output", {}).get("nbytes", 0))
+      if nbytes:
+        body = await self._reader.readexactly(nbytes)
+      if resp.get("status") != "ok":
+        raise RuntimeError(f"sidecar error: {resp.get('error', resp)}")
+      return resp, body
+
+  async def shutdown(self) -> None:
+    try:
+      await self.request({"cmd": "quit"})
+    except Exception:
+      pass
+    await self.close_connection()
+    if self.proc is not None:
+      try:
+        self.proc.wait(timeout=5)
+      except subprocess.TimeoutExpired:
+        self.proc.kill()
+      self.proc = None
+
+
+class NativeSidecarInferenceEngine(InferenceEngine):
+  def __init__(self, shard_downloader: Optional[ShardDownloader] = None, threads: Optional[int] = None):
+    self.shard_downloader = shard_downloader or NoopShardDownloader()
+    self.session: Dict[str, Any] = {}
+    self.shard: Optional[Shard] = None
+    self.tokenizer = None
+    self.client: Optional[SidecarClient] = None
+    self._threads = threads
+    self._cache_len = int(os.getenv("XOT_CACHE_LEN", "2048"))
+    self._shard_lock = asyncio.Lock()
+    self._rng = np.random.default_rng(int(os.getenv("XOT_SEED", str(int(time.time())))))
+    self._model_dir: Optional[Path] = None
+    self._is_last = False
+
+  # ------------------------------------------------------------- tokenizing
+
+  async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
+    await self.ensure_shard(shard)
+    tokenizer = await self._ensure_tokenizer()
+    return np.asarray(tokenizer.encode(prompt), dtype=np.int64)
+
+  async def decode(self, shard: Shard, tokens: np.ndarray) -> str:
+    await self.ensure_shard(shard)
+    tokenizer = await self._ensure_tokenizer()
+    return tokenizer.decode(np.asarray(tokens).reshape(-1).tolist())
+
+  async def _ensure_tokenizer(self):
+    if self.tokenizer is None:
+      try:
+        self.tokenizer = await resolve_tokenizer(self._model_dir)
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"Tokenizer resolution failed for {self._model_dir}: {e!r}; using dummy")
+        self.tokenizer = DummyTokenizer()
+    return self.tokenizer
+
+  # --------------------------------------------------------------- sampling
+
+  async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K) -> np.ndarray:
+    logits = np.asarray(x, dtype=np.float32)
+    if logits.ndim == 3:
+      logits = logits[:, -1, :]
+    elif logits.ndim == 1:
+      logits = logits[None, :]
+    if temp <= 0.0:
+      return np.argmax(logits, axis=-1).astype(np.int64)
+    scaled = logits / max(temp, 1e-6)
+    if top_k and top_k > 0 and top_k < scaled.shape[-1]:
+      kth = np.partition(scaled, -top_k, axis=-1)[:, -top_k][:, None]
+      scaled = np.where(scaled < kth, -np.inf, scaled)
+    # Gumbel-max: argmax(logits + G) ~ softmax sample — the same
+    # exponential-noise trick the reference sampler used
+    # (sharded_inference_engine.py:208-228).
+    gumbel = -np.log(-np.log(self._rng.uniform(size=scaled.shape) + 1e-12) + 1e-12)
+    return np.argmax(scaled + gumbel, axis=-1).astype(np.int64)
+
+  # ---------------------------------------------------------------- serving
+
+  async def ensure_shard(self, shard: Shard) -> None:
+    if self.shard == shard:
+      return
+    async with self._shard_lock:
+      if self.shard == shard:
+        return
+      model_dir = await self.shard_downloader.ensure_shard(shard, self.__class__.__name__)
+      if self.client is None:
+        self.client = await SidecarClient.spawn(self._threads)
+      resp, _ = await self.client.request({
+        "cmd": "load",
+        "model_path": str(model_dir),
+        "layer_start": shard.start_layer,
+        "layer_end": shard.end_layer,
+        "layer_total": shard.n_layers,
+        "cache_len": self._cache_len,
+      })
+      self._is_last = bool(resp.get("is_last"))
+      self._model_dir = Path(model_dir)
+      self.tokenizer = None
+      self.shard = shard
+      if DEBUG >= 1:
+        print(f"Native sidecar ready for {shard} ({resp.get('family')}, load {resp.get('load_ns', 0)/1e6:.0f}ms)")
+
+  async def infer_tensor(
+    self, request_id: str, shard: Shard, input_data: np.ndarray, inference_state: Optional[dict] = None
+  ) -> Tuple[np.ndarray, Optional[dict]]:
+    await self.ensure_shard(shard)
+    arr = np.asarray(input_data)
+    if arr.ndim == 2:
+      payload = arr.astype(np.int32).tobytes()
+      meta = {"shape": list(arr.shape), "dtype": "int32", "nbytes": len(payload)}
+    elif arr.ndim == 3:
+      # bf16 on the wire: truncate-to-bf16 via round-to-nearest-even.
+      f32 = np.ascontiguousarray(arr, dtype=np.float32).view(np.uint32)
+      rounded = ((f32 + 0x7FFF + ((f32 >> 16) & 1)) >> 16).astype(np.uint16)
+      payload = rounded.tobytes()
+      meta = {"shape": list(arr.shape), "dtype": "bfloat16", "nbytes": len(payload)}
+    else:
+      raise ValueError(f"infer_tensor expects 2-D tokens or 3-D hidden state, got ndim={arr.ndim}")
+
+    resp, body = await self.client.request(
+      {"cmd": "infer", "session_id": request_id, "input": meta}, payload
+    )
+    out = _decode_payload(resp["output"], body)
+    return out, inference_state
+
+  async def clear_request(self, request_id: str) -> None:
+    if self.client is not None:
+      try:
+        await self.client.request({"cmd": "reset", "session_id": request_id})
+      except Exception:
+        pass
+
+  async def stop(self) -> None:
+    if self.client is not None:
+      await self.client.shutdown()
+      self.client = None

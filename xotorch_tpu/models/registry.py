@@ -116,8 +116,19 @@ def get_model_card(model_id: str) -> Optional[Dict]:
   return model_cards.get(model_id)
 
 
+NATIVE = "NativeSidecarInferenceEngine"
+
+
 def get_repo(model_id: str, inference_engine_classname: str) -> Optional[str]:
-  return model_cards.get(model_id, {}).get("repo", {}).get(inference_engine_classname)
+  repos = model_cards.get(model_id, {}).get("repo", {})
+  repo = repos.get(inference_engine_classname)
+  if repo is None and inference_engine_classname == NATIVE:
+    # The native sidecar reads the same HF safetensors layout the JAX engine
+    # does, so JAX repo entries serve both — dense families only (the C++
+    # forward has no expert routing).
+    if not model_cards.get(model_id, {}).get("moe"):
+      repo = repos.get(JAX)
+  return repo
 
 
 def build_base_shard(model_id: str, inference_engine_classname: str) -> Optional[Shard]:

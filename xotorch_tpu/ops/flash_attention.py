@@ -27,7 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q, block_k, groups, scale):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q, block_k, scale):
   """Grid = (B, Hq, nQ, nK); nK innermost so the scratch accumulators carry
   the online-softmax state across kv blocks of one (b, h, i) triple."""
   i = pl.program_id(2)
@@ -113,7 +113,7 @@ def flash_attention(
   grid = (B, Hq, T // block_q, T // block_k)
 
   out = pl.pallas_call(
-    functools.partial(_flash_kernel, block_q=block_q, block_k=block_k, groups=groups, scale=scale),
+    functools.partial(_flash_kernel, block_q=block_q, block_k=block_k, scale=scale),
     grid=grid,
     in_specs=[
       pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
