@@ -86,6 +86,12 @@ class ChatGPTAPI:
     r.add_post("/download", self.handle_post_download)
     r.add_get("/initial_models", self.handle_get_initial_models)
     r.add_get("/quit", self.handle_quit)
+    # Observability: span export + prometheus exposition + device traces
+    # (the reference declared both intents but wired neither — SURVEY §0, §5).
+    r.add_get("/v1/traces", self.handle_get_traces)
+    r.add_get("/metrics", self.handle_get_metrics)
+    r.add_post("/v1/trace/device/start", self.handle_device_trace_start)
+    r.add_post("/v1/trace/device/stop", self.handle_device_trace_stop)
     r.add_get("/", self.handle_root)
     if WEB_DIR.exists():
       r.add_static("/static", WEB_DIR, name="static")
@@ -131,6 +137,30 @@ class ChatGPTAPI:
 
   async def handle_healthcheck(self, request):
     return web.json_response({"status": "ok"})
+
+  async def handle_get_traces(self, request):
+    """Finished spans, OTLP-style JSON. ?trace_id= filters one trace;
+    ?clear=1 drains the buffer after reading."""
+    trace_id = request.query.get("trace_id")
+    clear = request.query.get("clear") == "1"
+    spans = self.node.tracer.export(trace_id=trace_id, clear=clear)
+    return web.json_response({"spans": spans, "count": len(spans)})
+
+  async def handle_get_metrics(self, request):
+    return web.Response(
+      body=self.node.metrics.exposition(), content_type="text/plain", charset="utf-8"
+    )
+
+  async def handle_device_trace_start(self, request):
+    from xotorch_tpu.orchestration.tracing import start_device_trace
+    body = await request.json() if request.can_read_body else {}
+    logdir = body.get("logdir", "/tmp/xot_jax_trace")
+    started = start_device_trace(logdir)
+    return web.json_response({"started": started, "logdir": logdir})
+
+  async def handle_device_trace_stop(self, request):
+    from xotorch_tpu.orchestration.tracing import stop_device_trace
+    return web.json_response({"stopped": stop_device_trace()})
 
   async def handle_get_models(self, request):
     models = [

@@ -83,8 +83,11 @@ class GRPCPeerHandle(PeerHandle):
         print(f"Health check failed for {self._id}@{self.address}: {e!r}")
       return False
 
-  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None) -> None:
-    await self._call("SendPrompt", {"shard": shard.to_dict(), "prompt": prompt, "request_id": request_id})
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
+                        traceparent: Optional[str] = None) -> None:
+    await self._call("SendPrompt", {
+      "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
+    })
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
                         inference_state: Optional[dict] = None) -> None:

@@ -56,7 +56,9 @@ class GRPCServer(Server):
     # otherwise exceed any sane deadline and couple peer lifetimes).
     fields, _ = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
-    asyncio.create_task(self.node.process_prompt(shard, fields["prompt"], fields.get("request_id")))
+    asyncio.create_task(self.node.process_prompt(
+      shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent")
+    ))
     return encode_message({"ok": True})
 
   async def _rpc_send_tensor(self, request: bytes, context) -> bytes:
@@ -86,6 +88,10 @@ class GRPCServer(Server):
     fields, tensors = decode_message(request)
     result = tensors["result"] if "result" in tensors else fields.get("result", [])
     self.node.on_token.trigger_all(fields["request_id"], result, fields["is_finished"])
+    if fields["is_finished"]:
+      # The finished broadcast is how non-sampler peers learn a request ended;
+      # drop their per-request bookkeeping here or it leaks forever.
+      self.node.finish_request_state(fields["request_id"])
     return encode_message({"ok": True})
 
   async def _rpc_send_opaque_status(self, request: bytes, context) -> bytes:

@@ -50,7 +50,8 @@ class PeerHandle(ABC):
     ...
 
   @abstractmethod
-  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None) -> None:
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
+                        traceparent: Optional[str] = None) -> None:
     ...
 
   @abstractmethod

@@ -80,6 +80,15 @@ class Node:
     self._topology_task: Optional[asyncio.Task] = None
     self.outstanding_requests: Dict[str, str] = {}
 
+    # Observability: real spans + real prometheus metrics for the intents the
+    # reference declared but never wired (SURVEY §0, §5).
+    from xotorch_tpu.orchestration.metrics import NodeMetrics
+    from xotorch_tpu.orchestration.tracing import Tracer
+    self.tracer = Tracer(node_id=self.id)
+    self.metrics = NodeMetrics(node_id=self.id)
+    self._request_trace_ctx: Dict[str, Any] = {}
+    self._last_token_time: Dict[str, float] = {}
+
   # ------------------------------------------------------------- lifecycle
 
   async def start(self, wait_for_peers: int = 0, topology_interval: float = 2.0) -> None:
@@ -117,6 +126,15 @@ class Node:
       elif status_type == "node_status":
         if status.get("status", "").startswith("start_"):
           self.topology.active_node_id = status.get("node_id")
+          # Adopt the origin's trace context before any tensor hop arrives so
+          # even peers that only observe the request join its trace.
+          rid = status.get("request_id")
+          tp = status.get("traceparent")
+          if rid and tp and rid not in self._request_trace_ctx:
+            from xotorch_tpu.orchestration.tracing import TraceContext
+            ctx = TraceContext.from_traceparent(tp)
+            if ctx is not None:
+              self._request_trace_ctx[rid] = ctx
         elif status.get("status", "").startswith("end_"):
           if status.get("node_id") == self.topology.active_node_id:
             self.topology.active_node_id = None
@@ -128,17 +146,32 @@ class Node:
 
   # ------------------------------------------------------------ inference
 
-  async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None) -> None:
+  async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None,
+                           traceparent: Optional[str] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
     start_ns = time.perf_counter_ns()
-    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
-      "type": "node_status", "node_id": self.id, "status": "start_process_prompt",
-      "base_shard": base_shard.to_dict(), "shard": shard.to_dict(),
-      "prompt": prompt, "request_id": request_id,
-    })))
-    await self._process_prompt(base_shard, prompt, request_id)
+    self.metrics.requests_total.inc()
+    # A forwarded prompt carries the origin node's trace context; joining it
+    # keeps one trace per request across the ring (reference tracing.py:36-70).
+    from xotorch_tpu.orchestration.tracing import TraceContext
+    parent_ctx = TraceContext.from_traceparent(traceparent)
+    with self.tracer.start_span(
+      "process_prompt" if parent_ctx is None else "process_prompt.forwarded",
+      parent=parent_ctx,
+      attributes={"request.id": request_id, "model.id": base_shard.model_id},
+    ) as span:
+      # The request's root span context rides the status bus + tensor hops so
+      # every peer's hop spans join the same trace (reference tracing.py:36-70).
+      self._request_trace_ctx[request_id] = span.context()
+      asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+        "type": "node_status", "node_id": self.id, "status": "start_process_prompt",
+        "base_shard": base_shard.to_dict(), "shard": shard.to_dict(),
+        "prompt": prompt, "request_id": request_id,
+        "traceparent": span.context().traceparent(),
+      })))
+      await self._process_prompt(base_shard, prompt, request_id)
     asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
       "type": "node_status", "node_id": self.id, "status": "end_process_prompt",
       "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
@@ -151,6 +184,7 @@ class Node:
       await self.forward_prompt(base_shard, prompt, request_id, 0)
       return
     self.outstanding_requests[request_id] = "processing prompt"
+    self.metrics.active_requests.set(len(self.outstanding_requests))
     result, inference_state = await self.inference_engine.infer_prompt(request_id, shard, prompt)
     await self.process_inference_result(base_shard, result, request_id, inference_state)
 
@@ -161,13 +195,28 @@ class Node:
       request_id = str(uuid.uuid4())
     start_ns = time.perf_counter_ns()
     self.outstanding_requests[request_id] = "processing tensor"
+    self.metrics.active_requests.set(len(self.outstanding_requests))
+    self.metrics.tensor_hops_total.inc()
+    # Join the request's trace: the traceparent rides the inference_state
+    # side-channel across peers (W3C propagation, reference tracing.py:36-70).
+    from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext
+    ctx = self._request_trace_ctx.get(request_id)
+    if ctx is None and inference_state:
+      ctx = TraceContext.from_traceparent(inference_state.get(TRACEPARENT_KEY))
+      if ctx is not None:
+        self._request_trace_ctx[request_id] = ctx
     try:
-      result, inference_state = await self.inference_engine.infer_tensor(
-        request_id, shard, tensor, inference_state
-      )
+      with self.tracer.start_span(
+        "process_tensor", parent=ctx,
+        attributes={"request.id": request_id, "shard.start": shard.start_layer, "shard.end": shard.end_layer},
+      ):
+        result, inference_state = await self.inference_engine.infer_tensor(
+          request_id, shard, tensor, inference_state
+        )
+      self.metrics.hop_latency.observe((time.perf_counter_ns() - start_ns) / 1e9)
       await self.process_inference_result(base_shard, result, request_id, inference_state)
     except Exception as e:
-      self.outstanding_requests.pop(request_id, None)
+      self.finish_request_state(request_id)
       print(f"Error processing tensor for shard {shard}: {e!r}")
       if DEBUG >= 2:
         import traceback
@@ -196,6 +245,13 @@ class Node:
     )
     token_int = int(np.asarray(token).reshape(-1)[0])
     buffered.append(token_int)
+    now = time.monotonic()
+    last = self._last_token_time.get(request_id)
+    if last is not None:
+      self.metrics.token_latency.observe(now - last)
+    self._last_token_time[request_id] = now
+    self.metrics.tokens_total.inc()
+    self.tracer.record_token(request_id, self._request_trace_ctx.get(request_id))
     is_finished = (
       token_int in self._eos_token_ids()
       or len(buffered) >= self.max_generate_tokens
@@ -208,7 +264,7 @@ class Node:
     asyncio.create_task(self.broadcast_result(request_id, buffered, is_finished))
 
     if is_finished:
-      self.outstanding_requests.pop(request_id, None)
+      self.finish_request_state(request_id)
       self.buffered_token_output.pop(request_id, None)  # callbacks/broadcast hold the list
       clear = getattr(self.inference_engine, "clear_request", None)
       if clear is not None:
@@ -264,13 +320,21 @@ class Node:
     peer = next((p for p in self.peers if p.id() == target_id), None)
     if peer is None:
       raise ValueError(f"Peer for {target_index} ({target_id}) not found")
-    await peer.send_prompt(next_shard, prompt, request_id)
+    ctx = self._request_trace_ctx.get(request_id)
+    await peer.send_prompt(next_shard, prompt, request_id,
+                           traceparent=ctx.traceparent() if ctx else None)
 
   async def forward_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, target_index: int,
                            inference_state: Optional[dict] = None) -> None:
     partitions = self.partitioning_strategy.partition(self.topology)
     target_id = partitions[target_index].node_id
     next_shard = self.get_current_shard(base_shard, target_index)
+    # Inject the trace context so the receiving peer's hop span joins this
+    # request's trace (rides the existing inference_state side-channel).
+    ctx = self._request_trace_ctx.get(request_id)
+    if ctx is not None:
+      from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY
+      inference_state = {**(inference_state or {}), TRACEPARENT_KEY: ctx.traceparent()}
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
@@ -397,6 +461,7 @@ class Node:
     connected = await asyncio.gather(*(_connect(p) for p in peers_added))
     await asyncio.gather(*(_disconnect(p) for p in peers_removed))
     self.peers = peers_kept + [p for p, ok in zip(peers_added, connected) if ok]
+    self.metrics.peers.set(len(self.peers))
     return bool(peers_added or peers_removed)
 
   async def periodic_topology_collection(self, interval: float) -> None:
@@ -460,6 +525,17 @@ class Node:
     return get_supported_models(pools)
 
   # ------------------------------------------------------------ broadcast
+
+  def finish_request_state(self, request_id: str) -> None:
+    """Release all per-request bookkeeping (idempotent). Runs on the sampler
+    when a request finishes or errors, and on every other peer when the
+    finished-result broadcast arrives — so mid-ring nodes don't leak
+    outstanding/trace state for requests whose end they never see locally."""
+    self.outstanding_requests.pop(request_id, None)
+    self.metrics.active_requests.set(len(self.outstanding_requests))
+    self.tracer.finish_request(request_id)
+    self._request_trace_ctx.pop(request_id, None)
+    self._last_token_time.pop(request_id, None)
 
   def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
     self.on_token.trigger_all(request_id, tokens, is_finished)

@@ -1,0 +1,221 @@
+"""Distributed request tracing — the reference's intent, implemented for real.
+
+The reference shipped an OpenTelemetry tracer that was never imported and
+whose dependency was absent from setup.py (orchestration/tracing.py:21-166;
+SURVEY §0, §5). This module keeps its design — per-request spans, W3C
+`traceparent` propagation across peers (:36-70), 10-token group spans
+(:72-103) — but is self-contained: this image ships the opentelemetry API
+namespace without an SDK, so spans are recorded into a bounded in-process
+buffer and exported as JSON via the API's `/v1/traces` route instead of
+through an OTLP pipeline. The span dict layout matches the OTLP JSON field
+names (traceId/spanId/parentSpanId/name/startTimeUnixNano/endTimeUnixNano/
+attributes) so an external collector can ingest the export unchanged.
+
+Cross-host propagation rides the side-channels that already cross the wire:
+the `inference_state` dict on tensor hops and the opaque-status JSON bus —
+no new RPCs.
+
+On-TPU device traces: `start_device_trace`/`stop_device_trace` wrap
+`jax.profiler` so a request trace can be correlated with an XLA trace.
+"""
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+TRACEPARENT_KEY = "traceparent"
+_TOKEN_GROUP_SIZE = 10  # parity: reference tracing.py:72-103
+
+
+@dataclass
+class TraceContext:
+  """W3C trace-context carrier (traceparent version 00)."""
+  trace_id: str  # 32 hex chars
+  span_id: str  # 16 hex chars (the parent for anything created from this ctx)
+  sampled: bool = True
+
+  def traceparent(self) -> str:
+    return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+  @classmethod
+  def from_traceparent(cls, header: Optional[str]) -> Optional["TraceContext"]:
+    if not header:
+      return None
+    parts = header.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+      return None
+    return cls(trace_id=parts[1], span_id=parts[2], sampled=parts[3] == "01")
+
+  @classmethod
+  def new(cls) -> "TraceContext":
+    return cls(trace_id=secrets.token_hex(16), span_id=secrets.token_hex(8))
+
+
+@dataclass
+class Span:
+  name: str
+  trace_id: str
+  span_id: str
+  parent_span_id: Optional[str]
+  start_ns: int
+  end_ns: Optional[int] = None
+  attributes: Dict[str, Any] = field(default_factory=dict)
+  status: str = "OK"
+
+  def end(self, status: str = "OK") -> None:
+    if self.end_ns is None:
+      self.end_ns = time.time_ns()
+      self.status = status
+
+  def set_attribute(self, key: str, value: Any) -> None:
+    self.attributes[key] = value
+
+  def context(self) -> TraceContext:
+    return TraceContext(trace_id=self.trace_id, span_id=self.span_id)
+
+  def to_dict(self) -> dict:
+    return {
+      "traceId": self.trace_id,
+      "spanId": self.span_id,
+      "parentSpanId": self.parent_span_id or "",
+      "name": self.name,
+      "startTimeUnixNano": self.start_ns,
+      "endTimeUnixNano": self.end_ns or 0,
+      "attributes": [{"key": k, "value": v} for k, v in self.attributes.items()],
+      "status": self.status,
+    }
+
+
+class _SpanHandle:
+  """Context manager that ends the span (ERROR on exception)."""
+
+  def __init__(self, tracer: "Tracer", span: Span):
+    self._tracer = tracer
+    self.span = span
+
+  def __enter__(self) -> Span:
+    return self.span
+
+  def __exit__(self, exc_type, exc, tb) -> None:
+    self._tracer.end_span(self.span, status="ERROR" if exc_type else "OK")
+
+
+class Tracer:
+  """Thread-safe span recorder with a bounded buffer.
+
+  Enabled by default; set XOT_TRACING=0 to turn span recording into no-ops
+  (span objects are still returned so call sites stay unconditional)."""
+
+  def __init__(self, node_id: str = "", max_spans: int = 4096):
+    self.node_id = node_id
+    self.enabled = os.getenv("XOT_TRACING", "1") == "1"
+    self._finished: deque = deque(maxlen=max_spans)
+    self._lock = threading.Lock()
+    self._token_groups: Dict[str, Span] = {}
+    self._token_counts: Dict[str, int] = {}
+
+  # ----------------------------------------------------------------- spans
+
+  def start_span(self, name: str, parent: Optional[TraceContext] = None,
+                 attributes: Optional[Dict[str, Any]] = None) -> _SpanHandle:
+    if parent is None:
+      parent = TraceContext.new()
+      parent_span_id = None
+    else:
+      parent_span_id = parent.span_id
+    span = Span(
+      name=name,
+      trace_id=parent.trace_id,
+      span_id=secrets.token_hex(8),
+      parent_span_id=parent_span_id,
+      start_ns=time.time_ns(),
+      attributes={"node.id": self.node_id, **(attributes or {})},
+    )
+    return _SpanHandle(self, span)
+
+  def end_span(self, span: Span, status: str = "OK") -> None:
+    span.end(status)
+    if self.enabled:
+      with self._lock:
+        self._finished.append(span)
+
+  # ----------------------------------------------- token group spans (10x)
+
+  def record_token(self, request_id: str, ctx: Optional[TraceContext]) -> None:
+    """Group every 10 sampled tokens into one span under the request trace
+    (parity: reference tracing.py:72-103 — span-per-token is too chatty)."""
+    if not self.enabled:
+      return
+    with self._lock:
+      count = self._token_counts.get(request_id, 0)
+      entry = self._token_groups.get(request_id)
+      if entry is None:
+        parent = ctx or TraceContext.new()
+        group = Span(
+          name=f"tokens[{count}..{count + _TOKEN_GROUP_SIZE - 1}]",
+          trace_id=parent.trace_id,
+          span_id=secrets.token_hex(8),
+          parent_span_id=ctx.span_id if ctx else None,
+          start_ns=time.time_ns(),
+          attributes={"node.id": self.node_id, "request.id": request_id},
+        )
+        entry = (group, count)
+        self._token_groups[request_id] = entry
+      group, group_start = entry
+      self._token_counts[request_id] = count + 1
+      group.set_attribute("token.count", self._token_counts[request_id] - group_start)
+      if self._token_counts[request_id] % _TOKEN_GROUP_SIZE == 0:
+        group.end()
+        self._finished.append(group)
+        del self._token_groups[request_id]
+
+  def finish_request(self, request_id: str) -> None:
+    """Flush a partial token-group span when a request completes."""
+    with self._lock:
+      entry = self._token_groups.pop(request_id, None)
+      self._token_counts.pop(request_id, None)
+      if entry is not None and self.enabled:
+        group, _ = entry
+        group.end()
+        self._finished.append(group)
+
+  # ---------------------------------------------------------------- export
+
+  def export(self, trace_id: Optional[str] = None, clear: bool = False) -> List[dict]:
+    with self._lock:
+      spans = [s.to_dict() for s in self._finished if trace_id is None or s.trace_id == trace_id]
+      if clear:
+        self._finished.clear()
+    return spans
+
+
+# ------------------------------------------------------- jax device traces
+
+_profiling = False
+
+
+def start_device_trace(logdir: str = "/tmp/xot_jax_trace") -> bool:
+  """Start a jax.profiler trace (TensorBoard-compatible) alongside the span
+  trace. Returns False if a trace is already running."""
+  global _profiling
+  if _profiling:
+    return False
+  import jax
+  jax.profiler.start_trace(logdir)
+  _profiling = True
+  return True
+
+
+def stop_device_trace() -> bool:
+  global _profiling
+  if not _profiling:
+    return False
+  import jax
+  jax.profiler.stop_trace()
+  _profiling = False
+  return True
