@@ -127,3 +127,69 @@ async def test_system_prompt_injection():
     assert "prompt" in seen  # callback fired with the built prompt
   finally:
     await client.close()
+
+
+async def test_max_tokens_cap_and_finish_reason():
+  """OpenAI max_tokens must cap the completion and yield finish_reason
+  "length"; the dummy engine would otherwise run 10 tokens to EOS."""
+  client, node, engine = await _api_client()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "max_tokens": 3,
+      "messages": [{"role": "user", "content": "hello"}],
+    })
+    data = await resp.json()
+    assert data["usage"]["completion_tokens"] == 3
+    assert data["choices"][0]["finish_reason"] == "length"
+    # The node must also have cleaned up the per-request cap.
+    assert node._request_max_tokens == {}
+  finally:
+    await client.close()
+
+
+async def test_invalid_max_tokens_rejected_with_400():
+  client, node, _ = await _api_client()
+  try:
+    for bad in ("abc", 0, -3, None):
+      payload = {"model": "dummy", "max_tokens": bad,
+                 "messages": [{"role": "user", "content": "hello"}]}
+      if bad is None:
+        payload["max_tokens"] = {"not": "a number"}
+      resp = await client.post("/v1/chat/completions", json=payload)
+      assert resp.status == 400, (bad, resp.status)
+      body = await resp.json()
+      assert body["error"]["type"] == "invalid_request_error"
+  finally:
+    await client.close()
+
+
+async def test_engine_failure_returns_500_not_empty_200():
+  """An engine failure mid-request must surface as an error, not an empty
+  successful completion."""
+  client, node, engine = await _api_client()
+
+  async def exploding_infer_prompt(request_id, shard, prompt):
+    raise RuntimeError("engine exploded")
+
+  engine.infer_prompt = exploding_infer_prompt
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "messages": [{"role": "user", "content": "hello"}],
+    })
+    assert resp.status == 500
+    body = await resp.json()
+    assert body["error"]["type"] == "server_error"
+    assert "engine exploded" in body["error"]["message"]
+    assert node.request_errors == {}  # consumed by the API
+
+    # Streaming: error event then [DONE], no fake completion chunks.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "stream": True, "messages": [{"role": "user", "content": "hello"}],
+    })
+    raw = await resp.text()
+    events = [line[6:] for line in raw.split("\n") if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    payloads = [json.loads(e) for e in events[:-1]]
+    assert any("error" in p for p in payloads)
+  finally:
+    await client.close()

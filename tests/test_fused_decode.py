@@ -1,0 +1,184 @@
+"""Fused multi-token decode (models/generate.py + Node fast path).
+
+The single-partition fused path must be a pure optimisation: greedy decode
+through the chunked path has to produce exactly the tokens the per-token ring
+produces (same executable semantics, sampling on-device), including when
+max_generate_tokens is not a multiple of the chunk size.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking.discovery import Discovery
+from xotorch_tpu.orchestration.node import Node
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+class _NullServer:
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+
+class _NoDiscovery(Discovery):
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+  async def discover_peers(self, wait_for_peers: int = 0):
+    return []
+
+
+async def _generate(model_dir, chunk_size: int, max_tokens: int):
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+  node = Node(
+    f"n-chunk{chunk_size}", _NullServer(), eng, _NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=max_tokens, default_sample_temp=0.0,
+    decode_chunk_size=chunk_size,
+  )
+  node.device_capabilities = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  node.topology.update_node(node.id, node.device_capabilities)
+
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  await node.process_prompt(Shard("m", 0, n - 1, n), "hello fused world", "req")
+  await asyncio.wait_for(done.wait(), timeout=60)
+  return out["tokens"]
+
+
+@pytest.fixture()
+def tiny_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+
+
+async def test_fused_chunk_matches_per_token_ring(tiny_model_dir):
+  # 13 tokens with chunk 4: one prefill token + 3 full chunks, with the last
+  # chunk truncated on the host (max is not a chunk multiple).
+  per_token = await _generate(tiny_model_dir, chunk_size=1, max_tokens=13)
+  fused = await _generate(tiny_model_dir, chunk_size=4, max_tokens=13)
+  assert fused == per_token
+  assert len(fused) == 13
+
+
+async def test_fused_chunk_engine_guard_rails(tiny_model_dir):
+  """generate_chunk refuses partial shards and unknown requests."""
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  full = Shard("m", 0, n - 1, n)
+  half = Shard("m", 0, n // 2 - 1, n)
+
+  # Unknown request id: the caller guaranteed a prefill, so a missing state
+  # means it was evicted — that must fail loudly, not fall back silently.
+  from xotorch_tpu.inference.engine import RequestStateLost
+  await eng.ensure_shard(full)
+  with pytest.raises(RequestStateLost):
+    await eng.generate_chunk("missing", full, 1, 4)
+
+  # Partial shard can never run the fused loop (no logits on this peer).
+  assert await eng.generate_chunk("missing", half, 1, 4) is None
+
+
+async def test_fused_decode_runs_detached_from_process_prompt(tiny_model_dir):
+  """process_prompt must return after the first token — streaming clients
+  need tokens as they are produced, not after EOS (the fused loop runs as a
+  background task)."""
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  gate = asyncio.Event()
+  orig = eng.generate_chunk
+
+  async def gated(*a, **k):
+    await gate.wait()
+    return await orig(*a, **k)
+
+  eng.generate_chunk = gated
+  node = Node(
+    "detached", _NullServer(), eng, _NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=12, default_sample_temp=0.0, decode_chunk_size=4,
+  )
+  node.device_capabilities = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  node.topology.update_node(node.id, node.device_capabilities)
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  await node.process_prompt(Shard("m", 0, n - 1, n), "hello", "req-detached")
+  # The fused loop is gated: if process_prompt awaited it, we'd deadlock. At
+  # this point exactly the prefill token has been emitted.
+  assert out["tokens"] is not None and len(out["tokens"]) == 1
+  assert not done.is_set()
+  gate.set()
+  await asyncio.wait_for(done.wait(), timeout=60)
+  assert len(out["tokens"]) == 12
+
+
+async def test_cache_exhaustion_finishes_as_length(tiny_model_dir):
+  """Filling the KV cache must end the request as a normal truncated
+  completion, not an error."""
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  eng._configured_cache_len = 16  # survives _load_shard's cache_len derivation
+  node = Node(
+    "cachecap", _NullServer(), eng, _NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=100, default_sample_temp=0.0, decode_chunk_size=4,
+  )
+  node.device_capabilities = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  node.topology.update_node(node.id, node.device_capabilities)
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  await node.process_prompt(Shard("m", 0, n - 1, n), "hello fused world", "req-cap")
+  await asyncio.wait_for(done.wait(), timeout=60)
+  # Generation stopped because the 16-slot cache filled, with the prompt's
+  # tokens plus generated ones resident; no error was recorded.
+  assert 1 <= len(out["tokens"]) < 100
+  assert node.request_errors == {}
+  assert node.buffered_token_output == {}
+
+
+async def test_lost_state_raises_not_garbage(tiny_model_dir):
+  """Evicted mid-generation state must fail loudly (RequestStateLost), never
+  silently restart from an empty cache."""
+  from xotorch_tpu.inference.engine import RequestStateLost
+
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  full = Shard("m", 0, n - 1, n)
+  prompt = np.array([[1, 5, 9]], dtype=np.int64)
+  await eng.infer_tensor("victim", full, prompt)
+  eng.states.clear()  # simulate LRU eviction under concurrency
+  with pytest.raises(RequestStateLost):
+    await eng.generate_chunk("victim", full, 1, 4)

@@ -257,3 +257,56 @@ async def test_opaque_status_bus_and_active_node_tracking():
     assert node_b.topology.active_node_id is None
   finally:
     await _stop_ring(node_a, node_b)
+
+
+async def test_hop_error_aborts_request_on_all_nodes():
+  """A mid-ring engine failure must not leak per-request state anywhere:
+  the failing node broadcasts a finish so peers (and API clients) clean up."""
+  engine_a = DummyInferenceEngine()
+  engine_b = DummyInferenceEngine()
+
+  async def exploding_infer_tensor(request_id, shard, tensor, inference_state=None):
+    raise RuntimeError("boom")
+
+  # Partition order sorts by (memory, id) desc => node-b owns partition 0,
+  # node-a the tail. Failing node-a's infer_tensor breaks the b->a tensor hop.
+  engine_a.infer_tensor = exploding_infer_tensor
+  node_a, node_b = await _two_node_ring(engine_a, engine_b)
+  try:
+    done = asyncio.Event()
+
+    def on_token(request_id, tokens, is_finished):
+      if is_finished:
+        done.set()
+
+    node_a.on_token.register("t").on_next(on_token)
+    node_b.on_token.register("t").on_next(on_token)
+    shard = Shard("dummy", 0, 0, 8)
+    await node_a.process_prompt(shard, "hello", "req-err")
+    await asyncio.wait_for(done.wait(), timeout=15)
+    await asyncio.sleep(0.5)  # let the finished broadcast land everywhere
+    for node in (node_a, node_b):
+      assert node.outstanding_requests == {}, (node.id, node.outstanding_requests)
+      assert node._request_max_tokens == {}
+      assert node.buffered_token_output == {}
+  finally:
+    await _stop_ring(node_a, node_b)
+
+
+async def test_prompt_error_aborts_request():
+  """An engine failure during prefill must finish the request (callbacks get
+  is_finished) instead of leaving API clients hanging until timeout."""
+  engine = DummyInferenceEngine()
+
+  async def exploding_infer_prompt(request_id, shard, prompt):
+    raise RuntimeError("prefill boom")
+
+  engine.infer_prompt = exploding_infer_prompt
+  node = await _make_node("solo", engine)
+  node.topology.update_node("solo", _caps())
+  done = asyncio.Event()
+  node.on_token.register("t").on_next(lambda rid, toks, fin: done.set() if fin else None)
+  await node.process_prompt(Shard("dummy", 0, 0, 8), "hi", "req-pfail")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  assert node.outstanding_requests == {}
+  assert node.buffered_token_output == {}

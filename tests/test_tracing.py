@@ -91,6 +91,10 @@ def test_export_filter_and_clear():
     pass
   only_a = tracer.export(trace_id=a.trace_id)
   assert [s["name"] for s in only_a] == ["a"]
+  # Filtered drain removes only that trace — other traces stay readable.
+  tracer.export(trace_id=a.trace_id, clear=True)
+  remaining = tracer.export()
+  assert [s["name"] for s in remaining] == ["b"]
   tracer.export(clear=True)
   assert tracer.export() == []
 
@@ -185,6 +189,7 @@ async def test_ring_releases_per_request_state_on_all_nodes():
       assert node.outstanding_requests == {}, node.outstanding_requests
       assert node._request_trace_ctx == {}, node._request_trace_ctx
       assert node._last_token_time == {}
+      assert node._request_max_tokens == {}
       assert node.tracer._token_groups == {}
   finally:
     await node_a.stop()

@@ -147,13 +147,19 @@ class ChatGPTAPI:
     return web.json_response({"spans": spans, "count": len(spans)})
 
   async def handle_get_metrics(self, request):
-    return web.Response(
-      body=self.node.metrics.exposition(), content_type="text/plain", charset="utf-8"
-    )
+    body, content_type = self.node.metrics.exposition_with_content_type()
+    # aiohttp's content_type kwarg rejects parameters; set the full
+    # exposition header (incl. version=0.0.4) directly.
+    return web.Response(body=body, headers={"Content-Type": content_type})
 
   async def handle_device_trace_start(self, request):
     from xotorch_tpu.orchestration.tracing import start_device_trace
-    body = await request.json() if request.can_read_body else {}
+    try:
+      body = await request.json() if request.can_read_body else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": "body must be JSON"}}, status=400
+      )
     logdir = body.get("logdir", "/tmp/xot_jax_trace")
     started = start_device_trace(logdir)
     return web.json_response({"started": started, "logdir": logdir})
@@ -264,9 +270,21 @@ class ChatGPTAPI:
         if DEBUG >= 1:
           print(f"on_chat_completion_request callback error: {e!r}")
 
+    # OpenAI caps: max_tokens (legacy) / max_completion_tokens (current);
+    # an explicit null is treated like an absent key.
+    max_tokens = data.get("max_completion_tokens")
+    if max_tokens is None:
+      max_tokens = data.get("max_tokens")
+    if max_tokens is not None:
+      if isinstance(max_tokens, bool) or not isinstance(max_tokens, int) or max_tokens < 1:
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"max_tokens must be a positive integer, got {max_tokens!r}"}},
+          status=400,
+        )
     self.token_queues[request_id] = asyncio.Queue()
     try:
-      await self.node.process_prompt(shard, prompt, request_id)
+      await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens)
       if stream:
         return await self._stream_response(request, request_id, model, tokenizer)
       return await self._full_response(request_id, model, tokenizer, prompt)
@@ -306,8 +324,14 @@ class ChatGPTAPI:
     }
 
   def _eos_ids(self, tokenizer) -> set:
+    # Whatever stops the node must classify as "stop" here: delegate to the
+    # node's own EOS set (engine tokenizer + model cfg) and add the ids of
+    # the tokenizer used for this request (may differ from the engine's).
+    ids = set(self.node._eos_token_ids())
     eos = getattr(tokenizer, "eos_token_id", None)
-    return {eos} if eos is not None else set()
+    if eos is not None:
+      ids.add(eos)
+    return ids
 
   async def _stream_response(self, request, request_id: str, model: str, tokenizer):
     response = web.StreamResponse(status=200, headers={
@@ -321,6 +345,12 @@ class ChatGPTAPI:
       while not finished:
         timeout = max(0.1, deadline - time.monotonic())
         tokens, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
+        error = self.node.request_errors.pop(request_id, None) if finished else None
+        if error is not None:
+          # Mid-stream failure: OpenAI-style error event, then terminate.
+          payload = {"error": {"type": "server_error", "message": error}}
+          await response.write(f"data: {json.dumps(payload)}\n\n".encode())
+          break
         delta = self._delta_tokens(request_id, tokens)
         new_tokens = [t for t in delta if t not in eos_ids]
         finish_reason = None
@@ -352,6 +382,11 @@ class ChatGPTAPI:
       except asyncio.TimeoutError:
         return web.json_response({"detail": "Response timed out"}, status=408)
       deadline = time.monotonic() + self.response_timeout
+    error = self.node.request_errors.pop(request_id, None)
+    if error is not None:
+      return web.json_response(
+        {"error": {"type": "server_error", "message": error}}, status=500
+      )
     finish_reason = "stop" if (tokens and tokens[-1] in eos_ids) else "length"
     content_tokens = [t for t in tokens if t not in eos_ids]
     content = tokenizer.decode(content_tokens) if content_tokens else ""

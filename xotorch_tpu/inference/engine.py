@@ -17,6 +17,18 @@ import numpy as np
 from xotorch_tpu.inference.shard import Shard
 
 
+class CacheExhausted(Exception):
+  """The request's KV cache is full: generation cannot continue, but the
+  tokens produced so far are valid — the orchestrator ends the request as a
+  normal 'length' finish rather than an error."""
+
+
+class RequestStateLost(Exception):
+  """The engine no longer holds the request's device state (e.g. LRU-evicted
+  under concurrency). Continuing would silently restart from an empty cache
+  and produce garbage; the orchestrator must abort the request instead."""
+
+
 class InferenceEngine(ABC):
   """One peer's compute backend for a layer-range shard."""
 

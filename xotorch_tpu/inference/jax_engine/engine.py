@@ -37,7 +37,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from xotorch_tpu.download.shard_download import NoopShardDownloader, ShardDownloader
-from xotorch_tpu.inference.engine import InferenceEngine
+from xotorch_tpu.inference.engine import CacheExhausted, InferenceEngine, RequestStateLost
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.inference.tokenizers import DummyTokenizer, resolve_tokenizer
 from xotorch_tpu.models.config import ModelConfig, config_from_hf_dict, load_model_config
@@ -170,7 +170,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     # Check against the padded bucket, not true_t: dynamic_update_slice CLAMPS
     # out-of-range starts, which would silently overwrite earlier cache slots.
     if state.pos + bucket > self.cache_len:
-      raise ValueError(
+      raise CacheExhausted(
         f"Request {request_id}: {true_t} new tokens at pos {state.pos} "
         f"(padded to {bucket}) exceed cache length {self.cache_len}"
       )
@@ -192,6 +192,52 @@ class JAXShardInferenceEngine(InferenceEngine):
     # cache by subsequent decode steps before ever becoming visible (the
     # causal mask hides them until then), but must be sliced off the output.
     return np.asarray(out[:, :true_t])
+
+  async def generate_chunk(
+    self, request_id: str, shard: Shard, prev_token: int, num_tokens: int,
+    temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
+  ) -> Optional[np.ndarray]:
+    """Fused multi-token decode (models/generate.py): one device dispatch
+    produces `num_tokens` sampled tokens, with sampling on-device under the
+    same `lax.scan` as the forward steps. Only valid when this shard spans
+    the whole model (single-partition ring) and the request already has a
+    prefilled cache. Returns None when the fast path does not apply so the
+    caller (Node.process_inference_result) falls back to the per-token ring.
+    """
+    if not (shard == self.shard and shard.is_first_layer and shard.is_last_layer) or num_tokens < 1:
+      return None
+    state = self.states.get(request_id)
+    if state is None:
+      # The caller guaranteed a prefill happened, so the state was LRU-evicted
+      # under concurrency. Falling back would silently restart from an empty
+      # cache — fail loudly instead.
+      raise RequestStateLost(f"request {request_id}: device state evicted mid-generation")
+    # Refresh LRU recency: a request decoding purely through the fused path
+    # must not be evicted mid-generation by newer requests' prefills.
+    self.states.move_to_end(request_id)
+    # The chunk advances the cache by num_tokens starting at pos (the slot of
+    # prev_token's forward step is pos, the last sampled token's is pos+K-1).
+    if state.pos + num_tokens > self.cache_len:
+      if state.pos + 1 > self.cache_len:
+        raise CacheExhausted(f"request {request_id}: cache full at {state.pos}/{self.cache_len}")
+      return None  # tail shorter than a chunk: per-token ring finishes it
+
+    def _chunk() -> np.ndarray:
+      import jax
+      import jax.numpy as jnp
+      from xotorch_tpu.models.generate import decode_chunk
+      self._sample_calls += 1
+      key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+      tok = jnp.asarray([[prev_token]], dtype=jnp.int32)
+      toks, state.cache = decode_chunk(
+        self.params, tok, state.cache, jnp.int32(state.pos), key,
+        self.cfg, num_tokens, float(temp), int(top_k),
+      )
+      state.pos += num_tokens
+      state.last_used = time.monotonic()
+      return np.asarray(toks[0]).astype(np.int64)
+
+    return await self._run(_chunk)
 
   def _new_cache(self):
     import jax.numpy as jnp

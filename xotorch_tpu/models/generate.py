@@ -1,0 +1,62 @@
+"""Fused multi-token decode: forward + sampling under one `lax.scan`.
+
+The reference's decode loop pays a full host round-trip per token — logits
+come back to python, sampling runs there, and the next token is re-dispatched
+(sharded_inference_engine.py:208-228 + node.py:109-147). That cost is
+structural on GPU+gRPC; on TPU it is pure overhead whenever a single
+partition owns the whole model (the common single-host case and the bench
+config). Here the whole decode chunk is ONE XLA computation: `lax.scan` over
+K steps, each step = forward_shard (cache-resident) + on-device Gumbel-max
+sampling, so the host sees K tokens per dispatch instead of per-token
+latency. EOS is checked between chunks on the host; tokens past EOS inside a
+chunk are discarded by the caller (bounded overshoot, amortised to nothing).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from xotorch_tpu.models.config import ModelConfig
+from xotorch_tpu.models.transformer import forward_shard
+from xotorch_tpu.ops.sampling import sample_logits
+
+
+@partial(
+  jax.jit,
+  static_argnames=("cfg", "num_tokens", "temp", "top_k", "top_p"),
+  donate_argnames=("cache",),
+)
+def decode_chunk(
+  params,
+  tok: jnp.ndarray,  # [B, 1] int32 — last sampled token
+  cache: Dict[str, jnp.ndarray],
+  start_pos: jnp.ndarray,  # scalar int32 — absolute position of `tok`
+  key: jax.Array,
+  cfg: ModelConfig,
+  num_tokens: int,
+  temp: float,
+  top_k: int,
+  top_p: float = 0.0,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+  """Generate `num_tokens` tokens in one device program.
+
+  Requires the shard to span the whole model (is_first and is_last). Returns
+  ([B, num_tokens] int32 sampled tokens, updated cache). The incoming `tok`
+  is consumed (its forward step is the first scan iteration); the returned
+  tokens start at position start_pos + 1.
+  """
+
+  def step(carry, _):
+    tok, cache, pos, key = carry
+    logits, cache = forward_shard(params, tok, cache, pos, cfg=cfg, is_first=True, is_last=True)
+    key, sub = jax.random.split(key)
+    nxt = sample_logits(logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p)
+    return (nxt[:, None], cache, pos + 1, key), nxt
+
+  (_, cache, _, _), toks = jax.lax.scan(
+    step, (tok.astype(jnp.int32), cache, start_pos.astype(jnp.int32), key), None, length=num_tokens
+  )
+  return toks.T, cache  # [B, num_tokens]

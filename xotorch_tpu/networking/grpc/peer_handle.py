@@ -84,9 +84,10 @@ class GRPCPeerHandle(PeerHandle):
       return False
 
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
-                        traceparent: Optional[str] = None) -> None:
+                        traceparent: Optional[str] = None, max_tokens: Optional[int] = None) -> None:
     await self._call("SendPrompt", {
       "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
+      "max_tokens": max_tokens,
     })
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
@@ -108,11 +109,13 @@ class GRPCPeerHandle(PeerHandle):
     loss = fields.get("loss")
     return (loss, tensors.get("grads")) if loss is not None else None
 
-  async def send_result(self, request_id: str, result, is_finished: bool) -> None:
+  async def send_result(self, request_id: str, result, is_finished: bool,
+                        error: Optional[str] = None) -> None:
+    fields = {"request_id": request_id, "is_finished": is_finished, "error": error}
     if isinstance(result, np.ndarray):
-      await self._call("SendResult", {"request_id": request_id, "is_finished": is_finished}, {"result": result})
+      await self._call("SendResult", fields, {"result": result})
     else:
-      await self._call("SendResult", {"request_id": request_id, "result": list(result), "is_finished": is_finished})
+      await self._call("SendResult", {**fields, "result": list(result)})
 
   async def send_opaque_status(self, request_id: str, status: str) -> None:
     await self._call("SendOpaqueStatus", {"request_id": request_id, "status": status})

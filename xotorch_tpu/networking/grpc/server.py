@@ -57,7 +57,8 @@ class GRPCServer(Server):
     fields, _ = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
     asyncio.create_task(self.node.process_prompt(
-      shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent")
+      shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
+      max_tokens=fields.get("max_tokens"),
     ))
     return encode_message({"ok": True})
 
@@ -87,11 +88,21 @@ class GRPCServer(Server):
   async def _rpc_send_result(self, request: bytes, context) -> bytes:
     fields, tensors = decode_message(request)
     result = tensors["result"] if "result" in tensors else fields.get("result", [])
+    if fields.get("error"):
+      # Record before triggering so API consumers see the cause when the
+      # finished callback lands.
+      self.node.record_request_error(fields["request_id"], fields["error"])
     self.node.on_token.trigger_all(fields["request_id"], result, fields["is_finished"])
     if fields["is_finished"]:
       # The finished broadcast is how non-sampler peers learn a request ended;
-      # drop their per-request bookkeeping here or it leaks forever.
+      # drop their per-request bookkeeping AND the engine's resident KV cache
+      # for it (an n_layers-deep bf16 buffer in HBM) or both leak until LRU
+      # eviction.
       self.node.finish_request_state(fields["request_id"])
+      self.node.buffered_token_output.pop(fields["request_id"], None)
+      clear = getattr(self.node.inference_engine, "clear_request", None)
+      if clear is not None:
+        asyncio.create_task(clear(fields["request_id"]))
     return encode_message({"ok": True})
 
   async def _rpc_send_opaque_status(self, request: bytes, context) -> bytes:

@@ -51,7 +51,7 @@ class PeerHandle(ABC):
 
   @abstractmethod
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
-                        traceparent: Optional[str] = None) -> None:
+                        traceparent: Optional[str] = None, max_tokens: Optional[int] = None) -> None:
     ...
 
   @abstractmethod
@@ -65,7 +65,8 @@ class PeerHandle(ABC):
     ...
 
   @abstractmethod
-  async def send_result(self, request_id: str, result, is_finished: bool) -> None:
+  async def send_result(self, request_id: str, result, is_finished: bool,
+                        error: Optional[str] = None) -> None:
     ...
 
   @abstractmethod

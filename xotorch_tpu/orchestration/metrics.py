@@ -44,3 +44,9 @@ class NodeMetrics:
   def exposition(self) -> bytes:
     from prometheus_client import generate_latest
     return generate_latest(self.registry)
+
+  def exposition_with_content_type(self) -> tuple:
+    """(body, content_type) pair using the library's exposition constant so
+    scrapers see a conforming endpoint."""
+    from prometheus_client import CONTENT_TYPE_LATEST
+    return self.exposition(), CONTENT_TYPE_LATEST

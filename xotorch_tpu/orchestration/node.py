@@ -22,13 +22,16 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from xotorch_tpu.inference.engine import InferenceEngine, inference_engine_classes
+from xotorch_tpu.inference.engine import (
+  CacheExhausted, InferenceEngine, RequestStateLost, inference_engine_classes,
+)
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.models.registry import get_supported_models
 from xotorch_tpu.networking.discovery import Discovery
@@ -36,8 +39,14 @@ from xotorch_tpu.networking.peer_handle import PeerHandle
 from xotorch_tpu.networking.server import Server
 from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES, device_capabilities
 from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitions_to_shards
+from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
+from xotorch_tpu.orchestration.metrics import NodeMetrics
 from xotorch_tpu.topology.topology import Topology
 from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
+
+# inference_state side-channel key carrying the per-request completion cap to
+# the last-layer peer (companion to tracing.TRACEPARENT_KEY).
+MAX_TOKENS_KEY = "xot_max_tokens"
 
 
 class Node:
@@ -53,6 +62,7 @@ class Node:
     default_sample_temp: float = 0.6,
     default_sample_top_k: int = 35,
     topology_viz=None,
+    decode_chunk_size: Optional[int] = None,
   ):
     self.id = _id
     self.server = server
@@ -64,6 +74,13 @@ class Node:
     self.default_sample_temp = default_sample_temp
     self.default_sample_top_k = default_sample_top_k
     self.topology_viz = topology_viz
+    # Tokens per fused decode dispatch when one partition owns the whole
+    # model; 1 disables (pure per-token ring). Bounds both streaming latency
+    # and the EOS overshoot (tokens computed past EOS are discarded).
+    self.decode_chunk_size = (
+      decode_chunk_size if decode_chunk_size is not None
+      else int(os.getenv("XOT_DECODE_CHUNK", "8"))
+    )
 
     self.peers: List[PeerHandle] = []
     self.topology = Topology()
@@ -82,12 +99,16 @@ class Node:
 
     # Observability: real spans + real prometheus metrics for the intents the
     # reference declared but never wired (SURVEY §0, §5).
-    from xotorch_tpu.orchestration.metrics import NodeMetrics
-    from xotorch_tpu.orchestration.tracing import Tracer
     self.tracer = Tracer(node_id=self.id)
     self.metrics = NodeMetrics(node_id=self.id)
     self._request_trace_ctx: Dict[str, Any] = {}
     self._last_token_time: Dict[str, float] = {}
+    # Per-request completion caps (OpenAI max_tokens); rides the
+    # inference_state side-channel to whichever peer owns the last layer.
+    self._request_max_tokens: Dict[str, int] = {}
+    # Why a request aborted (bounded LRU; API pops entries when reporting).
+    from collections import OrderedDict
+    self.request_errors: "OrderedDict[str, str]" = OrderedDict()
 
   # ------------------------------------------------------------- lifecycle
 
@@ -131,7 +152,6 @@ class Node:
           rid = status.get("request_id")
           tp = status.get("traceparent")
           if rid and tp and rid not in self._request_trace_ctx:
-            from xotorch_tpu.orchestration.tracing import TraceContext
             ctx = TraceContext.from_traceparent(tp)
             if ctx is not None:
               self._request_trace_ctx[rid] = ctx
@@ -147,15 +167,21 @@ class Node:
   # ------------------------------------------------------------ inference
 
   async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None,
-                           traceparent: Optional[str] = None) -> None:
+                           traceparent: Optional[str] = None, max_tokens: Optional[int] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
+    if max_tokens is not None:
+      # Per-request completion cap (OpenAI max_tokens); the node-wide
+      # max_generate_tokens stays the hard ceiling.
+      self._request_max_tokens[request_id] = self._clamp_max_tokens(max_tokens)
     start_ns = time.perf_counter_ns()
-    self.metrics.requests_total.inc()
+    if traceparent is None:
+      # Count only origin requests: a forwarded prompt re-enters process_prompt
+      # on the partition-0 owner and would double the cluster-wide sum.
+      self.metrics.requests_total.inc()
     # A forwarded prompt carries the origin node's trace context; joining it
     # keeps one trace per request across the ring (reference tracing.py:36-70).
-    from xotorch_tpu.orchestration.tracing import TraceContext
     parent_ctx = TraceContext.from_traceparent(traceparent)
     with self.tracer.start_span(
       "process_prompt" if parent_ctx is None else "process_prompt.forwarded",
@@ -171,7 +197,14 @@ class Node:
         "prompt": prompt, "request_id": request_id,
         "traceparent": span.context().traceparent(),
       })))
-      await self._process_prompt(base_shard, prompt, request_id)
+      try:
+        await self._process_prompt(base_shard, prompt, request_id)
+      except Exception as e:
+        print(f"Error processing prompt [{request_id}]: {e!r}")
+        if DEBUG >= 2:
+          import traceback
+          traceback.print_exc()
+        await self._abort_request(request_id, f"prompt processing failed on {self.id}: {e!r}")
     asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
       "type": "node_status", "node_id": self.id, "status": "end_process_prompt",
       "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
@@ -183,6 +216,8 @@ class Node:
       # Not our turn: hand the prompt to the partition-0 owner and stop.
       await self.forward_prompt(base_shard, prompt, request_id, 0)
       return
+    # In a multi-partition ring the EOS/max decision is made by the
+    # last-layer peer; forward_prompt carries the cap there (see below).
     self.outstanding_requests[request_id] = "processing prompt"
     self.metrics.active_requests.set(len(self.outstanding_requests))
     result, inference_state = await self.inference_engine.infer_prompt(request_id, shard, prompt)
@@ -199,12 +234,15 @@ class Node:
     self.metrics.tensor_hops_total.inc()
     # Join the request's trace: the traceparent rides the inference_state
     # side-channel across peers (W3C propagation, reference tracing.py:36-70).
-    from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext
     ctx = self._request_trace_ctx.get(request_id)
     if ctx is None and inference_state:
       ctx = TraceContext.from_traceparent(inference_state.get(TRACEPARENT_KEY))
       if ctx is not None:
         self._request_trace_ctx[request_id] = ctx
+    if inference_state and request_id not in self._request_max_tokens:
+      cap = inference_state.get(MAX_TOKENS_KEY)
+      if cap is not None:
+        self._request_max_tokens[request_id] = self._clamp_max_tokens(cap)
     try:
       with self.tracer.start_span(
         "process_tensor", parent=ctx,
@@ -215,15 +253,58 @@ class Node:
         )
       self.metrics.hop_latency.observe((time.perf_counter_ns() - start_ns) / 1e9)
       await self.process_inference_result(base_shard, result, request_id, inference_state)
+    except CacheExhausted as e:
+      # The KV cache is full: the tokens so far are a valid, truncated
+      # completion — end as a normal "length" finish, not an error.
+      if DEBUG >= 1:
+        print(f"[{request_id}] cache exhausted, finishing as length: {e}")
+      await self._finish_as_length(request_id)
     except Exception as e:
-      self.finish_request_state(request_id)
       print(f"Error processing tensor for shard {shard}: {e!r}")
       if DEBUG >= 2:
         import traceback
         traceback.print_exc()
+      await self._abort_request(request_id, f"tensor hop failed on {self.id} ({shard}): {e!r}")
     finally:
       if DEBUG >= 3:
         print(f"process_tensor elapsed {(time.perf_counter_ns()-start_ns)/1e6:.1f}ms")
+
+  async def _abort_request(self, request_id: str, error: str) -> None:
+    """Terminate a request after a hop error: release local state AND tell
+    every peer it finished, so mid-ring nodes (which only learn request
+    lifecycles from the finished-result broadcast) don't leak bookkeeping or
+    KV caches for a request that will never complete. The reference simply
+    loses in-flight requests on failure (SURVEY §5); broadcasting a finish
+    also unblocks any API client waiting on the token stream. The error
+    string rides the broadcast so API nodes surface a real error instead of
+    an empty successful completion."""
+    self.record_request_error(request_id, error)
+    tokens, _ = self.buffered_token_output.get(request_id, ([], False))
+    self.trigger_on_token_callbacks(request_id, tokens, True)
+    try:
+      await self.broadcast_result(request_id, tokens, True, error=error)
+    except Exception:
+      pass
+    await self._finish_generation(request_id)
+
+  async def _finish_as_length(self, request_id: str) -> None:
+    """End a request gracefully with whatever tokens it produced (used when
+    the KV cache fills before EOS/cap — the OpenAI 'length' outcome)."""
+    tokens, _ = self.buffered_token_output.get(request_id, ([], False))
+    self.buffered_token_output[request_id] = (tokens, True)
+    self.trigger_on_token_callbacks(request_id, tokens, True)
+    try:
+      await self.broadcast_result(request_id, tokens, True)
+    except Exception:
+      pass
+    await self._finish_generation(request_id)
+
+  def record_request_error(self, request_id: str, error: str) -> None:
+    """Remember why a request died (bounded; consumed by the API when it
+    reports the failure to the client)."""
+    self.request_errors[request_id] = error
+    while len(self.request_errors) > 256:
+      self.request_errors.popitem(last=False)
 
   async def process_inference_result(self, base_shard: Shard, result: np.ndarray, request_id: str,
                                      inference_state: Optional[dict] = None) -> None:
@@ -244,39 +325,103 @@ class Node:
       result, temp=self.default_sample_temp, top_k=self.default_sample_top_k
     )
     token_int = int(np.asarray(token).reshape(-1)[0])
-    buffered.append(token_int)
-    now = time.monotonic()
-    last = self._last_token_time.get(request_id)
-    if last is not None:
-      self.metrics.token_latency.observe(now - last)
-    self._last_token_time[request_id] = now
-    self.metrics.tokens_total.inc()
-    self.tracer.record_token(request_id, self._request_trace_ctx.get(request_id))
-    is_finished = (
-      token_int in self._eos_token_ids()
-      or len(buffered) >= self.max_generate_tokens
-    )
-    self.buffered_token_output[request_id] = (buffered, is_finished)
     if DEBUG >= 2:
-      print(f"[{request_id}] token {token_int} ({len(buffered)} so far, finished={is_finished})")
-
-    self.trigger_on_token_callbacks(request_id, buffered, is_finished)
-    asyncio.create_task(self.broadcast_result(request_id, buffered, is_finished))
-
-    if is_finished:
-      self.finish_request_state(request_id)
-      self.buffered_token_output.pop(request_id, None)  # callbacks/broadcast hold the list
-      clear = getattr(self.inference_engine, "clear_request", None)
-      if clear is not None:
-        await clear(request_id)
+      print(f"[{request_id}] token {token_int} ({len(buffered)+1} so far)")
+    if self._ingest_sampled_tokens(request_id, [token_int], buffered):
+      await self._finish_generation(request_id)
       return
 
+    # Fused fast path: when this single partition owns the whole model, decode
+    # K tokens per device dispatch (forward + on-device sampling under one
+    # lax.scan, models/generate.py) instead of paying a host round-trip per
+    # token. Runs DETACHED so the awaited process_prompt chain returns after
+    # the first token and API streaming starts immediately (the per-token
+    # path gets the same property from forward_tensor's create_task).
+    if shard.is_first_layer and self.decode_chunk_size > 1:
+      gen = getattr(self.inference_engine, "generate_chunk", None)
+      if gen is not None:
+        asyncio.create_task(
+          self._fused_decode_loop(base_shard, shard, request_id, buffered, inference_state, gen)
+        )
+        return
+
+    await self._forward_next_token(base_shard, request_id, buffered, inference_state)
+
+  async def _fused_decode_loop(self, base_shard: Shard, shard: Shard, request_id: str,
+                               buffered: List[int], inference_state: Optional[dict], gen) -> None:
+    """Chunked decode until EOS/cap; EOS/max checks happen between chunks and
+    surplus tokens after EOS inside a chunk are discarded."""
+    try:
+      self.outstanding_requests[request_id] = "generating"
+      while True:
+        chunk = await gen(
+          request_id, shard, buffered[-1], self.decode_chunk_size,
+          temp=self.default_sample_temp, top_k=self.default_sample_top_k,
+        )
+        if chunk is None:
+          # Fast path unavailable (cache nearly full, shard changed): fall
+          # back to the per-token ring.
+          await self._forward_next_token(base_shard, request_id, buffered, inference_state)
+          return
+        if self._ingest_sampled_tokens(request_id, chunk.reshape(-1).tolist(), buffered):
+          await self._finish_generation(request_id)
+          return
+    except CacheExhausted as e:
+      if DEBUG >= 1:
+        print(f"[{request_id}] cache exhausted, finishing as length: {e}")
+      await self._finish_as_length(request_id)
+    except Exception as e:
+      print(f"Error in fused decode for [{request_id}]: {e!r}")
+      if DEBUG >= 2:
+        import traceback
+        traceback.print_exc()
+      await self._abort_request(request_id, f"fused decode failed on {self.id}: {e!r}")
+
+  async def _forward_next_token(self, base_shard: Shard, request_id: str,
+                                buffered: List[int], inference_state: Optional[dict]) -> None:
     # Feed the sampled token back to partition 0 for the next decode step.
     self.outstanding_requests[request_id] = "waiting"
     await self.forward_tensor(
-      base_shard, np.asarray([[token_int]], dtype=np.int64), request_id,
+      base_shard, np.asarray([[buffered[-1]]], dtype=np.int64), request_id,
       self.get_partition_index_of_first_layer(), inference_state,
     )
+
+  def _ingest_sampled_tokens(self, request_id: str, new_tokens: List[int], buffered: List[int]) -> bool:
+    """Shared per-token accounting for the per-token ring and the fused chunk
+    path: append to the request buffer (stopping at EOS or the request cap),
+    update metrics/trace, fire callbacks, and broadcast. Returns finished."""
+    eos = self._eos_token_ids()
+    limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
+    trace_ctx = self._request_trace_ctx.get(request_id)
+    now = time.monotonic()
+    last = self._last_token_time.get(request_id)
+    appended = 0
+    finished = False
+    for t in new_tokens:
+      buffered.append(int(t))
+      appended += 1
+      self.metrics.tokens_total.inc()
+      self.tracer.record_token(request_id, trace_ctx)
+      if int(t) in eos or len(buffered) >= limit:
+        finished = True
+        break
+    if last is not None and appended:
+      self.metrics.token_latency.observe((now - last) / appended)
+    self._last_token_time[request_id] = now
+    self.buffered_token_output[request_id] = (buffered, finished)
+    self.trigger_on_token_callbacks(request_id, buffered, finished)
+    asyncio.create_task(self.broadcast_result(request_id, buffered, finished))
+    return finished
+
+  async def _finish_generation(self, request_id: str) -> None:
+    self.finish_request_state(request_id)
+    self.buffered_token_output.pop(request_id, None)  # callbacks/broadcast hold the list
+    clear = getattr(self.inference_engine, "clear_request", None)
+    if clear is not None:
+      await clear(request_id)
+
+  def _clamp_max_tokens(self, cap: Any) -> int:
+    return max(1, min(int(cap), self.max_generate_tokens))
 
   def _eos_token_ids(self) -> Tuple[int, ...]:
     tokenizer = getattr(self.inference_engine, "tokenizer", None)
@@ -322,7 +467,8 @@ class Node:
       raise ValueError(f"Peer for {target_index} ({target_id}) not found")
     ctx = self._request_trace_ctx.get(request_id)
     await peer.send_prompt(next_shard, prompt, request_id,
-                           traceparent=ctx.traceparent() if ctx else None)
+                           traceparent=ctx.traceparent() if ctx else None,
+                           max_tokens=self._request_max_tokens.get(request_id))
 
   async def forward_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, target_index: int,
                            inference_state: Optional[dict] = None) -> None:
@@ -333,8 +479,10 @@ class Node:
     # request's trace (rides the existing inference_state side-channel).
     ctx = self._request_trace_ctx.get(request_id)
     if ctx is not None:
-      from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY
       inference_state = {**(inference_state or {}), TRACEPARENT_KEY: ctx.traceparent()}
+    cap = self._request_max_tokens.get(request_id)
+    if cap is not None:
+      inference_state = {**(inference_state or {}), MAX_TOKENS_KEY: cap}
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
@@ -536,14 +684,16 @@ class Node:
     self.tracer.finish_request(request_id)
     self._request_trace_ctx.pop(request_id, None)
     self._last_token_time.pop(request_id, None)
+    self._request_max_tokens.pop(request_id, None)
 
   def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
     self.on_token.trigger_all(request_id, tokens, is_finished)
 
-  async def broadcast_result(self, request_id: str, result: List[int], is_finished: bool) -> None:
+  async def broadcast_result(self, request_id: str, result: List[int], is_finished: bool,
+                             error: Optional[str] = None) -> None:
     async def send(peer):
       try:
-        await asyncio.wait_for(peer.send_result(request_id, result, is_finished), timeout=15.0)
+        await asyncio.wait_for(peer.send_result(request_id, result, is_finished, error=error), timeout=15.0)
       except Exception as e:
         if DEBUG >= 2:
           print(f"broadcast_result to {peer.id()} failed: {e!r}")

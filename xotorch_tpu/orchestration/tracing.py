@@ -190,7 +190,15 @@ class Tracer:
     with self._lock:
       spans = [s.to_dict() for s in self._finished if trace_id is None or s.trace_id == trace_id]
       if clear:
-        self._finished.clear()
+        if trace_id is None:
+          self._finished.clear()
+        else:
+          # Drain only the requested trace; other traces stay readable and
+          # the buffer keeps its max_spans bound.
+          self._finished = deque(
+            (s for s in self._finished if s.trace_id != trace_id),
+            maxlen=self._finished.maxlen,
+          )
     return spans
 
 
