@@ -168,7 +168,7 @@ async def test_engine_failure_returns_500_not_empty_200():
   successful completion."""
   client, node, engine = await _api_client()
 
-  async def exploding_infer_prompt(request_id, shard, prompt):
+  async def exploding_infer_prompt(request_id, shard, prompt, **kwargs):
     raise RuntimeError("engine exploded")
 
   engine.infer_prompt = exploding_infer_prompt
@@ -191,5 +191,24 @@ async def test_engine_failure_returns_500_not_empty_200():
     assert events[-1] == "[DONE]"
     payloads = [json.loads(e) for e in events[:-1]]
     assert any("error" in p for p in payloads)
+  finally:
+    await client.close()
+
+
+async def test_malformed_image_payload_rejected_with_400():
+  client, node, _ = await _api_client()
+  try:
+    for bad_url in ("data:image/png", "data:image/png;base64,!!!notb64!!!",
+                    "data:image/png;base64,aGVsbG8=", "https://example.com/cat.png"):
+      resp = await client.post("/v1/chat/completions", json={
+        "model": "dummy",
+        "messages": [{"role": "user", "content": [
+          {"type": "text", "text": "look"},
+          {"type": "image_url", "image_url": {"url": bad_url}},
+        ]}],
+      })
+      assert resp.status == 400, (bad_url, resp.status)
+      body = await resp.json()
+      assert body["error"]["type"] == "invalid_request_error"
   finally:
     await client.close()

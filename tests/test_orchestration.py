@@ -298,7 +298,7 @@ async def test_prompt_error_aborts_request():
   is_finished) instead of leaving API clients hanging until timeout."""
   engine = DummyInferenceEngine()
 
-  async def exploding_infer_prompt(request_id, shard, prompt):
+  async def exploding_infer_prompt(request_id, shard, prompt, **kwargs):
     raise RuntimeError("prefill boom")
 
   engine.infer_prompt = exploding_infer_prompt

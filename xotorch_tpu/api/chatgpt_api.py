@@ -33,13 +33,40 @@ class PromptSession:
     self.prompt = prompt
 
 
+def extract_images(messages: List[dict]) -> list:
+  """Decode image_url content parts (data: URIs) to uint8 HWC arrays, in
+  prompt order. Unlike the reference (which remaps every image to the LAST
+  placeholder, chatgpt_api.py:97-128), multi-image prompts keep all images."""
+  from xotorch_tpu.models.vision import decode_image_data_uri
+  images = []
+  for m in messages:
+    content = m.get("content", "")
+    if not isinstance(content, list):
+      continue
+    for part in content:
+      if isinstance(part, dict) and part.get("type") == "image_url":
+        url = (part.get("image_url") or {}).get("url", "")
+        images.append(decode_image_data_uri(url))
+  return images
+
+
 def build_prompt(tokenizer, messages: List[dict], tools: Optional[list] = None) -> str:
-  """Chat-template prompt build with UTF-8 fallback (parity :131-150)."""
+  """Chat-template prompt build with UTF-8 fallback (parity :131-150).
+  image_url parts become <image> placeholders at their position in the
+  message (LLaVA convention; the engine splices patch features there)."""
   chat = []
   for m in messages:
     content = m.get("content", "")
-    if isinstance(content, list):  # multi-part content: join text parts
-      content = "\n".join(part.get("text", "") for part in content if isinstance(part, dict) and part.get("type") == "text")
+    if isinstance(content, list):  # multi-part content: text + image parts
+      pieces = []
+      for part in content:
+        if not isinstance(part, dict):
+          continue
+        if part.get("type") == "text":
+          pieces.append(part.get("text", ""))
+        elif part.get("type") == "image_url":
+          pieces.append("<image>")
+      content = "\n".join(pieces)
     chat.append({"role": m.get("role", "user"), "content": content})
   try:
     kwargs = {"tokenize": False, "add_generation_prompt": True}
@@ -282,9 +309,15 @@ class ChatGPTAPI:
                      "message": f"max_tokens must be a positive integer, got {max_tokens!r}"}},
           status=400,
         )
+    try:
+      images = extract_images(data.get("messages", [])) or None
+    except ValueError as e:
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": str(e)}}, status=400
+      )
     self.token_queues[request_id] = asyncio.Queue()
     try:
-      await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens)
+      await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens, images=images)
       if stream:
         return await self._stream_response(request, request_id, model, tokenizer)
       return await self._full_response(request_id, model, tokenizer, prompt)
@@ -296,6 +329,14 @@ class ChatGPTAPI:
     if model.startswith("synthetic") or model == "dummy":
       from xotorch_tpu.inference.tokenizers import DummyTokenizer
       return DummyTokenizer()
+    # The engine resolves its tokenizer from the local model dir at load time;
+    # reuse it when it serves the same model — no duplicate load, and no
+    # network dependency in offline deployments.
+    engine = self.node.inference_engine
+    engine_shard = getattr(engine, "shard", None)
+    engine_tok = getattr(engine, "tokenizer", None)
+    if engine_tok is not None and engine_shard is not None and engine_shard.model_id == model:
+      return engine_tok
     target = get_repo(model, self.inference_engine_classname)
     if self.node.shard_downloader is not None:
       try:
@@ -378,9 +419,11 @@ class ChatGPTAPI:
     while not finished:
       timeout = max(0.1, deadline - time.monotonic())
       try:
-        tokens, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
+        payload, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
       except asyncio.TimeoutError:
         return web.json_response({"detail": "Response timed out"}, status=408)
+      if len(payload) >= len(tokens):
+        tokens = payload  # an empty finish signal must not wipe the completion
       deadline = time.monotonic() + self.response_timeout
     error = self.node.request_errors.pop(request_id, None)
     if error is not None:

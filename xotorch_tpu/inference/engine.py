@@ -60,8 +60,11 @@ class InferenceEngine(ABC):
     ...
 
   async def infer_prompt(
-    self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None
+    self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
+    images: Optional[list] = None,
   ) -> Tuple[np.ndarray, Optional[dict]]:
+    """Default text path: encode -> infer_tensor. Engines with a vision tower
+    override to consume `images` (list of uint8 HWC numpy arrays)."""
     tokens = await self.encode(shard, prompt)
     x = tokens.reshape(1, -1)
     return await self.infer_tensor(request_id, shard, x, inference_state)

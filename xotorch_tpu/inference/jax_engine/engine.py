@@ -147,16 +147,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax
     import jax.numpy as jnp
 
-    state = self.states.get(request_id)
-    if state is None:
-      state = _RequestState(cache=self._new_cache(), pos=0, last_used=time.monotonic())
-      self.states[request_id] = state
-      while len(self.states) > MAX_RESIDENT_REQUESTS:
-        evicted, _ = self.states.popitem(last=False)
-        if DEBUG >= 2:
-          print(f"Evicted request state {evicted}")
-    # True LRU: refresh recency on every touch, not just creation.
-    self.states.move_to_end(request_id)
+    state = self._get_or_create_state(request_id)
 
     if input_data.ndim == 2:
       x = jnp.asarray(input_data.astype(np.int32))
@@ -191,6 +182,51 @@ class JAXShardInferenceEngine(InferenceEngine):
     # Padded tail positions carry garbage activations; they are overwritten in
     # cache by subsequent decode steps before ever becoming visible (the
     # causal mask hides them until then), but must be sliced off the output.
+    return np.asarray(out[:, :true_t])
+
+  async def infer_prompt(
+    self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
+    images: Optional[list] = None,
+  ) -> Tuple[np.ndarray, Optional[dict]]:
+    await self.ensure_shard(shard)
+    if not images or not (self.cfg and self.cfg.is_multimodal):
+      return await super().infer_prompt(request_id, shard, prompt, inference_state)
+    tokens = await self.encode(shard, prompt)
+    out = await self._run(self._infer_multimodal_sync, request_id, tokens.reshape(-1), images)
+    return out, inference_state
+
+  def _infer_multimodal_sync(self, request_id: str, token_ids: np.ndarray, images: list) -> np.ndarray:
+    """Multimodal prefill: vision tower -> projector -> splice patch features
+    at <image> placeholder positions -> run the text stack on the merged
+    embedding sequence (is_first=False jit). LLaVA-1.5 semantics, verified
+    against transformers in tests/test_vision_llava.py."""
+    import jax.numpy as jnp
+    from xotorch_tpu.models.vision import encode_images, merge_image_features, preprocess_images, project_features
+
+    if self._vision is None:
+      raise RuntimeError("vision weights unavailable for multimodal request")
+    vparams, pparams = self._vision
+    cfg = self.cfg
+    pixels = preprocess_images(images, cfg.vision.image_size)
+    feats = encode_images(vparams, jnp.asarray(pixels), cfg.vision,
+                          feature_layer=cfg.vision_feature_layer,
+                          select=cfg.vision_feature_select)
+    feats = project_features(pparams, feats)
+    token_embeds = self.params["embed"]["embedding"][jnp.asarray(token_ids.astype(np.int32))]
+    merged = merge_image_features(token_embeds, token_ids, feats, cfg.image_token_index)
+
+    state = self._get_or_create_state(request_id)
+
+    true_t = merged.shape[0]
+    bucket = 1 if true_t == 1 else _bucket(true_t)
+    if state.pos + bucket > self.cache_len:
+      raise CacheExhausted(f"multimodal prompt of {true_t} embeddings exceeds cache {self.cache_len}")
+    x = merged[None]
+    if bucket != true_t:
+      x = jnp.pad(x, [(0, 0), (0, bucket - true_t), (0, 0)])
+    out, state.cache = self._forward_hidden_jit(self.params, x.astype(self._dtype()), state.cache, jnp.int32(state.pos))
+    state.pos += true_t
+    state.last_used = time.monotonic()
     return np.asarray(out[:, :true_t])
 
   async def generate_chunk(
@@ -239,6 +275,21 @@ class JAXShardInferenceEngine(InferenceEngine):
 
     return await self._run(_chunk)
 
+  def _get_or_create_state(self, request_id: str) -> _RequestState:
+    """Per-request device state with LRU residency (shared by the text,
+    multimodal, and fused-decode paths — one lifecycle, no drift)."""
+    state = self.states.get(request_id)
+    if state is None:
+      state = _RequestState(cache=self._new_cache(), pos=0, last_used=time.monotonic())
+      self.states[request_id] = state
+      while len(self.states) > MAX_RESIDENT_REQUESTS:
+        evicted, _ = self.states.popitem(last=False)
+        if DEBUG >= 2:
+          print(f"Evicted request state {evicted}")
+    # True LRU: refresh recency on every touch, not just creation.
+    self.states.move_to_end(request_id)
+    return state
+
   def _new_cache(self):
     import jax.numpy as jnp
     from xotorch_tpu.models.transformer import init_kv_cache
@@ -286,9 +337,22 @@ class JAXShardInferenceEngine(InferenceEngine):
       )
       forward_jit = jax.jit(fwd, donate_argnums=(2,))
       forward_flash_jit = jax.jit(partial(fwd, use_flash=True), donate_argnums=(2,))
-      return cfg, params, forward_jit, forward_flash_jit
+      # Multimodal prefill injects merged (text+image) embeddings as hidden
+      # state, bypassing the token-embedding lookup: an is_first=False jit.
+      forward_hidden_jit = None
+      vision = None
+      if cfg.is_multimodal and shard.is_first_layer:
+        forward_hidden_jit = jax.jit(
+          partial(forward_shard, cfg=cfg, is_first=False, is_last=shard.is_last_layer),
+          donate_argnums=(2,),
+        )
+        if model_dir is not None:
+          from xotorch_tpu.models.weights import load_vision_tower
+          vision = load_vision_tower(model_dir, cfg, dtype=self._dtype())
+      return cfg, params, forward_jit, forward_flash_jit, forward_hidden_jit, vision
 
-    self.cfg, self.params, self._forward_jit, self._forward_flash_jit = await self._run(_load)
+    (self.cfg, self.params, self._forward_jit, self._forward_flash_jit,
+     self._forward_hidden_jit, self._vision) = await self._run(_load)
     self._opt_state = None  # optimizer state is invalid for a new param tree
     self.cache_len = min(self._configured_cache_len, self.cfg.max_seq_len)
     self._model_dir = model_dir

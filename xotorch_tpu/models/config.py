@@ -47,16 +47,37 @@ class ModelConfig:
   moe_intermediate_size: int = 0
   norm_topk_prob: bool = False
   eos_token_ids: Tuple[int, ...] = ()
+  # Multimodal (llava-style): hashable VisionConfig keeps jit cache keys
+  # working; None = text-only.
+  vision: Optional["object"] = None  # models.vision.VisionConfig
+  image_token_index: int = -1
+  vision_feature_layer: int = -2
+  vision_feature_select: str = "default"
 
   @property
   def is_moe(self) -> bool:
     return self.num_experts > 0
 
+  @property
+  def is_multimodal(self) -> bool:
+    return self.vision is not None
+
 
 def config_from_hf_dict(cfg: dict) -> ModelConfig:
   model_type = cfg.get("model_type", "llama")
-  # Multimodal configs nest the decoder under text_config (llava et al).
+  # Multimodal configs nest the decoder under text_config (llava et al);
+  # capture the vision side before descending.
+  vision = None
+  image_token_index = -1
+  vision_feature_layer = -2
+  vision_feature_select = "default"
   if "text_config" in cfg:
+    if "vision_config" in cfg:
+      from xotorch_tpu.models.vision import vision_config_from_hf
+      vision = vision_config_from_hf(cfg["vision_config"])
+      image_token_index = int(cfg.get("image_token_index", 32000))
+      vision_feature_layer = int(cfg.get("vision_feature_layer", -2))
+      vision_feature_select = str(cfg.get("vision_feature_select_strategy", "default"))
     inner = dict(cfg["text_config"])
     inner.setdefault("model_type", inner.get("model_type", model_type))
     cfg = inner
@@ -112,6 +133,10 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
     moe_intermediate_size=int(cfg.get("moe_intermediate_size", 0) or 0),
     norm_topk_prob=bool(cfg.get("norm_topk_prob", False)),
     eos_token_ids=eos,
+    vision=vision,
+    image_token_index=image_token_index,
+    vision_feature_layer=vision_feature_layer,
+    vision_feature_select=vision_feature_select,
   )
 
 

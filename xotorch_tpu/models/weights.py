@@ -37,8 +37,16 @@ def layer_of(tensor_name: str) -> Optional[int]:
 def tensor_names_for_shard(all_names: List[str], shard: Shard, tie_word_embeddings: bool) -> List[str]:
   """Which checkpoint tensors a shard needs (drives both loading and the
   downloader's layer-aware file filtering, parity: hf_helpers.py:74-98)."""
+  from xotorch_tpu.models.vision import is_vision_tensor
+
   wanted = []
   for name in all_names:
+    if is_vision_tensor(name):
+      # Vision tower + projector live with the first shard (they feed the
+      # embedding merge); their encoder.layers.N names are NOT text layers.
+      if shard.is_first_layer:
+        wanted.append(name)
+      continue
     layer = layer_of(name)
     if layer is not None:
       if shard.start_layer <= layer <= shard.end_layer:
@@ -123,8 +131,9 @@ def load_shard_params(
   """Load a shard's params in the stacked layout used by forward_shard."""
   model_dir = Path(model_dir)
   index = _index_for(model_dir)
+  from xotorch_tpu.models.vision import is_vision_tensor
   names = tensor_names_for_shard(list(index.keys()), shard, cfg.tie_word_embeddings)
-  raw = _read_tensors(model_dir, names, index)
+  raw = _read_tensors(model_dir, [n for n in names if not is_vision_tensor(n)], index)
   t = {_strip_prefix(k): v for k, v in raw.items()}
   _split_fused_projections(t, cfg)
 
@@ -186,6 +195,18 @@ def load_shard_params(
     n_params = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params))
     print(f"Loaded shard {shard}: {n_params/1e6:.1f}M params from {model_dir}")
   return params
+
+
+def load_vision_tower(model_dir: Path, cfg: ModelConfig, dtype=jnp.float32):
+  """Read the vision tower + projector tensors of a llava-style checkpoint
+  and build (vision params, projector params). First-shard only."""
+  from xotorch_tpu.models.vision import is_vision_tensor, load_vision_params
+
+  model_dir = Path(model_dir)
+  index = _index_for(model_dir)
+  names = [n for n in index if is_vision_tensor(n)]
+  raw = _read_tensors(model_dir, names, index)
+  return load_vision_params(raw, cfg.vision, dtype=dtype)
 
 
 def save_shard_params(params: Dict[str, Any], cfg: ModelConfig, shard: Shard, out_path: Path) -> None:

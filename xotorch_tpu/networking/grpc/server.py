@@ -54,11 +54,12 @@ class GRPCServer(Server):
     # Ack immediately and process in the background: a ring hop's RPC must
     # not stay open for the remainder of the generation (the chain would
     # otherwise exceed any sane deadline and couple peer lifetimes).
-    fields, _ = decode_message(request)
+    fields, tensors = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
+    images = [tensors[f"image_{i}"] for i in range(fields.get("n_images") or 0)] or None
     asyncio.create_task(self.node.process_prompt(
       shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
-      max_tokens=fields.get("max_tokens"),
+      max_tokens=fields.get("max_tokens"), images=images,
     ))
     return encode_message({"ok": True})
 
@@ -87,22 +88,23 @@ class GRPCServer(Server):
 
   async def _rpc_send_result(self, request: bytes, context) -> bytes:
     fields, tensors = decode_message(request)
+    request_id = fields["request_id"]
     result = tensors["result"] if "result" in tensors else fields.get("result", [])
+    if not result and fields["is_finished"]:
+      # A mid-ring abort/exhaustion broadcast carries no token payload (only
+      # the sampler buffers tokens); fall back to whatever this peer knows so
+      # listeners aren't handed an empty completion.
+      result = self.node.buffered_token_output.get(request_id, ([], False))[0]
     if fields.get("error"):
       # Record before triggering so API consumers see the cause when the
       # finished callback lands.
-      self.node.record_request_error(fields["request_id"], fields["error"])
-    self.node.on_token.trigger_all(fields["request_id"], result, fields["is_finished"])
+      self.node.record_request_error(request_id, fields["error"])
+    self.node.on_token.trigger_all(request_id, result, fields["is_finished"])
     if fields["is_finished"]:
-      # The finished broadcast is how non-sampler peers learn a request ended;
-      # drop their per-request bookkeeping AND the engine's resident KV cache
-      # for it (an n_layers-deep bf16 buffer in HBM) or both leak until LRU
-      # eviction.
-      self.node.finish_request_state(fields["request_id"])
-      self.node.buffered_token_output.pop(fields["request_id"], None)
-      clear = getattr(self.node.inference_engine, "clear_request", None)
-      if clear is not None:
-        asyncio.create_task(clear(fields["request_id"]))
+      # The finished broadcast is how non-sampler peers learn a request
+      # ended; run the same cleanup the sampler runs (bookkeeping + the
+      # engine's resident KV cache).
+      await self.node._finish_generation(request_id)
     return encode_message({"ok": True})
 
   async def _rpc_send_opaque_status(self, request: bytes, context) -> bytes:

@@ -167,7 +167,8 @@ class Node:
   # ------------------------------------------------------------ inference
 
   async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None,
-                           traceparent: Optional[str] = None, max_tokens: Optional[int] = None) -> None:
+                           traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
+                           images: Optional[List[np.ndarray]] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
@@ -198,7 +199,7 @@ class Node:
         "traceparent": span.context().traceparent(),
       })))
       try:
-        await self._process_prompt(base_shard, prompt, request_id)
+        await self._process_prompt(base_shard, prompt, request_id, images)
       except Exception as e:
         print(f"Error processing prompt [{request_id}]: {e!r}")
         if DEBUG >= 2:
@@ -210,17 +211,20 @@ class Node:
       "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
     })))
 
-  async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str) -> None:
+  async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str,
+                            images: Optional[List[np.ndarray]] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if not shard.is_first_layer:
       # Not our turn: hand the prompt to the partition-0 owner and stop.
-      await self.forward_prompt(base_shard, prompt, request_id, 0)
+      await self.forward_prompt(base_shard, prompt, request_id, 0, images)
       return
     # In a multi-partition ring the EOS/max decision is made by the
     # last-layer peer; forward_prompt carries the cap there (see below).
     self.outstanding_requests[request_id] = "processing prompt"
     self.metrics.active_requests.set(len(self.outstanding_requests))
-    result, inference_state = await self.inference_engine.infer_prompt(request_id, shard, prompt)
+    result, inference_state = await self.inference_engine.infer_prompt(
+      request_id, shard, prompt, images=images
+    )
     await self.process_inference_result(base_shard, result, request_id, inference_state)
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
@@ -453,14 +457,15 @@ class Node:
     shards = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)
     return shards[index]
 
-  async def forward_prompt(self, base_shard: Shard, prompt: str, request_id: str, target_index: int) -> None:
+  async def forward_prompt(self, base_shard: Shard, prompt: str, request_id: str, target_index: int,
+                           images: Optional[List[np.ndarray]] = None) -> None:
     if DEBUG >= 1:
       print(f"Forwarding prompt [{request_id}] to partition {target_index}")
     partitions = self.partitioning_strategy.partition(self.topology)
     target_id = partitions[target_index].node_id
     next_shard = self.get_current_shard(base_shard, target_index)
     if target_id == self.id:
-      await self._process_prompt(base_shard, prompt, request_id)
+      await self._process_prompt(base_shard, prompt, request_id, images)
       return
     peer = next((p for p in self.peers if p.id() == target_id), None)
     if peer is None:
@@ -468,7 +473,8 @@ class Node:
     ctx = self._request_trace_ctx.get(request_id)
     await peer.send_prompt(next_shard, prompt, request_id,
                            traceparent=ctx.traceparent() if ctx else None,
-                           max_tokens=self._request_max_tokens.get(request_id))
+                           max_tokens=self._request_max_tokens.get(request_id),
+                           images=images)
 
   async def forward_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, target_index: int,
                            inference_state: Optional[dict] = None) -> None:
