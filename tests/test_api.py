@@ -212,3 +212,27 @@ async def test_malformed_image_payload_rejected_with_400():
       assert body["error"]["type"] == "invalid_request_error"
   finally:
     await client.close()
+
+
+async def test_image_on_text_only_model_rejected():
+  """Images sent to a non-vision model must be rejected, not silently
+  dropped (the model would confidently answer about an unseen image)."""
+  import base64, io
+  from PIL import Image
+  client, node, _ = await _api_client()
+  buf = io.BytesIO()
+  Image.new("RGB", (4, 4), (0, 128, 255)).save(buf, format="PNG")
+  uri = "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy",
+      "messages": [{"role": "user", "content": [
+        {"type": "text", "text": "what is this"},
+        {"type": "image_url", "image_url": {"url": uri}},
+      ]}],
+    })
+    assert resp.status == 400
+    body = await resp.json()
+    assert "does not support image" in body["error"]["message"]
+  finally:
+    await client.close()

@@ -110,7 +110,9 @@ class ChatGPTAPI:
     r.add_get("/healthcheck", self.handle_healthcheck)
     r.add_get("/v1/download/progress", self.handle_get_download_progress)
     r.add_delete("/models/{model_name}", self.handle_delete_model)
+    r.add_delete("/v1/models/{model_name}", self.handle_delete_model)
     r.add_post("/download", self.handle_post_download)
+    r.add_post("/v1/download", self.handle_post_download)
     r.add_get("/initial_models", self.handle_get_initial_models)
     r.add_get("/quit", self.handle_quit)
     # Observability: span export + prometheus exposition + device traces
@@ -248,6 +250,8 @@ class ChatGPTAPI:
     card = get_model_card(model_id)
     if not card or self.inference_engine_classname not in card.get("repo", {}):
       return web.json_response({"detail": f"Invalid model: {model_id}"}, status=400)
+    if self.node.shard_downloader is None:
+      return web.json_response({"detail": "No shard downloader configured on this node"}, status=503)
     shard = build_base_shard(model_id, self.inference_engine_classname)
     asyncio.create_task(self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname))
     return web.json_response({"status": "success", "message": f"Download started: {model_id}"})
@@ -314,6 +318,12 @@ class ChatGPTAPI:
     except ValueError as e:
       return web.json_response(
         {"error": {"type": "invalid_request_error", "message": str(e)}}, status=400
+      )
+    if images and not (get_model_card(model) or {}).get("vision"):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": f"model {model} does not support image input"}},
+        status=400,
       )
     self.token_queues[request_id] = asyncio.Queue()
     try:

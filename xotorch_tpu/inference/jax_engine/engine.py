@@ -189,8 +189,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     images: Optional[list] = None,
   ) -> Tuple[np.ndarray, Optional[dict]]:
     await self.ensure_shard(shard)
-    if not images or not (self.cfg and self.cfg.is_multimodal):
+    if not images:
       return await super().infer_prompt(request_id, shard, prompt, inference_state)
+    if not (self.cfg and self.cfg.is_multimodal):
+      # Defense in depth (the API rejects this earlier): never silently answer
+      # about an image the model cannot see.
+      raise ValueError(f"model {shard.model_id} does not support image input")
     tokens = await self.encode(shard, prompt)
     out = await self._run(self._infer_multimodal_sync, request_id, tokens.reshape(-1), images)
     return out, inference_state
@@ -224,7 +228,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     x = merged[None]
     if bucket != true_t:
       x = jnp.pad(x, [(0, 0), (0, bucket - true_t), (0, 0)])
-    out, state.cache = self._forward_hidden_jit(self.params, x.astype(self._dtype()), state.cache, jnp.int32(state.pos))
+    forward = self._forward_hidden_jit
+    if true_t > 1 and state.pos == 0 and self._flash_enabled():
+      forward = self._forward_hidden_flash_jit
+    out, state.cache = forward(self.params, x.astype(self._dtype()), state.cache, jnp.int32(state.pos))
     state.pos += true_t
     state.last_used = time.monotonic()
     return np.asarray(out[:, :true_t])
@@ -340,19 +347,21 @@ class JAXShardInferenceEngine(InferenceEngine):
       # Multimodal prefill injects merged (text+image) embeddings as hidden
       # state, bypassing the token-embedding lookup: an is_first=False jit.
       forward_hidden_jit = None
+      forward_hidden_flash_jit = None
       vision = None
       if cfg.is_multimodal and shard.is_first_layer:
-        forward_hidden_jit = jax.jit(
-          partial(forward_shard, cfg=cfg, is_first=False, is_last=shard.is_last_layer),
-          donate_argnums=(2,),
-        )
+        hidden_fwd = partial(forward_shard, cfg=cfg, is_first=False, is_last=shard.is_last_layer)
+        forward_hidden_jit = jax.jit(hidden_fwd, donate_argnums=(2,))
+        # Image prompts are the longest fresh-context prefills (576 patches
+        # per image on llava-1.5) — they deserve the Pallas flash path too.
+        forward_hidden_flash_jit = jax.jit(partial(hidden_fwd, use_flash=True), donate_argnums=(2,))
         if model_dir is not None:
           from xotorch_tpu.models.weights import load_vision_tower
           vision = load_vision_tower(model_dir, cfg, dtype=self._dtype())
-      return cfg, params, forward_jit, forward_flash_jit, forward_hidden_jit, vision
+      return cfg, params, forward_jit, forward_flash_jit, forward_hidden_jit, forward_hidden_flash_jit, vision
 
     (self.cfg, self.params, self._forward_jit, self._forward_flash_jit,
-     self._forward_hidden_jit, self._vision) = await self._run(_load)
+     self._forward_hidden_jit, self._forward_hidden_flash_jit, self._vision) = await self._run(_load)
     self._opt_state = None  # optimizer state is invalid for a new param tree
     self.cache_len = min(self._configured_cache_len, self.cfg.max_seq_len)
     self._model_dir = model_dir

@@ -148,8 +148,17 @@ def is_vision_tensor(name: str) -> bool:
 
 def load_vision_params(raw: Dict[str, jnp.ndarray], vcfg: VisionConfig, dtype=jnp.float32) -> Tuple[Params, Params]:
   """Build (vision tower params, projector params) from raw HF tensors
-  (llava checkpoint names)."""
-  t = {k[len(_VISION_PREFIX):] if k.startswith(_VISION_PREFIX) else k: v for k, v in raw.items()}
+  (llava checkpoint names; any wrapper prefix before vision_tower./
+  multi_modal_projector. is stripped)."""
+  def canon(name: str) -> str:
+    for marker in (_VISION_PREFIX, _PROJ_PREFIX):
+      idx = name.find(marker)
+      if idx >= 0:
+        stripped = name[idx + len(marker):]
+        return stripped if marker == _VISION_PREFIX else _PROJ_PREFIX + stripped
+    return name
+
+  t = {canon(k): v for k, v in raw.items()}
 
   def lin(name: str) -> jnp.ndarray:
     return t[name].T.astype(dtype)
@@ -263,16 +272,17 @@ def merge_image_features(
   """LLaVA-1.5 merge: each <image> placeholder token expands into that
   image's N patch features (sequence grows by n_images*(N-1)). Host-side
   (prefill-only, once per request)."""
-  pieces = []
-  img_idx = 0
   ids = np.asarray(token_ids).reshape(-1)
+  positions = np.where(ids == image_token_id)[0]
+  if len(positions) != image_feats.shape[0]:
+    raise ValueError(
+      f"prompt has {len(positions)} image placeholders but {image_feats.shape[0]} images were provided"
+    )
+  pieces = []
   start = 0
-  for pos in np.where(ids == image_token_id)[0]:
+  for img_idx, pos in enumerate(positions):
     pieces.append(token_embeds[start:pos])
     pieces.append(image_feats[img_idx].astype(token_embeds.dtype))
-    img_idx += 1
     start = pos + 1
   pieces.append(token_embeds[start:])
-  if img_idx != image_feats.shape[0]:
-    raise ValueError(f"prompt has {img_idx} image placeholders but {image_feats.shape[0]} images were provided")
   return jnp.concatenate(pieces, axis=0)

@@ -90,7 +90,7 @@ class GRPCServer(Server):
     fields, tensors = decode_message(request)
     request_id = fields["request_id"]
     result = tensors["result"] if "result" in tensors else fields.get("result", [])
-    if not result and fields["is_finished"]:
+    if len(result) == 0 and fields["is_finished"]:  # len(), not truthiness: result may be an ndarray
       # A mid-ring abort/exhaustion broadcast carries no token payload (only
       # the sampler buffers tokens); fall back to whatever this peer knows so
       # listeners aren't handed an empty completion.
