@@ -1,22 +1,35 @@
-"""Benchmark: single-chip greedy decode throughput on the flagship model.
+"""Benchmark: greedy/sampled decode throughput on the flagship model.
 
 Measures the reference's own two native metrics (BASELINE.md): aggregate
 output tokens/sec at the sampler (the chat-TUI method, chat_tui.py:121-128)
-and per-token latency, plus TTFT for the prefill path. Config #1 of
-BASELINE.json: Llama-3.2-1B-shaped model, greedy decode, one device.
+and per-token latency, plus TTFT for the prefill path and MFU / HBM-bandwidth
+utilisation against the chip's public peak (TPU_CHIP_SPECS).
 
-Zero-egress environment: weights are synthetic (same shapes/dtype as
-Llama-3.2-1B, bf16); throughput is compute-bound so tok/s is representative.
+Robustness contract (this file's ONE job is to always emit a diagnosable
+result line):
+
+- The measurement runs in a CHILD process; the parent never imports jax, so a
+  hung TPU backend init (observed >9 min on the tunneled axon backend) cannot
+  hang the bench. The child appends stage records to a progress file; the
+  parent extends the deadline while progress is being made and kills the
+  child when it stalls.
+- TPU acquisition is retried (BENCH_TPU_TRIES, default 2) with bounded
+  per-stage stall timeouts (BENCH_STALL_TIMEOUT, default 420 s for init —
+  first TPU compile included — then 240 s between stages).
+- A tiny smoke config runs before the flagship so at least one number lands
+  even if the flagship compile dies; on total TPU failure the bench falls
+  back to CPU and still reports, carrying the TPU error in the JSON.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
-vs_baseline compares against BENCH_BASELINE.json (written on first run, so
-round 1 establishes the baseline the reference never published).
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N, ...}
+vs_baseline compares against BENCH_BASELINE.json, keyed per (model, platform,
+method) — a CPU smoke run never becomes the TPU bar.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -28,37 +41,50 @@ def log(msg: str) -> None:
   print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-  prefill_len = int(os.getenv("BENCH_PREFILL", "128"))
-  decode_tokens = int(os.getenv("BENCH_DECODE", "128"))
-  model_id = os.getenv("BENCH_MODEL", "synthetic-llama-1b")
+# --------------------------------------------------------------------- child
 
-  t0 = time.time()
+
+def _record(progress_path: str, stage: str, **kw) -> None:
+  rec = {"stage": stage, "t": round(time.time(), 2), **kw}
+  with open(progress_path, "a") as f:
+    f.write(json.dumps(rec) + "\n")
+  log(f"[bench:{stage}] {kw if kw else ''}")
+
+
+def _tpu_peaks(devices):
+  """(peak bf16 TFLOP/s, peak HBM GB/s) for one chip, or (None, None)."""
+  d0 = devices[0]
+  if d0.platform != "tpu":
+    return None, None
+  from xotorch_tpu.topology.device_capabilities import TPU_CHIP_SPECS, _tpu_kind_to_key
+  key = _tpu_kind_to_key(str(getattr(d0, "device_kind", ""))) or "v5e"
+  spec = TPU_CHIP_SPECS.get(key, TPU_CHIP_SPECS["v5e"])
+  return spec["bf16"], spec["hbm_gbps"]
+
+
+def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
+                cache_len: int, progress_path: str, stage_prefix: str) -> dict:
+  """Measure one model config end to end. Returns the result dict."""
   import jax
   import jax.numpy as jnp
   import numpy as np
-
-  if os.getenv("BENCH_CPU", "0") == "1":
-    jax.config.update("jax_platforms", "cpu")
-  devices = jax.devices()
-  log(f"devices: {devices} (init {time.time()-t0:.1f}s)")
-
   from functools import partial
   from xotorch_tpu.models.config import config_from_hf_dict
   from xotorch_tpu.models.registry import model_cards
   from xotorch_tpu.models.transformer import forward_shard, init_kv_cache, init_random_params
+  from xotorch_tpu.models.generate import decode_chunk
 
   cfg = config_from_hf_dict(model_cards[model_id]["synthetic_config"])
   n = cfg.num_layers
-  cache_len = int(os.getenv("BENCH_CACHE_LEN", "1024"))
 
   t0 = time.time()
   params = init_random_params(cfg, n, True, True, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
-  params = jax.tree.map(lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, params)
-  log(f"params built ({time.time()-t0:.1f}s)")
+  params = jax.block_until_ready(params)
+  n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+  _record(progress_path, f"{stage_prefix}:params", model=model_id,
+          n_params=n_params, secs=round(time.time() - t0, 1))
 
   fwd = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True), donate_argnums=(2,))
-
   cache = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
   prompt = jnp.asarray(np.random.randint(0, cfg.vocab_size, (1, prefill_len)), jnp.int32)
 
@@ -66,15 +92,14 @@ def main() -> None:
   t0 = time.time()
   logits, cache = fwd(params, prompt, cache, jnp.int32(0))
   logits.block_until_ready()
-  ttft_compile = time.time() - t0
-  log(f"prefill compile+run: {ttft_compile:.2f}s")
+  _record(progress_path, f"{stage_prefix}:prefill_compile", secs=round(time.time() - t0, 1))
 
   # warm decode compile
   tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
   t0 = time.time()
   logits, cache = fwd(params, tok, cache, jnp.int32(prefill_len))
   logits.block_until_ready()
-  log(f"decode compile+run: {time.time()-t0:.2f}s")
+  _record(progress_path, f"{stage_prefix}:decode_compile", secs=round(time.time() - t0, 1))
 
   # steady-state TTFT (cached executable)
   cache2 = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
@@ -94,23 +119,19 @@ def main() -> None:
   tok.block_until_ready()
   elapsed = time.time() - t0
   hop_toks_per_sec = decode_tokens / elapsed
-  hop_per_token_ms = 1000 * elapsed / decode_tokens
-  log(f"per-token decode: {decode_tokens} tokens in {elapsed:.2f}s -> {hop_toks_per_sec:.1f} tok/s, {hop_per_token_ms:.2f} ms/tok, TTFT {ttft*1000:.1f} ms")
+  _record(progress_path, f"{stage_prefix}:per_token", tok_s=round(hop_toks_per_sec, 1))
 
   # --- fused decode (the serving fast path: forward + sampling under one
   # lax.scan, models/generate.py; Node uses it whenever one partition owns
   # the whole model) ---
-  from xotorch_tpu.models.generate import decode_chunk
-
-  chunk = int(os.getenv("BENCH_CHUNK", "32"))
   cache3 = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
   logits3, cache3 = fwd(params, prompt, cache3, jnp.int32(0))
   tok3 = jnp.argmax(logits3[:, -1:], axis=-1).astype(jnp.int32)
   key = jax.random.PRNGKey(0)
-  # compile
+  t0 = time.time()
   toks, cache3 = decode_chunk(params, tok3, cache3, jnp.int32(prefill_len), key, cfg, chunk, 0.0, 0)
   toks.block_until_ready()
-  log(f"fused decode compile+run ({chunk}-token chunk) done")
+  _record(progress_path, f"{stage_prefix}:fused_compile", secs=round(time.time() - t0, 1))
   produced = chunk
   t0 = time.time()
   while produced < decode_tokens + chunk:  # match the per-token loop's length
@@ -122,11 +143,124 @@ def main() -> None:
   fused_n = produced - chunk
   toks_per_sec = fused_n / fused_elapsed
   per_token_ms = 1000 * fused_elapsed / fused_n
-  log(f"fused decode: {fused_n} tokens in {fused_elapsed:.2f}s -> {toks_per_sec:.1f} tok/s, "
-      f"{per_token_ms:.3f} ms/tok ({toks_per_sec/hop_toks_per_sec:.2f}x per-token path)")
 
-  # Baselines are per-platform (a CPU smoke run must not become the TPU bar).
-  platform = devices[0].platform
+  # Roofline context: decode does ~2·P MACs/token (bf16) and must stream the
+  # full 2-byte param set from HBM each token — MFU for the compute view,
+  # BW% for the (binding, at batch 1) memory view.
+  devices = jax.devices()
+  peak_tflops, peak_gbps = _tpu_peaks(devices)
+  mfu_pct = round(100 * 2 * n_params * toks_per_sec / (peak_tflops * 1e12), 2) if peak_tflops else None
+  hbm_pct = round(100 * 2 * n_params * toks_per_sec / (peak_gbps * 1e9), 2) if peak_gbps else None
+
+  return {
+    "model_id": model_id,
+    "platform": devices[0].platform,
+    "n_devices": len(devices),
+    "device_kind": str(getattr(devices[0], "device_kind", "")),
+    "n_params": n_params,
+    "tok_s": round(toks_per_sec, 2),
+    "per_token_ms": round(per_token_ms, 3),
+    "ttft_ms": round(ttft * 1000, 1),
+    "per_token_path_tok_s": round(hop_toks_per_sec, 2),
+    "fused_speedup": round(toks_per_sec / hop_toks_per_sec, 2),
+    "mfu_pct": mfu_pct,
+    "hbm_bw_pct": hbm_pct,
+    "prefill_len": prefill_len,
+    "decode_tokens": decode_tokens,
+  }
+
+
+def child_main() -> None:
+  progress_path = os.environ["BENCH_PROGRESS_PATH"]
+  prefill_len = int(os.getenv("BENCH_PREFILL", "128"))
+  decode_tokens = int(os.getenv("BENCH_DECODE", "128"))
+  chunk = int(os.getenv("BENCH_CHUNK", "32"))
+  cache_len = int(os.getenv("BENCH_CACHE_LEN", "1024"))
+  model_id = os.getenv("BENCH_MODEL", "synthetic-llama-1b")
+
+  _record(progress_path, "spawn", jax_platforms=os.getenv("JAX_PLATFORMS", ""))
+  t0 = time.time()
+  import jax
+  if os.getenv("BENCH_FORCE_CPU", "0") == "1":
+    # The image's sitecustomize force-registers the tunneled TPU backend and
+    # overrides JAX_PLATFORMS — pin the selection back post-import or the
+    # "CPU" fallback would hang in the very TPU init it is escaping.
+    jax.config.update("jax_platforms", "cpu")
+  devices = jax.devices()  # backend init happens here — the hang risk
+  _record(progress_path, "init", platform=devices[0].platform, n_devices=len(devices),
+          device_kind=str(getattr(devices[0], "device_kind", "")),
+          secs=round(time.time() - t0, 1))
+
+  if os.getenv("BENCH_SKIP_SMOKE", "0") != "1":
+    smoke = _run_config("synthetic-tiny", 64, 64, 32, 512, progress_path, "smoke")
+    _record(progress_path, "smoke_result", **smoke)
+
+  res = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path, "flagship")
+  _record(progress_path, "flagship_result", **res)
+  print(json.dumps(res), flush=True)
+
+
+# -------------------------------------------------------------------- parent
+
+
+def _read_progress(progress_path: str) -> list:
+  try:
+    lines = Path(progress_path).read_text().splitlines()
+  except OSError:
+    return []
+  out = []
+  for ln in lines:
+    try:
+      out.append(json.loads(ln))
+    except json.JSONDecodeError:
+      pass
+  return out
+
+
+def _run_child(env: dict, progress_path: str, init_timeout: float, stage_timeout: float):
+  """Run the measurement child, extending the deadline while it makes
+  progress. Returns (result dict or None, records, error string or None)."""
+  Path(progress_path).write_text("")
+  env = dict(env)
+  env["BENCH_PROGRESS_PATH"] = progress_path
+  proc = subprocess.Popen(
+    [sys.executable, str(Path(__file__).resolve()), "--child"],
+    stdout=subprocess.PIPE, stderr=None, env=env, text=True,
+  )
+  n_records = 0
+  deadline = time.time() + init_timeout
+  while True:
+    rc = proc.poll()
+    if rc is not None:
+      break
+    recs = _read_progress(progress_path)
+    if len(recs) > n_records:
+      n_records = len(recs)
+      deadline = time.time() + stage_timeout
+    if time.time() > deadline:
+      log(f"[bench] child stalled (> {stage_timeout:.0f}s without progress at "
+          f"{recs[-1]['stage'] if recs else 'spawn'}); killing")
+      proc.kill()
+      try:
+        proc.wait(timeout=10)
+      except subprocess.TimeoutExpired:
+        pass
+      return None, recs, "stalled"
+    time.sleep(2)
+  stdout = proc.stdout.read() if proc.stdout else ""
+  recs = _read_progress(progress_path)
+  if rc == 0:
+    for ln in reversed(stdout.strip().splitlines()):
+      try:
+        return json.loads(ln), recs, None
+      except json.JSONDecodeError:
+        continue
+    return None, recs, "no JSON on child stdout"
+  return None, recs, f"child exited rc={rc}"
+
+
+def _apply_baseline(result: dict) -> dict:
+  """vs_baseline per (model, platform, method); first run records the bar."""
   baseline_file = REPO / "BENCH_BASELINE.json"
   baselines = {}
   if baseline_file.exists():
@@ -134,32 +268,102 @@ def main() -> None:
       baselines = json.loads(baseline_file.read_text())
     except json.JSONDecodeError:
       baselines = {}
-  # Key includes the measurement method: the headline switched from the
-  # per-token loop to fused-chunk decode, and the two are not comparable.
-  key = f"{model_id}:{platform}:fused"
+  key = f"{result['model_id']}:{result['platform']}:fused"
   baseline = baselines.get(key, {}).get("tok_s")
   if baseline is None:
-    baseline = toks_per_sec
+    baseline = result["tok_s"]
     baselines[key] = {
-      "tok_s": toks_per_sec, "per_token_ms": per_token_ms, "ttft_ms": ttft * 1000,
-      "recorded": time.strftime("%Y-%m-%d"),
+      "tok_s": result["tok_s"], "per_token_ms": result["per_token_ms"],
+      "ttft_ms": result["ttft_ms"], "recorded": time.strftime("%Y-%m-%d"),
     }
     try:
       baseline_file.write_text(json.dumps(baselines, indent=2))
     except OSError:
       pass
+  result["vs_baseline"] = round(result["tok_s"] / baseline, 3) if baseline else 1.0
+  return result
 
-  print(json.dumps({
+
+def _emit(result: dict) -> None:
+  model_id = result.get("model_id", "unknown")
+  out = {
     "metric": f"decode_tok_s_{model_id.replace('-', '_')}_bf16_1chip",
-    "value": round(toks_per_sec, 2),
+    "value": result.get("tok_s", 0.0),
     "unit": "tok/s",
-    "vs_baseline": round(toks_per_sec / baseline, 3) if baseline else 1.0,
-    "per_token_ms": round(per_token_ms, 3),
-    "ttft_ms": round(ttft * 1000, 1),
-    "per_token_path_tok_s": round(hop_toks_per_sec, 2),
-    "fused_speedup": round(toks_per_sec / hop_toks_per_sec, 2),
-    "platform": platform,
-  }))
+    "vs_baseline": result.get("vs_baseline", 0.0),
+  }
+  for k in ("per_token_ms", "ttft_ms", "per_token_path_tok_s", "fused_speedup",
+            "mfu_pct", "hbm_bw_pct", "platform", "n_devices", "device_kind",
+            "n_params", "stage", "tpu_error", "error"):
+    if result.get(k) is not None:
+      out[k] = result[k]
+  print(json.dumps(out), flush=True)
+
+
+def _salvage(recs: list) -> dict | None:
+  """Best partial result from a dead child's progress records."""
+  for stage, tag in (("flagship_result", "flagship"), ("smoke_result", "smoke")):
+    for rec in reversed(recs):
+      if rec.get("stage") == stage:
+        res = {k: v for k, v in rec.items() if k not in ("stage", "t")}
+        res["stage"] = tag
+        return res
+  return None
+
+
+def main() -> None:
+  if "--child" in sys.argv:
+    child_main()
+    return
+
+  progress_path = str(REPO / ".bench_progress.jsonl")
+  tries = int(os.getenv("BENCH_TPU_TRIES", "2"))
+  init_timeout = float(os.getenv("BENCH_INIT_TIMEOUT", "420"))
+  stage_timeout = float(os.getenv("BENCH_STALL_TIMEOUT", "240"))
+  base_env = dict(os.environ)
+
+  attempts = []
+  if os.getenv("BENCH_CPU", "0") != "1":
+    for i in range(tries):
+      log(f"[bench] TPU attempt {i + 1}/{tries}")
+      result, recs, err = _run_child(base_env, progress_path, init_timeout, stage_timeout)
+      if result is not None:
+        _emit(_apply_baseline(result))
+        return
+      salvaged = _salvage(recs)
+      if salvaged is not None:
+        log(f"[bench] child died after {salvaged['stage']} stage; using partial result")
+        salvaged["error"] = err
+        _emit(_apply_baseline(salvaged))
+        return
+      last_stage = recs[-1]["stage"] if recs else "spawn"
+      attempts.append(f"try{i + 1}: {err} at {last_stage}")
+      # Init never completed — retry is only useful for transient
+      # UNAVAILABLE; a hang burns the budget, so shorten the next try.
+      init_timeout = min(init_timeout, stage_timeout)
+
+  # CPU fallback: smaller workload, but a number always lands.
+  log(f"[bench] falling back to CPU ({'; '.join(attempts) or 'BENCH_CPU=1'})")
+  cpu_env = dict(base_env)
+  cpu_env["JAX_PLATFORMS"] = "cpu"
+  cpu_env["BENCH_FORCE_CPU"] = "1"
+  # The 1.2B flagship decodes at ~0.1 tok/s on CPU — shrink the workload so
+  # the fallback lands a diagnosable number in minutes, not an hour.
+  cpu_env.setdefault("BENCH_PREFILL", "32")
+  cpu_env.setdefault("BENCH_DECODE", "8")
+  cpu_env.setdefault("BENCH_CHUNK", "8")
+  result, recs, err = _run_child(cpu_env, progress_path, 300, 300)
+  if result is None:
+    result = _salvage(recs) or {}
+  if attempts:
+    result["tpu_error"] = "; ".join(attempts)
+  if not result.get("tok_s"):
+    result.setdefault("error", err or "cpu fallback failed")
+    result.setdefault("model_id", os.getenv("BENCH_MODEL", "synthetic-llama-1b"))
+    result["vs_baseline"] = 0.0
+    _emit(result)
+    sys.exit(1)
+  _emit(_apply_baseline(result))
 
 
 if __name__ == "__main__":

@@ -143,6 +143,7 @@ async def test_cache_exhaustion_finishes_as_length(tiny_model_dir):
   completion, not an error."""
   eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
   eng._configured_cache_len = 16  # survives _load_shard's cache_len derivation
+  eng._configured_max_cache_len = 16  # no growth: exhaustion must still surface
   node = Node(
     "cachecap", _NullServer(), eng, _NoDiscovery(), None,
     RingMemoryWeightedPartitioningStrategy(),

@@ -1,0 +1,154 @@
+"""Long-context serving (VERDICT r1 missing #3 / SURVEY §5 greenfield):
+
+- per-request KV caches GROW by doubling past the initial allocation up to
+  min(XOT_MAX_CACHE_LEN, cfg.max_seq_len) instead of hard-failing at 2048;
+- prompts longer than XOT_PREFILL_CHUNK prefill in fixed segments, so no
+  [T, S] score tensor is ever materialised;
+- the occupancy-aware Pallas cached-attention kernel (ops/flash_decode.py)
+  serves decode steps and pos>0 segments, selected by XOT_FLASH_DECODE;
+- exhaustion beyond the max still raises CacheExhausted (finish as
+  "length" at the orchestration layer).
+
+The 16 k prompt test runs the XLA dense path in small segments on CPU (the
+Pallas interpret mode is too slow at that scale); kernel selection and
+correctness are proven at smaller shapes where interpret mode is fast.
+"""
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.engine import CacheExhausted
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+LONG_CFG = dict(TINY_LLAMA_CFG, num_hidden_layers=2, max_position_embeddings=32768)
+
+
+@pytest.fixture()
+def tiny_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+
+
+@pytest.fixture()
+def long_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, LONG_CFG, seed=5)
+
+
+def _engine(model_dir, monkeypatch, cache_len, max_cache_len=32768, **env):
+  monkeypatch.setenv("XOT_CACHE_LEN", str(cache_len))
+  monkeypatch.setenv("XOT_MAX_CACHE_LEN", str(max_cache_len))
+  for k, v in env.items():
+    monkeypatch.setenv(k, str(v))
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+async def test_cache_grows_past_initial_allocation(tiny_model_dir, monkeypatch):
+  """Decode past the initial cache must grow the buffer (doubling) and stay
+  numerically identical to an engine that started with a large cache."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+
+  small = _engine(tiny_model_dir, monkeypatch, 32, max_cache_len=128)
+  big = _engine(tiny_model_dir, monkeypatch, 128, max_cache_len=128)
+
+  prompt = np.array([[1, 5, 9, 200, 17] * 4], dtype=np.int64)  # 20 tokens
+  ls, _ = await small.infer_tensor("r", shard, prompt)
+  lb, _ = await big.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(ls, lb, atol=1e-4, rtol=1e-3)
+
+  tok = int(np.argmax(ls[0, -1]))
+  for step in range(40):  # crosses 32 and 64 twice over
+    nxt = np.array([[tok]], dtype=np.int64)
+    ls, _ = await small.infer_tensor("r", shard, nxt)
+    lb, _ = await big.infer_tensor("r", shard, nxt)
+    np.testing.assert_allclose(ls, lb, atol=1e-4, rtol=1e-3)
+    tok = int(np.argmax(ls[0, -1]))
+  assert small.states["r"].cache["k"].shape[2] > 32
+
+
+async def test_exhaustion_beyond_max_still_raises(tiny_model_dir, monkeypatch):
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  eng = _engine(tiny_model_dir, monkeypatch, 16, max_cache_len=32)
+  prompt = np.array([[1, 2, 3] * 7], dtype=np.int64)  # 21 tokens -> grows to 32
+  out, _ = await eng.infer_tensor("r", shard, prompt)
+  with pytest.raises(CacheExhausted):
+    for _ in range(40):
+      nxt = np.array([[int(np.argmax(out[0, -1]))]], dtype=np.int64)
+      out, _ = await eng.infer_tensor("r", shard, nxt)
+
+
+async def test_chunked_prefill_matches_single_shot(tiny_model_dir, monkeypatch):
+  """Segmented prefill (XOT_PREFILL_CHUNK) must equal one-shot prefill."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([np.arange(100) % 250], dtype=np.int64)
+
+  one = _engine(tiny_model_dir, monkeypatch, 128, XOT_PREFILL_CHUNK=4096)
+  lo, _ = await one.infer_tensor("r", shard, prompt)
+  seg = _engine(tiny_model_dir, monkeypatch, 128, XOT_PREFILL_CHUNK=32)
+  lseg, _ = await seg.infer_tensor("r", shard, prompt)
+  assert lseg.shape == lo.shape
+  np.testing.assert_allclose(lseg, lo, atol=1e-4, rtol=1e-3)
+
+  # Decode after segmented prefill continues correctly.
+  tok = np.array([[int(np.argmax(lo[0, -1]))]], dtype=np.int64)
+  do, _ = await one.infer_tensor("r", shard, tok)
+  ds, _ = await seg.infer_tensor("r", shard, tok)
+  np.testing.assert_allclose(ds, do, atol=1e-4, rtol=1e-3)
+
+
+async def test_flash_cached_path_selected_and_correct(tiny_model_dir, monkeypatch):
+  """With XOT_FLASH_DECODE forced on, decode steps and pos>0 segments go
+  through the Pallas cached-attention executable and match the dense path."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([np.arange(90) % 250], dtype=np.int64)
+
+  dense = _engine(tiny_model_dir, monkeypatch, 128, XOT_FLASH_DECODE="0", XOT_PREFILL_CHUNK=32)
+  ld, _ = await dense.infer_tensor("r", shard, prompt)
+
+  flash = _engine(tiny_model_dir, monkeypatch, 128, XOT_FLASH_DECODE="1",
+                  XOT_FLASH_DECODE_MIN="0", XOT_PREFILL_CHUNK=32)
+  # Trigger the shard load, then wrap the flash executable with a counter.
+  await flash.ensure_shard(shard)
+  calls = {"n": 0}
+  inner = flash._forward_decode_flash_jit
+
+  def counting(*args, **kw):
+    calls["n"] += 1
+    return inner(*args, **kw)
+
+  flash._forward_decode_flash_jit = counting
+  lf, _ = await flash.infer_tensor("r", shard, prompt)
+  assert calls["n"] >= 2, "pos>0 prefill segments did not take the cached kernel"
+  np.testing.assert_allclose(lf, ld, atol=1e-4, rtol=1e-3)
+
+  tok = np.array([[int(np.argmax(ld[0, -1]))]], dtype=np.int64)
+  dd, _ = await dense.infer_tensor("r", shard, tok)
+  df, _ = await flash.infer_tensor("r", shard, tok)
+  assert calls["n"] >= 3, "decode step did not take the cached kernel"
+  np.testing.assert_allclose(df, dd, atol=1e-4, rtol=1e-3)
+
+
+async def test_16k_prompt_serves_without_oom(long_model_dir, monkeypatch):
+  """A 16 k-token prompt on a 32 k-max model must prefill (in segments),
+  grow the cache to 16 k, and decode — on CPU, with bounded memory."""
+  n = LONG_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  eng = _engine(long_model_dir, monkeypatch, 2048, max_cache_len=32768,
+                XOT_PREFILL_CHUNK=512, XOT_FLASH_ATTENTION="0", XOT_FLASH_DECODE="0")
+
+  T = 16000
+  prompt = np.array([np.arange(T) % 250], dtype=np.int64)
+  out, _ = await eng.infer_tensor("long", shard, prompt)
+  assert out.shape == (1, T, LONG_CFG["vocab_size"])
+  assert eng.states["long"].cache["k"].shape[2] >= T
+  assert eng.states["long"].pos == T
+
+  tok = np.array([[int(np.argmax(out[0, -1]))]], dtype=np.int64)
+  d, _ = await eng.infer_tensor("long", shard, tok)
+  assert d.shape == (1, 1, LONG_CFG["vocab_size"])
+  assert np.isfinite(d).all()

@@ -1,0 +1,99 @@
+"""Multi-chip (tensor-parallel) serving: engine output on a virtual device
+mesh must match single-device output exactly in semantics (allclose under
+XLA resharding).
+
+VERDICT r1 #2 / SURVEY §7.2 stage 7: a peer with several local chips serves
+its shard SPMD over a local {'tp': t} mesh (params placed per the Megatron
+rules in parallel/mesh.py; XLA inserts the tp collectives). The virtual
+8-device CPU mesh from conftest stands in for real chips, exactly as the
+driver's dryrun does.
+"""
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+@pytest.fixture()
+def tiny_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+
+
+def _engine(model_dir, monkeypatch, tp):
+  monkeypatch.setenv("XOT_SERVE_TP", str(tp))
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+async def test_tp_serving_matches_single_device(tiny_model_dir, monkeypatch):
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  tokens = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+
+  ref = _engine(tiny_model_dir, monkeypatch, 0)
+  out_ref, _ = await ref.infer_tensor("r", shard, tokens)
+  assert ref._mesh is None
+
+  # tiny model: 2 kv heads bound tp to 2 (the feasibility reduction).
+  tp = _engine(tiny_model_dir, monkeypatch, 2)
+  out_tp, _ = await tp.infer_tensor("r", shard, tokens)
+  assert tp._mesh is not None and tp._mesh.shape["tp"] == 2
+
+  np.testing.assert_allclose(out_tp, out_ref, atol=1e-4, rtol=1e-3)
+
+  # Decode steps (the cache-resident path) must agree too.
+  t_ref = np.array([[int(np.argmax(out_ref[0, -1]))]], dtype=np.int64)
+  d_ref, _ = await ref.infer_tensor("r", shard, t_ref)
+  d_tp, _ = await tp.infer_tensor("r", shard, t_ref)
+  np.testing.assert_allclose(d_tp, d_ref, atol=1e-4, rtol=1e-3)
+
+
+async def test_tp_requested_size_reduced_to_feasible(tiny_model_dir, monkeypatch):
+  """Asking for tp=8 on a 2-kv-head model must reduce to 2, not fail."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  eng = _engine(tiny_model_dir, monkeypatch, 8)
+  out, _ = await eng.infer_tensor("r", Shard("m", 0, n - 1, n), np.array([[1, 2, 3]], dtype=np.int64))
+  assert eng._mesh is not None and eng._mesh.shape["tp"] == 2
+  assert out.shape[-1] == TINY_LLAMA_CFG["vocab_size"]
+
+
+async def test_tp_fused_decode_chunk(tiny_model_dir, monkeypatch):
+  """The fused multi-token decode path (generate_chunk) must run on the tp
+  mesh and agree with the per-token ring path."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+
+  tp = _engine(tiny_model_dir, monkeypatch, 2)
+  out, _ = await tp.infer_tensor("req", shard, prompt)
+  first = int(np.argmax(out[0, -1]))
+  toks = await tp.generate_chunk("req", shard, first, 4, temp=0.0, top_k=0)
+  assert toks is not None and toks.shape == (4,)
+
+  ref = _engine(tiny_model_dir, monkeypatch, 0)
+  out_r, _ = await ref.infer_tensor("req", shard, prompt)
+  seq = [int(np.argmax(out_r[0, -1]))]
+  for _ in range(4):
+    nxt = np.array([[seq[-1]]], dtype=np.int64)
+    out_r, _ = await ref.infer_tensor("req", shard, nxt)
+    seq.append(int(np.argmax(out_r[0, -1])))
+  assert toks.tolist() == seq[1:]
+
+
+async def test_tp_split_ring_equivalence(tiny_model_dir, monkeypatch):
+  """Pipeline split where EACH stage is tp-sharded locally (the pp-over-ring
+  × tp-within-peer composition) must match the full single-device model."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  tokens = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+
+  full = _engine(tiny_model_dir, monkeypatch, 0)
+  out_full, _ = await full.infer_tensor("r", Shard("m", 0, n - 1, n), tokens)
+
+  first = _engine(tiny_model_dir, monkeypatch, 2)
+  second = _engine(tiny_model_dir, monkeypatch, 2)
+  hidden, st = await first.infer_tensor("r", Shard("m", 0, n // 2 - 1, n), tokens)
+  out_split, _ = await second.infer_tensor("r", Shard("m", n // 2, n - 1, n), hidden, st)
+  np.testing.assert_allclose(out_split, out_full, atol=1e-4, rtol=1e-3)

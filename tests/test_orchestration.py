@@ -310,3 +310,57 @@ async def test_prompt_error_aborts_request():
   await asyncio.wait_for(done.wait(), timeout=10)
   assert node.outstanding_requests == {}
   assert node.buffered_token_output == {}
+
+
+async def test_two_partition_ring_throughput_within_2x():
+  """VERDICT r1 #4 done-criterion: a 2-partition ring on the same host decodes
+  within ~2x of the single-partition PER-TOKEN path (the extra cost is one
+  more engine dispatch + two localhost gRPC hops per token; sampling stays
+  on-device at the last shard either way). Uses generous slack (2.5x) to
+  absorb CPU timing noise; the measured ratio is printed for the bench log."""
+  import time as _time
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  gen_tokens = 24
+  shard = Shard("synthetic-tiny", 0, 0, 4)
+
+  async def _timed_generation(node, tag):
+    # Warmup request compiles every executable, measured request only runs.
+    for which in ("warm", "meas"):
+      done = asyncio.Event()
+      req_id = f"{tag}-{which}"
+      node_list = node if isinstance(node, tuple) else (node,)
+      for n in node_list:
+        cb = n.on_token.register(req_id)
+        # Filter by request id: a late finished-broadcast from the warmup
+        # request must not end the measured run early.
+        cb.on_next(lambda rid, toks, fin, want=req_id: done.set() if (fin and rid == want) else None)
+      t0 = _time.monotonic()
+      await node_list[0].process_prompt(shard, "hello world test prompt", req_id)
+      await asyncio.wait_for(done.wait(), timeout=120)
+      elapsed = _time.monotonic() - t0
+      for n in node_list:
+        n.on_token.deregister(f"{tag}-{which}")
+    return elapsed
+
+  # Single partition, per-token path (fused chunking disabled).
+  solo = await _make_node(
+    "solo", JAXShardInferenceEngine(dtype="float32"),
+    max_generate_tokens=gen_tokens, default_sample_temp=0.0, decode_chunk_size=1,
+  )
+  solo.topology.update_node("solo", _caps())
+  solo_elapsed = await _timed_generation(solo, "solo")
+
+  # Two partitions over localhost gRPC.
+  node_a, node_b = await _two_node_ring(
+    JAXShardInferenceEngine(dtype="float32"), JAXShardInferenceEngine(dtype="float32"),
+    max_generate_tokens=gen_tokens, default_sample_temp=0.0, decode_chunk_size=1,
+  )
+  try:
+    ring_elapsed = await _timed_generation((node_a, node_b), "ring")
+    ratio = ring_elapsed / solo_elapsed
+    print(f"ring decode {gen_tokens} tokens: solo {gen_tokens/solo_elapsed:.1f} tok/s, "
+          f"ring {gen_tokens/ring_elapsed:.1f} tok/s, ratio {ratio:.2f}x")
+    assert ratio < 2.5, f"2-partition ring is {ratio:.2f}x slower than single-partition"
+  finally:
+    await _stop_ring(node_a, node_b)

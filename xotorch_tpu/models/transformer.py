@@ -49,7 +49,7 @@ def _attention_block(
   layer: Params, x: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
   positions: jnp.ndarray, kv_valid_len: jnp.ndarray, start_pos: jnp.ndarray,
   cfg: ModelConfig, inv_freq: jnp.ndarray, use_flash: bool = False,
-  ring_mesh=None,
+  ring_mesh=None, use_flash_decode: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
@@ -76,6 +76,15 @@ def _attention_block(
     # positions, so the Pallas kernel's in-segment causal mask is exact.
     from xotorch_tpu.ops.flash_attention import flash_attention
     attn = flash_attention(q, k, v)
+  elif use_flash_decode:
+    # Decode steps and chunked-prefill segments over a long resident cache:
+    # Pallas kernel whose cost is proportional to the OCCUPIED prefix
+    # (blocks past the causally visible region are never DMA'd) and whose
+    # scores never leave VMEM — no [T, S] materialisation
+    # (ops/flash_decode.py).
+    from xotorch_tpu.ops.flash_decode import flash_cached_attention
+    q_start = jnp.full((B,), start_pos, dtype=jnp.int32)
+    attn = flash_cached_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), q_start)
   elif ring_mesh is not None:
     # Sequence-parallel training path (start_pos == 0, T sharded over 'sp'):
     # ring attention rotates KV chunks over ICI instead of materialising the
@@ -122,13 +131,17 @@ def forward_shard(
   is_last: bool,
   use_flash: bool = False,
   ring_mesh=None,
+  use_flash_decode: bool = False,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
 
-  cfg/is_first/is_last/use_flash must be static under jit; start_pos is
-  traced so one executable serves every decode step. use_flash selects the
-  Pallas prefill kernel (ops/flash_attention.py) and is only valid when
-  start_pos == 0 — the engine picks the right executable per call.
+  cfg/is_first/is_last/use_flash/use_flash_decode must be static under jit;
+  start_pos is traced so one executable serves every decode step. use_flash
+  selects the Pallas prefill kernel (ops/flash_attention.py) and is only
+  valid when start_pos == 0; use_flash_decode selects the occupancy-aware
+  Pallas cached-attention kernel (ops/flash_decode.py), valid for decode
+  steps (T == 1) and pos>0 chunked-prefill segments (T > 1) — the engine
+  picks the right executable per call.
   """
   if is_first:
     h = jnp.take(params["embed"]["embedding"], x, axis=0)
@@ -143,7 +156,7 @@ def forward_shard(
     layer, k_cache, v_cache = xs
     attn_out, k_cache, v_cache = _attention_block(
       layer, h, k_cache, v_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
-      ring_mesh,
+      ring_mesh, use_flash_decode,
     )
     h = h + attn_out
     mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)

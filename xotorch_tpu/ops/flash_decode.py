@@ -1,0 +1,179 @@
+"""Pallas TPU cached-attention kernels: queries at an offset over a long,
+HBM-resident KV cache (decode steps and chunked long-prompt prefill).
+
+Attention over the resident cache is HBM-bound: it must stream the occupied
+cache past the MXU. The XLA baseline (ops/attention.py) materialises
+[T, S] scores over the ENTIRE static buffer regardless of occupancy — cheap
+at 2 k, the dominant cost (and at long T an OOM) at 32 k (VERDICT r1 weak
+#7 / missing #3). This kernel makes the cost proportional to the OCCUPIED,
+CAUSALLY-VISIBLE prefix:
+
+- `q_start` (the segment's absolute position) is a scalar-prefetch operand,
+  so the BlockSpec index maps can depend on it: kv blocks past the last
+  visible block re-map to the last visible block index. Pallas skips the
+  DMA when consecutive grid steps map to the same block — unneeded cache is
+  never fetched from HBM, not just masked.
+- Queries of all `groups` q-heads sharing one kv head are batched into the
+  sublane dim together with `block_q` positions (GQA packing: row r of a
+  tile is position r // groups, head r % groups), with the online-softmax
+  recurrence carried across kv blocks in VMEM scratch.
+- Scores never leave VMEM — no [T, S] materialisation, so a 2048-token
+  segment attending a 32 k cache costs VMEM tiles, not gigabytes.
+
+T == 1 is the decode step; T > 1 at q_start > 0 is a chunked-prefill
+segment (the engine splits prompts longer than XOT_PREFILL_CHUNK). Prefill
+from zero uses the in-segment kernel in ops/flash_attention.py. On CPU the
+kernel runs in interpret mode so tests exercise the same code path.
+
+Reference context: the torch engine re-ran SDPA over a host-built dense mask
+every step (sharded_inference_engine.py:144-186); there is no reference
+long-context path to mirror (SURVEY §5 "Long-context" — greenfield).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, block_q: int, block_k: int, groups: int, scale: float):
+  """Grid = (B, Hkv, nQ, nK); nK innermost so scratch carries the
+  online-softmax state across kv blocks of one (batch, kv-head, q-block)."""
+  b = pl.program_id(0)
+  i = pl.program_id(2)
+  j = pl.program_id(3)
+  n_k = pl.num_programs(3)
+  q_start = start_ref[b]
+  # Last absolute position covered by this q block (incl. bucket padding).
+  q_last = q_start + (i + 1) * block_q - 1
+
+  @pl.when(j == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  @pl.when(j * block_k <= q_last)
+  def _compute():
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q * groups, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+
+    s = jax.lax.dot_general(
+      q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [block_q * groups, block_k]
+
+    # Row r is query position q_start + i*block_q + r // groups.
+    row_pos = q_start + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // groups
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos <= row_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+
+    l_ref[:] = jnp.broadcast_to(alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+  @pl.when(j == n_k - 1)
+  def _finalize():
+    l = l_ref[:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_cached_attention(
+  q: jnp.ndarray,  # [B, T, Hq, D] — queries at absolute positions q_start + [0, T)
+  k: jnp.ndarray,  # [B, S, Hkv, D] — full static cache buffer (segment already written)
+  v: jnp.ndarray,  # [B, S, Hkv, D]
+  q_start: jnp.ndarray,  # [B] int32 — absolute position of q[:, 0]
+  block_q: int = 128,
+  block_k: int = 256,
+  interpret: bool | None = None,
+) -> jnp.ndarray:
+  """Causal GQA attention of a query segment over the occupied cache prefix.
+
+  Query t attends cache positions [0, q_start + t]. Returns [B, T, Hq, D].
+  """
+  B, T, Hq, D = q.shape
+  S, Hkv = k.shape[1], k.shape[2]
+  groups = Hq // Hkv
+  # Halve block sizes until they divide the actual T/S: cache lengths are
+  # usually powers of two, but XOT_MAX_CACHE_LEN / cfg.max_seq_len clamps can
+  # produce odd sizes — degrade block size instead of crashing the hot path.
+  block_q = min(block_q, T)
+  while T % block_q:
+    block_q //= 2
+  block_k = min(block_k, S)
+  while S % block_k:
+    block_k //= 2
+  if interpret is None:
+    interpret = jax.default_backend() != "tpu"
+
+  scale = 1.0 / math.sqrt(D)
+  # GQA packing: [B, Hkv, T * groups, D], row = position * groups + group.
+  qt = q.reshape(B, T, Hkv, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, Hkv, T * groups, D)
+  kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, D]
+  vt = v.transpose(0, 2, 1, 3)
+  start = q_start.astype(jnp.int32)
+
+  rows = block_q * groups
+  n_q = T // block_q
+  n_k = S // block_k
+
+  def kv_index(b, h, i, j, start_ref):
+    # Blocks past this q block's last visible position re-map to the last
+    # visible block: the grid index stops changing, so Pallas elides the DMA.
+    last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
+    return (b, h, jnp.minimum(j, last), 0)
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=1,
+    grid=(B, Hkv, n_q, n_k),
+    in_specs=[
+      pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
+      pl.BlockSpec((1, 1, block_k, D), kv_index),
+      pl.BlockSpec((1, 1, block_k, D), kv_index),
+    ],
+    out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
+    scratch_shapes=[
+      pltpu.VMEM((rows, D), jnp.float32),
+      pltpu.VMEM((rows, 128), jnp.float32),
+      pltpu.VMEM((rows, 128), jnp.float32),
+    ],
+  )
+
+  out = pl.pallas_call(
+    functools.partial(_cached_kernel, block_q=block_q, block_k=block_k, groups=groups, scale=scale),
+    grid_spec=grid_spec,
+    out_shape=jax.ShapeDtypeStruct((B, Hkv, T * groups, D), q.dtype),
+    interpret=interpret,
+  )(start, qt, kt, vt)
+
+  return out.reshape(B, Hkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, T, Hq, D)
+
+
+def flash_decode_attention(
+  q: jnp.ndarray,  # [B, 1, Hq, D]
+  k: jnp.ndarray,  # [B, S, Hkv, D]
+  v: jnp.ndarray,  # [B, S, Hkv, D]
+  kv_valid: jnp.ndarray,  # [B] int32 — occupied prefix length (incl. this step)
+  block_k: int = 256,
+  interpret: bool | None = None,
+) -> jnp.ndarray:
+  """Single-token decode attention (T == 1 specialisation)."""
+  return flash_cached_attention(q, k, v, kv_valid.astype(jnp.int32) - 1,
+                                block_q=1, block_k=block_k, interpret=interpret)

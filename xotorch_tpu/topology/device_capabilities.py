@@ -76,17 +76,22 @@ UNKNOWN_DEVICE_CAPABILITIES = DeviceCapabilities(
   model="Unknown Model", chip="Unknown Chip", memory=0, flops=DeviceFlops(fp32=0, fp16=0, int8=0)
 )
 
-# Public per-chip peak numbers (bf16 dense TFLOP/s, HBM GB).
-# fp32 on TPU ≈ bf16/2 via the MXU's fp32-accumulate path; int8 2× bf16 where
-# supported. This is the TPU analogue of the reference's CHIP_FLOPS table
-# (device_capabilities.py:54-164).
+# Public PER-DEVICE peak numbers (bf16 dense TFLOP/s, HBM GB, HBM GB/s),
+# where "device" is what jax reports: a CORE on v2/v3 (two devices per chip),
+# a CHIP on v4+ (megacore). All three columns use the same denominator so
+# bench MFU and HBM-BW%% are mutually consistent. fp32 on TPU ≈ bf16/2 via
+# the MXU's fp32-accumulate path; int8 2× bf16 where supported. This is the
+# TPU analogue of the reference's CHIP_FLOPS table
+# (device_capabilities.py:54-164). hbm_gbps feeds the bench's bandwidth-
+# utilisation metric: batch-1 decode is HBM-bound, so BW% is the honest
+# "how close to roofline" number (MFU alone undersells decode).
 TPU_CHIP_SPECS: Dict[str, Dict[str, float]] = {
-  "v2": {"bf16": 22.5, "hbm_gb": 8},
-  "v3": {"bf16": 61.5, "hbm_gb": 16},
-  "v4": {"bf16": 137.5, "hbm_gb": 16},  # per-core reporting; a v4 chip = 2 cores = 275
-  "v5e": {"bf16": 197.0, "hbm_gb": 16},
-  "v5p": {"bf16": 229.5, "hbm_gb": 47.5},
-  "v6e": {"bf16": 918.0, "hbm_gb": 32},
+  "v2": {"bf16": 22.5, "hbm_gb": 8, "hbm_gbps": 350.0},  # per core (half chip)
+  "v3": {"bf16": 61.5, "hbm_gb": 16, "hbm_gbps": 450.0},  # per core (half chip)
+  "v4": {"bf16": 275.0, "hbm_gb": 32, "hbm_gbps": 1228.0},  # per chip (megacore)
+  "v5e": {"bf16": 197.0, "hbm_gb": 16, "hbm_gbps": 819.0},
+  "v5p": {"bf16": 459.0, "hbm_gb": 95.0, "hbm_gbps": 2765.0},
+  "v6e": {"bf16": 918.0, "hbm_gb": 32, "hbm_gbps": 1638.0},
 }
 
 # Minimal GPU table for mixed dev rings (fallback path only).
