@@ -236,3 +236,27 @@ async def test_image_on_text_only_model_rejected():
     assert "does not support image" in body["error"]["message"]
   finally:
     await client.close()
+
+
+async def test_chat_token_encode_route():
+  """Parity: /v1/chat/token/encode (reference chatgpt_api.py:210-211,287-306)
+  tokenizes the templated chat without running inference."""
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.post("/v1/chat/token/encode", json={
+      "model": "dummy",
+      "messages": [{"role": "user", "content": "hello world"}],
+    })
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["num_tokens"] == len(data["encoded_tokens"]) > 0
+    assert isinstance(data["encoded_prompt"], str) and data["length"] == len(data["encoded_prompt"])
+    assert all(isinstance(t, int) for t in data["encoded_tokens"])
+
+    # Unknown model -> 400, not a crash.
+    resp = await client.post("/chat/token/encode", json={
+      "model": "no-such-model", "messages": [{"role": "user", "content": "x"}],
+    })
+    assert resp.status == 400
+  finally:
+    await client.close()

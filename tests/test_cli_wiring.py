@@ -1,0 +1,116 @@
+"""CLI wiring parity bits (VERDICT r1 #5): the flags that were parsed but
+dead in round 1 now reach their implementations.
+
+- --chat-tui -> viz/chat_tui.run_chat_tui (reference main.py:100,380-381)
+- --resume-checkpoint -> engine.load_checkpoint before the first train step
+  (reference parses it at main.py:82; its engine leaf was a no-op)
+"""
+import argparse
+import asyncio
+
+import numpy as np
+
+from xotorch_tpu.inference.dummy import DummyInferenceEngine
+from xotorch_tpu.inference.tokenizers import DummyTokenizer
+from xotorch_tpu.main import build_parser, train_model_cli
+from xotorch_tpu.viz.chat_tui import run_chat_tui
+
+from tests.test_orchestration import _caps, _make_node
+
+
+def test_parser_has_chat_tui_and_resume_flags():
+  args = build_parser().parse_args(["--chat-tui", "--resume-checkpoint", "/tmp/ckpt"])
+  assert args.chat_tui is True
+  assert args.resume_checkpoint == "/tmp/ckpt"
+  assert build_parser().parse_args([]).chat_tui is False
+
+
+def test_chat_tui_suppresses_topology_viz():
+  """The chat TUI owns the terminal: build_node must not also start the Live
+  topology layout (reference main.py:158)."""
+  from xotorch_tpu.main import build_node
+  args = build_parser().parse_args(["--inference-engine", "dummy", "--chat-tui"])
+  node, engine, classname, api, topology_viz = build_node(args)
+  assert topology_viz is None
+
+
+async def test_chat_tui_one_turn(monkeypatch, capsys):
+  """Drive one REPL turn end-to-end through a real Node: input -> ring ->
+  streamed tokens -> tok/s line."""
+  node = await _make_node("tui-node", DummyInferenceEngine())
+  node.topology.update_node("tui-node", _caps())
+
+  inputs = iter(["hello there"])
+
+  def fake_input(prompt=""):
+    try:
+      return next(inputs)
+    except StopIteration:
+      raise EOFError
+
+  monkeypatch.setattr("builtins.input", fake_input)
+  await run_chat_tui(node, "DummyInferenceEngine", "dummy", DummyTokenizer())
+  out = capsys.readouterr().out
+  assert "tok/s" in out, out
+  assert "Chatting with dummy" in out
+
+
+async def test_resume_checkpoint_loads_before_training(tmp_path):
+  """train_model_cli with --resume-checkpoint must call the engine's
+  load_checkpoint on the node's local shard before stepping."""
+  engine = DummyInferenceEngine()
+  calls = []
+
+  async def record_load(shard, path):
+    calls.append((shard, path))
+
+  engine.load_checkpoint = record_load
+  node = await _make_node("train-node", engine)
+  node.topology.update_node("train-node", _caps())
+
+  args = argparse.Namespace(
+    data="xotorch_tpu/train/data/lora", iters=1, batch_size=1, sequence_length=32,
+    save_every=0, save_checkpoint_dir=str(tmp_path), resume_checkpoint=str(tmp_path / "ckpt"),
+  )
+  await train_model_cli(node, "DummyInferenceEngine", "dummy", args)
+  assert len(calls) == 1
+  shard, path = calls[0]
+  assert path == str(tmp_path / "ckpt")
+  assert shard.model_id == "dummy"
+
+
+async def test_coordinate_resume_reaches_all_peers():
+  """Ring-wide resume: every peer loads ITS layer range, not just the node
+  where the CLI ran (a resumed multi-partition ring must not be a chimera
+  of restored + fresh shards)."""
+  from xotorch_tpu.inference.shard import Shard
+  from tests.test_orchestration import _two_node_ring, _stop_ring
+
+  loads = {"node-a": [], "node-b": []}
+
+  def recording_engine(name):
+    eng = DummyInferenceEngine()
+
+    async def record_load(shard, path, _name=name):
+      loads[_name].append((shard, path))
+
+    eng.load_checkpoint = record_load
+    return eng
+
+  node_a, node_b = await _two_node_ring(recording_engine("node-a"), recording_engine("node-b"))
+  try:
+    await node_a.coordinate_resume(Shard("dummy", 0, 0, 8), "/tmp/ckpts/dummy")
+    for _ in range(50):  # peer side runs via broadcast -> create_task
+      if loads["node-b"]:
+        break
+      await asyncio.sleep(0.1)
+    assert len(loads["node-a"]) == 1 and len(loads["node-b"]) == 1
+    shard_a, path_a = loads["node-a"][0]
+    shard_b, path_b = loads["node-b"][0]
+    assert path_a == path_b == "/tmp/ckpts/dummy"
+    # Each peer restored its OWN contiguous range; together they cover 0..7.
+    covered = sorted(range(shard_a.start_layer, shard_a.end_layer + 1)) + \
+              sorted(range(shard_b.start_layer, shard_b.end_layer + 1))
+    assert sorted(covered) == list(range(8))
+  finally:
+    await _stop_ring(node_a, node_b)

@@ -260,3 +260,15 @@ async def test_manual_discovery_keeps_last_good_config(tmp_path):
     assert len(await d.discover_peers()) == 1
   finally:
     await d.stop()
+
+
+def test_subnet_broadcast_address():
+  """Directed /24 broadcast derivation (parity udp_discovery.py:26-49): pins
+  the egress NIC on multi-NIC hosts; non-IPv4 sources fall back to None."""
+  from xotorch_tpu.networking.udp.discovery import subnet_broadcast_address
+  assert subnet_broadcast_address("192.168.1.42") == "192.168.1.255"
+  assert subnet_broadcast_address("10.0.7.1") == "10.0.7.255"
+  assert subnet_broadcast_address("::1") is None
+  assert subnet_broadcast_address("fe80::2") is None
+  assert subnet_broadcast_address("localhost") is None
+  assert subnet_broadcast_address("300.1.2.3") is None

@@ -102,6 +102,8 @@ class ChatGPTAPI:
     r = self.app.router
     r.add_post("/v1/chat/completions", self.handle_post_chat_completions)
     r.add_post("/chat/completions", self.handle_post_chat_completions)
+    r.add_post("/v1/chat/token/encode", self.handle_post_chat_token_encode)
+    r.add_post("/chat/token/encode", self.handle_post_chat_token_encode)
     r.add_get("/v1/models", self.handle_get_models)
     r.add_get("/models", self.handle_get_models)
     r.add_get("/modelpool", self.handle_model_support)
@@ -271,6 +273,31 @@ class ChatGPTAPI:
     if not model or model.startswith("gpt-"):  # alias gpt-* (parity :322-323)
       return self.default_model
     return model
+
+  async def handle_post_chat_token_encode(self, request):
+    """Tokenize a chat request without running it (parity reference
+    chatgpt_api.py:287-306 — same response shape: length, num_tokens,
+    encoded_tokens, encoded_prompt)."""
+    data = await request.json()
+    model = self._resolve_model(data.get("model"))
+    shard = build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"detail": f"Invalid model: {model}"}, status=400)
+    messages = data.get("messages", [])
+    # Mirror the completions path exactly (incl. the injected system prompt)
+    # so the reported token count matches what a completion would really run.
+    if self.system_prompt and not any(m.get("role") == "system" for m in messages):
+      messages = [{"role": "system", "content": self.system_prompt}] + messages
+    tokenizer = await self._tokenizer_for(model, shard)
+    prompt = build_prompt(tokenizer, messages, data.get("tools"))
+    tokens = tokenizer.encode(prompt)
+    tokens = tokens.tolist() if hasattr(tokens, "tolist") else list(tokens)
+    return web.json_response({
+      "length": len(prompt),
+      "num_tokens": len(tokens),
+      "encoded_tokens": tokens,
+      "encoded_prompt": prompt,
+    })
 
   async def handle_post_chat_completions(self, request):
     data = await request.json()

@@ -226,17 +226,22 @@ class JAXShardInferenceEngine(InferenceEngine):
     use_fd = (not use_flash) and self._flash_decode_on(state.cache["k"].shape[2])
     return x, true_t, state, use_flash, use_fd
 
-  def _forward_segment(self, request_id: str, input_data: np.ndarray):
+  def _forward_segment(self, request_id: str, input_data: np.ndarray, fill: bool = False):
     """Single-segment device forward. Returns (device output, true_t) —
     the output stays on device so callers that don't need it (cache-fill
-    segments, the fused sample path) never pay the host copy."""
+    segments, the fused sample path) never pay the host copy. `fill` selects
+    the hidden-only executables on a last-layer shard (cache update without
+    the unembedding)."""
     import jax.numpy as jnp
     x, true_t, state, use_flash, use_fd = self._segment_setup(request_id, input_data)
-    forward = self._forward_jit
-    if use_flash:
+    if fill and self._fill_jits is not None:
+      forward = self._fill_jits["flash" if use_flash else ("cached" if use_fd else "base")]
+    elif use_flash:
       forward = self._forward_flash_jit
     elif use_fd:
       forward = self._forward_decode_flash_jit
+    else:
+      forward = self._forward_jit
     out, new_cache = forward(self.params, x, state.cache, jnp.int32(state.pos))
     state.cache = new_cache
     state.pos += true_t
@@ -283,11 +288,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     true_t = input_data.shape[1]
     chunk = self._prefill_chunk()
     if true_t > chunk:
-      # All but the final segment only fill the cache — their outputs are
-      # dropped on device, never copied to host.
+      # All but the final segment only fill the cache — hidden-only
+      # executables, outputs dropped on device, never copied to host.
       split = ((true_t - 1) // chunk) * chunk
       for off in range(0, split, chunk):
-        self._forward_segment(request_id, input_data[:, off:off + chunk])
+        self._forward_segment(request_id, input_data[:, off:off + chunk], fill=True)
       input_data = input_data[:, split:]
 
     x, seg_t, state, use_flash, use_fd = self._segment_setup(request_id, input_data)
@@ -526,6 +531,18 @@ class JAXShardInferenceEngine(InferenceEngine):
       )
       forward_jit = jax.jit(fwd, donate_argnums=(2,))
       forward_flash_jit = jax.jit(partial(fwd, use_flash=True), donate_argnums=(2,))
+      # Cache-fill executables for the fused-sample path: hidden-only
+      # (is_last=False) so non-final chunked-prefill segments never pay the
+      # [T, vocab] unembedding nobody reads. jit construction is lazy —
+      # these cost nothing unless a long prompt actually uses them.
+      fill_jits = None
+      if shard.is_last_layer:
+        fill_fwd = partial(forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=False)
+        fill_jits = {
+          "base": jax.jit(fill_fwd, donate_argnums=(2,)),
+          "flash": jax.jit(partial(fill_fwd, use_flash=True), donate_argnums=(2,)),
+          "cached": jax.jit(partial(fill_fwd, use_flash_decode=True), donate_argnums=(2,)),
+        }
       # Multimodal prefill injects merged (text+image) embeddings as hidden
       # state, bypassing the token-embedding lookup: an is_first=False jit.
       forward_hidden_jit = None
@@ -540,10 +557,12 @@ class JAXShardInferenceEngine(InferenceEngine):
         if model_dir is not None:
           from xotorch_tpu.models.weights import load_vision_tower
           vision = load_vision_tower(model_dir, cfg, dtype=self._dtype())
-      return cfg, params, mesh, forward_jit, forward_flash_jit, forward_hidden_jit, forward_hidden_flash_jit, vision
+      return (cfg, params, mesh, forward_jit, forward_flash_jit, fill_jits,
+              forward_hidden_jit, forward_hidden_flash_jit, vision)
 
     (self.cfg, self.params, self._mesh, self._forward_jit, self._forward_flash_jit,
-     self._forward_hidden_jit, self._forward_hidden_flash_jit, self._vision) = await self._run(_load)
+     self._fill_jits, self._forward_hidden_jit, self._forward_hidden_flash_jit,
+     self._vision) = await self._run(_load)
     self._opt_state = None  # optimizer state is invalid for a new param tree
     self.cache_len = min(self._configured_cache_len, self.cfg.max_seq_len)
     self.max_cache_len = max(self.cache_len, min(self._configured_max_cache_len, self.cfg.max_seq_len))

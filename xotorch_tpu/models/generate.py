@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from xotorch_tpu.models.config import ModelConfig
-from xotorch_tpu.models.transformer import forward_shard, rms_norm
+from xotorch_tpu.models.transformer import forward_shard, unembed
 from xotorch_tpu.ops.sampling import sample_logits
 
 
@@ -57,12 +57,8 @@ def forward_sample(
   h, cache = forward_shard(params, x, cache, start_pos, cfg=cfg, is_first=is_first,
                            is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
-  h_last = rms_norm(h_last, params["final_norm"], cfg.rms_norm_eps)
-  if cfg.tie_word_embeddings and "lm_head" not in params:
-    logits = h_last @ params["embed"]["embedding"].T
-  else:
-    logits = h_last @ params["lm_head"]
-  tok = sample_logits(logits.astype(jnp.float32)[:, -1, :], key, temp=temp, top_k=top_k)
+  logits = unembed(params, h_last, cfg)
+  tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k)
   return tok, cache
 
 

@@ -168,12 +168,19 @@ def forward_shard(
 
   if not is_last:
     return h, new_cache
+  return unembed(params, h, cfg), new_cache
+
+
+def unembed(params: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+  """Final norm + (tied-embedding or lm_head) unembedding -> fp32 logits.
+  The single source of truth shared by forward_shard and the fused sampling
+  path (models/generate.forward_sample)."""
   h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
   if cfg.tie_word_embeddings and "lm_head" not in params:
     logits = h @ params["embed"]["embedding"].T
   else:
     logits = h @ params["lm_head"]
-  return logits.astype(jnp.float32), new_cache
+  return logits.astype(jnp.float32)
 
 
 def init_random_params(

@@ -44,6 +44,22 @@ class ListenProtocol(asyncio.DatagramProtocol):
     asyncio.create_task(self.on_message(data, addr))
 
 
+def subnet_broadcast_address(ip_addr: str) -> Optional[str]:
+  """/24 directed-broadcast address for the NIC's subnet, or None for
+  non-IPv4 sources. Matters on multi-NIC hosts: the global broadcast is
+  routed out ONE interface chosen by the OS, while the directed address
+  always leaves the NIC that owns `ip_addr` (parity udp_discovery.py:26-49)."""
+  parts = ip_addr.split(".")
+  if len(parts) != 4:
+    return None
+  try:
+    if not all(0 <= int(p) <= 255 for p in parts):
+      return None
+  except ValueError:
+    return None
+  return ".".join(parts[:3] + ["255"])
+
+
 class BroadcastProtocol(asyncio.DatagramProtocol):
   def __init__(self, message: str, broadcast_port: int, source_ip: str):
     self.message = message
@@ -53,7 +69,16 @@ class BroadcastProtocol(asyncio.DatagramProtocol):
   def connection_made(self, transport):
     sock = transport.get_extra_info("socket")
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
-    transport.sendto(self.message.encode("utf-8"), ("<broadcast>", self.broadcast_port))
+    payload = self.message.encode("utf-8")
+    # Subnet-directed first (pins the egress NIC), then the global broadcast
+    # for containers/VPNs whose subnet mask isn't /24.
+    directed = subnet_broadcast_address(self.source_ip)
+    if directed is not None:
+      try:
+        transport.sendto(payload, (directed, self.broadcast_port))
+      except OSError:
+        pass
+    transport.sendto(payload, ("<broadcast>", self.broadcast_port))
     transport.close()
 
 
