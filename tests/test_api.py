@@ -260,3 +260,50 @@ async def test_chat_token_encode_route():
     assert resp.status == 400
   finally:
     await client.close()
+
+
+async def test_prompt_cache_overflow_returns_400_context_length():
+  """A prompt that overflows the KV budget during PREFILL is the client's
+  error: 400 context_length_exceeded, not a 500 (ADVICE r1 (d); the decode
+  side already finishes gracefully as 'length')."""
+  from xotorch_tpu.inference.engine import CacheExhausted
+
+  client, node, engine = await _api_client()
+
+  async def overflowing_infer_prompt(request_id, shard, prompt, **kwargs):
+    raise CacheExhausted("prompt of 99999 tokens exceeds max cache length 16")
+
+  engine.infer_prompt = overflowing_infer_prompt
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "messages": [{"role": "user", "content": "way too long"}],
+    })
+    assert resp.status == 400
+    body = await resp.json()
+    assert body["error"]["type"] == "invalid_request_error"
+    assert body["error"]["code"] == "context_length_exceeded"
+    assert node.request_errors == {}  # consumed by the API
+
+    # Streaming variant: invalid_request_error event, not server_error.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "stream": True, "messages": [{"role": "user", "content": "long"}],
+    })
+    raw = await resp.text()
+    events = [line[6:] for line in raw.split("\n") if line.startswith("data: ")]
+    payloads = [json.loads(e) for e in events if e != "[DONE]"]
+    errs = [p for p in payloads if "error" in p]
+    assert errs and errs[0]["error"]["type"] == "invalid_request_error"
+  finally:
+    await client.close()
+
+
+async def test_base_engine_rejects_images_loudly():
+  """InferenceEngine.infer_prompt (the base text path) must raise on image
+  input rather than silently dropping it (ADVICE r1 (c)) — defense in depth
+  below the API's model-card vision check."""
+  from xotorch_tpu.inference.shard import Shard
+
+  engine = DummyInferenceEngine()
+  img = np.zeros((8, 8, 3), dtype=np.uint8)
+  with pytest.raises(ValueError, match="no vision path"):
+    await engine.infer_prompt("r", Shard("dummy", 0, 7, 8), "look at this", images=[img])

@@ -170,6 +170,51 @@ async def test_cache_exhaustion_finishes_as_length(tiny_model_dir):
   assert node.buffered_token_output == {}
 
 
+async def test_engine_seam_fused_sampling_equals_host_sampling(tiny_model_dir):
+  """VERDICT r2 #8: the direct engine-seam equivalence the bench relies on.
+
+  Three decode paths over the same tiny checkpoint must agree greedy-for-
+  greedy, per step: (a) host-side `sample(infer_tensor(...))` — the ring's
+  reference semantics; (b) `infer_sample_tensor` — on-device fused sampling;
+  (c) `generate_chunk` (decode_chunk) — the scan-fused serving fast path.
+  This is the unit-level guard that catches a backend producing fast-but-
+  wrong tokens before the bench ever times it."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  full = Shard("m", 0, n - 1, n)
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  steps = 8
+
+  # (a) host path: logits to host, argmax there.
+  eng_a = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  logits, _ = await eng_a.infer_tensor("a", full, prompt)
+  tok = int((await eng_a.sample(logits, temp=0.0))[0])
+  host_toks = [tok]
+  for _ in range(steps - 1):
+    logits, _ = await eng_a.infer_tensor("a", full, np.array([[tok]], dtype=np.int64))
+    tok = int((await eng_a.sample(logits, temp=0.0))[0])
+    host_toks.append(tok)
+
+  # (b) fused on-device sampling, one token per dispatch.
+  eng_b = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  tok_b, _ = await eng_b.infer_sample_tensor("b", full, prompt, temp=0.0, top_k=0)
+  fused_toks = [int(tok_b)]
+  for _ in range(steps - 1):
+    tok_b, _ = await eng_b.infer_sample_tensor("b", full, np.array([[tok_b]], dtype=np.int64), temp=0.0, top_k=0)
+    fused_toks.append(int(tok_b))
+  assert fused_toks == host_toks
+
+  # (c) scan-fused chunks (4 + 3 tokens after the prefill token).
+  eng_c = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  logits, _ = await eng_c.infer_tensor("c", full, prompt)
+  tok_c = int((await eng_c.sample(logits, temp=0.0))[0])
+  chunk_toks = [tok_c]
+  out = await eng_c.generate_chunk("c", full, chunk_toks[-1], 4, temp=0.0)
+  chunk_toks.extend(int(t) for t in out)
+  out = await eng_c.generate_chunk("c", full, chunk_toks[-1], 3, temp=0.0)
+  chunk_toks.extend(int(t) for t in out)
+  assert chunk_toks == host_toks
+
+
 async def test_lost_state_raises_not_garbage(tiny_model_dir):
   """Evicted mid-generation state must fail loudly (RequestStateLost), never
   silently restart from an empty cache."""

@@ -425,8 +425,12 @@ class ChatGPTAPI:
         tokens, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
         error = self.node.request_errors.pop(request_id, None) if finished else None
         if error is not None:
-          # Mid-stream failure: OpenAI-style error event, then terminate.
-          payload = {"error": {"type": "server_error", "message": error}}
+          # Mid-stream failure: OpenAI-style error event, then terminate. A
+          # prompt that overflowed the KV budget is the client's error
+          # (context_length_exceeded), not a server fault.
+          etype = ("invalid_request_error" if error.startswith("context_length_exceeded")
+                   else "server_error")
+          payload = {"error": {"type": etype, "message": error}}
           await response.write(f"data: {json.dumps(payload)}\n\n".encode())
           break
         delta = self._delta_tokens(request_id, tokens)
@@ -464,6 +468,13 @@ class ChatGPTAPI:
       deadline = time.monotonic() + self.response_timeout
     error = self.node.request_errors.pop(request_id, None)
     if error is not None:
+      if error.startswith("context_length_exceeded"):
+        # The prompt didn't fit the model's KV budget — 400, like OpenAI's
+        # context-length error, not a 500 (ADVICE r1 (d)).
+        return web.json_response(
+          {"error": {"type": "invalid_request_error", "code": "context_length_exceeded",
+                     "message": error}}, status=400
+        )
       return web.json_response(
         {"error": {"type": "server_error", "message": error}}, status=500
       )

@@ -64,7 +64,12 @@ class InferenceEngine(ABC):
     images: Optional[list] = None,
   ) -> Tuple[np.ndarray, Optional[dict]]:
     """Default text path: encode -> infer_tensor. Engines with a vision tower
-    override to consume `images` (list of uint8 HWC numpy arrays)."""
+    override to consume `images` (list of uint8 HWC numpy arrays); the base
+    path must never silently answer about images it cannot see (ADVICE r1)."""
+    if images:
+      raise ValueError(
+        f"{type(self).__name__} has no vision path; cannot process {len(images)} image(s)"
+      )
     tokens = await self.encode(shard, prompt)
     x = tokens.reshape(1, -1)
     return await self.infer_tensor(request_id, shard, x, inference_state)

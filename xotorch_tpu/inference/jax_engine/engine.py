@@ -337,7 +337,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     feats = encode_images(vparams, jnp.asarray(pixels), cfg.vision,
                           feature_layer=cfg.vision_feature_layer,
                           select=cfg.vision_feature_select)
-    feats = project_features(pparams, feats)
+    feats = project_features(pparams, feats, act=cfg.projector_hidden_act)
     token_embeds = self.params["embed"]["embedding"][jnp.asarray(token_ids.astype(np.int32))]
     merged = merge_image_features(token_embeds, token_ids, feats, cfg.image_token_index)
 

@@ -53,6 +53,7 @@ class ModelConfig:
   image_token_index: int = -1
   vision_feature_layer: int = -2
   vision_feature_select: str = "default"
+  projector_hidden_act: str = "gelu"
 
   @property
   def is_moe(self) -> bool:
@@ -71,6 +72,7 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
   image_token_index = -1
   vision_feature_layer = -2
   vision_feature_select = "default"
+  projector_hidden_act = "gelu"
   if "text_config" in cfg:
     if "vision_config" in cfg:
       from xotorch_tpu.models.vision import vision_config_from_hf
@@ -78,6 +80,7 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
       image_token_index = int(cfg.get("image_token_index", 32000))
       vision_feature_layer = int(cfg.get("vision_feature_layer", -2))
       vision_feature_select = str(cfg.get("vision_feature_select_strategy", "default"))
+      projector_hidden_act = str(cfg.get("projector_hidden_act", "gelu"))
     inner = dict(cfg["text_config"])
     inner.setdefault("model_type", inner.get("model_type", model_type))
     cfg = inner
@@ -137,6 +140,7 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
     image_token_index=image_token_index,
     vision_feature_layer=vision_feature_layer,
     vision_feature_select=vision_feature_select,
+    projector_hidden_act=projector_hidden_act,
   )
 
 

@@ -70,8 +70,16 @@ def _layer_norm(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray, eps: float) -> j
 
 
 def _act(x: jnp.ndarray, kind: str) -> jnp.ndarray:
+  """HF ACT2FN subset: exact-erf "gelu" default, sigmoid "quick_gelu",
+  tanh-approximated "gelu_new"/"gelu_pytorch_tanh", plain "relu"/"silu"."""
   if kind == "quick_gelu":
     return x * jax.nn.sigmoid(1.702 * x)
+  if kind in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
+    return jax.nn.gelu(x, approximate=True)
+  if kind == "relu":
+    return jax.nn.relu(x)
+  if kind == "silu":
+    return jax.nn.silu(x)
   return jax.nn.gelu(x, approximate=False)
 
 
@@ -129,10 +137,12 @@ def encode_images(
   return feats
 
 
-def project_features(pparams: Params, feats: jnp.ndarray) -> jnp.ndarray:
-  """LLaVA multi-modal projector: linear -> GELU -> linear into text space."""
+def project_features(pparams: Params, feats: jnp.ndarray, act: str = "gelu") -> jnp.ndarray:
+  """LLaVA multi-modal projector: linear -> act -> linear into text space.
+  `act` comes from the checkpoint's `projector_hidden_act` (HF ACT2FN
+  semantics — "gelu" exact-erf by default, not hardcoded; ADVICE r1)."""
   h = feats @ pparams["w1"] + pparams["b1"]
-  h = jax.nn.gelu(h, approximate=False)
+  h = _act(h, act)
   return h @ pparams["w2"] + pparams["b2"]
 
 
@@ -206,8 +216,11 @@ def load_vision_params(raw: Dict[str, jnp.ndarray], vcfg: VisionConfig, dtype=jn
 def preprocess_images(images: List[np.ndarray], image_size: int) -> np.ndarray:
   """uint8 HWC images (any size) -> CLIP-normalised [B, 3, S, S] fp32.
 
-  Bicubic-free resize (bilinear) is numerically close enough for serving;
-  the oracle test bypasses this by feeding pre-sized pixels.
+  CLIPImageProcessor semantics (ADVICE r1: no aspect-ratio stretching):
+  resize so the SHORTEST edge equals image_size (aspect preserved), then
+  center-crop to image_size x image_size. Bicubic-free resize (bilinear) is
+  numerically close enough for serving; the oracle test feeds pre-sized
+  pixels so tower parity is checked independently of interpolation flavor.
   """
   out = np.empty((len(images), 3, image_size, image_size), dtype=np.float32)
   for i, img in enumerate(images):
@@ -216,18 +229,26 @@ def preprocess_images(images: List[np.ndarray], image_size: int) -> np.ndarray:
       arr = np.stack([arr] * 3, axis=-1)
     if arr.shape[-1] == 4:
       arr = arr[..., :3]
-    if arr.shape[0] != image_size or arr.shape[1] != image_size:
-      arr = _resize_bilinear(arr.astype(np.float32), image_size)
+    h, w = arr.shape[:2]
+    if h != image_size or w != image_size:
+      if h <= w:
+        new_h, new_w = image_size, max(image_size, round(w * image_size / h))
+      else:
+        new_h, new_w = max(image_size, round(h * image_size / w)), image_size
+      arr = _resize_bilinear(arr.astype(np.float32), new_h, new_w)
+      top = (new_h - image_size) // 2
+      left = (new_w - image_size) // 2
+      arr = arr[top:top + image_size, left:left + image_size]
     x = arr.astype(np.float32) / 255.0
     x = (x - CLIP_IMAGE_MEAN) / CLIP_IMAGE_STD
     out[i] = x.transpose(2, 0, 1)
   return out
 
 
-def _resize_bilinear(img: np.ndarray, size: int) -> np.ndarray:
+def _resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
   h, w = img.shape[:2]
-  ys = (np.arange(size) + 0.5) * h / size - 0.5
-  xs = (np.arange(size) + 0.5) * w / size - 0.5
+  ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+  xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
   y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
   x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
   y1 = np.clip(y0 + 1, 0, h - 1)

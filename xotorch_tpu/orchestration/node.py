@@ -208,6 +208,19 @@ class Node:
       })))
       try:
         await self._process_prompt(base_shard, prompt, request_id, images)
+      except CacheExhausted as e:
+        # Prefill overflow: the prompt itself doesn't fit the KV budget. If
+        # any tokens were already produced, end as a normal truncated
+        # completion (the decode side's path); a pure-prefill overflow is a
+        # client error the API answers with 400 context_length_exceeded —
+        # never a 500 (ADVICE r1 (d); ref chatgpt_api.py:357-438 semantics).
+        tokens, _ = self.buffered_token_output.get(request_id, ([], False))
+        if tokens:
+          await self._finish_as_length(request_id)
+        else:
+          if DEBUG >= 1:
+            print(f"[{request_id}] prompt exceeds cache: {e}")
+          await self._abort_request(request_id, f"context_length_exceeded: {e}")
       except Exception as e:
         print(f"Error processing prompt [{request_id}]: {e!r}")
         if DEBUG >= 2:
