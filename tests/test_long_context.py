@@ -115,13 +115,14 @@ async def test_flash_cached_path_selected_and_correct(tiny_model_dir, monkeypatc
   # Trigger the shard load, then wrap the flash executable with a counter.
   await flash.ensure_shard(shard)
   calls = {"n": 0}
-  inner = flash._forward_decode_flash_jit
+  ctx = flash._contexts[shard]
+  inner = ctx.forward_decode_flash_jit
 
   def counting(*args, **kw):
     calls["n"] += 1
     return inner(*args, **kw)
 
-  flash._forward_decode_flash_jit = counting
+  ctx.forward_decode_flash_jit = counting
   lf, _ = await flash.infer_tensor("r", shard, prompt)
   assert calls["n"] >= 2, "pos>0 prefill segments did not take the cached kernel"
   np.testing.assert_allclose(lf, ld, atol=1e-4, rtol=1e-3)

@@ -18,6 +18,12 @@ model instead of eager dispatch:
   singleton state, fixing the documented interleaving race
   (sharded_inference_engine.py:42,135; SURVEY §5) and allowing concurrent
   requests; an LRU bound caps HBM.
+- Per-MODEL `_ShardContext` replaces the reference's whole-world reload on
+  model switch (ensure_shard drops everything, :372-421; VERDICT r2 weak
+  #2): params/executables/tokenizer/request-states are kept per (model,
+  layer-range) in an LRU of resident contexts, every compute path binds its
+  context at call time, and alternating models through the API never
+  corrupt each other's in-flight requests.
 - All device work funnels through a single-worker executor (same structural
   concurrency model as the reference, :46) so the asyncio loop never blocks
   on XLA, and JAX tracing is never entered from two threads.
@@ -29,7 +35,7 @@ import os
 import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
@@ -47,6 +53,8 @@ from xotorch_tpu.utils.helpers import DEBUG
 from xotorch_tpu.ops.sampling import DEFAULT_TEMP, DEFAULT_TOP_K
 
 MAX_RESIDENT_REQUESTS = int(os.getenv("XOT_MAX_RESIDENT_REQUESTS", "8"))
+# How many (model, layer-range) contexts stay resident in HBM at once.
+MAX_RESIDENT_MODELS = int(os.getenv("XOT_MAX_RESIDENT_MODELS", "2"))
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -63,30 +71,89 @@ class _RequestState:
   last_used: float
 
 
+@dataclass
+class _ShardContext:
+  """Everything one (model, layer-range) needs to serve: weights,
+  executables, tokenizer, and the per-request device states. Compute paths
+  bind their context at call time, so a model switch can never swap the
+  params out from under an in-flight request."""
+  shard: Shard
+  cfg: ModelConfig
+  params: Any
+  mesh: Any
+  forward_jit: Any
+  forward_flash_jit: Any
+  forward_decode_flash_jit: Any
+  fill_jits: Optional[Dict[str, Any]]
+  forward_hidden_jit: Any
+  forward_hidden_flash_jit: Any
+  vision: Any
+  model_dir: Optional[Path]
+  synthetic: bool
+  cache_len: int
+  max_cache_len: int
+  tokenizer: Any = None
+  states: "OrderedDict[str, _RequestState]" = field(default_factory=OrderedDict)
+  opt_state: Any = None
+  optimizer: Any = None
+
+
 class JAXShardInferenceEngine(InferenceEngine):
   def __init__(self, shard_downloader: Optional[ShardDownloader] = None, dtype: Optional[str] = None):
     self.shard_downloader = shard_downloader or NoopShardDownloader()
     self.session: Dict[str, Any] = {}
-    self.shard: Optional[Shard] = None
-    self.cfg: Optional[ModelConfig] = None
-    self.params: Any = None
-    self.tokenizer = None
-    self.states: "OrderedDict[str, _RequestState]" = OrderedDict()
-    self._mesh = None  # local tp mesh for multi-chip serving (set per shard)
+    self._contexts: "OrderedDict[Shard, _ShardContext]" = OrderedDict()
+    self._active: Optional[_ShardContext] = None
     self.executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="jax-engine")
-    self._forward_jit = None
     self._dtype_name = dtype or os.getenv("XOT_DTYPE", "bfloat16")
     # cache_len is the INITIAL per-request KV allocation; caches grow by
     # doubling (bounded executables: one decode program per power-of-two
     # size) up to max_cache_len = min(XOT_MAX_CACHE_LEN, cfg.max_seq_len).
     self._configured_cache_len = int(os.getenv("XOT_CACHE_LEN", "2048"))
     self._configured_max_cache_len = int(os.getenv("XOT_MAX_CACHE_LEN", "32768"))
-    self.cache_len = self._configured_cache_len
-    self.max_cache_len = self._configured_max_cache_len
     self._shard_lock = asyncio.Lock()
     self._seed = int(os.getenv("XOT_SEED", str(int(time.time()))))
     self._sample_calls = 0
     self._oom_count = 0
+
+  # ------------------------------------- active-context delegation (compat)
+
+  @property
+  def shard(self) -> Optional[Shard]:
+    return self._active.shard if self._active else None
+
+  @property
+  def cfg(self) -> Optional[ModelConfig]:
+    return self._active.cfg if self._active else None
+
+  @property
+  def params(self) -> Any:
+    return self._active.params if self._active else None
+
+  @property
+  def states(self) -> "OrderedDict[str, _RequestState]":
+    return self._active.states if self._active else OrderedDict()
+
+  @property
+  def tokenizer(self):
+    return self._active.tokenizer if self._active else None
+
+  @tokenizer.setter
+  def tokenizer(self, value):
+    if self._active is not None:
+      self._active.tokenizer = value
+
+  @property
+  def _mesh(self):
+    return self._active.mesh if self._active else None
+
+  @property
+  def cache_len(self) -> int:
+    return self._active.cache_len if self._active else self._configured_cache_len
+
+  @property
+  def max_cache_len(self) -> int:
+    return self._active.max_cache_len if self._active else self._configured_max_cache_len
 
   # ---------------------------------------------------------------- helpers
 
@@ -157,13 +224,13 @@ class JAXShardInferenceEngine(InferenceEngine):
   # ------------------------------------------------------------- public API
 
   async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
-    await self.ensure_shard(shard)
-    tokenizer = await self._ensure_tokenizer()
+    ctx = await self._ensure_ctx(shard)
+    tokenizer = await self._ensure_tokenizer(ctx)
     return np.asarray(tokenizer.encode(prompt), dtype=np.int64)
 
   async def decode(self, shard: Shard, tokens: np.ndarray) -> str:
-    await self.ensure_shard(shard)
-    tokenizer = await self._ensure_tokenizer()
+    ctx = await self._ensure_ctx(shard)
+    tokenizer = await self._ensure_tokenizer(ctx)
     return tokenizer.decode(np.asarray(tokens).reshape(-1).tolist())
 
   async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K) -> np.ndarray:
@@ -185,9 +252,9 @@ class JAXShardInferenceEngine(InferenceEngine):
   async def infer_tensor(
     self, request_id: str, shard: Shard, input_data: np.ndarray, inference_state: Optional[dict] = None
   ) -> Tuple[np.ndarray, Optional[dict]]:
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
     start = time.perf_counter_ns()
-    out = await self._run(self._infer_sync, request_id, input_data)
+    out = await self._run(self._infer_sync, ctx, request_id, input_data)
     if DEBUG >= 4:
       print(f"infer_tensor[{request_id}] {input_data.shape} -> {out.shape} in {(time.perf_counter_ns()-start)/1e6:.2f}ms")
     return out, inference_state
@@ -205,7 +272,7 @@ class JAXShardInferenceEngine(InferenceEngine):
   def _prefill_chunk(self) -> int:
     return int(os.getenv("XOT_PREFILL_CHUNK", "4096"))
 
-  def _segment_setup(self, request_id: str, input_data: np.ndarray):
+  def _segment_setup(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray):
     """Shared per-segment prep for the forward and fused-sample paths:
     device transfer, bucket padding, state/capacity, and the
     flash-vs-cached-vs-baseline executable choice (one place, no drift).
@@ -218,7 +285,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     x = self._to_device_input(input_data)
     true_t = x.shape[1]
     bucket = 1 if true_t == 1 else _bucket(true_t)
-    state = self._prep_state(request_id, bucket)
+    state = self._prep_state(ctx, request_id, bucket)
     if bucket != true_t:
       pad = [(0, 0), (0, bucket - true_t)] + [(0, 0)] * (x.ndim - 2)
       x = jnp.pad(x, pad)
@@ -226,29 +293,30 @@ class JAXShardInferenceEngine(InferenceEngine):
     use_fd = (not use_flash) and self._flash_decode_on(state.cache["k"].shape[2])
     return x, true_t, state, use_flash, use_fd
 
-  def _forward_segment(self, request_id: str, input_data: np.ndarray, fill: bool = False):
+  def _forward_segment(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
+                       fill: bool = False):
     """Single-segment device forward. Returns (device output, true_t) —
     the output stays on device so callers that don't need it (cache-fill
     segments, the fused sample path) never pay the host copy. `fill` selects
     the hidden-only executables on a last-layer shard (cache update without
     the unembedding)."""
     import jax.numpy as jnp
-    x, true_t, state, use_flash, use_fd = self._segment_setup(request_id, input_data)
-    if fill and self._fill_jits is not None:
-      forward = self._fill_jits["flash" if use_flash else ("cached" if use_fd else "base")]
+    x, true_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
+    if fill and ctx.fill_jits is not None:
+      forward = ctx.fill_jits["flash" if use_flash else ("cached" if use_fd else "base")]
     elif use_flash:
-      forward = self._forward_flash_jit
+      forward = ctx.forward_flash_jit
     elif use_fd:
-      forward = self._forward_decode_flash_jit
+      forward = ctx.forward_decode_flash_jit
     else:
-      forward = self._forward_jit
-    out, new_cache = forward(self.params, x, state.cache, jnp.int32(state.pos))
+      forward = ctx.forward_jit
+    out, new_cache = forward(ctx.params, x, state.cache, jnp.int32(state.pos))
     state.cache = new_cache
     state.pos += true_t
     state.last_used = time.monotonic()
     return out, true_t
 
-  def _infer_sync(self, request_id: str, input_data: np.ndarray) -> np.ndarray:
+  def _infer_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray) -> np.ndarray:
     # Long prompts prefill in fixed segments: bounds the prefill-bucket
     # executable set and (with the cached Pallas kernel) keeps attention
     # memory at VMEM-tile scale instead of [T, S] — a 32 k prompt never
@@ -258,11 +326,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     if true_t > chunk:
       outs = []
       for off in range(0, true_t, chunk):
-        out, t = self._forward_segment(request_id, input_data[:, off:off + chunk])
+        out, t = self._forward_segment(ctx, request_id, input_data[:, off:off + chunk])
         # Padded tail positions carry garbage activations — slice them off.
         outs.append(np.asarray(out[:, :t]))
       return np.concatenate(outs, axis=1)
-    out, t = self._forward_segment(request_id, input_data)
+    out, t = self._forward_segment(ctx, request_id, input_data)
     return np.asarray(out[:, :t])
 
   async def infer_sample_tensor(
@@ -274,13 +342,14 @@ class JAXShardInferenceEngine(InferenceEngine):
     the host receives one int, not [B, T, vocab] fp32 logits. This is the
     ring's last-layer hot path (VERDICT r1 weak #3 — the reference pulls
     ~0.5 MB of logits to the host per token, node.py:109-147)."""
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer:
       raise ValueError(f"infer_sample_tensor requires the last-layer shard, got {shard}")
-    tok = await self._run(self._infer_sample_sync, request_id, input_data, float(temp), int(top_k))
+    tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data, float(temp), int(top_k))
     return tok, inference_state
 
-  def _infer_sample_sync(self, request_id: str, input_data: np.ndarray, temp: float, top_k: int) -> int:
+  def _infer_sample_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
+                         temp: float, top_k: int) -> int:
     import jax
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import forward_sample
@@ -292,15 +361,15 @@ class JAXShardInferenceEngine(InferenceEngine):
       # executables, outputs dropped on device, never copied to host.
       split = ((true_t - 1) // chunk) * chunk
       for off in range(0, split, chunk):
-        self._forward_segment(request_id, input_data[:, off:off + chunk], fill=True)
+        self._forward_segment(ctx, request_id, input_data[:, off:off + chunk], fill=True)
       input_data = input_data[:, split:]
 
-    x, seg_t, state, use_flash, use_fd = self._segment_setup(request_id, input_data)
+    x, seg_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
     self._sample_calls += 1
     key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
     tok, state.cache = forward_sample(
-      self.params, x, state.cache, jnp.int32(state.pos), jnp.int32(seg_t - 1), key,
-      self.cfg, x.ndim == 2, temp, top_k, use_flash=use_flash, use_flash_decode=use_fd,
+      ctx.params, x, state.cache, jnp.int32(state.pos), jnp.int32(seg_t - 1), key,
+      ctx.cfg, x.ndim == 2, temp, top_k, use_flash=use_flash, use_flash_decode=use_fd,
     )
     state.pos += seg_t
     state.last_used = time.monotonic()
@@ -310,18 +379,19 @@ class JAXShardInferenceEngine(InferenceEngine):
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
     images: Optional[list] = None,
   ) -> Tuple[np.ndarray, Optional[dict]]:
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
     if not images:
       return await super().infer_prompt(request_id, shard, prompt, inference_state)
-    if not (self.cfg and self.cfg.is_multimodal):
+    if not ctx.cfg.is_multimodal:
       # Defense in depth (the API rejects this earlier): never silently answer
       # about an image the model cannot see.
       raise ValueError(f"model {shard.model_id} does not support image input")
     tokens = await self.encode(shard, prompt)
-    out = await self._run(self._infer_multimodal_sync, request_id, tokens.reshape(-1), images)
+    out = await self._run(self._infer_multimodal_sync, ctx, request_id, tokens.reshape(-1), images)
     return out, inference_state
 
-  def _infer_multimodal_sync(self, request_id: str, token_ids: np.ndarray, images: list) -> np.ndarray:
+  def _infer_multimodal_sync(self, ctx: _ShardContext, request_id: str, token_ids: np.ndarray,
+                             images: list) -> np.ndarray:
     """Multimodal prefill: vision tower -> projector -> splice patch features
     at <image> placeholder positions -> run the text stack on the merged
     embedding sequence (is_first=False jit). LLaVA-1.5 semantics, verified
@@ -329,28 +399,28 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax.numpy as jnp
     from xotorch_tpu.models.vision import encode_images, merge_image_features, preprocess_images, project_features
 
-    if self._vision is None:
+    if ctx.vision is None:
       raise RuntimeError("vision weights unavailable for multimodal request")
-    vparams, pparams = self._vision
-    cfg = self.cfg
+    vparams, pparams = ctx.vision
+    cfg = ctx.cfg
     pixels = preprocess_images(images, cfg.vision.image_size)
     feats = encode_images(vparams, jnp.asarray(pixels), cfg.vision,
                           feature_layer=cfg.vision_feature_layer,
                           select=cfg.vision_feature_select)
     feats = project_features(pparams, feats, act=cfg.projector_hidden_act)
-    token_embeds = self.params["embed"]["embedding"][jnp.asarray(token_ids.astype(np.int32))]
+    token_embeds = ctx.params["embed"]["embedding"][jnp.asarray(token_ids.astype(np.int32))]
     merged = merge_image_features(token_embeds, token_ids, feats, cfg.image_token_index)
 
     true_t = merged.shape[0]
     bucket = 1 if true_t == 1 else _bucket(true_t)
-    state = self._prep_state(request_id, bucket)
+    state = self._prep_state(ctx, request_id, bucket)
     x = merged[None]
     if bucket != true_t:
       x = jnp.pad(x, [(0, 0), (0, bucket - true_t), (0, 0)])
-    forward = self._forward_hidden_jit
+    forward = ctx.forward_hidden_jit
     if true_t > 1 and state.pos == 0 and self._flash_enabled():
-      forward = self._forward_hidden_flash_jit
-    out, state.cache = forward(self.params, x.astype(self._dtype()), state.cache, jnp.int32(state.pos))
+      forward = ctx.forward_hidden_flash_jit
+    out, state.cache = forward(ctx.params, x.astype(self._dtype()), state.cache, jnp.int32(state.pos))
     state.pos += true_t
     state.last_used = time.monotonic()
     return np.asarray(out[:, :true_t])
@@ -366,22 +436,34 @@ class JAXShardInferenceEngine(InferenceEngine):
     prefilled cache. Returns None when the fast path does not apply so the
     caller (Node.process_inference_result) falls back to the per-token ring.
     """
-    if not (shard == self.shard and shard.is_first_layer and shard.is_last_layer) or num_tokens < 1:
+    if not (shard.is_first_layer and shard.is_last_layer) or num_tokens < 1:
       return None
-    state = self.states.get(request_id)
+    ctx = self._contexts.get(shard)
+    if ctx is None:
+      # A full-model shard with no resident context means the context (and
+      # the request's KV cache with it) was LRU-evicted mid-generation: the
+      # prefill that preceded this call must have created it. Returning None
+      # would silently fall back to the per-token ring, which would reload
+      # the model with EMPTY states and restart from pos 0 — fail loudly.
+      raise RequestStateLost(
+        f"request {request_id}: model context {shard.model_id} evicted mid-generation"
+      )
+    state = ctx.states.get(request_id)
     if state is None:
       # The caller guaranteed a prefill happened, so the state was LRU-evicted
       # under concurrency. Falling back would silently restart from an empty
       # cache — fail loudly instead.
       raise RequestStateLost(f"request {request_id}: device state evicted mid-generation")
-    # Refresh LRU recency: a request decoding purely through the fused path
-    # must not be evicted mid-generation by newer requests' prefills.
-    self.states.move_to_end(request_id)
+    # Refresh LRU recency at BOTH levels: a request decoding purely through
+    # the fused path must not have its request state — or its whole model
+    # context — evicted mid-generation by newer requests.
+    self._contexts.move_to_end(shard)
+    ctx.states.move_to_end(request_id)
     # The chunk advances the cache by num_tokens starting at pos (the slot of
     # prev_token's forward step is pos, the last sampled token's is pos+K-1).
-    if state.pos + num_tokens > self.max_cache_len:
-      if state.pos + 1 > self.max_cache_len:
-        raise CacheExhausted(f"request {request_id}: cache full at {state.pos}/{self.max_cache_len}")
+    if state.pos + num_tokens > ctx.max_cache_len:
+      if state.pos + 1 > ctx.max_cache_len:
+        raise CacheExhausted(f"request {request_id}: cache full at {state.pos}/{ctx.max_cache_len}")
       return None  # tail shorter than a chunk: per-token ring finishes it
 
     def _chunk() -> np.ndarray:
@@ -389,13 +471,13 @@ class JAXShardInferenceEngine(InferenceEngine):
       import jax.numpy as jnp
       from xotorch_tpu.models.generate import decode_chunk
       if state.pos + num_tokens > state.cache["k"].shape[2]:
-        self._grow_cache(state, state.pos + num_tokens)
+        self._grow_cache(ctx, state, state.pos + num_tokens)
       self._sample_calls += 1
       key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
       tok = jnp.asarray([[prev_token]], dtype=jnp.int32)
       toks, state.cache = decode_chunk(
-        self.params, tok, state.cache, jnp.int32(state.pos), key,
-        self.cfg, num_tokens, float(temp), int(top_k),
+        ctx.params, tok, state.cache, jnp.int32(state.pos), key,
+        ctx.cfg, num_tokens, float(temp), int(top_k),
         use_flash_decode=self._flash_decode_on(state.cache["k"].shape[2]),
       )
       state.pos += num_tokens
@@ -404,23 +486,23 @@ class JAXShardInferenceEngine(InferenceEngine):
 
     return await self._run(_chunk)
 
-  def _prep_state(self, request_id: str, bucket: int) -> _RequestState:
+  def _prep_state(self, ctx: _ShardContext, request_id: str, bucket: int) -> _RequestState:
     """State + capacity for `bucket` more tokens. Checks are against the
     padded bucket, not true_t: dynamic_update_slice CLAMPS out-of-range
     starts, which would silently overwrite earlier cache slots. Runs on the
     engine executor (it may touch the device to grow the cache)."""
-    state = self._get_or_create_state(request_id, min_len=bucket)
+    state = self._get_or_create_state(ctx, request_id, min_len=bucket)
     needed = state.pos + bucket
-    if needed > self.max_cache_len:
+    if needed > ctx.max_cache_len:
       raise CacheExhausted(
         f"Request {request_id}: {bucket} new tokens at pos {state.pos} "
-        f"exceed max cache length {self.max_cache_len}"
+        f"exceed max cache length {ctx.max_cache_len}"
       )
     if needed > state.cache["k"].shape[2]:
-      self._grow_cache(state, needed)
+      self._grow_cache(ctx, state, needed)
     return state
 
-  def _grow_cache(self, state: _RequestState, needed: int) -> None:
+  def _grow_cache(self, ctx: _ShardContext, state: _RequestState, needed: int) -> None:
     """Double the request's KV buffer until it fits `needed` (caller bounds
     against max_cache_len). Power-of-two sizes keep the executable count
     logarithmic; contents are preserved, tail slots zero-padded."""
@@ -430,7 +512,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     new_len = S
     while new_len < needed:
       new_len *= 2
-    new_len = min(new_len, self.max_cache_len)
+    new_len = min(new_len, ctx.max_cache_len)
 
     def _pad(x):
       pad = [(0, 0)] * x.ndim
@@ -438,58 +520,89 @@ class JAXShardInferenceEngine(InferenceEngine):
       return jnp.pad(x, pad)
 
     state.cache = jax.tree.map(_pad, state.cache)
-    if self._mesh is not None:
+    if ctx.mesh is not None:
       from xotorch_tpu.parallel.mesh import shard_cache
-      state.cache = shard_cache(state.cache, self._mesh)
+      state.cache = shard_cache(state.cache, ctx.mesh)
     if DEBUG >= 2:
       print(f"KV cache grown {S} -> {new_len}")
 
-  def _get_or_create_state(self, request_id: str, min_len: int = 0) -> _RequestState:
+  def _get_or_create_state(self, ctx: _ShardContext, request_id: str, min_len: int = 0) -> _RequestState:
     """Per-request device state with LRU residency (shared by the text,
     multimodal, and fused-decode paths — one lifecycle, no drift). A fresh
     state is allocated at the bucket size covering min_len so a long prompt
     doesn't allocate-then-immediately-regrow."""
-    state = self.states.get(request_id)
+    state = ctx.states.get(request_id)
     if state is None:
-      length = self.cache_len
-      while length < min_len and length < self.max_cache_len:
+      length = ctx.cache_len
+      while length < min_len and length < ctx.max_cache_len:
         length *= 2
       # The doubling can overshoot a non-power-of-two max; never allocate
       # beyond the configured bound (callers raise CacheExhausted when even
       # max_cache_len can't fit the request).
-      length = min(length, self.max_cache_len)
-      state = _RequestState(cache=self._new_cache(length), pos=0, last_used=time.monotonic())
-      self.states[request_id] = state
-      while len(self.states) > MAX_RESIDENT_REQUESTS:
-        evicted, _ = self.states.popitem(last=False)
+      length = min(length, ctx.max_cache_len)
+      state = _RequestState(cache=self._new_cache(ctx, length), pos=0, last_used=time.monotonic())
+      ctx.states[request_id] = state
+      while len(ctx.states) > MAX_RESIDENT_REQUESTS:
+        evicted, _ = ctx.states.popitem(last=False)
         if DEBUG >= 2:
           print(f"Evicted request state {evicted}")
     # True LRU: refresh recency on every touch, not just creation.
-    self.states.move_to_end(request_id)
+    ctx.states.move_to_end(request_id)
     return state
 
-  def _new_cache(self, length: Optional[int] = None):
-    import jax.numpy as jnp
+  def _new_cache(self, ctx: _ShardContext, length: Optional[int] = None):
     from xotorch_tpu.models.transformer import init_kv_cache
-    cache = init_kv_cache(self.cfg, self.shard.get_layer_count(), 1, length or self.cache_len, self._dtype())
-    if getattr(self, "_mesh", None) is not None:
+    cache = init_kv_cache(ctx.cfg, ctx.shard.get_layer_count(), 1, length or ctx.cache_len, self._dtype())
+    if ctx.mesh is not None:
       # KV heads shard over tp alongside the attention weights, so the cache
       # stays distributed across the local chips' HBM for the request's life.
       from xotorch_tpu.parallel.mesh import shard_cache
-      cache = shard_cache(cache, self._mesh)
+      cache = shard_cache(cache, ctx.mesh)
     return cache
 
   # ------------------------------------------------------------ shard setup
 
   async def ensure_shard(self, shard: Shard) -> None:
-    if self.shard == shard:
-      return
-    async with self._shard_lock:
-      if self.shard == shard:  # another task finished the load while we waited
-        return
-      await self._load_shard(shard)
+    await self._ensure_ctx(shard)
 
-  async def _load_shard(self, shard: Shard) -> None:
+  async def _ensure_ctx(self, shard: Shard) -> _ShardContext:
+    """Resolve the context for `shard`, loading it if absent. Resident
+    contexts are an LRU bounded by XOT_MAX_RESIDENT_MODELS: switching models
+    keeps the previous model's params/executables/request-states warm
+    (VERDICT r2 weak #2 — the old engine dropped every in-flight request's
+    KV cache on any model switch), and compute paths hold their own ctx
+    reference so eviction can never corrupt a running computation (its
+    params stay alive through the reference; only NEW requests miss)."""
+    ctx = self._contexts.get(shard)
+    if ctx is not None:
+      self._contexts.move_to_end(shard)
+      self._active = ctx
+      return ctx
+    async with self._shard_lock:
+      ctx = self._contexts.get(shard)  # another task loaded it while we waited
+      if ctx is not None:
+        self._contexts.move_to_end(shard)
+        self._active = ctx
+        return ctx
+      ctx = await self._load_shard(shard)
+      self._contexts[shard] = ctx
+      self._contexts.move_to_end(shard)
+      self._active = ctx
+      while len(self._contexts) > MAX_RESIDENT_MODELS:
+        # Prefer evicting a context with no in-flight request states; only
+        # when every candidate is busy does the oldest go (its requests then
+        # fail loudly via RequestStateLost rather than silently restarting).
+        victim = next(
+          (s for s, c in self._contexts.items() if s != shard and not c.states),
+          next(s for s in self._contexts if s != shard),
+        )
+        evicted = self._contexts.pop(victim)
+        if DEBUG >= 1:
+          print(f"Evicted model context {victim} "
+                f"({len(evicted.states)} resident request states)")
+      return ctx
+
+  async def _load_shard(self, shard: Shard) -> _ShardContext:
     card = get_model_card(shard.model_id) or {}
     synthetic_cfg = card.get("synthetic_config")
     if synthetic_cfg is not None:
@@ -531,6 +644,9 @@ class JAXShardInferenceEngine(InferenceEngine):
       )
       forward_jit = jax.jit(fwd, donate_argnums=(2,))
       forward_flash_jit = jax.jit(partial(fwd, use_flash=True), donate_argnums=(2,))
+      # Occupancy-aware Pallas decode executable (long-context serving); jit
+      # construction is lazy so this costs nothing until first selected.
+      forward_decode_flash_jit = jax.jit(partial(fwd, use_flash_decode=True), donate_argnums=(2,))
       # Cache-fill executables for the fused-sample path: hidden-only
       # (is_last=False) so non-final chunked-prefill segments never pay the
       # [T, vocab] unembedding nobody reads. jit construction is lazy —
@@ -557,87 +673,79 @@ class JAXShardInferenceEngine(InferenceEngine):
         if model_dir is not None:
           from xotorch_tpu.models.weights import load_vision_tower
           vision = load_vision_tower(model_dir, cfg, dtype=self._dtype())
-      return (cfg, params, mesh, forward_jit, forward_flash_jit, fill_jits,
-              forward_hidden_jit, forward_hidden_flash_jit, vision)
+      return (cfg, params, mesh, forward_jit, forward_flash_jit, forward_decode_flash_jit,
+              fill_jits, forward_hidden_jit, forward_hidden_flash_jit, vision)
 
-    (self.cfg, self.params, self._mesh, self._forward_jit, self._forward_flash_jit,
-     self._fill_jits, self._forward_hidden_jit, self._forward_hidden_flash_jit,
-     self._vision) = await self._run(_load)
-    self._opt_state = None  # optimizer state is invalid for a new param tree
-    self.cache_len = min(self._configured_cache_len, self.cfg.max_seq_len)
-    self.max_cache_len = max(self.cache_len, min(self._configured_max_cache_len, self.cfg.max_seq_len))
-    # Occupancy-aware Pallas decode executable (long-context serving); jit
-    # construction is lazy so this costs nothing until first selected.
-    import jax as _jax
-    from xotorch_tpu.models.transformer import forward_shard as _fwd
-    self._forward_decode_flash_jit = _jax.jit(
-      partial(_fwd, cfg=self.cfg, is_first=shard.is_first_layer, is_last=shard.is_last_layer,
-              use_flash_decode=True),
-      donate_argnums=(2,),
+    (cfg, params, mesh, forward_jit, forward_flash_jit, forward_decode_flash_jit,
+     fill_jits, forward_hidden_jit, forward_hidden_flash_jit, vision) = await self._run(_load)
+    cache_len = min(self._configured_cache_len, cfg.max_seq_len)
+    max_cache_len = max(cache_len, min(self._configured_max_cache_len, cfg.max_seq_len))
+    ctx = _ShardContext(
+      shard=shard, cfg=cfg, params=params, mesh=mesh,
+      forward_jit=forward_jit, forward_flash_jit=forward_flash_jit,
+      forward_decode_flash_jit=forward_decode_flash_jit, fill_jits=fill_jits,
+      forward_hidden_jit=forward_hidden_jit, forward_hidden_flash_jit=forward_hidden_flash_jit,
+      vision=vision, model_dir=model_dir, synthetic=synthetic_cfg is not None,
+      cache_len=cache_len, max_cache_len=max_cache_len,
     )
-    self._model_dir = model_dir
-    self._synthetic = synthetic_cfg is not None
-    self.tokenizer = None  # resolved lazily: mid-ring shards never need one
-    self.shard = shard
-    self.states.clear()
     if DEBUG >= 1:
-      print(f"JAX engine ready for {shard} (dtype={self._dtype_name}, cache_len={self.cache_len})")
+      print(f"JAX engine ready for {shard} (dtype={self._dtype_name}, cache_len={cache_len})")
+    return ctx
 
-  async def _ensure_tokenizer(self):
-    if self.tokenizer is not None:
-      return self.tokenizer
-    if self._synthetic or self.shard.model_id == "dummy":
-      self.tokenizer = DummyTokenizer()
-      if self.cfg.eos_token_ids:
-        self.tokenizer.eos_token_id = self.cfg.eos_token_ids[0]
-      return self.tokenizer
+  async def _ensure_tokenizer(self, ctx: Optional[_ShardContext] = None):
+    ctx = ctx or self._active
+    if ctx.tokenizer is not None:
+      return ctx.tokenizer
+    if ctx.synthetic or ctx.shard.model_id == "dummy":
+      ctx.tokenizer = DummyTokenizer()
+      if ctx.cfg.eos_token_ids:
+        ctx.tokenizer.eos_token_id = ctx.cfg.eos_token_ids[0]
+      return ctx.tokenizer
     try:
-      self.tokenizer = await resolve_tokenizer(self._model_dir)
+      ctx.tokenizer = await resolve_tokenizer(ctx.model_dir)
     except Exception as e:
       if DEBUG >= 1:
-        print(f"Tokenizer resolution failed for {self._model_dir}: {e!r}; using dummy tokenizer")
-      self.tokenizer = DummyTokenizer()
-      if self.cfg.eos_token_ids:
-        self.tokenizer.eos_token_id = self.cfg.eos_token_ids[0]
-    return self.tokenizer
+        print(f"Tokenizer resolution failed for {ctx.model_dir}: {e!r}; using dummy tokenizer")
+      ctx.tokenizer = DummyTokenizer()
+      if ctx.cfg.eos_token_ids:
+        ctx.tokenizer.eos_token_id = ctx.cfg.eos_token_ids[0]
+    return ctx.tokenizer
 
   # ------------------------------------------------------------ checkpoints
 
   async def load_checkpoint(self, shard: Shard, path: str) -> None:
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
 
     def _load():
-      import jax.numpy as jnp
-      from safetensors import safe_open
       from xotorch_tpu.models.weights import load_shard_params
       p = Path(path)
       model_dir = p if p.is_dir() else p.parent
-      return load_shard_params(model_dir, self.cfg, self.shard, dtype=self._dtype())
+      return load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype())
 
-    self.params = await self._run(_load)
-    self._opt_state = None  # optimizer state is invalid for reloaded weights
+    ctx.params = await self._run(_load)
+    ctx.opt_state = None  # optimizer state is invalid for reloaded weights
 
   async def save_checkpoint(self, shard: Shard, path: str) -> None:
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
 
     def _save():
       from xotorch_tpu.models.weights import save_shard_params
-      save_shard_params(self.params, self.cfg, self.shard, Path(path))
+      save_shard_params(ctx.params, ctx.cfg, ctx.shard, Path(path))
 
     await self._run(_save)
 
   # -------------------------------------------------------------- training
 
-  def _ensure_optimizer(self):
-    """Optimizer state is tied to the current param tree; _load_shard and
+  def _ensure_optimizer(self, ctx: _ShardContext):
+    """Optimizer state is tied to the context's param tree; _load_shard and
     load_checkpoint reset it (stale Adam moments must never be applied to a
     different tree)."""
-    if getattr(self, "_optimizer", None) is None or getattr(self, "_opt_state", None) is None:
+    if ctx.optimizer is None or ctx.opt_state is None:
       import optax
       lr = float(os.getenv("XOT_LR", "1e-5"))
-      self._optimizer = optax.adamw(lr)
-      self._opt_state = self._optimizer.init(self.params)
-    return self._optimizer
+      ctx.optimizer = optax.adamw(lr)
+      ctx.opt_state = ctx.optimizer.init(ctx.params)
+    return ctx.optimizer
 
   async def train_example(self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
                           lengths: np.ndarray, forward_fn=None):
@@ -646,10 +754,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     through the saved vjp, apply AdamW locally, hand the input-gradient
     upstream. Completes node.py:299-345's missing engine leaf. Every device
     op (including host<->device transfers) runs on the single executor."""
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer and forward_fn is None:
       raise ValueError("Non-last shard requires forward_fn to chain the ring")
-    optimizer = self._ensure_optimizer()
+    optimizer = self._ensure_optimizer(ctx)
 
     if shard.is_last_layer:
       def _last():
@@ -660,10 +768,10 @@ class JAXShardInferenceEngine(InferenceEngine):
         tgt = jnp.asarray(np.asarray(target).astype(np.int32))
         lens = jnp.asarray(np.asarray(lengths).reshape(-1).astype(np.int32))
         loss, x_grad, param_grads = shard_loss_and_grads(
-          self.params, self.cfg, x, tgt, lens, shard.is_first_layer, True
+          ctx.params, ctx.cfg, x, tgt, lens, shard.is_first_layer, True
         )
-        updates, self._opt_state = optimizer.update(param_grads, self._opt_state, self.params)
-        self.params = optax.apply_updates(self.params, updates)
+        updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, ctx.params)
+        ctx.params = optax.apply_updates(ctx.params, updates)
         return float(loss), np.asarray(x_grad)
       return await self._run(_last)
 
@@ -674,15 +782,15 @@ class JAXShardInferenceEngine(InferenceEngine):
       from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
       x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
       B, T = x.shape[0], x.shape[1]
-      cache = init_kv_cache(self.cfg, shard.get_layer_count(), B, T, jnp.float32)
+      cache = init_kv_cache(ctx.cfg, shard.get_layer_count(), B, T, jnp.float32)
 
       def fwd(p, xin):
-        return forward_shard(p, xin, cache, jnp.int32(0), self.cfg, shard.is_first_layer, False)[0]
+        return forward_shard(p, xin, cache, jnp.int32(0), ctx.cfg, shard.is_first_layer, False)[0]
 
       if shard.is_first_layer:
-        out, vjp_fn = jax.vjp(lambda p: fwd(p, x), self.params)
+        out, vjp_fn = jax.vjp(lambda p: fwd(p, x), ctx.params)
       else:
-        out, vjp_fn = jax.vjp(fwd, self.params, x)
+        out, vjp_fn = jax.vjp(fwd, ctx.params, x)
       return np.asarray(out), vjp_fn, out.dtype
 
     activations, vjp_fn, out_dtype = await self._run(_fwd_vjp)
@@ -700,8 +808,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       else:
         param_grads, xg = vjp_fn(down)
         x_grad = np.asarray(xg)
-      updates, self._opt_state = optimizer.update(param_grads, self._opt_state, self.params)
-      self.params = optax.apply_updates(self.params, updates)
+      updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, ctx.params)
+      ctx.params = optax.apply_updates(ctx.params, updates)
       return x_grad
 
     x_grad = await self._run(_bwd_apply)
@@ -709,7 +817,7 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   async def evaluate_example(self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
                              lengths: np.ndarray, forward_fn=None) -> float:
-    await self.ensure_shard(shard)
+    ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer and forward_fn is None:
       raise ValueError("Non-last shard requires forward_fn to chain the ring")
 
@@ -718,8 +826,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
       x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
       B, T = x.shape[0], x.shape[1]
-      cache = init_kv_cache(self.cfg, shard.get_layer_count(), B, T, jnp.float32)
-      out = forward_shard(self.params, x, cache, jnp.int32(0), self.cfg,
+      cache = init_kv_cache(ctx.cfg, shard.get_layer_count(), B, T, jnp.float32)
+      out = forward_shard(ctx.params, x, cache, jnp.int32(0), ctx.cfg,
                           shard.is_first_layer, shard.is_last_layer)[0]
       if shard.is_last_layer:
         from xotorch_tpu.train.step import masked_ce_loss
@@ -735,4 +843,5 @@ class JAXShardInferenceEngine(InferenceEngine):
     return loss
 
   async def clear_request(self, request_id: str) -> None:
-    self.states.pop(request_id, None)
+    for ctx in self._contexts.values():
+      ctx.states.pop(request_id, None)
