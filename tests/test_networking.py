@@ -57,6 +57,7 @@ def _mock_node():
   node.collect_topology = mock.AsyncMock(return_value=topo)
   node.on_token = mock.MagicMock()
   node.on_opaque_status = mock.MagicMock()
+  node.ingest_remote_result = mock.AsyncMock(return_value=(True, 3))
   return node
 
 
@@ -94,8 +95,9 @@ async def test_grpc_server_and_peer_handle_roundtrip():
     topo = await peer.collect_topology(set(), max_depth=2)
     assert topo.nodes == {}
 
-    await peer.send_result("req-1", [1, 2, 3], False)
-    node.on_token.trigger_all.assert_called_once()
+    ack = await peer.send_result("req-1", [1, 2, 3], False, total_len=3)
+    node.ingest_remote_result.assert_awaited_once_with("req-1", [1, 2, 3], 3, False, error=None)
+    assert ack == {"ok": True, "applied": True, "have": 3}
     await peer.send_opaque_status("req-1", json.dumps({"type": "node_status"}))
     node.on_opaque_status.trigger_all.assert_called_once()
 

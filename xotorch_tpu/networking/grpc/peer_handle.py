@@ -112,12 +112,15 @@ class GRPCPeerHandle(PeerHandle):
     return (loss, tensors.get("grads")) if loss is not None else None
 
   async def send_result(self, request_id: str, result, is_finished: bool,
-                        error: Optional[str] = None) -> None:
-    fields = {"request_id": request_id, "is_finished": is_finished, "error": error}
+                        error: Optional[str] = None,
+                        total_len: Optional[int] = None) -> Optional[dict]:
+    fields = {"request_id": request_id, "is_finished": is_finished, "error": error,
+              "total_len": total_len}
     if isinstance(result, np.ndarray):
-      await self._call("SendResult", fields, {"result": result})
+      ack, _ = await self._call("SendResult", fields, {"result": result})
     else:
-      await self._call("SendResult", {**fields, "result": list(result)})
+      ack, _ = await self._call("SendResult", {**fields, "result": list(result)})
+    return ack
 
   async def send_opaque_status(self, request_id: str, status: str) -> None:
     await self._call("SendOpaqueStatus", {"request_id": request_id, "status": status})

@@ -90,22 +90,11 @@ class GRPCServer(Server):
     fields, tensors = decode_message(request)
     request_id = fields["request_id"]
     result = tensors["result"] if "result" in tensors else fields.get("result", [])
-    if len(result) == 0 and fields["is_finished"]:  # len(), not truthiness: result may be an ndarray
-      # A mid-ring abort/exhaustion broadcast carries no token payload (only
-      # the sampler buffers tokens); fall back to whatever this peer knows so
-      # listeners aren't handed an empty completion.
-      result = self.node.buffered_token_output.get(request_id, ([], False))[0]
-    if fields.get("error"):
-      # Record before triggering so API consumers see the cause when the
-      # finished callback lands.
-      self.node.record_request_error(request_id, fields["error"])
-    self.node.on_token.trigger_all(request_id, result, fields["is_finished"])
-    if fields["is_finished"]:
-      # The finished broadcast is how non-sampler peers learn a request
-      # ended; run the same cleanup the sampler runs (bookkeeping + the
-      # engine's resident KV cache).
-      await self.node._finish_generation(request_id)
-    return encode_message({"ok": True})
+    applied, have = await self.node.ingest_remote_result(
+      request_id, [int(t) for t in result], fields.get("total_len"),
+      fields["is_finished"], error=fields.get("error"),
+    )
+    return encode_message({"ok": True, "applied": applied, "have": have})
 
   async def _rpc_send_opaque_status(self, request: bytes, context) -> bytes:
     fields, _ = decode_message(request)

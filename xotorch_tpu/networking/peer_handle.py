@@ -67,7 +67,15 @@ class PeerHandle(ABC):
 
   @abstractmethod
   async def send_result(self, request_id: str, result, is_finished: bool,
-                        error: Optional[str] = None) -> None:
+                        error: Optional[str] = None,
+                        total_len: Optional[int] = None) -> Optional[dict]:
+    """Deliver sampled tokens. `result` is a DELTA (the newly sampled tokens)
+    when `total_len` is given — total_len is the sender's full buffered
+    length, letting the receiver detect gaps and request reconciliation via
+    the returned ack ({"applied": bool, "have": int}). total_len=None keeps
+    the legacy full-list semantics (SURVEY §2.5 flags the reference's
+    full-list-every-token broadcast, node.py:580-591, as the known-
+    inefficient design to replace — this is the replacement)."""
     ...
 
   @abstractmethod

@@ -109,6 +109,9 @@ class Node:
     # Why a request aborted (bounded LRU; API pops entries when reporting).
     from collections import OrderedDict
     self.request_errors: "OrderedDict[str, str]" = OrderedDict()
+    # Request ids whose finish broadcast was applied here (bounded): shields
+    # against out-of-order straggler deltas resurrecting finished requests.
+    self._finished_results: "OrderedDict[str, None]" = OrderedDict()
 
   # ------------------------------------------------------------- lifecycle
 
@@ -469,7 +472,19 @@ class Node:
     self._last_token_time[request_id] = now
     self.buffered_token_output[request_id] = (buffered, finished)
     self.trigger_on_token_callbacks(request_id, buffered, finished)
-    asyncio.create_task(self.broadcast_result(request_id, buffered, finished))
+    # Delta broadcast: only the newly appended tokens ride the wire —
+    # O(1) bytes/token instead of the reference's full-list-every-token
+    # O(T^2) fan-out (node.py:580-591; SURVEY §2.5 "known-inefficient
+    # design to replace"). total_len lets receivers detect gaps and ask for
+    # a one-shot full reconciliation (broadcast_result handles the resend).
+    delta = buffered[len(buffered) - appended:] if appended else []
+    # full_ref is the LIVE buffer object: by the time a gapped peer asks for
+    # reconciliation, buffered_token_output may already be popped by
+    # _finish_generation — the list object itself stays complete.
+    asyncio.create_task(
+      self.broadcast_result(request_id, delta, finished, total_len=len(buffered),
+                            full_ref=buffered)
+    )
     return finished
 
   async def _finish_generation(self, request_id: str) -> None:
@@ -772,14 +787,96 @@ class Node:
     self.on_token.trigger_all(request_id, tokens, is_finished)
 
   async def broadcast_result(self, request_id: str, result: List[int], is_finished: bool,
-                             error: Optional[str] = None) -> None:
+                             error: Optional[str] = None, total_len: Optional[int] = None,
+                             full_ref: Optional[List[int]] = None) -> None:
+    """Fan the (delta) token payload out to every peer. A peer whose ack
+    reports a gap (it missed an earlier broadcast — joined late, dropped an
+    RPC) gets a full-list reconciliation send (retried once: for a finished
+    request this second RPC is the peer's only chance to learn the end);
+    steady state stays O(1) bytes per token. `full_ref` is the sender's live
+    token buffer — read at reconciliation time, NOT via buffered_token_output
+    (the sampler pops that entry the moment the request finishes)."""
     async def send(peer):
       try:
-        await asyncio.wait_for(peer.send_result(request_id, result, is_finished, error=error), timeout=15.0)
+        ack = await asyncio.wait_for(
+          peer.send_result(request_id, result, is_finished, error=error, total_len=total_len),
+          timeout=15.0,
+        )
+        if total_len is not None and isinstance(ack, dict) and ack.get("applied") is False:
+          full = list(full_ref) if full_ref is not None else (
+            self.buffered_token_output.get(request_id, (list(result), is_finished))[0]
+          )
+          for attempt in (1, 2):
+            try:
+              await asyncio.wait_for(
+                peer.send_result(request_id, full, is_finished, error=error,
+                                 total_len=len(full)),
+                timeout=15.0,
+              )
+              break
+            except Exception:
+              if attempt == 2:
+                raise
       except Exception as e:
         if DEBUG >= 2:
           print(f"broadcast_result to {peer.id()} failed: {e!r}")
     await asyncio.gather(*(send(p) for p in self.peers), return_exceptions=True)
+
+  async def ingest_remote_result(self, request_id: str, tokens: List[int],
+                                 total_len: Optional[int], is_finished: bool,
+                                 error: Optional[str] = None) -> Tuple[bool, int]:
+    """Receiver side of the delta token broadcast: reconcile the delta into
+    this peer's buffer. Returns (applied, have) for the sender's ack — a gap
+    (missed broadcast) reports applied=False so the sender re-sends the full
+    list. total_len=None means `tokens` IS the full list (legacy/abort
+    sends).
+
+    Ordering robustness (each broadcast is an independent task, so unary
+    RPCs to the same peer can land out of order): a send whose total_len is
+    not ahead of what we hold is STALE and ignored (monotonic guard — a
+    delayed early delta must never truncate newer state), and anything
+    arriving after the finish was applied is dropped outright (a straggler
+    must not resurrect per-request state or fire post-finish callbacks)."""
+    if request_id in self._finished_results:
+      return True, 0  # straggler after finish: drop
+    buffered, _ = self.buffered_token_output.get(request_id, ([], False))
+    have = len(buffered)
+    if is_finished and not tokens:
+      # A mid-ring abort/exhaustion broadcast carries no token payload (only
+      # the sampler buffers tokens); fall back to whatever this peer knows so
+      # listeners aren't handed an empty completion.
+      merged = buffered
+    elif total_len is not None and total_len <= have and not is_finished and not error:
+      return True, have  # stale reorder: newer state already held
+    elif total_len is None or total_len == len(tokens):
+      merged = list(tokens)  # full list (legacy send or reconciliation)
+    else:
+      start = total_len - len(tokens)
+      if have >= start:
+        merged = buffered[:start] + list(tokens)  # contiguous (or finish replay)
+      else:
+        # Gap: we never saw tokens [have, start). Don't hand listeners a
+        # sequence with a hole — ask for reconciliation. Record the error
+        # NOW though: its delivery must not depend on the second RPC.
+        if error:
+          self.record_request_error(request_id, error)
+        return False, have
+    if error:
+      # Record before triggering so API consumers see the cause when the
+      # finished callback lands.
+      self.record_request_error(request_id, error)
+    self.buffered_token_output[request_id] = (merged, is_finished)
+    self.trigger_on_token_callbacks(request_id, merged, is_finished)
+    if is_finished:
+      # The finished broadcast is how non-sampler peers learn a request
+      # ended; run the same cleanup the sampler runs (bookkeeping + the
+      # engine's resident KV cache). Remember the id (bounded) so delayed
+      # stragglers can't resurrect the request.
+      self._finished_results[request_id] = None
+      while len(self._finished_results) > 512:
+        self._finished_results.popitem(last=False)
+      await self._finish_generation(request_id)
+    return True, len(merged)
 
   async def broadcast_opaque_status(self, request_id: str, status: str) -> None:
     async def send(peer):
