@@ -1,0 +1,230 @@
+"""LoRA fine-tuning (train/lora.py + engine wiring).
+
+BASELINE.md config 5 / VERDICT r2 #5: adapter A/B tensors on the attention
+projections, frozen base via optax masking, adapter-only checkpoints that
+round-trip through coordinate_save/coordinate_resume, CLI --lora-rank.
+Reference intent: the train CLI defaults to the bundled LoRA dataset
+(/root/reference/xotorch/main.py:298-315, train/data/lora/) but its engine
+train leaf was never implemented (SURVEY §0).
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+@pytest.fixture()
+def tiny_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+
+
+def _full_shard():
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  return Shard("m", 0, n - 1, n)
+
+
+def _engine(model_dir, monkeypatch, rank=0):
+  if rank:
+    monkeypatch.setenv("XOT_LORA_RANK", str(rank))
+  else:
+    monkeypatch.delenv("XOT_LORA_RANK", raising=False)
+  monkeypatch.setenv("XOT_LR", "1e-2")  # tiny model: visible progress fast
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+def _batch(seed=0, B=2, T=16):
+  rng = np.random.RandomState(seed)
+  inputs = rng.randint(3, TINY_LLAMA_CFG["vocab_size"], (B, T)).astype(np.int64)
+  targets = np.roll(inputs, -1, axis=1)
+  lengths = np.full((B,), T - 1, np.int64)
+  return inputs, targets, lengths
+
+
+async def test_lora_init_is_identity(tiny_model_dir, monkeypatch):
+  """B=0 at init: attaching adapters must not change the model's outputs."""
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  base = _engine(tiny_model_dir, monkeypatch, rank=0)
+  ref, _ = await base.infer_tensor("r", _full_shard(), prompt)
+  lora = _engine(tiny_model_dir, monkeypatch, rank=2)
+  got, _ = await lora.infer_tensor("r", _full_shard(), prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+async def test_lora_train_freezes_base_and_reduces_loss(tiny_model_dir, monkeypatch):
+  """Training with adapters: loss decreases, ONLY adapter tensors move, and
+  the trainable fraction is tiny (rank-2 on a 64-wide toy model lands ~2%;
+  on the 1B+ models the same wiring is <<1%)."""
+  from xotorch_tpu.train.lora import has_lora, lora_param_counts
+
+  eng = _engine(tiny_model_dir, monkeypatch, rank=2)
+  shard = _full_shard()
+  await eng.ensure_shard(shard)
+  assert has_lora(eng.params)
+
+  adapter, total = lora_param_counts(eng.params)
+  assert 0 < adapter / total < 0.03
+
+  base_before = {
+    k: np.asarray(v).copy() for k, v in eng.params["layers"].items() if not k.startswith("lora_")
+  }
+  embed_before = np.asarray(eng.params["embed"]["embedding"]).copy()
+
+  inputs, targets, lengths = _batch()
+  losses = []
+  for i in range(30):
+    loss, _ = await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
+    losses.append(loss)
+  assert losses[-1] < losses[0] * 0.9, f"loss did not decrease: {losses[0]:.4f} -> {losses[-1]:.4f}"
+
+  # Frozen base: bit-identical after 30 optimizer steps.
+  for k, before in base_before.items():
+    np.testing.assert_array_equal(np.asarray(eng.params["layers"][k]), before, err_msg=k)
+  np.testing.assert_array_equal(np.asarray(eng.params["embed"]["embedding"]), embed_before)
+  # Adapters actually moved (B leaves start at zero and must leave it).
+  assert any(
+    np.abs(np.asarray(v)).max() > 0
+    for k, v in eng.params["layers"].items() if k.endswith("_b")
+  )
+
+
+async def test_lora_adapter_only_checkpoint_roundtrip(tiny_model_dir, monkeypatch, tmp_path):
+  """save_checkpoint with adapters writes ONLY lora.* tensors (MBs, not the
+  base); a fresh engine over the same base restores identical outputs."""
+  from safetensors import safe_open
+
+  eng = _engine(tiny_model_dir, monkeypatch, rank=2)
+  shard = _full_shard()
+  inputs, targets, lengths = _batch()
+  for i in range(4):
+    await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
+
+  ckpt = tmp_path / "adapters.safetensors"
+  await eng.save_checkpoint(shard, str(ckpt))
+  with safe_open(str(ckpt), framework="np") as f:
+    names = list(f.keys())
+  assert names and all(n.startswith("lora.") for n in names)
+  # Adapter file is a sliver of the base checkpoint's size.
+  base_size = sum(p.stat().st_size for p in tiny_model_dir.glob("*.safetensors"))
+  assert ckpt.stat().st_size < base_size / 5
+
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  want, _ = await eng.infer_tensor("r", shard, prompt)
+
+  fresh = _engine(tiny_model_dir, monkeypatch, rank=2)
+  await fresh.load_checkpoint(shard, str(ckpt))
+  got, _ = await fresh.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+async def test_lora_coordinate_save_resume_roundtrip(tiny_model_dir, monkeypatch, tmp_path):
+  """The ring-level checkpoint flow: coordinate_save writes this shard's
+  adapter file under {dir}/{model}/{sid}-{iter}.safetensors; a fresh node
+  resumes from the directory and serves identical logits."""
+  from tests.test_orchestration import NullServer, StaticDiscovery, _caps
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  def make_node(name, engine):
+    node = Node(name, NullServer(), engine, StaticDiscovery([]), None,
+                RingMemoryWeightedPartitioningStrategy())
+    node.device_capabilities = _caps()
+    node.topology.update_node(name, _caps())
+    return node
+
+  eng = _engine(tiny_model_dir, monkeypatch, rank=2)
+  shard = _full_shard()
+  node = make_node("trainer", eng)
+  inputs, targets, lengths = _batch()
+  for i in range(4):
+    await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
+  await node.coordinate_save(shard, 4, str(tmp_path))
+
+  saved = list((tmp_path / "m").glob("*.safetensors"))
+  assert len(saved) == 1 and saved[0].name == "0-3-4.safetensors"
+
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  want, _ = await eng.infer_tensor("r", shard, prompt)
+
+  fresh_eng = _engine(tiny_model_dir, monkeypatch, rank=2)
+  fresh = make_node("resumer", fresh_eng)
+  await fresh.coordinate_resume(shard, str(tmp_path / "m"))
+  got, _ = await fresh_eng.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+async def test_lora_pipelined_two_shard_training(tiny_model_dir, monkeypatch):
+  """Adapters work through the pipelined ring path too: a 2-shard split
+  trains (loss decreases) with both shards' bases frozen."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  first = Shard("m", 0, n // 2 - 1, n)
+  second = Shard("m", n // 2, n - 1, n)
+  eng_a = _engine(tiny_model_dir, monkeypatch, rank=2)
+  eng_b = _engine(tiny_model_dir, monkeypatch, rank=2)
+  await eng_a.ensure_shard(first)
+  await eng_b.ensure_shard(second)
+  base_a = {k: np.asarray(v).copy() for k, v in eng_a.params["layers"].items() if not k.startswith("lora_")}
+
+  async def downstream(activations, target, lengths_, train):
+    return await eng_b.train_example("req", second, activations, target, lengths_)
+
+  inputs, targets, lengths = _batch()
+  losses = []
+  for i in range(10):
+    loss, _ = await eng_a.train_example("req", first, inputs, targets, lengths, forward_fn=downstream)
+    losses.append(loss)
+  assert losses[-1] < losses[0] * 0.95
+  for k, before in base_a.items():
+    np.testing.assert_array_equal(np.asarray(eng_a.params["layers"][k]), before, err_msg=k)
+
+
+def test_cli_has_lora_rank_flag():
+  from xotorch_tpu.main import build_parser
+  args = build_parser().parse_args(["train", "m", "--lora-rank", "8"])
+  assert args.lora_rank == 8
+  assert build_parser().parse_args([]).lora_rank == 0
+
+
+async def test_full_checkpoint_coordinate_save_resume(tiny_model_dir, monkeypatch, tmp_path):
+  """Without --lora-rank, coordinate_save writes per-shard FULL checkpoints
+  ({sid}-{iter}.safetensors, no HF index); resume from that directory must
+  load them, not FileNotFoundError into a silent fresh-weights restart."""
+  from tests.test_orchestration import NullServer, StaticDiscovery, _caps
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  def make_node(name, engine):
+    node = Node(name, NullServer(), engine, StaticDiscovery([]), None,
+                RingMemoryWeightedPartitioningStrategy())
+    node.device_capabilities = _caps()
+    node.topology.update_node(name, _caps())
+    return node
+
+  eng = _engine(tiny_model_dir, monkeypatch, rank=0)
+  shard = _full_shard()
+  node = make_node("full-trainer", eng)
+  inputs, targets, lengths = _batch()
+  for i in range(3):
+    await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
+  await node.coordinate_save(shard, 3, str(tmp_path))
+  assert (tmp_path / "m" / "0-3-3.safetensors").exists()
+
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  want, _ = await eng.infer_tensor("r", shard, prompt)
+
+  fresh_eng = _engine(tiny_model_dir, monkeypatch, rank=0)
+  fresh = make_node("full-resumer", fresh_eng)
+  await fresh.coordinate_resume(shard, str(tmp_path / "m"))
+  got, _ = await fresh_eng.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+  # And it actually differs from the untrained base (the resume did load).
+  base_eng = _engine(tiny_model_dir, monkeypatch, rank=0)
+  base_logits, _ = await base_eng.infer_tensor("r", shard, prompt)
+  assert not np.allclose(np.asarray(got), np.asarray(base_logits), atol=1e-5)

@@ -639,6 +639,17 @@ class JAXShardInferenceEngine(InferenceEngine):
         if DEBUG >= 1:
           print(f"Serving shard over local tp={mesh.shape['tp']} mesh")
 
+      # LoRA fine-tuning (XOT_LORA_RANK / CLI --lora-rank): adapter tensors
+      # join the stacked layers pytree (replicated under a tp mesh — they are
+      # rank-r slivers), the base stays frozen via the masked optimizer.
+      lora_rank = int(os.getenv("XOT_LORA_RANK", "0"))
+      if lora_rank > 0:
+        from xotorch_tpu.train.lora import ATTN_SLOTS, MLP_SLOTS, add_lora_params
+        targets = ATTN_SLOTS + (MLP_SLOTS if os.getenv("XOT_LORA_TARGETS", "") == "all" else ())
+        params = add_lora_params(params, lora_rank, jax.random.PRNGKey(self._seed), targets)
+        if DEBUG >= 1:
+          print(f"LoRA adapters attached: rank={lora_rank}, targets={targets}")
+
       fwd = partial(
         forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=shard.is_last_layer
       )
@@ -713,14 +724,61 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   # ------------------------------------------------------------ checkpoints
 
+  def _checkpoint_file_for(self, path: Path, shard: Shard) -> Optional[Path]:
+    """Resolve a concrete safetensors file for this shard: a file path is
+    taken as-is; a directory prefers this shard's own `{start}-{end}-*`
+    saves (latest iteration), falling back to any safetensors present."""
+    if path.is_file():
+      return path
+    if not path.is_dir():
+      return None
+    sid = f"{shard.start_layer}-{shard.end_layer}"
+    mine = sorted(
+      path.glob(f"{sid}-*.safetensors"),
+      key=lambda p: int(p.stem.rsplit("-", 1)[-1]) if p.stem.rsplit("-", 1)[-1].isdigit() else -1,
+    )
+    if mine:
+      return mine[-1]
+    # Never fall back to ANOTHER shard's save (a `{start}-{end}-{iter}` file
+    # for a different layer range would load garbage or KeyError); only
+    # non-shard-patterned files qualify as a generic fallback.
+    import re
+    rest = sorted(p for p in path.glob("*.safetensors")
+                  if not re.fullmatch(r"\d+-\d+-\d+", p.stem))
+    return rest[0] if rest else None
+
   async def load_checkpoint(self, shard: Shard, path: str) -> None:
     ctx = await self._ensure_ctx(shard)
 
     def _load():
+      import jax
+      from xotorch_tpu.train import lora as lora_mod
       from xotorch_tpu.models.weights import load_shard_params
       p = Path(path)
+      ckpt = self._checkpoint_file_for(p, ctx.shard)
+      if ckpt is not None and lora_mod.is_lora_checkpoint(ckpt):
+        # Adapter-only checkpoint: merge into the (already loaded) base.
+        return lora_mod.load_lora_checkpoint(ctx.params, ctx.shard, ckpt)
       model_dir = p if p.is_dir() else p.parent
-      return load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype())
+      if (model_dir / "model.safetensors.index.json").exists() or (model_dir / "model.safetensors").exists():
+        params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype())
+      elif ckpt is not None:
+        # coordinate_save wrote a per-shard `{sid}-{iter}` file (no HF index).
+        params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype(),
+                                   checkpoint_file=ckpt)
+      else:
+        raise FileNotFoundError(f"no checkpoint for shard {ctx.shard} at {path}")
+      # An engine running with LoRA must stay a LoRA engine after a full/base
+      # checkpoint load: re-attach FRESH adapters (same rank/targets as the
+      # current ones) so has_lora stays true and the optimizer keeps the base
+      # frozen — otherwise a base reload silently converts --lora-rank
+      # training into a full fine-tune.
+      lora_a_keys = sorted(k for k in ctx.params["layers"] if k.startswith("lora_") and k.endswith("_a"))
+      if lora_a_keys:
+        rank = int(ctx.params["layers"][lora_a_keys[0]].shape[-1])
+        targets = tuple(k[len("lora_"):-len("_a")] for k in lora_a_keys)
+        params = lora_mod.add_lora_params(params, rank, jax.random.PRNGKey(self._seed), targets)
+      return params
 
     ctx.params = await self._run(_load)
     ctx.opt_state = None  # optimizer state is invalid for reloaded weights
@@ -729,6 +787,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     ctx = await self._ensure_ctx(shard)
 
     def _save():
+      from xotorch_tpu.train import lora as lora_mod
+      if lora_mod.has_lora(ctx.params):
+        # Parameter-efficient save: adapters only (MBs, not the base model).
+        lora_mod.save_lora_checkpoint(ctx.params, ctx.shard, Path(path))
+        return
       from xotorch_tpu.models.weights import save_shard_params
       save_shard_params(ctx.params, ctx.cfg, ctx.shard, Path(path))
 
@@ -742,8 +805,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     different tree)."""
     if ctx.optimizer is None or ctx.opt_state is None:
       import optax
+      from xotorch_tpu.train.lora import has_lora, masked_optimizer
       lr = float(os.getenv("XOT_LR", "1e-5"))
-      ctx.optimizer = optax.adamw(lr)
+      base = optax.adamw(lr)
+      # With adapters attached, the base model is FROZEN: optax.masked zeroes
+      # non-adapter updates and never allocates Adam moments for them.
+      ctx.optimizer = masked_optimizer(base, ctx.params) if has_lora(ctx.params) else base
       ctx.opt_state = ctx.optimizer.init(ctx.params)
     return ctx.optimizer
 

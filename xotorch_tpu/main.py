@@ -73,12 +73,19 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--save-every", type=int, default=5)
   parser.add_argument("--save-checkpoint-dir", type=str, default="checkpoints")
   parser.add_argument("--resume-checkpoint", type=str, default=None)
+  parser.add_argument("--lora-rank", type=int, default=0,
+                      help="attach rank-r LoRA adapters; train updates only them (<1%% of params)")
   return parser
 
 
 def build_node(args) -> tuple:
   node_id = args.node_id or get_or_create_node_id()
   node_port = args.node_port or find_available_port()
+  if getattr(args, "lora_rank", 0):
+    # The engine reads this at shard-load time (every peer must agree, so the
+    # train CLI's value rides the env into locally spawned engines; remote
+    # peers set their own flag).
+    os.environ["XOT_LORA_RANK"] = str(args.lora_rank)
 
   from xotorch_tpu.download import NoopShardDownloader
   from xotorch_tpu.download.hf_shard_download import HFShardDownloader

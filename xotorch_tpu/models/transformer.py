@@ -34,6 +34,20 @@ from xotorch_tpu.ops.rope import apply_rope, rope_frequencies
 Params = Dict[str, Any]
 
 
+LORA_SCALE = 2.0  # alpha / r with alpha = 2r (train/lora.py builds the tensors)
+
+
+def _maybe_lora(layer: Params, slot: str, h: jnp.ndarray, base_out: jnp.ndarray) -> jnp.ndarray:
+  """base_out + scale * (h @ A) @ B when `slot` carries LoRA tensors. The
+  presence check is static under jit — adapters change the traced graph, not
+  a runtime branch, so un-adapted serving pays nothing."""
+  a = layer.get(f"lora_{slot}_a")
+  if a is None:
+    return base_out
+  delta = (h @ a) @ layer[f"lora_{slot}_b"]
+  return base_out + delta.astype(base_out.dtype) * LORA_SCALE
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
   x32 = x.astype(jnp.float32)
   norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
@@ -53,9 +67,9 @@ def _attention_block(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-  q = h @ layer["wq"]
-  k = h @ layer["wk"]
-  v = h @ layer["wv"]
+  q = _maybe_lora(layer, "wq", h, h @ layer["wq"])
+  k = _maybe_lora(layer, "wk", h, h @ layer["wk"])
+  v = _maybe_lora(layer, "wv", h, h @ layer["wv"])
   if "bq" in layer:
     q = q + layer["bq"]
     k = k + layer["bk"]
@@ -93,13 +107,15 @@ def _attention_block(
     attn = ring_attention_sharded(q, k, v, ring_mesh)
   else:
     attn = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), positions, kv_valid_len)
-  out = attn.reshape(B, T, cfg.num_heads * cfg.head_dim) @ layer["wo"]
+  attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+  out = _maybe_lora(layer, "wo", attn2d, attn2d @ layer["wo"])
   return out, k_cache, v_cache
 
 
 def _dense_mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
-  gate = jax.nn.silu(h @ layer["w_gate"])
-  return (gate * (h @ layer["w_up"])) @ layer["w_down"]
+  gate = jax.nn.silu(_maybe_lora(layer, "w_gate", h, h @ layer["w_gate"]))
+  up = gate * _maybe_lora(layer, "w_up", h, h @ layer["w_up"])
+  return _maybe_lora(layer, "w_down", up, up @ layer["w_down"])
 
 
 def _moe_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:

@@ -126,11 +126,23 @@ def _strip_prefix(name: str) -> str:
 
 
 def load_shard_params(
-  model_dir: Path, cfg: ModelConfig, shard: Shard, dtype=jnp.bfloat16
+  model_dir: Path, cfg: ModelConfig, shard: Shard, dtype=jnp.bfloat16,
+  checkpoint_file: Optional[Path] = None,
 ) -> Dict[str, Any]:
-  """Load a shard's params in the stacked layout used by forward_shard."""
+  """Load a shard's params in the stacked layout used by forward_shard.
+
+  checkpoint_file: load every tensor from this one safetensors file instead
+  of the HF index (coordinate_save writes per-shard `{sid}-{iter}` files
+  without an index; resume must read them back)."""
   model_dir = Path(model_dir)
-  index = _index_for(model_dir)
+  if checkpoint_file is not None:
+    from safetensors import safe_open
+    checkpoint_file = Path(checkpoint_file)
+    model_dir = checkpoint_file.parent
+    with safe_open(str(checkpoint_file), framework="np") as f:
+      index = {name: checkpoint_file.name for name in f.keys()}
+  else:
+    index = _index_for(model_dir)
   from xotorch_tpu.models.vision import is_vision_tensor
   names = tensor_names_for_shard(list(index.keys()), shard, cfg.tie_word_embeddings)
   raw = _read_tensors(model_dir, [n for n in names if not is_vision_tensor(n)], index)

@@ -1,0 +1,161 @@
+"""LoRA adapters for shard transformers, TPU-first.
+
+Fulfills the reference's parameter-efficient-training intent (the `xot train`
+CLI defaults to a bundled LoRA dataset, main.py:79 + train/data/lora/, but
+the reference's engine train leaf was never implemented — SURVEY §0).
+
+Design: adapter tensors live INSIDE the stacked `params["layers"]` pytree as
+`lora_<slot>_a` [L, in, r] / `lora_<slot>_b` [L, r, out], so the existing
+`lax.scan` over layers carries them with zero structural change — one XLA
+layer body, adapters included, regardless of shard depth. The base weights
+stay frozen via `optax.masked` (updates for non-adapter leaves are zeroed at
+the optimizer, so Adam never allocates moments for them either — the
+optimizer state is ~2x adapter size, not 2x model size).
+
+Init follows the standard recipe: A ~ N(0, 0.02), B = 0, so training starts
+at the base model exactly. The contribution is scaled by alpha/r with
+alpha = 2r (scale 2.0), the common default.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import optax
+
+from xotorch_tpu.models.transformer import LORA_SCALE  # noqa: F401 (single source of truth)
+
+Params = Dict[str, Any]
+
+# Slots eligible for adaptation: attention projections by default (the
+# classic LoRA target set); MLP projections opt-in.
+ATTN_SLOTS = ("wq", "wk", "wv", "wo")
+MLP_SLOTS = ("w_gate", "w_up", "w_down")
+
+
+def lora_names(slot: str) -> Tuple[str, str]:
+  return f"lora_{slot}_a", f"lora_{slot}_b"
+
+
+def add_lora_params(
+  params: Params, rank: int, key: jax.Array,
+  targets: Iterable[str] = ATTN_SLOTS, scale_init: float = 0.02,
+) -> Params:
+  """Return params with stacked LoRA tensors added to the layers pytree for
+  every target slot present in this shard. Base tensors are untouched."""
+  layers = dict(params["layers"])
+  for i, slot in enumerate(targets):
+    base = layers.get(slot)
+    if base is None:
+      continue
+    L, d_in, d_out = base.shape[0], base.shape[1], base.shape[2]
+    a_name, b_name = lora_names(slot)
+    k = jax.random.fold_in(key, i)
+    layers[a_name] = (jax.random.normal(k, (L, d_in, rank), jnp.float32) * scale_init).astype(base.dtype)
+    layers[b_name] = jnp.zeros((L, rank, d_out), base.dtype)
+  return {**params, "layers": layers}
+
+
+def strip_lora_params(params: Params) -> Params:
+  """Return params with every adapter tensor removed (the frozen base)."""
+  layers = {k: v for k, v in params["layers"].items() if not k.startswith("lora_")}
+  return {**params, "layers": layers}
+
+
+def has_lora(params: Params) -> bool:
+  return any(k.startswith("lora_") for k in params.get("layers", {}))
+
+
+def lora_mask(params: Params) -> Params:
+  """Boolean pytree: True exactly on adapter leaves (for optax.masked)."""
+
+  def mask_layers(layers: Params) -> Params:
+    return {k: k.startswith("lora_") for k in layers}
+
+  return {
+    k: (mask_layers(v) if k == "layers" else jax.tree.map(lambda _: False, v))
+    for k, v in params.items()
+  }
+
+
+def lora_param_counts(params: Params) -> Tuple[int, int]:
+  """(trainable adapter param count, total param count)."""
+  total = sum(int(x.size) for x in jax.tree.leaves(params))
+  adapter = sum(
+    int(v.size) for k, v in params.get("layers", {}).items() if k.startswith("lora_")
+  )
+  return adapter, total
+
+
+def masked_optimizer(base: optax.GradientTransformation, params: Params) -> optax.GradientTransformation:
+  """Freeze everything but the adapters. NOTE optax.masked alone is a trap:
+  it passes masked-OUT updates through unchanged (raw gradients applied at
+  scale 1 — instant divergence). multi_transform routes frozen leaves to
+  set_to_zero, which also allocates no Adam moments for them."""
+  labels = jax.tree.map(lambda m: "lora" if m else "frozen", lora_mask(params))
+  return optax.multi_transform({"lora": base, "frozen": optax.set_to_zero()}, labels)
+
+
+def save_lora_checkpoint(params: Params, shard, out_path) -> None:
+  """Adapter-ONLY checkpoint: a LoRA fine-tune of a 70B model saves MBs, not
+  the 140 GB base (the reference saved nothing at all — its engine
+  save_checkpoint was a no-op, inference_engine.py:34-41). Tensor names are
+  absolute-layer-indexed (`lora.layers.{i}.{slot}_{a|b}`) so any peer
+  holding that layer range can restore its slice."""
+  from pathlib import Path
+  from safetensors.flax import save_file
+
+  flat: Dict[str, jnp.ndarray] = {}
+  for k, v in params["layers"].items():
+    if not k.startswith("lora_"):
+      continue
+    for idx, i in enumerate(range(shard.start_layer, shard.end_layer + 1)):
+      flat[f"lora.layers.{i}.{k[len('lora_'):]}"] = v[idx]
+  out_path = Path(out_path)
+  out_path.parent.mkdir(parents=True, exist_ok=True)
+  save_file(flat, str(out_path))
+
+
+def is_lora_checkpoint(path) -> bool:
+  """True when every tensor in the safetensors FILE is an adapter tensor.
+  Directory-to-file resolution is the caller's job (the engine's
+  _checkpoint_file_for owns the shard-aware pick — one rule, one place)."""
+  from safetensors import safe_open
+
+  try:
+    with safe_open(str(path), framework="np") as f:
+      names = list(f.keys())
+  except Exception:
+    return False
+  return bool(names) and all(n.startswith("lora.") for n in names)
+
+
+def load_lora_checkpoint(params: Params, shard, path) -> Params:
+  """Merge an adapter-only checkpoint FILE into `params` (restacking this
+  shard's layer range). The base tree is untouched; a checkpoint that does
+  not cover this shard's layers raises with the missing range."""
+  from safetensors import safe_open
+
+  raw: Dict[str, jnp.ndarray] = {}
+  with safe_open(str(path), framework="np") as f:
+    for name in f.keys():
+      raw[name] = jnp.asarray(f.get_tensor(name))
+
+  slots = sorted({n.split(".", 3)[3] for n in raw if n.startswith("lora.layers.")})
+  layers = dict(params["layers"])
+  for slot in slots:  # e.g. "wq_a"
+    missing = [i for i in range(shard.start_layer, shard.end_layer + 1)
+               if f"lora.layers.{i}.{slot}" not in raw]
+    if missing:
+      raise KeyError(
+        f"adapter checkpoint {path} lacks layers {missing} of slot {slot} "
+        f"needed by shard {shard.start_layer}-{shard.end_layer}"
+      )
+    stacked = jnp.stack([
+      raw[f"lora.layers.{i}.{slot}"] for i in range(shard.start_layer, shard.end_layer + 1)
+    ])
+    base_dtype = layers[slot.rsplit("_", 1)[0]].dtype if slot.rsplit("_", 1)[0] in layers else stacked.dtype
+    layers[f"lora_{slot}"] = stacked.astype(base_dtype)
+  return {**params, "layers": layers}
