@@ -321,6 +321,100 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   return result
 
 
+def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_path: str) -> dict:
+  """2-partition same-process ring throughput (VERDICT r2 #3 'bench gains a
+  2-partition mode'): two engines in one process joined by
+  InProcessPeerHandle — hidden states hop device-resident, the decode is the
+  per-token ring path. Measured with the chat-TUI method (tokens/elapsed at
+  the token callback, ref chat_tui.py:121-128)."""
+  import asyncio
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.networking.inprocess import InProcessPeerHandle
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+
+  class _NullServer:
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+  class _NoDiscovery:
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+    async def discover_peers(self, wait_for_peers: int = 0):
+      return []
+
+  def caps():
+    return DeviceCapabilities("bench", "chip", 1024, DeviceFlops(1.0, 2.0, 4.0))
+
+  async def run() -> dict:
+    from xotorch_tpu.inference.shard import Shard
+
+    nodes = []
+    for name in ("ring2-a", "ring2-b"):
+      node = Node(name, _NullServer(), JAXShardInferenceEngine(), _NoDiscovery(), None,
+                  RingMemoryWeightedPartitioningStrategy(),
+                  max_generate_tokens=decode_tokens, default_sample_temp=0.0,
+                  decode_chunk_size=1)
+      node.device_capabilities = caps()
+      nodes.append(node)
+    for node in nodes:
+      for other in nodes:
+        node.topology.update_node(other.id, caps())
+      node.peers = [InProcessPeerHandle(o) for o in nodes if o is not node]
+
+    shard = Shard(model_id, 0, n_layers - 1, n_layers)
+    prompt = " ".join(["w"] * prefill_len)  # DummyTokenizer: 1 token/word
+
+    async def generate(tag: str) -> dict:
+      done = asyncio.Event()
+      stamps = []
+
+      def on_token(request_id, tokens, is_finished):
+        if request_id != f"bench-{tag}":
+          return  # a straggler broadcast from a previous run must not leak in
+        stamps.append((time.time(), len(tokens)))
+        if is_finished:
+          done.set()
+
+      for node in nodes:
+        node.on_token.register(f"bench-{tag}-{node.id}").on_next(on_token)
+      t0 = time.time()
+      await nodes[0].process_prompt(shard, prompt, f"bench-{tag}")
+      await asyncio.wait_for(done.wait(), timeout=1800)
+      for node in nodes:
+        node.on_token.deregister(f"bench-{tag}-{node.id}")
+      n_tokens = max(n for _, n in stamps)
+      # Steady-state decode rate: drop the first token (prefill + compiles).
+      after_first = [t for t, n in stamps if n > 1]
+      steady = (n_tokens - 1) / (after_first[-1] - stamps[0][0]) if len(after_first) > 1 else 0.0
+      return {"ttft_s": stamps[0][0] - t0, "tok_s": steady, "n_tokens": n_tokens}
+
+    warm = await generate("warmup")  # compiles both shards' executables
+    _record(progress_path, "ring2:warmup", **{k: round(v, 3) for k, v in warm.items()})
+    timed = await generate("timed")
+    return {
+      "ring2_tok_s": round(timed["tok_s"], 2),
+      "ring2_per_token_ms": round(1000.0 / timed["tok_s"], 3) if timed["tok_s"] else None,
+      "ring2_ttft_ms": round(timed["ttft_s"] * 1000, 1),
+      "ring2_n_tokens": timed["n_tokens"],
+    }
+
+  return asyncio.run(run())
+
+
 def child_main() -> None:
   progress_path = os.environ["BENCH_PROGRESS_PATH"]
   prefill_len = int(os.getenv("BENCH_PREFILL", "128"))
@@ -354,6 +448,11 @@ def child_main() -> None:
   res = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path,
                     "flagship", measure_async)
   res["block_until_ready_ok"] = calib["block_until_ready_ok"]
+  if os.getenv("BENCH_RING", "") == "2":
+    try:
+      res.update(_run_ring2(model_id, prefill_len, min(decode_tokens, 32), progress_path))
+    except Exception as e:  # the flagship number must land even if ring2 dies
+      res["ring2_error"] = repr(e)
   _record(progress_path, "flagship_result", **res)
   print(json.dumps(res), flush=True)
 
@@ -465,6 +564,7 @@ def _emit(result: dict) -> None:
   for k in ("per_token_ms", "ttft_ms", "per_token_path_tok_s", "fused_speedup",
             "async_tok_s", "async_divergence", "tokens_verified", "tokens_agree_prefix",
             "implausible", "diagnosis", "block_until_ready_ok", "roofline_tok_s",
+            "ring2_tok_s", "ring2_per_token_ms", "ring2_ttft_ms", "ring2_error",
             "mfu_pct", "hbm_bw_pct", "platform", "n_devices", "device_kind",
             "n_params", "stage", "tpu_error", "error"):
     if result.get(k) is not None:

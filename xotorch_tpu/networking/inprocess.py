@@ -1,0 +1,92 @@
+"""In-process peer handle: the device-resident fast path for co-located
+partitions (SURVEY §7.2 stage 7 / VERDICT r2 #3).
+
+When consecutive ring partitions live in ONE process (one host's chips —
+a single `xot` process serving two partitions in tests/bench, or a future
+multi-engine host), the hidden-state hop does not need gRPC, numpy, or any
+host round-trip at all: this handle passes the jax device array straight to
+the target Node, so the tensor stays in HBM from one shard's scan into the
+next. The reference pays device->numpy->protobuf->numpy->device per hop per
+token even between processes on one box (ref node.py:109-147 +
+grpc_peer_handle.py:111-130); the gRPC handle remains the cross-host path.
+
+`accepts_device_arrays = True` is the capability flag Node.forward_tensor
+and the engine's keep_on_device plumbing key off.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking.peer_handle import PeerHandle
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
+from xotorch_tpu.topology.topology import Topology
+
+
+class InProcessPeerHandle(PeerHandle):
+  accepts_device_arrays = True
+
+  def __init__(self, node):
+    self.node = node
+
+  def id(self) -> str:
+    return self.node.id
+
+  def addr(self) -> str:
+    return "inprocess"
+
+  def description(self) -> str:
+    return "in-process (device-resident hops)"
+
+  def device_capabilities(self) -> DeviceCapabilities:
+    return self.node.device_capabilities
+
+  async def connect(self) -> None:
+    pass
+
+  async def is_connected(self) -> bool:
+    return True
+
+  async def disconnect(self) -> None:
+    pass
+
+  async def health_check(self) -> bool:
+    return True
+
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
+                        traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
+                        images: Optional[list] = None) -> None:
+    # Detached, like the gRPC server's ack-then-process: a hop must not hold
+    # the sender's coroutine chain for the rest of the generation.
+    asyncio.create_task(self.node.process_prompt(
+      shard, prompt, request_id, traceparent=traceparent, max_tokens=max_tokens, images=images,
+    ))
+
+  async def send_tensor(self, shard: Shard, tensor, request_id: Optional[str] = None,
+                        inference_state: Optional[dict] = None) -> None:
+    # `tensor` may be a jax device array — passed through untouched; the
+    # receiving engine consumes it without a host copy.
+    asyncio.create_task(self.node.process_tensor(shard, tensor, request_id, inference_state))
+
+  async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
+                         train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
+    loss, grads = await self.node.process_example(shard, example, target, length, train, request_id)
+    return (loss, grads) if loss is not None else None
+
+  async def send_result(self, request_id: str, result, is_finished: bool,
+                        error: Optional[str] = None,
+                        total_len: Optional[int] = None) -> Optional[dict]:
+    tokens = [int(t) for t in (result if not isinstance(result, np.ndarray) else result.reshape(-1))]
+    applied, have = await self.node.ingest_remote_result(
+      request_id, tokens, total_len, is_finished, error=error,
+    )
+    return {"ok": True, "applied": applied, "have": have}
+
+  async def send_opaque_status(self, request_id: str, status: str) -> None:
+    self.node.on_opaque_status.trigger_all(request_id, status)
+
+  async def collect_topology(self, visited: set, max_depth: int) -> Topology:
+    return await self.node.collect_topology(set(visited), max_depth)

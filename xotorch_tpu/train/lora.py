@@ -58,12 +58,6 @@ def add_lora_params(
   return {**params, "layers": layers}
 
 
-def strip_lora_params(params: Params) -> Params:
-  """Return params with every adapter tensor removed (the frozen base)."""
-  layers = {k: v for k, v in params["layers"].items() if not k.startswith("lora_")}
-  return {**params, "layers": layers}
-
-
 def has_lora(params: Params) -> bool:
   return any(k.startswith("lora_") for k in params.get("layers", {}))
 
@@ -133,15 +127,20 @@ def is_lora_checkpoint(path) -> bool:
 
 
 def load_lora_checkpoint(params: Params, shard, path) -> Params:
-  """Merge an adapter-only checkpoint FILE into `params` (restacking this
-  shard's layer range). The base tree is untouched; a checkpoint that does
-  not cover this shard's layers raises with the missing range."""
+  """Merge adapter-only checkpoint FILE(s) into `params` (restacking this
+  shard's layer range). `path` may be one file or a list — the absolute layer
+  indexing exists precisely so a RE-PARTITIONED ring can restore: a node now
+  serving layers 0-15 merges the 0-7 and 8-15 files saved by a previous
+  2-node split. The base tree is untouched; layers the file set does not
+  cover raise with the missing range."""
   from safetensors import safe_open
 
+  paths = path if isinstance(path, (list, tuple)) else [path]
   raw: Dict[str, jnp.ndarray] = {}
-  with safe_open(str(path), framework="np") as f:
-    for name in f.keys():
-      raw[name] = jnp.asarray(f.get_tensor(name))
+  for p in paths:
+    with safe_open(str(p), framework="np") as f:
+      for name in f.keys():
+        raw[name] = jnp.asarray(f.get_tensor(name))
 
   slots = sorted({n.split(".", 3)[3] for n in raw if n.startswith("lora.layers.")})
   layers = dict(params["layers"])
