@@ -321,6 +321,30 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   return result
 
 
+class _NullServer:
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+
+class _NoDiscovery:
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+  async def discover_peers(self, wait_for_peers: int = 0):
+    return []
+
+
+def _bench_caps():
+  from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+  return DeviceCapabilities("bench", "chip", 1024, DeviceFlops(1.0, 2.0, 4.0))
+
+
 def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_path: str) -> dict:
   """2-partition same-process ring throughput (VERDICT r2 #3 'bench gains a
   2-partition mode'): two engines in one process joined by
@@ -334,30 +358,9 @@ def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_pat
   from xotorch_tpu.models.registry import model_cards
   from xotorch_tpu.networking.inprocess import InProcessPeerHandle
   from xotorch_tpu.orchestration.node import Node
-  from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
   from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
 
   n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
-
-  class _NullServer:
-    async def start(self):
-      pass
-
-    async def stop(self):
-      pass
-
-  class _NoDiscovery:
-    async def start(self):
-      pass
-
-    async def stop(self):
-      pass
-
-    async def discover_peers(self, wait_for_peers: int = 0):
-      return []
-
-  def caps():
-    return DeviceCapabilities("bench", "chip", 1024, DeviceFlops(1.0, 2.0, 4.0))
 
   async def run() -> dict:
     from xotorch_tpu.inference.shard import Shard
@@ -368,11 +371,11 @@ def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_pat
                   RingMemoryWeightedPartitioningStrategy(),
                   max_generate_tokens=decode_tokens, default_sample_temp=0.0,
                   decode_chunk_size=1)
-      node.device_capabilities = caps()
+      node.device_capabilities = _bench_caps()
       nodes.append(node)
     for node in nodes:
       for other in nodes:
-        node.topology.update_node(other.id, caps())
+        node.topology.update_node(other.id, _bench_caps())
       node.peers = [InProcessPeerHandle(o) for o in nodes if o is not node]
 
     shard = Shard(model_id, 0, n_layers - 1, n_layers)
@@ -410,6 +413,84 @@ def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_pat
       "ring2_per_token_ms": round(1000.0 / timed["tok_s"], 3) if timed["tok_s"] else None,
       "ring2_ttft_ms": round(timed["ttft_s"] * 1000, 1),
       "ring2_n_tokens": timed["n_tokens"],
+    }
+
+  return asyncio.run(run())
+
+
+def _run_concurrent(model_id: str, prefill_len: int, decode_tokens: int, n_conc: int,
+                    progress_path: str) -> dict:
+  """Aggregate throughput of N concurrent requests through one Node with
+  continuous batching (VERDICT r2 #9: the target is >= 4x single-request
+  tok/s at 8 concurrent — decode is HBM-bound at batch 1, so batched rows
+  ride the same weight reads)."""
+  import asyncio
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+
+  async def run() -> dict:
+    engine = JAXShardInferenceEngine()
+    widths = []
+    inner = engine._decode_batch_sync
+
+    def recording(ctx, items, *a):
+      widths.append(len(items))
+      return inner(ctx, items, *a)
+
+    engine._decode_batch_sync = recording
+    node = Node("bench-conc", _NullServer(), engine, _NoDiscovery(), None,
+                RingMemoryWeightedPartitioningStrategy(),
+                max_generate_tokens=decode_tokens, default_sample_temp=0.0,
+                decode_chunk_size=32)
+    node.device_capabilities = _bench_caps()
+    node.topology.update_node(node.id, node.device_capabilities)
+    shard = Shard(model_id, 0, n_layers - 1, n_layers)
+
+    async def generate(rid: str, n_words: int) -> int:
+      done = asyncio.Event()
+      count = {"n": 0}
+
+      def on_token(request_id, tokens, is_finished):
+        if request_id != rid:
+          return
+        count["n"] = len(tokens)
+        if is_finished:
+          done.set()
+
+      node.on_token.register(f"cb-{rid}").on_next(on_token)
+      await node.process_prompt(shard, " ".join(["w"] * n_words), rid)
+      await asyncio.wait_for(done.wait(), timeout=1800)
+      node.on_token.deregister(f"cb-{rid}")
+      return count["n"]
+
+    # Warmup: compiles prefill + every power-of-two batch width.
+    await asyncio.gather(*(generate(f"warm-{i}", prefill_len) for i in range(n_conc)))
+
+    t0 = time.time()
+    n1 = await generate("single", prefill_len)
+    single_tok_s = n1 / (time.time() - t0)
+    _record(progress_path, "concurrent:single", tok_s=round(single_tok_s, 2))
+
+    widths.clear()
+    t0 = time.time()
+    counts = await asyncio.gather(*(generate(f"conc-{i}", prefill_len) for i in range(n_conc)))
+    agg_tok_s = sum(counts) / (time.time() - t0)
+    max_width = max(widths) if widths else 0
+    _record(progress_path, "concurrent:aggregate", n=n_conc, tok_s=round(agg_tok_s, 2),
+            dispatches=len(widths), max_batch_width=max_width)
+    return {
+      "concurrent_n": n_conc,
+      "concurrent_tok_s": round(agg_tok_s, 2),
+      "single_stream_tok_s": round(single_tok_s, 2),
+      "concurrency_speedup": round(agg_tok_s / single_tok_s, 2) if single_tok_s else None,
+      "concurrent_max_batch_width": max_width,
     }
 
   return asyncio.run(run())
@@ -453,6 +534,12 @@ def child_main() -> None:
       res.update(_run_ring2(model_id, prefill_len, min(decode_tokens, 32), progress_path))
     except Exception as e:  # the flagship number must land even if ring2 dies
       res["ring2_error"] = repr(e)
+  n_conc = int(os.getenv("BENCH_CONCURRENT", "0"))
+  if n_conc > 1:
+    try:
+      res.update(_run_concurrent(model_id, min(prefill_len, 64), decode_tokens, n_conc, progress_path))
+    except Exception as e:
+      res["concurrent_error"] = repr(e)
   _record(progress_path, "flagship_result", **res)
   print(json.dumps(res), flush=True)
 
@@ -565,6 +652,8 @@ def _emit(result: dict) -> None:
             "async_tok_s", "async_divergence", "tokens_verified", "tokens_agree_prefix",
             "implausible", "diagnosis", "block_until_ready_ok", "roofline_tok_s",
             "ring2_tok_s", "ring2_per_token_ms", "ring2_ttft_ms", "ring2_error",
+            "concurrent_n", "concurrent_tok_s", "single_stream_tok_s",
+            "concurrency_speedup", "concurrent_max_batch_width", "concurrent_error",
             "mfu_pct", "hbm_bw_pct", "platform", "n_devices", "device_kind",
             "n_params", "stage", "tpu_error", "error"):
     if result.get(k) is not None:

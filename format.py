@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""Repo formatter runner (parity: /root/reference/format.py + .style.yapf —
+2-space indent, long columns). Uses yapf when available; prints install
+guidance otherwise so the style config is never silently skipped."""
+import subprocess
+import sys
+
+
+def main() -> int:
+  targets = sys.argv[1:] or ["xotorch_tpu", "tests", "bench.py", "__graft_entry__.py"]
+  try:
+    import yapf  # noqa: F401
+  except ImportError:
+    print("yapf is not installed; run `pip install yapf` (style: .style.yapf)")
+    return 1
+  return subprocess.call([sys.executable, "-m", "yapf", "-ri", *targets])
+
+
+if __name__ == "__main__":
+  sys.exit(main())

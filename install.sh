@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Editable install into a venv (parity: /root/reference/install.sh:1-11).
+set -e
+
+PY=python3
+if command -v python3.12 &>/dev/null; then
+  PY=python3.12
+else
+  echo "Python 3.12 recommended; proceeding with $($PY --version)"
+fi
+
+$PY -m venv .venv
+source .venv/bin/activate
+pip install -e .
+echo "Installed. Run 'source .venv/bin/activate' then 'xot --help'."

@@ -1,0 +1,93 @@
+"""Real-checkpoint end-to-end gate (VERDICT r2 #2).
+
+Every other oracle test synthesizes tiny HF checkpoints; this one proves the
+downloader -> index -> weights -> tokenizer -> engine -> API chain on a REAL
+artifact (sharded safetensors + real tokenizer.json). Network-gated: set
+XOT_REAL_MODEL=1 to run (this CI/container image has zero egress, so it is
+skipped by default — run it wherever HF is reachable).
+
+Reference equivalent: the torch engine's real llama-3.2-1b smoke
+(/root/reference/xotorch/inference/torch/tests/test_inference_engine.py:15-48).
+"""
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+  os.getenv("XOT_REAL_MODEL", "0") != "1",
+  reason="real-model e2e needs network + disk; set XOT_REAL_MODEL=1 to run",
+)
+
+MODEL_ID = os.getenv("XOT_REAL_MODEL_ID", "llama-3.2-1b")
+
+
+async def test_real_model_download_serve_and_api():
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_tpu.download.hf_shard_download import HFShardDownloader
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.models.registry import build_full_shard
+  from tests.test_orchestration import _make_node, _caps
+
+  shard = build_full_shard(MODEL_ID, "JAXShardInferenceEngine")
+  assert shard is not None, f"{MODEL_ID} has no JAX repo in the registry"
+
+  downloader = HFShardDownloader()
+  engine = JAXShardInferenceEngine(downloader)
+
+  # 1. Download (resumable, layer-filtered) + engine load.
+  t0 = time.time()
+  await engine.ensure_shard(shard)
+  print(f"[real-model] {MODEL_ID} downloaded+loaded in {time.time() - t0:.1f}s")
+
+  # 2. Real tokenizer resolved (not the dummy fallback).
+  tok = await engine._ensure_tokenizer()
+  assert type(tok).__name__ != "DummyTokenizer"
+  ids = tok.encode("The capital of France is")
+  assert len(ids) >= 5
+
+  # 3. Greedy completion through the node: sane, non-degenerate text.
+  node = await _make_node("real", engine, max_generate_tokens=24,
+                          default_sample_temp=0.0)
+  node.topology.update_node("real", _caps())
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  t0 = time.time()
+  await node.process_prompt(shard, "The capital of France is", "real-req")
+  await asyncio.wait_for(done.wait(), timeout=600)
+  elapsed = time.time() - t0
+  text = tok.decode(out["tokens"])
+  print(f"[real-model] {len(out['tokens'])} tokens in {elapsed:.1f}s "
+        f"= {len(out['tokens'])/elapsed:.1f} tok/s :: {text!r}")
+  assert "Paris" in text, f"degenerate completion: {text!r}"
+  assert len(set(out["tokens"])) > 3, "token collapse (repeated single token)"
+
+  # 4. Same artifact through the OpenAI-compatible API.
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=600,
+                   default_model=MODEL_ID)
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": MODEL_ID, "max_tokens": 16,
+      "messages": [{"role": "user", "content": "Reply with exactly: pong"}],
+    })
+    assert resp.status == 200
+    body = await resp.json()
+    content = body["choices"][0]["message"]["content"]
+    print(f"[real-model] API completion: {content!r}")
+    assert content.strip(), "empty API completion"
+    assert body["usage"]["completion_tokens"] > 0
+  finally:
+    await client.close()

@@ -82,8 +82,15 @@ def _attention_block(
     k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
   q = apply_rope(q, positions, inv_freq)
   k = apply_rope(k, positions, inv_freq)
-  k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start_pos, 0, 0))
-  v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start_pos, 0, 0))
+  if jnp.ndim(start_pos) == 0:
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start_pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start_pos, 0, 0))
+  else:
+    # Per-row positions (continuous batching: concurrent requests at
+    # different depths decode in ONE dispatch) — vmap the row update.
+    row_update = jax.vmap(lambda c, x, sp: jax.lax.dynamic_update_slice(c, x, (sp, 0, 0)))
+    k_cache = row_update(k_cache, k.astype(k_cache.dtype), start_pos)
+    v_cache = row_update(v_cache, v.astype(v_cache.dtype), start_pos)
   if use_flash:
     # Prefill-from-zero fast path (engine guarantees start_pos == 0): the
     # fresh segment IS the whole visible context, and relative == absolute
@@ -95,9 +102,10 @@ def _attention_block(
     # Pallas kernel whose cost is proportional to the OCCUPIED prefix
     # (blocks past the causally visible region are never DMA'd) and whose
     # scores never leave VMEM — no [T, S] materialisation
-    # (ops/flash_decode.py).
+    # (ops/flash_decode.py). q_start is already per-row.
     from xotorch_tpu.ops.flash_decode import flash_cached_attention
-    q_start = jnp.full((B,), start_pos, dtype=jnp.int32)
+    q_start = (jnp.full((B,), start_pos, dtype=jnp.int32) if jnp.ndim(start_pos) == 0
+               else start_pos.astype(jnp.int32))
     attn = flash_cached_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), q_start)
   elif ring_mesh is not None:
     # Sequence-parallel training path (start_pos == 0, T sharded over 'sp'):
@@ -164,8 +172,14 @@ def forward_shard(
   else:
     h = x
   B, T = h.shape[0], h.shape[1]
-  positions = (start_pos + jnp.arange(T, dtype=jnp.int32))[None, :].repeat(B, axis=0)
-  kv_valid_len = jnp.full((B,), start_pos + T, dtype=jnp.int32)
+  if jnp.ndim(start_pos) == 0:
+    positions = (start_pos + jnp.arange(T, dtype=jnp.int32))[None, :].repeat(B, axis=0)
+    kv_valid_len = jnp.full((B,), start_pos + T, dtype=jnp.int32)
+  else:
+    # [B] start positions: each batch row is an independent request at its
+    # own depth (continuous batching of concurrent decodes).
+    positions = start_pos.astype(jnp.int32)[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    kv_valid_len = start_pos.astype(jnp.int32) + T
   inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
   def layer_body(h, xs):

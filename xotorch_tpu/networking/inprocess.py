@@ -31,6 +31,14 @@ class InProcessPeerHandle(PeerHandle):
 
   def __init__(self, node):
     self.node = node
+    # Strong refs to in-flight detached tasks: the event loop only weakly
+    # references tasks, and a GC'd hop task would silently drop the tensor.
+    self._tasks: set = set()
+
+  def _spawn(self, coro) -> None:
+    task = asyncio.create_task(coro)
+    self._tasks.add(task)
+    task.add_done_callback(self._tasks.discard)
 
   def id(self) -> str:
     return self.node.id
@@ -61,7 +69,7 @@ class InProcessPeerHandle(PeerHandle):
                         images: Optional[list] = None) -> None:
     # Detached, like the gRPC server's ack-then-process: a hop must not hold
     # the sender's coroutine chain for the rest of the generation.
-    asyncio.create_task(self.node.process_prompt(
+    self._spawn(self.node.process_prompt(
       shard, prompt, request_id, traceparent=traceparent, max_tokens=max_tokens, images=images,
     ))
 
@@ -69,7 +77,7 @@ class InProcessPeerHandle(PeerHandle):
                         inference_state: Optional[dict] = None) -> None:
     # `tensor` may be a jax device array — passed through untouched; the
     # receiving engine consumes it without a host copy.
-    asyncio.create_task(self.node.process_tensor(shard, tensor, request_id, inference_state))
+    self._spawn(self.node.process_tensor(shard, tensor, request_id, inference_state))
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
                          train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
