@@ -529,12 +529,18 @@ def child_main() -> None:
   res = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path,
                     "flagship", measure_async)
   res["block_until_ready_ok"] = calib["block_until_ready_ok"]
-  if os.getenv("BENCH_RING", "") == "2":
+  # The ring-2 and continuous-batching measurements auto-enable on real TPU
+  # (a few extra minutes there; hours on the CPU fallback where the flagship
+  # decodes at ~0.1 tok/s). Explicit BENCH_RING / BENCH_CONCURRENT override.
+  on_tpu = res.get("platform") == "tpu"
+  ring_default = "2" if on_tpu else ""
+  conc_default = "8" if on_tpu else "0"
+  if os.getenv("BENCH_RING", ring_default) == "2":
     try:
       res.update(_run_ring2(model_id, prefill_len, min(decode_tokens, 32), progress_path))
     except Exception as e:  # the flagship number must land even if ring2 dies
       res["ring2_error"] = repr(e)
-  n_conc = int(os.getenv("BENCH_CONCURRENT", "0"))
+  n_conc = int(os.getenv("BENCH_CONCURRENT", conc_default) or 0)
   if n_conc > 1:
     try:
       res.update(_run_concurrent(model_id, min(prefill_len, 64), decode_tokens, n_conc, progress_path))

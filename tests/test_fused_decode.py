@@ -317,3 +317,32 @@ async def test_busy_context_survives_eviction_preference(tmp_path):
   await eng.ensure_shard(shard("c"))  # forces an eviction: B (idle), not A (busy)
   assert shard("a") in eng._contexts
   assert shard("b") not in eng._contexts
+
+
+async def test_eos_check_uses_request_model_not_active_model(tmp_path):
+  """With per-model contexts, the EOS check for a request must come from
+  THAT request's model — not whichever model is currently active on the
+  engine (two in-flight models would otherwise read each other's EOS)."""
+  cfg_a = dict(TINY_LLAMA_CFG, eos_token_id=7)
+  cfg_b = dict(TINY_LLAMA_CFG, eos_token_id=99)
+  dir_a = make_hf_checkpoint(tmp_path / "a", cfg_a, seed=3)
+  dir_b = make_hf_checkpoint(tmp_path / "b", cfg_b, seed=11)
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"a": dir_a, "b": dir_b}), dtype="float32")
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard_a, shard_b = Shard("a", 0, n - 1, n), Shard("b", 0, n - 1, n)
+  await eng.ensure_shard(shard_a)
+  await eng.ensure_shard(shard_b)  # B is now the ACTIVE context
+
+  assert 7 in eng.eos_token_ids_for(shard_a)
+  assert 99 not in eng.eos_token_ids_for(shard_a)
+  assert 99 in eng.eos_token_ids_for(shard_b)
+
+  node = Node(
+    "eos-node", _NullServer(), eng, _NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=50,
+  )
+  node.device_capabilities = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  node.topology.update_node(node.id, node.device_capabilities)
+  # Node resolves per-request EOS through the shard, even though B is active.
+  assert 7 in node._eos_token_ids(shard_a)
+  assert 99 not in node._eos_token_ids(shard_a)

@@ -144,7 +144,11 @@ class _DecodeBatcher:
     try:
       # One event-loop yield before the first take: concurrent loops woken in
       # the same pass (e.g. all prefills just finished) coalesce immediately.
-      await asyncio.sleep(float(os.getenv("XOT_BATCH_WINDOW_MS", "0")) / 1000.0)
+      try:
+        window = float(os.getenv("XOT_BATCH_WINDOW_MS", "0")) / 1000.0
+      except ValueError:
+        window = 0.0
+      await asyncio.sleep(window)
       while self.pending:
         batch, self.pending = self.pending, []
         # Sampling params and chunk length are static under jit: only
@@ -171,6 +175,14 @@ class _DecodeBatcher:
         # Let the resolved requests' loops ingest tokens and re-submit before
         # the next take, so steady-state batches stay wide.
         await asyncio.sleep(0)
+    except Exception as e:
+      # A failure OUTSIDE the per-group dispatch (whose errors already land
+      # on their futures) must fail every pending submitter loudly — a
+      # hanging `await fut` with no error would freeze the whole server.
+      failed, self.pending = self.pending, []
+      for *_, fut in failed:
+        if not fut.done():
+          fut.set_exception(e)
     finally:
       self._draining = False
       if self.pending:
@@ -871,6 +883,18 @@ class JAXShardInferenceEngine(InferenceEngine):
     if DEBUG >= 1:
       print(f"JAX engine ready for {shard} (dtype={self._dtype_name}, cache_len={cache_len})")
     return ctx
+
+  def eos_token_ids_for(self, shard: Shard) -> Tuple[int, ...]:
+    """EOS ids for a SPECIFIC resident model — the Node's per-request EOS
+    check must not read whichever context happens to be active (two models
+    in flight would check each other's EOS ids). Unresolved tokenizer falls
+    back to the checkpoint config's eos list."""
+    ctx = self._contexts.get(shard)
+    if ctx is None:
+      return ()
+    eos = getattr(ctx.tokenizer, "eos_token_id", None) if ctx.tokenizer else None
+    from_cfg = tuple(ctx.cfg.eos_token_ids or ())
+    return tuple(e for e in ((eos,) if eos is not None else ()) + from_cfg)
 
   async def _ensure_tokenizer(self, ctx: Optional[_ShardContext] = None):
     ctx = ctx or self._active

@@ -112,6 +112,19 @@ class Node:
     # Request ids whose finish broadcast was applied here (bounded): shields
     # against out-of-order straggler deltas resurrecting finished requests.
     self._finished_results: "OrderedDict[str, None]" = OrderedDict()
+    # Per-request EOS id cache: constant over a request's lifetime; avoids a
+    # ring-partition recompute per sampled token on the per-token path.
+    self._request_eos: Dict[str, Tuple[int, ...]] = {}
+    # Strong refs to detached tasks (hops, fused loops, broadcasts): the
+    # event loop holds tasks only weakly — a GC'd generation-driving task
+    # would silently stall its request with no error.
+    self._detached_tasks: set = set()
+
+  def _spawn(self, coro) -> "asyncio.Task":
+    task = asyncio.create_task(coro)
+    self._detached_tasks.add(task)
+    task.add_done_callback(self._detached_tasks.discard)
+    return task
 
   # ------------------------------------------------------------- lifecycle
 
@@ -154,7 +167,7 @@ class Node:
         if status.get("node_id") != self.id:
           base = Shard.from_dict(status.get("base_shard", {}))
           path = status.get("path", "")
-          asyncio.create_task(self._resume_local(base, path))
+          self._spawn(self._resume_local(base, path))
       elif status_type == "node_status":
         if status.get("status", "").startswith("start_"):
           self.topology.active_node_id = status.get("node_id")
@@ -203,7 +216,7 @@ class Node:
       # The request's root span context rides the status bus + tensor hops so
       # every peer's hop spans join the same trace (reference tracing.py:36-70).
       self._request_trace_ctx[request_id] = span.context()
-      asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+      self._spawn(self.broadcast_opaque_status(request_id, json.dumps({
         "type": "node_status", "node_id": self.id, "status": "start_process_prompt",
         "base_shard": base_shard.to_dict(), "shard": shard.to_dict(),
         "prompt": prompt, "request_id": request_id,
@@ -230,7 +243,7 @@ class Node:
           import traceback
           traceback.print_exc()
         await self._abort_request(request_id, f"prompt processing failed on {self.id}: {e!r}")
-    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+    self._spawn(self.broadcast_opaque_status(request_id, json.dumps({
       "type": "node_status", "node_id": self.id, "status": "end_process_prompt",
       "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
     })))
@@ -391,7 +404,7 @@ class Node:
 
     if DEBUG >= 2:
       print(f"[{request_id}] token {token_int} ({len(buffered)+1} so far)")
-    if self._ingest_sampled_tokens(request_id, [token_int], buffered):
+    if self._ingest_sampled_tokens(request_id, [token_int], buffered, base_shard):
       await self._finish_generation(request_id)
       return
 
@@ -404,7 +417,7 @@ class Node:
     if shard.is_first_layer and self.decode_chunk_size > 1:
       gen = getattr(self.inference_engine, "generate_chunk", None)
       if gen is not None:
-        asyncio.create_task(
+        self._spawn(
           self._fused_decode_loop(base_shard, shard, request_id, buffered, inference_state, gen)
         )
         return
@@ -427,7 +440,7 @@ class Node:
           # back to the per-token ring.
           await self._forward_next_token(base_shard, request_id, buffered, inference_state)
           return
-        if self._ingest_sampled_tokens(request_id, chunk.reshape(-1).tolist(), buffered):
+        if self._ingest_sampled_tokens(request_id, chunk.reshape(-1).tolist(), buffered, base_shard):
           await self._finish_generation(request_id)
           return
     except CacheExhausted as e:
@@ -450,11 +463,15 @@ class Node:
       self.get_partition_index_of_first_layer(), inference_state,
     )
 
-  def _ingest_sampled_tokens(self, request_id: str, new_tokens: List[int], buffered: List[int]) -> bool:
+  def _ingest_sampled_tokens(self, request_id: str, new_tokens: List[int], buffered: List[int],
+                             base_shard: Optional[Shard] = None) -> bool:
     """Shared per-token accounting for the per-token ring and the fused chunk
     path: append to the request buffer (stopping at EOS or the request cap),
     update metrics/trace, fire callbacks, and broadcast. Returns finished."""
-    eos = self._eos_token_ids()
+    eos = self._request_eos.get(request_id)
+    if eos is None:
+      eos = self._eos_token_ids(base_shard)
+      self._request_eos[request_id] = eos
     limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
     trace_ctx = self._request_trace_ctx.get(request_id)
     now = time.monotonic()
@@ -483,7 +500,7 @@ class Node:
     # full_ref is the LIVE buffer object: by the time a gapped peer asks for
     # reconciliation, buffered_token_output may already be popped by
     # _finish_generation — the list object itself stays complete.
-    asyncio.create_task(
+    self._spawn(
       self.broadcast_result(request_id, delta, finished, total_len=len(buffered),
                             full_ref=buffered)
     )
@@ -499,7 +516,17 @@ class Node:
   def _clamp_max_tokens(self, cap: Any) -> int:
     return max(1, min(int(cap), self.max_generate_tokens))
 
-  def _eos_token_ids(self) -> Tuple[int, ...]:
+  def _eos_token_ids(self, base_shard: Optional[Shard] = None) -> Tuple[int, ...]:
+    """EOS ids for the REQUEST's model. With per-model engine contexts, the
+    engine's active tokenizer/cfg may belong to a different in-flight model —
+    resolve per shard when the engine supports it, never from whichever
+    model happens to be active."""
+    per_shard = getattr(self.inference_engine, "eos_token_ids_for", None)
+    if base_shard is not None and per_shard is not None:
+      try:
+        return per_shard(self.get_current_shard(base_shard))
+      except Exception:
+        pass
     tokenizer = getattr(self.inference_engine, "tokenizer", None)
     eos = getattr(tokenizer, "eos_token_id", None) if tokenizer else None
     cfg = getattr(self.inference_engine, "cfg", None)
@@ -587,7 +614,7 @@ class Node:
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
-      asyncio.create_task(self.process_tensor(base_shard, tensor, request_id, inference_state))
+      self._spawn(self.process_tensor(base_shard, tensor, request_id, inference_state))
       return
     peer = next((p for p in self.peers if p.id() == target_id), None)
     if peer is None:
@@ -628,7 +655,7 @@ class Node:
       request_id = str(uuid.uuid4())
     start_ns = time.perf_counter_ns()
     status_kind = "train_example" if train else "eval_example"
-    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+    self._spawn(self.broadcast_opaque_status(request_id, json.dumps({
       "type": "node_status", "node_id": self.id, "status": f"start_{status_kind}",
       "request_id": request_id,
     })))
@@ -646,7 +673,7 @@ class Node:
         )
         return loss, None
     finally:
-      asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({
+      self._spawn(self.broadcast_opaque_status(request_id, json.dumps({
         "type": "node_status", "node_id": self.id, "status": f"end_{status_kind}",
         "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
       })))
@@ -811,6 +838,7 @@ class Node:
     self._request_trace_ctx.pop(request_id, None)
     self._last_token_time.pop(request_id, None)
     self._request_max_tokens.pop(request_id, None)
+    self._request_eos.pop(request_id, None)
 
   def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
     self.on_token.trigger_all(request_id, tokens, is_finished)
