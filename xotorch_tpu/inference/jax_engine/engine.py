@@ -149,6 +149,7 @@ class _DecodeBatcher:
       except ValueError:
         window = 0.0
       await asyncio.sleep(window)
+      batch: list = []
       while self.pending:
         batch, self.pending = self.pending, []
         # Sampling params and chunk length are static under jit: only
@@ -177,10 +178,12 @@ class _DecodeBatcher:
         await asyncio.sleep(0)
     except Exception as e:
       # A failure OUTSIDE the per-group dispatch (whose errors already land
-      # on their futures) must fail every pending submitter loudly — a
-      # hanging `await fut` with no error would freeze the whole server.
+      # on their futures) must fail every affected submitter loudly — both
+      # the not-yet-taken `pending` AND the taken-but-undispatched remainder
+      # of `batch`. A hanging `await fut` with no error would freeze the
+      # whole server. set_exception is idempotent via the done() check.
       failed, self.pending = self.pending, []
-      for *_, fut in failed:
+      for *_, fut in batch + failed:
         if not fut.done():
           fut.set_exception(e)
     finally:

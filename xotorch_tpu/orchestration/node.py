@@ -471,7 +471,11 @@ class Node:
     eos = self._request_eos.get(request_id)
     if eos is None:
       eos = self._eos_token_ids(base_shard)
-      self._request_eos[request_id] = eos
+      if eos:
+        # Only cache a RESOLVED set: an empty result may mean the tokenizer
+        # wasn't ready yet, and freezing that for the request's lifetime
+        # would disable EOS detection entirely.
+        self._request_eos[request_id] = eos
     limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
     trace_ctx = self._request_trace_ctx.get(request_id)
     now = time.monotonic()
@@ -524,7 +528,12 @@ class Node:
     per_shard = getattr(self.inference_engine, "eos_token_ids_for", None)
     if base_shard is not None and per_shard is not None:
       try:
-        return per_shard(self.get_current_shard(base_shard))
+        ids = per_shard(self.get_current_shard(base_shard))
+        # Empty means "context not resident / tokenizer unresolved", not
+        # "this model has no EOS" — fall through to the engine-level lookup
+        # rather than silently disabling EOS detection.
+        if ids:
+          return ids
       except Exception:
         pass
     tokenizer = getattr(self.inference_engine, "tokenizer", None)
