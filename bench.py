@@ -35,6 +35,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).parent
+QUANT_PREFIXES = {"int8", "int4"}
 
 
 def log(msg: str) -> None:
@@ -117,12 +118,15 @@ def _calibrate_sync(progress_path: str) -> dict:
 
 def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
                 cache_len: int, progress_path: str, stage_prefix: str,
-                measure_async: bool = False) -> dict:
+                measure_async: bool = False, quantize: str = "") -> dict:
   """Measure one model config end to end. Returns the result dict.
 
   `measure_async`: also time block_until_ready-only variants of both decode
   paths (doubles the workload) — only worth it when the sync calibration
-  found block_until_ready broken, or BENCH_ASYNC=1 forces the diagnostic."""
+  found block_until_ready broken, or BENCH_ASYNC=1 forces the diagnostic.
+  `quantize`: "int8" measures the weight-only-quantized model
+  (models/quantize.py) — roofline math then uses the ACTUAL resident bytes
+  (int8 halves them), not 2 bytes/param."""
   import jax
   import jax.numpy as jnp
   import numpy as np
@@ -131,16 +135,20 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   from xotorch_tpu.models.registry import model_cards
   from xotorch_tpu.models.transformer import forward_shard, init_kv_cache, init_random_params
   from xotorch_tpu.models.generate import decode_chunk
+  from xotorch_tpu.models.quantize import quantize_params, quantized_bytes
 
   cfg = config_from_hf_dict(model_cards[model_id]["synthetic_config"])
   n = cfg.num_layers
 
   t0 = time.time()
   params = init_random_params(cfg, n, True, True, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
-  params = jax.block_until_ready(params)
   n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+  if quantize:
+    params = quantize_params(params, quantize)
+  params = jax.block_until_ready(params)
+  param_bytes = quantized_bytes(params)
   _record(progress_path, f"{stage_prefix}:params", model=model_id,
-          n_params=n_params, secs=round(time.time() - t0, 1))
+          n_params=n_params, gb=round(param_bytes / 1e9, 2), secs=round(time.time() - t0, 1))
 
   fwd = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True), donate_argnums=(2,))
   cache = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
@@ -273,14 +281,14 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   async_divergence = (round(async_toks_per_sec / toks_per_sec, 2)
                       if (async_toks_per_sec and toks_per_sec) else None)
 
-  # Roofline context: decode does ~2·P MACs/token (bf16) and must stream the
-  # full 2-byte param set from HBM each token — MFU for the compute view,
-  # BW% for the (binding, at batch 1) memory view.
+  # Roofline context: decode does ~2·P MACs/token and must stream the full
+  # resident param bytes from HBM each token (2/param at bf16, ~1 at int8) —
+  # MFU for the compute view, BW% for the (binding, at batch 1) memory view.
   devices = jax.devices()
   peak_tflops, peak_gbps = _tpu_peaks(devices)
   mfu_pct = round(100 * 2 * n_params * toks_per_sec / (peak_tflops * 1e12), 2) if peak_tflops else None
-  hbm_pct = round(100 * 2 * n_params * toks_per_sec / (peak_gbps * 1e9), 2) if peak_gbps else None
-  ceiling = round(peak_gbps * 1e9 / (2 * n_params), 1) if peak_gbps else None
+  hbm_pct = round(100 * param_bytes * toks_per_sec / (peak_gbps * 1e9), 2) if peak_gbps else None
+  ceiling = round(peak_gbps * 1e9 / param_bytes, 1) if peak_gbps else None
 
   result = {
     "model_id": model_id,
@@ -288,6 +296,8 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     "n_devices": len(devices),
     "device_kind": str(getattr(devices[0], "device_kind", "")),
     "n_params": n_params,
+    "quantize": quantize or None,
+    "param_bytes": param_bytes,
     "tok_s": round(toks_per_sec, 2),
     "per_token_ms": round(per_token_ms, 3),
     "ttft_ms": round(ttft * 1000, 1),
@@ -529,6 +539,30 @@ def child_main() -> None:
   res = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path,
                     "flagship", measure_async)
   res["block_until_ready_ok"] = calib["block_until_ready_ok"]
+  # int8 weight-only flagship (the "beats" half: decode is HBM-bound at
+  # batch 1, so halving resident bytes ~doubles the roofline). Auto-enabled
+  # on real TPU; BENCH_QUANT= overrides ("" disables, "int8" forces).
+  on_tpu = res.get("platform") == "tpu"
+  quant = os.getenv("BENCH_QUANT", "int8" if on_tpu else "")
+  if quant:
+    res["quant_fmt"] = quant  # _emit keys the field pass-through off this
+    try:
+      qres = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path,
+                         "flagship-int8", measure_async, quantize=quant)
+      res.update({
+        f"{quant}_tok_s": qres["tok_s"],
+        f"{quant}_per_token_ms": qres["per_token_ms"],
+        f"{quant}_ttft_ms": qres["ttft_ms"],
+        f"{quant}_hbm_bw_pct": qres["hbm_bw_pct"],
+        f"{quant}_roofline_tok_s": qres["roofline_tok_s"],
+        f"{quant}_tokens_verified": qres["tokens_verified"],
+        f"{quant}_speedup": round(qres["tok_s"] / res["tok_s"], 2) if res.get("tok_s") else None,
+        f"{quant}_implausible": qres["implausible"],
+      })
+      if qres.get("diagnosis"):
+        res[f"{quant}_diagnosis"] = qres["diagnosis"]
+    except Exception as e:  # the bf16 flagship must land even if int8 dies
+      res[f"{quant}_error"] = repr(e)
   # The ring-2 and continuous-batching measurements auto-enable on real TPU
   # (a few extra minutes there; hours on the CPU fallback where the flagship
   # decodes at ~0.1 tok/s). Explicit BENCH_RING / BENCH_CONCURRENT override.
@@ -661,9 +695,19 @@ def _emit(result: dict) -> None:
             "concurrent_n", "concurrent_tok_s", "single_stream_tok_s",
             "concurrency_speedup", "concurrent_max_batch_width", "concurrent_error",
             "mfu_pct", "hbm_bw_pct", "platform", "n_devices", "device_kind",
-            "n_params", "stage", "tpu_error", "error"):
+            "n_params", "param_bytes", "stage", "tpu_error", "error"):
     if result.get(k) is not None:
       out[k] = result[k]
+  # Quantized-flagship fields (int8_tok_s, int8_speedup, int8_error, ...)
+  # pass through as a family keyed off the ATTEMPTED format, so even an
+  # unsupported-format failure surfaces its <fmt>_error diagnostic.
+  prefixes = set(QUANT_PREFIXES)
+  if result.get("quant_fmt"):
+    out["quant_fmt"] = result["quant_fmt"]
+    prefixes.add(result["quant_fmt"])
+  for k, v in result.items():
+    if k.split("_", 1)[0] in prefixes and v is not None:
+      out[k] = v
   print(json.dumps(out), flush=True)
 
 

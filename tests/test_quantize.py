@@ -1,0 +1,227 @@
+"""int8 weight-only quantization (models/quantize.py).
+
+The wiring invariant is tight: forward over a QUANTIZED pytree must equal
+forward over its DEQUANTIZED float reconstruction (same rounded weights, so
+only float reassociation separates them). Quality vs the ORIGINAL weights is
+a separate, looser check (int8 rounding error is real but small). Parity
+note: no reference counterpart — the reference serves torch fp16/bf16 only
+(sharded_inference_engine.py:58-65); this is beyond-parity capability.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.models.config import config_from_hf_dict
+from xotorch_tpu.models.quantize import (
+  dequantize_params, dequantize_tensor, is_quantized, quantize_params,
+  quantize_tensor, quantized_bytes,
+)
+from xotorch_tpu.models.registry import model_cards
+from xotorch_tpu.models.transformer import forward_shard, init_kv_cache, init_random_params
+
+
+def _tiny(model_id="synthetic-tiny", dtype=jnp.float32):
+  cfg = config_from_hf_dict(model_cards[model_id]["synthetic_config"])
+  params = init_random_params(cfg, cfg.num_layers, True, True, jax.random.PRNGKey(0), dtype=dtype)
+  return cfg, params
+
+
+def test_quantize_tensor_roundtrip_error_bound():
+  w = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 48), jnp.float32)
+  q, scale = quantize_tensor(w, axis=1, scale_dtype=jnp.float32)
+  assert q.dtype == jnp.int8 and scale.shape == (4, 48)
+  back = dequantize_tensor(q, scale, axis=1, dtype=jnp.float32)
+  # Symmetric rounding: error per element <= scale/2 for its channel.
+  err = np.abs(np.asarray(back) - np.asarray(w))
+  bound = np.asarray(scale)[:, None, :] * 0.5 + 1e-6
+  assert (err <= bound).all()
+
+
+def test_quantized_forward_matches_dequantized_reconstruction():
+  cfg, params = _tiny()
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+  assert is_quantized(qparams) and not is_quantized(params)
+  # int8 leaves plus float scales must be ~half the bf16 bytes (f32 here: ~1/4).
+  assert quantized_bytes(qparams) < 0.35 * quantized_bytes(params)
+  ref = dequantize_params(qparams, jnp.float32)
+
+  x = jnp.asarray([[3, 7, 11, 250, 1, 42]], jnp.int32)
+  cache_q = init_kv_cache(cfg, cfg.num_layers, 1, 32, jnp.float32)
+  cache_r = init_kv_cache(cfg, cfg.num_layers, 1, 32, jnp.float32)
+  out_q, _ = forward_shard(qparams, x, cache_q, jnp.int32(0), cfg, True, True)
+  out_r, _ = forward_shard(ref, x, cache_r, jnp.int32(0), cfg, True, True)
+  np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_r), atol=2e-3, rtol=1e-3)
+
+
+def test_quantized_forward_close_to_original():
+  cfg, params = _tiny()
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+  x = jnp.asarray([[3, 7, 11, 250, 1, 42]], jnp.int32)
+  cache_q = init_kv_cache(cfg, cfg.num_layers, 1, 32, jnp.float32)
+  cache_f = init_kv_cache(cfg, cfg.num_layers, 1, 32, jnp.float32)
+  out_q, _ = forward_shard(qparams, x, cache_q, jnp.int32(0), cfg, True, True)
+  out_f, _ = forward_shard(params, x, cache_f, jnp.int32(0), cfg, True, True)
+  q, f = np.asarray(out_q), np.asarray(out_f)
+  rel_l2 = np.linalg.norm(q - f) / np.linalg.norm(f)
+  assert rel_l2 < 0.05, f"int8 deviates {rel_l2:.3f} rel L2 from float"
+  # Greedy next-token agreement on the last position.
+  assert int(q[0, -1].argmax()) == int(f[0, -1].argmax())
+
+
+def test_quantized_moe_forward():
+  cfg, params = _tiny("synthetic-tiny-moe")
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+  for slot in ("we_gate", "we_up", "we_down"):
+    assert qparams["layers"][slot].dtype == jnp.int8
+    assert slot + "_scale" in qparams["layers"]
+  ref = dequantize_params(qparams, jnp.float32)
+  x = jnp.asarray([[3, 7, 11, 250]], jnp.int32)
+  cache_q = init_kv_cache(cfg, cfg.num_layers, 1, 16, jnp.float32)
+  cache_r = init_kv_cache(cfg, cfg.num_layers, 1, 16, jnp.float32)
+  out_q, _ = forward_shard(qparams, x, cache_q, jnp.int32(0), cfg, True, True)
+  out_r, _ = forward_shard(ref, x, cache_r, jnp.int32(0), cfg, True, True)
+  np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_r), atol=5e-3, rtol=1e-2)
+
+
+def test_quantized_tied_embedding_unembed():
+  import dataclasses
+  cfg, params = _tiny()
+  # Tied variant: drop lm_head so unembed rides the (quantized) embedding.
+  cfg2 = dataclasses.replace(cfg, tie_word_embeddings=True)
+  params = {k: v for k, v in params.items() if k != "lm_head"}
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+  assert qparams["embed"]["embedding"].dtype == jnp.int8
+  ref = dequantize_params(qparams, jnp.float32)
+  x = jnp.asarray([[5, 9, 2]], jnp.int32)
+  cache_q = init_kv_cache(cfg2, cfg2.num_layers, 1, 16, jnp.float32)
+  cache_r = init_kv_cache(cfg2, cfg2.num_layers, 1, 16, jnp.float32)
+  out_q, _ = forward_shard(qparams, x, cache_q, jnp.int32(0), cfg2, True, True)
+  out_r, _ = forward_shard(ref, x, cache_r, jnp.int32(0), cfg2, True, True)
+  np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_r), atol=2e-3, rtol=1e-3)
+
+
+def test_quantized_decode_chunk_matches_dequantized():
+  from xotorch_tpu.models.generate import decode_chunk
+  cfg, params = _tiny()
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+  ref = dequantize_params(qparams, jnp.float32)
+
+  prompt = jnp.asarray([[3, 7, 11, 250, 1]], jnp.int32)
+
+  def run(p):
+    cache = init_kv_cache(cfg, cfg.num_layers, 1, 64, jnp.float32)
+    logits, cache = forward_shard(p, prompt, cache, jnp.int32(0), cfg, True, True)
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+    toks, _ = decode_chunk(p, tok, cache, jnp.int32(prompt.shape[1]), jax.random.PRNGKey(0),
+                           cfg, 16, 0.0, 0)
+    return np.asarray(toks)[0].tolist()
+
+  assert run(qparams) == run(ref)
+
+
+def test_quantized_params_shard_over_tp_mesh():
+  from xotorch_tpu.parallel.mesh import make_mesh, param_specs_like, shard_params
+  cfg, params = _tiny()
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+  mesh = make_mesh({"tp": 2})
+  specs = param_specs_like(qparams, mesh)
+  assert specs["layers"]["wq_scale"] is not None
+  placed = shard_params(qparams, mesh)
+  x = jnp.asarray([[3, 7, 11, 250]], jnp.int32)
+  cache = init_kv_cache(cfg, cfg.num_layers, 1, 16, jnp.float32)
+  out, _ = jax.jit(forward_shard, static_argnames=("cfg", "is_first", "is_last"))(
+    placed, x, cache, jnp.int32(0), cfg=cfg, is_first=True, is_last=True)
+  ref_cache = init_kv_cache(cfg, cfg.num_layers, 1, 16, jnp.float32)
+  ref_out, _ = forward_shard(qparams, x, ref_cache, jnp.int32(0), cfg, True, True)
+  np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=2e-3, rtol=1e-3)
+
+
+def test_qlora_train_step_updates_adapters_only():
+  import optax
+  from xotorch_tpu.train.lora import add_lora_params, lora_param_counts, masked_optimizer
+  from xotorch_tpu.train.step import make_train_step, trainable_subtree
+  cfg, params = _tiny()
+  qparams = quantize_params(params, scale_dtype=jnp.float32)
+
+  # A quantized base without adapters must be rejected (scales/norms would
+  # train against immutable int8 weights).
+  bare_step = make_train_step(cfg, optax.adamw(1e-2))
+  with pytest.raises(ValueError, match="LoRA"):
+    bare_step(qparams, optax.adamw(1e-2).init(trainable_subtree(qparams)), {
+      "inputs": jnp.zeros((1, 4), jnp.int32), "targets": jnp.zeros((1, 4), jnp.int32),
+      "lengths": jnp.asarray([4], jnp.int32),
+    })
+
+  qparams = add_lora_params(qparams, rank=4, key=jax.random.PRNGKey(7))
+  assert qparams["layers"]["lora_wq_a"].dtype == jnp.float32  # NOT int8
+  adapter, total = lora_param_counts(qparams)
+  assert adapter < total * 0.2
+
+  optimizer = masked_optimizer(optax.adamw(1e-2), qparams)
+  step = make_train_step(cfg, optimizer)
+  # opt_state lives over the float subtree: the int8 base is invisible to it.
+  opt_state = optimizer.init(trainable_subtree(qparams))
+  batch = {
+    "inputs": jnp.asarray(np.random.RandomState(0).randint(0, 255, (2, 8)), jnp.int32),
+    "targets": jnp.asarray(np.random.RandomState(1).randint(0, 255, (2, 8)), jnp.int32),
+    "lengths": jnp.asarray([8, 8], jnp.int32),
+  }
+  p, opt_state, loss0 = step(qparams, opt_state, batch)
+  losses = [float(loss0)]
+  for _ in range(8):
+    p, opt_state, loss = step(p, opt_state, batch)
+    losses.append(float(loss))
+  assert losses[-1] < losses[0], f"QLoRA loss did not decrease: {losses}"
+  # The int8 base is bit-identical; only adapters moved.
+  np.testing.assert_array_equal(np.asarray(p["layers"]["wq"]), np.asarray(qparams["layers"]["wq"]))
+  assert not np.array_equal(np.asarray(p["layers"]["lora_wq_a"]),
+                            np.asarray(qparams["layers"]["lora_wq_a"]))
+
+
+async def test_engine_quantized_serving(tmp_path, monkeypatch):
+  from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+  from xotorch_tpu.download.shard_download import LocalShardDownloader
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  model_dir = make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  tokens = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+
+  full = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+  out_f, _ = await full.infer_tensor("r", shard, tokens)
+
+  quant = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
+                                  quantize="int8")
+  out_q, _ = await quant.infer_tensor("r", shard, tokens)
+  assert out_q.shape == out_f.shape
+  assert int(np.argmax(out_q[0, -1])) == int(np.argmax(out_f[0, -1]))
+
+  # save_checkpoint of a quantized engine writes float safetensors (HF-layout,
+  # loadable by stock tooling).
+  ckpt = tmp_path / "ck" / "model.safetensors"
+  await quant.save_checkpoint(shard, str(ckpt))
+  from safetensors import safe_open
+  with safe_open(str(ckpt), framework="np") as f:
+    name = next(n for n in f.keys() if n.endswith("q_proj.weight"))
+    assert f.get_tensor(name).dtype == np.float32
+
+
+async def test_engine_quantized_full_train_rejected(tmp_path):
+  from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+  from xotorch_tpu.download.shard_download import LocalShardDownloader
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  model_dir = make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
+                                quantize="int8")
+  x = np.random.RandomState(0).randint(0, 255, (1, 8))
+  with pytest.raises(ValueError, match="LoRA"):
+    await eng.train_example("t", shard, x, x, np.array([8]))

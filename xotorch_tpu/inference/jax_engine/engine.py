@@ -196,13 +196,18 @@ class _DecodeBatcher:
 
 
 class JAXShardInferenceEngine(InferenceEngine):
-  def __init__(self, shard_downloader: Optional[ShardDownloader] = None, dtype: Optional[str] = None):
+  def __init__(self, shard_downloader: Optional[ShardDownloader] = None, dtype: Optional[str] = None,
+               quantize: Optional[str] = None):
     self.shard_downloader = shard_downloader or NoopShardDownloader()
     self.session: Dict[str, Any] = {}
     self._contexts: "OrderedDict[Shard, _ShardContext]" = OrderedDict()
     self._active: Optional[_ShardContext] = None
     self.executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="jax-engine")
     self._dtype_name = dtype or os.getenv("XOT_DTYPE", "bfloat16")
+    # Weight-only quantization (models/quantize.py): "int8" halves the HBM
+    # bytes per decoded token — the binding resource at batch 1. CLI
+    # --quantize / env XOT_QUANTIZE.
+    self._quantize = (quantize or os.getenv("XOT_QUANTIZE", "")).lower() or None
     # cache_len is the INITIAL per-request KV allocation; caches grow by
     # doubling (bounded executables: one decode program per power-of-two
     # size) up to max_cache_len = min(XOT_MAX_CACHE_LEN, cfg.max_seq_len).
@@ -813,6 +818,10 @@ class JAXShardInferenceEngine(InferenceEngine):
         cfg = load_model_config(model_dir)
         params = load_shard_params(model_dir, cfg, shard, dtype=self._dtype())
 
+      if self._quantize:
+        from xotorch_tpu.models.quantize import quantize_params
+        params = quantize_params(params, self._quantize, scale_dtype=self._dtype())
+
       mesh = self._serving_mesh(cfg)
       if mesh is not None:
         # Place params per the Megatron partition rules; inside jit, XLA
@@ -993,6 +1002,12 @@ class JAXShardInferenceEngine(InferenceEngine):
                                    checkpoint_file=ckpt)
       else:
         raise FileNotFoundError(f"no checkpoint for shard {ctx.shard} at {path}")
+      if self._quantize:
+        # A quantized engine stays quantized across full-weight reloads
+        # (checkpoints are stored in compute dtype — save_checkpoint
+        # dequantizes — so requantize on the way back in).
+        from xotorch_tpu.models.quantize import quantize_params
+        params = quantize_params(params, self._quantize, scale_dtype=self._dtype())
       # An engine running with LoRA must stay a LoRA engine after a full/base
       # checkpoint load: re-attach FRESH adapters (same rank/targets as the
       # current ones) so has_lora stays true and the optimizer keeps the base
@@ -1017,8 +1032,14 @@ class JAXShardInferenceEngine(InferenceEngine):
         # Parameter-efficient save: adapters only (MBs, not the base model).
         lora_mod.save_lora_checkpoint(ctx.params, ctx.shard, Path(path))
         return
+      from xotorch_tpu.models.quantize import dequantize_params, is_quantized
       from xotorch_tpu.models.weights import save_shard_params
-      save_shard_params(ctx.params, ctx.cfg, ctx.shard, Path(path))
+      params = ctx.params
+      if is_quantized(params):
+        # Checkpoints stay HF-layout compute-dtype safetensors — loadable by
+        # stock tooling, never a private int8 format.
+        params = dequantize_params(params, self._dtype())
+      save_shard_params(params, ctx.cfg, ctx.shard, Path(path))
 
     await self._run(_save)
 
@@ -1031,12 +1052,15 @@ class JAXShardInferenceEngine(InferenceEngine):
     if ctx.optimizer is None or ctx.opt_state is None:
       import optax
       from xotorch_tpu.train.lora import has_lora, masked_optimizer
+      from xotorch_tpu.train.step import trainable_subtree
       lr = float(os.getenv("XOT_LR", "1e-5"))
       base = optax.adamw(lr)
       # With adapters attached, the base model is FROZEN: optax.masked zeroes
       # non-adapter updates and never allocates Adam moments for them.
+      # Optimizer state lives over trainable_subtree(params) (train/step.py)
+      # — an int8-quantized base is invisible to the optimizer entirely.
       ctx.optimizer = masked_optimizer(base, ctx.params) if has_lora(ctx.params) else base
-      ctx.opt_state = ctx.optimizer.init(ctx.params)
+      ctx.opt_state = ctx.optimizer.init(trainable_subtree(ctx.params))
     return ctx.optimizer
 
   async def train_example(self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
@@ -1049,21 +1073,31 @@ class JAXShardInferenceEngine(InferenceEngine):
     ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer and forward_fn is None:
       raise ValueError("Non-last shard requires forward_fn to chain the ring")
+    from xotorch_tpu.models.quantize import is_quantized
+    from xotorch_tpu.train.lora import has_lora
+    if is_quantized(ctx.params) and not has_lora(ctx.params):
+      raise ValueError(
+        "Full-parameter training on an int8-quantized base is not supported; "
+        "attach adapters (--lora-rank / XOT_LORA_RANK) for QLoRA fine-tuning"
+      )
     optimizer = self._ensure_optimizer(ctx)
 
     if shard.is_last_layer:
       def _last():
         import jax.numpy as jnp
         import optax
-        from xotorch_tpu.train.step import shard_loss_and_grads
+        from xotorch_tpu.train.step import merge_trees, shard_loss_and_grads, split_float
         x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
         tgt = jnp.asarray(np.asarray(target).astype(np.int32))
         lens = jnp.asarray(np.asarray(lengths).reshape(-1).astype(np.int32))
         loss, x_grad, param_grads = shard_loss_and_grads(
           ctx.params, ctx.cfg, x, tgt, lens, shard.is_first_layer, True
         )
-        updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, ctx.params)
-        ctx.params = optax.apply_updates(ctx.params, updates)
+        # Updates apply to the float subtree only; a quantized base rides
+        # through untouched (never copied, never zero-filled).
+        fl, nf = split_float(ctx.params)
+        updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, fl)
+        ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
         return float(loss), np.asarray(x_grad)
       return await self._run(_last)
 
@@ -1072,17 +1106,22 @@ class JAXShardInferenceEngine(InferenceEngine):
       import jax
       import jax.numpy as jnp
       from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
+      from xotorch_tpu.train.step import merge_trees, split_float
       x = jnp.asarray(example.astype(np.int32) if example.ndim == 2 else example)
       B, T = x.shape[0], x.shape[1]
       cache = init_kv_cache(ctx.cfg, shard.get_layer_count(), B, T, jnp.float32)
+      # vjp over the float subtree only: an int8-quantized base is frozen and
+      # non-differentiable (train/step.split_float).
+      fl, nf = split_float(ctx.params)
 
-      def fwd(p, xin):
-        return forward_shard(p, xin, cache, jnp.int32(0), ctx.cfg, shard.is_first_layer, False)[0]
+      def fwd(p_fl, xin):
+        return forward_shard(merge_trees(p_fl, nf), xin, cache, jnp.int32(0), ctx.cfg,
+                             shard.is_first_layer, False)[0]
 
       if shard.is_first_layer:
-        out, vjp_fn = jax.vjp(lambda p: fwd(p, x), ctx.params)
+        out, vjp_fn = jax.vjp(lambda p: fwd(p, x), fl)
       else:
-        out, vjp_fn = jax.vjp(fwd, ctx.params, x)
+        out, vjp_fn = jax.vjp(fwd, fl, x)
       return np.asarray(out), vjp_fn, out.dtype
 
     activations, vjp_fn, out_dtype = await self._run(_fwd_vjp)
@@ -1093,15 +1132,18 @@ class JAXShardInferenceEngine(InferenceEngine):
     def _bwd_apply():
       import jax.numpy as jnp
       import optax
+      from xotorch_tpu.train.step import merge_trees, split_float
       down = jnp.asarray(np.asarray(down_grad)).astype(out_dtype)
       if shard.is_first_layer:
-        (param_grads,) = vjp_fn(down)
+        (float_grads,) = vjp_fn(down)
         x_grad = np.zeros((1,), np.float32)  # token inputs are not differentiable
       else:
-        param_grads, xg = vjp_fn(down)
+        float_grads, xg = vjp_fn(down)
         x_grad = np.asarray(xg)
-      updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, ctx.params)
-      ctx.params = optax.apply_updates(ctx.params, updates)
+      # Float-subtree update: the frozen int8 base is never copied.
+      fl, nf = split_float(ctx.params)
+      updates, ctx.opt_state = optimizer.update(float_grads, ctx.opt_state, fl)
+      ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
       return x_grad
 
     x_grad = await self._run(_bwd_apply)

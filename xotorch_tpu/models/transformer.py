@@ -48,6 +48,30 @@ def _maybe_lora(layer: Params, slot: str, h: jnp.ndarray, base_out: jnp.ndarray)
   return base_out + delta.astype(base_out.dtype) * LORA_SCALE
 
 
+def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
+  """h @ layer[slot], transparently dequantizing int8 weight-only slots
+  (models/quantize.py): presence of `<slot>_scale` is a static pytree
+  property, so the quantized graph is baked at trace time. XLA fuses the
+  int8->bf16 convert + per-channel scale into the dot's operand read — HBM
+  streams int8, the MXU computes bf16."""
+  w = layer[slot]
+  scale = layer.get(slot + "_scale")
+  if scale is None:
+    return h @ w
+  return (h @ w.astype(h.dtype)) * scale.astype(h.dtype)
+
+
+def _moe_einsum(layer: Params, slot: str, eq: str, h: jnp.ndarray) -> jnp.ndarray:
+  """Expert einsum with the same static int8 dispatch; per-(expert, out)
+  scales broadcast over the leading E axis of the 'e...' output."""
+  w = layer[slot]
+  scale = layer.get(slot + "_scale")
+  if scale is None:
+    return jnp.einsum(eq, h, w)
+  out = jnp.einsum(eq, h, w.astype(h.dtype))
+  return out * scale.astype(h.dtype)[:, None, None, :]
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
   x32 = x.astype(jnp.float32)
   norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
@@ -67,9 +91,9 @@ def _attention_block(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-  q = _maybe_lora(layer, "wq", h, h @ layer["wq"])
-  k = _maybe_lora(layer, "wk", h, h @ layer["wk"])
-  v = _maybe_lora(layer, "wv", h, h @ layer["wv"])
+  q = _maybe_lora(layer, "wq", h, _linear(layer, "wq", h))
+  k = _maybe_lora(layer, "wk", h, _linear(layer, "wk", h))
+  v = _maybe_lora(layer, "wv", h, _linear(layer, "wv", h))
   if "bq" in layer:
     q = q + layer["bq"]
     k = k + layer["bk"]
@@ -116,14 +140,14 @@ def _attention_block(
   else:
     attn = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), positions, kv_valid_len)
   attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
-  out = _maybe_lora(layer, "wo", attn2d, attn2d @ layer["wo"])
+  out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
   return out, k_cache, v_cache
 
 
 def _dense_mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
-  gate = jax.nn.silu(_maybe_lora(layer, "w_gate", h, h @ layer["w_gate"]))
-  up = gate * _maybe_lora(layer, "w_up", h, h @ layer["w_up"])
-  return _maybe_lora(layer, "w_down", up, up @ layer["w_down"])
+  gate = jax.nn.silu(_maybe_lora(layer, "w_gate", h, _linear(layer, "w_gate", h)))
+  up = gate * _maybe_lora(layer, "w_up", h, _linear(layer, "w_up", h))
+  return _maybe_lora(layer, "w_down", up, _linear(layer, "w_down", up))
 
 
 def _moe_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -139,9 +163,9 @@ def _moe_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     top_vals = top_vals / top_vals.sum(axis=-1, keepdims=True)
   combine = jnp.zeros_like(probs)
   combine = jnp.put_along_axis(combine, top_idx, top_vals, axis=-1, inplace=False)  # [B,T,E]
-  gate = jax.nn.silu(jnp.einsum("bth,ehi->ebti", h, layer["we_gate"]))
-  up = jnp.einsum("bth,ehi->ebti", h, layer["we_up"])
-  expert_out = jnp.einsum("ebti,eih->ebth", gate * up, layer["we_down"])
+  gate = jax.nn.silu(_moe_einsum(layer, "we_gate", "bth,ehi->ebti", h))
+  up = _moe_einsum(layer, "we_up", "bth,ehi->ebti", h)
+  expert_out = _moe_einsum(layer, "we_down", "ebti,eih->ebth", gate * up)
   return jnp.einsum("ebth,bte->bth", expert_out, combine.astype(h.dtype))
 
 
@@ -168,7 +192,15 @@ def forward_shard(
   picks the right executable per call.
   """
   if is_first:
-    h = jnp.take(params["embed"]["embedding"], x, axis=0)
+    emb = params["embed"]["embedding"]
+    row_scale = params["embed"].get("embedding_scale")
+    if row_scale is None:
+      h = jnp.take(emb, x, axis=0)
+    else:
+      # int8 table: each looked-up row rescales by its own per-row scale
+      # (models/quantize.py) — compute dtype comes from the scale.
+      h = (jnp.take(emb, x, axis=0).astype(row_scale.dtype)
+           * jnp.take(row_scale, x, axis=0)[..., None])
   else:
     h = x
   B, T = h.shape[0], h.shape[1]
@@ -207,9 +239,19 @@ def unembed(params: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
   path (models/generate.forward_sample)."""
   h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
   if cfg.tie_word_embeddings and "lm_head" not in params:
-    logits = h @ params["embed"]["embedding"].T
+    emb = params["embed"]["embedding"]
+    row_scale = params["embed"].get("embedding_scale")
+    if row_scale is None:
+      logits = h @ emb.T
+    else:
+      # Tied int8 table: the per-row scale becomes a per-vocab-column scale.
+      logits = (h @ emb.astype(h.dtype).T) * row_scale.astype(h.dtype)[None, None, :]
   else:
-    logits = h @ params["lm_head"]
+    head_scale = params.get("lm_head_scale")
+    if head_scale is None:
+      logits = h @ params["lm_head"]
+    else:
+      logits = (h @ params["lm_head"].astype(h.dtype)) * head_scale.astype(h.dtype)[None, None, :]
   return logits.astype(jnp.float32)
 
 

@@ -61,6 +61,18 @@ def spec_for_param(name: str):
     "embedding": P(None, "tp"),
     "final_norm": P(None),
     "lm_head": P(None, "tp"),
+    # int8 weight-only scales (models/quantize.py): one scale per OUTPUT
+    # channel, so each follows its base tensor's out-axis sharding with the
+    # contraction axis dropped.
+    "wq_scale": P(None, "tp"), "wk_scale": P(None, "tp"), "wv_scale": P(None, "tp"),
+    "wo_scale": P(None, None),
+    "w_gate_scale": P(None, "tp"), "w_up_scale": P(None, "tp"), "w_down_scale": P(None, None),
+    "we_gate_scale": P(None, "ep", "tp"), "we_up_scale": P(None, "ep", "tp"),
+    "we_down_scale": P(None, "ep", None),
+    # Per-vocab-row embedding scale: replicated (the int8 table itself still
+    # shards over tp along hidden).
+    "embedding_scale": P(None),
+    "lm_head_scale": P("tp"),
   }
   return rules.get(name)
 

@@ -53,9 +53,21 @@ def add_lora_params(
     L, d_in, d_out = base.shape[0], base.shape[1], base.shape[2]
     a_name, b_name = lora_names(slot)
     k = jax.random.fold_in(key, i)
-    layers[a_name] = (jax.random.normal(k, (L, d_in, rank), jnp.float32) * scale_init).astype(base.dtype)
-    layers[b_name] = jnp.zeros((L, rank, d_out), base.dtype)
+    dtype = _adapter_dtype(layers, slot)
+    layers[a_name] = (jax.random.normal(k, (L, d_in, rank), jnp.float32) * scale_init).astype(dtype)
+    layers[b_name] = jnp.zeros((L, rank, d_out), dtype)
   return {**params, "layers": layers}
+
+
+def _adapter_dtype(layers: Params, slot: str):
+  """Adapters follow the base dtype — except over an int8-quantized base
+  (QLoRA, models/quantize.py), where they take the scale's compute dtype:
+  integer adapters could neither train nor add a fractional delta."""
+  base = layers[slot]
+  if jnp.issubdtype(base.dtype, jnp.floating):
+    return base.dtype
+  scale = layers.get(slot + "_scale")
+  return scale.dtype if scale is not None else jnp.bfloat16
 
 
 def has_lora(params: Params) -> bool:
@@ -87,8 +99,15 @@ def masked_optimizer(base: optax.GradientTransformation, params: Params) -> opta
   """Freeze everything but the adapters. NOTE optax.masked alone is a trap:
   it passes masked-OUT updates through unchanged (raw gradients applied at
   scale 1 — instant divergence). multi_transform routes frozen leaves to
-  set_to_zero, which also allocates no Adam moments for them."""
-  labels = jax.tree.map(lambda m: "lora" if m else "frozen", lora_mask(params))
+  set_to_zero, which also allocates no Adam moments for them.
+
+  Operates over trainable_subtree(params) — the structure grads and
+  opt_state use everywhere (train/step.py); over an int8-quantized base
+  that is the float leaves only, so the base never even appears in the
+  optimizer's label tree."""
+  from xotorch_tpu.train.step import trainable_subtree
+  fl = trainable_subtree(params)
+  labels = jax.tree.map(lambda m: "lora" if m else "frozen", lora_mask(fl))
   return optax.multi_transform({"lora": base, "frozen": optax.set_to_zero()}, labels)
 
 
@@ -155,6 +174,7 @@ def load_lora_checkpoint(params: Params, shard, path) -> Params:
     stacked = jnp.stack([
       raw[f"lora.layers.{i}.{slot}"] for i in range(shard.start_layer, shard.end_layer + 1)
     ])
-    base_dtype = layers[slot.rsplit("_", 1)[0]].dtype if slot.rsplit("_", 1)[0] in layers else stacked.dtype
-    layers[f"lora_{slot}"] = stacked.astype(base_dtype)
+    base_slot = slot.rsplit("_", 1)[0]
+    dtype = _adapter_dtype(layers, base_slot) if base_slot in layers else stacked.dtype
+    layers[f"lora_{slot}"] = stacked.astype(dtype)
   return {**params, "layers": layers}

@@ -23,6 +23,47 @@ from xotorch_tpu.models.config import ModelConfig
 from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
 
 
+def split_float(tree: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+  """Partition a nested-dict pytree into (float leaves, non-float leaves).
+
+  QLoRA support: an int8-quantized base (models/quantize.py) is not
+  differentiable — jax.grad over the whole tree would reject the integer
+  leaves. Training differentiates the float subtree only (LoRA adapters +
+  norms + scales) with the int leaves closed over; the frozen-base optimizer
+  mask already routes every non-adapter update to zero, so the result is
+  identical to full-tree grad on an unquantized model."""
+  fl: Dict[str, Any] = {}
+  nf: Dict[str, Any] = {}
+  for k, v in tree.items():
+    if isinstance(v, dict):
+      a, b = split_float(v)
+      if a:
+        fl[k] = a
+      if b:
+        nf[k] = b
+    elif jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+      fl[k] = v
+    else:
+      nf[k] = v
+  return fl, nf
+
+
+def merge_trees(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+  """Inverse of split_float: overlay two disjoint nested dicts."""
+  out = dict(a)
+  for k, v in b.items():
+    out[k] = merge_trees(out[k], v) if k in out and isinstance(v, dict) else v
+  return out
+
+
+def trainable_subtree(params: Dict[str, Any]) -> Dict[str, Any]:
+  """The float subtree — what optimizers see. Grads, updates, and opt_state
+  all live in THIS structure (identical to `params` for an unquantized
+  model), so the frozen int8 base is never copied, zero-filled, or walked by
+  the optimizer at all."""
+  return split_float(params)[0]
+
+
 def masked_ce_loss(logits: jnp.ndarray, targets: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
   """logits [B,T,V] fp32, targets [B,T] int32, lengths [B] int32."""
   T = logits.shape[1]
@@ -50,15 +91,27 @@ def make_train_step(
   loss_fn: Optional[Callable] = None,
   ring_mesh=None,
 ) -> Callable:
-  """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss)."""
+  """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss).
+
+  `opt_state` must be built over trainable_subtree(params) — identical to
+  `params` for float models; for an int8-quantized base it is the float
+  leaves only (adapters/norms/scales), so the optimizer neither stores state
+  for nor rewrites the frozen base."""
   loss_fn = loss_fn or partial(full_model_loss, cfg=cfg, ring_mesh=ring_mesh)
 
   @jax.jit
   def train_step(params, opt_state, batch):
-    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-    updates, opt_state = optimizer.update(grads, opt_state, params)
-    params = optax.apply_updates(params, updates)
-    return params, opt_state, loss
+    from xotorch_tpu.models.quantize import is_quantized
+    from xotorch_tpu.train.lora import has_lora
+    if is_quantized(params) and not has_lora(params):
+      # Without a frozen-base mask the float scales/norms would train against
+      # immutable int8 weights — neither a full fine-tune nor a clean freeze.
+      raise ValueError("Training a quantized base requires LoRA adapters "
+                       "(add_lora_params + masked_optimizer)")
+    fl, nf = split_float(params)
+    loss, grads = jax.value_and_grad(lambda f: loss_fn(merge_trees(f, nf), batch))(fl)
+    updates, opt_state = optimizer.update(grads, opt_state, fl)
+    return merge_trees(optax.apply_updates(fl, updates), nf), opt_state, loss
 
   return train_step
 
@@ -83,6 +136,8 @@ def shard_loss_and_grads(
   Last shard: returns (loss, grad_wrt_input, param_grads) from targets.
   Other shards: returns (loss_passthrough, grad_wrt_input, param_grads) by
   chaining the downstream shard's input-gradient through this shard's vjp.
+  param_grads come back in trainable_subtree(params) structure (== params
+  for float models; float leaves only over an int8-quantized base).
   """
   B, T = x.shape[0], x.shape[1]
   cache = init_kv_cache(cfg, params["layers"]["attn_norm"].shape[0], B, T, jnp.float32)
@@ -92,20 +147,23 @@ def shard_loss_and_grads(
     return out
 
   # Token inputs (first shard) are not differentiable; close over x there.
+  # Grads flow through the float subtree only (int8-quantized bases are
+  # non-differentiable by construction — split_float docstring).
+  fl, nf = split_float(params)
   if is_last:
-    def loss_of(p, xin):
-      return masked_ce_loss(fwd(p, xin), back_grad_or_targets, lengths)
+    def loss_of(p_fl, xin):
+      return masked_ce_loss(fwd(merge_trees(p_fl, nf), xin), back_grad_or_targets, lengths)
     if is_first:
-      loss, param_grads = jax.value_and_grad(lambda p: loss_of(p, x))(params)
+      loss, float_grads = jax.value_and_grad(lambda p: loss_of(p, x))(fl)
       x_grad = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
     else:
-      loss, (param_grads, x_grad) = jax.value_and_grad(loss_of, argnums=(0, 1))(params, x)
-    return loss, x_grad, param_grads
+      loss, (float_grads, x_grad) = jax.value_and_grad(loss_of, argnums=(0, 1))(fl, x)
+    return loss, x_grad, float_grads
   if is_first:
-    out, vjp_fn = jax.vjp(lambda p: fwd(p, x), params)
-    (param_grads,) = vjp_fn(back_grad_or_targets.astype(out.dtype))
+    out, vjp_fn = jax.vjp(lambda p: fwd(merge_trees(p, nf), x), fl)
+    (float_grads,) = vjp_fn(back_grad_or_targets.astype(out.dtype))
     x_grad = jnp.zeros((B, T, cfg.hidden_size), jnp.float32)
   else:
-    out, vjp_fn = jax.vjp(fwd, params, x)
-    param_grads, x_grad = vjp_fn(back_grad_or_targets.astype(out.dtype))
-  return jnp.float32(0.0), x_grad, param_grads
+    out, vjp_fn = jax.vjp(lambda p, xin: fwd(merge_trees(p, nf), xin), fl, x)
+    float_grads, x_grad = vjp_fn(back_grad_or_targets.astype(out.dtype))
+  return jnp.float32(0.0), x_grad, float_grads
