@@ -112,6 +112,56 @@ async def test_batcher_respects_cap_and_single_request_path(tiny_model_dir, monk
   assert batch_sizes and max(batch_sizes) <= 2
 
 
+async def test_mixed_chunk_sizes_coalesce_at_min(tiny_model_dir, monkeypatch):
+  """Requests at different points of the adaptive growth ladder (node.py)
+  still share a dispatch: the batch runs at the MINIMUM requested size and
+  larger requesters get fewer tokens (they loop). Streams stay identical to
+  solo runs — fewer tokens per call must never change WHAT is decoded.
+  A batch window makes the two loops' submissions overlap deterministically
+  (without it, two requests at different cadences can ping-pong on the
+  single-worker executor and never meet in one take)."""
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_BATCH_WINDOW_MS", "150")
+  shard = _full_shard()
+
+  async def decode_n(eng, rid, prompt, total, chunk_size):
+    logits, _ = await eng.infer_tensor(rid, shard, prompt)
+    tok = int((await eng.sample(logits, temp=0.0))[0])
+    toks = [tok]
+    while len(toks) < total + 1:
+      out = await eng.generate_chunk(rid, shard, toks[-1], chunk_size, temp=0.0)
+      toks.extend(int(t) for t in out)
+    return toks[: total + 1]
+
+  want = {}
+  for rid, (prompt, size) in {
+    "big": (_prompts()["req-a"], 8), "small": (_prompts()["req-b"], 2),
+  }.items():
+    eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+    want[rid] = await decode_n(eng, rid, prompt, 8, size)
+
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  dispatched = []  # (width, num_tokens)
+  orig = eng._decode_batch_sync
+
+  def recording(ctx, items, num_tokens, *a):
+    dispatched.append((len(items), num_tokens))
+    return orig(ctx, items, num_tokens, *a)
+
+  monkeypatch.setattr(eng, "_decode_batch_sync", recording)
+  got_big, got_small = await asyncio.gather(
+    decode_n(eng, "big", _prompts()["req-a"], 8, 8),
+    decode_n(eng, "small", _prompts()["req-b"], 8, 2),
+  )
+  assert got_big == want["big"]
+  assert got_small == want["small"]
+  # At least one dispatch coalesced both requests, and every coalesced
+  # dispatch ran at the smaller requested size.
+  wide = [(w, n) for w, n in dispatched if w >= 2]
+  assert wide, f"mixed sizes never coalesced: {dispatched}"
+  assert all(n == 2 for _, n in wide), f"coalesced dispatch not at min size: {dispatched}"
+
+
 async def test_batched_rows_at_different_depths(tiny_model_dir, monkeypatch):
   """Requests whose caches sit at very different positions (one grew past
   its initial buffer) still batch correctly — per-row positions + padded

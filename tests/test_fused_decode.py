@@ -80,6 +80,53 @@ async def test_fused_chunk_matches_per_token_ring(tiny_model_dir):
   assert len(fused) == 13
 
 
+async def test_adaptive_chunk_growth_schedule(tiny_model_dir):
+  """Chunk sizes double per dispatch up to XOT_DECODE_CHUNK_MAX, and the last
+  chunk shrinks to the next power of two covering the request cap — the
+  growth must never change WHAT is generated, only how it is dispatched."""
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  node = Node(
+    "n-grow", _NullServer(), eng, _NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=30, default_sample_temp=0.0, decode_chunk_size=2,
+  )
+  node.max_decode_chunk_size = 8
+  node.device_capabilities = DeviceCapabilities("test", "chip", 1024, DeviceFlops(1, 2, 4))
+  node.topology.update_node(node.id, node.device_capabilities)
+
+  sizes = []
+  inner = eng.generate_chunk
+
+  async def recording(request_id, shard, prev_token, num_tokens, **kw):
+    sizes.append(num_tokens)
+    return await inner(request_id, shard, prev_token, num_tokens, **kw)
+
+  eng.generate_chunk = recording
+
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(request_id, tokens, is_finished):
+    out["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  await node.process_prompt(Shard("m", 0, n - 1, n), "hello fused world", "req")
+  await asyncio.wait_for(done.wait(), timeout=60)
+
+  # 1 prefill token + chunks: 2, 4, 8, 8, 4(cap: 7 remaining -> pow2 8? no:
+  # remaining 29-(1+2+4+8+8)=6 -> 8 capped by growth 8 -> min(8, 8)=8...
+  # assert structure instead of exact tail: doubling prefix then cap.
+  assert sizes[0] == 2 and sizes[1] == 4 and sizes[2] == 8
+  assert all(s <= 8 for s in sizes)
+  assert len(out["tokens"]) == 30
+  # The stream itself must match the per-token reference.
+  per_token = await _generate(tiny_model_dir, chunk_size=1, max_tokens=30)
+  assert out["tokens"] == per_token
+
+
 async def test_fused_chunk_engine_guard_rails(tiny_model_dir):
   """generate_chunk refuses partial shards and unknown requests."""
   eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")

@@ -152,13 +152,18 @@ class _DecodeBatcher:
       batch: list = []
       while self.pending:
         batch, self.pending = self.pending, []
-        # Sampling params and chunk length are static under jit: only
-        # identical configurations share a dispatch (the serving defaults
-        # make this the common case).
-        groups: Dict[Tuple[int, float, int], list] = {}
+        # Sampling params are static under jit: only identical (temp, top_k)
+        # share a dispatch. Chunk length is NOT a grouping key — requests at
+        # different points of the adaptive growth ladder (node.py
+        # _fused_decode_loop) still coalesce, running at the MINIMUM
+        # requested size; rows that asked for more get fewer tokens and
+        # loop again. Coalescing beats chunk length: batched rows share one
+        # weight read, which is the whole win.
+        groups: Dict[Tuple[float, int], list] = {}
         for item in batch:
-          groups.setdefault((item[3], item[4], item[5]), []).append(item)
-        for (num_tokens, temp, top_k), items in groups.items():
+          groups.setdefault((item[4], item[5]), []).append(item)
+        for (temp, top_k), items in groups.items():
+          num_tokens = min(item[3] for item in items)
           cap = self.engine._decode_batch_max()
           for off in range(0, len(items), cap):
             chunk_items = items[off:off + cap]
@@ -551,11 +556,14 @@ class JAXShardInferenceEngine(InferenceEngine):
     temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
   ) -> Optional[np.ndarray]:
     """Fused multi-token decode (models/generate.py): one device dispatch
-    produces `num_tokens` sampled tokens, with sampling on-device under the
-    same `lax.scan` as the forward steps. Only valid when this shard spans
-    the whole model (single-partition ring) and the request already has a
-    prefilled cache. Returns None when the fast path does not apply so the
-    caller (Node.process_inference_result) falls back to the per-token ring.
+    produces UP TO `num_tokens` sampled tokens, with sampling on-device under
+    the same `lax.scan` as the forward steps. A coalesced batch runs at the
+    minimum size requested across its rows (the batcher's grouping note), so
+    callers must treat the returned length as authoritative and loop. Only
+    valid when this shard spans the whole model (single-partition ring) and
+    the request already has a prefilled cache. Returns None when the fast
+    path does not apply so the caller (Node.process_inference_result) falls
+    back to the per-token ring.
     """
     if not (shard.is_first_layer and shard.is_last_layer) or num_tokens < 1:
       return None

@@ -81,6 +81,14 @@ class Node:
       decode_chunk_size if decode_chunk_size is not None
       else int(os.getenv("XOT_DECODE_CHUNK", "8"))
     )
+    # Adaptive growth ceiling: each fused dispatch doubles the chunk up to
+    # this cap, so long generations amortise the per-dispatch host sync
+    # (~O(100ms) on tunneled TPUs) while the FIRST chunk stays small for
+    # streaming latency and short replies never overshoot far past EOS.
+    # Power-of-two ladder => bounded executable count per (B, size) pair.
+    self.max_decode_chunk_size = max(
+      self.decode_chunk_size, int(os.getenv("XOT_DECODE_CHUNK_MAX", "64"))
+    )
 
     self.peers: List[PeerHandle] = []
     self.topology = Topology()
@@ -430,9 +438,15 @@ class Node:
     surplus tokens after EOS inside a chunk are discarded."""
     try:
       self.outstanding_requests[request_id] = "generating"
+      size = self.decode_chunk_size
       while True:
+        # Never compute far past the request cap: shrink the last chunk to
+        # the next power of two covering what the cap still allows.
+        limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
+        remaining = max(1, limit - len(buffered))
+        this_size = min(size, 1 << (remaining - 1).bit_length())
         chunk = await gen(
-          request_id, shard, buffered[-1], self.decode_chunk_size,
+          request_id, shard, buffered[-1], this_size,
           temp=self.default_sample_temp, top_k=self.default_sample_top_k,
         )
         if chunk is None:
@@ -443,6 +457,7 @@ class Node:
         if self._ingest_sampled_tokens(request_id, chunk.reshape(-1).tolist(), buffered, base_shard):
           await self._finish_generation(request_id)
           return
+        size = min(size * 2, self.max_decode_chunk_size)
     except CacheExhausted as e:
       if DEBUG >= 1:
         print(f"[{request_id}] cache exhausted, finishing as length: {e}")
