@@ -207,6 +207,17 @@ async def test_cache_exhaustion_finishes_as_length(tiny_model_dir):
       done.set()
 
   node.on_token.register("t").on_next(on_token)
+  # The cache tail must drain through the FUSED path (shrunken chunks on the
+  # power-of-two ladder), never the per-token ring — one host round-trip per
+  # tail token is exactly what the adaptive ladder exists to avoid.
+  ring_calls = []
+  inner_fwd = node._forward_next_token
+
+  async def spying_fwd(*a, **kw):
+    ring_calls.append(a)
+    return await inner_fwd(*a, **kw)
+
+  node._forward_next_token = spying_fwd
   n = TINY_LLAMA_CFG["num_hidden_layers"]
   await node.process_prompt(Shard("m", 0, n - 1, n), "hello fused world", "req-cap")
   await asyncio.wait_for(done.wait(), timeout=60)
@@ -215,6 +226,7 @@ async def test_cache_exhaustion_finishes_as_length(tiny_model_dir):
   assert 1 <= len(out["tokens"]) < 100
   assert node.request_errors == {}
   assert node.buffered_token_output == {}
+  assert ring_calls == [], "cache tail fell back to the per-token ring"
 
 
 async def test_engine_seam_fused_sampling_equals_host_sampling(tiny_model_dir):

@@ -593,7 +593,14 @@ class JAXShardInferenceEngine(InferenceEngine):
     if state.pos + num_tokens > ctx.max_cache_len:
       if state.pos + 1 > ctx.max_cache_len:
         raise CacheExhausted(f"request {request_id}: cache full at {state.pos}/{ctx.max_cache_len}")
-      return None  # tail shorter than a chunk: per-token ring finishes it
+      # Shrink to the cache tail and keep the FUSED path to the very end —
+      # with the adaptive growth ladder (node.py) the tail can be up to
+      # max_decode_chunk_size-1 tokens, far too many to hand to the
+      # per-token ring at one host round-trip each. Largest power of two
+      # <= tail stays on the compiled-size ladder (at most log2 extra
+      # dispatches to drain the tail); the check above guaranteed tail >= 1.
+      tail = ctx.max_cache_len - state.pos
+      num_tokens = min(num_tokens, 1 << (tail.bit_length() - 1))
 
     if self._decode_batch_max() > 1:
       # Continuous batching: coalesce with other requests' concurrent chunks

@@ -75,8 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--resume-checkpoint", type=str, default=None)
   parser.add_argument("--lora-rank", type=int, default=0,
                       help="attach rank-r LoRA adapters; train updates only them (<1%% of params)")
-  parser.add_argument("--quantize", type=str, default=None, choices=["int8"],
-                      help="weight-only quantization: int8 halves HBM bytes/token (~2x decode)")
+  parser.add_argument("--quantize", type=str, default=None, choices=["int8", "int4"],
+                      help="weight-only quantization: int8 halves HBM bytes/token (~2x decode); "
+                           "int4 quarters them (group-wise, embeddings/experts stay int8)")
   return parser
 
 

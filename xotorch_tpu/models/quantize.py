@@ -41,7 +41,16 @@ import jax.numpy as jnp
 LAYER_SLOTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                "we_gate", "we_up", "we_down")
 
-QUANT_DTYPES = {"int8": jnp.int8}
+QUANT_DTYPES = {"int8": jnp.int8, "int4": jnp.int4}
+
+# int4 quantizes GROUP-WISE along the contraction axis (per-channel is too
+# coarse at 4 bits): weight [.., in, out] reshapes to [.., G, gs, out] with
+# one scale per (group, out-channel). 128 matches the MXU contraction tile.
+INT4_GROUP_SIZE = 128
+
+# int4 keeps these at int8: embedding/lm_head rows carry outsized numerical
+# leverage, and the MoE expert einsum doesn't need a third layout variant.
+_INT4_LAYER_SLOTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
 def quantize_tensor(w: jnp.ndarray, axis: int, dtype=jnp.int8,
@@ -62,6 +71,34 @@ def dequantize_tensor(q: jnp.ndarray, scale: jnp.ndarray, axis: int,
   return (q.astype(jnp.float32) * jnp.expand_dims(scale.astype(jnp.float32), axis)).astype(dtype)
 
 
+def _group_size(d_in: int, group_size: int = INT4_GROUP_SIZE) -> int:
+  """Largest usable group: `group_size` when it divides the contraction dim,
+  else the whole dim (degrades to per-channel — tiny test models)."""
+  return group_size if d_in % group_size == 0 else d_in
+
+
+def quantize_tensor_grouped(w: jnp.ndarray, dtype=jnp.int4, scale_dtype=jnp.bfloat16,
+                            group_size: int = INT4_GROUP_SIZE) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Group-wise symmetric quantization of a stacked weight [L, in, out] ->
+  (q [L, G, gs, out], scale [L, G, out]). The contraction axis splits into
+  groups; each (group, out-channel) gets its own scale."""
+  L, d_in, d_out = w.shape
+  gs = _group_size(d_in, group_size)
+  qmax = float(jnp.iinfo(dtype).max)
+  wg = w.astype(jnp.float32).reshape(L, d_in // gs, gs, d_out)
+  scale = jnp.max(jnp.abs(wg), axis=2, keepdims=True) / qmax
+  scale = jnp.maximum(scale, 1e-12)
+  q = jnp.clip(jnp.round(wg / scale), -qmax, qmax).astype(dtype)
+  return q, jnp.squeeze(scale, axis=2).astype(scale_dtype)
+
+
+def dequantize_tensor_grouped(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+  """Inverse of quantize_tensor_grouped: [L, G, gs, out] -> [L, in, out]."""
+  L, G, gs, d_out = q.shape
+  w = q.astype(jnp.float32) * scale.astype(jnp.float32)[:, :, None, :]
+  return w.reshape(L, G * gs, d_out).astype(dtype)
+
+
 def _contraction_axis(slot: str, ndim: int) -> int:
   """Input (contraction) axis of a stacked weight: [L, in, out] -> 1,
   MoE [L, E, in, out] -> 2, except *_down whose input axis is the expert
@@ -80,27 +117,34 @@ def quantize_params(params: Dict[str, Any], fmt: str = "int8",
   if fmt not in QUANT_DTYPES:
     raise ValueError(f"Unsupported quantization format {fmt!r}; have {sorted(QUANT_DTYPES)}")
   qdtype = QUANT_DTYPES[fmt]
+  int4 = fmt == "int4"
 
   out: Dict[str, Any] = dict(params)
   layers = dict(params["layers"])
   for slot in LAYER_SLOTS:
     w = layers.get(slot)
-    if w is None or w.dtype == qdtype:
+    if w is None or w.dtype in (jnp.int8, jnp.int4):
       continue
-    q, scale = quantize_tensor(w, _contraction_axis(slot, w.ndim), qdtype, scale_dtype)
-    layers[slot] = q
-    layers[slot + "_scale"] = scale
+    if int4 and slot in _INT4_LAYER_SLOTS:
+      q, gscale = quantize_tensor_grouped(w, qdtype, scale_dtype)
+      layers[slot] = q
+      layers[slot + "_gscale"] = gscale
+    else:
+      # int8 per-channel — also the int4 format's fallback for MoE experts.
+      q, scale = quantize_tensor(w, _contraction_axis(slot, w.ndim), jnp.int8, scale_dtype)
+      layers[slot] = q
+      layers[slot + "_scale"] = scale
   out["layers"] = layers
 
   embed = params.get("embed")
-  if embed is not None and embed["embedding"].dtype != qdtype:
+  if embed is not None and embed["embedding"].dtype not in (jnp.int8, jnp.int4):
     w = embed["embedding"]  # [vocab, H]: per-row scale serves take AND tied unembed
-    q, scale = quantize_tensor(w, 1, qdtype, scale_dtype)
+    q, scale = quantize_tensor(w, 1, jnp.int8, scale_dtype)
     out["embed"] = {"embedding": q, "embedding_scale": scale}
 
   head = params.get("lm_head")
-  if head is not None and head.dtype != qdtype:
-    q, scale = quantize_tensor(head, 0, qdtype, scale_dtype)  # [H, vocab] -> scale [vocab]
+  if head is not None and head.dtype not in (jnp.int8, jnp.int4):
+    q, scale = quantize_tensor(head, 0, jnp.int8, scale_dtype)  # [H, vocab] -> scale [vocab]
     out["lm_head"] = q
     out["lm_head_scale"] = scale
   return out
@@ -113,6 +157,10 @@ def dequantize_params(params: Dict[str, Any], dtype=jnp.bfloat16) -> Dict[str, A
   out: Dict[str, Any] = dict(params)
   layers = dict(params["layers"])
   for slot in LAYER_SLOTS:
+    gscale = layers.pop(slot + "_gscale", None)
+    if gscale is not None:
+      layers[slot] = dequantize_tensor_grouped(layers[slot], gscale, dtype)
+      continue
     scale = layers.pop(slot + "_scale", None)
     if scale is None:
       continue
@@ -129,10 +177,19 @@ def dequantize_params(params: Dict[str, Any], dtype=jnp.bfloat16) -> Dict[str, A
 
 
 def is_quantized(params: Dict[str, Any]) -> bool:
-  return any(k.endswith("_scale") for k in params.get("layers", {})) or "lm_head_scale" in params
+  return (any(k.endswith("_scale") or k.endswith("_gscale") for k in params.get("layers", {}))
+          or "lm_head_scale" in params)
 
 
 def quantized_bytes(params: Dict[str, Any]) -> int:
   """Actual HBM bytes of a param pytree (roofline math for quantized benches
-  — n_params * 2 overstates an int8 model by ~2x)."""
-  return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+  — n_params * 2 overstates an int8 model by ~2x). int4 counts as packed
+  half-bytes (ml_dtypes reports itemsize 1 for int4, but XLA packs 2/byte
+  in HBM)."""
+  total = 0
+  for x in jax.tree.leaves(params):
+    if x.dtype == jnp.int4:
+      total += (x.size + 1) // 2
+    else:
+      total += x.size * x.dtype.itemsize
+  return total

@@ -49,12 +49,22 @@ def _maybe_lora(layer: Params, slot: str, h: jnp.ndarray, base_out: jnp.ndarray)
 
 
 def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
-  """h @ layer[slot], transparently dequantizing int8 weight-only slots
-  (models/quantize.py): presence of `<slot>_scale` is a static pytree
-  property, so the quantized graph is baked at trace time. XLA fuses the
-  int8->bf16 convert + per-channel scale into the dot's operand read — HBM
-  streams int8, the MXU computes bf16."""
+  """h @ layer[slot], transparently dequantizing weight-only-quantized slots
+  (models/quantize.py): presence of `<slot>_scale` (int8, per-out-channel)
+  or `<slot>_gscale` (int4, group-wise) is a static pytree property, so the
+  quantized graph is baked at trace time. XLA fuses the narrow->bf16 convert
+  + scale into the dot's operand read — HBM streams int8/int4, the MXU
+  computes bf16."""
   w = layer[slot]
+  gscale = layer.get(slot + "_gscale")
+  if gscale is not None:
+    # int4 group-wise: w [G, gs, out], gscale [G, out]. Per-group partial
+    # dots (K = gs = 128, one MXU contraction tile) scaled then summed.
+    B, T, _ = h.shape
+    G, gs, _ = w.shape
+    hg = h.reshape(B, T, G, gs)
+    partial = jnp.einsum("btgi,gio->btgo", hg, w.astype(h.dtype))
+    return jnp.einsum("btgo,go->bto", partial, gscale.astype(h.dtype))
   scale = layer.get(slot + "_scale")
   if scale is None:
     return h @ w

@@ -23,6 +23,9 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+# Dense matmul slots that may appear in the int4 grouped rank-4 layout.
+_INT4_DENSE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
 
 def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
   """Build a Mesh with named axes from {axis: size}. Axes of size 1 are kept
@@ -40,12 +43,24 @@ def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
   return Mesh(mesh_devices, names)
 
 
-def spec_for_param(name: str):
+def spec_for_param(name: str, ndim: Optional[int] = None):
   """PartitionSpec for a single named parameter in the stacked layout
   (transformer.py). Megatron layout: qkv/gate/up column-parallel over tp,
   o/down row-parallel (their matmul output implies an XLA all-reduce over
-  tp); norms replicated; MoE experts shard over ep."""
+  tp); norms replicated; MoE experts shard over ep.
+
+  `ndim` disambiguates the int4 grouped layout (models/quantize.py): a DENSE
+  matmul slot at rank 4 is [L, G, gs, out] — the out axis moves to -1 and
+  row-parallel slots shard the GROUP axis (in = G*gs)."""
   from jax.sharding import PartitionSpec as P
+
+  if ndim == 4 and name in _INT4_DENSE:
+    col = name in ("wq", "wk", "wv", "w_gate", "w_up")
+    return P(None, None, None, "tp") if col else P(None, "tp", None, None)
+  if name.endswith("_gscale"):
+    base = name[: -len("_gscale")]
+    col = base in ("wq", "wk", "wv", "w_gate", "w_up")
+    return P(None, None, "tp") if col else P(None, "tp", None)
 
   rules = {
     "attn_norm": P(None, None), "mlp_norm": P(None, None),
@@ -77,15 +92,38 @@ def spec_for_param(name: str):
   return rules.get(name)
 
 
-def _restrict_spec(spec, mesh):
+def _int4_shape_guard(name: str, leaf):
+  """Shape to divisibility-check, ONLY for the int4 grouped layouts: their
+  group axis legitimately degrades (G=1 on tiny models) and should fall back
+  to replication. Every other parameter keeps the LOUD device_put failure on
+  a non-dividing mesh axis — silently replicating a misconfigured tp run
+  would hide the config error and blow HBM on large models."""
+  is_int4_dense = getattr(leaf, "ndim", None) == 4 and name in _INT4_DENSE
+  if is_int4_dense or name.endswith("_gscale"):
+    return getattr(leaf, "shape", None)
+  return None
+
+
+def _restrict_spec(spec, mesh, shape: Optional[Tuple[int, ...]] = None):
   """Drop axis names the mesh doesn't have (e.g. tp rules on a dp×ep mesh):
-  an absent axis simply means replicated there."""
+  an absent axis simply means replicated there. With `shape` (int4 grouped
+  layouts only — _int4_shape_guard), also drop a mesh axis the tensor
+  dimension doesn't divide evenly (G=1 degenerate groups replicate rather
+  than fail)."""
   from jax.sharding import PartitionSpec as P
 
   if spec is None:
     return P()
   names = set(mesh.axis_names)
-  return P(*[(ax if ax in names else None) for ax in spec])
+  out = []
+  for i, ax in enumerate(spec):
+    if ax not in names:
+      out.append(None)
+    elif shape is not None and i < len(shape) and shape[i] % mesh.shape[ax] != 0:
+      out.append(None)
+    else:
+      out.append(ax)
+  return P(*out)
 
 
 def param_specs_like(params: Dict[str, Any], mesh=None) -> Dict[str, Any]:
@@ -96,9 +134,11 @@ def param_specs_like(params: Dict[str, Any], mesh=None) -> Dict[str, Any]:
 
   def spec(path, leaf):
     name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-    s = spec_for_param(name)
+    s = spec_for_param(name, getattr(leaf, "ndim", None))
     if mesh is not None:
-      return _restrict_spec(s, mesh)
+      # Same shape guard as shard_params: the returned specs must agree
+      # with actual placement or in_shardings consumers get mismatches.
+      return _restrict_spec(s, mesh, _int4_shape_guard(name, leaf))
     return s if s is not None else P()
 
   return jax.tree_util.tree_map_with_path(spec, params)
@@ -112,7 +152,9 @@ def shard_params(params: Dict[str, Any], mesh) -> Dict[str, Any]:
 
   def place(path, leaf):
     name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-    return jax.device_put(leaf, NamedSharding(mesh, _restrict_spec(spec_for_param(name), mesh)))
+    spec = spec_for_param(name, getattr(leaf, "ndim", None))
+    placement = _restrict_spec(spec, mesh, _int4_shape_guard(name, leaf))
+    return jax.device_put(leaf, NamedSharding(mesh, placement))
 
   return jax.tree_util.tree_map_with_path(place, params)
 

@@ -50,7 +50,12 @@ def add_lora_params(
     base = layers.get(slot)
     if base is None:
       continue
-    L, d_in, d_out = base.shape[0], base.shape[1], base.shape[2]
+    if base.ndim == 4:
+      # int4 grouped layout [L, G, gs, out] (dense targets only; experts
+      # are never a LoRA target): logical in = G*gs.
+      L, d_in, d_out = base.shape[0], base.shape[1] * base.shape[2], base.shape[3]
+    else:
+      L, d_in, d_out = base.shape[0], base.shape[1], base.shape[2]
     a_name, b_name = lora_names(slot)
     k = jax.random.fold_in(key, i)
     dtype = _adapter_dtype(layers, slot)
@@ -60,13 +65,15 @@ def add_lora_params(
 
 
 def _adapter_dtype(layers: Params, slot: str):
-  """Adapters follow the base dtype — except over an int8-quantized base
-  (QLoRA, models/quantize.py), where they take the scale's compute dtype:
-  integer adapters could neither train nor add a fractional delta."""
+  """Adapters follow the base dtype — except over a quantized base (QLoRA,
+  models/quantize.py), where they take the scale's compute dtype: integer
+  adapters could neither train nor add a fractional delta."""
   base = layers[slot]
   if jnp.issubdtype(base.dtype, jnp.floating):
     return base.dtype
   scale = layers.get(slot + "_scale")
+  if scale is None:
+    scale = layers.get(slot + "_gscale")
   return scale.dtype if scale is not None else jnp.bfloat16
 
 
