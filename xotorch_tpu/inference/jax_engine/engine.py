@@ -102,6 +102,11 @@ class _ShardContext:
   opt_state: Any = None
   optimizer: Any = None
   batcher: Any = None  # lazy _DecodeBatcher (continuous batching)
+  # Automatic prefix cache: completed prefills' KV snapshots keyed by token
+  # hash — a new prompt sharing a long common prefix (system prompt,
+  # multi-turn history) seeds its cache from the snapshot and prefills only
+  # the suffix. LRU bounded by XOT_PREFIX_CACHE entries (device HBM!).
+  prefix_cache: "OrderedDict[int, Tuple[np.ndarray, Any]]" = field(default_factory=OrderedDict)
 
 
 class _DecodeBatcher:
@@ -222,6 +227,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._seed = int(os.getenv("XOT_SEED", str(int(time.time()))))
     self._sample_calls = 0
     self._oom_count = 0
+    # Prefix-cache observability (tests + /metrics): hits and tokens whose
+    # prefill was skipped entirely.
+    self._prefix_hits = 0
+    self._prefix_tokens_saved = 0
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -479,6 +488,20 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import forward_sample
 
+    # Automatic prefix cache: a fresh token prefill sharing a long common
+    # prefix with a stored snapshot seeds its KV from it and runs only the
+    # suffix. Full-model text path only (mid-shards see hidden states, not
+    # tokens, so they cannot key a prefix).
+    full_prompt = None
+    is_prefill = (getattr(input_data, "ndim", 0) == 2 and input_data.shape[1] > 1
+                  and input_data.shape[0] == 1  # snapshots are keyed batch-1
+                  and ctx.shard.is_first_layer and request_id not in ctx.states)
+    if is_prefill:
+      full_prompt = np.asarray(input_data)
+      consumed = self._prefix_reuse(ctx, request_id, full_prompt)
+      if consumed:
+        input_data = input_data[:, consumed:]
+
     true_t = input_data.shape[1]
     chunk = self._prefill_chunk()
     if true_t > chunk:
@@ -498,7 +521,88 @@ class JAXShardInferenceEngine(InferenceEngine):
     )
     state.pos += seg_t
     state.last_used = time.monotonic()
+    if full_prompt is not None:
+      self._prefix_store(ctx, request_id, full_prompt)
     return int(np.asarray(tok).reshape(-1)[0])
+
+  # ----------------------------------------------------------- prefix cache
+
+  def _prefix_cache_max(self) -> int:
+    """Snapshot entries kept per model context (0 disables). Each entry
+    holds a device KV copy of its prompt — HBM cost scales with model size
+    and prompt length, so the default is small."""
+    return int(os.getenv("XOT_PREFIX_CACHE", "2"))
+
+  def _prefix_cache_min(self) -> int:
+    return int(os.getenv("XOT_PREFIX_CACHE_MIN", "32"))
+
+  def _prefix_reuse(self, ctx: _ShardContext, request_id: str, tokens_2d: np.ndarray) -> int:
+    """Seed a fresh request's cache from the stored snapshot with the
+    longest common token prefix (causality makes positions < common valid
+    regardless of what follows). Returns positions consumed (0 = no hit)."""
+    if self._prefix_cache_max() <= 0 or not ctx.prefix_cache:
+      return 0
+    toks = np.asarray(tokens_2d).reshape(-1).astype(np.int64)
+    limit = toks.shape[0] - 1  # at least one token must still be forwarded
+    best_key, best_len = None, 0
+    for key, (ptoks, _) in ctx.prefix_cache.items():
+      n = min(ptoks.shape[0], limit)
+      if n <= best_len:
+        continue
+      neq = np.nonzero(ptoks[:n] != toks[:n])[0]
+      common = int(neq[0]) if neq.size else n
+      if common > best_len:
+        best_key, best_len = key, common
+    if best_key is None or best_len < self._prefix_cache_min():
+      return 0
+    import jax
+    _, snap = ctx.prefix_cache[best_key]
+    ctx.prefix_cache.move_to_end(best_key)
+    state = self._get_or_create_state(ctx, request_id, min_len=toks.shape[0])
+    zeros = (0,) * 5  # [L, B, S, Hkv, D]
+    state.cache = {
+      name: jax.lax.dynamic_update_slice(
+        state.cache[name], snap[name][:, :, :best_len].astype(state.cache[name].dtype), zeros
+      )
+      for name in state.cache
+    }
+    state.pos = best_len
+    self._prefix_hits += 1
+    self._prefix_tokens_saved += best_len
+    if DEBUG >= 2:
+      print(f"[{request_id}] prefix cache hit: {best_len} tokens reused")
+    return best_len
+
+  def _prefix_store(self, ctx: _ShardContext, request_id: str, tokens_2d: np.ndarray) -> None:
+    """Snapshot a completed prefill's KV for future prefix reuse. The slice
+    is a fresh device buffer — never aliased with the (donated) live cache."""
+    if self._prefix_cache_max() <= 0:
+      return
+    toks = np.asarray(tokens_2d).reshape(-1).astype(np.int64)
+    T = toks.shape[0]
+    if T < self._prefix_cache_min():
+      return
+    state = ctx.states.get(request_id)
+    if state is None or state.pos < T:
+      return
+    key = hash(toks.tobytes())
+    if key in ctx.prefix_cache:
+      ctx.prefix_cache.move_to_end(key)
+      return
+    import jax.numpy as jnp
+
+    def snap(buf):
+      # A FULL slice (T == buffer length, e.g. a prompt landing exactly on
+      # its power-of-two bucket) returns the SAME array object in JAX — and
+      # the live cache is donated into the next decode dispatch, which would
+      # delete the "snapshot" out from under future reuse. Force a copy in
+      # exactly that case.
+      s = buf[:, :, :T]
+      return jnp.copy(s) if s is buf else s
+
+    ctx.prefix_cache[key] = (toks, {name: snap(buf) for name, buf in state.cache.items()})
+    while len(ctx.prefix_cache) > self._prefix_cache_max():
+      ctx.prefix_cache.popitem(last=False)
 
   async def infer_prompt(
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
@@ -1037,6 +1141,7 @@ class JAXShardInferenceEngine(InferenceEngine):
 
     ctx.params = await self._run(_load)
     ctx.opt_state = None  # optimizer state is invalid for reloaded weights
+    ctx.prefix_cache.clear()  # snapshots were computed under the old weights
 
   async def save_checkpoint(self, shard: Shard, path: str) -> None:
     ctx = await self._ensure_ctx(shard)
@@ -1113,6 +1218,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         fl, nf = split_float(ctx.params)
         updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, fl)
         ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
+        ctx.prefix_cache.clear()  # prefill snapshots are stale under new weights
         return float(loss), np.asarray(x_grad)
       return await self._run(_last)
 
@@ -1159,6 +1265,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       fl, nf = split_float(ctx.params)
       updates, ctx.opt_state = optimizer.update(float_grads, ctx.opt_state, fl)
       ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
+      ctx.prefix_cache.clear()  # prefill snapshots are stale under new weights
       return x_grad
 
     x_grad = await self._run(_bwd_apply)

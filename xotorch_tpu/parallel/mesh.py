@@ -23,8 +23,11 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-# Dense matmul slots that may appear in the int4 grouped rank-4 layout.
-_INT4_DENSE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+def _int4_dense_slots():
+  """Single source of truth for which dense slots can carry the int4
+  grouped rank-4 layout (models/quantize.py owns the list)."""
+  from xotorch_tpu.models.quantize import _INT4_LAYER_SLOTS
+  return _INT4_LAYER_SLOTS
 
 
 def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
@@ -54,7 +57,7 @@ def spec_for_param(name: str, ndim: Optional[int] = None):
   row-parallel slots shard the GROUP axis (in = G*gs)."""
   from jax.sharding import PartitionSpec as P
 
-  if ndim == 4 and name in _INT4_DENSE:
+  if ndim == 4 and name in _int4_dense_slots():
     col = name in ("wq", "wk", "wv", "w_gate", "w_up")
     return P(None, None, None, "tp") if col else P(None, "tp", None, None)
   if name.endswith("_gscale"):
@@ -98,7 +101,7 @@ def _int4_shape_guard(name: str, leaf):
   to replication. Every other parameter keeps the LOUD device_put failure on
   a non-dividing mesh axis — silently replicating a misconfigured tp run
   would hide the config error and blow HBM on large models."""
-  is_int4_dense = getattr(leaf, "ndim", None) == 4 and name in _INT4_DENSE
+  is_int4_dense = getattr(leaf, "ndim", None) == 4 and name in _int4_dense_slots()
   if is_int4_dense or name.endswith("_gscale"):
     return getattr(leaf, "shape", None)
   return None
