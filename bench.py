@@ -186,10 +186,14 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   first_tok = int(np.asarray(tok)[0, 0])  # t1: produced by the warm decode step
   loop_tokens = [first_tok]
   t0 = time.time()
+  last_beat = t0
   for i in range(decode_tokens):
     logits, cache = fwd(params, tok, cache, jnp.int32(pos + i))
     tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
     loop_tokens.append(int(np.asarray(tok)[0, 0]))
+    if time.time() - last_beat > 60:  # keep the parent's stall watchdog fed
+      last_beat = time.time()
+      _record(progress_path, f"{stage_prefix}:per_token_progress", i=i + 1, of=decode_tokens)
   elapsed = time.time() - t0
   hop_toks_per_sec = decode_tokens / elapsed
   _record(progress_path, f"{stage_prefix}:per_token", tok_s=round(hop_toks_per_sec, 1))
@@ -230,11 +234,15 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   fused_tokens = [int(v) for v in np.asarray(toks)[0]]
   produced = chunk
   t0 = time.time()
+  last_beat = t0
   while produced < decode_tokens + chunk:  # match the per-token loop's length
     tok3 = toks[:, -1:].astype(jnp.int32)
     toks, cache3 = decode_chunk(params, tok3, cache3, jnp.int32(prefill_len + produced), key, cfg, chunk, 0.0, 0)
     fused_tokens.extend(int(v) for v in np.asarray(toks)[0])  # host fetch per chunk = control sync
     produced += chunk
+    if time.time() - last_beat > 60:
+      last_beat = time.time()
+      _record(progress_path, f"{stage_prefix}:fused_progress", produced=produced)
   fused_elapsed = time.time() - t0
   fused_n = produced - chunk
   toks_per_sec = fused_n / fused_elapsed
@@ -727,7 +735,9 @@ def main() -> None:
     child_main()
     return
 
-  progress_path = str(REPO / ".bench_progress.jsonl")
+  # PID-scoped: two concurrent bench processes (e.g. a smoke run next to the
+  # real one) must never read each other's progress records.
+  progress_path = str(REPO / f".bench_progress.{os.getpid()}.jsonl")
   tries = int(os.getenv("BENCH_TPU_TRIES", "2"))
   init_timeout = float(os.getenv("BENCH_INIT_TIMEOUT", "420"))
   stage_timeout = float(os.getenv("BENCH_STALL_TIMEOUT", "240"))
@@ -759,10 +769,19 @@ def main() -> None:
   cpu_env["JAX_PLATFORMS"] = "cpu"
   cpu_env["BENCH_FORCE_CPU"] = "1"
   # The 1.2B flagship decodes at ~0.1 tok/s on CPU — shrink the workload so
-  # the fallback lands a diagnosable number in minutes, not an hour.
-  cpu_env.setdefault("BENCH_PREFILL", "32")
-  cpu_env.setdefault("BENCH_DECODE", "8")
-  cpu_env.setdefault("BENCH_CHUNK", "8")
+  # the fallback lands a diagnosable number in minutes, not an hour. After a
+  # TPU failure the shrink is FORCED (a TPU-sized BENCH_CHUNK/DECODE left in
+  # the env would grind the fallback for hours); an intentional BENCH_CPU=1
+  # run keeps the caller's explicit sizes.
+  if attempts:
+    # Quant stage disabled too: doubling a CPU flagship run is the grind
+    # the forced shrink exists to prevent.
+    cpu_env.update({"BENCH_PREFILL": "32", "BENCH_DECODE": "8", "BENCH_CHUNK": "8",
+                    "BENCH_QUANT": ""})
+  else:
+    cpu_env.setdefault("BENCH_PREFILL", "32")
+    cpu_env.setdefault("BENCH_DECODE", "8")
+    cpu_env.setdefault("BENCH_CHUNK", "8")
   result, recs, err = _run_child(cpu_env, progress_path, 300, 300)
   if result is None:
     result = _salvage(recs) or {}

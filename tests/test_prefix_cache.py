@@ -7,8 +7,6 @@ with reuse is IDENTICAL to a cold engine's. No reference counterpart (the
 reference rebuilds the full mask/cache per request,
 sharded_inference_engine.py:144-186) — beyond-parity serving capability.
 """
-import asyncio
-
 import numpy as np
 import pytest
 
