@@ -736,8 +736,26 @@ def main() -> None:
     return
 
   # PID-scoped: two concurrent bench processes (e.g. a smoke run next to the
-  # real one) must never read each other's progress records.
+  # real one) must never read each other's progress records. Our own file is
+  # removed on exit (finally below); stale files from crashed runs are swept
+  # once they stop being written (live runs append every stage/heartbeat).
+  for stale in REPO.glob(".bench_progress.*.jsonl"):
+    try:
+      if time.time() - stale.stat().st_mtime > 3600:
+        stale.unlink()
+    except OSError:
+      pass
   progress_path = str(REPO / f".bench_progress.{os.getpid()}.jsonl")
+  try:
+    _orchestrate(progress_path)
+  finally:
+    try:
+      os.unlink(progress_path)
+    except OSError:
+      pass
+
+
+def _orchestrate(progress_path: str) -> None:
   tries = int(os.getenv("BENCH_TPU_TRIES", "2"))
   init_timeout = float(os.getenv("BENCH_INIT_TIMEOUT", "420"))
   stage_timeout = float(os.getenv("BENCH_STALL_TIMEOUT", "240"))

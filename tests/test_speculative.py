@@ -1,0 +1,135 @@
+"""Prompt-lookup speculative decoding (engine.verify_draft + node ladder).
+
+Model-free drafting: the continuation of an earlier occurrence of the tail
+n-gram is verified in ONE forward; KV rollback is free because rejected
+positions sit past the rolled-back pos, invisible to the validity mask.
+Correctness bar: the greedy stream WITH speculation is identical to the
+stream without it. No reference counterpart — beyond-parity capability.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking.discovery import Discovery
+from xotorch_tpu.orchestration.node import Node, _lookup_draft
+from xotorch_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+class _NullServer:
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+
+class _NoDiscovery(Discovery):
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+  async def discover_peers(self, wait_for_peers: int = 0):
+    return []
+
+
+@pytest.fixture()
+def tiny_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+
+
+def test_lookup_draft():
+  # Tail [7,8,9] occurred earlier; draft continues from there.
+  ctx = [1, 2, 7, 8, 9, 4, 5, 6, 0, 7, 8, 9]
+  assert _lookup_draft(ctx, 4) == [4, 5, 6, 0]
+  # Self-referential repetition drafts the repeating token run.
+  rep = [3, 3, 3, 3, 3, 3]
+  assert _lookup_draft(rep, 3) == [3, 3, 3]
+  # No repeated n-gram -> no draft.
+  assert _lookup_draft([1, 2, 3, 4, 5, 6, 7, 8], 4) == []
+  assert _lookup_draft([1, 2], 4) == []
+  assert _lookup_draft(ctx, 1) == []  # k < 2 never drafts
+
+
+async def test_verify_draft_matches_sequential_greedy(tiny_model_dir):
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([[1, 5, 9, 200, 17, 3, 42]], dtype=np.int64)
+
+  # Sequential greedy reference.
+  ref_eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  tok, _ = await ref_eng.infer_sample_tensor("ref", shard, prompt, temp=0.0)
+  ref = [int(tok)]
+  for _ in range(6):
+    tok, _ = await ref_eng.infer_sample_tensor("ref", shard, np.asarray([[ref[-1]]]), temp=0.0)
+    ref.append(int(tok))
+
+  # Speculative: prefill, then verify drafts built FROM the reference (the
+  # best case) and a deliberately wrong draft (worst case).
+  tok, _ = await eng.infer_sample_tensor("spec", shard, prompt, temp=0.0)
+  got = [int(tok)]
+  # Perfect draft: everything accepted + 1 bonus.
+  accepted = await eng.verify_draft("spec", shard, got[-1], ref[1:4])
+  assert accepted == ref[1:5], f"{accepted} != {ref[1:5]}"
+  got.extend(accepted)
+  # Wrong-tail draft: correct first token, garbage after — exactly one
+  # accepted + the model's own next token as bonus.
+  wrong = [ref[5], (ref[6] + 1) % 250, (ref[6] + 2) % 250]
+  accepted = await eng.verify_draft("spec", shard, got[-1], wrong)
+  assert accepted[:2] == ref[5:7]
+  assert len(accepted) == 2  # 1 accepted + bonus
+  got.extend(accepted)
+  assert got == ref[: len(got)]
+
+  # Fully-wrong draft: zero accepted, bonus only — still exactly greedy.
+  tok8, _ = await ref_eng.infer_sample_tensor("ref", shard, np.asarray([[ref[-1]]]), temp=0.0)
+  bad = [(int(tok8) + 9) % 250, 1, 2]
+  accepted = await eng.verify_draft("spec", shard, got[-1], bad)
+  assert accepted == [int(tok8)]
+
+
+async def test_node_speculative_stream_identical(tiny_model_dir, monkeypatch):
+  """End-to-end: a repetitive prompt decodes to the SAME stream with
+  speculation on, while verify_draft actually fires."""
+
+  async def generate(env_spec):
+    if env_spec:
+      monkeypatch.setenv("XOT_SPECULATE", str(env_spec))
+    else:
+      monkeypatch.delenv("XOT_SPECULATE", raising=False)
+    eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+    node = Node(
+      f"spec-{env_spec}", _NullServer(), eng, _NoDiscovery(), None,
+      RingMemoryWeightedPartitioningStrategy(),
+      max_generate_tokens=24, default_sample_temp=0.0, decode_chunk_size=4,
+    )
+    node.device_capabilities = DeviceCapabilities("t", "c", 1024, DeviceFlops(1, 2, 4))
+    node.topology.update_node(node.id, node.device_capabilities)
+    done = asyncio.Event()
+    out = {}
+
+    def on_token(request_id, tokens, is_finished):
+      out["tokens"] = list(tokens)
+      if is_finished:
+        done.set()
+
+    node.on_token.register("t").on_next(on_token)
+    n = TINY_LLAMA_CFG["num_hidden_layers"]
+    # DummyTokenizer-friendly repetitive prompt: word repeats -> n-gram hits.
+    await node.process_prompt(Shard("m", 0, n - 1, n), "a b c a b c a b c", "r")
+    await asyncio.wait_for(done.wait(), timeout=60)
+    return out["tokens"], eng
+
+  want, _ = await generate(0)
+  got, eng = await generate(6)
+  assert got == want, f"speculative stream diverged: {got} != {want}"
+  assert eng._spec_proposed > 0, "speculation never fired on a repetitive prompt"

@@ -231,6 +231,9 @@ class JAXShardInferenceEngine(InferenceEngine):
     # prefill was skipped entirely.
     self._prefix_hits = 0
     self._prefix_tokens_saved = 0
+    # Speculative-decode observability: drafted vs model-confirmed tokens.
+    self._spec_proposed = 0
+    self._spec_accepted = 0
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -524,6 +527,65 @@ class JAXShardInferenceEngine(InferenceEngine):
     if full_prompt is not None:
       self._prefix_store(ctx, request_id, full_prompt)
     return int(np.asarray(tok).reshape(-1)[0])
+
+  # ---------------------------------------------------- speculative decode
+
+  async def verify_draft(self, request_id: str, shard: Shard, prev_token: int,
+                         draft: list) -> Optional[list]:
+    """Greedy draft verification (prompt-lookup speculative decoding): run
+    ONE forward over [prev_token] + draft, accept the longest prefix of the
+    draft that matches the model's own argmax stream, and take the model's
+    next token after the accepted prefix as a bonus. Returns 1..len(draft)+1
+    tokens — every one exactly what sequential greedy decode would have
+    produced — or None when the fast path does not apply.
+
+    KV rollback is free by design: rejected positions' cache slots sit past
+    the rolled-back `pos`, invisible to the validity mask
+    (transformer.forward_shard kv_valid_len) and overwritten by the next
+    write at the same offsets.
+    """
+    if not (shard.is_first_layer and shard.is_last_layer) or not draft:
+      return None
+    ctx = self._contexts.get(shard)
+    if ctx is None:
+      raise RequestStateLost(
+        f"request {request_id}: model context {shard.model_id} evicted mid-generation")
+    state = ctx.states.get(request_id)
+    if state is None:
+      raise RequestStateLost(f"request {request_id}: device state evicted mid-generation")
+    # Room check uses the PADDED bucket (what _prep_state will actually
+    # demand), not the raw draft length — near the cache end a raw-length
+    # guard would pass and then _prep_state would raise CacheExhausted,
+    # ending the request early where plain decode drains to the last slot.
+    if state.pos + _bucket(1 + len(draft)) > ctx.max_cache_len:
+      return None  # no room to verify: caller falls back to plain decode
+    # Refresh LRU at BOTH levels (same reasoning as generate_chunk): a
+    # request decoding purely through accepted drafts must not have its
+    # model context evicted out from under it.
+    self._contexts.move_to_end(shard)
+    ctx.states.move_to_end(request_id)
+    return await self._run(self._verify_draft_sync, ctx, request_id, int(prev_token),
+                           [int(t) for t in draft])
+
+  def _verify_draft_sync(self, ctx: _ShardContext, request_id: str, prev_token: int,
+                         draft: list) -> list:
+    import jax.numpy as jnp
+    state = ctx.states[request_id]
+    pos_before = state.pos
+    x = np.asarray([[prev_token] + draft], dtype=np.int64)
+    out, true_t = self._forward_segment(ctx, request_id, x)
+    # preds[i] = model's greedy choice AFTER consuming x[:, : i + 1].
+    preds = np.asarray(jnp.argmax(out[0, :true_t], axis=-1)).astype(np.int64)
+    n_acc = 0
+    while n_acc < len(draft) and int(preds[n_acc]) == draft[n_acc]:
+      n_acc += 1
+    accepted = draft[:n_acc] + [int(preds[n_acc])] if n_acc < len(draft) else draft + [int(preds[-1])]
+    # Roll back: only prev_token + the accepted draft wrote VALID cache
+    # slots; the rest are masked out and re-written by the next dispatch.
+    state.pos = pos_before + 1 + n_acc
+    self._spec_proposed += len(draft)
+    self._spec_accepted += n_acc
+    return accepted
 
   # ----------------------------------------------------------- prefix cache
 

@@ -49,6 +49,40 @@ from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
 MAX_TOKENS_KEY = "xot_max_tokens"
 
 
+_DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
+
+
+def _lookup_draft(context: List[int], k: int) -> List[int]:
+  """Prompt-lookup drafting (model-free speculative decoding): propose the
+  continuation of the most recent EARLIER occurrence of the current tail
+  n-gram in prompt+output. Summarisation/extraction/code workloads repeat
+  long prompt spans verbatim, so drafts verify at high acceptance; on text
+  with no repeats this returns [] and decode proceeds normally."""
+  if k < 2 or len(context) < 4:
+    return []
+  # Bound the backward scan: long-context prompts would otherwise pay an
+  # O(prompt) Python scan per decode round on the event loop.
+  context = context[-_DRAFT_SCAN_WINDOW:]
+  for n in (3, 2):
+    if len(context) <= n:
+      continue
+    tail = context[-n:]
+    best: List[int] = []
+    # Newest occurrence preferred, but keep scanning older ones when the
+    # continuation is short — self-repetition's newest match sits right at
+    # the tail with almost nothing after it, while older ones run long.
+    for i in range(len(context) - n - 1, -1, -1):
+      if context[i:i + n] == tail:
+        cont = context[i + n:i + n + k]
+        if len(cont) == k:
+          return cont
+        if len(cont) > len(best):
+          best = cont
+    if len(best) >= 2:
+      return best
+  return []
+
+
 class Node:
   def __init__(
     self,
@@ -123,6 +157,10 @@ class Node:
     # Per-request EOS id cache: constant over a request's lifetime; avoids a
     # ring-partition recompute per sampled token on the per-token path.
     self._request_eos: Dict[str, Tuple[int, ...]] = {}
+    # Prompt token ids per request (sampler peer only): the draft source for
+    # prompt-lookup speculative decoding (XOT_SPECULATE).
+    self._request_prompt_tokens: Dict[str, List[int]] = {}
+    self.speculate_tokens = int(os.getenv("XOT_SPECULATE", "0"))
     # Strong refs to detached tasks (hops, fused loops, broadcasts): the
     # event loop holds tasks only weakly — a GC'd generation-driving task
     # would silently stall its request with no error.
@@ -272,6 +310,8 @@ class Node:
       # Single-partition text prompt: prefill + on-device sampling in one
       # engine call — the host never sees the prompt's logits.
       tokens = await self.inference_engine.encode(shard, prompt)
+      if self.speculate_tokens > 0:
+        self._request_prompt_tokens[request_id] = [int(t) for t in np.asarray(tokens).reshape(-1)]
       token, _ = await sampler(
         request_id, shard, np.asarray(tokens).reshape(1, -1),
         temp=self.default_sample_temp, top_k=self.default_sample_top_k,
@@ -436,6 +476,13 @@ class Node:
                                buffered: List[int], inference_state: Optional[dict], gen) -> None:
     """Chunked decode until EOS/cap; EOS/max checks happen between chunks and
     surplus tokens after EOS inside a chunk are discarded."""
+    verify = (getattr(self.inference_engine, "verify_draft", None)
+              if self.speculate_tokens > 0 and self.default_sample_temp == 0 else None)
+    # Persistent draft context: prompt + generated tokens, appended as they
+    # arrive (never rebuilt — a 32k prompt must not be re-copied per round).
+    spec_context = (list(self._request_prompt_tokens.get(request_id, ())) + list(buffered)
+                    if verify is not None else [])
+    spec_strikes = 0
     try:
       self.outstanding_requests[request_id] = "generating"
       size = self.decode_chunk_size
@@ -444,6 +491,31 @@ class Node:
         # the next power of two covering what the cap still allows.
         limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
         remaining = max(1, limit - len(buffered))
+        if verify is not None:
+          # Prompt-lookup speculation (greedy only): draft the continuation
+          # of the last n-gram's previous occurrence in prompt+output; ONE
+          # verify forward yields up to draft+1 tokens, each exactly what
+          # sequential greedy decode would produce (engine.verify_draft).
+          draft = _lookup_draft(spec_context, min(self.speculate_tokens, remaining))
+          if len(draft) >= 2:
+            accepted = await verify(request_id, shard, buffered[-1], draft)
+            if accepted:
+              # Back-off: repeated full rejections (bonus-only returns) mean
+              # the text repeats n-grams with divergent continuations — each
+              # round would pay a whole verify forward for ONE token, far
+              # below the fused-chunk baseline. Stop speculating for this
+              # request after two straight misses.
+              if len(accepted) == 1:
+                spec_strikes += 1
+                if spec_strikes >= 2:
+                  verify = None
+              else:
+                spec_strikes = 0
+              spec_context.extend(accepted)
+              if self._ingest_sampled_tokens(request_id, accepted, buffered, base_shard):
+                await self._finish_generation(request_id)
+                return
+              continue
         this_size = min(size, 1 << (remaining - 1).bit_length())
         chunk = await gen(
           request_id, shard, buffered[-1], this_size,
@@ -454,7 +526,10 @@ class Node:
           # back to the per-token ring.
           await self._forward_next_token(base_shard, request_id, buffered, inference_state)
           return
-        if self._ingest_sampled_tokens(request_id, chunk.reshape(-1).tolist(), buffered, base_shard):
+        new_tokens = chunk.reshape(-1).tolist()
+        if verify is not None:
+          spec_context.extend(int(t) for t in new_tokens)
+        if self._ingest_sampled_tokens(request_id, new_tokens, buffered, base_shard):
           await self._finish_generation(request_id)
           return
         size = min(size * 2, self.max_decode_chunk_size)
@@ -863,6 +938,7 @@ class Node:
     self._last_token_time.pop(request_id, None)
     self._request_max_tokens.pop(request_id, None)
     self._request_eos.pop(request_id, None)
+    self._request_prompt_tokens.pop(request_id, None)
 
   def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
     self.on_token.trigger_all(request_id, tokens, is_finished)
