@@ -207,7 +207,7 @@ class _DecodeBatcher:
 
 class JAXShardInferenceEngine(InferenceEngine):
   def __init__(self, shard_downloader: Optional[ShardDownloader] = None, dtype: Optional[str] = None,
-               quantize: Optional[str] = None):
+               quantize: Optional[str] = None, kv_quant: Optional[str] = None):
     self.shard_downloader = shard_downloader or NoopShardDownloader()
     self.session: Dict[str, Any] = {}
     self._contexts: "OrderedDict[Shard, _ShardContext]" = OrderedDict()
@@ -218,6 +218,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     # bytes per decoded token — the binding resource at batch 1. CLI
     # --quantize / env XOT_QUANTIZE.
     self._quantize = (quantize or os.getenv("XOT_QUANTIZE", "")).lower() or None
+    # int8 KV cache (models/transformer.init_kv_cache kv_quant): halves
+    # cache bandwidth + HBM per resident token — the binding resource for
+    # LONG contexts. CLI --kv-quantize / env XOT_KV_QUANT.
+    self._kv_quant = (kv_quant or os.getenv("XOT_KV_QUANT", "")).lower() or None
+    if self._kv_quant not in (None, "int8"):
+      raise ValueError(f"Unsupported KV quantization {self._kv_quant!r}; have ['int8']")
     # cache_len is the INITIAL per-request KV allocation; caches grow by
     # doubling (bounded executables: one decode program per power-of-two
     # size) up to max_cache_len = min(XOT_MAX_CACHE_LEN, cfg.max_seq_len).
@@ -298,6 +304,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     the resident cache is at least XOT_FLASH_DECODE_MIN (default 4096 —
     below that the fused XLA path is already bandwidth-optimal and the
     kernel-launch overhead isn't worth it)."""
+    if self._kv_quant:
+      # The Pallas kernel reads raw bf16 cache buffers; int8 caches take
+      # the XLA path, whose fused dequant keeps HBM traffic int8.
+      return False
     env = os.getenv("XOT_FLASH_DECODE")
     if env == "0":
       return False
@@ -621,10 +631,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     _, snap = ctx.prefix_cache[best_key]
     ctx.prefix_cache.move_to_end(best_key)
     state = self._get_or_create_state(ctx, request_id, min_len=toks.shape[0])
-    zeros = (0,) * 5  # [L, B, S, Hkv, D]
     state.cache = {
+      # Rank-generic: int8-KV scale leaves are rank 4 ([L, B, S, Hkv]),
+      # K/V rank 5 — start indices must match each leaf's own rank.
       name: jax.lax.dynamic_update_slice(
-        state.cache[name], snap[name][:, :, :best_len].astype(state.cache[name].dtype), zeros
+        state.cache[name], snap[name][:, :, :best_len].astype(state.cache[name].dtype),
+        (0,) * state.cache[name].ndim,
       )
       for name in state.cache
     }
@@ -840,7 +852,7 @@ class JAXShardInferenceEngine(InferenceEngine):
 
     cache_b = {
       name: jnp.concatenate([padded(s.cache[name]) for s in row_states], axis=1)
-      for name in ("k", "v")
+      for name in states[0].cache  # generic: int8 caches carry scale leaves
     }
     toks_in = jnp.asarray([[t] for t in row_tokens], dtype=jnp.int32)
     pos_vec = jnp.asarray([s.pos for s in row_states], dtype=jnp.int32)
@@ -851,7 +863,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     out_np = np.asarray(out)
     for i, state in enumerate(states):
       S_i = state.cache["k"].shape[2]
-      state.cache = {name: cache_b[name][:, i:i + 1, :S_i] for name in ("k", "v")}
+      state.cache = {name: cache_b[name][:, i:i + 1, :S_i] for name in cache_b}
       state.pos += num_tokens
       state.last_used = time.monotonic()
     return [out_np[i].astype(np.int64) for i in range(len(states))]
@@ -922,7 +934,8 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _new_cache(self, ctx: _ShardContext, length: Optional[int] = None):
     from xotorch_tpu.models.transformer import init_kv_cache
-    cache = init_kv_cache(ctx.cfg, ctx.shard.get_layer_count(), 1, length or ctx.cache_len, self._dtype())
+    cache = init_kv_cache(ctx.cfg, ctx.shard.get_layer_count(), 1, length or ctx.cache_len,
+                          self._dtype(), kv_quant=self._kv_quant is not None)
     if ctx.mesh is not None:
       # KV heads shard over tp alongside the attention weights, so the cache
       # stays distributed across the local chips' HBM for the request's life.

@@ -78,6 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--quantize", type=str, default=None, choices=["int8", "int4"],
                       help="weight-only quantization: int8 halves HBM bytes/token (~2x decode); "
                            "int4 quarters them (group-wise, embeddings/experts stay int8)")
+  parser.add_argument("--kv-quantize", type=str, default=None, choices=["int8"],
+                      help="int8 KV cache: half the cache bandwidth + HBM per resident token "
+                           "(long-context serving)")
   return parser
 
 
@@ -91,6 +94,8 @@ def build_node(args) -> tuple:
     os.environ["XOT_LORA_RANK"] = str(args.lora_rank)
   if getattr(args, "quantize", None):
     os.environ["XOT_QUANTIZE"] = args.quantize
+  if getattr(args, "kv_quantize", None):
+    os.environ["XOT_KV_QUANT"] = args.kv_quantize
 
   from xotorch_tpu.download import NoopShardDownloader
   from xotorch_tpu.download.hf_shard_download import HFShardDownloader

@@ -88,17 +88,71 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
   return (norm * weight.astype(jnp.float32)).astype(x.dtype)
 
 
-def init_kv_cache(cfg: ModelConfig, num_layers: int, batch: int, max_seq: int, dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+def init_kv_cache(cfg: ModelConfig, num_layers: int, batch: int, max_seq: int, dtype=jnp.bfloat16,
+                  kv_quant: bool = False) -> Dict[str, jnp.ndarray]:
+  """KV buffers [L, B, S, Hkv, D]. kv_quant stores K/V as int8 with one
+  scale per (position, head) — half the cache bandwidth and HBM per token;
+  presence of the scale leaves is the static marker the forward dispatches
+  on (same pattern as weight quantization)."""
   shape = (num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
-  return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+  if not kv_quant:
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+  return {
+    "k": jnp.zeros(shape, jnp.int8), "v": jnp.zeros(shape, jnp.int8),
+    "k_scale": jnp.zeros(shape[:-1], dtype), "v_scale": jnp.zeros(shape[:-1], dtype),
+  }
+
+
+def _quantize_kv(x: jnp.ndarray, scale_dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Per-(position, head) symmetric int8 over the head dim: [B,T,H,D] ->
+  (int8 [B,T,H,D], scale [B,T,H])."""
+  scale = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True), 1e-12) / 127.0
+  q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+  return q, jnp.squeeze(scale, -1).astype(scale_dtype)
+
+
+def _cache_write(layer_cache: Dict[str, jnp.ndarray], k: jnp.ndarray, v: jnp.ndarray,
+                 start_pos: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+  """Insert fresh K/V at start_pos (scalar, or [B] per-row for continuous
+  batching), quantizing on the way in when the cache is int8."""
+  quant = "k_scale" in layer_cache
+  new = {}
+  entries = [("k", k), ("v", v)]
+  if quant:
+    qk, sk = _quantize_kv(k, layer_cache["k_scale"].dtype)
+    qv, sv = _quantize_kv(v, layer_cache["v_scale"].dtype)
+    entries = [("k", qk), ("v", qv), ("k_scale", sk), ("v_scale", sv)]
+  for name, val in entries:
+    buf = layer_cache[name]
+    val = val.astype(buf.dtype)
+    if jnp.ndim(start_pos) == 0:
+      zeros = (0,) * (buf.ndim - 2)
+      new[name] = jax.lax.dynamic_update_slice(buf, val, (0, start_pos) + zeros)
+    else:
+      row = jax.vmap(lambda c, x, sp: jax.lax.dynamic_update_slice(
+        c, x, (sp,) + (0,) * (c.ndim - 1)))
+      new[name] = row(buf, val, start_pos)
+  return new
+
+
+def _cache_read(layer_cache: Dict[str, jnp.ndarray], dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """(K, V) in compute dtype; int8 caches dequantize on read — XLA fuses the
+  convert + scale into the attention operand stream, so HBM traffic stays
+  int8."""
+  k = layer_cache["k"].astype(dtype)
+  v = layer_cache["v"].astype(dtype)
+  if "k_scale" in layer_cache:
+    k = k * layer_cache["k_scale"].astype(dtype)[..., None]
+    v = v * layer_cache["v_scale"].astype(dtype)[..., None]
+  return k, v
 
 
 def _attention_block(
-  layer: Params, x: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+  layer: Params, x: jnp.ndarray, layer_cache: Dict[str, jnp.ndarray],
   positions: jnp.ndarray, kv_valid_len: jnp.ndarray, start_pos: jnp.ndarray,
   cfg: ModelConfig, inv_freq: jnp.ndarray, use_flash: bool = False,
   ring_mesh=None, use_flash_decode: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
   q = _maybe_lora(layer, "wq", h, _linear(layer, "wq", h))
@@ -116,31 +170,31 @@ def _attention_block(
     k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
   q = apply_rope(q, positions, inv_freq)
   k = apply_rope(k, positions, inv_freq)
-  if jnp.ndim(start_pos) == 0:
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start_pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start_pos, 0, 0))
-  else:
-    # Per-row positions (continuous batching: concurrent requests at
-    # different depths decode in ONE dispatch) — vmap the row update.
-    row_update = jax.vmap(lambda c, x, sp: jax.lax.dynamic_update_slice(c, x, (sp, 0, 0)))
-    k_cache = row_update(k_cache, k.astype(k_cache.dtype), start_pos)
-    v_cache = row_update(v_cache, v.astype(v_cache.dtype), start_pos)
+  layer_cache = _cache_write(layer_cache, k, v, start_pos)
+  kv_quant = "k_scale" in layer_cache
   if use_flash:
     # Prefill-from-zero fast path (engine guarantees start_pos == 0): the
     # fresh segment IS the whole visible context, and relative == absolute
     # positions, so the Pallas kernel's in-segment causal mask is exact.
+    # Attends over the FRESH k/v (never reads the cache), so it composes
+    # with an int8 cache unchanged.
     from xotorch_tpu.ops.flash_attention import flash_attention
     attn = flash_attention(q, k, v)
-  elif use_flash_decode:
+  elif use_flash_decode and not kv_quant:
     # Decode steps and chunked-prefill segments over a long resident cache:
     # Pallas kernel whose cost is proportional to the OCCUPIED prefix
     # (blocks past the causally visible region are never DMA'd) and whose
     # scores never leave VMEM — no [T, S] materialisation
-    # (ops/flash_decode.py). q_start is already per-row.
+    # (ops/flash_decode.py). q_start is already per-row. An int8 cache
+    # takes the XLA path instead (the kernel reads raw bf16 buffers; a
+    # pre-kernel dequant would materialise the full cache and forfeit the
+    # bandwidth win — the engine also gates flash_decode off under
+    # XOT_KV_QUANT).
     from xotorch_tpu.ops.flash_decode import flash_cached_attention
     q_start = (jnp.full((B,), start_pos, dtype=jnp.int32) if jnp.ndim(start_pos) == 0
                else start_pos.astype(jnp.int32))
-    attn = flash_cached_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), q_start)
+    attn = flash_cached_attention(q, layer_cache["k"].astype(q.dtype),
+                                  layer_cache["v"].astype(q.dtype), q_start)
   elif ring_mesh is not None:
     # Sequence-parallel training path (start_pos == 0, T sharded over 'sp'):
     # ring attention rotates KV chunks over ICI instead of materialising the
@@ -148,10 +202,11 @@ def _attention_block(
     from xotorch_tpu.ops.ring_attention import ring_attention_sharded
     attn = ring_attention_sharded(q, k, v, ring_mesh)
   else:
-    attn = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), positions, kv_valid_len)
+    k_all, v_all = _cache_read(layer_cache, q.dtype)
+    attn = gqa_attention(q, k_all, v_all, positions, kv_valid_len)
   attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
   out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
-  return out, k_cache, v_cache
+  return out, layer_cache
 
 
 def _dense_mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
@@ -225,18 +280,20 @@ def forward_shard(
   inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
   def layer_body(h, xs):
-    layer, k_cache, v_cache = xs
-    attn_out, k_cache, v_cache = _attention_block(
-      layer, h, k_cache, v_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
+    layer, layer_cache = xs
+    attn_out, layer_cache = _attention_block(
+      layer, h, layer_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
       ring_mesh, use_flash_decode,
     )
     h = h + attn_out
     mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
     mlp_out = _moe_mlp(layer, mlp_in, cfg) if cfg.is_moe else _dense_mlp(layer, mlp_in)
-    return h + mlp_out, (k_cache, v_cache)
+    return h + mlp_out, layer_cache
 
-  h, (new_k, new_v) = jax.lax.scan(layer_body, h, (params["layers"], cache["k"], cache["v"]))
-  new_cache = {"k": new_k, "v": new_v}
+  # The cache dict rides the scan as a pytree: each leaf's leading L axis is
+  # sliced per layer, so int8 caches (extra scale leaves) need no special
+  # casing anywhere downstream.
+  h, new_cache = jax.lax.scan(layer_body, h, (params["layers"], cache))
 
   if not is_last:
     return h, new_cache

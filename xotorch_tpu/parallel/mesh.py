@@ -179,9 +179,12 @@ def shard_batch(batch, mesh):
   )
 
 
-def cache_spec():
-  # [L, B, S, Hkv, D]: batch over dp, kv heads over tp.
+def cache_spec(rank: int = 5):
+  # [L, B, S, Hkv, D]: batch over dp, kv heads over tp. int8-KV scale
+  # leaves are rank 4 ([L, B, S, Hkv]) — same placement minus the head dim.
   from jax.sharding import PartitionSpec as P
+  if rank == 4:
+    return P(None, "dp", None, "tp")
   return P(None, "dp", None, "tp", None)
 
 
@@ -189,5 +192,5 @@ def shard_cache(cache, mesh):
   import jax
   from jax.sharding import NamedSharding
   return jax.tree.map(
-    lambda x: jax.device_put(x, NamedSharding(mesh, _restrict_spec(cache_spec(), mesh))), cache
+    lambda x: jax.device_put(x, NamedSharding(mesh, _restrict_spec(cache_spec(x.ndim), mesh))), cache
   )
