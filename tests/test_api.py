@@ -307,3 +307,50 @@ async def test_base_engine_rejects_images_loudly():
   img = np.zeros((8, 8, 3), dtype=np.uint8)
   with pytest.raises(ValueError, match="no vision path"):
     await engine.infer_prompt("r", Shard("dummy", 0, 7, 8), "look at this", images=[img])
+
+
+async def test_full_serving_stack_with_all_accelerations(monkeypatch):
+  """The HTTP surface over the REAL JAX engine with every serving
+  acceleration on at once: int8 weights, int8 KV cache, prefix caching,
+  speculative decoding, adaptive fused chunks — a config-matrix smoke that
+  the features compose (each is covered in depth by its own suite)."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  monkeypatch.setenv("XOT_QUANTIZE", "int8")
+  monkeypatch.setenv("XOT_KV_QUANT", "int8")
+  monkeypatch.setenv("XOT_SPECULATE", "6")
+  monkeypatch.setenv("XOT_PREFIX_CACHE_MIN", "8")
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("api-accel", engine, max_generate_tokens=16,
+                          default_sample_temp=0.0, decode_chunk_size=4)
+  node.topology.update_node("api-accel", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    payload = {
+      "model": "synthetic-tiny",
+      "messages": [{"role": "user", "content": "one two three four five six seven eight nine"}],
+    }
+    resp = await client.post("/v1/chat/completions", json=payload)
+    assert resp.status == 200
+    first = await resp.json()
+    assert first["usage"]["completion_tokens"] > 0
+
+    # Same prompt again: identical completion, now riding the prefix cache.
+    resp = await client.post("/v1/chat/completions", json=payload)
+    assert resp.status == 200
+    second = await resp.json()
+    assert second["choices"][0]["message"]["content"] == first["choices"][0]["message"]["content"]
+    assert engine._prefix_hits >= 1
+
+    import jax.numpy as jnp
+    ctx = next(iter(engine._contexts.values()))
+    assert ctx.params["layers"]["wq"].dtype == jnp.int8  # weights quantized
+    # Finished requests' states are cleared; verify the KV layout the
+    # requests used via a freshly allocated cache.
+    fresh = engine._new_cache(ctx)
+    assert fresh["k"].dtype == jnp.int8 and "k_scale" in fresh  # KV quantized
+  finally:
+    await client.close()

@@ -20,7 +20,14 @@ class DummyTokenizer:
     self.vocab_size = 1000
 
   def apply_chat_template(self, messages, tokenize: bool = True, add_generation_prompt: bool = True, tools=None) -> str:
-    return "dummy_tokenized_prompt"
+    # Content-preserving: the reference's dummy returned a fixed string, but
+    # serving behaviors keyed on prompt CONTENT (prefix cache, speculation,
+    # chunked prefill) need the template to keep the words so token counts
+    # track the conversation.
+    parts = [f"{m.get('role', 'user')}:" + " " + str(m.get("content", "")) for m in messages]
+    if add_generation_prompt:
+      parts.append("assistant:")
+    return " ".join(parts)
 
   def encode(self, text: str) -> List[int]:
     return [1] * max(1, len(text.split()))
