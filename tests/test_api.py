@@ -333,6 +333,12 @@ async def test_full_serving_stack_with_all_accelerations(monkeypatch):
       "model": "synthetic-tiny",
       "messages": [{"role": "user", "content": "one two three four five six seven eight nine"}],
     }
+    # Capture raw token ids per request: DummyTokenizer.decode ignores ids,
+    # so string equality alone would only compare token COUNTS.
+    streams = {}
+    node.on_token.register("capture").on_next(
+      lambda rid, tokens, fin: streams.__setitem__(rid, list(tokens)))
+
     resp = await client.post("/v1/chat/completions", json=payload)
     assert resp.status == 200
     first = await resp.json()
@@ -344,6 +350,8 @@ async def test_full_serving_stack_with_all_accelerations(monkeypatch):
     second = await resp.json()
     assert second["choices"][0]["message"]["content"] == first["choices"][0]["message"]["content"]
     assert engine._prefix_hits >= 1
+    ids = list(streams.values())
+    assert len(ids) == 2 and ids[0] == ids[1], f"token streams diverged: {ids}"
 
     import jax.numpy as jnp
     ctx = next(iter(engine._contexts.values()))

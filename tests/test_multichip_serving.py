@@ -97,3 +97,40 @@ async def test_tp_split_ring_equivalence(tiny_model_dir, monkeypatch):
   hidden, st = await first.infer_tensor("r", Shard("m", 0, n // 2 - 1, n), tokens)
   out_split, _ = await second.infer_tensor("r", Shard("m", n // 2, n - 1, n), hidden, st)
   np.testing.assert_allclose(out_split, out_full, atol=1e-4, rtol=1e-3)
+
+
+async def test_tp_serving_with_int8_kv_cache(tiny_model_dir, monkeypatch):
+  """int8 KV under the tp mesh: the rank-4 scale leaves shard alongside K/V
+  (cache_spec rank-awareness) and greedy decode matches the unquantized tp
+  stream on the tiny model."""
+  import jax.numpy as jnp
+
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  tokens = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+
+  ref = _engine(tiny_model_dir, monkeypatch, 2)
+  out_ref, _ = await ref.infer_tensor("r", shard, tokens)
+
+  monkeypatch.setenv("XOT_KV_QUANT", "int8")
+  q = _engine(tiny_model_dir, monkeypatch, 2)
+  out_q, _ = await q.infer_tensor("r", shard, tokens)
+  assert q._mesh is not None and q._mesh.shape["tp"] == 2
+  state = q._contexts[shard].states["r"]
+  assert state.cache["k"].dtype == jnp.int8 and "k_scale" in state.cache
+  assert int(np.argmax(out_q[0, -1])) == int(np.argmax(out_ref[0, -1]))
+
+  # Decode over the sharded quantized cache, incl. a fused chunk whose
+  # TOKENS must equal the per-token reference continuation.
+  t = np.array([[int(np.argmax(out_ref[0, -1]))]], dtype=np.int64)
+  d_ref, _ = await ref.infer_tensor("r", shard, t)
+  d_q, _ = await q.infer_tensor("r", shard, t)
+  assert int(np.argmax(d_q[0, -1])) == int(np.argmax(d_ref[0, -1]))
+  ref_toks = []
+  nxt = np.array([[int(np.argmax(d_ref[0, -1]))]], dtype=np.int64)
+  for _ in range(4):
+    d_ref, _ = await ref.infer_tensor("r", shard, nxt)
+    ref_toks.append(int(np.argmax(d_ref[0, -1])))
+    nxt = np.array([[ref_toks[-1]]], dtype=np.int64)
+  chunk = await q.generate_chunk("r", shard, int(np.argmax(d_q[0, -1])), 4, temp=0.0)
+  assert [int(x) for x in chunk] == ref_toks, f"{chunk} != {ref_toks}"
