@@ -323,3 +323,41 @@ async def test_lora_repartition_resume_with_base_files_in_same_dir(tiny_model_di
   await full_eng.load_checkpoint(full, str(tiny_model_dir))
   got, _ = await full_eng.infer_tensor("chk", full, prompt)
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-4)
+
+
+async def test_qlora_over_int8_base_end_to_end(tiny_model_dir, monkeypatch, tmp_path):
+  """QLoRA through the ENGINE: train adapters over a frozen int8-quantized
+  base (loss decreases, int8 base bit-identical), save the adapter-only
+  checkpoint, restore it into a fresh quantized engine with identical
+  outputs."""
+  import jax.numpy as jnp
+  from xotorch_tpu.models.quantize import is_quantized
+
+  monkeypatch.setenv("XOT_QUANTIZE", "int8")
+  eng = _engine(tiny_model_dir, monkeypatch, rank=2)
+  shard = _full_shard()
+  await eng.ensure_shard(shard)
+  assert is_quantized(eng.params)
+  assert eng.params["layers"]["wq"].dtype == jnp.int8
+  assert eng.params["layers"]["lora_wq_a"].dtype != jnp.int8
+
+  base_before = np.asarray(eng.params["layers"]["wq"]).copy()
+  inputs, targets, lengths = _batch()
+  losses = []
+  for i in range(30):
+    loss, _ = await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
+    losses.append(loss)
+  assert losses[-1] < losses[0] * 0.95, f"QLoRA loss did not decrease: {losses[0]:.4f} -> {losses[-1]:.4f}"
+  np.testing.assert_array_equal(np.asarray(eng.params["layers"]["wq"]), base_before)
+
+  ckpt = tmp_path / "qlora.safetensors"
+  await eng.save_checkpoint(shard, str(ckpt))
+  prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  want, _ = await eng.infer_tensor("r", shard, prompt)
+
+  fresh = _engine(tiny_model_dir, monkeypatch, rank=2)
+  monkeypatch.setenv("XOT_QUANTIZE", "int8")
+  await fresh.load_checkpoint(shard, str(ckpt))
+  assert is_quantized(fresh.params)
+  got, _ = await fresh.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
