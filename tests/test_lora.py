@@ -355,8 +355,8 @@ async def test_qlora_over_int8_base_end_to_end(tiny_model_dir, monkeypatch, tmp_
   prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
   want, _ = await eng.infer_tensor("r", shard, prompt)
 
+  # XOT_QUANTIZE from above is still set: `fresh` builds quantized too.
   fresh = _engine(tiny_model_dir, monkeypatch, rank=2)
-  monkeypatch.setenv("XOT_QUANTIZE", "int8")
   await fresh.load_checkpoint(shard, str(ckpt))
   assert is_quantized(fresh.params)
   got, _ = await fresh.infer_tensor("r", shard, prompt)
