@@ -118,7 +118,8 @@ def _calibrate_sync(progress_path: str) -> dict:
 
 def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
                 cache_len: int, progress_path: str, stage_prefix: str,
-                measure_async: bool = False, quantize: str = "") -> dict:
+                measure_async: bool = False, quantize: str = "",
+                long_stage: bool = False) -> dict:
   """Measure one model config end to end. Returns the result dict.
 
   `measure_async`: also time block_until_ready-only variants of both decode
@@ -248,6 +249,51 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   toks_per_sec = fused_n / fused_elapsed
   per_token_ms = 1000 * fused_elapsed / fused_n
 
+  # --- long-context decode (auto on TPU; BENCH_LONG=0 disables, =N sets
+  # the depth). Prefill runs in 2048-token chunked segments (the serving
+  # path's design — no [T, S] score blowup), then decode at depth measures
+  # the resident-cache read cost the short config can't see.
+  on_tpu_now = jax.devices()[0].platform == "tpu"
+  long_ctx = int(os.getenv("BENCH_LONG", "16384" if on_tpu_now else "0") or 0) if long_stage else 0
+  long_result = {}
+  if long_ctx >= 2048:
+    seg = 2048
+    long_ctx -= long_ctx % seg  # whole segments: ONE executable serves all
+    cache_shape_len = long_ctx + 2 * chunk + 64
+    lprompt = np.random.randint(0, cfg.vocab_size, (1, long_ctx))
+    # Compile warm-up OUTSIDE the timed window (the long cache shape is new,
+    # so the first segment call would otherwise bill XLA compile time as
+    # prefill throughput — every other metric here excludes compiles).
+    lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16)
+    lg, lcache = fwd(params, jnp.asarray(lprompt[:, :seg], jnp.int32), lcache, jnp.int32(0))
+    np.asarray(lg[:, -1, :1])
+    del lcache
+    lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16)
+    t0 = time.time()
+    for off in range(0, long_ctx, seg):
+      x = jnp.asarray(lprompt[:, off:off + seg], jnp.int32)
+      lg, lcache = fwd(params, x, lcache, jnp.int32(off))
+    np.asarray(lg[:, -1, :1])  # host fetch: true barrier
+    long_prefill_s = time.time() - t0
+    ltok = jnp.argmax(lg[:, -1:], axis=-1).astype(jnp.int32)
+    ltoks, lcache = decode_chunk(params, ltok, lcache, jnp.int32(long_ctx), key, cfg, chunk, 0.0, 0)
+    np.asarray(ltoks)  # decode compile + first chunk
+    t0 = time.time()
+    produced_l = 0
+    while produced_l < 32:
+      ltok = ltoks[:, -1:].astype(jnp.int32)
+      ltoks, lcache = decode_chunk(params, ltok, lcache, jnp.int32(long_ctx + chunk + produced_l),
+                                   key, cfg, chunk, 0.0, 0)
+      np.asarray(ltoks)
+      produced_l += chunk
+    long_result = {
+      "long_ctx": long_ctx,
+      "long_prefill_s": round(long_prefill_s, 2),
+      "long_tok_s": round(produced_l / (time.time() - t0), 2),
+    }
+    del lcache, lg, ltok, ltoks
+    _record(progress_path, f"{stage_prefix}:long_context", **long_result)
+
   # Async fused variant (block_until_ready only) — diagnostic.
   async_toks_per_sec = None
   if measure_async:
@@ -321,6 +367,7 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     "roofline_tok_s": ceiling,
     "prefill_len": prefill_len,
     "decode_tokens": decode_tokens,
+    **long_result,
   }
   result["implausible"] = bool(
     (hbm_pct is not None and hbm_pct > 110)
@@ -545,7 +592,7 @@ def child_main() -> None:
     _record(progress_path, "smoke_result", **smoke)
 
   res = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path,
-                    "flagship", measure_async)
+                    "flagship", measure_async, long_stage=True)
   res["block_until_ready_ok"] = calib["block_until_ready_ok"]
   # int8 weight-only flagship (the "beats" half: decode is HBM-bound at
   # batch 1, so halving resident bytes ~doubles the roofline). Auto-enabled
@@ -556,7 +603,7 @@ def child_main() -> None:
     res["quant_fmt"] = quant  # _emit keys the field pass-through off this
     try:
       qres = _run_config(model_id, prefill_len, decode_tokens, chunk, cache_len, progress_path,
-                         "flagship-int8", measure_async, quantize=quant)
+                         "flagship-int8", measure_async, quantize=quant, long_stage=True)
       res.update({
         f"{quant}_tok_s": qres["tok_s"],
         f"{quant}_per_token_ms": qres["per_token_ms"],
@@ -566,6 +613,8 @@ def child_main() -> None:
         f"{quant}_tokens_verified": qres["tokens_verified"],
         f"{quant}_speedup": round(qres["tok_s"] / res["tok_s"], 2) if res.get("tok_s") else None,
         f"{quant}_implausible": qres["implausible"],
+        f"{quant}_long_tok_s": qres.get("long_tok_s"),
+        f"{quant}_long_prefill_s": qres.get("long_prefill_s"),
       })
       if qres.get("diagnosis"):
         res[f"{quant}_diagnosis"] = qres["diagnosis"]
@@ -674,6 +723,10 @@ def _apply_baseline(result: dict) -> dict:
   if result.get("implausible"):
     result["vs_baseline"] = round(result["tok_s"] / baseline, 3) if baseline else 0.0
     return result
+  if os.getenv("BENCH_NO_BASELINE", "0") == "1":
+    # Ad-hoc smoke runs must not write throwaway configs in as the bar.
+    result["vs_baseline"] = round(result["tok_s"] / baseline, 3) if baseline else 1.0
+    return result
   if baseline is None:
     baseline = result["tok_s"]
     baselines[key] = {
@@ -697,6 +750,7 @@ def _emit(result: dict) -> None:
     "vs_baseline": result.get("vs_baseline", 0.0),
   }
   for k in ("per_token_ms", "ttft_ms", "per_token_path_tok_s", "fused_speedup",
+            "long_ctx", "long_prefill_s", "long_tok_s",
             "async_tok_s", "async_divergence", "tokens_verified", "tokens_agree_prefix",
             "implausible", "diagnosis", "block_until_ready_ok", "roofline_tok_s",
             "ring2_tok_s", "ring2_per_token_ms", "ring2_ttft_ms", "ring2_error",
