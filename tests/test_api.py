@@ -362,3 +362,54 @@ async def test_full_serving_stack_with_all_accelerations(monkeypatch):
     assert fresh["k"].dtype == jnp.int8 and "k_scale" in fresh  # KV quantized
   finally:
     await client.close()
+
+
+async def test_per_request_temperature_reaches_sampler(monkeypatch):
+  """OpenAI `temperature` must govern the REQUEST's sampling — not be
+  silently replaced by the node default (which is what the reference does,
+  chatgpt_api.py:97-128 parses it and drops it)."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  engine = JAXShardInferenceEngine()
+  seen = {}
+  inner = engine.infer_sample_tensor
+
+  async def spy(request_id, shard, input_data, temp=0.6, top_k=35, **kw):
+    seen.setdefault(request_id, []).append(float(temp))
+    return await inner(request_id, shard, input_data, temp=temp, top_k=top_k, **kw)
+
+  engine.infer_sample_tensor = spy
+  node = await _make_node("api-temp", engine, max_generate_tokens=4,
+                          default_sample_temp=0.6, decode_chunk_size=1)
+  node.topology.update_node("api-temp", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    # temperature: 0 -> every sample call for this request is greedy.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "temperature": 0,
+      "messages": [{"role": "user", "content": "hello there"}],
+    })
+    assert resp.status == 200
+    assert seen and all(t == 0.0 for ts in seen.values() for t in ts), seen
+
+    # Absent temperature -> the node default applies.
+    seen.clear()
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny",
+      "messages": [{"role": "user", "content": "hello there"}],
+    })
+    assert resp.status == 200
+    assert seen and all(abs(t - 0.6) < 1e-9 for ts in seen.values() for t in ts), seen
+
+    # Invalid temperature -> 400, request never reaches the node.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "temperature": 3.5,
+      "messages": [{"role": "user", "content": "x"}],
+    })
+    assert resp.status == 400
+    assert (await resp.json())["error"]["type"] == "invalid_request_error"
+  finally:
+    await client.close()

@@ -189,3 +189,41 @@ async def test_batched_rows_at_different_depths(tiny_model_dir, monkeypatch):
   states = eng._contexts[shard].states
   sizes = {states["long"].cache["k"].shape[2], states["short"].cache["k"].shape[2]}
   assert len(sizes) == 2
+
+
+async def test_mixed_temperatures_share_one_dispatch(tiny_model_dir, monkeypatch):
+  """Temperature is traced per row (ops/sampling.sample_logits): a greedy
+  request and a sampled request coalesce into ONE dispatch, and the greedy
+  row's stream is bit-identical to its solo greedy run."""
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_BATCH_WINDOW_MS", "150")
+  shard = _full_shard()
+
+  async def decode(eng, rid, prompt, temp, chunks=3):
+    logits, _ = await eng.infer_tensor(rid, shard, prompt)
+    tok = int((await eng.sample(logits, temp=0.0))[0])
+    toks = [tok]
+    for _ in range(chunks):
+      out = await eng.generate_chunk(rid, shard, toks[-1], 4, temp=temp)
+      toks.extend(int(t) for t in out)
+    return toks
+
+  solo = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  want_greedy = await decode(solo, "solo", _prompts()["req-a"], temp=0.0)
+
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  widths = []
+  orig = eng._decode_batch_sync
+
+  def recording(ctx, items, *a):
+    widths.append(len(items))
+    return orig(ctx, items, *a)
+
+  monkeypatch.setattr(eng, "_decode_batch_sync", recording)
+  greedy_stream, sampled_stream = await asyncio.gather(
+    decode(eng, "greedy", _prompts()["req-a"], temp=0.0),
+    decode(eng, "sampled", _prompts()["req-b"], temp=1.2),
+  )
+  assert max(widths) >= 2, f"mixed temperatures never coalesced: {widths}"
+  assert greedy_stream == want_greedy, f"{greedy_stream} != {want_greedy}"
+  assert len(sampled_stream) == len(want_greedy)

@@ -489,3 +489,47 @@ async def test_delta_ingest_reorder_and_straggler_robustness():
   assert await node.ingest_remote_result("q", [3], 3, False) == (True, 0)
   assert len(seen) == n_events  # no spurious post-finish callback
   assert "q" not in node.buffered_token_output  # state not resurrected
+
+
+async def test_temperature_rides_the_ring_side_channel():
+  """In a 2-partition ring the SAMPLING peer (last layer) must use the
+  origin request's temperature: it rides send_prompt and the tensor hops'
+  inference_state (TEMP_KEY), exactly like max_tokens."""
+  engines = [DummyInferenceEngine(), DummyInferenceEngine()]
+  seen = []
+
+  def make_spy(eng):
+    inner = eng.sample
+
+    async def spy(x, temp=0.0, top_k=0):
+      seen.append(float(temp))
+      return await inner(x, temp=temp, top_k=top_k)
+
+    eng.sample = spy
+
+  for eng in engines:
+    make_spy(eng)  # ring order decides which peer samples — spy both
+  from xotorch_tpu.networking.inprocess import InProcessPeerHandle
+  nodes = []
+  for i, eng in enumerate(engines):
+    node = await _make_node(f"temp-{i}", eng, default_sample_temp=0.6,
+                            decode_chunk_size=1, max_generate_tokens=6)
+    nodes.append(node)
+  for node in nodes:
+    for other in nodes:
+      node.topology.update_node(other.id, _caps())
+    node.peers = [InProcessPeerHandle(o) for o in nodes if o is not node]
+
+  done = asyncio.Event()
+
+  def on_token(request_id, tokens, is_finished):
+    if is_finished:
+      done.set()
+
+  for node in nodes:
+    node.on_token.register(f"t-{node.id}").on_next(on_token)
+  shard = Shard("dummy", 0, 7, 8)
+  await nodes[0].process_prompt(shard, "hello ring", "temp-req", temperature=0.0)
+  await asyncio.wait_for(done.wait(), timeout=30)
+  assert seen and all(t == 0.0 for t in seen), \
+    f"sampler used {seen} instead of the request's 0.0 (node default is 0.6)"

@@ -340,6 +340,18 @@ class ChatGPTAPI:
                      "message": f"max_tokens must be a positive integer, got {max_tokens!r}"}},
           status=400,
         )
+    # OpenAI temperature: per-request sampling temperature; the node default
+    # applies when absent/null. Rides the ring to whichever peer samples.
+    temperature = data.get("temperature")
+    if temperature is not None:
+      if isinstance(temperature, bool) or not isinstance(temperature, (int, float)) \
+         or not (0 <= temperature <= 2):
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"temperature must be a number in [0, 2], got {temperature!r}"}},
+          status=400,
+        )
+      temperature = float(temperature)
     try:
       images = extract_images(data.get("messages", [])) or None
     except ValueError as e:
@@ -354,7 +366,8 @@ class ChatGPTAPI:
       )
     self.token_queues[request_id] = asyncio.Queue()
     try:
-      await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens, images=images)
+      await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens, images=images,
+                                     temperature=temperature)
       if stream:
         return await self._stream_response(request, request_id, model, tokenizer)
       return await self._full_response(request_id, model, tokenizer, prompt)

@@ -157,24 +157,23 @@ class _DecodeBatcher:
       batch: list = []
       while self.pending:
         batch, self.pending = self.pending, []
-        # Sampling params are static under jit: only identical (temp, top_k)
-        # share a dispatch. Chunk length is NOT a grouping key — requests at
-        # different points of the adaptive growth ladder (node.py
-        # _fused_decode_loop) still coalesce, running at the MINIMUM
-        # requested size; rows that asked for more get fewer tokens and
-        # loop again. Coalescing beats chunk length: batched rows share one
-        # weight read, which is the whole win.
-        groups: Dict[Tuple[float, int], list] = {}
+        # Only top_k is a compile-time sampling constant: temperature is
+        # TRACED per row (ops/sampling.sample_logits), so requests at
+        # different temperatures — and different points of the adaptive
+        # chunk ladder (min size wins; bigger requesters loop again) —
+        # still share ONE dispatch and one weight read, which is the
+        # whole win.
+        groups: Dict[int, list] = {}
         for item in batch:
-          groups.setdefault((item[4], item[5]), []).append(item)
-        for (temp, top_k), items in groups.items():
+          groups.setdefault(item[5], []).append(item)
+        for top_k, items in groups.items():
           num_tokens = min(item[3] for item in items)
           cap = self.engine._decode_batch_max()
           for off in range(0, len(items), cap):
             chunk_items = items[off:off + cap]
             try:
               results = await self.engine._run(
-                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, temp, top_k
+                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k
               )
               for (_, _, _, _, _, _, fut), toks in zip(chunk_items, results):
                 if not fut.done():
@@ -790,8 +789,8 @@ class JAXShardInferenceEngine(InferenceEngine):
 
     def _chunk() -> np.ndarray:
       return self._decode_batch_sync(
-        ctx, [(request_id, state, prev_token, num_tokens, temp, top_k, None)],
-        num_tokens, float(temp), int(top_k),
+        ctx, [(request_id, state, prev_token, num_tokens, float(temp), top_k, None)],
+        num_tokens, int(top_k),
       )[0]
 
     return await self._run(_chunk)
@@ -800,7 +799,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     return int(os.getenv("XOT_DECODE_BATCH", "8"))
 
   def _decode_batch_sync(self, ctx: _ShardContext, items: list, num_tokens: int,
-                         temp: float, top_k: int) -> list:
+                         top_k: int) -> list:
     """Run one fused decode chunk for 1..B requests in a single dispatch.
 
     B == 1 keeps the existing single-request executable (cache donated in
@@ -827,7 +826,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       tok = jnp.asarray([[items[0][2]]], dtype=jnp.int32)
       toks, state.cache = decode_chunk(
         ctx.params, tok, state.cache, jnp.int32(state.pos), key,
-        ctx.cfg, num_tokens, temp, top_k, use_flash_decode=use_fd,
+        ctx.cfg, num_tokens, float(items[0][4]), top_k, use_flash_decode=use_fd,
       )
       state.pos += num_tokens
       state.last_used = time.monotonic()
@@ -856,9 +855,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     }
     toks_in = jnp.asarray([[t] for t in row_tokens], dtype=jnp.int32)
     pos_vec = jnp.asarray([s.pos for s in row_states], dtype=jnp.int32)
+    # Per-ROW temperatures (traced): mixed-temperature requests share the
+    # dispatch; dummy pad rows replicate row 0's.
+    temp_vec = jnp.asarray([it[4] for it in items] + [items[0][4]] * (B_pad - B), jnp.float32)
     out, cache_b = decode_chunk(
       ctx.params, toks_in, cache_b, pos_vec, key,
-      ctx.cfg, num_tokens, temp, top_k, use_flash_decode=use_fd,
+      ctx.cfg, num_tokens, temp_vec, top_k, use_flash_decode=use_fd,
     )
     out_np = np.asarray(out)
     for i, state in enumerate(states):

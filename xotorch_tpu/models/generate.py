@@ -26,7 +26,7 @@ from xotorch_tpu.ops.sampling import sample_logits
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "is_first", "temp", "top_k", "use_flash", "use_flash_decode"),
+  static_argnames=("cfg", "is_first", "top_k", "use_flash", "use_flash_decode"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -64,7 +64,7 @@ def forward_sample(
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "num_tokens", "temp", "top_k", "top_p", "use_flash_decode"),
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode"),
   donate_argnames=("cache",),
 )
 def decode_chunk(
@@ -85,7 +85,9 @@ def decode_chunk(
   Requires the shard to span the whole model (is_first and is_last). Returns
   ([B, num_tokens] int32 sampled tokens, updated cache). The incoming `tok`
   is consumed (its forward step is the first scan iteration); the returned
-  tokens start at position start_pos + 1.
+  tokens start at position start_pos + 1. `temp` is traced — a scalar or a
+  per-ROW [B] array (ops/sampling.sample_logits), so batched rows may carry
+  different request temperatures in one dispatch.
   """
 
   def step(carry, _):

@@ -60,6 +60,7 @@ class GRPCServer(Server):
     asyncio.create_task(self.node.process_prompt(
       shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
       max_tokens=fields.get("max_tokens"), images=images,
+      temperature=fields.get("temperature"),
     ))
     return encode_message({"ok": True})
 

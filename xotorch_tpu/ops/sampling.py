@@ -16,18 +16,25 @@ DEFAULT_TEMP = 0.6
 DEFAULT_TOP_K = 35
 
 
-@partial(jax.jit, static_argnames=("temp", "top_k", "top_p"))
+@partial(jax.jit, static_argnames=("top_k", "top_p"))
 def sample_logits(
   logits: jnp.ndarray,  # [B, V] fp32
   key: jax.Array,
-  temp: float = DEFAULT_TEMP,
+  temp=DEFAULT_TEMP,  # python float, traced scalar, or per-ROW [B] array
   top_k: int = DEFAULT_TOP_K,
   top_p: float = 0.0,
 ) -> jnp.ndarray:
-  """Returns [B] int32 sampled token ids."""
-  if temp == 0.0:
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-  logits = logits.astype(jnp.float32) / temp
+  """Returns [B] int32 sampled token ids.
+
+  `temp` is TRACED (not a compile-time constant): per-row temperatures let
+  continuous batching coalesce mixed-temperature requests into one dispatch
+  (the batcher groups by top_k only). Rows with temp == 0 resolve to greedy
+  via a where — identical to the static-greedy graph's output."""
+  greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+  if isinstance(temp, (int, float)) and temp == 0.0:
+    return greedy  # static shortcut: pure-greedy callers skip the sampling graph
+  temp_b = jnp.broadcast_to(jnp.asarray(temp, jnp.float32).reshape(-1), (logits.shape[0],))
+  logits = logits.astype(jnp.float32) / jnp.maximum(temp_b, 1e-6)[:, None]
   if top_k and top_k > 0 and top_k < logits.shape[-1]:
     kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
     logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -41,4 +48,5 @@ def sample_logits(
     logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
   # Gumbel-max sampling (same estimator as the reference's exponential trick).
   gumbel = jax.random.gumbel(key, logits.shape, dtype=jnp.float32)
-  return jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)
+  sampled = jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)
+  return jnp.where(temp_b > 0, sampled, greedy)

@@ -47,6 +47,10 @@ from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
 # inference_state side-channel key carrying the per-request completion cap to
 # the last-layer peer (companion to tracing.TRACEPARENT_KEY).
 MAX_TOKENS_KEY = "xot_max_tokens"
+# Same side-channel for the per-request sampling temperature (OpenAI
+# `temperature`): whichever peer samples must use the REQUEST's temperature,
+# not its own node default.
+TEMP_KEY = "xot_temperature"
 
 
 _DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
@@ -148,6 +152,8 @@ class Node:
     # Per-request completion caps (OpenAI max_tokens); rides the
     # inference_state side-channel to whichever peer owns the last layer.
     self._request_max_tokens: Dict[str, int] = {}
+    # Per-request sampling temperature (OpenAI temperature); same channel.
+    self._request_temp: Dict[str, float] = {}
     # Why a request aborted (bounded LRU; API pops entries when reporting).
     from collections import OrderedDict
     self.request_errors: "OrderedDict[str, str]" = OrderedDict()
@@ -238,7 +244,8 @@ class Node:
 
   async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None,
                            traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
-                           images: Optional[List[np.ndarray]] = None) -> None:
+                           images: Optional[List[np.ndarray]] = None,
+                           temperature: Optional[float] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
@@ -246,6 +253,10 @@ class Node:
       # Per-request completion cap (OpenAI max_tokens); the node-wide
       # max_generate_tokens stays the hard ceiling.
       self._request_max_tokens[request_id] = self._clamp_max_tokens(max_tokens)
+    if temperature is not None:
+      # Per-request sampling temperature (OpenAI temperature); the node
+      # default applies only when the request doesn't specify one.
+      self._request_temp[request_id] = max(0.0, float(temperature))
     start_ns = time.perf_counter_ns()
     if traceparent is None:
       # Count only origin requests: a forwarded prompt re-enters process_prompt
@@ -314,7 +325,7 @@ class Node:
         self._request_prompt_tokens[request_id] = [int(t) for t in np.asarray(tokens).reshape(-1)]
       token, _ = await sampler(
         request_id, shard, np.asarray(tokens).reshape(1, -1),
-        temp=self.default_sample_temp, top_k=self.default_sample_top_k,
+        temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
       )
       await self.process_sampled_token(base_shard, int(token), request_id, None)
       return
@@ -344,6 +355,10 @@ class Node:
       cap = inference_state.get(MAX_TOKENS_KEY)
       if cap is not None:
         self._request_max_tokens[request_id] = self._clamp_max_tokens(cap)
+    if inference_state and request_id not in self._request_temp:
+      t = inference_state.get(TEMP_KEY)
+      if t is not None:
+        self._request_temp[request_id] = max(0.0, float(t))
     try:
       sampler = getattr(self.inference_engine, "infer_sample_tensor", None)
       fuse_sample = shard.is_last_layer and sampler is not None
@@ -356,7 +371,7 @@ class Node:
           # only the sampled token int crosses to the host, not the
           # [1, 1, vocab] fp32 logits (VERDICT r1 weak #3).
           token, inference_state = await sampler(
-            request_id, shard, tensor, temp=self.default_sample_temp,
+            request_id, shard, tensor, temp=self._temp_for(request_id),
             top_k=self.default_sample_top_k, inference_state=inference_state,
           )
         else:
@@ -434,7 +449,7 @@ class Node:
 
     # Last layer: sample, then continue via the shared token path.
     token = await self.inference_engine.sample(
-      result, temp=self.default_sample_temp, top_k=self.default_sample_top_k
+      result, temp=self._temp_for(request_id), top_k=self.default_sample_top_k
     )
     await self.process_sampled_token(
       base_shard, int(np.asarray(token).reshape(-1)[0]), request_id, inference_state
@@ -477,7 +492,7 @@ class Node:
     """Chunked decode until EOS/cap; EOS/max checks happen between chunks and
     surplus tokens after EOS inside a chunk are discarded."""
     verify = (getattr(self.inference_engine, "verify_draft", None)
-              if self.speculate_tokens > 0 and self.default_sample_temp == 0 else None)
+              if self.speculate_tokens > 0 and self._temp_for(request_id) == 0 else None)
     # Persistent draft context: prompt + generated tokens, appended as they
     # arrive (never rebuilt — a 32k prompt must not be re-copied per round).
     spec_context = (list(self._request_prompt_tokens.get(request_id, ())) + list(buffered)
@@ -519,7 +534,7 @@ class Node:
         this_size = min(size, 1 << (remaining - 1).bit_length())
         chunk = await gen(
           request_id, shard, buffered[-1], this_size,
-          temp=self.default_sample_temp, top_k=self.default_sample_top_k,
+          temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
         )
         if chunk is None:
           # Fast path unavailable (cache nearly full, shard changed): fall
@@ -607,6 +622,12 @@ class Node:
     if clear is not None:
       await clear(request_id)
 
+  def _temp_for(self, request_id: str) -> float:
+    """The request's sampling temperature, falling back to the node default
+    (read at SAMPLE time, so a temp that arrived via the tensor
+    side-channel after the prompt hop still applies)."""
+    return self._request_temp.get(request_id, self.default_sample_temp)
+
   def _clamp_max_tokens(self, cap: Any) -> int:
     return max(1, min(int(cap), self.max_generate_tokens))
 
@@ -672,7 +693,8 @@ class Node:
     await peer.send_prompt(next_shard, prompt, request_id,
                            traceparent=ctx.traceparent() if ctx else None,
                            max_tokens=self._request_max_tokens.get(request_id),
-                           images=images)
+                           images=images,
+                           temperature=self._request_temp.get(request_id))
 
   def _keep_on_device_kwargs(self, shard: Shard) -> dict:
     """Engine kwargs for a mid-ring hop: request device-resident output when
@@ -710,6 +732,9 @@ class Node:
     cap = self._request_max_tokens.get(request_id)
     if cap is not None:
       inference_state = {**(inference_state or {}), MAX_TOKENS_KEY: cap}
+    t = self._request_temp.get(request_id)
+    if t is not None:
+      inference_state = {**(inference_state or {}), TEMP_KEY: t}
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
@@ -937,6 +962,7 @@ class Node:
     self._request_trace_ctx.pop(request_id, None)
     self._last_token_time.pop(request_id, None)
     self._request_max_tokens.pop(request_id, None)
+    self._request_temp.pop(request_id, None)
     self._request_eos.pop(request_id, None)
     self._request_prompt_tokens.pop(request_id, None)
 
