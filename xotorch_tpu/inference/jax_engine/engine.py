@@ -217,6 +217,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     # bytes per decoded token — the binding resource at batch 1. CLI
     # --quantize / env XOT_QUANTIZE.
     self._quantize = (quantize or os.getenv("XOT_QUANTIZE", "")).lower() or None
+    if self._quantize is not None:
+      from xotorch_tpu.models.quantize import QUANT_DTYPES
+      if self._quantize not in QUANT_DTYPES:
+        # Fail at construction, not at first shard load minutes later.
+        raise ValueError(f"Unsupported quantization {self._quantize!r}; have {sorted(QUANT_DTYPES)}")
     # int8 KV cache (models/transformer.init_kv_cache kv_quant): halves
     # cache bandwidth + HBM per resident token — the binding resource for
     # LONG contexts. CLI --kv-quantize / env XOT_KV_QUANT.
@@ -588,7 +593,9 @@ class JAXShardInferenceEngine(InferenceEngine):
     n_acc = 0
     while n_acc < len(draft) and int(preds[n_acc]) == draft[n_acc]:
       n_acc += 1
-    accepted = draft[:n_acc] + [int(preds[n_acc])] if n_acc < len(draft) else draft + [int(preds[-1])]
+    # preds has len(draft)+1 entries, so preds[n_acc] is the bonus token in
+    # BOTH the partial- and full-acceptance cases.
+    accepted = draft[:n_acc] + [int(preds[n_acc])]
     # Roll back: only prev_token + the accepted draft wrote VALID cache
     # slots; the rest are masked out and re-written by the next dispatch.
     state.pos = pos_before + 1 + n_acc

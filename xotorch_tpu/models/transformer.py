@@ -105,10 +105,10 @@ def init_kv_cache(cfg: ModelConfig, num_layers: int, batch: int, max_seq: int, d
 
 def _quantize_kv(x: jnp.ndarray, scale_dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
   """Per-(position, head) symmetric int8 over the head dim: [B,T,H,D] ->
-  (int8 [B,T,H,D], scale [B,T,H])."""
-  scale = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True), 1e-12) / 127.0
-  q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
-  return q, jnp.squeeze(scale, -1).astype(scale_dtype)
+  (int8 [B,T,H,D], scale [B,T,H]). Same math as the weight path — one
+  quantizer, two tensor families."""
+  from xotorch_tpu.models.quantize import quantize_tensor
+  return quantize_tensor(x, axis=-1, scale_dtype=scale_dtype)
 
 
 def _cache_write(layer_cache: Dict[str, jnp.ndarray], k: jnp.ndarray, v: jnp.ndarray,
