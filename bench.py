@@ -856,7 +856,9 @@ def _orchestrate(progress_path: str) -> None:
     cpu_env.setdefault("BENCH_PREFILL", "32")
     cpu_env.setdefault("BENCH_DECODE", "8")
     cpu_env.setdefault("BENCH_CHUNK", "8")
-  result, recs, err = _run_child(cpu_env, progress_path, 300, 300)
+  # Generous stage budget: a 1.2B CPU fused-decode COMPILE alone can exceed
+  # 300 s on a loaded box, and no heartbeat can fire inside one jit call.
+  result, recs, err = _run_child(cpu_env, progress_path, 300, 900)
   if result is None:
     result = _salvage(recs) or {}
   if attempts:

@@ -413,3 +413,49 @@ async def test_per_request_temperature_reaches_sampler(monkeypatch):
     assert (await resp.json())["error"]["type"] == "invalid_request_error"
   finally:
     await client.close()
+
+
+async def test_per_request_top_p_reaches_sampler(monkeypatch):
+  """OpenAI top_p: validated, snapped to a 0.05 grid (bounded executables),
+  1 normalises to disabled, and the value reaches the request's sampler."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  engine = JAXShardInferenceEngine()
+  seen = []
+  inner = engine.infer_sample_tensor
+
+  async def spy(request_id, shard, input_data, temp=0.6, top_k=35, top_p=0.0, **kw):
+    seen.append(float(top_p))
+    return await inner(request_id, shard, input_data, temp=temp, top_k=top_k, top_p=top_p, **kw)
+
+  engine.infer_sample_tensor = spy
+  node = await _make_node("api-topp", engine, max_generate_tokens=3,
+                          default_sample_temp=0.6, decode_chunk_size=1)
+  node.topology.update_node("api-topp", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "top_p": 0.91,
+      "messages": [{"role": "user", "content": "hello there"}],
+    })
+    assert resp.status == 200
+    assert seen and all(abs(p - 0.9) < 1e-9 for p in seen), seen  # snapped to grid
+
+    seen.clear()
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "top_p": 1,
+      "messages": [{"role": "user", "content": "hello there"}],
+    })
+    assert resp.status == 200
+    assert seen and all(p == 0.0 for p in seen), seen  # 1 -> disabled
+
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "top_p": 0,
+      "messages": [{"role": "user", "content": "x"}],
+    })
+    assert resp.status == 400
+  finally:
+    await client.close()

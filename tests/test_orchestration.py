@@ -501,9 +501,9 @@ async def test_temperature_rides_the_ring_side_channel():
   def make_spy(eng):
     inner = eng.sample
 
-    async def spy(x, temp=0.0, top_k=0):
+    async def spy(x, temp=0.0, top_k=0, **kw):
       seen.append(float(temp))
-      return await inner(x, temp=temp, top_k=top_k)
+      return await inner(x, temp=temp, top_k=top_k, **kw)
 
     eng.sample = spy
 

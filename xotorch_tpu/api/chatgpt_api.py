@@ -352,6 +352,24 @@ class ChatGPTAPI:
           status=400,
         )
       temperature = float(temperature)
+    # OpenAI top_p (nucleus sampling): 1 (the OpenAI default) disables it.
+    # Values snap to a 0.05 grid: top_p is a compile-time constant of the
+    # sampling executable, and an unbounded value set would compile one
+    # program per distinct client value.
+    top_p = data.get("top_p")
+    if top_p is not None:
+      if isinstance(top_p, bool) or not isinstance(top_p, (int, float)) or not (0 < top_p <= 1):
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"top_p must be a number in (0, 1], got {top_p!r}"}},
+          status=400,
+        )
+      # Clamp the snap floor to 0.05: a tiny top_p must stay maximally
+      # restrictive — snapping to 0.0 would read as "nucleus OFF", the
+      # semantic opposite of what the client asked for.
+      top_p = max(0.05, round(float(top_p) * 20) / 20)
+      if top_p >= 1.0:
+        top_p = None  # the OpenAI default: nucleus filtering off
     try:
       images = extract_images(data.get("messages", [])) or None
     except ValueError as e:
@@ -367,7 +385,7 @@ class ChatGPTAPI:
     self.token_queues[request_id] = asyncio.Queue()
     try:
       await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens, images=images,
-                                     temperature=temperature)
+                                     temperature=temperature, top_p=top_p)
       if stream:
         return await self._stream_response(request, request_id, model, tokenizer)
       return await self._full_response(request_id, model, tokenizer, prompt)

@@ -28,7 +28,7 @@ class DummyInferenceEngine(InferenceEngine):
     await self.ensure_shard(shard)
     return np.array(self.tokenizer.encode(prompt), dtype=np.int64)
 
-  async def sample(self, x: np.ndarray, temp: float = 0.0, top_k: int = 0) -> np.ndarray:
+  async def sample(self, x: np.ndarray, temp: float = 0.0, top_k: int = 0, top_p: float = 0.0) -> np.ndarray:
     # Count-based EOS so ring tests terminate deterministically.
     self._count += 1
     if self._count >= self.num_generate_dummy_tokens:

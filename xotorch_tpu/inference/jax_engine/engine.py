@@ -137,9 +137,9 @@ class _DecodeBatcher:
     self._drain_task = None  # strong ref: the loop only weakly holds tasks
 
   async def submit(self, request_id: str, state: "_RequestState", prev_token: int,
-                   num_tokens: int, temp: float, top_k: int) -> np.ndarray:
+                   num_tokens: int, temp: float, top_k: int, top_p: float = 0.0) -> np.ndarray:
     fut = asyncio.get_running_loop().create_future()
-    self.pending.append((request_id, state, prev_token, num_tokens, temp, top_k, fut))
+    self.pending.append((request_id, state, prev_token, num_tokens, temp, top_k, top_p, fut))
     if not self._draining:
       self._draining = True
       self._drain_task = asyncio.create_task(self._drain())
@@ -157,25 +157,25 @@ class _DecodeBatcher:
       batch: list = []
       while self.pending:
         batch, self.pending = self.pending, []
-        # Only top_k is a compile-time sampling constant: temperature is
-        # TRACED per row (ops/sampling.sample_logits), so requests at
-        # different temperatures — and different points of the adaptive
-        # chunk ladder (min size wins; bigger requesters loop again) —
-        # still share ONE dispatch and one weight read, which is the
-        # whole win.
-        groups: Dict[int, list] = {}
+        # Only (top_k, top_p) are compile-time sampling constants:
+        # temperature is TRACED per row (ops/sampling.sample_logits), so
+        # requests at different temperatures — and different points of the
+        # adaptive chunk ladder (min size wins; bigger requesters loop
+        # again) — still share ONE dispatch and one weight read, which is
+        # the whole win.
+        groups: Dict[Tuple[int, float], list] = {}
         for item in batch:
-          groups.setdefault(item[5], []).append(item)
-        for top_k, items in groups.items():
+          groups.setdefault((item[5], item[6]), []).append(item)
+        for (top_k, top_p), items in groups.items():
           num_tokens = min(item[3] for item in items)
           cap = self.engine._decode_batch_max()
           for off in range(0, len(items), cap):
             chunk_items = items[off:off + cap]
             try:
               results = await self.engine._run(
-                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k
+                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k, top_p
               )
-              for (_, _, _, _, _, _, fut), toks in zip(chunk_items, results):
+              for (*_, fut), toks in zip(chunk_items, results):
                 if not fut.done():
                   fut.set_result(toks)
             except Exception as e:
@@ -366,7 +366,8 @@ class JAXShardInferenceEngine(InferenceEngine):
     tokenizer = await self._ensure_tokenizer(ctx)
     return tokenizer.decode(np.asarray(tokens).reshape(-1).tolist())
 
-  async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K) -> np.ndarray:
+  async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
+                   top_p: float = 0.0) -> np.ndarray:
     def _sample() -> np.ndarray:
       import jax
       from xotorch_tpu.ops.sampling import sample_logits
@@ -377,7 +378,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         logits = logits[None, :]
       self._sample_calls += 1
       key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
-      out = sample_logits(jax.numpy.asarray(logits), key, temp=temp, top_k=top_k)
+      out = sample_logits(jax.numpy.asarray(logits), key, temp=temp, top_k=top_k, top_p=top_p)
       return np.asarray(out).astype(np.int64)
 
     return await self._run(_sample)
@@ -487,7 +488,7 @@ class JAXShardInferenceEngine(InferenceEngine):
   async def infer_sample_tensor(
     self, request_id: str, shard: Shard, input_data: np.ndarray,
     temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
-    inference_state: Optional[dict] = None,
+    inference_state: Optional[dict] = None, top_p: float = 0.0,
   ) -> Tuple[int, Optional[dict]]:
     """Last-shard forward + ON-DEVICE sampling (models/generate.forward_sample):
     the host receives one int, not [B, T, vocab] fp32 logits. This is the
@@ -496,11 +497,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer:
       raise ValueError(f"infer_sample_tensor requires the last-layer shard, got {shard}")
-    tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data, float(temp), int(top_k))
+    tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data, float(temp),
+                          int(top_k), float(top_p))
     return tok, inference_state
 
   def _infer_sample_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
-                         temp: float, top_k: int) -> int:
+                         temp: float, top_k: int, top_p: float = 0.0) -> int:
     import jax
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import forward_sample
@@ -534,7 +536,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
     tok, state.cache = forward_sample(
       ctx.params, x, state.cache, jnp.int32(state.pos), jnp.int32(seg_t - 1), key,
-      ctx.cfg, x.ndim == 2, temp, top_k, use_flash=use_flash, use_flash_decode=use_fd,
+      ctx.cfg, x.ndim == 2, temp, top_k, top_p, use_flash=use_flash, use_flash_decode=use_fd,
     )
     state.pos += seg_t
     state.last_used = time.monotonic()
@@ -737,7 +739,7 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   async def generate_chunk(
     self, request_id: str, shard: Shard, prev_token: int, num_tokens: int,
-    temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
+    temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K, top_p: float = 0.0,
   ) -> Optional[np.ndarray]:
     """Fused multi-token decode (models/generate.py): one device dispatch
     produces UP TO `num_tokens` sampled tokens, with sampling on-device under
@@ -792,12 +794,12 @@ class JAXShardInferenceEngine(InferenceEngine):
       if ctx.batcher is None:
         ctx.batcher = _DecodeBatcher(self, ctx)
       return await ctx.batcher.submit(request_id, state, prev_token, num_tokens,
-                                      float(temp), int(top_k))
+                                      float(temp), int(top_k), float(top_p))
 
     def _chunk() -> np.ndarray:
       return self._decode_batch_sync(
-        ctx, [(request_id, state, prev_token, num_tokens, float(temp), top_k, None)],
-        num_tokens, int(top_k),
+        ctx, [(request_id, state, prev_token, num_tokens, float(temp), top_k, float(top_p), None)],
+        num_tokens, int(top_k), float(top_p),
       )[0]
 
     return await self._run(_chunk)
@@ -806,7 +808,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     return int(os.getenv("XOT_DECODE_BATCH", "8"))
 
   def _decode_batch_sync(self, ctx: _ShardContext, items: list, num_tokens: int,
-                         top_k: int) -> list:
+                         top_k: int, top_p: float = 0.0) -> list:
     """Run one fused decode chunk for 1..B requests in a single dispatch.
 
     B == 1 keeps the existing single-request executable (cache donated in
@@ -833,7 +835,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       tok = jnp.asarray([[items[0][2]]], dtype=jnp.int32)
       toks, state.cache = decode_chunk(
         ctx.params, tok, state.cache, jnp.int32(state.pos), key,
-        ctx.cfg, num_tokens, float(items[0][4]), top_k, use_flash_decode=use_fd,
+        ctx.cfg, num_tokens, float(items[0][4]), top_k, top_p, use_flash_decode=use_fd,
       )
       state.pos += num_tokens
       state.last_used = time.monotonic()
@@ -867,7 +869,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     temp_vec = jnp.asarray([it[4] for it in items] + [items[0][4]] * (B_pad - B), jnp.float32)
     out, cache_b = decode_chunk(
       ctx.params, toks_in, cache_b, pos_vec, key,
-      ctx.cfg, num_tokens, temp_vec, top_k, use_flash_decode=use_fd,
+      ctx.cfg, num_tokens, temp_vec, top_k, top_p, use_flash_decode=use_fd,
     )
     out_np = np.asarray(out)
     for i, state in enumerate(states):

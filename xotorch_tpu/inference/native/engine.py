@@ -192,7 +192,7 @@ class NativeSidecarInferenceEngine(InferenceEngine):
 
   # --------------------------------------------------------------- sampling
 
-  async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K) -> np.ndarray:
+  async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K, top_p: float = 0.0) -> np.ndarray:
     logits = np.asarray(x, dtype=np.float32)
     if logits.ndim == 3:
       logits = logits[:, -1, :]
@@ -204,6 +204,16 @@ class NativeSidecarInferenceEngine(InferenceEngine):
     if top_k and top_k > 0 and top_k < scaled.shape[-1]:
       kth = np.partition(scaled, -top_k, axis=-1)[:, -top_k][:, None]
       scaled = np.where(scaled < kth, -np.inf, scaled)
+    if top_p and 0.0 < top_p < 1.0:
+      # Nucleus cutoff, numpy mirror of ops/sampling.sample_logits: keep the
+      # smallest prefix with cumulative mass >= top_p (always >= 1 token).
+      sorted_desc = np.sort(scaled, axis=-1)[:, ::-1]
+      exp = np.exp(sorted_desc - sorted_desc[:, :1])
+      probs = exp / exp.sum(axis=-1, keepdims=True)
+      cumulative = np.cumsum(probs, axis=-1)
+      cutoff_idx = np.sum(cumulative < top_p, axis=-1, keepdims=True)
+      cutoff_logit = np.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+      scaled = np.where(scaled < cutoff_logit, -np.inf, scaled)
     # Gumbel-max: argmax(logits + G) ~ softmax sample — the same
     # exponential-noise trick the reference sampler used
     # (sharded_inference_engine.py:208-228).

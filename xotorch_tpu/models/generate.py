@@ -26,7 +26,7 @@ from xotorch_tpu.ops.sampling import sample_logits
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "is_first", "top_k", "use_flash", "use_flash_decode"),
+  static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -40,6 +40,7 @@ def forward_sample(
   is_first: bool,
   temp: float,
   top_k: int,
+  top_p: float = 0.0,
   use_flash: bool = False,
   use_flash_decode: bool = False,
 ):
@@ -58,7 +59,7 @@ def forward_sample(
                            is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
-  tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k)
+  tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p)
   return tok, cache
 
 

@@ -60,7 +60,7 @@ class GRPCServer(Server):
     asyncio.create_task(self.node.process_prompt(
       shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
       max_tokens=fields.get("max_tokens"), images=images,
-      temperature=fields.get("temperature"),
+      temperature=fields.get("temperature"), top_p=fields.get("top_p"),
     ))
     return encode_message({"ok": True})
 

@@ -52,7 +52,8 @@ class PeerHandle(ABC):
   @abstractmethod
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
-                        images: Optional[list] = None, temperature: Optional[float] = None) -> None:
+                        images: Optional[list] = None, temperature: Optional[float] = None,
+                        top_p: Optional[float] = None) -> None:
     ...
 
   @abstractmethod

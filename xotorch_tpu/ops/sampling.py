@@ -28,8 +28,9 @@ def sample_logits(
 
   `temp` is TRACED (not a compile-time constant): per-row temperatures let
   continuous batching coalesce mixed-temperature requests into one dispatch
-  (the batcher groups by top_k only). Rows with temp == 0 resolve to greedy
-  via a where — identical to the static-greedy graph's output."""
+  (the batcher groups by (top_k, top_p), the remaining compile-time
+  constants). Rows with temp == 0 resolve to greedy via a where — identical
+  to the static-greedy graph's output."""
   greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
   if isinstance(temp, (int, float)) and temp == 0.0:
     return greedy  # static shortcut: pure-greedy callers skip the sampling graph

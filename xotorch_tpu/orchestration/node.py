@@ -51,6 +51,9 @@ MAX_TOKENS_KEY = "xot_max_tokens"
 # `temperature`): whichever peer samples must use the REQUEST's temperature,
 # not its own node default.
 TEMP_KEY = "xot_temperature"
+# And for OpenAI `top_p` (nucleus sampling). Values snap to a 0.05 grid at
+# the API so the (top_k, top_p)-specialised executables stay bounded.
+TOP_P_KEY = "xot_top_p"
 
 
 _DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
@@ -154,6 +157,8 @@ class Node:
     self._request_max_tokens: Dict[str, int] = {}
     # Per-request sampling temperature (OpenAI temperature); same channel.
     self._request_temp: Dict[str, float] = {}
+    # Per-request nucleus sampling (OpenAI top_p); same channel.
+    self._request_top_p: Dict[str, float] = {}
     # Why a request aborted (bounded LRU; API pops entries when reporting).
     from collections import OrderedDict
     self.request_errors: "OrderedDict[str, str]" = OrderedDict()
@@ -245,7 +250,8 @@ class Node:
   async def process_prompt(self, base_shard: Shard, prompt: str, request_id: Optional[str] = None,
                            traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                            images: Optional[List[np.ndarray]] = None,
-                           temperature: Optional[float] = None) -> None:
+                           temperature: Optional[float] = None,
+                           top_p: Optional[float] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
@@ -257,6 +263,8 @@ class Node:
       # Per-request sampling temperature (OpenAI temperature); the node
       # default applies only when the request doesn't specify one.
       self._request_temp[request_id] = max(0.0, float(temperature))
+    if top_p is not None:
+      self._request_top_p[request_id] = min(1.0, max(0.0, float(top_p)))
     start_ns = time.perf_counter_ns()
     if traceparent is None:
       # Count only origin requests: a forwarded prompt re-enters process_prompt
@@ -326,6 +334,7 @@ class Node:
       token, _ = await sampler(
         request_id, shard, np.asarray(tokens).reshape(1, -1),
         temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
+        top_p=self._top_p_for(request_id),
       )
       await self.process_sampled_token(base_shard, int(token), request_id, None)
       return
@@ -359,6 +368,10 @@ class Node:
       t = inference_state.get(TEMP_KEY)
       if t is not None:
         self._request_temp[request_id] = max(0.0, float(t))
+    if inference_state and request_id not in self._request_top_p:
+      p = inference_state.get(TOP_P_KEY)
+      if p is not None:
+        self._request_top_p[request_id] = min(1.0, max(0.0, float(p)))
     try:
       sampler = getattr(self.inference_engine, "infer_sample_tensor", None)
       fuse_sample = shard.is_last_layer and sampler is not None
@@ -373,6 +386,7 @@ class Node:
           token, inference_state = await sampler(
             request_id, shard, tensor, temp=self._temp_for(request_id),
             top_k=self.default_sample_top_k, inference_state=inference_state,
+            top_p=self._top_p_for(request_id),
           )
         else:
           result, inference_state = await self.inference_engine.infer_tensor(
@@ -449,7 +463,8 @@ class Node:
 
     # Last layer: sample, then continue via the shared token path.
     token = await self.inference_engine.sample(
-      result, temp=self._temp_for(request_id), top_k=self.default_sample_top_k
+      result, temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
+      top_p=self._top_p_for(request_id),
     )
     await self.process_sampled_token(
       base_shard, int(np.asarray(token).reshape(-1)[0]), request_id, inference_state
@@ -535,6 +550,7 @@ class Node:
         chunk = await gen(
           request_id, shard, buffered[-1], this_size,
           temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
+          top_p=self._top_p_for(request_id),
         )
         if chunk is None:
           # Fast path unavailable (cache nearly full, shard changed): fall
@@ -628,6 +644,11 @@ class Node:
     side-channel after the prompt hop still applies)."""
     return self._request_temp.get(request_id, self.default_sample_temp)
 
+  def _top_p_for(self, request_id: str) -> float:
+    """The request's nucleus-sampling threshold; 0.0 (and the OpenAI
+    default 1.0, normalised at the API) means disabled."""
+    return self._request_top_p.get(request_id, 0.0)
+
   def _clamp_max_tokens(self, cap: Any) -> int:
     return max(1, min(int(cap), self.max_generate_tokens))
 
@@ -694,7 +715,8 @@ class Node:
                            traceparent=ctx.traceparent() if ctx else None,
                            max_tokens=self._request_max_tokens.get(request_id),
                            images=images,
-                           temperature=self._request_temp.get(request_id))
+                           temperature=self._request_temp.get(request_id),
+                           top_p=self._request_top_p.get(request_id))
 
   def _keep_on_device_kwargs(self, shard: Shard) -> dict:
     """Engine kwargs for a mid-ring hop: request device-resident output when
@@ -735,6 +757,9 @@ class Node:
     t = self._request_temp.get(request_id)
     if t is not None:
       inference_state = {**(inference_state or {}), TEMP_KEY: t}
+    p = self._request_top_p.get(request_id)
+    if p is not None:
+      inference_state = {**(inference_state or {}), TOP_P_KEY: p}
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
@@ -963,6 +988,7 @@ class Node:
     self._last_token_time.pop(request_id, None)
     self._request_max_tokens.pop(request_id, None)
     self._request_temp.pop(request_id, None)
+    self._request_top_p.pop(request_id, None)
     self._request_eos.pop(request_id, None)
     self._request_prompt_tokens.pop(request_id, None)
 
