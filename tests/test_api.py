@@ -459,3 +459,55 @@ async def test_per_request_top_p_reaches_sampler(monkeypatch):
     assert resp.status == 400
   finally:
     await client.close()
+
+
+async def test_stop_sequences_truncate_and_cancel(monkeypatch):
+  """OpenAI stop: the completion is cut BEFORE the first stop occurrence,
+  finish_reason is 'stop', and server-side generation is cancelled rather
+  than running to the cap — in both response modes."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("api-stop", engine, max_generate_tokens=64,
+                          default_sample_temp=0.0, decode_chunk_size=2)
+  node.topology.update_node("api-stop", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    # DummyTokenizer decodes every token as "dummy", so "dummy dummy" must
+    # appear immediately; the completion must cut before it.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "stop": "dummy dummy",
+      "messages": [{"role": "user", "content": "hello there everyone today"}],
+    })
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert "dummy dummy" not in data["choices"][0]["message"]["content"]
+    # Cancelled well before the 64-token cap.
+    assert data["usage"]["completion_tokens"] < 16
+
+    # Streaming: no emitted chunk may contain the stop sequence, and the
+    # stream must terminate with finish_reason 'stop'.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny", "stream": True, "stop": ["dummy dummy"],
+      "messages": [{"role": "user", "content": "hello there everyone today"}],
+    })
+    raw = await resp.text()
+    events = [line[6:] for line in raw.split("\n") if line.startswith("data: ")]
+    chunks = [json.loads(e) for e in events if e != "[DONE]"]
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert "dummy dummy" not in text
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+    # Invalid stop payloads -> 400.
+    for bad in ([], ["a"] * 5, [1], ""):
+      resp = await client.post("/v1/chat/completions", json={
+        "model": "synthetic-tiny", "stop": bad,
+        "messages": [{"role": "user", "content": "x"}],
+      })
+      assert resp.status == 400, bad
+  finally:
+    await client.close()

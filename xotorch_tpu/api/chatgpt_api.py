@@ -370,6 +370,19 @@ class ChatGPTAPI:
       top_p = max(0.05, round(float(top_p) * 20) / 20)
       if top_p >= 1.0:
         top_p = None  # the OpenAI default: nucleus filtering off
+    # OpenAI stop sequences: up to 4 strings; the completion is cut BEFORE
+    # the first occurrence and generation is cancelled server-side.
+    stop = data.get("stop")
+    if stop is not None:
+      if isinstance(stop, str):
+        stop = [stop]
+      if (not isinstance(stop, list) or not stop or len(stop) > 4
+          or not all(isinstance(s, str) and s for s in stop)):
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"stop must be a non-empty string or list of 1-4 strings, got {stop!r}"}},
+          status=400,
+        )
     try:
       images = extract_images(data.get("messages", [])) or None
     except ValueError as e:
@@ -387,8 +400,8 @@ class ChatGPTAPI:
       await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens, images=images,
                                      temperature=temperature, top_p=top_p)
       if stream:
-        return await self._stream_response(request, request_id, model, tokenizer)
-      return await self._full_response(request_id, model, tokenizer, prompt)
+        return await self._stream_response(request, request_id, model, tokenizer, stop=stop)
+      return await self._full_response(request_id, model, tokenizer, prompt, stop=stop)
     finally:
       self.token_queues.pop(request_id, None)
       self.prev_token_lens.pop(request_id, None)
@@ -442,12 +455,20 @@ class ChatGPTAPI:
       ids.add(eos)
     return ids
 
-  async def _stream_response(self, request, request_id: str, model: str, tokenizer):
+  async def _stream_response(self, request, request_id: str, model: str, tokenizer,
+                             stop: Optional[List[str]] = None):
     response = web.StreamResponse(status=200, headers={
       "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
     })
     await response.prepare(request)
     eos_ids = self._eos_ids(tokenizer)
+    # Stop-sequence scanning works on the DECODED text: `acc` is everything
+    # decoded so far, `sent` how much has been emitted. Until the request
+    # finishes, a tail of max(len(stop))-1 chars is held back so a stop
+    # sequence split across two token chunks is still caught before any of
+    # it reaches the client.
+    acc, sent = "", 0
+    holdback = max((len(s) for s in stop), default=1) - 1 if stop else 0
     try:
       deadline = time.monotonic() + self.response_timeout
       finished = False
@@ -470,6 +491,20 @@ class ChatGPTAPI:
         if finished:
           finish_reason = "stop" if (delta and delta[-1] in eos_ids) else "length"
         content = tokenizer.decode(new_tokens) if new_tokens else ""
+        if stop:
+          # Scan only the fresh tail (+ holdback overlap): earlier text was
+          # fully scanned on previous chunks — re-scanning all of `acc`
+          # each chunk would be O(n^2) over the stream.
+          scan_from = max(0, len(acc) - holdback)
+          acc += content
+          cut = min((i for i in (acc.find(s, scan_from) for s in stop) if i >= 0), default=-1)
+          if cut >= 0:
+            content, finished, finish_reason = acc[sent:cut], True, "stop"
+            await self.node.cancel_request(request_id)
+          else:
+            emit_to = len(acc) if finished else max(sent, len(acc) - holdback)
+            content = acc[sent:emit_to]
+          sent += len(content)
         chunk = self._chunk(request_id, model, content, finish_reason)
         await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
         deadline = time.monotonic() + self.response_timeout
@@ -483,10 +518,12 @@ class ChatGPTAPI:
       await response.write_eof()
       return response
 
-  async def _full_response(self, request_id: str, model: str, tokenizer, prompt: str):
+  async def _full_response(self, request_id: str, model: str, tokenizer, prompt: str,
+                           stop: Optional[List[str]] = None):
     eos_ids = self._eos_ids(tokenizer)
     tokens: List[int] = []
     finished = False
+    cancel_sent = False
     deadline = time.monotonic() + self.response_timeout
     while not finished:
       timeout = max(0.1, deadline - time.monotonic())
@@ -496,6 +533,17 @@ class ChatGPTAPI:
         return web.json_response({"detail": "Response timed out"}, status=408)
       if len(payload) >= len(tokens):
         tokens = payload  # an empty finish signal must not wipe the completion
+      if stop and not cancel_sent and not finished and tokens:
+        # Stop already reached: cancel generation instead of running to the
+        # cap; the cancel surfaces as the finished signal. Scan a bounded
+        # TAIL window only (a stop crossing further back was caught on an
+        # earlier payload) — a full re-decode per payload would be O(n^2)
+        # on the event loop every request shares.
+        window = [t for t in tokens[-(32 + max(len(s) for s in stop)):] if t not in eos_ids]
+        text = tokenizer.decode(window)
+        if any(s in text for s in stop):
+          cancel_sent = True
+          await self.node.cancel_request(request_id)
       deadline = time.monotonic() + self.response_timeout
     error = self.node.request_errors.pop(request_id, None)
     if error is not None:
@@ -512,6 +560,15 @@ class ChatGPTAPI:
     finish_reason = "stop" if (tokens and tokens[-1] in eos_ids) else "length"
     content_tokens = [t for t in tokens if t not in eos_ids]
     content = tokenizer.decode(content_tokens) if content_tokens else ""
+    if stop:
+      cut = min((i for i in (content.find(s) for s in stop) if i >= 0), default=-1)
+      if cut >= 0:
+        # OpenAI semantics: the completion ends BEFORE the stop sequence.
+        content, finish_reason = content[:cut], "stop"
+        if content and hasattr(tokenizer, "encode"):
+          content_tokens = tokenizer.encode(content)
+        elif not content:
+          content_tokens = []
     prompt_tokens = len(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else 0
     return web.json_response({
       "id": f"chatcmpl-{request_id}",

@@ -171,6 +171,13 @@ class Node:
     # Prompt token ids per request (sampler peer only): the draft source for
     # prompt-lookup speculative decoding (XOT_SPECULATE).
     self._request_prompt_tokens: Dict[str, List[int]] = {}
+    # Client-cancelled requests (cancel_request): the decode loops stop at
+    # the next token/chunk boundary instead of running to EOS/cap. Bounded
+    # LRU rather than per-request cleanup: the flag must outlive
+    # finish_request_state so a still-running loop (possibly on a REMOTE
+    # sampler peer, marked via the finished broadcast) reliably observes it.
+    from collections import OrderedDict as _OD
+    self._cancelled: "OrderedDict[str, None]" = _OD()
     self.speculate_tokens = int(os.getenv("XOT_SPECULATE", "0"))
     # Strong refs to detached tasks (hops, fused loops, broadcasts): the
     # event loop holds tasks only weakly — a GC'd generation-driving task
@@ -432,6 +439,29 @@ class Node:
       pass
     await self._finish_generation(request_id)
 
+  async def cancel_request(self, request_id: str) -> None:
+    """Client-initiated graceful stop (OpenAI stop sequences, disconnects):
+    end the request with the tokens produced so far — no error. Takes effect
+    between fused chunks / sampled tokens on THIS node (the sampler in
+    single-partition serving); a multi-partition ring's other peers finish
+    via the resulting broadcast."""
+    if request_id not in self.outstanding_requests and request_id not in self.buffered_token_output:
+      return  # already finished (or never seen here) — idempotent
+    self._mark_cancelled(request_id)
+    tokens, _ = self.buffered_token_output.get(request_id, ([], False))
+    self.buffered_token_output[request_id] = (tokens, True)
+    self.trigger_on_token_callbacks(request_id, tokens, True)
+    self._spawn(self.broadcast_result(request_id, [], True, total_len=len(tokens), full_ref=tokens))
+    # Final cleanup happens when the driving loop observes the flag at its
+    # next boundary (or when the ring's finished broadcast arrives); the
+    # flag itself ages out of the bounded LRU, so no cleanup races it.
+
+  def _mark_cancelled(self, request_id: str) -> None:
+    self._cancelled[request_id] = None
+    self._cancelled.move_to_end(request_id)
+    while len(self._cancelled) > 256:
+      self._cancelled.popitem(last=False)
+
   async def _finish_as_length(self, request_id: str) -> None:
     """End a request gracefully with whatever tokens it produced (used when
     the KV cache fills before EOS/cap — the OpenAI 'length' outcome)."""
@@ -517,6 +547,9 @@ class Node:
       self.outstanding_requests[request_id] = "generating"
       size = self.decode_chunk_size
       while True:
+        if request_id in self._cancelled:
+          await self._finish_generation(request_id)
+          return
         # Never compute far past the request cap: shrink the last chunk to
         # the next power of two covering what the cap still allows.
         limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
@@ -589,6 +622,10 @@ class Node:
     """Shared per-token accounting for the per-token ring and the fused chunk
     path: append to the request buffer (stopping at EOS or the request cap),
     update metrics/trace, fire callbacks, and broadcast. Returns finished."""
+    if request_id in self._cancelled:
+      # Tokens computed after a client cancel are discarded; report finished
+      # so the driving loop stops at this boundary.
+      return True
     eos = self._request_eos.get(request_id)
     if eos is None:
       eos = self._eos_token_ids(base_shard)
@@ -1080,7 +1117,11 @@ class Node:
       # The finished broadcast is how non-sampler peers learn a request
       # ended; run the same cleanup the sampler runs (bookkeeping + the
       # engine's resident KV cache). Remember the id (bounded) so delayed
-      # stragglers can't resurrect the request.
+      # stragglers can't resurrect the request. Mark cancelled too: if THIS
+      # peer is the sampler with a decode loop still running (an API peer
+      # cancelled on a stop sequence), the loop must stop at its next
+      # boundary, not run to the cap re-creating popped request state.
+      self._mark_cancelled(request_id)
       self._finished_results[request_id] = None
       while len(self._finished_results) > 512:
         self._finished_results.popitem(last=False)
