@@ -511,3 +511,29 @@ async def test_stop_sequences_truncate_and_cancel(monkeypatch):
       assert resp.status == 400, bad
   finally:
     await client.close()
+
+
+async def test_metrics_include_engine_serving_counters(monkeypatch):
+  """/metrics surfaces the engine's prefix-cache and speculation counters."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  monkeypatch.setenv("XOT_PREFIX_CACHE_MIN", "8")
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("api-metrics", engine, max_generate_tokens=3,
+                          default_sample_temp=0.0, decode_chunk_size=1)
+  node.topology.update_node("api-metrics", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    payload = {"model": "synthetic-tiny",
+               "messages": [{"role": "user", "content": "one two three four five six seven eight nine"}]}
+    await client.post("/v1/chat/completions", json=payload)
+    await client.post("/v1/chat/completions", json=payload)  # prefix hit
+    resp = await client.get("/metrics")
+    text = await resp.text()
+    assert "xot_prefix_cache_hits_total 1" in text, text.splitlines()[-8:]
+    assert "xot_spec_tokens_proposed_total" in text
+  finally:
+    await client.close()

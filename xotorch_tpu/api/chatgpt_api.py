@@ -179,6 +179,22 @@ class ChatGPTAPI:
 
   async def handle_get_metrics(self, request):
     body, content_type = self.node.metrics.exposition_with_content_type()
+    # Engine-level serving counters (prefix cache, speculative decoding):
+    # appended as plain exposition lines — they live on the engine, not the
+    # node registry, and only exist on engines that implement the features.
+    eng = self.node.inference_engine
+    extra = []
+    for attr, name, help_text in (
+      ("_prefix_hits", "xot_prefix_cache_hits_total", "Prefill prefix-cache hits"),
+      ("_prefix_tokens_saved", "xot_prefix_tokens_saved_total", "Prompt tokens whose prefill was skipped"),
+      ("_spec_proposed", "xot_spec_tokens_proposed_total", "Speculative draft tokens proposed"),
+      ("_spec_accepted", "xot_spec_tokens_accepted_total", "Speculative draft tokens accepted"),
+    ):
+      val = getattr(eng, attr, None)
+      if val is not None:
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} counter\n{name} {val}\n")
+    if extra:
+      body = body + "".join(extra).encode()
     # aiohttp's content_type kwarg rejects parameters; set the full
     # exposition header (incl. version=0.0.4) directly.
     return web.Response(body=body, headers={"Content-Type": content_type})
@@ -462,11 +478,16 @@ class ChatGPTAPI:
     })
     await response.prepare(request)
     eos_ids = self._eos_ids(tokenizer)
-    # Stop-sequence scanning works on the DECODED text: `acc` is everything
-    # decoded so far, `sent` how much has been emitted. Until the request
-    # finishes, a tail of max(len(stop))-1 chars is held back so a stop
-    # sequence split across two token chunks is still caught before any of
-    # it reaches the client.
+    # Stop-sequence scanning works on the TRUE decoded text: each iteration
+    # decodes the full non-EOS token list and diffs against the previously
+    # decoded text (per-chunk decode concatenation diverges from the real
+    # decode for SentencePiece-family tokenizers, which strip each chunk's
+    # leading space — a stop with a space at a chunk boundary would never
+    # match). Decodes happen once per CHUNK, not per token, so the total
+    # cost is O(n^2/chunk) — negligible at serving chunk sizes. Until the
+    # request finishes, a tail of max(len(stop))-1 chars is held back so a
+    # stop split across chunks is caught before any of it reaches the
+    # client; `sent` tracks what was emitted.
     acc, sent = "", 0
     holdback = max((len(s) for s in stop), default=1) - 1 if stop else 0
     try:
@@ -486,17 +507,14 @@ class ChatGPTAPI:
           await response.write(f"data: {json.dumps(payload)}\n\n".encode())
           break
         delta = self._delta_tokens(request_id, tokens)
-        new_tokens = [t for t in delta if t not in eos_ids]
         finish_reason = None
         if finished:
           finish_reason = "stop" if (delta and delta[-1] in eos_ids) else "length"
-        content = tokenizer.decode(new_tokens) if new_tokens else ""
         if stop:
-          # Scan only the fresh tail (+ holdback overlap): earlier text was
-          # fully scanned on previous chunks — re-scanning all of `acc`
-          # each chunk would be O(n^2) over the stream.
+          non_eos = [t for t in tokens if t not in eos_ids]
+          full_text = tokenizer.decode(non_eos) if non_eos else ""
           scan_from = max(0, len(acc) - holdback)
-          acc += content
+          acc = full_text
           cut = min((i for i in (acc.find(s, scan_from) for s in stop) if i >= 0), default=-1)
           if cut >= 0:
             content, finished, finish_reason = acc[sent:cut], True, "stop"
@@ -505,6 +523,9 @@ class ChatGPTAPI:
             emit_to = len(acc) if finished else max(sent, len(acc) - holdback)
             content = acc[sent:emit_to]
           sent += len(content)
+        else:
+          new_tokens = [t for t in delta if t not in eos_ids]
+          content = tokenizer.decode(new_tokens) if new_tokens else ""
         chunk = self._chunk(request_id, model, content, finish_reason)
         await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
         deadline = time.monotonic() + self.response_timeout
@@ -524,6 +545,7 @@ class ChatGPTAPI:
     tokens: List[int] = []
     finished = False
     cancel_sent = False
+    scanned_len = 0
     deadline = time.monotonic() + self.response_timeout
     while not finished:
       timeout = max(0.1, deadline - time.monotonic())
@@ -533,13 +555,15 @@ class ChatGPTAPI:
         return web.json_response({"detail": "Response timed out"}, status=408)
       if len(payload) >= len(tokens):
         tokens = payload  # an empty finish signal must not wipe the completion
-      if stop and not cancel_sent and not finished and tokens:
+      if stop and not cancel_sent and not finished and len(tokens) > scanned_len:
         # Stop already reached: cancel generation instead of running to the
-        # cap; the cancel surfaces as the finished signal. Scan a bounded
-        # TAIL window only (a stop crossing further back was caught on an
-        # earlier payload) — a full re-decode per payload would be O(n^2)
-        # on the event loop every request shares.
-        window = [t for t in tokens[-(32 + max(len(s) for s in stop)):] if t not in eos_ids]
+        # cap; the cancel surfaces as the finished signal. Scan the NEW
+        # payload delta plus a stop-sized token overlap (a stop of C chars
+        # spans at most C tokens) — a full re-decode per payload would be
+        # O(n^2) on the event loop every request shares.
+        overlap = max(len(s) for s in stop)
+        window = [t for t in tokens[max(0, scanned_len - overlap):] if t not in eos_ids]
+        scanned_len = len(tokens)
         text = tokenizer.decode(window)
         if any(s in text for s in stop):
           cancel_sent = True

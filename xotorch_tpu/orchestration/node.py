@@ -176,8 +176,7 @@ class Node:
     # LRU rather than per-request cleanup: the flag must outlive
     # finish_request_state so a still-running loop (possibly on a REMOTE
     # sampler peer, marked via the finished broadcast) reliably observes it.
-    from collections import OrderedDict as _OD
-    self._cancelled: "OrderedDict[str, None]" = _OD()
+    self._cancelled: "OrderedDict[str, None]" = OrderedDict()
     self.speculate_tokens = int(os.getenv("XOT_SPECULATE", "0"))
     # Strong refs to detached tasks (hops, fused loops, broadcasts): the
     # event loop holds tasks only weakly — a GC'd generation-driving task
