@@ -537,3 +537,45 @@ async def test_metrics_include_engine_serving_counters(monkeypatch):
     assert "xot_spec_tokens_proposed_total" in text
   finally:
     await client.close()
+
+
+async def test_n_completions_both_modes(monkeypatch):
+  """OpenAI n: multiple choices with correct indices in both response modes;
+  completions 2..n ride the prefix cache (engine hit counter)."""
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  monkeypatch.setenv("XOT_PREFIX_CACHE_MIN", "8")
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("api-n", engine, max_generate_tokens=6,
+                          default_sample_temp=0.0, decode_chunk_size=2)
+  node.topology.update_node("api-n", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    payload = {"model": "synthetic-tiny", "n": 3,
+               "messages": [{"role": "user", "content": "one two three four five six seven eight nine"}]}
+    resp = await client.post("/v1/chat/completions", json=payload)
+    assert resp.status == 200
+    data = await resp.json()
+    assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+    # Greedy: all three completions identical; prefix cache served 2 of them.
+    contents = {c["message"]["content"] for c in data["choices"]}
+    assert len(contents) == 1
+    assert engine._prefix_hits >= 2
+    assert data["usage"]["completion_tokens"] == 3 * 6
+
+    resp = await client.post("/v1/chat/completions", json={**payload, "stream": True})
+    raw = await resp.text()
+    events = [line[6:] for line in raw.split("\n") if line.startswith("data: ")]
+    chunks = [json.loads(e) for e in events if e != "[DONE]"]
+    seen_idx = {c["choices"][0]["index"] for c in chunks}
+    assert seen_idx == {0, 1, 2}
+    finishes = [c["choices"][0]["index"] for c in chunks if c["choices"][0]["finish_reason"]]
+    assert sorted(finishes) == [0, 1, 2]
+
+    resp = await client.post("/v1/chat/completions", json={**payload, "n": 0})
+    assert resp.status == 400
+  finally:
+    await client.close()

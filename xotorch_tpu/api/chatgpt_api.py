@@ -411,16 +411,40 @@ class ChatGPTAPI:
                    "message": f"model {model} does not support image input"}},
         status=400,
       )
-    self.token_queues[request_id] = asyncio.Queue()
+    # OpenAI n: independent completions of the same prompt. They compose
+    # with the serving stack for free — completions 2..n prefill via the
+    # prefix cache and their decodes coalesce in the continuous batcher.
+    n = data.get("n")
+    if n is None:
+      n = 1  # explicit null means "default", like the OpenAI API
+    if isinstance(n, bool) or not isinstance(n, int) or not (1 <= n <= 8):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": f"n must be an integer in [1, 8], got {n!r}"}},
+        status=400,
+      )
+    request_ids = [request_id] if n == 1 else [f"{request_id}#{i}" for i in range(n)]
+    for rid in request_ids:
+      self.token_queues[rid] = asyncio.Queue()
     try:
-      await self.node.process_prompt(shard, prompt, request_id, max_tokens=max_tokens, images=images,
-                                     temperature=temperature, top_p=top_p)
+      for rid in request_ids:
+        await self.node.process_prompt(shard, prompt, rid, max_tokens=max_tokens, images=images,
+                                       temperature=temperature, top_p=top_p)
       if stream:
-        return await self._stream_response(request, request_id, model, tokenizer, stop=stop)
-      return await self._full_response(request_id, model, tokenizer, prompt, stop=stop)
+        return await self._stream_response(request, request_ids, model, tokenizer, stop=stop)
+      return await self._full_response(request_ids, model, tokenizer, prompt, stop=stop)
     finally:
-      self.token_queues.pop(request_id, None)
-      self.prev_token_lens.pop(request_id, None)
+      for rid in request_ids:
+        self.token_queues.pop(rid, None)
+        self.prev_token_lens.pop(rid, None)
+        # A sub-request abandoned early (peer error, timeout, client gone,
+        # a later sibling's process_prompt raising) must not keep decoding
+        # to the cap with nobody listening. Idempotent: finished requests
+        # no-op.
+        try:
+          await self.node.cancel_request(rid)
+        except Exception:
+          pass
 
   async def _tokenizer_for(self, model: str, shard):
     if model.startswith("synthetic") or model == "dummy":
@@ -448,14 +472,15 @@ class ChatGPTAPI:
     self.prev_token_lens[request_id] = len(tokens)
     return tokens[prev:]
 
-  def _chunk(self, request_id: str, model: str, content: str, finish_reason: Optional[str]) -> dict:
+  def _chunk(self, request_id: str, model: str, content: str, finish_reason: Optional[str],
+             index: int = 0) -> dict:
     return {
-      "id": f"chatcmpl-{request_id}",
+      "id": f"chatcmpl-{request_id.split('#')[0]}",
       "object": "chat.completion.chunk",
       "created": int(time.time()),
       "model": model,
       "choices": [{
-        "index": 0,
+        "index": index,
         "delta": {"role": "assistant", "content": content} if content else {},
         "finish_reason": finish_reason,
       }],
@@ -471,32 +496,51 @@ class ChatGPTAPI:
       ids.add(eos)
     return ids
 
-  async def _stream_response(self, request, request_id: str, model: str, tokenizer,
+  async def _stream_response(self, request, request_ids: List[str], model: str, tokenizer,
                              stop: Optional[List[str]] = None):
+    """SSE stream over one or more completions (OpenAI n): sub-requests'
+    queues are merged and each chunk carries its choice index.
+
+    Stop-sequence scanning works on the TRUE decoded text: each iteration
+    decodes a choice's full non-EOS token list and diffs against the
+    previously decoded text (per-chunk decode concatenation diverges from
+    the real decode for SentencePiece-family tokenizers, which strip each
+    chunk's leading space — a stop with a space at a chunk boundary would
+    never match). Decodes happen once per CHUNK, not per token, so total
+    cost is O(n^2/chunk) — negligible at serving chunk sizes. Until a
+    choice finishes, a tail of max(len(stop))-1 chars is held back so a
+    stop split across chunks is caught before any of it reaches the
+    client; `sent[i]` tracks what choice i emitted."""
     response = web.StreamResponse(status=200, headers={
       "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
     })
     await response.prepare(request)
     eos_ids = self._eos_ids(tokenizer)
-    # Stop-sequence scanning works on the TRUE decoded text: each iteration
-    # decodes the full non-EOS token list and diffs against the previously
-    # decoded text (per-chunk decode concatenation diverges from the real
-    # decode for SentencePiece-family tokenizers, which strip each chunk's
-    # leading space — a stop with a space at a chunk boundary would never
-    # match). Decodes happen once per CHUNK, not per token, so the total
-    # cost is O(n^2/chunk) — negligible at serving chunk sizes. Until the
-    # request finishes, a tail of max(len(stop))-1 chars is held back so a
-    # stop split across chunks is caught before any of it reaches the
-    # client; `sent` tracks what was emitted.
-    acc, sent = "", 0
+    acc = ["" for _ in request_ids]
+    sent = [0 for _ in request_ids]
+    done = [False for _ in request_ids]
     holdback = max((len(s) for s in stop), default=1) - 1 if stop else 0
+
+    merged: asyncio.Queue = asyncio.Queue()
+
+    def _pump(idx: int, rid: str):
+      async def run():
+        while True:
+          payload, fin = await self.token_queues[rid].get()
+          await merged.put((idx, rid, payload, fin))
+          if fin:
+            return
+      return asyncio.create_task(run())
+
+    pumps = [_pump(i, rid) for i, rid in enumerate(request_ids)]
     try:
       deadline = time.monotonic() + self.response_timeout
-      finished = False
-      while not finished:
+      while not all(done):
         timeout = max(0.1, deadline - time.monotonic())
-        tokens, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
-        error = self.node.request_errors.pop(request_id, None) if finished else None
+        idx, rid, tokens, finished = await asyncio.wait_for(merged.get(), timeout=timeout)
+        if done[idx]:
+          continue  # straggler after a stop-sequence cut
+        error = self.node.request_errors.pop(rid, None) if finished else None
         if error is not None:
           # Mid-stream failure: OpenAI-style error event, then terminate. A
           # prompt that overflowed the KV budget is the client's error
@@ -505,43 +549,52 @@ class ChatGPTAPI:
                    else "server_error")
           payload = {"error": {"type": etype, "message": error}}
           await response.write(f"data: {json.dumps(payload)}\n\n".encode())
+          done = [True] * len(done)
           break
-        delta = self._delta_tokens(request_id, tokens)
+        delta = self._delta_tokens(rid, tokens)
         finish_reason = None
         if finished:
           finish_reason = "stop" if (delta and delta[-1] in eos_ids) else "length"
         if stop:
           non_eos = [t for t in tokens if t not in eos_ids]
           full_text = tokenizer.decode(non_eos) if non_eos else ""
-          scan_from = max(0, len(acc) - holdback)
-          acc = full_text
-          cut = min((i for i in (acc.find(s, scan_from) for s in stop) if i >= 0), default=-1)
+          scan_from = max(0, len(acc[idx]) - holdback)
+          if len(full_text) >= len(acc[idx]):
+            acc[idx] = full_text  # an empty finish signal must not wipe the text
+          cut = min((i for i in (acc[idx].find(s, scan_from) for s in stop) if i >= 0), default=-1)
           if cut >= 0:
-            content, finished, finish_reason = acc[sent:cut], True, "stop"
-            await self.node.cancel_request(request_id)
+            content, finished, finish_reason = acc[idx][sent[idx]:cut], True, "stop"
+            await self.node.cancel_request(rid)
           else:
-            emit_to = len(acc) if finished else max(sent, len(acc) - holdback)
-            content = acc[sent:emit_to]
-          sent += len(content)
+            emit_to = len(acc[idx]) if finished else max(sent[idx], len(acc[idx]) - holdback)
+            content = acc[idx][sent[idx]:emit_to]
+          sent[idx] += len(content)
         else:
           new_tokens = [t for t in delta if t not in eos_ids]
           content = tokenizer.decode(new_tokens) if new_tokens else ""
-        chunk = self._chunk(request_id, model, content, finish_reason)
+        done[idx] = done[idx] or finished
+        chunk = self._chunk(rid, model, content, finish_reason, index=idx)
         await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
         deadline = time.monotonic() + self.response_timeout
       await response.write(b"data: [DONE]\n\n")
       await response.write_eof()
       return response
     except asyncio.TimeoutError:
-      chunk = self._chunk(request_id, model, "", "length")
-      await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+      for idx, rid in enumerate(request_ids):
+        if not done[idx]:
+          chunk = self._chunk(rid, model, "", "length", index=idx)
+          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
       await response.write(b"data: [DONE]\n\n")
       await response.write_eof()
       return response
+    finally:
+      for p in pumps:
+        p.cancel()
 
-  async def _full_response(self, request_id: str, model: str, tokenizer, prompt: str,
-                           stop: Optional[List[str]] = None):
-    eos_ids = self._eos_ids(tokenizer)
+  async def _await_completion(self, request_id: str, tokenizer, eos_ids: set,
+                              stop: Optional[List[str]]):
+    """Collect one sub-request's full token list. Returns (tokens, error).
+    Raises asyncio.TimeoutError on stall."""
     tokens: List[int] = []
     finished = False
     cancel_sent = False
@@ -549,10 +602,7 @@ class ChatGPTAPI:
     deadline = time.monotonic() + self.response_timeout
     while not finished:
       timeout = max(0.1, deadline - time.monotonic())
-      try:
-        payload, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
-      except asyncio.TimeoutError:
-        return web.json_response({"detail": "Response timed out"}, status=408)
+      payload, finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=timeout)
       if len(payload) >= len(tokens):
         tokens = payload  # an empty finish signal must not wipe the completion
       if stop and not cancel_sent and not finished and len(tokens) > scanned_len:
@@ -569,7 +619,18 @@ class ChatGPTAPI:
           cancel_sent = True
           await self.node.cancel_request(request_id)
       deadline = time.monotonic() + self.response_timeout
-    error = self.node.request_errors.pop(request_id, None)
+    return tokens, self.node.request_errors.pop(request_id, None)
+
+  async def _full_response(self, request_ids: List[str], model: str, tokenizer, prompt: str,
+                           stop: Optional[List[str]] = None):
+    eos_ids = self._eos_ids(tokenizer)
+    try:
+      results = await asyncio.gather(*(
+        self._await_completion(rid, tokenizer, eos_ids, stop) for rid in request_ids
+      ))
+    except asyncio.TimeoutError:
+      return web.json_response({"detail": "Response timed out"}, status=408)
+    error = next((err for _, err in results if err), None)
     if error is not None:
       if error.startswith("context_length_exceeded"):
         # The prompt didn't fit the model's KV budget — 400, like OpenAI's
@@ -581,33 +642,38 @@ class ChatGPTAPI:
       return web.json_response(
         {"error": {"type": "server_error", "message": error}}, status=500
       )
-    finish_reason = "stop" if (tokens and tokens[-1] in eos_ids) else "length"
-    content_tokens = [t for t in tokens if t not in eos_ids]
-    content = tokenizer.decode(content_tokens) if content_tokens else ""
-    if stop:
-      cut = min((i for i in (content.find(s) for s in stop) if i >= 0), default=-1)
-      if cut >= 0:
-        # OpenAI semantics: the completion ends BEFORE the stop sequence.
-        content, finish_reason = content[:cut], "stop"
-        if content and hasattr(tokenizer, "encode"):
-          content_tokens = tokenizer.encode(content)
-        elif not content:
-          content_tokens = []
+    choices = []
+    total_completion = 0
+    for idx, (tokens, _) in enumerate(results):
+      finish_reason = "stop" if (tokens and tokens[-1] in eos_ids) else "length"
+      content_tokens = [t for t in tokens if t not in eos_ids]
+      content = tokenizer.decode(content_tokens) if content_tokens else ""
+      if stop:
+        cut = min((i for i in (content.find(s) for s in stop) if i >= 0), default=-1)
+        if cut >= 0:
+          # OpenAI semantics: the completion ends BEFORE the stop sequence.
+          content, finish_reason = content[:cut], "stop"
+          if content and hasattr(tokenizer, "encode"):
+            content_tokens = tokenizer.encode(content)
+          elif not content:
+            content_tokens = []
+      total_completion += len(content_tokens)
+      choices.append({
+        "index": idx,
+        "message": {"role": "assistant", "content": content},
+        "finish_reason": finish_reason,
+      })
     prompt_tokens = len(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else 0
     return web.json_response({
-      "id": f"chatcmpl-{request_id}",
+      "id": f"chatcmpl-{request_ids[0].split('#')[0]}",
       "object": "chat.completion",
       "created": int(time.time()),
       "model": model,
-      "choices": [{
-        "index": 0,
-        "message": {"role": "assistant", "content": content},
-        "finish_reason": finish_reason,
-      }],
+      "choices": choices,
       "usage": {
         "prompt_tokens": prompt_tokens,
-        "completion_tokens": len(content_tokens),
-        "total_tokens": prompt_tokens + len(content_tokens),
+        "completion_tokens": total_completion,
+        "total_tokens": prompt_tokens + total_completion,
       },
     })
 
