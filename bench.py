@@ -725,8 +725,9 @@ def _apply_baseline(result: dict) -> dict:
   if result.get("implausible"):
     result["vs_baseline"] = round(result["tok_s"] / baseline, 3) if baseline else 0.0
     return result
-  if os.getenv("BENCH_NO_BASELINE", "0") == "1":
-    # Ad-hoc smoke runs must not write throwaway configs in as the bar.
+  if os.getenv("BENCH_NO_BASELINE", "0") == "1" or result.get("stage") == "smoke":
+    # Ad-hoc smoke runs — and SALVAGED smoke partials from a dead child —
+    # must not write throwaway configs in as the bar.
     result["vs_baseline"] = round(result["tok_s"] / baseline, 3) if baseline else 1.0
     return result
   if baseline is None:

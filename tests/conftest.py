@@ -26,6 +26,18 @@ def pytest_configure(config):
   config.addinivalue_line("markers", "asyncio: run the test inside a fresh asyncio event loop")
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+  """Bound in-process XLA state: after ~100 accumulated CPU executables the
+  NEXT pjit-over-a-mesh compile segfaults inside XLA:CPU
+  (backend_compile_and_load, reproducible at the first test_multichip test
+  in a full-suite run; every affected file passes in isolation). Dropping
+  compiled executables between modules keeps the process under the
+  threshold at the cost of a few recompiles per file."""
+  yield
+  jax.clear_caches()
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
   """Run coroutine tests with asyncio.run (no pytest-asyncio in this image)."""
