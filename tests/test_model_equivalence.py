@@ -85,7 +85,31 @@ def hf_logits(model_dir: Path, tokens: np.ndarray) -> np.ndarray:
     return model(torch.tensor(tokens)).logits.numpy()
 
 
-@pytest.mark.parametrize("hf_cfg", [TINY_LLAMA_CFG, TINY_QWEN2_CFG], ids=["llama3-scaled-rope", "qwen2-bias-tied"])
+TINY_PHI3_CFG = {
+  "architectures": ["Phi3ForCausalLM"],
+  "model_type": "phi3",
+  "hidden_size": 64,
+  "intermediate_size": 128,
+  "num_attention_heads": 4,
+  "num_key_value_heads": 2,
+  "num_hidden_layers": 3,
+  "vocab_size": 256,
+  "max_position_embeddings": 128,
+  "rms_norm_eps": 1e-5,
+  "rope_theta": 10000.0,
+  "tie_word_embeddings": False,
+  "torch_dtype": "float32",
+  "eos_token_id": 2,
+  "pad_token_id": 0,  # Phi3Config defaults to 32000, beyond the tiny vocab
+}
+
+
+@pytest.mark.parametrize(
+  "hf_cfg", [TINY_LLAMA_CFG, TINY_QWEN2_CFG, TINY_PHI3_CFG],
+  # phi3 checkpoints fuse qkv_proj and gate_up_proj — the only oracle
+  # coverage of weights._split_fused_projections against real transformers.
+  ids=["llama3-scaled-rope", "qwen2-bias-tied", "phi3-fused-proj"],
+)
 def test_full_model_matches_transformers(tmp_path, hf_cfg):
   from xotorch_tpu.inference.shard import Shard
   from xotorch_tpu.models.config import load_model_config
