@@ -3,10 +3,11 @@
 The reference's key invariant is split-vs-full logit equality
 (inference/test_inference_engine.py:12-47, bit-identical via np.array_equal);
 here it's allclose (XLA reassociates fp math) and strengthened with an
-*external* oracle: a tiny Llama/Qwen2 checkpoint is synthesized locally in HF
-format (zero-egress environment), loaded by both torch transformers and this
-framework, and must agree — catching layout/RoPE/GQA bugs an internal-only
-test can't see.
+*external* oracle: tiny checkpoints for every supported dense family
+(llama3, qwen2, phi3 fused projections, mistral non-derived head_dim,
+qwen3 qk-norm) are synthesized locally in HF format (zero-egress
+environment), loaded by both torch transformers and this framework, and
+must agree — catching layout/RoPE/GQA bugs an internal-only test can't see.
 """
 import json
 from pathlib import Path
@@ -103,12 +104,44 @@ TINY_PHI3_CFG = {
   "pad_token_id": 0,  # Phi3Config defaults to 32000, beyond the tiny vocab
 }
 
+def _tiny_cfg(model_type: str, architecture: str, **overrides) -> dict:
+  """Shared tiny-checkpoint boilerplate; each family states only what
+  distinguishes it."""
+  cfg = {
+    "architectures": [architecture],
+    "model_type": model_type,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "num_hidden_layers": 3,
+    "vocab_size": 256,
+    "max_position_embeddings": 128,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0,
+    "tie_word_embeddings": False,
+    "torch_dtype": "float32",
+    "eos_token_id": 2,
+  }
+  cfg.update(overrides)
+  return cfg
+
+
+# head_dim=32 != hidden/heads (16): exercises the EXPLICIT head_dim config
+# path (o_proj becomes [hidden, heads*head_dim]), not the derived default.
+TINY_MISTRAL_CFG = _tiny_cfg("mistral", "MistralForCausalLM", head_dim=32)
+
+TINY_QWEN3_CFG = _tiny_cfg("qwen3", "Qwen3ForCausalLM", head_dim=32,
+                           rms_norm_eps=1e-6, tie_word_embeddings=True)
+
 
 @pytest.mark.parametrize(
-  "hf_cfg", [TINY_LLAMA_CFG, TINY_QWEN2_CFG, TINY_PHI3_CFG],
-  # phi3 checkpoints fuse qkv_proj and gate_up_proj — the only oracle
-  # coverage of weights._split_fused_projections against real transformers.
-  ids=["llama3-scaled-rope", "qwen2-bias-tied", "phi3-fused-proj"],
+  "hf_cfg", [TINY_LLAMA_CFG, TINY_QWEN2_CFG, TINY_PHI3_CFG, TINY_MISTRAL_CFG, TINY_QWEN3_CFG],
+  # phi3 fuses qkv_proj/gate_up_proj (weights._split_fused_projections),
+  # qwen3 exercises the qk_norm path — the reference's own full-model suite
+  # covered llama/qwen/mistral (test_llama3_full.py etc., SURVEY §4).
+  ids=["llama3-scaled-rope", "qwen2-bias-tied", "phi3-fused-proj",
+       "mistral-headdim", "qwen3-qk-norm"],
 )
 def test_full_model_matches_transformers(tmp_path, hf_cfg):
   from xotorch_tpu.inference.shard import Shard
