@@ -109,3 +109,57 @@ async def test_sampling_temperature_zero_is_argmax(tiny_model_dir):
   assert int(tok[0]) == 42
   tok_k = await eng.sample(logits, temp=0.8, top_k=1)
   assert int(tok_k[0]) == 42
+
+
+async def test_hbm_exhaustion_recovers_engine(tiny_model_dir):
+  """RESOURCE_EXHAUSTED during a device computation must (a) surface as
+  CacheExhausted (the graceful length/400 path), (b) free prefix snapshots
+  and resident request states, and (c) leave the engine healthy for the
+  NEXT request — the TPU analogue of the reference's CUDA-OOM clear_model
+  recovery (sharded_inference_engine.py:85-106)."""
+  from xotorch_tpu.inference.engine import CacheExhausted
+
+  eng = _engine(tiny_model_dir)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  tokens = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+  await eng.infer_tensor("r1", shard, tokens)  # resident state exists
+  assert eng._contexts[shard].states
+
+  def explode():
+    raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 2.4G")
+
+  with pytest.raises(CacheExhausted, match="device memory exhausted"):
+    await eng._run(explode)
+  assert eng._oom_count == 1
+  assert not eng._contexts[shard].states  # request states dropped
+
+  # Engine still serves: a fresh request completes normally.
+  out, _ = await eng.infer_tensor("r2", shard, tokens)
+  assert out.shape[-1] == TINY_LLAMA_CFG["vocab_size"]
+
+
+async def test_oom_lost_state_fails_loudly_and_load_oom_is_not_4xx(tiny_model_dir):
+  """(a) A request whose state was dropped by OOM recovery must fail with
+  RequestStateLost on its next plain-infer touch, never silently restart
+  from an empty cache. (b) A LOAD-time OOM is a capacity problem: it
+  surfaces as RuntimeError, not CacheExhausted/400."""
+  from xotorch_tpu.inference.engine import CacheExhausted, RequestStateLost
+
+  eng = _engine(tiny_model_dir)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  tokens = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+  await eng.infer_tensor("victim", shard, tokens)
+
+  def explode():
+    raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 2.4G")
+
+  with pytest.raises(CacheExhausted):
+    await eng._run(explode)
+  # The victim's decode continuation must not silently restart at pos 0.
+  with pytest.raises(RequestStateLost, match="OOM recovery"):
+    await eng.infer_tensor("victim", shard, np.array([[7]], dtype=np.int64))
+
+  with pytest.raises(RuntimeError, match="device memory exhausted"):
+    await eng._run(explode, oom_as_cache_exhausted=False)

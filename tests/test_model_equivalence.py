@@ -19,47 +19,47 @@ import jax
 import jax.numpy as jnp
 
 
-TINY_LLAMA_CFG = {
-  "architectures": ["LlamaForCausalLM"],
-  "model_type": "llama",
-  "hidden_size": 64,
-  "intermediate_size": 128,
-  "num_attention_heads": 4,
-  "num_key_value_heads": 2,
-  "num_hidden_layers": 4,
-  "vocab_size": 256,
-  "max_position_embeddings": 128,
-  "rms_norm_eps": 1e-5,
-  "rope_theta": 500000.0,
-  "tie_word_embeddings": False,
-  "torch_dtype": "float32",
-  "rope_scaling": {
+
+def _tiny_cfg(model_type: str, architecture: str, **overrides) -> dict:
+  """Shared tiny-checkpoint boilerplate; each family states only what
+  distinguishes it."""
+  cfg = {
+    "architectures": [architecture],
+    "model_type": model_type,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "num_hidden_layers": 3,
+    "vocab_size": 256,
+    "max_position_embeddings": 128,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0,
+    "tie_word_embeddings": False,
+    "torch_dtype": "float32",
+    "eos_token_id": 2,
+  }
+  cfg.update(overrides)
+  return cfg
+
+
+
+TINY_LLAMA_CFG = _tiny_cfg(
+  "llama", "LlamaForCausalLM", num_hidden_layers=4, rope_theta=500000.0,
+  rope_scaling={
     "rope_type": "llama3",
     "factor": 8.0,
     "low_freq_factor": 1.0,
     "high_freq_factor": 4.0,
     "original_max_position_embeddings": 64,
   },
-  "eos_token_id": 2,
-}
+)
 
-TINY_QWEN2_CFG = {
-  "architectures": ["Qwen2ForCausalLM"],
-  "model_type": "qwen2",
-  "hidden_size": 64,
-  "intermediate_size": 128,
-  "num_attention_heads": 4,
-  "num_key_value_heads": 2,
-  "num_hidden_layers": 3,
-  "vocab_size": 256,
-  "max_position_embeddings": 128,
-  "rms_norm_eps": 1e-6,
-  "rope_theta": 10000.0,
-  "tie_word_embeddings": True,
-  "torch_dtype": "float32",
-  "eos_token_id": 2,
-}
+TINY_QWEN2_CFG = _tiny_cfg("qwen2", "Qwen2ForCausalLM", rms_norm_eps=1e-6,
+                           tie_word_embeddings=True)
 
+# Phi3Config defaults pad_token_id=32000, beyond the tiny vocab.
+TINY_PHI3_CFG = _tiny_cfg("phi3", "Phi3ForCausalLM", pad_token_id=0)
 
 def make_hf_checkpoint(tmp_path: Path, hf_cfg: dict, seed: int = 0) -> Path:
   """Create a random-weight HF checkpoint on disk using transformers itself."""
@@ -84,47 +84,6 @@ def hf_logits(model_dir: Path, tokens: np.ndarray) -> np.ndarray:
   model = AutoModelForCausalLM.from_pretrained(model_dir, torch_dtype=torch.float32).eval()
   with torch.no_grad():
     return model(torch.tensor(tokens)).logits.numpy()
-
-
-TINY_PHI3_CFG = {
-  "architectures": ["Phi3ForCausalLM"],
-  "model_type": "phi3",
-  "hidden_size": 64,
-  "intermediate_size": 128,
-  "num_attention_heads": 4,
-  "num_key_value_heads": 2,
-  "num_hidden_layers": 3,
-  "vocab_size": 256,
-  "max_position_embeddings": 128,
-  "rms_norm_eps": 1e-5,
-  "rope_theta": 10000.0,
-  "tie_word_embeddings": False,
-  "torch_dtype": "float32",
-  "eos_token_id": 2,
-  "pad_token_id": 0,  # Phi3Config defaults to 32000, beyond the tiny vocab
-}
-
-def _tiny_cfg(model_type: str, architecture: str, **overrides) -> dict:
-  """Shared tiny-checkpoint boilerplate; each family states only what
-  distinguishes it."""
-  cfg = {
-    "architectures": [architecture],
-    "model_type": model_type,
-    "hidden_size": 64,
-    "intermediate_size": 128,
-    "num_attention_heads": 4,
-    "num_key_value_heads": 2,
-    "num_hidden_layers": 3,
-    "vocab_size": 256,
-    "max_position_embeddings": 128,
-    "rms_norm_eps": 1e-5,
-    "rope_theta": 10000.0,
-    "tie_word_embeddings": False,
-    "torch_dtype": "float32",
-    "eos_token_id": 2,
-  }
-  cfg.update(overrides)
-  return cfg
 
 
 # head_dim=32 != hidden/heads (16): exercises the EXPLICIT head_dim config

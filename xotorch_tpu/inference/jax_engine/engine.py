@@ -244,6 +244,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     # Speculative-decode observability: drafted vs model-confirmed tokens.
     self._spec_proposed = 0
     self._spec_accepted = 0
+    # Requests whose device state was dropped by OOM recovery (bounded LRU):
+    # their next touch raises RequestStateLost instead of silently starting
+    # over from an empty cache.
+    self._states_lost_to_oom: "OrderedDict[str, None]" = OrderedDict()
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -351,8 +355,56 @@ class JAXShardInferenceEngine(InferenceEngine):
     from xotorch_tpu.parallel.mesh import make_mesh
     return make_mesh({"tp": t}, jax.local_devices())
 
-  async def _run(self, fn, *args):
-    return await asyncio.get_running_loop().run_in_executor(self.executor, fn, *args)
+  async def _run(self, fn, *args, oom_as_cache_exhausted: bool = True):
+    """Every device computation funnels through the single-worker executor.
+    HBM exhaustion is caught HERE: the engine frees what it can (prefix
+    snapshots, resident request states, idle model contexts) so SUBSEQUENT
+    requests find a healthy engine. Serving computations surface the OOM as
+    CacheExhausted (the graceful length/400 path); load/train callers pass
+    oom_as_cache_exhausted=False and get a RuntimeError instead — a model
+    that does not FIT is a capacity problem, not the client's prompt
+    length. TPU-native analogue of the reference's CUDA-OOM clear_model
+    recovery (sharded_inference_engine.py:85-106, 330-334)."""
+    try:
+      return await asyncio.get_running_loop().run_in_executor(self.executor, fn, *args)
+    except Exception as e:
+      if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+        self._oom_count += 1
+        try:
+          # Runs ON the event loop, no awaits: cooperative scheduling makes
+          # the dict mutations atomic w.r.t. every other coroutine, and the
+          # single executor worker is idle (its task just failed).
+          freed = self._free_device_memory()
+        except Exception as free_err:  # recovery must never mask the OOM
+          freed = f"recovery itself failed: {free_err!r}"
+        msg = f"device memory exhausted (recovery #{self._oom_count}: freed {freed}); original: {e}"
+        if oom_as_cache_exhausted:
+          raise CacheExhausted(msg) from e
+        raise RuntimeError(msg) from e
+      raise
+
+  def _free_device_memory(self) -> str:
+    """Aggressive, reference-style recovery: drop every prefix-cache
+    snapshot, every resident request state, and all but the active model
+    context. Cleared requests are remembered (bounded) so their next touch
+    fails loudly with RequestStateLost instead of silently restarting from
+    an empty cache."""
+    n_snap = n_state = n_ctx = 0
+    for ctx in self._contexts.values():
+      n_snap += len(ctx.prefix_cache)
+      ctx.prefix_cache.clear()
+      for rid in ctx.states:
+        self._states_lost_to_oom[rid] = None
+      n_state += len(ctx.states)
+      ctx.states.clear()
+    while len(self._states_lost_to_oom) > 512:
+      self._states_lost_to_oom.popitem(last=False)
+    for shard in [s for s, c in self._contexts.items() if c is not self._active]:
+      self._contexts.pop(shard)
+      n_ctx += 1
+    import jax
+    jax.clear_caches()  # drop compiled executables' scratch allocations too
+    return f"{n_snap} prefix snapshots, {n_state} request states, {n_ctx} model contexts"
 
   # ------------------------------------------------------------- public API
 
@@ -926,6 +978,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     doesn't allocate-then-immediately-regrow."""
     state = ctx.states.get(request_id)
     if state is None:
+      if request_id in self._states_lost_to_oom:
+        # The plain infer path would otherwise silently recreate a pos=0
+        # state and decode with no context after an OOM recovery dropped
+        # it. The entry stays (LRU-bounded): retries of a dead request must
+        # keep failing loudly, and request ids are never reused (uuids).
+        raise RequestStateLost(
+          f"request {request_id}: device state dropped by OOM recovery")
       length = ctx.cache_len
       while length < min_len and length < ctx.max_cache_len:
         length *= 2
@@ -1086,7 +1145,8 @@ class JAXShardInferenceEngine(InferenceEngine):
               fill_jits, forward_hidden_jit, forward_hidden_flash_jit, vision)
 
     (cfg, params, mesh, forward_jit, forward_flash_jit, forward_decode_flash_jit,
-     fill_jits, forward_hidden_jit, forward_hidden_flash_jit, vision) = await self._run(_load)
+     fill_jits, forward_hidden_jit, forward_hidden_flash_jit, vision) = await self._run(
+       _load, oom_as_cache_exhausted=False)
     cache_len = min(self._configured_cache_len, cfg.max_seq_len)
     max_cache_len = max(cache_len, min(self._configured_max_cache_len, cfg.max_seq_len))
     ctx = _ShardContext(
@@ -1225,7 +1285,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         params = lora_mod.add_lora_params(params, rank, jax.random.PRNGKey(self._seed), targets)
       return params
 
-    ctx.params = await self._run(_load)
+    ctx.params = await self._run(_load, oom_as_cache_exhausted=False)
     ctx.opt_state = None  # optimizer state is invalid for reloaded weights
     ctx.prefix_cache.clear()  # snapshots were computed under the old weights
 
@@ -1247,7 +1307,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         params = dequantize_params(params, self._dtype())
       save_shard_params(params, ctx.cfg, ctx.shard, Path(path))
 
-    await self._run(_save)
+    await self._run(_save, oom_as_cache_exhausted=False)
 
   # -------------------------------------------------------------- training
 
@@ -1306,7 +1366,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
         ctx.prefix_cache.clear()  # prefill snapshots are stale under new weights
         return float(loss), np.asarray(x_grad)
-      return await self._run(_last)
+      return await self._run(_last, oom_as_cache_exhausted=False)
 
     # Mid/first shard: one forward with saved residuals, then backward later.
     def _fwd_vjp():
@@ -1331,7 +1391,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         out, vjp_fn = jax.vjp(fwd, fl, x)
       return np.asarray(out), vjp_fn, out.dtype
 
-    activations, vjp_fn, out_dtype = await self._run(_fwd_vjp)
+    activations, vjp_fn, out_dtype = await self._run(_fwd_vjp, oom_as_cache_exhausted=False)
     loss, down_grad = await forward_fn(activations, np.asarray(target), np.asarray(lengths), True)
     if down_grad is None:
       raise RuntimeError(f"Downstream shard returned no gradient for {request_id}")
@@ -1354,7 +1414,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       ctx.prefix_cache.clear()  # prefill snapshots are stale under new weights
       return x_grad
 
-    x_grad = await self._run(_bwd_apply)
+    x_grad = await self._run(_bwd_apply, oom_as_cache_exhausted=False)
     return float(loss), x_grad
 
   async def evaluate_example(self, request_id: str, shard: Shard, example: np.ndarray, target: np.ndarray,
@@ -1378,7 +1438,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         return float(masked_ce_loss(out, tgt, lens))
       return np.asarray(out)
 
-    out = await self._run(_fwd)
+    out = await self._run(_fwd, oom_as_cache_exhausted=False)
     if shard.is_last_layer:
       return out
     loss, _ = await forward_fn(out, np.asarray(target), np.asarray(lengths), False)
