@@ -579,3 +579,16 @@ async def test_n_completions_both_modes(monkeypatch):
     assert resp.status == 400
   finally:
     await client.close()
+
+
+async def test_tinychat_served_at_root():
+  """The bundled web UI is reachable at / (parity: the reference serves
+  tinychat from the API root, chatgpt_api.py:226-229)."""
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.get("/")
+    assert resp.status == 200
+    body = await resp.text()
+    assert "<html" in body.lower()
+  finally:
+    await client.close()
