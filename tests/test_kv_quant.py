@@ -141,3 +141,38 @@ async def test_kv_quant_disables_flash_decode(tmp_path, monkeypatch):
   eng = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
                                 kv_quant="int8")
   assert eng._flash_decode_on(10_000) is False
+
+
+async def test_flash_prefill_composes_with_int8_cache(tmp_path, monkeypatch):
+  """Pallas flash prefill (interpret mode on CPU) WRITES the quantized cache
+  while attending over fresh K/V; the subsequent decode reads the int8
+  cache — the exact composition real-TPU serving uses. Streams must agree
+  with the no-flash int8-cache engine."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+  from xotorch_tpu.download.shard_download import LocalShardDownloader
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  model_dir = make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=5)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([[1, 5, 9, 200, 17, 33, 2, 8]], dtype=np.int64)
+
+  async def decode_steps(eng, k=4):
+    tok, _ = await eng.infer_sample_tensor("r", shard, prompt, temp=0.0)
+    toks = [int(tok)]
+    for _ in range(k):
+      tok, _ = await eng.infer_sample_tensor("r", shard, np.asarray([[toks[-1]]]), temp=0.0)
+      toks.append(int(tok))
+    return toks
+
+  monkeypatch.setenv("XOT_FLASH_ATTENTION", "0")
+  base = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
+                                 kv_quant="int8")
+  want = await decode_steps(base)
+
+  monkeypatch.setenv("XOT_FLASH_ATTENTION", "1")  # interpret mode off-TPU
+  flash = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
+                                  kv_quant="int8")
+  assert flash._flash_enabled()
+  got = await decode_steps(flash)
+  assert got == want, f"flash+int8KV stream {got} != baseline {want}"
