@@ -81,7 +81,10 @@ def hf_logits(model_dir: Path, tokens: np.ndarray) -> np.ndarray:
   import torch
   from transformers import AutoModelForCausalLM
 
-  model = AutoModelForCausalLM.from_pretrained(model_dir, torch_dtype=torch.float32).eval()
+  # eager = exact softmax attention for every family; sdpa would silently
+  # SKIP gemma2's attention soft-capping (transformers falls back without it).
+  model = AutoModelForCausalLM.from_pretrained(
+    model_dir, torch_dtype=torch.float32, attn_implementation="eager").eval()
   with torch.no_grad():
     return model(torch.tensor(tokens)).logits.numpy()
 
@@ -93,14 +96,27 @@ TINY_MISTRAL_CFG = _tiny_cfg("mistral", "MistralForCausalLM", head_dim=32)
 TINY_QWEN3_CFG = _tiny_cfg("qwen3", "Qwen3ForCausalLM", head_dim=32,
                            rms_norm_eps=1e-6, tie_word_embeddings=True)
 
+# Gemma2 is the most architecturally distinct dense family: (1+w) RMSNorm,
+# sandwich norms, gelu-tanh MLP, sqrt(hidden) embedding scale, tanh
+# soft-capped attention + final logits, query_pre_attn_scalar score scale,
+# and an ALTERNATING sliding window. window=4 over an 8-token prompt makes
+# the window mask actually bite in this test (ref card: models.py:206-207).
+TINY_GEMMA2_CFG = _tiny_cfg(
+  "gemma2", "Gemma2ForCausalLM", head_dim=32, rms_norm_eps=1e-6,
+  tie_word_embeddings=True, hidden_activation="gelu_pytorch_tanh",
+  query_pre_attn_scalar=24.0, attn_logit_softcapping=50.0,
+  final_logit_softcapping=30.0, sliding_window=4,
+)
+
 
 @pytest.mark.parametrize(
-  "hf_cfg", [TINY_LLAMA_CFG, TINY_QWEN2_CFG, TINY_PHI3_CFG, TINY_MISTRAL_CFG, TINY_QWEN3_CFG],
+  "hf_cfg", [TINY_LLAMA_CFG, TINY_QWEN2_CFG, TINY_PHI3_CFG, TINY_MISTRAL_CFG, TINY_QWEN3_CFG,
+             TINY_GEMMA2_CFG],
   # phi3 fuses qkv_proj/gate_up_proj (weights._split_fused_projections),
   # qwen3 exercises the qk_norm path — the reference's own full-model suite
   # covered llama/qwen/mistral (test_llama3_full.py etc., SURVEY §4).
   ids=["llama3-scaled-rope", "qwen2-bias-tied", "phi3-fused-proj",
-       "mistral-headdim", "qwen3-qk-norm"],
+       "mistral-headdim", "qwen3-qk-norm", "gemma2-sandwich-window"],
 )
 def test_full_model_matches_transformers(tmp_path, hf_cfg):
   from xotorch_tpu.inference.shard import Shard
@@ -181,3 +197,80 @@ def test_save_roundtrip(tmp_path):
     names = list(f.keys())
   assert any("layers.1." in n for n in names) and any("layers.2." in n for n in names)
   assert not any("layers.0." in n or "layers.3." in n for n in names)
+
+
+def test_gemma2_sliding_window_incremental_decode(tmp_path):
+  """Sliding-window correctness where it can actually go wrong: CACHED decode
+  at depths past the window. A 12-token prompt (3x the window) is prefilled,
+  then 4 greedy tokens are decoded incrementally; every step's logits must
+  match an HF full re-forward over the growing sequence — so the alternating
+  per-layer window mask must hold for both prefill and single-token cached
+  queries at absolute positions >> window."""
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import load_model_config
+  from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
+  from xotorch_tpu.models.weights import load_shard_params
+
+  model_dir = make_hf_checkpoint(tmp_path, TINY_GEMMA2_CFG, seed=3)
+  cfg = load_model_config(model_dir)
+  assert cfg.uses_sliding_window and cfg.sliding_window == 4
+  # gemma2 alternates: even layers slide, odd are global.
+  assert [cfg.layer_window(i) for i in range(3)] == [4, 0, 4]
+  n = cfg.num_layers
+  params = load_shard_params(model_dir, cfg, Shard("g", 0, n - 1, n), dtype=jnp.float32)
+
+  tokens = np.array([[2, 7, 11, 40, 3, 99, 150, 23, 8, 61, 5, 17]], dtype=np.int32)
+  cache = init_kv_cache(cfg, n, 1, 32, jnp.float32)
+  logits, cache = forward_shard(params, jnp.asarray(tokens), cache, jnp.int32(0), cfg, True, True)
+  np.testing.assert_allclose(np.asarray(logits), hf_logits(model_dir, tokens),
+                             atol=2e-4, rtol=2e-3)
+
+  seq, pos = tokens, tokens.shape[1]
+  for _ in range(4):
+    nxt = np.asarray(jnp.argmax(logits[:, -1:], axis=-1)).astype(np.int32)
+    logits, cache = forward_shard(params, jnp.asarray(nxt), cache, jnp.int32(pos), cfg, True, True)
+    seq = np.concatenate([seq, nxt], axis=1)
+    np.testing.assert_allclose(np.asarray(logits)[:, -1], hf_logits(model_dir, seq)[:, -1],
+                               atol=2e-4, rtol=2e-3)
+    pos += 1
+
+
+def test_use_sliding_window_false_disables_windowing():
+  """Qwen2.5-style checkpoints state sliding_window=131072 but gate it with
+  use_sliding_window=false (every released card) — they must stay
+  global-attention AND keep the Pallas fast path (uses_sliding_window is
+  what the engine's kernel gate consults)."""
+  from xotorch_tpu.models.config import config_from_hf_dict
+
+  base = {"model_type": "qwen2", "vocab_size": 128, "hidden_size": 64,
+          "num_hidden_layers": 2, "num_attention_heads": 2,
+          "intermediate_size": 128, "sliding_window": 131072}
+  gated = config_from_hf_dict({**base, "use_sliding_window": False})
+  assert not gated.uses_sliding_window and gated.layer_window(0) == 0
+  on = config_from_hf_dict({**base, "use_sliding_window": True})
+  assert on.uses_sliding_window and on.layer_window(0) == 131072
+  # Absent flag: the stated window applies (original-mistral semantics).
+  assert config_from_hf_dict(base).uses_sliding_window
+
+
+def test_mistral_sliding_window_all_layers(tmp_path):
+  """Original-mistral semantics: when the checkpoint states sliding_window,
+  EVERY layer windows (no alternation). window=4 against a 10-token prompt
+  diverges hard from global attention, so this fails if the mask is dropped."""
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import load_model_config
+  from xotorch_tpu.models.transformer import forward_shard, init_kv_cache
+  from xotorch_tpu.models.weights import load_shard_params
+
+  hf_cfg = _tiny_cfg("mistral", "MistralForCausalLM", head_dim=32, sliding_window=4)
+  model_dir = make_hf_checkpoint(tmp_path, hf_cfg, seed=4)
+  cfg = load_model_config(model_dir)
+  assert [cfg.layer_window(i) for i in range(3)] == [4, 4, 4]
+  n = cfg.num_layers
+  params = load_shard_params(model_dir, cfg, Shard("m", 0, n - 1, n), dtype=jnp.float32)
+
+  tokens = np.array([[1, 5, 9, 200, 17, 3, 42, 77, 123, 250]], dtype=np.int32)
+  cache = init_kv_cache(cfg, n, 1, 32, jnp.float32)
+  got, _ = forward_shard(params, jnp.asarray(tokens), cache, jnp.int32(0), cfg, True, True)
+  np.testing.assert_allclose(np.asarray(got), hf_logits(model_dir, tokens),
+                             atol=2e-4, rtol=2e-3)

@@ -25,7 +25,7 @@ class RopeScaling:
 
 @dataclass(frozen=True)
 class ModelConfig:
-  model_family: str  # llama | qwen2 | qwen3 | mistral | phi3 | generic
+  model_family: str  # llama | qwen2 | qwen3 | mistral | phi3 | gemma2 | generic
   vocab_size: int
   hidden_size: int
   num_layers: int
@@ -40,6 +40,20 @@ class ModelConfig:
   tie_word_embeddings: bool = False
   attention_bias: bool = False  # qwen2-style q/k/v bias
   qk_norm: bool = False  # qwen3-style per-head RMSNorm on q/k
+  # Gemma-family architecture knobs (all inert at their defaults, so every
+  # other family's compiled graph is unchanged):
+  hidden_act: str = "silu"  # MLP gate activation ("gelu_pytorch_tanh" = gemma)
+  norm_offset: bool = False  # RMSNorm multiplies by (1 + w) (zero-centred w)
+  scale_embedding: bool = False  # embeddings scaled by sqrt(hidden_size)
+  sandwich_norms: bool = False  # gemma2 post-attn / pre+post-ffn norms
+  attn_logit_softcap: float = 0.0  # tanh soft-cap on attention scores
+  final_logit_softcap: float = 0.0  # tanh soft-cap on lm-head logits
+  query_pre_attn_scalar: float = 0.0  # attention scale = this**-0.5 (0 -> head_dim)
+  # Sliding-window attention. 0 = global everywhere. Which layers slide comes
+  # from HF `layer_types` when the checkpoint states it, else the family rule
+  # (mistral: every layer; gemma2: even layers).
+  sliding_window: int = 0
+  layer_types: Optional[Tuple[str, ...]] = None
   # MoE (0 experts = dense). The reference shipped only dead MoE stubs
   # (llm_utils.py:502-590); here MoE is a first-class config.
   num_experts: int = 0
@@ -58,6 +72,25 @@ class ModelConfig:
   @property
   def is_moe(self) -> bool:
     return self.num_experts > 0
+
+  def layer_window(self, layer_idx: int) -> int:
+    """Sliding-window size for an ABSOLUTE layer index (0 = global
+    attention). HF `layer_types` wins when present; otherwise gemma2
+    alternates (even layers slide, transformers Gemma2Config) and every
+    other windowed family slides everywhere (mistral semantics)."""
+    if self.sliding_window <= 0:
+      return 0
+    if self.layer_types is not None:
+      kind = self.layer_types[layer_idx % len(self.layer_types)]
+      return self.sliding_window if kind == "sliding_attention" else 0
+    if self.model_family == "gemma2":
+      return self.sliding_window if layer_idx % 2 == 0 else 0
+    return self.sliding_window
+
+  @property
+  def uses_sliding_window(self) -> bool:
+    return self.sliding_window > 0 and any(
+      self.layer_window(i) > 0 for i in range(self.num_layers))
 
   @property
   def is_multimodal(self) -> bool:
@@ -92,7 +125,9 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
     "qwen3": "qwen3",
     "qwen3_moe": "qwen3",
     "phi3": "phi3",
+    "gemma2": "gemma2",
   }.get(model_type, "generic")
+  is_gemma = family == "gemma2"
 
   num_heads = int(cfg.get("num_attention_heads", 32))
   hidden = int(cfg.get("hidden_size", 4096))
@@ -115,6 +150,20 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
   else:
     eos = tuple(int(e) for e in eos)
 
+  # Sliding windows: gemma2 always windows (HF Gemma2Config defaults to
+  # 4096); mistral only when the checkpoint says so (v0.3+/nemo set null).
+  # Qwen2.5-style checkpoints state a sliding_window but gate it behind
+  # use_sliding_window (false on every released card) — honouring the gate
+  # keeps those families global-attention AND on the Pallas fast path.
+  sliding = cfg.get("sliding_window")
+  if cfg.get("use_sliding_window") is False:
+    sliding = 0
+  if sliding is None and is_gemma:
+    sliding = 4096
+  layer_types = cfg.get("layer_types")
+  if layer_types is not None:
+    layer_types = tuple(str(k) for k in layer_types)
+
   return ModelConfig(
     model_family=family,
     vocab_size=int(cfg.get("vocab_size", 32000)),
@@ -128,9 +177,19 @@ def config_from_hf_dict(cfg: dict) -> ModelConfig:
     rope_theta=float(cfg.get("rope_theta", 10000.0)),
     rope_scaling=rope_scaling,
     max_seq_len=int(cfg.get("max_position_embeddings", 8192)),
-    tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+    tie_word_embeddings=bool(cfg.get("tie_word_embeddings", is_gemma)),
     attention_bias=bool(cfg.get("attention_bias", model_type == "qwen2")),
     qk_norm=model_type in ("qwen3", "qwen3_moe"),
+    hidden_act=str(cfg.get("hidden_activation") or cfg.get("hidden_act")
+                   or ("gelu_pytorch_tanh" if is_gemma else "silu")),
+    norm_offset=is_gemma,
+    scale_embedding=is_gemma,
+    sandwich_norms=is_gemma,
+    attn_logit_softcap=float(cfg.get("attn_logit_softcapping") or 0.0),
+    final_logit_softcap=float(cfg.get("final_logit_softcapping") or 0.0),
+    query_pre_attn_scalar=float(cfg.get("query_pre_attn_scalar") or 0.0),
+    sliding_window=int(sliding or 0),
+    layer_types=layer_types,
     num_experts=int(cfg.get("num_experts", cfg.get("num_local_experts", 0)) or 0),
     num_experts_per_tok=int(cfg.get("num_experts_per_tok", 0) or 0),
     moe_intermediate_size=int(cfg.get("moe_intermediate_size", 0) or 0),

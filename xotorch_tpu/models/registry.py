@@ -56,6 +56,11 @@ model_cards: Dict[str, Dict] = {
   "qwen-3-30b-a3b": {"layers": 48, "repo": {JAX: "Qwen/Qwen3-30B-A3B"}, "moe": True},
   ### vision
   "llava-1.5-7b-hf": {"layers": 32, "repo": {JAX: "llava-hf/llava-1.5-7b-hf"}, "vision": True},
+  ### gemma 2 (sandwich norms, alternating sliding window, soft-capped
+  ### logits — models.py:206-207 ships 9b/27b; 2b added for small hosts)
+  "gemma2-2b": {"layers": 26, "repo": {JAX: "google/gemma-2-2b-it"}},
+  "gemma2-9b": {"layers": 42, "repo": {JAX: "google/gemma-2-9b-it"}},
+  "gemma2-27b": {"layers": 46, "repo": {JAX: "google/gemma-2-27b-it"}},
   ### nemotron
   "nemotron-70b": {"layers": 80, "repo": {JAX: "nvidia/Llama-3.1-Nemotron-70B-Instruct-HF"}},
   ### phi
@@ -109,6 +114,9 @@ pretty_names: Dict[str, str] = {
   "llama-3.2-1b": "Llama 3.2 1B",
   "llama-3.1-8b": "Llama 3.1 8B",
   "qwen-3-30b-a3b": "Qwen 3 30B A3B (MoE)",
+  "gemma2-2b": "Gemma2 2B",
+  "gemma2-9b": "Gemma2 9B",
+  "gemma2-27b": "Gemma2 27B",
 }
 
 

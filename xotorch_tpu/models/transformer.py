@@ -82,10 +82,22 @@ def _moe_einsum(layer: Params, slot: str, eq: str, h: jnp.ndarray) -> jnp.ndarra
   return out * scale.astype(h.dtype)[:, None, None, :]
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float,
+             offset: bool = False) -> jnp.ndarray:
+  """offset=True is the gemma convention: weights are stored zero-centred
+  and the norm multiplies by (1 + w), all in fp32 (HF GemmaRMSNorm)."""
   x32 = x.astype(jnp.float32)
   norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-  return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+  w32 = weight.astype(jnp.float32)
+  if offset:
+    w32 = 1.0 + w32
+  return (norm * w32).astype(x.dtype)
+
+
+def _mlp_act(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+  if cfg.hidden_act == "gelu_pytorch_tanh":
+    return jax.nn.gelu(x, approximate=True)
+  return jax.nn.silu(x)
 
 
 def init_kv_cache(cfg: ModelConfig, num_layers: int, batch: int, max_seq: int, dtype=jnp.bfloat16,
@@ -152,9 +164,10 @@ def _attention_block(
   positions: jnp.ndarray, kv_valid_len: jnp.ndarray, start_pos: jnp.ndarray,
   cfg: ModelConfig, inv_freq: jnp.ndarray, use_flash: bool = False,
   ring_mesh=None, use_flash_decode: bool = False,
+  window: Optional[jnp.ndarray] = None,  # per-layer scalar, 0 = global
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   B, T, H = x.shape
-  h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+  h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
   q = _maybe_lora(layer, "wq", h, _linear(layer, "wq", h))
   k = _maybe_lora(layer, "wk", h, _linear(layer, "wk", h))
   v = _maybe_lora(layer, "wv", h, _linear(layer, "wv", h))
@@ -166,12 +179,16 @@ def _attention_block(
   k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
   v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
   if cfg.qk_norm:
-    q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
-    k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+    q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+    k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps, cfg.norm_offset)
   q = apply_rope(q, positions, inv_freq)
   k = apply_rope(k, positions, inv_freq)
   layer_cache = _cache_write(layer_cache, k, v, start_pos)
   kv_quant = "k_scale" in layer_cache
+  if (window is not None or cfg.attn_logit_softcap) and (use_flash or use_flash_decode or ring_mesh is not None):
+    raise ValueError(
+      "sliding-window / attn-softcap configs (gemma2, windowed mistral) take "
+      "the XLA attention path — the engine gates the Pallas kernels off for them")
   if use_flash:
     # Prefill-from-zero fast path (engine guarantees start_pos == 0): the
     # fresh segment IS the whole visible context, and relative == absolute
@@ -203,14 +220,19 @@ def _attention_block(
     attn = ring_attention_sharded(q, k, v, ring_mesh)
   else:
     k_all, v_all = _cache_read(layer_cache, q.dtype)
-    attn = gqa_attention(q, k_all, v_all, positions, kv_valid_len)
+    attn = gqa_attention(q, k_all, v_all, positions, kv_valid_len,
+                         scale=(cfg.query_pre_attn_scalar ** -0.5
+                                if cfg.query_pre_attn_scalar else None),
+                         softcap=cfg.attn_logit_softcap, window=window)
   attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
   out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
+  if cfg.sandwich_norms:
+    out = rms_norm(out, layer["post_attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
   return out, layer_cache
 
 
-def _dense_mlp(layer: Params, h: jnp.ndarray) -> jnp.ndarray:
-  gate = jax.nn.silu(_maybe_lora(layer, "w_gate", h, _linear(layer, "w_gate", h)))
+def _dense_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+  gate = _mlp_act(cfg, _maybe_lora(layer, "w_gate", h, _linear(layer, "w_gate", h)))
   up = gate * _maybe_lora(layer, "w_up", h, _linear(layer, "w_up", h))
   return _maybe_lora(layer, "w_down", up, _linear(layer, "w_down", up))
 
@@ -245,6 +267,7 @@ def forward_shard(
   use_flash: bool = False,
   ring_mesh=None,
   use_flash_decode: bool = False,
+  start_layer: int = 0,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
 
@@ -255,6 +278,11 @@ def forward_shard(
   Pallas cached-attention kernel (ops/flash_decode.py), valid for decode
   steps (T == 1) and pos>0 chunked-prefill segments (T > 1) — the engine
   picks the right executable per call.
+
+  start_layer (static): ABSOLUTE index of this shard's first layer — only
+  consulted by sliding-window families, where which layers slide is a
+  property of the absolute layer index (gemma2 alternates), so a mid-ring
+  shard must know where it sits.
   """
   if is_first:
     emb = params["embed"]["embedding"]
@@ -266,6 +294,10 @@ def forward_shard(
       # (models/quantize.py) — compute dtype comes from the scale.
       h = (jnp.take(emb, x, axis=0).astype(row_scale.dtype)
            * jnp.take(row_scale, x, axis=0)[..., None])
+    if cfg.scale_embedding:
+      # Gemma normalises embeddings by sqrt(hidden); HF rounds the
+      # normaliser to the compute dtype first — match that exactly.
+      h = h * jnp.asarray(cfg.hidden_size ** 0.5, h.dtype)
   else:
     h = x
   B, T = h.shape[0], h.shape[1]
@@ -279,21 +311,38 @@ def forward_shard(
     kv_valid_len = start_pos.astype(jnp.int32) + T
   inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
 
+  # Per-layer sliding windows ride the scan as one more xs leaf ([L] int32,
+  # 0 = global) — the scan still compiles ONE layer body; the window is a
+  # traced scalar inside it, so alternating gemma2 layers share the graph.
+  L = jax.tree.leaves(params["layers"])[0].shape[0]
+  windows = None
+  if cfg.uses_sliding_window:
+    import numpy as _np
+    windows = jnp.asarray(
+      _np.array([cfg.layer_window(start_layer + i) for i in range(L)], _np.int32))
+
   def layer_body(h, xs):
-    layer, layer_cache = xs
+    if windows is None:
+      layer, layer_cache = xs
+      window = None
+    else:
+      layer, layer_cache, window = xs
     attn_out, layer_cache = _attention_block(
       layer, h, layer_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
-      ring_mesh, use_flash_decode,
+      ring_mesh, use_flash_decode, window=window,
     )
     h = h + attn_out
-    mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-    mlp_out = _moe_mlp(layer, mlp_in, cfg) if cfg.is_moe else _dense_mlp(layer, mlp_in)
+    mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+    mlp_out = _moe_mlp(layer, mlp_in, cfg) if cfg.is_moe else _dense_mlp(layer, mlp_in, cfg)
+    if cfg.sandwich_norms:
+      mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     return h + mlp_out, layer_cache
 
   # The cache dict rides the scan as a pytree: each leaf's leading L axis is
   # sliced per layer, so int8 caches (extra scale leaves) need no special
   # casing anywhere downstream.
-  h, new_cache = jax.lax.scan(layer_body, h, (params["layers"], cache))
+  xs = (params["layers"], cache) if windows is None else (params["layers"], cache, windows)
+  h, new_cache = jax.lax.scan(layer_body, h, xs)
 
   if not is_last:
     return h, new_cache
@@ -304,7 +353,7 @@ def unembed(params: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
   """Final norm + (tied-embedding or lm_head) unembedding -> fp32 logits.
   The single source of truth shared by forward_shard and the fused sampling
   path (models/generate.forward_sample)."""
-  h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+  h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_offset)
   if cfg.tie_word_embeddings and "lm_head" not in params:
     emb = params["embed"]["embedding"]
     row_scale = params["embed"].get("embedding_scale")
@@ -319,7 +368,11 @@ def unembed(params: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
       logits = h @ params["lm_head"]
     else:
       logits = (h @ params["lm_head"].astype(h.dtype)) * head_scale.astype(h.dtype)[None, None, :]
-  return logits.astype(jnp.float32)
+  logits = logits.astype(jnp.float32)
+  if cfg.final_logit_softcap:
+    cap = jnp.float32(cfg.final_logit_softcap)
+    logits = jnp.tanh(logits / cap) * cap
+  return logits
 
 
 def init_random_params(
@@ -344,14 +397,18 @@ def init_random_params(
   def layer_params(abs_idx: int) -> Params:
     def lk(slot: int):
       return jax.random.fold_in(jax.random.fold_in(key, abs_idx), slot)
+    norm_init = jnp.zeros if cfg.norm_offset else jnp.ones
     p: Params = {
-      "attn_norm": jnp.ones((H,), dtype),
-      "mlp_norm": jnp.ones((H,), dtype),
+      "attn_norm": norm_init((H,), dtype),
+      "mlp_norm": norm_init((H,), dtype),
       "wq": rnd(lk(0), H, cfg.num_heads * D),
       "wk": rnd(lk(1), H, cfg.num_kv_heads * D),
       "wv": rnd(lk(2), H, cfg.num_kv_heads * D),
       "wo": rnd(lk(3), cfg.num_heads * D, H),
     }
+    if cfg.sandwich_norms:
+      p["post_attn_norm"] = norm_init((H,), dtype)
+      p["post_mlp_norm"] = norm_init((H,), dtype)
     if cfg.attention_bias:
       p["bq"] = jnp.zeros((cfg.num_heads * D,), dtype)
       p["bk"] = jnp.zeros((cfg.num_kv_heads * D,), dtype)
@@ -378,7 +435,7 @@ def init_random_params(
   if is_first or cfg.tie_word_embeddings:
     params["embed"] = {"embedding": rnd(embed_key, cfg.vocab_size, H)}
   if is_last:
-    params["final_norm"] = jnp.ones((H,), dtype)
+    params["final_norm"] = (jnp.zeros if cfg.norm_offset else jnp.ones)((H,), dtype)
     if not cfg.tie_word_embeddings:
       params["lm_head"] = rnd(jax.random.fold_in(key, 1_000_001), H, cfg.vocab_size)
   return params

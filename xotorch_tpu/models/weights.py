@@ -162,14 +162,23 @@ def load_shard_params(
   def stack(fn) -> jnp.ndarray:
     return jnp.stack([fn(i) for i in layer_ids])
 
+  # In llama-lineage checkpoints post_attention_layernorm IS the pre-MLP
+  # norm; gemma2's sandwich layout instead names the pre-MLP norm
+  # pre_feedforward_layernorm and adds two post-norms.
+  pre_mlp = "pre_feedforward_layernorm" if cfg.sandwich_norms else "post_attention_layernorm"
   layers: Dict[str, jnp.ndarray] = {
     "attn_norm": stack(lambda i: t[f"layers.{i}.input_layernorm.weight"].astype(dtype)),
-    "mlp_norm": stack(lambda i: t[f"layers.{i}.post_attention_layernorm.weight"].astype(dtype)),
+    "mlp_norm": stack(lambda i: t[f"layers.{i}.{pre_mlp}.weight"].astype(dtype)),
     "wq": stack(lambda i: linear(f"layers.{i}.self_attn.q_proj.weight")),
     "wk": stack(lambda i: linear(f"layers.{i}.self_attn.k_proj.weight")),
     "wv": stack(lambda i: linear(f"layers.{i}.self_attn.v_proj.weight")),
     "wo": stack(lambda i: linear(f"layers.{i}.self_attn.o_proj.weight")),
   }
+  if cfg.sandwich_norms:
+    layers["post_attn_norm"] = stack(
+      lambda i: t[f"layers.{i}.post_attention_layernorm.weight"].astype(dtype))
+    layers["post_mlp_norm"] = stack(
+      lambda i: t[f"layers.{i}.post_feedforward_layernorm.weight"].astype(dtype))
   if cfg.attention_bias and get(f"layers.{shard.start_layer}.self_attn.q_proj.bias") is not None:
     layers["bq"] = stack(lambda i: t[f"layers.{i}.self_attn.q_proj.bias"].astype(dtype))
     layers["bk"] = stack(lambda i: t[f"layers.{i}.self_attn.k_proj.bias"].astype(dtype))
@@ -235,7 +244,12 @@ def save_shard_params(params: Dict[str, Any], cfg: ModelConfig, shard: Shard, ou
   for idx, i in enumerate(range(shard.start_layer, shard.end_layer + 1)):
     prefix = f"model.layers.{i}."
     flat[prefix + "input_layernorm.weight"] = layers["attn_norm"][idx]
-    flat[prefix + "post_attention_layernorm.weight"] = layers["mlp_norm"][idx]
+    if "post_attn_norm" in layers:  # gemma2 sandwich layout (see load side)
+      flat[prefix + "pre_feedforward_layernorm.weight"] = layers["mlp_norm"][idx]
+      flat[prefix + "post_attention_layernorm.weight"] = layers["post_attn_norm"][idx]
+      flat[prefix + "post_feedforward_layernorm.weight"] = layers["post_mlp_norm"][idx]
+    else:
+      flat[prefix + "post_attention_layernorm.weight"] = layers["mlp_norm"][idx]
     put_linear(prefix + "self_attn.q_proj.weight", layers["wq"][idx])
     put_linear(prefix + "self_attn.k_proj.weight", layers["wk"][idx])
     put_linear(prefix + "self_attn.v_proj.weight", layers["wv"][idx])

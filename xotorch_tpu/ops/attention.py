@@ -21,12 +21,17 @@ def gqa_attention(
   v: jnp.ndarray,  # [B, S, Hkv, D]
   q_positions: jnp.ndarray,  # [B, T] int32 absolute positions of the queries
   kv_valid_len: Optional[jnp.ndarray] = None,  # [B] int32: entries >= this are invalid
+  scale: Optional[float] = None,  # score scale; None -> D**-0.5
+  softcap: float = 0.0,  # gemma2 tanh soft-cap on scores (0 = off)
+  window: Optional[jnp.ndarray] = None,  # scalar int32 sliding window (0 = global)
 ) -> jnp.ndarray:
   """Grouped-query causal attention. Returns [B, T, Hq, D].
 
   Causality: key position s is visible to query position p iff s <= p.
   A static-size cache buffer is always passed; positions beyond the written
   region are masked by s <= p (decode) and optionally kv_valid_len (batch).
+  With a sliding `window` w (traced scalar; one executable serves gemma2's
+  alternating layers), visibility further requires s > p - w.
   """
   B, T, Hq, D = q.shape
   S, Hkv = k.shape[1], k.shape[2]
@@ -34,12 +39,19 @@ def gqa_attention(
 
   q_ = q.reshape(B, T, Hkv, groups, D)
   scores = jnp.einsum("btkgd,bskd->bkgts", q_, k, preferred_element_type=jnp.float32)
-  scores = scores / jnp.sqrt(jnp.float32(D))
+  scores = scores * jnp.float32(scale if scale is not None else D ** -0.5)
+  if softcap:
+    cap = jnp.float32(softcap)
+    scores = jnp.tanh(scores / cap) * cap
 
   kv_pos = jnp.arange(S, dtype=jnp.int32)
   visible = kv_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
   if kv_valid_len is not None:
     visible = visible & (kv_pos[None, None, :] < kv_valid_len[:, None, None])
+  if window is not None:
+    w = jnp.asarray(window, jnp.int32)
+    in_window = kv_pos[None, None, :] > q_positions[:, :, None] - w
+    visible = visible & ((w <= 0) | in_window)
   scores = jnp.where(visible[:, None, None, :, :], scores, jnp.float32(-1e30))
 
   probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))

@@ -67,6 +67,7 @@ def spec_for_param(name: str, ndim: Optional[int] = None):
 
   rules = {
     "attn_norm": P(None, None), "mlp_norm": P(None, None),
+    "post_attn_norm": P(None, None), "post_mlp_norm": P(None, None),
     "wq": P(None, None, "tp"), "wk": P(None, None, "tp"), "wv": P(None, None, "tp"),
     "wo": P(None, "tp", None),
     "w_gate": P(None, None, "tp"), "w_up": P(None, None, "tp"), "w_down": P(None, "tp", None),

@@ -127,7 +127,8 @@ def make_eval_step(cfg: ModelConfig, loss_fn: Optional[Callable] = None, ring_me
 
 
 def shard_loss_and_grads(
-  params, cfg: ModelConfig, x: jnp.ndarray, back_grad_or_targets, lengths, is_first: bool, is_last: bool
+  params, cfg: ModelConfig, x: jnp.ndarray, back_grad_or_targets, lengths, is_first: bool, is_last: bool,
+  start_layer: int = 0,
 ):
   """Pipelined training over the ring (parity with the reference's
   forward-activation / backward-gradient chaining, node.py:299-345 +
@@ -143,7 +144,8 @@ def shard_loss_and_grads(
   cache = init_kv_cache(cfg, params["layers"]["attn_norm"].shape[0], B, T, jnp.float32)
 
   def fwd(p, xin):
-    out, _ = forward_shard(p, xin, cache, jnp.int32(0), cfg, is_first, is_last)
+    out, _ = forward_shard(p, xin, cache, jnp.int32(0), cfg, is_first, is_last,
+                           start_layer=start_layer)
     return out
 
   # Token inputs (first shard) are not differentiable; close over x there.
