@@ -1,0 +1,85 @@
+"""Gemma2 at the ENGINE seam: ring splits and kernel gating.
+
+The model-level oracle (test_model_equivalence) proves the math; these tests
+prove the serving machinery handles gemma2's two sharp edges:
+
+- a mid-ring shard must window by ABSOLUTE layer index (gemma2 alternates
+  sliding/global per layer, so a shard starting at an odd layer that counted
+  from zero would window the wrong layers);
+- the Pallas flash/decode kernels implement neither the window lower bound
+  nor the tanh soft-cap, so the engine must route gemma2 down the XLA path
+  even when the kernels are force-enabled by env.
+
+Reference parity: gemma2 cards models.py:206-207 served through the same
+engine as every other family (sharded_inference_engine.py).
+"""
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_GEMMA2_CFG, hf_logits, make_hf_checkpoint
+
+N = TINY_GEMMA2_CFG["num_hidden_layers"]
+
+
+@pytest.fixture()
+def gemma_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_GEMMA2_CFG, seed=7)
+
+
+def _engine(model_dir):
+  return JAXShardInferenceEngine(LocalShardDownloader({"g": model_dir}), dtype="float32")
+
+
+async def test_gemma2_split_ring_windows_by_absolute_layer(gemma_dir):
+  """Split so the second shard STARTS AT AN ODD LAYER (its layers are abs
+  1..2: global, sliding). If start_layer were not threaded, the shard would
+  window layers (0-relative: sliding, global) — swapped — and diverge from
+  both the full engine and HF. Prompt is 3x the window so the mask bites."""
+  full = _engine(gemma_dir)
+  first = _engine(gemma_dir)
+  second = _engine(gemma_dir)
+
+  tokens = np.array([[2, 7, 11, 40, 3, 99, 150, 23, 8, 61, 5, 17]], dtype=np.int64)
+  out_full, _ = await full.infer_tensor("r", Shard("g", 0, N - 1, N), tokens)
+
+  hidden, state = await first.infer_tensor("r", Shard("g", 0, 0, N), tokens)
+  out_split, _ = await second.infer_tensor("r", Shard("g", 1, N - 1, N), hidden, state)
+  np.testing.assert_allclose(out_split, out_full, atol=1e-4, rtol=1e-3)
+
+  expected = hf_logits(gemma_dir, tokens.astype(np.int32))
+  np.testing.assert_allclose(out_full, expected, atol=2e-4, rtol=2e-3)
+
+
+async def test_gemma2_kernel_gates_hold_under_env_force(gemma_dir, monkeypatch):
+  """Force every Pallas kernel on by env; gemma2 must still serve correct
+  tokens (the engine's _pallas_kernels_ok gate routes it down the XLA path —
+  if the gate broke, transformer.forward_shard raises at trace time)."""
+  monkeypatch.setenv("XOT_FLASH_ATTENTION", "1")
+  monkeypatch.setenv("XOT_FLASH_DECODE", "1")
+  monkeypatch.setenv("XOT_FLASH_DECODE_MIN", "1")
+
+  shard = Shard("g", 0, N - 1, N)
+  prompt = np.array([[2, 7, 11, 40, 3, 99, 150, 23]], dtype=np.int64)
+  steps = 6
+
+  # Host-path greedy reference (same gated engine class, plain infer_tensor).
+  ref = _engine(gemma_dir)
+  logits, _ = await ref.infer_tensor("a", shard, prompt)
+  tok = int(np.argmax(logits[0, -1]))
+  host_toks = [tok]
+  for _ in range(steps - 1):
+    logits, _ = await ref.infer_tensor("a", shard, np.array([[tok]], dtype=np.int64))
+    tok = int(np.argmax(logits[0, -1]))
+    host_toks.append(tok)
+
+  # Fused on-device sampling + scan-fused chunks under forced-kernel env.
+  eng = _engine(gemma_dir)
+  tok_b, _ = await eng.infer_sample_tensor("b", shard, prompt, temp=0.0, top_k=0)
+  fused = [int(tok_b)]
+  out = await eng.generate_chunk("b", shard, fused[-1], steps - 1, temp=0.0)
+  fused.extend(int(t) for t in out)
+  assert fused == host_toks

@@ -74,6 +74,12 @@ class _RequestState:
   cache: Any  # device pytree {"k","v"}
   pos: int  # tokens already resident in this shard's cache
   last_used: float
+  # OpenAI sampling extras (seed / logit_bias / presence+frequency penalties):
+  # {"seed": int|None, "bias": [1,V] device array|None, "counts": [1,V] int32
+  #  device array|None, "presence": float, "frequency": float}. None = plain
+  # request — extras requests decode in their own fused chunk (never batched),
+  # so the common path's executables and batcher grouping are untouched.
+  extras: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -298,6 +304,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax.numpy as jnp
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[self._dtype_name]
 
+  def _pallas_kernels_ok(self, cfg: ModelConfig) -> bool:
+    """Sliding-window / attn-softcap families (gemma2, windowed mistral)
+    take the XLA attention path — the Pallas kernels implement neither the
+    window lower bound nor the tanh cap (transformer.py raises if they are
+    ever combined)."""
+    return not (cfg.uses_sliding_window or cfg.attn_logit_softcap)
+
   def _flash_enabled(self) -> bool:
     """XOT_FLASH_ATTENTION: 1 = force on (interpret mode off-TPU), 0 = off,
     unset = on when running on real TPU."""
@@ -489,8 +502,9 @@ class JAXShardInferenceEngine(InferenceEngine):
     if bucket != true_t:
       pad = [(0, 0), (0, bucket - true_t)] + [(0, 0)] * (x.ndim - 2)
       x = jnp.pad(x, pad)
-    use_flash = true_t > 1 and state.pos == 0 and self._flash_enabled()
-    use_fd = (not use_flash) and self._flash_decode_on(state.cache["k"].shape[2])
+    kernels_ok = self._pallas_kernels_ok(ctx.cfg)
+    use_flash = true_t > 1 and state.pos == 0 and kernels_ok and self._flash_enabled()
+    use_fd = (not use_flash) and kernels_ok and self._flash_decode_on(state.cache["k"].shape[2])
     return x, true_t, state, use_flash, use_fd
 
   def _forward_segment(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
@@ -541,20 +555,86 @@ class JAXShardInferenceEngine(InferenceEngine):
     self, request_id: str, shard: Shard, input_data: np.ndarray,
     temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
     inference_state: Optional[dict] = None, top_p: float = 0.0,
+    sampling: Optional[dict] = None,
   ) -> Tuple[int, Optional[dict]]:
     """Last-shard forward + ON-DEVICE sampling (models/generate.forward_sample):
     the host receives one int, not [B, T, vocab] fp32 logits. This is the
     ring's last-layer hot path (VERDICT r1 weak #3 — the reference pulls
-    ~0.5 MB of logits to the host per token, node.py:109-147)."""
+    ~0.5 MB of logits to the host per token, node.py:109-147).
+
+    `sampling`: OpenAI extras {seed, logit_bias, presence_penalty,
+    frequency_penalty} — applied on device (sampling.py); penalty counts
+    start at zero and accumulate per SAMPLED token (OpenAI's formula —
+    prompt tokens carry no penalty)."""
     ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer:
       raise ValueError(f"infer_sample_tensor requires the last-layer shard, got {shard}")
     tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data, float(temp),
-                          int(top_k), float(top_p))
+                          int(top_k), float(top_p), sampling)
     return tok, inference_state
 
+  def _build_extras(self, ctx: _ShardContext, sampling: dict) -> Dict[str, Any]:
+    """Materialise a request's sampling extras on device: a dense [1, V]
+    bias vector from the sparse logit_bias dict, and (when penalties are
+    set) a [1, V] count vector starting at ZERO — OpenAI's published
+    penalty formula counts how often a token was SAMPLED prior to the
+    current position, so prompt tokens carry no penalty (vLLM/TGI
+    implement the same rule; repetition-penalty-style prompt inclusion is
+    a different knob)."""
+    import jax.numpy as jnp
+    V = ctx.cfg.vocab_size
+    extras: Dict[str, Any] = {
+      "seed": sampling.get("seed"),
+      "presence": float(sampling.get("presence_penalty") or 0.0),
+      "frequency": float(sampling.get("frequency_penalty") or 0.0),
+      "bias": None, "counts": None,
+    }
+    lb = sampling.get("logit_bias")
+    if lb:
+      # Ids past the model's vocab are DROPPED (never wrapped — a modulo
+      # would silently bias an unrelated token); the API already rejected
+      # negatives and non-integers.
+      pairs = [(int(t), float(v)) for t, v in lb.items() if 0 <= int(t) < V]
+      if pairs:
+        ids = np.asarray([p[0] for p in pairs], np.int32)
+        vals = np.asarray([p[1] for p in pairs], np.float32)
+        extras["bias"] = jnp.zeros((1, V), jnp.float32).at[0, ids].add(vals)
+    if extras["presence"] or extras["frequency"]:
+      extras["counts"] = jnp.zeros((1, V), jnp.int32)
+    return extras
+
+  def _extras_key(self, state: "_RequestState", extras: Optional[Dict[str, Any]],
+                  request_id: str = "", sample_pos: Optional[int] = None):
+    """Seeded requests derive their PRNG stream from (seed, position, choice
+    index) so the same request replayed reproduces its tokens (OpenAI `seed`
+    best-effort determinism) while the n>1 sibling sub-requests ("rid#0",
+    "rid#1", ... — chatgpt_api request fan-out) still draw DISTINCT streams
+    instead of n identical completions; unseeded requests keep the
+    engine-global stream.
+
+    `sample_pos` is the ABSOLUTE position of the token being sampled — NOT
+    chunk-start state.pos, which a prefix-cache hit shifts (a warm replay
+    prefills only the uncached suffix, so folding chunk-start pos would give
+    the cold and warm runs different streams for the same seed)."""
+    import jax
+    if extras and extras.get("seed") is not None:
+      choice = 0
+      if "#" in request_id:
+        tail = request_id.rsplit("#", 1)[1]
+        # crc32, not hash(): PYTHONHASHSEED randomises hash() per process,
+        # which would break cross-run seed reproducibility for caller-chosen
+        # ids with a non-numeric '#'-suffix.
+        import zlib
+        choice = int(tail) if tail.isdigit() else zlib.crc32(tail.encode())
+      pos = state.pos if sample_pos is None else sample_pos
+      key = jax.random.fold_in(jax.random.PRNGKey(int(extras["seed"])), pos)
+      return jax.random.fold_in(key, choice)
+    self._sample_calls += 1
+    return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+
   def _infer_sample_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
-                         temp: float, top_k: int, top_p: float = 0.0) -> int:
+                         temp: float, top_k: int, top_p: float = 0.0,
+                         sampling: Optional[dict] = None) -> int:
     import jax
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import forward_sample
@@ -584,17 +664,27 @@ class JAXShardInferenceEngine(InferenceEngine):
       input_data = input_data[:, split:]
 
     x, seg_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
-    self._sample_calls += 1
-    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+    if sampling and state.extras is None:
+      state.extras = self._build_extras(ctx, sampling)
+    extras = state.extras
+    key = self._extras_key(state, extras, request_id=request_id,
+                           sample_pos=state.pos + seg_t - 1)
+    e = extras or {}
     tok, state.cache = forward_sample(
       ctx.params, x, state.cache, jnp.int32(state.pos), jnp.int32(seg_t - 1), key,
       ctx.cfg, x.ndim == 2, temp, top_k, top_p, use_flash=use_flash, use_flash_decode=use_fd,
+      start_layer=ctx.shard.start_layer,
+      bias=e.get("bias"), counts=e.get("counts"),
+      presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
     )
     state.pos += seg_t
     state.last_used = time.monotonic()
     if full_prompt is not None:
       self._prefix_store(ctx, request_id, full_prompt)
-    return int(np.asarray(tok).reshape(-1)[0])
+    tok_int = int(np.asarray(tok).reshape(-1)[0])
+    if extras and extras.get("counts") is not None:
+      extras["counts"] = extras["counts"].at[0, tok_int % ctx.cfg.vocab_size].add(1)
+    return tok_int
 
   # ---------------------------------------------------- speculative decode
 
@@ -782,7 +872,8 @@ class JAXShardInferenceEngine(InferenceEngine):
     if bucket != true_t:
       x = jnp.pad(x, [(0, 0), (0, bucket - true_t), (0, 0)])
     forward = ctx.forward_hidden_jit
-    if true_t > 1 and state.pos == 0 and self._flash_enabled():
+    if (true_t > 1 and state.pos == 0 and self._pallas_kernels_ok(ctx.cfg)
+        and self._flash_enabled()):
       forward = ctx.forward_hidden_flash_jit
     out, state.cache = forward(ctx.params, x.astype(self._dtype()), state.cache, jnp.int32(state.pos))
     state.pos += true_t
@@ -840,9 +931,12 @@ class JAXShardInferenceEngine(InferenceEngine):
       tail = ctx.max_cache_len - state.pos
       num_tokens = min(num_tokens, 1 << (tail.bit_length() - 1))
 
-    if self._decode_batch_max() > 1:
+    if self._decode_batch_max() > 1 and state.extras is None:
       # Continuous batching: coalesce with other requests' concurrent chunks
       # (a lone request flows through as a batch of one, same executable).
+      # Requests with sampling extras (seed/bias/penalties) skip the batcher
+      # and decode in their own fused chunk — correctness first, and the
+      # common path's executables stay free of [B, V] extras operands.
       if ctx.batcher is None:
         ctx.batcher = _DecodeBatcher(self, ctx)
       return await ctx.batcher.submit(request_id, state, prev_token, num_tokens,
@@ -878,20 +972,31 @@ class JAXShardInferenceEngine(InferenceEngine):
     for state in states:
       if state.pos + num_tokens > state.cache["k"].shape[2]:
         self._grow_cache(ctx, state, state.pos + num_tokens)
-    self._sample_calls += 1
-    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
-    use_fd = self._flash_decode_on(max(s.cache["k"].shape[2] for s in states))
+    use_fd = (self._pallas_kernels_ok(ctx.cfg)
+              and self._flash_decode_on(max(s.cache["k"].shape[2] for s in states)))
 
     if len(items) == 1:
       state = states[0]
+      extras = state.extras
+      key = self._extras_key(state, extras, request_id=items[0][0])
+      e = extras or {}
       tok = jnp.asarray([[items[0][2]]], dtype=jnp.int32)
-      toks, state.cache = decode_chunk(
+      out = decode_chunk(
         ctx.params, tok, state.cache, jnp.int32(state.pos), key,
         ctx.cfg, num_tokens, float(items[0][4]), top_k, top_p, use_flash_decode=use_fd,
+        bias=e.get("bias"), counts=e.get("counts"),
+        presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
       )
+      if e.get("counts") is not None:
+        toks, state.cache, extras["counts"] = out
+      else:
+        toks, state.cache = out
       state.pos += num_tokens
       state.last_used = time.monotonic()
       return [np.asarray(toks[0]).astype(np.int64)]
+
+    self._sample_calls += 1
+    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
 
     S_max = max(s.cache["k"].shape[2] for s in states)
 
@@ -1108,7 +1213,8 @@ class JAXShardInferenceEngine(InferenceEngine):
           print(f"LoRA adapters attached: rank={lora_rank}, targets={targets}")
 
       fwd = partial(
-        forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=shard.is_last_layer
+        forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=shard.is_last_layer,
+        start_layer=shard.start_layer,
       )
       forward_jit = jax.jit(fwd, donate_argnums=(2,))
       forward_flash_jit = jax.jit(partial(fwd, use_flash=True), donate_argnums=(2,))
@@ -1121,7 +1227,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       # these cost nothing unless a long prompt actually uses them.
       fill_jits = None
       if shard.is_last_layer:
-        fill_fwd = partial(forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=False)
+        fill_fwd = partial(forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=False,
+                           start_layer=shard.start_layer)
         fill_jits = {
           "base": jax.jit(fill_fwd, donate_argnums=(2,)),
           "flash": jax.jit(partial(fill_fwd, use_flash=True), donate_argnums=(2,)),
@@ -1133,7 +1240,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       forward_hidden_flash_jit = None
       vision = None
       if cfg.is_multimodal and shard.is_first_layer:
-        hidden_fwd = partial(forward_shard, cfg=cfg, is_first=False, is_last=shard.is_last_layer)
+        hidden_fwd = partial(forward_shard, cfg=cfg, is_first=False, is_last=shard.is_last_layer,
+                             start_layer=shard.start_layer)
         forward_hidden_jit = jax.jit(hidden_fwd, donate_argnums=(2,))
         # Image prompts are the longest fresh-context prefills (576 patches
         # per image on llava-1.5) — they deserve the Pallas flash path too.
@@ -1357,7 +1465,8 @@ class JAXShardInferenceEngine(InferenceEngine):
         tgt = jnp.asarray(np.asarray(target).astype(np.int32))
         lens = jnp.asarray(np.asarray(lengths).reshape(-1).astype(np.int32))
         loss, x_grad, param_grads = shard_loss_and_grads(
-          ctx.params, ctx.cfg, x, tgt, lens, shard.is_first_layer, True
+          ctx.params, ctx.cfg, x, tgt, lens, shard.is_first_layer, True,
+          start_layer=shard.start_layer,
         )
         # Updates apply to the float subtree only; a quantized base rides
         # through untouched (never copied, never zero-filled).
@@ -1383,7 +1492,7 @@ class JAXShardInferenceEngine(InferenceEngine):
 
       def fwd(p_fl, xin):
         return forward_shard(merge_trees(p_fl, nf), xin, cache, jnp.int32(0), ctx.cfg,
-                             shard.is_first_layer, False)[0]
+                             shard.is_first_layer, False, start_layer=shard.start_layer)[0]
 
       if shard.is_first_layer:
         out, vjp_fn = jax.vjp(lambda p: fwd(p, x), fl)
@@ -1430,7 +1539,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       B, T = x.shape[0], x.shape[1]
       cache = init_kv_cache(ctx.cfg, shard.get_layer_count(), B, T, jnp.float32)
       out = forward_shard(ctx.params, x, cache, jnp.int32(0), ctx.cfg,
-                          shard.is_first_layer, shard.is_last_layer)[0]
+                          shard.is_first_layer, shard.is_last_layer,
+                          start_layer=shard.start_layer)[0]
       if shard.is_last_layer:
         from xotorch_tpu.train.step import masked_ce_loss
         tgt = jnp.asarray(np.asarray(target).astype(np.int32))

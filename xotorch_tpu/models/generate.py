@@ -26,7 +26,8 @@ from xotorch_tpu.ops.sampling import sample_logits
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode"),
+  static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode",
+                   "start_layer"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -43,6 +44,11 @@ def forward_sample(
   top_p: float = 0.0,
   use_flash: bool = False,
   use_flash_decode: bool = False,
+  start_layer: int = 0,  # absolute first-layer index (sliding-window families)
+  bias: jnp.ndarray = None,  # [B, V] OpenAI logit_bias (presence static)
+  counts: jnp.ndarray = None,  # [B, V] token counts for penalties
+  presence: float = 0.0,
+  frequency: float = 0.0,
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
   ([B] int32 sampled token, updated cache).
@@ -56,10 +62,12 @@ def forward_sample(
     logits nobody reads.
   """
   h, cache = forward_shard(params, x, cache, start_pos, cfg=cfg, is_first=is_first,
-                           is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode)
+                           is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode,
+                           start_layer=start_layer)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
-  tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p)
+  tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p,
+                      bias=bias, counts=counts, presence=presence, frequency=frequency)
   return tok, cache
 
 
@@ -80,26 +88,44 @@ def decode_chunk(
   top_k: int,
   top_p: float = 0.0,
   use_flash_decode: bool = False,
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+  bias: jnp.ndarray = None,  # [B, V] OpenAI logit_bias
+  counts: jnp.ndarray = None,  # [B, V] token counts; updated INSIDE the scan
+  presence: float = 0.0,
+  frequency: float = 0.0,
+):
   """Generate `num_tokens` tokens in one device program.
 
   Requires the shard to span the whole model (is_first and is_last). Returns
-  ([B, num_tokens] int32 sampled tokens, updated cache). The incoming `tok`
-  is consumed (its forward step is the first scan iteration); the returned
-  tokens start at position start_pos + 1. `temp` is traced — a scalar or a
-  per-ROW [B] array (ops/sampling.sample_logits), so batched rows may carry
-  different request temperatures in one dispatch.
+  ([B, num_tokens] int32 sampled tokens, updated cache) — plus the updated
+  counts as a third element when `counts` is passed (penalty requests). The
+  incoming `tok` is consumed (its forward step is the first scan iteration);
+  the returned tokens start at position start_pos + 1. `temp` is traced — a
+  scalar or a per-ROW [B] array (ops/sampling.sample_logits), so batched
+  rows may carry different request temperatures in one dispatch. Counts ride
+  the scan carry: token i+1 inside the chunk sees token i's penalty — the
+  within-chunk feedback a host-side implementation would lose.
   """
+  track_counts = counts is not None
 
   def step(carry, _):
-    tok, cache, pos, key = carry
+    tok, cache, pos, key, counts = carry
     logits, cache = forward_shard(params, tok, cache, pos, cfg=cfg, is_first=True, is_last=True,
                                   use_flash_decode=use_flash_decode)
     key, sub = jax.random.split(key)
-    nxt = sample_logits(logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p)
-    return (nxt[:, None], cache, pos + 1, key), nxt
+    # counts=None (not the 0-d carry placeholder) when penalties are off:
+    # the None/array split is what keeps the [B, V] penalty subtractions out
+    # of the plain fused-decode executable entirely.
+    nxt = sample_logits(logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p,
+                        bias=bias, counts=counts if track_counts else None,
+                        presence=presence, frequency=frequency)
+    if track_counts:
+      rows = jnp.arange(counts.shape[0], dtype=jnp.int32)
+      counts = counts.at[rows, nxt].add(1)
+    return (nxt[:, None], cache, pos + 1, key, counts), nxt
 
-  (_, cache, _, _), toks = jax.lax.scan(
-    step, (tok.astype(jnp.int32), cache, start_pos.astype(jnp.int32), key), None, length=num_tokens
-  )
-  return toks.T, cache  # [B, num_tokens]
+  init = (tok.astype(jnp.int32), cache, start_pos.astype(jnp.int32), key,
+          counts if track_counts else jnp.zeros((), jnp.int32))
+  (_, cache, _, _, counts_out), toks = jax.lax.scan(step, init, None, length=num_tokens)
+  if track_counts:
+    return toks.T, cache, counts_out  # [B, num_tokens]
+  return toks.T, cache

@@ -23,6 +23,10 @@ def sample_logits(
   temp=DEFAULT_TEMP,  # python float, traced scalar, or per-ROW [B] array
   top_k: int = DEFAULT_TOP_K,
   top_p: float = 0.0,
+  bias: jnp.ndarray = None,  # [B, V] additive logit bias (OpenAI logit_bias)
+  counts: jnp.ndarray = None,  # [B, V] int32 token counts of the text so far
+  presence: float = 0.0,  # OpenAI presence_penalty (scalar or [B], traced)
+  frequency: float = 0.0,  # OpenAI frequency_penalty (scalar or [B], traced)
 ) -> jnp.ndarray:
   """Returns [B] int32 sampled token ids.
 
@@ -30,7 +34,21 @@ def sample_logits(
   continuous batching coalesce mixed-temperature requests into one dispatch
   (the batcher groups by (top_k, top_p), the remaining compile-time
   constants). Rows with temp == 0 resolve to greedy via a where — identical
-  to the static-greedy graph's output."""
+  to the static-greedy graph's output.
+
+  `bias`/`counts` presence is STATIC (None vs array selects the executable);
+  their values are traced. Penalties follow the OpenAI formula — logits
+  shift by -presence*(count>0) - frequency*count BEFORE temperature, so they
+  reshape greedy decoding too (the reference parsed these request fields and
+  dropped them, chatgpt_api.py)."""
+  if bias is not None:
+    logits = logits.astype(jnp.float32) + bias.astype(jnp.float32)
+  if counts is not None:
+    c = counts.astype(jnp.float32)
+    pres = jnp.broadcast_to(jnp.asarray(presence, jnp.float32).reshape(-1), (logits.shape[0],))
+    freq = jnp.broadcast_to(jnp.asarray(frequency, jnp.float32).reshape(-1), (logits.shape[0],))
+    logits = (logits.astype(jnp.float32)
+              - pres[:, None] * (c > 0) - freq[:, None] * c)
   greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
   if isinstance(temp, (int, float)) and temp == 0.0:
     return greedy  # static shortcut: pure-greedy callers skip the sampling graph
