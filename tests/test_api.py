@@ -592,3 +592,39 @@ async def test_tinychat_served_at_root():
     assert "<html" in body.lower()
   finally:
     await client.close()
+
+
+async def test_sampling_extras_validation_and_passthrough():
+  """OpenAI seed / penalties / logit_bias: malformed values 400 with the
+  OpenAI error shape; valid values flow to Node._request_sampling (the JAX
+  engine applies them on device — tests/test_sampling_extras.py proves the
+  math; the dummy engine here proves the wire+validation layer)."""
+  client, node, _ = await _api_client()
+  base = {"model": "dummy", "messages": [{"role": "user", "content": "hi"}]}
+  try:
+    for bad in ({"seed": "nope"}, {"seed": True},
+                {"presence_penalty": 3}, {"frequency_penalty": -2.5},
+                {"logit_bias": {"12": 200}}, {"logit_bias": {"x": 1}},
+                {"logit_bias": {"-1": -100}},  # negative ids: OpenAI rejects
+                {"logit_bias": "notadict"}):
+      resp = await client.post("/v1/chat/completions", json={**base, **bad})
+      assert resp.status == 400, bad
+      assert (await resp.json())["error"]["type"] == "invalid_request_error"
+
+    seen = {}
+    orig = node.process_prompt
+
+    async def spy(*a, **kw):
+      seen.update(kw.get("sampling") or {})
+      return await orig(*a, **kw)
+
+    node.process_prompt = spy
+    resp = await client.post("/v1/chat/completions", json={
+      **base, "seed": 11, "presence_penalty": 0.5, "frequency_penalty": 1.0,
+      "logit_bias": {"7": -100, "9": 50},
+    })
+    assert resp.status == 200
+    assert seen == {"seed": 11, "presence_penalty": 0.5, "frequency_penalty": 1.0,
+                    "logit_bias": {"7": -100.0, "9": 50.0}}
+  finally:
+    await client.close()

@@ -399,6 +399,46 @@ class ChatGPTAPI:
                      "message": f"stop must be a non-empty string or list of 1-4 strings, got {stop!r}"}},
           status=400,
         )
+    # OpenAI sampling extras, applied ON DEVICE by the sampler
+    # (ops/sampling.py); the reference parsed equivalents and dropped them.
+    sampling: dict = {}
+    seed = data.get("seed")
+    if seed is not None:
+      # int64 bound: jax.random.PRNGKey overflows past it — reject here as a
+      # 400 rather than surfacing an engine-side 500.
+      if isinstance(seed, bool) or not isinstance(seed, int) or not -(2**63) <= seed < 2**63:
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"seed must be a 64-bit integer, got {seed!r}"}}, status=400)
+      sampling["seed"] = seed
+    for pen_key in ("presence_penalty", "frequency_penalty"):
+      pen = data.get(pen_key)
+      if pen is not None:
+        if isinstance(pen, bool) or not isinstance(pen, (int, float)) or not (-2 <= pen <= 2):
+          return web.json_response(
+            {"error": {"type": "invalid_request_error",
+                       "message": f"{pen_key} must be a number in [-2, 2], got {pen!r}"}},
+            status=400)
+        if pen:
+          sampling[pen_key] = float(pen)
+    logit_bias = data.get("logit_bias")
+    if logit_bias is not None:
+      # isascii() because isdigit() alone admits non-ASCII digit strings
+      # (e.g. superscripts) that int() rejects — those must 400 here, not
+      # 500 in the engine executor.
+      ok = (isinstance(logit_bias, dict) and len(logit_bias) <= 300
+            and all(isinstance(k, (str, int)) and str(k).isascii() and str(k).isdigit()
+                    and isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and -100 <= v <= 100
+                    for k, v in logit_bias.items()))
+      if not ok:
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": "logit_bias must map up to 300 non-negative token ids "
+                                "to numbers in [-100, 100]"}},
+          status=400)
+      if logit_bias:
+        sampling["logit_bias"] = {str(k): float(v) for k, v in logit_bias.items()}
     try:
       images = extract_images(data.get("messages", [])) or None
     except ValueError as e:
@@ -429,7 +469,8 @@ class ChatGPTAPI:
     try:
       for rid in request_ids:
         await self.node.process_prompt(shard, prompt, rid, max_tokens=max_tokens, images=images,
-                                       temperature=temperature, top_p=top_p)
+                                       temperature=temperature, top_p=top_p,
+                                       sampling=sampling or None)
       if stream:
         return await self._stream_response(request, request_ids, model, tokenizer, stop=stop)
       return await self._full_response(request_ids, model, tokenizer, prompt, stop=stop)

@@ -54,6 +54,10 @@ TEMP_KEY = "xot_temperature"
 # And for OpenAI `top_p` (nucleus sampling). Values snap to a 0.05 grid at
 # the API so the (top_k, top_p)-specialised executables stay bounded.
 TOP_P_KEY = "xot_top_p"
+# And for the OpenAI sampling extras the reference parsed-and-dropped
+# (chatgpt_api.py): one JSON-safe dict {seed, logit_bias,
+# presence_penalty, frequency_penalty} applied on device by the sampler.
+SAMPLING_KEY = "xot_sampling"
 
 
 _DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
@@ -159,6 +163,12 @@ class Node:
     self._request_temp: Dict[str, float] = {}
     # Per-request nucleus sampling (OpenAI top_p); same channel.
     self._request_top_p: Dict[str, float] = {}
+    # Per-request sampling extras (OpenAI seed / logit_bias / penalties);
+    # same channel (SAMPLING_KEY).
+    self._request_sampling: Dict[str, dict] = {}
+    # Does engine.infer_sample_tensor accept the `sampling` kwarg? Resolved
+    # by signature inspection on first extras request (None = not yet).
+    self._engine_accepts_sampling: Optional[bool] = None
     # Why a request aborted (bounded LRU; API pops entries when reporting).
     from collections import OrderedDict
     self.request_errors: "OrderedDict[str, str]" = OrderedDict()
@@ -257,7 +267,8 @@ class Node:
                            traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                            images: Optional[List[np.ndarray]] = None,
                            temperature: Optional[float] = None,
-                           top_p: Optional[float] = None) -> None:
+                           top_p: Optional[float] = None,
+                           sampling: Optional[dict] = None) -> None:
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
@@ -271,6 +282,9 @@ class Node:
       self._request_temp[request_id] = max(0.0, float(temperature))
     if top_p is not None:
       self._request_top_p[request_id] = min(1.0, max(0.0, float(top_p)))
+    if sampling:
+      # OpenAI extras (seed / logit_bias / penalties), validated at the API.
+      self._request_sampling[request_id] = dict(sampling)
     start_ns = time.perf_counter_ns()
     if traceparent is None:
       # Count only origin requests: a forwarded prompt re-enters process_prompt
@@ -341,6 +355,7 @@ class Node:
         request_id, shard, np.asarray(tokens).reshape(1, -1),
         temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
         top_p=self._top_p_for(request_id),
+        **self._sampling_kwargs(request_id),
       )
       await self.process_sampled_token(base_shard, int(token), request_id, None)
       return
@@ -378,6 +393,10 @@ class Node:
       p = inference_state.get(TOP_P_KEY)
       if p is not None:
         self._request_top_p[request_id] = min(1.0, max(0.0, float(p)))
+    if inference_state and request_id not in self._request_sampling:
+      s = inference_state.get(SAMPLING_KEY)
+      if s:
+        self._request_sampling[request_id] = dict(s)
     try:
       sampler = getattr(self.inference_engine, "infer_sample_tensor", None)
       fuse_sample = shard.is_last_layer and sampler is not None
@@ -393,6 +412,7 @@ class Node:
             request_id, shard, tensor, temp=self._temp_for(request_id),
             top_k=self.default_sample_top_k, inference_state=inference_state,
             top_p=self._top_p_for(request_id),
+            **self._sampling_kwargs(request_id),
           )
         else:
           result, inference_state = await self.inference_engine.infer_tensor(
@@ -535,8 +555,16 @@ class Node:
                                buffered: List[int], inference_state: Optional[dict], gen) -> None:
     """Chunked decode until EOS/cap; EOS/max checks happen between chunks and
     surplus tokens after EOS inside a chunk are discarded."""
+    # Speculation verifies drafts by plain greedy argmax — requests whose
+    # extras RESHAPE the distribution (penalties/bias change even greedy
+    # argmax) must not speculate or the verified tokens would ignore them.
+    # A seed alone is irrelevant at temp==0 (greedy is already
+    # deterministic), so seed-only requests keep the speculation fast path.
+    reshaping = set(self._request_sampling.get(request_id, ())) & {
+      "presence_penalty", "frequency_penalty", "logit_bias"}
     verify = (getattr(self.inference_engine, "verify_draft", None)
-              if self.speculate_tokens > 0 and self._temp_for(request_id) == 0 else None)
+              if (self.speculate_tokens > 0 and self._temp_for(request_id) == 0
+                  and not reshaping) else None)
     # Persistent draft context: prompt + generated tokens, appended as they
     # arrive (never rebuilt — a 32k prompt must not be re-copied per round).
     spec_context = (list(self._request_prompt_tokens.get(request_id, ())) + list(buffered)
@@ -685,6 +713,27 @@ class Node:
     default 1.0, normalised at the API) means disabled."""
     return self._request_top_p.get(request_id, 0.0)
 
+  def _sampling_kwargs(self, request_id: str) -> dict:
+    """Extra kwargs for engines whose fused sampler supports the OpenAI
+    extras (seed/logit_bias/penalties). Empty for plain requests AND for
+    engines whose infer_sample_tensor signature never learned the `sampling`
+    kwarg — real signature inspection (cached), so an extras request against
+    an older engine degrades to plain sampling instead of TypeError-aborting."""
+    s = self._request_sampling.get(request_id)
+    if not s:
+      return {}
+    if self._engine_accepts_sampling is None:
+      import inspect
+      sampler = getattr(self.inference_engine, "infer_sample_tensor", None)
+      try:
+        params = inspect.signature(sampler).parameters
+        self._engine_accepts_sampling = (
+          "sampling" in params
+          or any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()))
+      except (TypeError, ValueError):
+        self._engine_accepts_sampling = False
+    return {"sampling": s} if self._engine_accepts_sampling else {}
+
   def _clamp_max_tokens(self, cap: Any) -> int:
     return max(1, min(int(cap), self.max_generate_tokens))
 
@@ -796,6 +845,9 @@ class Node:
     p = self._request_top_p.get(request_id)
     if p is not None:
       inference_state = {**(inference_state or {}), TOP_P_KEY: p}
+    s = self._request_sampling.get(request_id)
+    if s is not None:
+      inference_state = {**(inference_state or {}), SAMPLING_KEY: s}
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
@@ -1025,6 +1077,7 @@ class Node:
     self._request_max_tokens.pop(request_id, None)
     self._request_temp.pop(request_id, None)
     self._request_top_p.pop(request_id, None)
+    self._request_sampling.pop(request_id, None)
     self._request_eos.pop(request_id, None)
     self._request_prompt_tokens.pop(request_id, None)
 
