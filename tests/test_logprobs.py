@@ -1,0 +1,183 @@
+"""OpenAI `logprobs` / `top_logprobs`: per-token logprob reporting computed
+ON DEVICE next to sampling (ops/sampling.sample_logits_logprobs) — the
+[B, V] logits still never cross to the host; only [K+1] floats per token do.
+The reference's API exposed no logprob reporting at all (chatgpt_api.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+N = TINY_LLAMA_CFG["num_hidden_layers"]
+FULL = Shard("m", 0, N - 1, N)
+PROMPT = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+
+
+@pytest.fixture()
+def tiny_model_dir(tmp_path):
+  return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+
+
+def _engine(model_dir):
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+async def test_logprobs_match_host_log_softmax(tiny_model_dir):
+  """Greedy + logprobs through prefill and a fused chunk must equal the
+  host oracle: log_softmax over infer_tensor's logits, evaluated at the
+  sampled token and the top-3 alternatives, at every step."""
+  eng = _engine(tiny_model_dir)
+  tok, _ = await eng.infer_sample_tensor("r", FULL, PROMPT, temp=0.0, top_k=0,
+                                         sampling={"logprobs": 3})
+  got = [int(tok)]
+  out = await eng.generate_chunk("r", FULL, got[-1], 4, temp=0.0, top_k=0)
+  got.extend(int(t) for t in out)
+  entries = eng.pop_logprobs("r")
+  assert len(entries) == len(got)
+
+  ref = _engine(tiny_model_dir)
+  logits, _ = await ref.infer_tensor("o", FULL, PROMPT)
+  for tok_i, ent in zip(got, entries):
+    row = np.asarray(logits[0, -1], dtype=np.float64)
+    logp = row - np.log(np.exp(row - row.max()).sum()) - row.max()
+    assert tok_i == int(np.argmax(row))
+    np.testing.assert_allclose(ent["logprob"], logp[tok_i], atol=1e-4)
+    top_ids = [t for t, _ in ent["top"]]
+    top_lps = [p for _, p in ent["top"]]
+    assert top_ids == list(np.argsort(-logp)[:3])
+    np.testing.assert_allclose(top_lps, np.sort(logp)[::-1][:3], atol=1e-4)
+    logits, _ = await ref.infer_tensor("o", FULL, np.array([[tok_i]], dtype=np.int64))
+
+  # Drained: a second pop returns nothing.
+  assert eng.pop_logprobs("r") is None
+
+
+async def test_logprobs_reflect_logit_bias(tiny_model_dir):
+  """Logprobs report the PENALISED/BIASED distribution the request decodes
+  from: banning the greedy token pushes it out of the top alternatives and
+  the runner-up's reported logprob rises toward 0."""
+  ref = _engine(tiny_model_dir)
+  logits, _ = await ref.infer_tensor("o", FULL, PROMPT)
+  banned = int(np.argmax(logits[0, -1]))
+
+  eng = _engine(tiny_model_dir)
+  tok, _ = await eng.infer_sample_tensor(
+    "b", FULL, PROMPT, temp=0.0, top_k=0,
+    sampling={"logprobs": 3, "logit_bias": {str(banned): -100.0}})
+  [entry] = eng.pop_logprobs("b")
+  assert int(tok) != banned
+  assert banned not in [t for t, _ in entry["top"]]
+  assert entry["top"][0][0] == int(tok)
+
+
+async def test_logprobs_zero_top(tiny_model_dir):
+  """logprobs: true without top_logprobs reports the sampled token's
+  logprob with an empty alternatives list (OpenAI shape)."""
+  eng = _engine(tiny_model_dir)
+  await eng.infer_sample_tensor("z", FULL, PROMPT, temp=0.0, top_k=0,
+                                sampling={"logprobs": 0})
+  [entry] = eng.pop_logprobs("z")
+  assert entry["top"] == []
+  assert entry["logprob"] <= 0.0
+
+
+async def _api_client(max_tokens=8):
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  from tests.test_orchestration import _caps, _make_node
+
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("lp-node", engine, max_generate_tokens=max_tokens,
+                          default_sample_temp=0.0, decode_chunk_size=4)
+  node.topology.update_node("lp-node", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", response_timeout=60,
+                   default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return client, node, engine
+
+
+async def test_api_logprobs_full_response():
+  """choices[i].logprobs.content carries one OpenAI-shaped item per
+  completion token (token text, logprob<=0, bytes, top_logprobs of the
+  requested width) through the REAL engine + API stack."""
+  client, node, engine = await _api_client()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny",
+      "messages": [{"role": "user", "content": "hello there"}],
+      "logprobs": True, "top_logprobs": 2,
+    })
+    assert resp.status == 200
+    data = await resp.json()
+    choice = data["choices"][0]
+    content = choice["logprobs"]["content"]
+    assert len(content) == data["usage"]["completion_tokens"] > 0
+    for item in content:
+      assert item["logprob"] <= 0.0
+      assert item["bytes"] == list(item["token"].encode("utf-8"))
+      assert len(item["top_logprobs"]) == 2
+      # Greedy serving: the sampled token IS the argmax, so it leads the top
+      # list and alternatives are sorted by logprob.
+      assert item["top_logprobs"][0]["logprob"] >= item["top_logprobs"][1]["logprob"]
+
+    # Without the flag the field is null — and nothing leaks between
+    # requests through the engine's logprob store.
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny",
+      "messages": [{"role": "user", "content": "hello there"}],
+    })
+    assert (await resp.json())["choices"][0]["logprobs"] is None
+    assert not engine._logprob_store
+  finally:
+    await client.close()
+
+
+async def test_api_logprobs_streaming_aligned():
+  """SSE chunks carry logprobs.content aligned with each delta; the
+  concatenation covers the whole completion exactly once."""
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "synthetic-tiny",
+      "messages": [{"role": "user", "content": "stream me"}],
+      "stream": True, "logprobs": True, "top_logprobs": 1,
+    })
+    assert resp.status == 200
+    import json as _json
+    items, finish = [], None
+    async for line in resp.content:
+      if not line.startswith(b"data: ") or b"[DONE]" in line:
+        continue
+      chunk = _json.loads(line[6:])
+      ch = chunk["choices"][0]
+      if ch.get("logprobs"):
+        items.extend(ch["logprobs"]["content"])
+      finish = ch["finish_reason"] or finish
+    assert finish in ("stop", "length")
+    assert items, "no logprob items streamed"
+    assert all(i["logprob"] <= 0.0 and len(i["top_logprobs"]) == 1 for i in items)
+  finally:
+    await client.close()
+
+
+async def test_api_logprobs_validation():
+  client, node, _ = await _api_client()
+  base = {"model": "synthetic-tiny", "messages": [{"role": "user", "content": "x"}]}
+  try:
+    for bad in ({"logprobs": "yes"}, {"logprobs": True, "top_logprobs": 21},
+                {"logprobs": True, "top_logprobs": -1},
+                {"top_logprobs": 3},  # requires logprobs: true
+                {"logprobs": False, "top_logprobs": 3}):
+      resp = await client.post("/v1/chat/completions", json={**base, **bad})
+      assert resp.status == 400, bad
+      assert (await resp.json())["error"]["type"] == "invalid_request_error"
+  finally:
+    await client.close()

@@ -439,6 +439,29 @@ class ChatGPTAPI:
           status=400)
       if logit_bias:
         sampling["logit_bias"] = {str(k): float(v) for k, v in logit_bias.items()}
+    # OpenAI logprobs: per-token logprob of the sampled token, plus up to
+    # `top_logprobs` (0..20) alternatives — computed ON DEVICE alongside
+    # sampling (ops/sampling.sample_logits_logprobs), so the full [B, V]
+    # logits still never cross to the host.
+    want_logprobs = data.get("logprobs")
+    top_logprobs = data.get("top_logprobs")
+    if want_logprobs is not None and not isinstance(want_logprobs, bool):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": f"logprobs must be a boolean, got {want_logprobs!r}"}}, status=400)
+    if top_logprobs is not None:
+      if (isinstance(top_logprobs, bool) or not isinstance(top_logprobs, int)
+          or not 0 <= top_logprobs <= 20):
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"top_logprobs must be an integer in [0, 20], got {top_logprobs!r}"}},
+          status=400)
+      if not want_logprobs:
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": "top_logprobs requires logprobs to be true"}}, status=400)
+    if want_logprobs:
+      sampling["logprobs"] = int(top_logprobs or 0)
     try:
       images = extract_images(data.get("messages", [])) or None
     except ValueError as e:
@@ -472,8 +495,10 @@ class ChatGPTAPI:
                                        temperature=temperature, top_p=top_p,
                                        sampling=sampling or None)
       if stream:
-        return await self._stream_response(request, request_ids, model, tokenizer, stop=stop)
-      return await self._full_response(request_ids, model, tokenizer, prompt, stop=stop)
+        return await self._stream_response(request, request_ids, model, tokenizer, stop=stop,
+                                           logprobs=bool(want_logprobs))
+      return await self._full_response(request_ids, model, tokenizer, prompt, stop=stop,
+                                       logprobs=bool(want_logprobs))
     finally:
       for rid in request_ids:
         self.token_queues.pop(rid, None)
@@ -514,7 +539,7 @@ class ChatGPTAPI:
     return tokens[prev:]
 
   def _chunk(self, request_id: str, model: str, content: str, finish_reason: Optional[str],
-             index: int = 0) -> dict:
+             index: int = 0, logprobs: Optional[dict] = None) -> dict:
     return {
       "id": f"chatcmpl-{request_id.split('#')[0]}",
       "object": "chat.completion.chunk",
@@ -523,9 +548,26 @@ class ChatGPTAPI:
       "choices": [{
         "index": index,
         "delta": {"role": "assistant", "content": content} if content else {},
+        "logprobs": logprobs,
         "finish_reason": finish_reason,
       }],
     }
+
+  def _logprob_content(self, tokenizer, token_ids: List[int], entries: list) -> list:
+    """OpenAI logprobs content items for generated tokens: token text,
+    logprob, UTF-8 bytes, and the top-K alternatives the sampler reported.
+    `entries` come from the engine in sampling order, 1:1 with token_ids."""
+    items = []
+    for tid, ent in zip(token_ids, entries):
+      text = tokenizer.decode([tid])
+      tops = []
+      for alt_id, alt_lp in ent.get("top", ()):
+        alt_text = tokenizer.decode([alt_id])
+        tops.append({"token": alt_text, "logprob": alt_lp,
+                     "bytes": list(alt_text.encode("utf-8"))})
+      items.append({"token": text, "logprob": ent["logprob"],
+                    "bytes": list(text.encode("utf-8")), "top_logprobs": tops})
+    return items
 
   def _eos_ids(self, tokenizer) -> set:
     # Whatever stops the node must classify as "stop" here: delegate to the
@@ -538,7 +580,7 @@ class ChatGPTAPI:
     return ids
 
   async def _stream_response(self, request, request_ids: List[str], model: str, tokenizer,
-                             stop: Optional[List[str]] = None):
+                             stop: Optional[List[str]] = None, logprobs: bool = False):
     """SSE stream over one or more completions (OpenAI n): sub-requests'
     queues are merged and each chunk carries its choice index.
 
@@ -613,8 +655,18 @@ class ChatGPTAPI:
         else:
           new_tokens = [t for t in delta if t not in eos_ids]
           content = tokenizer.decode(new_tokens) if new_tokens else ""
+        lp_obj = None
+        if logprobs and not stop and delta:
+          # Token-aligned streaming: drain exactly this delta's entries.
+          # (Stop-sequence streams emit CHARACTER slices that cross token
+          # boundaries, so per-chunk logprobs are omitted there.)
+          entries = self.node.pop_request_logprobs(rid, len(delta))
+          if entries is not None:
+            pairs = [(t, e) for t, e in zip(delta, entries) if t not in eos_ids]
+            lp_obj = {"content": self._logprob_content(
+              tokenizer, [p[0] for p in pairs], [p[1] for p in pairs])}
         done[idx] = done[idx] or finished
-        chunk = self._chunk(rid, model, content, finish_reason, index=idx)
+        chunk = self._chunk(rid, model, content, finish_reason, index=idx, logprobs=lp_obj)
         await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
         deadline = time.monotonic() + self.response_timeout
       await response.write(b"data: [DONE]\n\n")
@@ -663,7 +715,7 @@ class ChatGPTAPI:
     return tokens, self.node.request_errors.pop(request_id, None)
 
   async def _full_response(self, request_ids: List[str], model: str, tokenizer, prompt: str,
-                           stop: Optional[List[str]] = None):
+                           stop: Optional[List[str]] = None, logprobs: bool = False):
     eos_ids = self._eos_ids(tokenizer)
     try:
       results = await asyncio.gather(*(
@@ -689,19 +741,43 @@ class ChatGPTAPI:
       finish_reason = "stop" if (tokens and tokens[-1] in eos_ids) else "length"
       content_tokens = [t for t in tokens if t not in eos_ids]
       content = tokenizer.decode(content_tokens) if content_tokens else ""
+      stop_cut = False
       if stop:
         cut = min((i for i in (content.find(s) for s in stop) if i >= 0), default=-1)
         if cut >= 0:
           # OpenAI semantics: the completion ends BEFORE the stop sequence.
-          content, finish_reason = content[:cut], "stop"
+          content, finish_reason, stop_cut = content[:cut], "stop", True
           if content and hasattr(tokenizer, "encode"):
             content_tokens = tokenizer.encode(content)
           elif not content:
             content_tokens = []
       total_completion += len(content_tokens)
+      lp_obj = None
+      if logprobs:
+        # Entries arrive from the engine in sampling order, 1:1 with the
+        # buffered tokens; EOS rows are dropped with their tokens. None (vs
+        # empty) when the sampler ran on a remote ring node — the token
+        # broadcast carries ids only.
+        entries = self.node.pop_request_logprobs(request_ids[idx])
+        if entries is not None:
+          pairs = [(t, e) for t, e in zip(tokens, entries) if t not in eos_ids]
+          if stop_cut:
+            # Truncate at the SAMPLED-token boundary: keep tokens until
+            # their decode covers the kept text (a re-encode of the cut
+            # text can tokenize differently from what was sampled, so
+            # len(content_tokens) is not a valid pair count here).
+            kept: list = []
+            for pair in pairs:
+              if len(tokenizer.decode([p[0] for p in kept])) >= len(content):
+                break
+              kept.append(pair)
+            pairs = kept
+          lp_obj = {"content": self._logprob_content(
+            tokenizer, [p[0] for p in pairs], [p[1] for p in pairs])}
       choices.append({
         "index": idx,
         "message": {"role": "assistant", "content": content},
+        "logprobs": lp_obj,
         "finish_reason": finish_reason,
       })
     prompt_tokens = len(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else 0

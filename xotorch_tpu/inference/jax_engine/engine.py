@@ -33,6 +33,7 @@ from __future__ import annotations
 import asyncio
 import os
 import re
+import threading
 import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
@@ -254,6 +255,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     # their next touch raises RequestStateLost instead of silently starting
     # over from an empty cache.
     self._states_lost_to_oom: "OrderedDict[str, None]" = OrderedDict()
+    # OpenAI logprob reports per request (bounded LRU of lists of per-token
+    # entries). Kept OUTSIDE _RequestState: the API drains them when it
+    # formats the response, which can happen after the node already cleared
+    # the request's device state. Locked: the recorder runs on the engine
+    # executor thread while the API pops from the event-loop thread.
+    self._logprob_store: "OrderedDict[str, list]" = OrderedDict()
+    self._logprob_lock = threading.Lock()
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -601,7 +609,38 @@ class JAXShardInferenceEngine(InferenceEngine):
         extras["bias"] = jnp.zeros((1, V), jnp.float32).at[0, ids].add(vals)
     if extras["presence"] or extras["frequency"]:
       extras["counts"] = jnp.zeros((1, V), jnp.int32)
+    # OpenAI logprobs: None = off; K in 0..20 = report the sampled token's
+    # logprob plus the top-K alternatives per step.
+    extras["logprobs"] = sampling.get("logprobs")
     return extras
+
+  def _record_logprobs(self, request_id: str, lp, top_ids, top_lps) -> None:
+    """Append per-token logprob entries ([T] lp, [T, K] ids/lps host arrays)
+    for the API to drain via pop_logprobs. Bounded LRU — an abandoned
+    request's entries age out instead of leaking."""
+    entries = [{
+      "logprob": float(lp[i]),
+      "top": [(int(t), float(p)) for t, p in zip(top_ids[i], top_lps[i])],
+    } for i in range(len(lp))]
+    with self._logprob_lock:
+      self._logprob_store.setdefault(request_id, []).extend(entries)
+      self._logprob_store.move_to_end(request_id)
+      while len(self._logprob_store) > 512:
+        self._logprob_store.popitem(last=False)
+
+  def pop_logprobs(self, request_id: str, n: Optional[int] = None) -> Optional[list]:
+    """Drain up to `n` (default: all) recorded logprob entries for a
+    request, in sampling order. None when the request never recorded any
+    (plain requests; requests sampled on a remote ring node)."""
+    with self._logprob_lock:
+      store = self._logprob_store.get(request_id)
+      if store is None:
+        return None
+      if n is None or n >= len(store):
+        self._logprob_store.pop(request_id, None)
+        return store
+      out, self._logprob_store[request_id] = store[:n], store[n:]
+      return out
 
   def _extras_key(self, state: "_RequestState", extras: Optional[Dict[str, Any]],
                   request_id: str = "", sample_pos: Optional[int] = None):
@@ -670,13 +709,21 @@ class JAXShardInferenceEngine(InferenceEngine):
     key = self._extras_key(state, extras, request_id=request_id,
                            sample_pos=state.pos + seg_t - 1)
     e = extras or {}
-    tok, state.cache = forward_sample(
+    want_lp = e.get("logprobs")
+    out, state.cache = forward_sample(
       ctx.params, x, state.cache, jnp.int32(state.pos), jnp.int32(seg_t - 1), key,
       ctx.cfg, x.ndim == 2, temp, top_k, top_p, use_flash=use_flash, use_flash_decode=use_fd,
       start_layer=ctx.shard.start_layer,
       bias=e.get("bias"), counts=e.get("counts"),
       presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+      top_lp=-1 if want_lp is None else int(want_lp),
     )
+    if want_lp is not None:
+      tok, lp, top_ids, top_lps = out
+      self._record_logprobs(request_id, np.asarray(lp), np.asarray(top_ids),
+                            np.asarray(top_lps))
+    else:
+      tok = out
     state.pos += seg_t
     state.last_used = time.monotonic()
     if full_prompt is not None:
@@ -980,13 +1027,20 @@ class JAXShardInferenceEngine(InferenceEngine):
       extras = state.extras
       key = self._extras_key(state, extras, request_id=items[0][0])
       e = extras or {}
+      want_lp = e.get("logprobs")
       tok = jnp.asarray([[items[0][2]]], dtype=jnp.int32)
       out = decode_chunk(
         ctx.params, tok, state.cache, jnp.int32(state.pos), key,
         ctx.cfg, num_tokens, float(items[0][4]), top_k, top_p, use_flash_decode=use_fd,
         bias=e.get("bias"), counts=e.get("counts"),
         presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+        top_lp=-1 if want_lp is None else int(want_lp),
       )
+      out = list(out)
+      if want_lp is not None:
+        lp, top_ids, top_lps = out.pop()  # [B, T], [B, T, K] — batch row 0
+        self._record_logprobs(items[0][0], np.asarray(lp[0]), np.asarray(top_ids[0]),
+                              np.asarray(top_lps[0]))
       if e.get("counts") is not None:
         toks, state.cache, extras["counts"] = out
       else:

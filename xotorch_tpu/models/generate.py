@@ -21,13 +21,13 @@ import jax.numpy as jnp
 
 from xotorch_tpu.models.config import ModelConfig
 from xotorch_tpu.models.transformer import forward_shard, unembed
-from xotorch_tpu.ops.sampling import sample_logits
+from xotorch_tpu.ops.sampling import sample_logits, sample_logits_logprobs
 
 
 @partial(
   jax.jit,
   static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode",
-                   "start_layer"),
+                   "start_layer", "top_lp"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -49,9 +49,11 @@ def forward_sample(
   counts: jnp.ndarray = None,  # [B, V] token counts for penalties
   presence: float = 0.0,
   frequency: float = 0.0,
+  top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
-  ([B] int32 sampled token, updated cache).
+  ([B] int32 sampled token, updated cache) — with `top_lp >= 0`, instead
+  ((tok, lp, top_ids, top_lps), cache) per ops/sampling.sample_logits_logprobs.
 
   Two wins over infer_tensor-then-sample (VERDICT r1 weak #3):
   - the host never sees the [B, T, vocab] fp32 logits (~0.5 MB/token for a
@@ -66,6 +68,11 @@ def forward_sample(
                            start_layer=start_layer)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
+  if top_lp >= 0:
+    out = sample_logits_logprobs(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p,
+                                 bias=bias, counts=counts, presence=presence,
+                                 frequency=frequency, top_lp=top_lp)
+    return out, cache
   tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p,
                       bias=bias, counts=counts, presence=presence, frequency=frequency)
   return tok, cache
@@ -73,7 +80,7 @@ def forward_sample(
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode"),
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "top_lp"),
   donate_argnames=("cache",),
 )
 def decode_chunk(
@@ -92,12 +99,15 @@ def decode_chunk(
   counts: jnp.ndarray = None,  # [B, V] token counts; updated INSIDE the scan
   presence: float = 0.0,
   frequency: float = 0.0,
+  top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
 ):
   """Generate `num_tokens` tokens in one device program.
 
   Requires the shard to span the whole model (is_first and is_last). Returns
   ([B, num_tokens] int32 sampled tokens, updated cache) — plus the updated
-  counts as a third element when `counts` is passed (penalty requests). The
+  counts when `counts` is passed (penalty requests), plus a logprob triple
+  (lp [B, T], top_ids [B, T, top_lp], top_lps [B, T, top_lp]) as the final
+  element when `top_lp >= 0` (the scan stacks per-step reports). The
   incoming `tok` is consumed (its forward step is the first scan iteration);
   the returned tokens start at position start_pos + 1. `temp` is traced — a
   scalar or a per-ROW [B] array (ops/sampling.sample_logits), so batched
@@ -106,6 +116,7 @@ def decode_chunk(
   within-chunk feedback a host-side implementation would lose.
   """
   track_counts = counts is not None
+  want_lp = top_lp >= 0
 
   def step(carry, _):
     tok, cache, pos, key, counts = carry
@@ -115,17 +126,33 @@ def decode_chunk(
     # counts=None (not the 0-d carry placeholder) when penalties are off:
     # the None/array split is what keeps the [B, V] penalty subtractions out
     # of the plain fused-decode executable entirely.
-    nxt = sample_logits(logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p,
-                        bias=bias, counts=counts if track_counts else None,
-                        presence=presence, frequency=frequency)
+    step_counts = counts if track_counts else None
+    if want_lp:
+      nxt, lp, top_ids, top_lps = sample_logits_logprobs(
+        logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p,
+        bias=bias, counts=step_counts, presence=presence, frequency=frequency, top_lp=top_lp)
+      ys = (nxt, lp, top_ids, top_lps)
+    else:
+      nxt = sample_logits(logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p,
+                          bias=bias, counts=step_counts,
+                          presence=presence, frequency=frequency)
+      ys = nxt
     if track_counts:
       rows = jnp.arange(counts.shape[0], dtype=jnp.int32)
       counts = counts.at[rows, nxt].add(1)
-    return (nxt[:, None], cache, pos + 1, key, counts), nxt
+    return (nxt[:, None], cache, pos + 1, key, counts), ys
 
   init = (tok.astype(jnp.int32), cache, start_pos.astype(jnp.int32), key,
           counts if track_counts else jnp.zeros((), jnp.int32))
-  (_, cache, _, _, counts_out), toks = jax.lax.scan(step, init, None, length=num_tokens)
+  (_, cache, _, _, counts_out), ys = jax.lax.scan(step, init, None, length=num_tokens)
+  if want_lp:
+    toks, lp, top_ids, top_lps = ys
+    aux = (lp.T, top_ids.transpose(1, 0, 2), top_lps.transpose(1, 0, 2))
+  else:
+    toks, aux = ys, None
+  out = [toks.T, cache]  # [B, num_tokens]
   if track_counts:
-    return toks.T, cache, counts_out  # [B, num_tokens]
-  return toks.T, cache
+    out.append(counts_out)
+  if want_lp:
+    out.append(aux)
+  return tuple(out)

@@ -16,6 +16,20 @@ DEFAULT_TEMP = 0.6
 DEFAULT_TOP_K = 35
 
 
+def _penalized(logits, bias, counts, presence, frequency):
+  """Apply the OpenAI logit adjustments (additive bias, presence/frequency
+  penalties) — the distribution BOTH sampling and logprob reporting see."""
+  if bias is not None:
+    logits = logits.astype(jnp.float32) + bias.astype(jnp.float32)
+  if counts is not None:
+    c = counts.astype(jnp.float32)
+    pres = jnp.broadcast_to(jnp.asarray(presence, jnp.float32).reshape(-1), (logits.shape[0],))
+    freq = jnp.broadcast_to(jnp.asarray(frequency, jnp.float32).reshape(-1), (logits.shape[0],))
+    logits = (logits.astype(jnp.float32)
+              - pres[:, None] * (c > 0) - freq[:, None] * c)
+  return logits
+
+
 @partial(jax.jit, static_argnames=("top_k", "top_p"))
 def sample_logits(
   logits: jnp.ndarray,  # [B, V] fp32
@@ -41,14 +55,7 @@ def sample_logits(
   shift by -presence*(count>0) - frequency*count BEFORE temperature, so they
   reshape greedy decoding too (the reference parsed these request fields and
   dropped them, chatgpt_api.py)."""
-  if bias is not None:
-    logits = logits.astype(jnp.float32) + bias.astype(jnp.float32)
-  if counts is not None:
-    c = counts.astype(jnp.float32)
-    pres = jnp.broadcast_to(jnp.asarray(presence, jnp.float32).reshape(-1), (logits.shape[0],))
-    freq = jnp.broadcast_to(jnp.asarray(frequency, jnp.float32).reshape(-1), (logits.shape[0],))
-    logits = (logits.astype(jnp.float32)
-              - pres[:, None] * (c > 0) - freq[:, None] * c)
+  logits = _penalized(logits, bias, counts, presence, frequency)
   greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
   if isinstance(temp, (int, float)) and temp == 0.0:
     return greedy  # static shortcut: pure-greedy callers skip the sampling graph
@@ -69,3 +76,38 @@ def sample_logits(
   gumbel = jax.random.gumbel(key, logits.shape, dtype=jnp.float32)
   sampled = jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)
   return jnp.where(temp_b > 0, sampled, greedy)
+
+
+@partial(jax.jit, static_argnames=("top_k", "top_p", "top_lp"))
+def sample_logits_logprobs(
+  logits: jnp.ndarray,  # [B, V] fp32
+  key: jax.Array,
+  temp=DEFAULT_TEMP,
+  top_k: int = DEFAULT_TOP_K,
+  top_p: float = 0.0,
+  bias: jnp.ndarray = None,
+  counts: jnp.ndarray = None,
+  presence: float = 0.0,
+  frequency: float = 0.0,
+  top_lp: int = 0,  # static: how many top alternatives to report (0..20)
+):
+  """sample_logits plus OpenAI logprob reporting, one dispatch: returns
+  (tok [B] int32, lp [B] fp32, top_ids [B, top_lp] int32,
+  top_lps [B, top_lp] fp32).
+
+  Logprobs are log-softmax of the PENALISED/BIASED logits (the
+  distribution the request actually decodes from) but PRE-temperature —
+  OpenAI semantics: temperature rescales sampling noise, not the reported
+  probabilities. top_lp == 0 returns empty [B, 0] alternative arrays (the
+  OpenAI `logprobs: true` without `top_logprobs` shape)."""
+  adj = _penalized(logits, bias, counts, presence, frequency)
+  tok = sample_logits(adj, key, temp=temp, top_k=top_k, top_p=top_p)
+  logp = jax.nn.log_softmax(adj.astype(jnp.float32), axis=-1)
+  lp = jnp.take_along_axis(logp, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+  if top_lp > 0:
+    top_lps, top_ids = jax.lax.top_k(logp, top_lp)
+  else:
+    B = logits.shape[0]
+    top_ids = jnp.zeros((B, 0), jnp.int32)
+    top_lps = jnp.zeros((B, 0), jnp.float32)
+  return tok, lp, top_ids.astype(jnp.int32), top_lps

@@ -557,11 +557,13 @@ class Node:
     surplus tokens after EOS inside a chunk are discarded."""
     # Speculation verifies drafts by plain greedy argmax — requests whose
     # extras RESHAPE the distribution (penalties/bias change even greedy
-    # argmax) must not speculate or the verified tokens would ignore them.
-    # A seed alone is irrelevant at temp==0 (greedy is already
-    # deterministic), so seed-only requests keep the speculation fast path.
+    # argmax) must not speculate or the verified tokens would ignore them;
+    # logprobs requests must not either (the verify path samples nothing,
+    # so it would record no logprob entries for accepted drafts). A seed
+    # alone is irrelevant at temp==0 (greedy is already deterministic), so
+    # seed-only requests keep the speculation fast path.
     reshaping = set(self._request_sampling.get(request_id, ())) & {
-      "presence_penalty", "frequency_penalty", "logit_bias"}
+      "presence_penalty", "frequency_penalty", "logit_bias", "logprobs"}
     verify = (getattr(self.inference_engine, "verify_draft", None)
               if (self.speculate_tokens > 0 and self._temp_for(request_id) == 0
                   and not reshaping) else None)
@@ -733,6 +735,15 @@ class Node:
       except (TypeError, ValueError):
         self._engine_accepts_sampling = False
     return {"sampling": s} if self._engine_accepts_sampling else {}
+
+  def pop_request_logprobs(self, request_id: str, n: Optional[int] = None) -> Optional[list]:
+    """Drain the engine's recorded logprob entries for a request (OpenAI
+    `logprobs`). None when the local engine recorded none — plain requests,
+    engines without the feature, or rings where a REMOTE node samples (the
+    token broadcast carries ids only; logprob reporting requires the API
+    node to host the sampling shard)."""
+    pop = getattr(self.inference_engine, "pop_logprobs", None)
+    return pop(request_id, n) if pop is not None else None
 
   def _clamp_max_tokens(self, cap: Any) -> int:
     return max(1, min(int(cap), self.max_generate_tokens))
