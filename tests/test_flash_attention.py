@@ -101,3 +101,139 @@ async def test_engine_prefill_uses_flash(tmp_path, monkeypatch):
   d_base, _ = await base.infer_tensor("r", shard, nxt)
   d_flash, _ = await flash.infer_tensor("r", shard, nxt)
   np.testing.assert_allclose(d_flash, d_base, atol=5e-2, rtol=5e-2)
+
+
+def _baseline_windowed(q, k, v, window=None, softcap=0.0, scale=None):
+  B, T = q.shape[0], q.shape[1]
+  pos = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+  w = None if window is None else jnp.int32(window)
+  return gqa_attention(q, k, v, pos, jnp.full((B,), T, jnp.int32),
+                       scale=scale, softcap=softcap, window=w)
+
+
+@pytest.mark.parametrize("window", [16, 32, 64])
+def test_flash_sliding_window_matches_baseline(window):
+  """Windowed kernel vs the XLA baseline's window mask: position t attends
+  exactly [t - w + 1, t]. Windows smaller than T make the lower bound bite;
+  w spanning multiple kv blocks exercises the block re-map."""
+  with jax.default_matmul_precision("highest"):
+    q, k, v = _inputs(2, 128, 4, 2, 64, seed=11)
+    ref = _baseline_windowed(q, k, v, window=window)
+    out = flash_attention(q, k, v, block_q=32, block_k=32, window=jnp.int32(window))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_flash_window_zero_is_global_one_executable():
+  """window=0 through the WINDOWED kernel equals global attention — the
+  property that lets gemma2's alternating layers (sliding w, global 0)
+  share one compiled kernel with the window as a traced scalar."""
+  with jax.default_matmul_precision("highest"):
+    q, k, v = _inputs(1, 64, 4, 2, 64, seed=12)
+    ref = _baseline(q, k, v)
+    out = flash_attention(q, k, v, block_q=32, block_k=32, window=jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_flash_softcap_and_scale():
+  """Gemma2 score shaping: tanh soft-cap and query_pre_attn_scalar scale,
+  with and without a window."""
+  with jax.default_matmul_precision("highest"):
+    q, k, v = _inputs(1, 64, 4, 2, 64, seed=13)
+    ref = _baseline_windowed(q, k, v, window=16, softcap=30.0, scale=0.125)
+    out = flash_attention(q, k, v, block_q=32, block_k=32, window=jnp.int32(16),
+                          softcap=30.0, scale=0.125)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    # Dropping the cap must CHANGE the result (the cap actually bites).
+    uncapped = flash_attention(q, k, v, block_q=32, block_k=32, window=jnp.int32(16),
+                               scale=0.125)
+    assert not np.allclose(np.asarray(uncapped), np.asarray(ref), atol=1e-3)
+
+
+def test_flash_window_locality():
+  """With window w, output at position t must IGNORE keys before t - w + 1:
+  corrupting them changes nothing (the stronger DMA-skip property holds on
+  TPU; this proves the mask semantics interpret mode shares)."""
+  with jax.default_matmul_precision("highest"):
+    q, k, v = _inputs(1, 128, 2, 2, 64, seed=14)
+    w = 32
+    out1 = flash_attention(q, k, v, block_q=32, block_k=32, window=jnp.int32(w))
+    k2 = k.at[:, :64].set(7.7)
+    v2 = v.at[:, :64].set(-3.3)
+    out2 = flash_attention(q, k2, v2, block_q=32, block_k=32, window=jnp.int32(w))
+    # Positions >= 64 + w - 1 see none of the corrupted prefix.
+    np.testing.assert_allclose(np.asarray(out1[:, 64 + w - 1:]),
+                               np.asarray(out2[:, 64 + w - 1:]), atol=1e-6)
+    # Early positions do see it.
+    assert not np.allclose(np.asarray(out1[:, :64]), np.asarray(out2[:, :64]))
+
+
+# ---------------------------------------------------------------- cached path
+
+from xotorch_tpu.ops.flash_decode import flash_cached_attention, flash_decode_attention
+
+
+def _cached_baseline(q, k, v, q_start, window=None, softcap=0.0, scale=None):
+  B, T = q.shape[0], q.shape[1]
+  pos = q_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+  w = None if window is None else jnp.int32(window)
+  return gqa_attention(q, k, v, pos, None, scale=scale, softcap=softcap, window=w)
+
+
+@pytest.mark.parametrize("window", [16, 48])
+def test_cached_window_decode_step(window):
+  """T == 1 decode at a depth far past the window: the kernel must attend
+  exactly the trailing `window` cache positions (and, on TPU, skip DMAs for
+  everything below them)."""
+  with jax.default_matmul_precision("highest"):
+    key = jax.random.PRNGKey(21)
+    B, S, Hq, Hkv, D = 2, 256, 4, 2, 64
+    k = jax.random.normal(key, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 1), (B, S, Hkv, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, 1, Hq, D), jnp.float32)
+    valid = jnp.asarray([200, 131], jnp.int32)  # per-row depths
+    ref = _cached_baseline(q, k, v, valid - 1, window=window)
+    out = flash_decode_attention(q, k, v, valid, block_k=32, window=jnp.int32(window))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_cached_window_chunk_segment():
+  """T > 1 chunked-prefill segment at an offset with a window smaller than
+  the occupied prefix, plus softcap + scale (the gemma2 combination)."""
+  with jax.default_matmul_precision("highest"):
+    key = jax.random.PRNGKey(22)
+    B, S, T, Hq, Hkv, D = 1, 256, 32, 4, 2, 64
+    k = jax.random.normal(key, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 1), (B, S, Hkv, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, T, Hq, D), jnp.float32)
+    start = jnp.asarray([160], jnp.int32)
+    ref = _cached_baseline(q, k, v, start, window=24, softcap=50.0, scale=0.2)
+    out = flash_cached_attention(q, k, v, start, block_q=16, block_k=32,
+                                 window=jnp.int32(24), softcap=50.0, scale=0.2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    # Window 0 through the windowed kernel == the global kernel's output.
+    ref_g = _cached_baseline(q, k, v, start)
+    out_g = flash_cached_attention(q, k, v, start, block_q=16, block_k=32,
+                                   window=jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(ref_g), atol=1e-5, rtol=1e-5)
+
+
+def test_cached_window_ignores_below_window_cache():
+  """Corrupting cache entries below the window must not change the output —
+  the mask-semantics twin of the TPU DMA-skip."""
+  with jax.default_matmul_precision("highest"):
+    key = jax.random.PRNGKey(23)
+    B, S, Hq, Hkv, D = 1, 128, 2, 2, 64
+    k = jax.random.normal(key, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 1), (B, S, Hkv, D), jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, 1, Hq, D), jnp.float32)
+    valid = jnp.asarray([100], jnp.int32)
+    w = 16
+    out1 = flash_decode_attention(q, k, v, valid, block_k=32, window=jnp.int32(w))
+    # Visible range is [100 - w, 99]; corrupt strictly below it.
+    k2 = k.at[:, :100 - w].set(9.9)
+    v2 = v.at[:, :100 - w].set(-9.9)
+    out2 = flash_decode_attention(q, k2, v2, valid, block_k=32, window=jnp.int32(w))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+    # Sanity: without the window the corruption DOES leak in.
+    out3 = flash_decode_attention(q, k2, v2, valid, block_k=32)
+    assert not np.allclose(np.asarray(out1), np.asarray(out3), atol=1e-3)

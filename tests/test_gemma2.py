@@ -6,9 +6,9 @@ prove the serving machinery handles gemma2's two sharp edges:
 - a mid-ring shard must window by ABSOLUTE layer index (gemma2 alternates
   sliding/global per layer, so a shard starting at an odd layer that counted
   from zero would window the wrong layers);
-- the Pallas flash/decode kernels implement neither the window lower bound
-  nor the tanh soft-cap, so the engine must route gemma2 down the XLA path
-  even when the kernels are force-enabled by env.
+- the Pallas flash/decode kernels implement the window lower bound (traced
+  per-layer scalar) and the tanh soft-cap, so force-enabling them by env
+  must serve the same tokens as the XLA path.
 
 Reference parity: gemma2 cards models.py:206-207 served through the same
 engine as every other family (sharded_inference_engine.py).
@@ -55,9 +55,10 @@ async def test_gemma2_split_ring_windows_by_absolute_layer(gemma_dir):
 
 
 async def test_gemma2_kernel_gates_hold_under_env_force(gemma_dir, monkeypatch):
-  """Force every Pallas kernel on by env; gemma2 must still serve correct
-  tokens (the engine's _pallas_kernels_ok gate routes it down the XLA path —
-  if the gate broke, transformer.forward_shard raises at trace time)."""
+  """Force every Pallas kernel on by env; gemma2 must serve the same greedy
+  tokens as the XLA host path — the windowed flash kernels (traced
+  per-layer window + static soft-cap, ops/flash_attention.py,
+  ops/flash_decode.py) are now the real serving path for this family."""
   monkeypatch.setenv("XOT_FLASH_ATTENTION", "1")
   monkeypatch.setenv("XOT_FLASH_DECODE", "1")
   monkeypatch.setenv("XOT_FLASH_DECODE_MIN", "1")

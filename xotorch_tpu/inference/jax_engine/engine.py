@@ -313,11 +313,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[self._dtype_name]
 
   def _pallas_kernels_ok(self, cfg: ModelConfig) -> bool:
-    """Sliding-window / attn-softcap families (gemma2, windowed mistral)
-    take the XLA attention path — the Pallas kernels implement neither the
-    window lower bound nor the tanh cap (transformer.py raises if they are
-    ever combined)."""
-    return not (cfg.uses_sliding_window or cfg.attn_logit_softcap)
+    """Every family takes the Pallas fast path: the flash kernels implement
+    the sliding-window lower bound (traced per-layer scalar; out-of-window
+    blocks' DMAs elided) and the gemma2 tanh soft-cap / query_pre_attn
+    scale as compile-time constants (ops/flash_attention.py,
+    ops/flash_decode.py). Kept as a seam for future configs the kernels
+    can't serve."""
+    return True
 
   def _flash_enabled(self) -> bool:
     """XOT_FLASH_ATTENTION: 1 = force on (interpret mode off-TPU), 0 = off,

@@ -185,18 +185,24 @@ def _attention_block(
   k = apply_rope(k, positions, inv_freq)
   layer_cache = _cache_write(layer_cache, k, v, start_pos)
   kv_quant = "k_scale" in layer_cache
-  if (window is not None or cfg.attn_logit_softcap) and (use_flash or use_flash_decode or ring_mesh is not None):
+  if (window is not None or cfg.attn_logit_softcap) and ring_mesh is not None:
     raise ValueError(
-      "sliding-window / attn-softcap configs (gemma2, windowed mistral) take "
-      "the XLA attention path — the engine gates the Pallas kernels off for them")
+      "ring attention (sequence-parallel training) does not support "
+      "sliding-window / attn-softcap configs (gemma2, windowed mistral)")
+  # Static gemma-family score adjustments; None/0.0 for every other family,
+  # so their compiled kernels are unchanged.
+  attn_scale = cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar else None
   if use_flash:
     # Prefill-from-zero fast path (engine guarantees start_pos == 0): the
     # fresh segment IS the whole visible context, and relative == absolute
     # positions, so the Pallas kernel's in-segment causal mask is exact.
     # Attends over the FRESH k/v (never reads the cache), so it composes
-    # with an int8 cache unchanged.
+    # with an int8 cache unchanged. The per-layer window rides in as a
+    # traced scalar (0 = global) — sliding and global layers share one
+    # kernel, and out-of-window kv blocks are never DMA'd.
     from xotorch_tpu.ops.flash_attention import flash_attention
-    attn = flash_attention(q, k, v)
+    attn = flash_attention(q, k, v, window=window, softcap=cfg.attn_logit_softcap,
+                           scale=attn_scale)
   elif use_flash_decode and not kv_quant:
     # Decode steps and chunked-prefill segments over a long resident cache:
     # Pallas kernel whose cost is proportional to the OCCUPIED prefix
@@ -206,12 +212,15 @@ def _attention_block(
     # takes the XLA path instead (the kernel reads raw bf16 buffers; a
     # pre-kernel dequant would materialise the full cache and forfeit the
     # bandwidth win — the engine also gates flash_decode off under
-    # XOT_KV_QUANT).
+    # XOT_KV_QUANT). With a sliding window the visible range shrinks to
+    # min(window, occupied): blocks below the window re-map too.
     from xotorch_tpu.ops.flash_decode import flash_cached_attention
     q_start = (jnp.full((B,), start_pos, dtype=jnp.int32) if jnp.ndim(start_pos) == 0
                else start_pos.astype(jnp.int32))
     attn = flash_cached_attention(q, layer_cache["k"].astype(q.dtype),
-                                  layer_cache["v"].astype(q.dtype), q_start)
+                                  layer_cache["v"].astype(q.dtype), q_start,
+                                  window=window, softcap=cfg.attn_logit_softcap,
+                                  scale=attn_scale)
   elif ring_mesh is not None:
     # Sequence-parallel training path (start_pos == 0, T sharded over 'sp'):
     # ring attention rotates KV chunks over ICI instead of materialising the
@@ -221,9 +230,7 @@ def _attention_block(
   else:
     k_all, v_all = _cache_read(layer_cache, q.dtype)
     attn = gqa_attention(q, k_all, v_all, positions, kv_valid_len,
-                         scale=(cfg.query_pre_attn_scalar ** -0.5
-                                if cfg.query_pre_attn_scalar else None),
-                         softcap=cfg.attn_logit_softcap, window=window)
+                         scale=attn_scale, softcap=cfg.attn_logit_softcap, window=window)
   attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
   out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
   if cfg.sandwich_norms:

@@ -39,11 +39,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xotorch_tpu.ops.flash_attention import _softcap
+
 NEG_INF = -1e30
 
 
 def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                   *, block_q: int, block_k: int, groups: int, scale: float):
+                   *, block_q: int, block_k: int, groups: int, scale: float,
+                   softcap: float = 0.0):
   """Grid = (B, Hkv, nQ, nK); nK innermost so scratch carries the
   online-softmax state across kv blocks of one (batch, kv-head, q-block)."""
   b = pl.program_id(0)
@@ -69,6 +72,7 @@ def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [block_q * groups, block_k]
+    s = _softcap(s, softcap)
 
     # Row r is query position q_start + i*block_q + r // groups.
     row_pos = q_start + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // groups
@@ -94,7 +98,75 @@ def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def _cached_kernel_windowed(start_ref, win_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                            l_ref, *, block_q: int, block_k: int, groups: int, scale: float,
+                            softcap: float):
+  """Sliding-window variant: win_ref ([1] int32, 0 = global) is the
+  per-LAYER window as a traced scalar-prefetch operand — one compiled
+  kernel serves gemma2's alternating sliding/global layers. Cache blocks
+  entirely below the window are skipped (and their DMAs elided via the
+  BlockSpec re-map), so decode cost is proportional to min(window,
+  occupied prefix) instead of the occupied prefix."""
+  b = pl.program_id(0)
+  i = pl.program_id(2)
+  j = pl.program_id(3)
+  n_k = pl.num_programs(3)
+  q_start = start_ref[b]
+  w = win_ref[0]
+  q_last = q_start + (i + 1) * block_q - 1
+  # Lowest position any query row of this block can see (first row has the
+  # block's minimum position q_start + i*block_q).
+  lowest_visible = q_start + i * block_q - w + 1
+
+  @pl.when(j == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  block_visible = jnp.logical_and(
+    j * block_k <= q_last,
+    jnp.logical_or(w <= 0, (j + 1) * block_k - 1 >= lowest_visible),
+  )
+
+  @pl.when(block_visible)
+  def _compute():
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q * groups, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+
+    s = jax.lax.dot_general(
+      q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    s = _softcap(s, softcap)
+
+    row_pos = q_start + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // groups
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    visible = k_pos <= row_pos
+    visible = jnp.logical_and(visible, jnp.logical_or(w <= 0, k_pos > row_pos - w))
+    s = jnp.where(visible, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+
+    l_ref[:] = jnp.broadcast_to(alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+  @pl.when(j == n_k - 1)
+  def _finalize():
+    l = l_ref[:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)  # window >= 1: every real row sees itself
+    o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret", "softcap",
+                                             "scale"))
 def flash_cached_attention(
   q: jnp.ndarray,  # [B, T, Hq, D] — queries at absolute positions q_start + [0, T)
   k: jnp.ndarray,  # [B, S, Hkv, D] — full static cache buffer (segment already written)
@@ -103,10 +175,16 @@ def flash_cached_attention(
   block_q: int = 128,
   block_k: int = 256,
   interpret: bool | None = None,
+  window: jnp.ndarray | None = None,  # traced scalar int32; None = global-only kernel
+  softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
+  scale: float | None = None,  # static score scale; None = D**-0.5
 ) -> jnp.ndarray:
   """Causal GQA attention of a query segment over the occupied cache prefix.
 
-  Query t attends cache positions [0, q_start + t]. Returns [B, T, Hq, D].
+  Query t attends cache positions [max(0, q_start + t - window + 1),
+  q_start + t] (window None/0 = the whole prefix). Returns [B, T, Hq, D].
+  `window=None` (static) compiles the original kernel, so non-windowed
+  families' executables are unchanged.
   """
   B, T, Hq, D = q.shape
   S, Hkv = k.shape[1], k.shape[2]
@@ -123,7 +201,7 @@ def flash_cached_attention(
   if interpret is None:
     interpret = jax.default_backend() != "tpu"
 
-  scale = 1.0 / math.sqrt(D)
+  scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
   # GQA packing: [B, Hkv, T * groups, D], row = position * groups + group.
   qt = q.reshape(B, T, Hkv, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, Hkv, T * groups, D)
   kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, D]
@@ -140,29 +218,63 @@ def flash_cached_attention(
     last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
     return (b, h, jnp.minimum(j, last), 0)
 
+  scratch = [
+    pltpu.VMEM((rows, D), jnp.float32),
+    pltpu.VMEM((rows, 128), jnp.float32),
+    pltpu.VMEM((rows, 128), jnp.float32),
+  ]
+
+  if window is None:
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=1,
+      grid=(B, Hkv, n_q, n_k),
+      in_specs=[
+        pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_k, D), kv_index),
+        pl.BlockSpec((1, 1, block_k, D), kv_index),
+      ],
+      out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
+      scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+      functools.partial(_cached_kernel, block_q=block_q, block_k=block_k, groups=groups,
+                        scale=scale, softcap=float(softcap)),
+      grid_spec=grid_spec,
+      out_shape=jax.ShapeDtypeStruct((B, Hkv, T * groups, D), q.dtype),
+      interpret=interpret,
+    )(start, qt, kt, vt)
+    return out.reshape(B, Hkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, T, Hq, D)
+
+  win = jnp.asarray(window, jnp.int32).reshape(1)
+
+  def kv_index_win(b, h, i, j, start_ref, win_ref):
+    # Clamp into the visible range: above the causal diagonal re-map down,
+    # below the sliding window re-map up — the repeated block index elides
+    # the DMA either way, so decode streams min(window, occupied) bytes.
+    last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
+    w = win_ref[0]
+    lo = jnp.where(w > 0,
+                   jnp.maximum(start_ref[b] + i * block_q - w + 1, 0) // block_k, 0)
+    return (b, h, jnp.clip(j, lo, last), 0)
+
   grid_spec = pltpu.PrefetchScalarGridSpec(
-    num_scalar_prefetch=1,
+    num_scalar_prefetch=2,
     grid=(B, Hkv, n_q, n_k),
     in_specs=[
-      pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
-      pl.BlockSpec((1, 1, block_k, D), kv_index),
-      pl.BlockSpec((1, 1, block_k, D), kv_index),
+      pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref, win_ref: (b, h, i, 0)),
+      pl.BlockSpec((1, 1, block_k, D), kv_index_win),
+      pl.BlockSpec((1, 1, block_k, D), kv_index_win),
     ],
-    out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
-    scratch_shapes=[
-      pltpu.VMEM((rows, D), jnp.float32),
-      pltpu.VMEM((rows, 128), jnp.float32),
-      pltpu.VMEM((rows, 128), jnp.float32),
-    ],
+    out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref, win_ref: (b, h, i, 0)),
+    scratch_shapes=scratch,
   )
-
   out = pl.pallas_call(
-    functools.partial(_cached_kernel, block_q=block_q, block_k=block_k, groups=groups, scale=scale),
+    functools.partial(_cached_kernel_windowed, block_q=block_q, block_k=block_k, groups=groups,
+                      scale=scale, softcap=float(softcap)),
     grid_spec=grid_spec,
     out_shape=jax.ShapeDtypeStruct((B, Hkv, T * groups, D), q.dtype),
     interpret=interpret,
-  )(start, qt, kt, vt)
-
+  )(start, win, qt, kt, vt)
   return out.reshape(B, Hkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, T, Hq, D)
 
 
@@ -173,7 +285,11 @@ def flash_decode_attention(
   kv_valid: jnp.ndarray,  # [B] int32 — occupied prefix length (incl. this step)
   block_k: int = 256,
   interpret: bool | None = None,
+  window: jnp.ndarray | None = None,
+  softcap: float = 0.0,
+  scale: float | None = None,
 ) -> jnp.ndarray:
   """Single-token decode attention (T == 1 specialisation)."""
   return flash_cached_attention(q, k, v, kv_valid.astype(jnp.int32) - 1,
-                                block_q=1, block_k=block_k, interpret=interpret)
+                                block_q=1, block_k=block_k, interpret=interpret,
+                                window=window, softcap=softcap, scale=scale)
