@@ -628,3 +628,17 @@ async def test_sampling_extras_validation_and_passthrough():
                     "logit_bias": {"7": -100.0, "9": 50.0}}
   finally:
     await client.close()
+
+
+async def test_image_generations_honest_501():
+  """Endpoint parity with the reference's /v1/image/generations
+  (chatgpt_api.py:214): its only diffusion card is commented out
+  (models.py:180-181), so the route is dead there; here it answers 501
+  with a clear message instead of a 404 or a hang."""
+  client, _, _ = await _api_client()
+  try:
+    resp = await client.post("/v1/image/generations", json={"model": "x", "prompt": "a cat"})
+    assert resp.status == 501
+    assert "not supported" in (await resp.json())["error"]["message"]
+  finally:
+    await client.close()

@@ -117,6 +117,12 @@ class ChatGPTAPI:
     r.add_post("/v1/download", self.handle_post_download)
     r.add_get("/initial_models", self.handle_get_initial_models)
     r.add_get("/quit", self.handle_quit)
+    r.add_post("/quit", self.handle_quit)  # the reference's verb (chatgpt_api.py:218)
+    # Endpoint parity with the reference's /v1/image/generations
+    # (chatgpt_api.py:214,445): its only diffusion card is commented out
+    # (models.py:180-181), so the route is dead there — here it answers
+    # honestly instead of 404ing clients ported from the reference.
+    r.add_post("/v1/image/generations", self.handle_post_image_generations)
     # Observability: span export + prometheus exposition + device traces
     # (the reference declared both intents but wired neither — SURVEY §0, §5).
     r.add_get("/v1/traces", self.handle_get_traces)
@@ -273,6 +279,17 @@ class ChatGPTAPI:
     shard = build_base_shard(model_id, self.inference_engine_classname)
     asyncio.create_task(self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname))
     return web.json_response({"status": "success", "message": f"Download started: {model_id}"})
+
+  async def handle_post_image_generations(self, request):
+    """501: no diffusion model family is registered. The reference exposes
+    the same route but its lone stable-diffusion card is commented out
+    (models.py:180-181), so requests there fail with 'Unsupported model';
+    this is the same truth stated up front."""
+    return web.json_response(
+      {"error": {"type": "invalid_request_error",
+                 "message": "image generation is not supported: no diffusion model "
+                            "family is registered (text and vision-language models only)"}},
+      status=501)
 
   async def handle_quit(self, request):
     response = web.json_response({"detail": "Quit signal received"})
