@@ -144,9 +144,11 @@ class _DecodeBatcher:
     self._drain_task = None  # strong ref: the loop only weakly holds tasks
 
   async def submit(self, request_id: str, state: "_RequestState", prev_token: int,
-                   num_tokens: int, temp: float, top_k: int, top_p: float = 0.0) -> np.ndarray:
+                   num_tokens: int, temp: float, top_k: int, top_p: float = 0.0,
+                   next_size: Optional[int] = None) -> np.ndarray:
     fut = asyncio.get_running_loop().create_future()
-    self.pending.append((request_id, state, prev_token, num_tokens, temp, top_k, top_p, fut))
+    self.pending.append((request_id, state, prev_token, num_tokens, temp, top_k, top_p,
+                         next_size, fut))
     if not self._draining:
       self._draining = True
       self._drain_task = asyncio.create_task(self._drain())
@@ -262,6 +264,17 @@ class JAXShardInferenceEngine(InferenceEngine):
     # executor thread while the API pops from the event-loop thread.
     self._logprob_store: "OrderedDict[str, list]" = OrderedDict()
     self._logprob_lock = threading.Lock()
+    # Speculatively dispatched next decode chunks (request_id -> record):
+    # while the host ingests chunk N's tokens (EOS scan, broadcast), chunk
+    # N+1 already runs on device — its input (chunk N's last token) is a
+    # DEVICE array, so no host value is needed to start it. Mispredictions
+    # (EOS stopped the request, the node shrank the next chunk, a verify
+    # step interleaved) just roll back state.pos; the cache slots written
+    # past pos are invisible to the validity mask and get overwritten, the
+    # same free-rollback design as verify_draft.
+    self._spec_next: Dict[str, dict] = {}
+    self._overlap_hits = 0
+    self._overlap_misses = 0
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -764,7 +777,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     # demand), not the raw draft length — near the cache end a raw-length
     # guard would pass and then _prep_state would raise CacheExhausted,
     # ending the request early where plain decode drains to the last slot.
-    if state.pos + _bucket(1 + len(draft)) > ctx.max_cache_len:
+    # COMMITTED position: an in-flight speculative chunk inflates state.pos
+    # by its size (and will be rolled back by _prep_state) — judging room by
+    # the inflated pos would disable speculation one chunk early.
+    spec = self._spec_next.get(request_id)
+    committed_pos = (spec["pos"] if spec is not None and state.pos == spec["pos"] + spec["n"]
+                     else state.pos)
+    if committed_pos + _bucket(1 + len(draft)) > ctx.max_cache_len:
       return None  # no room to verify: caller falls back to plain decode
     # Refresh LRU at BOTH levels (same reasoning as generate_chunk): a
     # request decoding purely through accepted drafts must not have its
@@ -932,6 +951,7 @@ class JAXShardInferenceEngine(InferenceEngine):
   async def generate_chunk(
     self, request_id: str, shard: Shard, prev_token: int, num_tokens: int,
     temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K, top_p: float = 0.0,
+    next_size: Optional[int] = None,
   ) -> Optional[np.ndarray]:
     """Fused multi-token decode (models/generate.py): one device dispatch
     produces UP TO `num_tokens` sampled tokens, with sampling on-device under
@@ -968,16 +988,24 @@ class JAXShardInferenceEngine(InferenceEngine):
     ctx.states.move_to_end(request_id)
     # The chunk advances the cache by num_tokens starting at pos (the slot of
     # prev_token's forward step is pos, the last sampled token's is pos+K-1).
-    if state.pos + num_tokens > ctx.max_cache_len:
-      if state.pos + 1 > ctx.max_cache_len:
-        raise CacheExhausted(f"request {request_id}: cache full at {state.pos}/{ctx.max_cache_len}")
+    # Capacity math MUST use the COMMITTED position: with a speculative
+    # chunk in flight state.pos is optimistically advanced by its size, and
+    # judging capacity by the inflated pos would raise CacheExhausted one
+    # chunk early — dropping a final chunk the device already computed.
+    spec = self._spec_next.get(request_id)
+    committed_pos = (spec["pos"] if spec is not None and state.pos == spec["pos"] + spec["n"]
+                     else state.pos)
+    if committed_pos + num_tokens > ctx.max_cache_len:
+      if committed_pos + 1 > ctx.max_cache_len:
+        raise CacheExhausted(
+          f"request {request_id}: cache full at {committed_pos}/{ctx.max_cache_len}")
       # Shrink to the cache tail and keep the FUSED path to the very end —
       # with the adaptive growth ladder (node.py) the tail can be up to
       # max_decode_chunk_size-1 tokens, far too many to hand to the
       # per-token ring at one host round-trip each. Largest power of two
       # <= tail stays on the compiled-size ladder (at most log2 extra
       # dispatches to drain the tail); the check above guaranteed tail >= 1.
-      tail = ctx.max_cache_len - state.pos
+      tail = ctx.max_cache_len - committed_pos
       num_tokens = min(num_tokens, 1 << (tail.bit_length() - 1))
 
     if self._decode_batch_max() > 1 and state.extras is None:
@@ -989,11 +1017,13 @@ class JAXShardInferenceEngine(InferenceEngine):
       if ctx.batcher is None:
         ctx.batcher = _DecodeBatcher(self, ctx)
       return await ctx.batcher.submit(request_id, state, prev_token, num_tokens,
-                                      float(temp), int(top_k), float(top_p))
+                                      float(temp), int(top_k), float(top_p),
+                                      next_size=next_size)
 
     def _chunk() -> np.ndarray:
       return self._decode_batch_sync(
-        ctx, [(request_id, state, prev_token, num_tokens, float(temp), top_k, float(top_p), None)],
+        ctx, [(request_id, state, prev_token, num_tokens, float(temp), top_k, float(top_p),
+               next_size, None)],
         num_tokens, int(top_k), float(top_p),
       )[0]
 
@@ -1001,6 +1031,19 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _decode_batch_max(self) -> int:
     return int(os.getenv("XOT_DECODE_BATCH", "8"))
+
+  def _overlap_on(self) -> bool:
+    """XOT_OVERLAP_CHUNKS: speculative next-chunk dispatch (default on)."""
+    return os.getenv("XOT_OVERLAP_CHUNKS", "1") != "0"
+
+  def _discard_spec(self, request_id: str, state: Optional["_RequestState"] = None) -> None:
+    """Drop a request's in-flight speculative chunk and roll back the
+    optimistic position advance. Called whenever any OTHER operation is
+    about to touch the request's device state (segment forwards, draft
+    verification, cleanup) — their view of pos must be the committed one."""
+    spec = self._spec_next.pop(request_id, None)
+    if spec is not None and state is not None and state.pos == spec["pos"] + spec["n"]:
+      state.pos = spec["pos"]
 
   def _decode_batch_sync(self, ctx: _ShardContext, items: list, num_tokens: int,
                          top_k: int, top_p: float = 0.0) -> list:
@@ -1018,38 +1061,101 @@ class JAXShardInferenceEngine(InferenceEngine):
     from xotorch_tpu.models.generate import decode_chunk
 
     states = [it[1] for it in items]
+
+    if len(items) == 1:
+      rid, state = items[0][0], states[0]
+      prev_token, temp = int(items[0][2]), float(items[0][4])
+      next_size = items[0][7] if len(items[0]) > 8 else None
+      extras = state.extras
+
+      # Speculative-chunk resolution: if the LAST call dispatched this very
+      # chunk ahead of time (same input token / size / sampling), its device
+      # result is (likely) already computed — skip the dispatch entirely.
+      # Any mismatch rolls pos back and decodes normally; the mispredicted
+      # cache writes sit past pos, invisible and overwritten.
+      spec = self._spec_next.pop(rid, None)
+      spec_hit = (
+        spec is not None and extras is None
+        and spec["prev"] == prev_token and spec["n"] == num_tokens
+        and spec["temp"] == temp and spec["top_k"] == top_k and spec["top_p"] == top_p
+        and state.pos == spec["pos"] + spec["n"]
+      )
+      if spec is not None:
+        self._overlap_hits += spec_hit
+        self._overlap_misses += not spec_hit
+      if spec is not None and not spec_hit and state.pos == spec["pos"] + spec["n"]:
+        state.pos = spec["pos"]
+
+      if spec_hit:
+        toks = spec["toks"]
+      else:
+        if state.pos + num_tokens > state.cache["k"].shape[2]:
+          self._grow_cache(ctx, state, state.pos + num_tokens)
+        use_fd = (self._pallas_kernels_ok(ctx.cfg)
+                  and self._flash_decode_on(state.cache["k"].shape[2]))
+        key = self._extras_key(state, extras, request_id=rid)
+        e = extras or {}
+        want_lp = e.get("logprobs")
+        tok = jnp.asarray([[prev_token]], dtype=jnp.int32)
+        out = decode_chunk(
+          ctx.params, tok, state.cache, jnp.int32(state.pos), key,
+          ctx.cfg, num_tokens, temp, top_k, top_p, use_flash_decode=use_fd,
+          bias=e.get("bias"), counts=e.get("counts"),
+          presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+          top_lp=-1 if want_lp is None else int(want_lp),
+        )
+        out = list(out)
+        if want_lp is not None:
+          lp, top_ids, top_lps = out.pop()  # [B, T], [B, T, K] — batch row 0
+          self._record_logprobs(rid, np.asarray(lp[0]), np.asarray(top_ids[0]),
+                                np.asarray(top_lps[0]))
+        if e.get("counts") is not None:
+          toks, state.cache, extras["counts"] = out
+        else:
+          toks, state.cache = out
+        state.pos += num_tokens
+
+      # Dispatch the NEXT chunk before fetching this one's tokens: its
+      # input is this chunk's last token — a device array — so the device
+      # crunches chunk N+1 while the host runs the EOS scan and broadcast
+      # for chunk N. This hides the host round-trip that otherwise
+      # serializes every chunk boundary (the dominant per-chunk cost on a
+      # tunneled TPU; still real time on local PCIe). Plain requests only:
+      # extras carry host-side state (counts/logprobs) per chunk.
+      spec_rec = None
+      if (extras is None and next_size and self._overlap_on()
+          and state.pos + int(next_size) <= ctx.max_cache_len):
+        if state.pos + int(next_size) > state.cache["k"].shape[2]:
+          self._grow_cache(ctx, state, state.pos + int(next_size))
+        use_fd2 = (self._pallas_kernels_ok(ctx.cfg)
+                   and self._flash_decode_on(state.cache["k"].shape[2]))
+        self._sample_calls += 1
+        key2 = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+        pos_before = state.pos
+        ntoks, state.cache = decode_chunk(
+          ctx.params, toks[:, -1:].astype(jnp.int32), state.cache, jnp.int32(pos_before),
+          key2, ctx.cfg, int(next_size), temp, top_k, top_p, use_flash_decode=use_fd2,
+        )
+        state.pos += int(next_size)
+        spec_rec = {"toks": ntoks, "n": int(next_size), "pos": pos_before,
+                    "temp": temp, "top_k": top_k, "top_p": top_p}
+
+      host = np.asarray(toks[0])  # fetch chunk N; chunk N+1 keeps computing
+      if spec_rec is not None:
+        spec_rec["prev"] = int(host[-1])
+        self._spec_next[rid] = spec_rec
+      state.last_used = time.monotonic()
+      return [host.astype(np.int64)]
+
+    # Multi-request batch: membership changed under any in-flight
+    # speculation — commit the rolled-back positions first.
+    for it in items:
+      self._discard_spec(it[0], it[1])
     for state in states:
       if state.pos + num_tokens > state.cache["k"].shape[2]:
         self._grow_cache(ctx, state, state.pos + num_tokens)
     use_fd = (self._pallas_kernels_ok(ctx.cfg)
               and self._flash_decode_on(max(s.cache["k"].shape[2] for s in states)))
-
-    if len(items) == 1:
-      state = states[0]
-      extras = state.extras
-      key = self._extras_key(state, extras, request_id=items[0][0])
-      e = extras or {}
-      want_lp = e.get("logprobs")
-      tok = jnp.asarray([[items[0][2]]], dtype=jnp.int32)
-      out = decode_chunk(
-        ctx.params, tok, state.cache, jnp.int32(state.pos), key,
-        ctx.cfg, num_tokens, float(items[0][4]), top_k, top_p, use_flash_decode=use_fd,
-        bias=e.get("bias"), counts=e.get("counts"),
-        presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
-        top_lp=-1 if want_lp is None else int(want_lp),
-      )
-      out = list(out)
-      if want_lp is not None:
-        lp, top_ids, top_lps = out.pop()  # [B, T], [B, T, K] — batch row 0
-        self._record_logprobs(items[0][0], np.asarray(lp[0]), np.asarray(top_ids[0]),
-                              np.asarray(top_lps[0]))
-      if e.get("counts") is not None:
-        toks, state.cache, extras["counts"] = out
-      else:
-        toks, state.cache = out
-      state.pos += num_tokens
-      state.last_used = time.monotonic()
-      return [np.asarray(toks[0]).astype(np.int64)]
 
     self._sample_calls += 1
     key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
@@ -1098,6 +1204,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     starts, which would silently overwrite earlier cache slots. Runs on the
     engine executor (it may touch the device to grow the cache)."""
     state = self._get_or_create_state(ctx, request_id, min_len=bucket)
+    # A segment forward (prefill, per-token ring, draft verify) supersedes
+    # any speculatively dispatched chunk: commit the rolled-back position
+    # before capacity math.
+    self._discard_spec(request_id, state)
     needed = state.pos + bucket
     if needed > ctx.max_cache_len:
       raise CacheExhausted(
@@ -1611,5 +1721,6 @@ class JAXShardInferenceEngine(InferenceEngine):
     return loss
 
   async def clear_request(self, request_id: str) -> None:
+    self._spec_next.pop(request_id, None)
     for ctx in self._contexts.values():
       ctx.states.pop(request_id, None)

@@ -609,10 +609,19 @@ class Node:
                 return
               continue
         this_size = min(size, 1 << (remaining - 1).bit_length())
+        # Next-chunk size hint for the engine's speculative dispatch: what
+        # THIS loop will ask for next if no EOS lands in this chunk — the
+        # ladder's next rung clipped to the cap that will remain. The engine
+        # overlaps that chunk with our EOS scan; a misprediction (EOS, cap)
+        # is a free rollback on its side.
+        rem_after = remaining - this_size
+        next_hint = (min(min(size * 2, self.max_decode_chunk_size),
+                         1 << (rem_after - 1).bit_length())
+                     if rem_after >= 1 else None)
         chunk = await gen(
           request_id, shard, buffered[-1], this_size,
           temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
-          top_p=self._top_p_for(request_id),
+          top_p=self._top_p_for(request_id), next_size=next_hint,
         )
         if chunk is None:
           # Fast path unavailable (cache nearly full, shard changed): fall
