@@ -164,8 +164,10 @@ async def test_mixed_chunk_sizes_coalesce_at_min(tiny_model_dir, monkeypatch):
 
 async def test_batched_rows_at_different_depths(tiny_model_dir, monkeypatch):
   """Requests whose caches sit at very different positions (one grew past
-  its initial buffer) still batch correctly — per-row positions + padded
-  stack."""
+  its initial buffer) still batch correctly — per-row positions; members
+  grow to a COMMON buffer length so the fused stack/decode/split
+  executable (models/generate.decode_chunk_batched) specializes on one
+  shape tuple."""
   monkeypatch.setenv("XOT_SEED", "7")
   monkeypatch.setenv("XOT_CACHE_LEN", "16")  # force growth on the long request
   shard = _full_shard()
@@ -185,10 +187,12 @@ async def test_batched_rows_at_different_depths(tiny_model_dir, monkeypatch):
   )
   assert got_long == want["long"]
   assert got_short == want["short"]
-  # The two requests' cache buffers really were different sizes.
+  # Uniform-growth invariant: batching grew the short request's buffer to
+  # the long one's length (one compiled shape tuple per batch width), and
+  # the batch really did span different DEPTHS (positions).
   states = eng._contexts[shard].states
-  sizes = {states["long"].cache["k"].shape[2], states["short"].cache["k"].shape[2]}
-  assert len(sizes) == 2
+  assert states["long"].cache["k"].shape[2] == states["short"].cache["k"].shape[2]
+  assert states["long"].pos != states["short"].pos
 
 
 async def test_mixed_temperatures_share_one_dispatch(tiny_model_dir, monkeypatch):

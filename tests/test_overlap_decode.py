@@ -156,6 +156,117 @@ async def test_cache_tail_uses_committed_pos(tiny_model_dir, monkeypatch):
   assert on == off, f"overlap drained {len(on)} tokens, sequential {len(off)}"
 
 
+async def _batched_ladder(eng, rid, prompt, n_total, size=4, cap=8, temp=0.0):
+  """Concurrent-request driver through the BATCHER (default XOT_DECODE_BATCH):
+  same ladder + hint math as the node's fused loop."""
+  import numpy as _np
+  logits, _ = await eng.infer_tensor(rid, FULL, prompt)
+  toks = [int(_np.argmax(logits[0, -1]))]
+  remaining = n_total
+  while remaining > 0:
+    this = min(size, 1 << (remaining - 1).bit_length())
+    rem_after = remaining - this
+    hint = (min(min(size * 2, cap), 1 << (rem_after - 1).bit_length())
+            if rem_after >= 1 else None)
+    out = await eng.generate_chunk(rid, FULL, toks[-1], this, temp=temp, top_k=0,
+                                   next_size=hint)
+    toks.extend(int(t) for t in out)
+    remaining -= len(out)
+    size = min(size * 2, cap)
+  return toks
+
+
+async def test_batch_overlap_matches_solo_streams(tiny_model_dir, monkeypatch):
+  """Batch-level overlap (XOT_OVERLAP_BATCH=1 opt-in — default off because
+  jittery membership makes it thrash, engine._batch_overlap_on): three
+  concurrent requests coalesce in the batcher and the NEXT batch is
+  speculatively dispatched from the current batch's device-side last
+  tokens. Every stream must equal its solo run, and the speculative batch
+  must actually have resolved at least once."""
+  import asyncio
+  monkeypatch.setenv("XOT_OVERLAP_BATCH", "1")
+  prompts = {
+    "a": np.array([[1, 5, 9, 2]], dtype=np.int64),
+    "b": np.array([[7, 3, 11]], dtype=np.int64),
+    "c": np.array([[42, 17, 5, 9, 100, 3]], dtype=np.int64),
+  }
+  want = {}
+  for rid, p in prompts.items():
+    solo = _engine(tiny_model_dir)
+    want[rid] = await _ladder_decode_prompt(solo, rid, p, 24)
+
+  eng = _engine(tiny_model_dir)
+  results = await asyncio.gather(*(
+    _batched_ladder(eng, rid, p, 24) for rid, p in prompts.items()))
+  got = dict(zip(prompts.keys(), results))
+  assert eng._overlap_batch_hits >= 1, "speculative batch never resolved"
+  for rid in want:
+    assert got[rid] == want[rid], rid
+
+
+async def _ladder_decode_prompt(eng, rid, prompt, n_total, size=4, cap=8):
+  import numpy as _np
+  logits, _ = await eng.infer_tensor(rid, FULL, prompt)
+  toks = [int(_np.argmax(logits[0, -1]))]
+  remaining = n_total
+  while remaining > 0:
+    this = min(size, 1 << (remaining - 1).bit_length())
+    out = await eng.generate_chunk(rid, FULL, toks[-1], this, temp=0.0, top_k=0)
+    toks.extend(int(t) for t in out)
+    remaining -= len(out)
+    size = min(size * 2, cap)
+  return toks
+
+
+async def test_batch_overlap_membership_change_rolls_back(tiny_model_dir, monkeypatch):
+  """One member finishes while a speculative batch is in flight: the others
+  must keep producing their exact solo streams through the re-formed
+  batches (misprediction rollback across the whole batch)."""
+  import asyncio
+  monkeypatch.setenv("XOT_OVERLAP_BATCH", "1")
+  pa = np.array([[1, 5, 9, 2]], dtype=np.int64)
+  pb = np.array([[7, 3, 11]], dtype=np.int64)
+
+  solo_a = await _ladder_decode_prompt(_engine(tiny_model_dir), "a", pa, 40)
+  solo_b = await _ladder_decode_prompt(_engine(tiny_model_dir), "b", pb, 12)
+
+  eng = _engine(tiny_model_dir)
+  res_a, res_b = await asyncio.gather(
+    _batched_ladder(eng, "a", pa, 40),  # long: keeps decoding after b ends
+    _batched_ladder(eng, "b", pb, 12),
+  )
+  await eng.clear_request("b")
+  assert res_a == solo_a
+  assert res_b == solo_b
+
+
+async def test_verify_draft_with_spec_in_flight(tiny_model_dir):
+  """Prompt-lookup verification while a speculative chunk is in flight:
+  verify must read the COMMITTED position (the review repro had it reading
+  the inflated pos, landing post-verify state past the real sequence and
+  pulling stale cache slots into the attention window). The combined
+  stream must equal plain greedy decode."""
+  solo = await _ladder_decode(_engine(tiny_model_dir), "s", 20, size=4, cap=4)
+
+  eng = _engine(tiny_model_dir)
+  logits, _ = await eng.infer_tensor("r", FULL, PROMPT)
+  toks = [int(np.argmax(logits[0, -1]))]
+  out = await eng.generate_chunk("r", FULL, toks[-1], 4, temp=0.0, top_k=0, next_size=4)
+  toks += [int(t) for t in out]
+  assert "r" in eng._spec_next  # speculation in flight
+  # Draft = the TRUE greedy continuation (from the solo run), so verify
+  # accepts everything and appends its bonus token.
+  draft = solo[len(toks):len(toks) + 3]
+  accepted = await eng.verify_draft("r", FULL, toks[-1], draft)
+  assert accepted is not None and list(accepted)[:3] == draft
+  toks += [int(t) for t in accepted]
+  # Continue fused decoding to the end; every token must match solo greedy.
+  while len(toks) < len(solo):
+    out = await eng.generate_chunk("r", FULL, toks[-1], 4, temp=0.0, top_k=0, next_size=4)
+    toks += [int(t) for t in out]
+  assert toks[:len(solo)] == solo
+
+
 async def test_clear_request_drops_spec(tiny_model_dir):
   eng = _engine(tiny_model_dir)
   logits, _ = await eng.infer_tensor("r", FULL, PROMPT)

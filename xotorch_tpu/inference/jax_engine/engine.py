@@ -109,6 +109,10 @@ class _ShardContext:
   opt_state: Any = None
   optimizer: Any = None
   batcher: Any = None  # lazy _DecodeBatcher (continuous batching)
+  # In-flight speculative BATCH chunk (decode overlap for a stable
+  # multi-request batch): {"rids", "n", "toks", "prev", "pos", "temps",
+  # "top_k", "top_p", "states"} — see _decode_batch_sync.
+  batch_spec: Any = None
   # Automatic prefix cache: completed prefills' KV snapshots keyed by token
   # hash — a new prompt sharing a long common prefix (system prompt,
   # multi-turn history) seeds its cache from the snapshot and prefills only
@@ -175,14 +179,25 @@ class _DecodeBatcher:
         groups: Dict[Tuple[int, float], list] = {}
         for item in batch:
           groups.setdefault((item[5], item[6]), []).append(item)
+        cap = self.engine._decode_batch_max()
+        # The context holds ONE speculative batch slot: speculating is only
+        # profitable when this drain cycle is a single dispatch (one
+        # sampling group, within cap). Multiple groups/slices would evict
+        # each other's in-flight batch every cycle — pure wasted device
+        # work at exactly the high-concurrency regime.
+        single_dispatch = (len(groups) == 1
+                           and all(len(g) <= cap for g in groups.values()))
         for (top_k, top_p), items in groups.items():
+          # Stable row order: speculative batch chunks match on the ordered
+          # request tuple, and asyncio wake-up order is not deterministic.
+          items.sort(key=lambda it: it[0])
           num_tokens = min(item[3] for item in items)
-          cap = self.engine._decode_batch_max()
           for off in range(0, len(items), cap):
             chunk_items = items[off:off + cap]
             try:
               results = await self.engine._run(
-                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k, top_p
+                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k, top_p,
+                single_dispatch,
               )
               for (*_, fut), toks in zip(chunk_items, results):
                 if not fut.done():
@@ -275,6 +290,8 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._spec_next: Dict[str, dict] = {}
     self._overlap_hits = 0
     self._overlap_misses = 0
+    self._overlap_batch_hits = 0
+    self._overlap_batch_misses = 0
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -780,9 +797,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     # COMMITTED position: an in-flight speculative chunk inflates state.pos
     # by its size (and will be rolled back by _prep_state) — judging room by
     # the inflated pos would disable speculation one chunk early.
-    spec = self._spec_next.get(request_id)
-    committed_pos = (spec["pos"] if spec is not None and state.pos == spec["pos"] + spec["n"]
-                     else state.pos)
+    committed_pos = self._committed_pos(ctx, request_id, state)
     if committed_pos + _bucket(1 + len(draft)) > ctx.max_cache_len:
       return None  # no room to verify: caller falls back to plain decode
     # Refresh LRU at BOTH levels (same reasoning as generate_chunk): a
@@ -797,6 +812,13 @@ class JAXShardInferenceEngine(InferenceEngine):
                          draft: list) -> list:
     import jax.numpy as jnp
     state = ctx.states[request_id]
+    # Discard in-flight speculation BEFORE capturing pos: _prep_state (via
+    # _forward_segment) would roll state.pos back underneath us, and a
+    # pos_before read from the inflated value would land the post-verify
+    # position past the real sequence — pulling stale cache slots inside
+    # the valid attention window for every later token.
+    self._discard_spec(request_id, state)
+    self._discard_batch_spec_for(ctx, request_id)
     pos_before = state.pos
     x = np.asarray([[prev_token] + draft], dtype=np.int64)
     out, true_t = self._forward_segment(ctx, request_id, x)
@@ -992,9 +1014,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     # chunk in flight state.pos is optimistically advanced by its size, and
     # judging capacity by the inflated pos would raise CacheExhausted one
     # chunk early — dropping a final chunk the device already computed.
-    spec = self._spec_next.get(request_id)
-    committed_pos = (spec["pos"] if spec is not None and state.pos == spec["pos"] + spec["n"]
-                     else state.pos)
+    committed_pos = self._committed_pos(ctx, request_id, state)
     if committed_pos + num_tokens > ctx.max_cache_len:
       if committed_pos + 1 > ctx.max_cache_len:
         raise CacheExhausted(
@@ -1036,6 +1056,17 @@ class JAXShardInferenceEngine(InferenceEngine):
     """XOT_OVERLAP_CHUNKS: speculative next-chunk dispatch (default on)."""
     return os.getenv("XOT_OVERLAP_CHUNKS", "1") != "0"
 
+  def _batch_overlap_on(self) -> bool:
+    """XOT_OVERLAP_BATCH: speculative next-BATCH dispatch (default off).
+    Measured on the bench TPU, concurrent batch membership jitters cycle to
+    cycle (requests sit at different ladder rungs and caps), so most
+    speculative batches missed and their wasted chunks cost more than the
+    overlap saved (279 vs 357 tok/s aggregate). The fused
+    stack/decode/split executable carries the batched win instead; flip
+    this on for workloads with genuinely stable membership (fixed-width
+    lockstep batch serving)."""
+    return os.getenv("XOT_OVERLAP_BATCH", "0") == "1"
+
   def _discard_spec(self, request_id: str, state: Optional["_RequestState"] = None) -> None:
     """Drop a request's in-flight speculative chunk and roll back the
     optimistic position advance. Called whenever any OTHER operation is
@@ -1045,16 +1076,54 @@ class JAXShardInferenceEngine(InferenceEngine):
     if spec is not None and state is not None and state.pos == spec["pos"] + spec["n"]:
       state.pos = spec["pos"]
 
+  def _discard_batch_spec(self, ctx: "_ShardContext") -> None:
+    """Drop an in-flight speculative BATCH chunk: roll every member's
+    optimistic position advance back to its committed value. Cache contents
+    past the committed positions are invisible and get overwritten — same
+    free-rollback property as the single-request path."""
+    spec, ctx.batch_spec = ctx.batch_spec, None
+    if spec is None:
+      return
+    for st, p in zip(spec["states"], spec["pos"]):
+      if st.pos == p + spec["n"]:
+        st.pos = p
+
+  def _discard_batch_spec_for(self, ctx: "_ShardContext", request_id: str) -> None:
+    """Discard the context's speculative batch IF this request is a member —
+    the single guard every path that supersedes batch speculation must run
+    (segment forwards, draft verify, membership shrink, cleanup)."""
+    if ctx.batch_spec is not None and request_id in ctx.batch_spec["rids"]:
+      self._discard_batch_spec(ctx)
+
+  def _committed_pos(self, ctx: "_ShardContext", request_id: str,
+                     state: "_RequestState") -> int:
+    """The request's position EXCLUDING any in-flight speculative chunk —
+    what capacity/room checks must judge by (the optimistic advance rolls
+    back for free; treating it as real would end requests a chunk early)."""
+    spec = self._spec_next.get(request_id)
+    if spec is not None and state.pos == spec["pos"] + spec["n"]:
+      return spec["pos"]
+    b = ctx.batch_spec
+    if b is not None and request_id in b["rids"]:
+      i = b["rids"].index(request_id)
+      if state.pos == b["pos"][i] + b["n"]:
+        return b["pos"][i]
+    return state.pos
+
   def _decode_batch_sync(self, ctx: _ShardContext, items: list, num_tokens: int,
-                         top_k: int, top_p: float = 0.0) -> list:
+                         top_k: int, top_p: float = 0.0,
+                         allow_batch_spec: bool = True) -> list:
     """Run one fused decode chunk for 1..B requests in a single dispatch.
 
     B == 1 keeps the existing single-request executable (cache donated in
-    place). B > 1 stacks the requests' caches along the batch axis (padded
-    to the longest buffer; kv_valid_len masks the tail), decodes with
-    PER-ROW positions (transformer.forward_shard vector start_pos), and
-    splits the updated cache back. The stack/split copies move KV bytes —
-    small next to the (B-1)x parameter re-reads the batching saves, since
+    place). B > 1 first GROWS every member's resident cache to a common
+    power-of-two length (uniform shapes -> one compiled stack/decode/split
+    executable per batch width; the cost is that a short request batched
+    with a long one keeps the long buffer until it finishes — bounded by
+    max_cache_len, and OOM recovery can still evict), then decodes with
+    PER-ROW positions (transformer.forward_shard vector start_pos) inside
+    models/generate.decode_chunk_batched — stack, scan, and split are ONE
+    compiled program, not dozens of eager dispatches, since
     decode at batch 1 is HBM-bandwidth-bound on the weights."""
     import jax
     import jax.numpy as jnp
@@ -1067,6 +1136,9 @@ class JAXShardInferenceEngine(InferenceEngine):
       prev_token, temp = int(items[0][2]), float(items[0][4])
       next_size = items[0][7] if len(items[0]) > 8 else None
       extras = state.extras
+      # Membership shrank to one: the speculative batch can't resolve
+      # through this path — commit the rolled-back positions.
+      self._discard_batch_spec_for(ctx, rid)
 
       # Speculative-chunk resolution: if the LAST call dispatched this very
       # chunk ahead of time (same input token / size / sampling), its device
@@ -1121,9 +1193,17 @@ class JAXShardInferenceEngine(InferenceEngine):
       # for chunk N. This hides the host round-trip that otherwise
       # serializes every chunk boundary (the dominant per-chunk cost on a
       # tunneled TPU; still real time on local PCIe). Plain requests only:
-      # extras carry host-side state (counts/logprobs) per chunk.
+      # extras carry host-side state (counts/logprobs) per chunk. And only
+      # when NO other request is actively decoding — under concurrency this
+      # request's next chunk will coalesce into a BATCH (different
+      # executable, different membership), so the solo speculation would
+      # miss every time and its wasted chunks cost more than they save
+      # (measured: 324 vs 357 tok/s aggregate at 8 streams).
+      now = time.monotonic()
+      others_active = any(st is not state and now - st.last_used < 1.0
+                          for st in ctx.states.values())
       spec_rec = None
-      if (extras is None and next_size and self._overlap_on()
+      if (extras is None and next_size and self._overlap_on() and not others_active
           and state.pos + int(next_size) <= ctx.max_cache_len):
         if state.pos + int(next_size) > state.cache["k"].shape[2]:
           self._grow_cache(ctx, state, state.pos + int(next_size))
@@ -1147,55 +1227,96 @@ class JAXShardInferenceEngine(InferenceEngine):
       state.last_used = time.monotonic()
       return [host.astype(np.int64)]
 
-    # Multi-request batch: membership changed under any in-flight
-    # speculation — commit the rolled-back positions first.
+    # Multi-request batch: any SINGLE-request speculation is superseded —
+    # commit those rolled-back positions first.
     for it in items:
       self._discard_spec(it[0], it[1])
-    for state in states:
-      if state.pos + num_tokens > state.cache["k"].shape[2]:
-        self._grow_cache(ctx, state, state.pos + num_tokens)
-    use_fd = (self._pallas_kernels_ok(ctx.cfg)
-              and self._flash_decode_on(max(s.cache["k"].shape[2] for s in states)))
 
-    self._sample_calls += 1
-    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
-
-    S_max = max(s.cache["k"].shape[2] for s in states)
-
-    def padded(c):
-      if c.shape[2] == S_max:
-        return c
-      pad = [(0, 0)] * c.ndim
-      pad[2] = (0, S_max - c.shape[2])
-      return jnp.pad(c, pad)
+    def dispatch_batch(row_tokens_dev, n_toks: int, temps):
+      """One batched fused chunk over the CURRENT states, fully inside ONE
+      compiled program (models/generate.decode_chunk_batched): stack the
+      caches, decode, split back — eager per-leaf concat/slice ops here
+      used to cost dozens of dispatches per cycle, which dominated the
+      batched path end to end. Members first grow to a COMMON power-of-two
+      cache length so the executable specializes on one shape tuple.
+      `row_tokens_dev` is [B, 1]. Returns the [B, n_toks] device tokens."""
+      from xotorch_tpu.models.generate import decode_chunk_batched
+      target = max(max(s.pos + n_toks for s in states),
+                   max(s.cache["k"].shape[2] for s in states))
+      for state in states:
+        if state.cache["k"].shape[2] < target:
+          self._grow_cache(ctx, state, target)
+      S_uniform = states[0].cache["k"].shape[2]
+      use_fd = (self._pallas_kernels_ok(ctx.cfg) and self._flash_decode_on(S_uniform))
+      pos_vec = jnp.asarray([s.pos for s in states], dtype=jnp.int32)
+      # Per-ROW temperatures (traced): mixed-temperature requests share the
+      # dispatch; dummy pad rows are built inside the executable.
+      temp_vec = jnp.asarray(list(temps), jnp.float32)
+      self._sample_calls += 1
+      key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+      out, new_caches = decode_chunk_batched(
+        ctx.params, tuple(s.cache for s in states), row_tokens_dev, pos_vec, key,
+        ctx.cfg, n_toks, temp_vec, top_k, top_p, use_flash_decode=use_fd,
+        pad_rows=B_pad - B,
+      )
+      for state, c in zip(states, new_caches):
+        state.cache = c
+        state.pos += n_toks
+      return out
 
     # Pad the batch width to a power of two (dummy rows replicate row 0 and
     # are discarded): bounds the decode executables to log2(B_max) widths
     # instead of one compile per distinct concurrency level mid-serving.
     B = len(states)
     B_pad = _bucket(B, 1)
-    row_states = states + [states[0]] * (B_pad - B)
-    row_tokens = [it[2] for it in items] + [items[0][2]] * (B_pad - B)
+    rids = tuple(it[0] for it in items)
+    temps = tuple(float(it[4]) for it in items)
+    prevs = [int(it[2]) for it in items]
 
-    cache_b = {
-      name: jnp.concatenate([padded(s.cache[name]) for s in row_states], axis=1)
-      for name in states[0].cache  # generic: int8 caches carry scale leaves
-    }
-    toks_in = jnp.asarray([[t] for t in row_tokens], dtype=jnp.int32)
-    pos_vec = jnp.asarray([s.pos for s in row_states], dtype=jnp.int32)
-    # Per-ROW temperatures (traced): mixed-temperature requests share the
-    # dispatch; dummy pad rows replicate row 0's.
-    temp_vec = jnp.asarray([it[4] for it in items] + [items[0][4]] * (B_pad - B), jnp.float32)
-    out, cache_b = decode_chunk(
-      ctx.params, toks_in, cache_b, pos_vec, key,
-      ctx.cfg, num_tokens, temp_vec, top_k, top_p, use_flash_decode=use_fd,
+    # Resolve an in-flight speculative batch: same ordered membership, same
+    # size/temps/sampling constants, each row's input token matching — its
+    # device result IS this batch's answer, no dispatch needed.
+    bspec = ctx.batch_spec
+    bhit = (
+      bspec is not None
+      and bspec["rids"] == rids and bspec["n"] == num_tokens
+      and bspec["temps"] == temps and bspec["top_k"] == top_k and bspec["top_p"] == top_p
+      and bspec["prev"] == prevs
+      and all(st.pos == p + num_tokens for st, p in zip(bspec["states"], bspec["pos"]))
     )
-    out_np = np.asarray(out)
-    for i, state in enumerate(states):
-      S_i = state.cache["k"].shape[2]
-      state.cache = {name: cache_b[name][:, i:i + 1, :S_i] for name in cache_b}
-      state.pos += num_tokens
-      state.last_used = time.monotonic()
+    if bspec is not None:
+      self._overlap_batch_hits += bhit
+      self._overlap_batch_misses += not bhit
+    if bhit:
+      ctx.batch_spec = None
+      out = bspec["toks"]  # caches were split and positions advanced at dispatch
+    else:
+      self._discard_batch_spec(ctx)
+      out = dispatch_batch(jnp.asarray([[t] for t in prevs], jnp.int32), num_tokens, temps)
+
+    # Speculative NEXT batch: dispatch it from this batch's device-side last
+    # tokens before fetching this batch's results — the device crunches
+    # chunk N+1 while every member's loop ingests chunk N (the same overlap
+    # as the single-request path, multiplied by the batch width).
+    next_sizes = [it[7] if len(it) > 8 else None for it in items]
+    spec_rec = None
+    if (allow_batch_spec and self._batch_overlap_on() and all(ns for ns in next_sizes)
+        and all(s.extras is None for s in states)):
+      n2 = min(int(ns) for ns in next_sizes)
+      if all(s.pos + n2 <= ctx.max_cache_len for s in states):
+        pos2 = [s.pos for s in states]
+        toks2 = dispatch_batch(out[:, -1:].astype(jnp.int32), n2, temps)
+        spec_rec = {"rids": rids, "n": n2, "toks": toks2, "prev": None, "pos": pos2,
+                    "temps": temps, "top_k": top_k, "top_p": top_p,
+                    "states": list(states)}
+
+    out_np = np.asarray(out)  # fetch chunk N; the speculative batch keeps computing
+    if spec_rec is not None:
+      spec_rec["prev"] = [int(out_np[i, -1]) for i in range(len(states))]
+      ctx.batch_spec = spec_rec
+    now = time.monotonic()
+    for state in states:
+      state.last_used = now
     return [out_np[i].astype(np.int64) for i in range(len(states))]
 
   def _prep_state(self, ctx: _ShardContext, request_id: str, bucket: int) -> _RequestState:
@@ -1208,6 +1329,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     # any speculatively dispatched chunk: commit the rolled-back position
     # before capacity math.
     self._discard_spec(request_id, state)
+    self._discard_batch_spec_for(ctx, request_id)
     needed = state.pos + bucket
     if needed > ctx.max_cache_len:
       raise CacheExhausted(
@@ -1721,6 +1843,15 @@ class JAXShardInferenceEngine(InferenceEngine):
     return loss
 
   async def clear_request(self, request_id: str) -> None:
-    self._spec_next.pop(request_id, None)
-    for ctx in self._contexts.values():
-      ctx.states.pop(request_id, None)
+    # Runs ON THE EXECUTOR: discarding a batch spec rolls back OTHER live
+    # requests' positions, which must never race a dispatch that is reading
+    # them on the executor thread (every pos mutation is serialized there).
+    def _clear():
+      self._spec_next.pop(request_id, None)
+      for ctx in self._contexts.values():
+        # A member finished: the batch's membership changes, so the
+        # speculative batch can never resolve — roll the others back.
+        self._discard_batch_spec_for(ctx, request_id)
+        ctx.states.pop(request_id, None)
+
+    await self._run(_clear, oom_as_cache_exhausted=False)

@@ -156,3 +156,55 @@ def decode_chunk(
   if want_lp:
     out.append(aux)
   return tuple(out)
+
+
+@partial(
+  jax.jit,
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "pad_rows"),
+  donate_argnames=("caches",),
+)
+def decode_chunk_batched(
+  params,
+  caches: Tuple[Dict[str, jnp.ndarray], ...],  # B per-request caches, UNIFORM shapes
+  toks: jnp.ndarray,  # [B, 1] int32 — each request's last sampled token
+  pos_vec: jnp.ndarray,  # [B] int32 per-request positions
+  key: jax.Array,
+  cfg: ModelConfig,
+  num_tokens: int,
+  temps: jnp.ndarray,  # [B] per-request temperatures (traced)
+  top_k: int,
+  top_p: float = 0.0,
+  use_flash_decode: bool = False,
+  pad_rows: int = 0,  # static: dummy rows padding B to a power of two
+):
+  """Batched fused decode for continuous batching, ONE executable end to
+  end: stack the requests' caches along the batch axis, run the decode
+  scan, split the updated caches back per request. Fusing the stack/split
+  into the compiled program matters twice — XLA schedules the copies next
+  to the compute instead of as dozens of EAGER ops (each a separate
+  dispatch: on a remote/tunneled device that overhead dominated the whole
+  batched path), and donation lets it reuse the input cache buffers.
+
+  Dummy pad rows (static count) are zeros built inside the program — pads
+  keep the executable count at log2(max batch) widths without donating the
+  same real buffer twice. Returns ([B_real, num_tokens] tokens, tuple of
+  B_real updated caches). Requires every cache to share one shape (the
+  engine grows members to a common length before calling).
+  """
+  B = len(caches)
+  cache_b = {
+    name: jnp.concatenate(
+      [c[name] for c in caches]
+      + [jnp.zeros_like(caches[0][name])] * pad_rows, axis=1)
+    for name in caches[0]
+  }
+  if pad_rows:
+    toks = jnp.concatenate([toks, jnp.broadcast_to(toks[:1], (pad_rows, 1))], axis=0)
+    pos_vec = jnp.concatenate([pos_vec, jnp.broadcast_to(pos_vec[:1], (pad_rows,))])
+    temps = jnp.concatenate([temps, jnp.broadcast_to(temps[:1], (pad_rows,))])
+  out, cache_b = decode_chunk(
+    params, toks, cache_b, pos_vec, key, cfg, num_tokens, temps, top_k, top_p,
+    use_flash_decode=use_flash_decode,
+  )
+  split = tuple({name: cache_b[name][:, i:i + 1] for name in cache_b} for i in range(B))
+  return out[:B], split
