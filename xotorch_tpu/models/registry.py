@@ -107,6 +107,20 @@ model_cards: Dict[str, Dict] = {
       "norm_topk_prob": True,
     },
   },
+  # Gemma2 architecture knobs end to end (sandwich norms, soft-caps,
+  # ALTERNATING sliding window) without a download — exercised by the
+  # multichip dryrun's windowed-family tp case.
+  "synthetic-tiny-gemma2": {
+    "layers": 4, "repo": {JAX: "synthetic"},
+    "synthetic_config": {
+      "model_type": "gemma2", "hidden_size": 64, "intermediate_size": 128,
+      "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+      "num_hidden_layers": 4, "vocab_size": 256, "max_position_embeddings": 2048,
+      "rope_theta": 10000.0, "eos_token_id": 2,
+      "sliding_window": 8, "attn_logit_softcapping": 50.0,
+      "final_logit_softcapping": 30.0, "query_pre_attn_scalar": 16.0,
+    },
+  },
 }
 
 pretty_names: Dict[str, str] = {
