@@ -145,8 +145,11 @@ def test_int4_grouped_roundtrip_and_forward():
   from xotorch_tpu.models.quantize import quantize_tensor_grouped, dequantize_tensor_grouped
   w = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 48), jnp.float32)
   q, gscale = quantize_tensor_grouped(w, scale_dtype=jnp.float32, group_size=16)
-  assert q.shape == (2, 4, 16, 48) and gscale.shape == (2, 4, 48)
-  assert q.dtype == jnp.int4
+  # PACKED uint8 container: two nibbles per byte along the group axis (a
+  # native S4 array crossing a jit boundary breaks some backends' transfer
+  # paths -- the tunneled TPU's recursed into jit).
+  assert q.shape == (2, 4, 8, 48) and gscale.shape == (2, 4, 48)
+  assert q.dtype == jnp.uint8
   back = dequantize_tensor_grouped(q, gscale, jnp.float32)
   err = np.abs(np.asarray(back) - np.asarray(w))
   bound = np.repeat(np.asarray(gscale), 16, axis=1) * 0.5 + 1e-6
@@ -154,7 +157,7 @@ def test_int4_grouped_roundtrip_and_forward():
 
   cfg, params = _tiny()
   qparams = quantize_params(params, "int4", scale_dtype=jnp.float32)
-  assert qparams["layers"]["wq"].dtype == jnp.int4
+  assert qparams["layers"]["wq"].dtype == jnp.uint8
   assert "wq_gscale" in qparams["layers"]
   assert qparams["embed"]["embedding"].dtype == jnp.int8  # embeddings stay int8
   # int4 layer slots + int8 embeddings: well under half the f32 bytes.
@@ -312,3 +315,22 @@ async def test_engine_quantized_full_train_rejected(tmp_path):
   x = np.random.RandomState(0).randint(0, 255, (1, 8))
   with pytest.raises(ValueError, match="LoRA"):
     await eng.train_example("t", shard, x, x, np.array([8]))
+
+
+def test_int4_pallas_matvec_matches_dequant():
+  """The decode-path Pallas kernel (in-register nibble unpack,
+  ops/int4_matmul.py) must equal the full dequantize-then-matmul oracle
+  for 1..8 rows and non-trivial group counts."""
+  from xotorch_tpu.models.quantize import dequantize_tensor_grouped, quantize_tensor_grouped
+  from xotorch_tpu.ops.int4_matmul import int4_grouped_matmul
+
+  w = jax.random.normal(jax.random.PRNGKey(5), (1, 256, 384), jnp.float32)
+  q, gscale = quantize_tensor_grouped(w, scale_dtype=jnp.float32, group_size=64)
+  ref_w = dequantize_tensor_grouped(q, gscale, jnp.float32)[0]  # [256, 384]
+  with jax.default_matmul_precision("highest"):
+    for rows in (1, 3, 8):
+      h = jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(6), rows),
+                            (rows, 256), jnp.float32)
+      got = int4_grouped_matmul(h, q[0], gscale[0], block_out=128)
+      np.testing.assert_allclose(np.asarray(got), np.asarray(h @ ref_w),
+                                 atol=1e-4, rtol=1e-4)

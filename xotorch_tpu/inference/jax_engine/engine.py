@@ -1486,6 +1486,11 @@ class JAXShardInferenceEngine(InferenceEngine):
         # computation follows data, no explicit collectives in model code.
         from xotorch_tpu.parallel.mesh import shard_params
         params = shard_params(params, mesh)
+        if self._quantize == "int4":
+          # The int4 decode Pallas kernel has no GSPMD partitioning rule:
+          # under tp it would all-gather the full packed weight per step,
+          # where the einsum path partitions into per-shard partial dots.
+          os.environ["XOT_INT4_KERNEL"] = "0"
         if DEBUG >= 1:
           print(f"Serving shard over local tp={mesh.shape['tp']} mesh")
 

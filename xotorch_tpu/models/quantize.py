@@ -41,7 +41,9 @@ import jax.numpy as jnp
 LAYER_SLOTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                "we_gate", "we_up", "we_down")
 
-QUANT_DTYPES = {"int8": jnp.int8, "int4": jnp.int4}
+# int4's STORED dtype is uint8 (two nibbles per byte, pack_int4) -- native
+# S4 arrays crossing jit boundaries are unsupported on some backends.
+QUANT_DTYPES = {"int8": jnp.int8, "int4": jnp.uint8}
 
 # int4 quantizes GROUP-WISE along the contraction axis (per-channel is too
 # coarse at 4 bits): weight [.., in, out] reshapes to [.., G, gs, out] with
@@ -77,25 +79,55 @@ def _group_size(d_in: int, group_size: int = INT4_GROUP_SIZE) -> int:
   return group_size if d_in % group_size == 0 else d_in
 
 
-def quantize_tensor_grouped(w: jnp.ndarray, dtype=jnp.int4, scale_dtype=jnp.bfloat16,
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+  """Pack int4 values (int32 in [-8, 7], [..., gs, out]) into uint8 nibble
+  pairs along the group axis -> [..., gs // 2, out]: element 2i rides the
+  LOW nibble, 2i+1 the high. uint8 is the STORED dtype everywhere — a
+  native int4 (S4) array crossing a jit boundary is unsupported on some
+  backends (the tunneled TPU's transfer path recurses into jit), while
+  uint8 is universal and streams the same 0.5 bytes/param from HBM."""
+  *lead, gs, d_out = q.shape
+  pairs = q.reshape(*lead, gs // 2, 2, d_out)
+  lo = pairs[..., 0, :] & 0xF
+  hi = pairs[..., 1, :] & 0xF
+  return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+  """Inverse of pack_int4: [..., gs // 2, out] uint8 -> [..., gs, out] int8
+  in [-8, 7]. Runs INSIDE compiled graphs (transformer._linear): XLA fuses
+  the shift/mask/sign-extend into the dot's operand read, so HBM streams
+  the packed bytes and the MXU sees bf16."""
+  lo = (packed & 0xF).astype(jnp.int8)
+  hi = (packed >> 4).astype(jnp.int8)
+  lo = jnp.where(lo > 7, lo - 16, lo)
+  hi = jnp.where(hi > 7, hi - 16, hi)
+  *lead, gs_half, d_out = packed.shape
+  return jnp.stack([lo, hi], axis=-2).reshape(*lead, gs_half * 2, d_out)
+
+
+def quantize_tensor_grouped(w: jnp.ndarray, scale_dtype=jnp.bfloat16,
                             group_size: int = INT4_GROUP_SIZE) -> Tuple[jnp.ndarray, jnp.ndarray]:
-  """Group-wise symmetric quantization of a stacked weight [L, in, out] ->
-  (q [L, G, gs, out], scale [L, G, out]). The contraction axis splits into
-  groups; each (group, out-channel) gets its own scale."""
+  """Group-wise symmetric int4 quantization of a stacked weight
+  [L, in, out] -> (packed uint8 [L, G, gs // 2, out], scale [L, G, out]).
+  The contraction axis splits into groups; each (group, out-channel) gets
+  its own scale; values pack two-per-byte (pack_int4)."""
   L, d_in, d_out = w.shape
   gs = _group_size(d_in, group_size)
-  qmax = float(jnp.iinfo(dtype).max)
+  qmax = 7.0
   wg = w.astype(jnp.float32).reshape(L, d_in // gs, gs, d_out)
   scale = jnp.max(jnp.abs(wg), axis=2, keepdims=True) / qmax
   scale = jnp.maximum(scale, 1e-12)
-  q = jnp.clip(jnp.round(wg / scale), -qmax, qmax).astype(dtype)
-  return q, jnp.squeeze(scale, axis=2).astype(scale_dtype)
+  q = jnp.clip(jnp.round(wg / scale), -qmax, qmax).astype(jnp.int32)
+  return pack_int4(q), jnp.squeeze(scale, axis=2).astype(scale_dtype)
 
 
 def dequantize_tensor_grouped(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
-  """Inverse of quantize_tensor_grouped: [L, G, gs, out] -> [L, in, out]."""
-  L, G, gs, d_out = q.shape
-  w = q.astype(jnp.float32) * scale.astype(jnp.float32)[:, :, None, :]
+  """Inverse of quantize_tensor_grouped: packed [L, G, gs // 2, out] ->
+  [L, in, out]."""
+  unpacked = unpack_int4(q)
+  L, G, gs, d_out = unpacked.shape
+  w = unpacked.astype(jnp.float32) * scale.astype(jnp.float32)[:, :, None, :]
   return w.reshape(L, G * gs, d_out).astype(dtype)
 
 
@@ -116,17 +148,20 @@ def quantize_params(params: Dict[str, Any], fmt: str = "int8",
   """
   if fmt not in QUANT_DTYPES:
     raise ValueError(f"Unsupported quantization format {fmt!r}; have {sorted(QUANT_DTYPES)}")
-  qdtype = QUANT_DTYPES[fmt]
   int4 = fmt == "int4"
 
   out: Dict[str, Any] = dict(params)
   layers = dict(params["layers"])
   for slot in LAYER_SLOTS:
     w = layers.get(slot)
-    if w is None or w.dtype in (jnp.int8, jnp.int4):
+    # uint8 = the packed-int4 container; gscale presence marks it even if a
+    # caller passes a rebuilt tree.
+    if (w is None or w.dtype in (jnp.int8, jnp.uint8)
+        or slot + "_gscale" in layers):
       continue
-    if int4 and slot in _INT4_LAYER_SLOTS:
-      q, gscale = quantize_tensor_grouped(w, qdtype, scale_dtype)
+    if (int4 and slot in _INT4_LAYER_SLOTS
+        and _group_size(w.shape[-2]) % 2 == 0):  # nibble pairs need even groups
+      q, gscale = quantize_tensor_grouped(w, scale_dtype)
       layers[slot] = q
       layers[slot + "_gscale"] = gscale
     else:
@@ -137,13 +172,13 @@ def quantize_params(params: Dict[str, Any], fmt: str = "int8",
   out["layers"] = layers
 
   embed = params.get("embed")
-  if embed is not None and embed["embedding"].dtype not in (jnp.int8, jnp.int4):
+  if embed is not None and embed["embedding"].dtype != jnp.int8:
     w = embed["embedding"]  # [vocab, H]: per-row scale serves take AND tied unembed
     q, scale = quantize_tensor(w, 1, jnp.int8, scale_dtype)
     out["embed"] = {"embedding": q, "embedding_scale": scale}
 
   head = params.get("lm_head")
-  if head is not None and head.dtype not in (jnp.int8, jnp.int4):
+  if head is not None and head.dtype != jnp.int8:
     q, scale = quantize_tensor(head, 0, jnp.int8, scale_dtype)  # [H, vocab] -> scale [vocab]
     out["lm_head"] = q
     out["lm_head_scale"] = scale
@@ -184,12 +219,9 @@ def is_quantized(params: Dict[str, Any]) -> bool:
 def quantized_bytes(params: Dict[str, Any]) -> int:
   """Actual HBM bytes of a param pytree (roofline math for quantized benches
   — n_params * 2 overstates an int8 model by ~2x). int4 counts as packed
-  half-bytes (ml_dtypes reports itemsize 1 for int4, but XLA packs 2/byte
-  in HBM)."""
+  half-bytes (int4 slots are packed uint8, two values
+  per byte, so plain itemsize accounting is exact)."""
   total = 0
   for x in jax.tree.leaves(params):
-    if x.dtype == jnp.int4:
-      total += (x.size + 1) // 2
-    else:
-      total += x.size * x.dtype.itemsize
+    total += x.size * x.dtype.itemsize
   return total

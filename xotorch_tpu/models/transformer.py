@@ -21,6 +21,7 @@ llm_utils.py:502-590).
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -58,12 +59,32 @@ def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
   w = layer[slot]
   gscale = layer.get(slot + "_gscale")
   if gscale is not None:
-    # int4 group-wise: w [G, gs, out], gscale [G, out]. Per-group partial
-    # dots (K = gs = 128, one MXU contraction tile) scaled then summed.
+    # int4 group-wise: w is PACKED uint8 [G, gs/2, out] (two nibbles per
+    # byte — models/quantize.pack_int4), gscale [G, out].
     B, T, _ = h.shape
-    G, gs, _ = w.shape
+    if (B * T <= 8 and jax.default_backend() == "tpu"
+        and os.getenv("XOT_INT4_KERNEL", "1") != "0"):
+      # Decode hot path ON REAL TPU: Pallas kernel (ops/int4_matmul.py)
+      # unpacks the nibbles IN REGISTERS between the packed-tile read and
+      # the MXU dot, so HBM streams the promised 0.5 bytes/param — XLA's
+      # lowering of the unpack graph materializes the unpacked tensor,
+      # erasing the format's bandwidth win (measured 230 -> 275 tok/s).
+      # Off-TPU the kernel would run in interpret mode (far slower than
+      # the einsum below); the engine also sets XOT_INT4_KERNEL=0 when
+      # serving over a tp mesh — GSPMD has no partitioning rule for the
+      # custom call, so it would gather the full weight per step where the
+      # einsum partitions into per-shard partial dots.
+      from xotorch_tpu.ops.int4_matmul import int4_grouped_matmul
+      out = int4_grouped_matmul(h.reshape(B * T, h.shape[-1]), w, gscale)
+      return out.reshape(B, T, -1).astype(h.dtype)
+    # Prefill / wide batches: compute-bound, one materialized unpack
+    # amortizes over the whole segment — per-group partial dots (K = gs =
+    # 128, one MXU contraction tile) scaled then summed.
+    from xotorch_tpu.models.quantize import unpack_int4
+    w4 = unpack_int4(w)  # [G, gs, out] int8
+    G, gs, _ = w4.shape
     hg = h.reshape(B, T, G, gs)
-    partial = jnp.einsum("btgi,gio->btgo", hg, w.astype(h.dtype))
+    partial = jnp.einsum("btgi,gio->btgo", hg, w4.astype(h.dtype))
     return jnp.einsum("btgo,go->bto", partial, gscale.astype(h.dtype))
   scale = layer.get(slot + "_scale")
   if scale is None:

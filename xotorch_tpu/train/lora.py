@@ -51,9 +51,10 @@ def add_lora_params(
     if base is None:
       continue
     if base.ndim == 4:
-      # int4 grouped layout [L, G, gs, out] (dense targets only; experts
-      # are never a LoRA target): logical in = G*gs.
-      L, d_in, d_out = base.shape[0], base.shape[1] * base.shape[2], base.shape[3]
+      # int4 grouped layout, PACKED uint8 [L, G, gs/2, out] (dense targets
+      # only; experts are never a LoRA target): logical in = G * gs =
+      # G * 2 * (gs/2) — two nibbles per stored byte.
+      L, d_in, d_out = base.shape[0], base.shape[1] * base.shape[2] * 2, base.shape[3]
     else:
       L, d_in, d_out = base.shape[0], base.shape[1], base.shape[2]
     a_name, b_name = lora_names(slot)
