@@ -134,3 +134,85 @@ async def test_tp_serving_with_int8_kv_cache(tiny_model_dir, monkeypatch):
     nxt = np.array([[ref_toks[-1]]], dtype=np.int64)
   chunk = await q.generate_chunk("r", shard, int(np.argmax(d_q[0, -1])), 4, temp=0.0)
   assert [int(x) for x in chunk] == ref_toks, f"{chunk} != {ref_toks}"
+
+
+async def test_sp_prefill_ring_attention_matches_solo(tiny_model_dir, monkeypatch):
+  """Sequence-parallel serving prefill (XOT_SERVE_SP): a long prompt's
+  from-zero segment shards its positions over the sp axis and runs RING
+  attention over the mesh (ops/ring_attention — the serving twin of the
+  training sp axis), composing with tp. The whole request (chunked prefill
+  through the ring + fused decode after) must match the solo engine's
+  greedy stream, and the ring executable must actually have run."""
+  import asyncio
+
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([np.arange(90) % 250], dtype=np.int64)
+
+  async def run(eng):
+    logits, _ = await eng.infer_tensor("r", shard, prompt)
+    toks = [int(np.argmax(logits[0, -1]))]
+    out = await eng.generate_chunk("r", shard, toks[-1], 4, temp=0.0, top_k=0)
+    toks.extend(int(t) for t in out)
+    return toks
+
+  monkeypatch.setenv("XOT_PREFILL_CHUNK", "32")
+  solo = _engine(tiny_model_dir, monkeypatch, 0)
+  want = await run(solo)
+
+  monkeypatch.setenv("XOT_SERVE_SP", "2")
+  sp = _engine(tiny_model_dir, monkeypatch, 2)  # sp=2 x tp=2 mesh
+  # ensure_shard builds the executables; then count ring invocations.
+  await sp.ensure_shard(shard)
+  ctx = sp._contexts[shard]
+  assert sp._mesh is not None and sp._mesh.shape["sp"] == 2 and sp._mesh.shape["tp"] == 2
+  assert ctx.fill_jits is not None and "ring" in ctx.fill_jits
+  calls = {"n": 0}
+  for variant in ("ring", "ring_full"):
+    inner = ctx.fill_jits[variant]
+
+    def counting(*a, _inner=inner, **kw):
+      calls["n"] += 1
+      return _inner(*a, **kw)
+
+    ctx.fill_jits[variant] = counting
+  got = await run(sp)
+  assert calls["n"] == 1, f"ring prefill ran {calls['n']} times (want 1: the from-zero segment)"
+  assert got == want
+
+
+async def test_sp_only_mesh_serves(tiny_model_dir, monkeypatch):
+  """XOT_SERVE_SP without tp (tp forced off) still builds a mesh and
+  serves correctly — sp is not parasitic on tp."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([np.arange(64) % 250], dtype=np.int64)
+
+  monkeypatch.setenv("XOT_PREFILL_CHUNK", "32")
+  solo = _engine(tiny_model_dir, monkeypatch, 0)
+  ref, _ = await solo.infer_tensor("r", shard, prompt)
+
+  monkeypatch.setenv("XOT_SERVE_SP", "4")
+  eng = _engine(tiny_model_dir, monkeypatch, 0)  # tp off
+  await eng.ensure_shard(shard)
+  assert eng._mesh is not None and eng._mesh.shape["sp"] == 4
+  out, _ = await eng.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-3)
+
+
+async def test_sp_clamps_and_shard_gating(tiny_model_dir, monkeypatch):
+  """Mesh-shape hygiene for the sp axis: a non-power-of-two request clamps
+  down (prefill buckets are powers of two — sp=3 would never divide them),
+  and a pipeline MID-shard never reserves sp devices its ring executables
+  cannot use."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+
+  monkeypatch.setenv("XOT_SERVE_SP", "3")
+  eng = _engine(tiny_model_dir, monkeypatch, 2)
+  await eng.ensure_shard(Shard("m", 0, n - 1, n))
+  assert eng._mesh is not None and eng._mesh.shape["sp"] == 2  # 3 -> 2
+
+  monkeypatch.setenv("XOT_SERVE_SP", "2")
+  mid = _engine(tiny_model_dir, monkeypatch, 2)
+  await mid.ensure_shard(Shard("m", 0, 1, n))  # first but not last layer
+  assert mid._mesh is not None and "sp" not in mid._mesh.shape  # tp only

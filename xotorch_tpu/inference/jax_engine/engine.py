@@ -377,36 +377,58 @@ class JAXShardInferenceEngine(InferenceEngine):
       return cache_s >= min_len
     return self._jax().default_backend() == "tpu" and cache_s >= min_len
 
-  def _serving_mesh(self, cfg: ModelConfig):
-    """Tensor-parallel mesh for multi-chip serving (VERDICT r1 #2 / SURVEY
-    §7.2 stage 7, the ICI fast path): a peer that owns several local chips
-    serves its layer-range shard SPMD over a local {'tp': t} mesh instead of
-    leaving all but one chip idle. XOT_SERVE_TP: 0 = off, N = force N-way,
-    unset = all local devices when running on real TPU. The requested size is
-    reduced to the largest feasible divisor of every tp-sharded dimension so
-    placements stay even (kv heads bound the cache axis, Megatron-style)."""
+  def _serving_mesh(self, cfg: ModelConfig, shard: Optional[Shard] = None):
+    """Multi-chip serving mesh (VERDICT r1 #2 / SURVEY §7.2 stage 7, the ICI
+    fast path): a peer that owns several local chips serves its layer-range
+    shard SPMD over a local mesh instead of leaving all but one chip idle.
+
+    Axes: 'tp' (Megatron tensor parallel — XOT_SERVE_TP: 0 = off, N = force,
+    unset = all local devices on real TPU) and optionally 'sp'
+    (XOT_SERVE_SP=N): sequence-parallel PREFILL, where a long prompt's
+    positions shard over sp chips and attention runs as ring attention over
+    ICI (ops/ring_attention) — the serving-side twin of the training sp
+    axis. Requested sizes reduce to the largest feasible divisors so
+    placements stay even."""
     env = os.getenv("XOT_SERVE_TP")
+    sp_env = int(os.getenv("XOT_SERVE_SP", "0") or 0)
+    # The ring executables need a whole-model shard (token input, from-zero
+    # context): a pipeline mid-shard must not reserve sp devices it can
+    # never use — they would hold replicated copies of the tp work.
+    if shard is not None and not (shard.is_first_layer and shard.is_last_layer):
+      sp_env = 0
     jax = self._jax()
     n_local = len(jax.local_devices())
     if env is not None:
       t = int(env)
-      if t <= 1:
-        return None
-      t = min(t, n_local)
+      t = min(max(t, 1), n_local)
     elif jax.default_backend() == "tpu" and n_local > 1:
-      t = n_local
+      # Auto-tp takes the local chips — but leaves room for an explicitly
+      # requested sp axis (otherwise XOT_SERVE_SP alone would silently
+      # reduce to 1 after tp claimed every device).
+      t = n_local // sp_env if sp_env > 1 else n_local
+      t = max(t, 1)
     else:
-      return None
+      t = 1
     dims = [cfg.num_kv_heads, cfg.num_heads, cfg.hidden_size,
             cfg.num_heads * cfg.head_dim, cfg.intermediate_size, cfg.vocab_size]
     if cfg.is_moe and cfg.moe_intermediate_size:
       dims.append(cfg.moe_intermediate_size)
     while t > 1 and any(d % t for d in dims):
       t -= 1
-    if t <= 1:
+    sp = min(sp_env, n_local // max(t, 1)) if sp_env > 1 else 1
+    # Prefill segments are padded to power-of-two buckets; a non-po2 sp
+    # would never divide them and the ring jits would sit unused while the
+    # axis held replicated copies — clamp to the largest power of two.
+    while sp > 1 and sp & (sp - 1):
+      sp -= 1
+    if t <= 1 and sp <= 1:
       return None
     from xotorch_tpu.parallel.mesh import make_mesh
-    return make_mesh({"tp": t}, jax.local_devices())
+    axes = {}
+    if sp > 1:
+      axes["sp"] = sp
+    axes["tp"] = max(t, 1)
+    return make_mesh(axes, jax.local_devices())
 
   async def _run(self, fn, *args, oom_as_cache_exhausted: bool = True):
     """Every device computation funnels through the single-worker executor.
@@ -556,7 +578,18 @@ class JAXShardInferenceEngine(InferenceEngine):
     the unembedding)."""
     import jax.numpy as jnp
     x, true_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
-    if fill and ctx.fill_jits is not None:
+    ring_ok = (ctx.fill_jits is not None and "ring" in ctx.fill_jits
+               and state.pos == 0 and x.ndim == 2 and true_t > 1
+               and x.shape[1] % ctx.mesh.shape["sp"] == 0)
+    if fill and ring_ok:
+      # Sequence-parallel prefill-from-zero (serving-side sp): the
+      # segment's positions shard over the sp chips and attention rings
+      # the KV chunks over ICI. Applies to the first (from-zero) segment;
+      # later segments attend the resident cache and use the cached path.
+      forward = ctx.fill_jits["ring"]
+    elif ring_ok:
+      forward = ctx.fill_jits["ring_full"]
+    elif fill and ctx.fill_jits is not None:
       forward = ctx.fill_jits["flash" if use_flash else ("cached" if use_fd else "base")]
     elif use_flash:
       forward = ctx.forward_flash_jit
@@ -1479,7 +1512,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         from xotorch_tpu.models.quantize import quantize_params
         params = quantize_params(params, self._quantize, scale_dtype=self._dtype())
 
-      mesh = self._serving_mesh(cfg)
+      mesh = self._serving_mesh(cfg, shard)
       if mesh is not None:
         # Place params per the Megatron partition rules; inside jit, XLA
         # derives the tp all-reduces (over ICI) from these placements —
@@ -1527,6 +1560,20 @@ class JAXShardInferenceEngine(InferenceEngine):
           "flash": jax.jit(partial(fill_fwd, use_flash=True), donate_argnums=(2,)),
           "cached": jax.jit(partial(fill_fwd, use_flash_decode=True), donate_argnums=(2,)),
         }
+        if (mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+            and shard.is_first_layer
+            and not (cfg.uses_sliding_window or cfg.attn_logit_softcap
+                     or cfg.query_pre_attn_scalar)):
+          # Sequence-parallel prefill-from-zero: the prompt's positions
+          # shard over the sp axis and attention runs as RING attention
+          # over ICI (ops/ring_attention; the serving twin of the training
+          # sp axis). KV writes land in the replicated cache via the
+          # GSPMD-inserted gathers. Windowed/soft-capped families are
+          # excluded (ring attention implements neither). "ring" is the
+          # hidden-only fill variant (fused-sample path); "ring_full" the
+          # logits variant (_infer_sync's segment loop).
+          fill_jits["ring"] = jax.jit(partial(fill_fwd, ring_mesh=mesh), donate_argnums=(2,))
+          fill_jits["ring_full"] = jax.jit(partial(fwd, ring_mesh=mesh), donate_argnums=(2,))
       # Multimodal prefill injects merged (text+image) embeddings as hidden
       # state, bypassing the token-embedding lookup: an is_first=False jit.
       forward_hidden_jit = None

@@ -206,10 +206,12 @@ def _attention_block(
   k = apply_rope(k, positions, inv_freq)
   layer_cache = _cache_write(layer_cache, k, v, start_pos)
   kv_quant = "k_scale" in layer_cache
-  if (window is not None or cfg.attn_logit_softcap) and ring_mesh is not None:
+  if (window is not None or cfg.attn_logit_softcap or cfg.query_pre_attn_scalar) \
+      and ring_mesh is not None:
     raise ValueError(
-      "ring attention (sequence-parallel training) does not support "
-      "sliding-window / attn-softcap configs (gemma2, windowed mistral)")
+      "ring attention (sequence parallelism) does not support sliding-window "
+      "/ attn-softcap / query_pre_attn_scalar configs (gemma2, windowed "
+      "mistral) — it hardcodes the 1/sqrt(head_dim) score scale")
   # Static gemma-family score adjustments; None/0.0 for every other family,
   # so their compiled kernels are unchanged.
   attn_scale = cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar else None
