@@ -81,6 +81,13 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--kv-quantize", type=str, default=None, choices=["int8"],
                       help="int8 KV cache: half the cache bandwidth + HBM per resident token "
                            "(long-context serving)")
+  parser.add_argument("--serve-tp", type=int, default=None,
+                      help="tensor-parallel width over this peer's local chips "
+                           "(default: all local chips on real TPU; 0/1 disables)")
+  parser.add_argument("--serve-sp", type=int, default=None,
+                      help="sequence-parallel width for long-prompt prefill: the from-zero "
+                           "segment ring-attends over this many local chips (composes with "
+                           "--serve-tp; power of two)")
   return parser
 
 
@@ -96,6 +103,10 @@ def build_node(args) -> tuple:
     os.environ["XOT_QUANTIZE"] = args.quantize
   if getattr(args, "kv_quantize", None):
     os.environ["XOT_KV_QUANT"] = args.kv_quantize
+  if getattr(args, "serve_tp", None) is not None:
+    os.environ["XOT_SERVE_TP"] = str(args.serve_tp)
+  if getattr(args, "serve_sp", None) is not None:
+    os.environ["XOT_SERVE_SP"] = str(args.serve_sp)
 
   from xotorch_tpu.download import NoopShardDownloader
   from xotorch_tpu.download.hf_shard_download import HFShardDownloader
