@@ -114,3 +114,23 @@ async def test_coordinate_resume_reaches_all_peers():
     assert sorted(covered) == list(range(8))
   finally:
     await _stop_ring(node_a, node_b)
+
+
+def test_serve_flags_zero_reaches_engine(monkeypatch):
+  """--serve-tp 0 must reach the engine as an EXPLICIT "tp off" (the
+  is-not-None guard): normalizing it to the truthiness style of the
+  neighbouring quantize flags would silently revert real-TPU hosts to
+  auto-tp."""
+  import os
+  from xotorch_tpu.main import build_parser
+
+  for k in ("XOT_SERVE_TP", "XOT_SERVE_SP"):
+    monkeypatch.delenv(k, raising=False)
+  args = build_parser().parse_args(
+    ["run", "dummy", "--inference-engine", "dummy", "--serve-tp", "0", "--serve-sp", "0"])
+  assert args.serve_tp == 0 and args.serve_sp == 0
+  # build_node plumbs them; use the dummy engine (no downloads, no probe).
+  from xotorch_tpu.main import build_node
+  build_node(args)
+  assert os.environ["XOT_SERVE_TP"] == "0"
+  assert os.environ["XOT_SERVE_SP"] == "0"
