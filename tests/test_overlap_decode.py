@@ -275,3 +275,21 @@ async def test_clear_request_drops_spec(tiny_model_dir):
   assert "r" in eng._spec_next
   await eng.clear_request("r")
   assert "r" not in eng._spec_next
+
+
+async def test_oom_recovery_drops_inflight_spec(tiny_model_dir):
+  """HBM-exhaustion recovery while a speculative chunk is in flight: the
+  spec record must be released with the states (a stale record must never
+  resolve against a recreated state), and the victim fails loudly with
+  RequestStateLost rather than silently restarting."""
+  from xotorch_tpu.inference.engine import RequestStateLost
+
+  eng = _engine(tiny_model_dir)
+  logits, _ = await eng.infer_tensor("r", FULL, PROMPT)
+  await eng.generate_chunk("r", FULL, int(np.argmax(logits[0, -1])), 4,
+                           temp=0.0, top_k=0, next_size=4)
+  assert "r" in eng._spec_next
+  eng._free_device_memory()
+  assert eng._spec_next == {}
+  with pytest.raises(RequestStateLost):
+    await eng.generate_chunk("r", FULL, 1, 4, temp=0.0, top_k=0)

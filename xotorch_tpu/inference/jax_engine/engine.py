@@ -465,7 +465,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     fails loudly with RequestStateLost instead of silently restarting from
     an empty cache."""
     n_snap = n_state = n_ctx = 0
+    # In-flight speculative chunks hold device token arrays and reference
+    # the states being dropped — release them too (their requests are lost
+    # to OOM anyway, and a stale record must never resolve against a
+    # recreated state).
+    self._spec_next.clear()
     for ctx in self._contexts.values():
+      ctx.batch_spec = None
       n_snap += len(ctx.prefix_cache)
       ctx.prefix_cache.clear()
       for rid in ctx.states:
