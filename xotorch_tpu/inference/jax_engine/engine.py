@@ -288,6 +288,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     # past pos are invisible to the validity mask and get overwritten, the
     # same free-rollback design as verify_draft.
     self._spec_next: Dict[str, dict] = {}
+    # Same overlap records for fused RING chunks (generate_chunk_ring):
+    # request_id -> {"toks","n","pos","temp","top_k","top_p","prev","states"}.
+    # Held on the DRIVING engine (the last shard's); the listed states may
+    # belong to peer engines' contexts — the ring loop is the request's sole
+    # driver, so only this engine's executor ever resolves/rolls them back.
+    self._ring_spec: Dict[str, dict] = {}
     self._overlap_hits = 0
     self._overlap_misses = 0
     self._overlap_batch_hits = 0
@@ -470,6 +476,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     # to OOM anyway, and a stale record must never resolve against a
     # recreated state).
     self._spec_next.clear()
+    self._ring_spec.clear()
     for ctx in self._contexts.values():
       ctx.batch_spec = None
       n_snap += len(ctx.prefix_cache)
@@ -1087,6 +1094,170 @@ class JAXShardInferenceEngine(InferenceEngine):
       )[0]
 
     return await self._run(_chunk)
+
+  # Node's ring-fusion detection keys off this flag: when every partition of
+  # a ring is served by an engine with it (co-located, one process/device),
+  # multi-partition decode folds into ONE fused executable per chunk instead
+  # of one hop per partition per token.
+  supports_ring_fusion = True
+
+  async def generate_chunk_ring(
+    self, request_id: str, chain, prev_token: int, num_tokens: int,
+    temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K, top_p: float = 0.0,
+    next_size: Optional[int] = None,
+  ) -> Optional[np.ndarray]:
+    """Fused decode across a CO-LOCATED multi-partition ring: `chain` is the
+    ring-ordered list of (engine, shard) pairs covering layers 0..N-1, every
+    engine a ring-fusion-capable instance in THIS process. One dispatch runs
+    all partitions' layer stacks + sampling for up to `num_tokens` tokens
+    (models/generate.decode_chunk_ring), so the multi-partition ring decodes
+    at the single-shard fused rate instead of per-token hop latency — the
+    reference's ring is per-token by construction (node.py:109-147).
+
+    Each partition's params and KV cache stay exactly where the per-token
+    ring keeps them (its engine's context/state) — entering or leaving the
+    fused path needs no migration, and the per-token ring remains the
+    fallback (returns None when the chain doesn't qualify). Called on the
+    LAST shard's engine (the sampler peer drives generation)."""
+    if num_tokens < 1 or len(chain) < 2:
+      return None
+    shards = [s for _, s in chain]
+    if not (shards[0].is_first_layer and shards[-1].is_last_layer):
+      return None
+    if any(b.start_layer != a.end_layer + 1 for a, b in zip(shards, shards[1:])):
+      return None  # non-contiguous coverage: not a whole-model chain
+    segs = []
+    for eng, sh in chain:
+      if not getattr(eng, "supports_ring_fusion", False) or not isinstance(eng, JAXShardInferenceEngine):
+        return None
+      ctx = eng._contexts.get(sh)
+      if ctx is None:
+        # Prefill created this context; its loss mid-generation means the KV
+        # cache is gone too — fail loudly (same contract as generate_chunk).
+        raise RequestStateLost(
+          f"request {request_id}: model context {sh.model_id} [{sh.start_layer}-{sh.end_layer}] "
+          f"evicted mid-generation on {eng!r}")
+      state = ctx.states.get(request_id)
+      if state is None:
+        raise RequestStateLost(
+          f"request {request_id}: device state for layers [{sh.start_layer}-{sh.end_layer}] "
+          f"evicted mid-generation")
+      if state.extras is not None:
+        return None  # sampling extras decode per-token (host-side bookkeeping)
+      eng._contexts.move_to_end(sh)
+      ctx.states.move_to_end(request_id)
+      segs.append((eng, ctx, state))
+
+    def _chunk() -> np.ndarray:
+      return self._ring_chunk_sync(segs, request_id, int(prev_token), int(num_tokens),
+                                   float(temp), int(top_k), float(top_p),
+                                   int(next_size) if next_size else None)
+
+    return await self._run(_chunk)
+
+  def _ring_chunk_sync(self, segs, request_id: str, prev_token: int, num_tokens: int,
+                       temp: float, top_k: int, top_p: float,
+                       next_size: Optional[int]) -> np.ndarray:
+    """Executor-side body of generate_chunk_ring: capacity checks, the fused
+    multi-segment dispatch, speculative next-chunk overlap, and the write-back
+    of every segment's cache/position. Runs on the DRIVING engine's executor;
+    peer segments' states are touched only here for the request's lifetime
+    (the ring loop is the request's sole driver), so cross-engine mutation is
+    race-free by construction."""
+    import jax
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import decode_chunk_ring
+
+    states = [st for _, _, st in segs]
+
+    # Resolve an in-flight speculative ring chunk (same free-rollback design
+    # as the single-shard path): a hit means the device already computed this
+    # very chunk; a miss rolls every segment's optimistic advance back.
+    spec = self._ring_spec.pop(request_id, None)
+    spec_hit = (
+      spec is not None
+      # IDENTITY comparison per state: == would fall into _RequestState's
+      # dataclass equality and try to compare jax-array cache pytrees.
+      and len(spec["states"]) == len(states)
+      and all(a is b for a, b in zip(spec["states"], states))
+      and spec["prev"] == prev_token and spec["n"] == num_tokens
+      and spec["temp"] == temp and spec["top_k"] == top_k and spec["top_p"] == top_p
+      and all(st.pos == spec["pos"] + spec["n"] for st in states)
+    )
+    if spec is not None:
+      self._overlap_hits += spec_hit
+      self._overlap_misses += not spec_hit
+      if not spec_hit:
+        # Roll back the states the speculation ADVANCED (the recorded ones —
+        # a replaced state object for the same request must keep its own pos).
+        for st in spec["states"]:
+          if st.pos == spec["pos"] + spec["n"]:
+            st.pos = spec["pos"]
+
+    max_len = min(ctx.max_cache_len for _, ctx, _ in segs)
+
+    def dispatch(tok_dev, n: int):
+      """One fused ring chunk from `tok_dev` ([1,1] int32). Grows every
+      segment's cache to a common power-of-two length first (one executable
+      per (n, S) pair) and advances every segment's position in lockstep."""
+      pos_now = states[0].pos
+      target = max(pos_now + n, max(st.cache["k"].shape[2] for st in states))
+      for (eng, ctx, st) in segs:
+        if st.cache["k"].shape[2] < target:
+          eng._grow_cache(ctx, st, target)
+      S = states[0].cache["k"].shape[2]
+      use_fd = (self._pallas_kernels_ok(segs[0][1].cfg) and self._flash_decode_on(S))
+      self._sample_calls += 1
+      key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+      toks, new_caches = decode_chunk_ring(
+        tuple(ctx.params for _, ctx, _ in segs), tok_dev,
+        tuple(st.cache for st in states), jnp.int32(pos_now), key,
+        segs[-1][1].cfg, n, temp, top_k, top_p, use_flash_decode=use_fd,
+        start_layers=tuple(ctx.shard.start_layer for _, ctx, _ in segs),
+      )
+      for st, c in zip(states, new_caches):
+        st.cache = c
+        st.pos = pos_now + n
+      return toks
+
+    if spec_hit:
+      # The speculated chunk IS this chunk (capacity was validated when it
+      # was dispatched); positions already sit past it.
+      toks = spec["toks"]
+    else:
+      pos = states[0].pos
+      if any(st.pos != pos for st in states):
+        # Lockstep broken (a segment restarted, partial prefill): the fused
+        # path would corrupt caches — make the node fall back to the ring.
+        return None
+      if pos + num_tokens > max_len:
+        if pos + 1 > max_len:
+          raise CacheExhausted(f"request {request_id}: cache full at {pos}/{max_len}")
+        tail = max_len - pos
+        num_tokens = min(num_tokens, 1 << (tail.bit_length() - 1))
+      toks = dispatch(jnp.asarray([[prev_token]], dtype=jnp.int32), num_tokens)
+
+    # Speculative NEXT ring chunk: dispatch it from this chunk's device-side
+    # last token BEFORE fetching — the device crunches chunk N+1 while the
+    # host ingests chunk N (EOS scan + broadcast), hiding the chunk-boundary
+    # round-trip exactly like the single-shard overlap path. Solo requests
+    # only (ring decode has no batcher to coalesce into).
+    spec_rec = None
+    if (next_size and self._overlap_on()
+        and states[0].pos + next_size <= max_len):
+      pos_before = states[0].pos
+      ntoks = dispatch(toks[:, -1:].astype(jnp.int32), next_size)
+      spec_rec = {"toks": ntoks, "n": next_size, "pos": pos_before, "temp": temp,
+                  "top_k": top_k, "top_p": top_p, "states": list(states)}
+
+    host = np.asarray(toks[0])  # fetch chunk N; the speculative chunk keeps computing
+    if spec_rec is not None:
+      spec_rec["prev"] = int(host[-1])
+      self._ring_spec[request_id] = spec_rec
+    now = time.monotonic()
+    for st in states:
+      st.last_used = now
+    return host.astype(np.int64)
 
   def _decode_batch_max(self) -> int:
     return int(os.getenv("XOT_DECODE_BATCH", "8"))
@@ -1906,6 +2077,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     # them on the executor thread (every pos mutation is serialized there).
     def _clear():
       self._spec_next.pop(request_id, None)
+      self._ring_spec.pop(request_id, None)
       for ctx in self._contexts.values():
         # A member finished: the batch's membership changes, so the
         # speculative batch can never resolve — roll the others back.

@@ -541,20 +541,77 @@ class Node:
     # token. Runs DETACHED so the awaited process_prompt chain returns after
     # the first token and API streaming starts immediately (the per-token
     # path gets the same property from forward_tensor's create_task).
-    if shard.is_first_layer and self.decode_chunk_size > 1:
-      gen = getattr(self.inference_engine, "generate_chunk", None)
-      if gen is not None:
-        self._spawn(
-          self._fused_decode_loop(base_shard, shard, request_id, buffered, inference_state, gen)
-        )
-        return
+    if self.decode_chunk_size > 1:
+      if shard.is_first_layer:
+        gen = getattr(self.inference_engine, "generate_chunk", None)
+        if gen is not None:
+          self._spawn(
+            self._fused_decode_loop(base_shard, shard, request_id, buffered, inference_state, gen)
+          )
+          return
+      elif shard.is_last_layer:
+        # Multi-partition ring whose every partition is co-located in THIS
+        # process: fold the whole chain into one fused executable per chunk
+        # (engine.generate_chunk_ring) instead of one hop per partition per
+        # token — the ring decodes at the fused rate. The sampler peer (last
+        # layer) drives, same as it drives the per-token ring.
+        ring_gen = self._ring_fused_gen(base_shard, request_id)
+        if ring_gen is not None:
+          self._spawn(
+            self._fused_decode_loop(base_shard, shard, request_id, buffered, inference_state,
+                                    ring_gen, allow_speculation=False)
+          )
+          return
 
     await self._forward_next_token(base_shard, request_id, buffered, inference_state)
 
+  def _ring_fused_gen(self, base_shard: Shard, request_id: str):
+    """A generate_chunk-shaped callable that decodes the WHOLE multi-partition
+    ring in fused chunks, or None when the ring doesn't qualify: every
+    partition must be served by a ring-fusion-capable engine living in this
+    process (self or an in-process peer — the same co-location the
+    device-resident hop path keys off), and the request must be a plain one
+    (sampling extras keep the per-token path, whose last-layer sampler
+    applies them). The chain binds the CURRENT partition table; if membership
+    changes mid-generation the engine fails loudly (RequestStateLost) rather
+    than decode against remapped shards."""
+    if self._request_sampling.get(request_id):
+      return None
+    ring = getattr(self.inference_engine, "generate_chunk_ring", None)
+    if ring is None:
+      return None
+    try:
+      partitions = self.partitioning_strategy.partition(self.topology)
+    except Exception:
+      return None
+    if len(partitions) < 2:
+      return None
+    chain = []
+    for i, part in enumerate(partitions):
+      if part.node_id == self.id:
+        eng = self.inference_engine
+      else:
+        peer = next((p for p in self.peers if p.id() == part.node_id), None)
+        node = getattr(peer, "node", None)  # InProcessPeerHandle only
+        eng = getattr(node, "inference_engine", None) if node is not None else None
+      if eng is None or not getattr(eng, "supports_ring_fusion", False):
+        return None
+      chain.append((eng, self.get_current_shard(base_shard, i)))
+
+    async def gen(rid, _shard, prev_token, num_tokens, temp, top_k, top_p=0.0, next_size=None):
+      return await ring(rid, chain, prev_token, num_tokens, temp=temp, top_k=top_k,
+                        top_p=top_p, next_size=next_size)
+
+    return gen
+
   async def _fused_decode_loop(self, base_shard: Shard, shard: Shard, request_id: str,
-                               buffered: List[int], inference_state: Optional[dict], gen) -> None:
+                               buffered: List[int], inference_state: Optional[dict], gen,
+                               allow_speculation: bool = True) -> None:
     """Chunked decode until EOS/cap; EOS/max checks happen between chunks and
-    surplus tokens after EOS inside a chunk are discarded."""
+    surplus tokens after EOS inside a chunk are discarded.
+    allow_speculation=False for the fused-RING path: verify_draft is a
+    single-shard executable and must not interleave with multi-segment
+    lockstep state."""
     # Speculation verifies drafts by plain greedy argmax — requests whose
     # extras RESHAPE the distribution (penalties/bias change even greedy
     # argmax) must not speculate or the verified tokens would ignore them;
@@ -565,8 +622,8 @@ class Node:
     reshaping = set(self._request_sampling.get(request_id, ())) & {
       "presence_penalty", "frequency_penalty", "logit_bias", "logprobs"}
     verify = (getattr(self.inference_engine, "verify_draft", None)
-              if (self.speculate_tokens > 0 and self._temp_for(request_id) == 0
-                  and not reshaping) else None)
+              if (allow_speculation and self.speculate_tokens > 0
+                  and self._temp_for(request_id) == 0 and not reshaping) else None)
     # Persistent draft context: prompt + generated tokens, appended as they
     # arrive (never rebuilt — a 32k prompt must not be re-copied per round).
     spec_context = (list(self._request_prompt_tokens.get(request_id, ())) + list(buffered)
