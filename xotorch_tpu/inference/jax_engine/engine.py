@@ -1157,7 +1157,7 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _ring_chunk_sync(self, segs, request_id: str, prev_token: int, num_tokens: int,
                        temp: float, top_k: int, top_p: float,
-                       next_size: Optional[int]) -> np.ndarray:
+                       next_size: Optional[int]) -> Optional[np.ndarray]:
     """Executor-side body of generate_chunk_ring: capacity checks, the fused
     multi-segment dispatch, speculative next-chunk overlap, and the write-back
     of every segment's cache/position. Runs on the DRIVING engine's executor;
