@@ -16,9 +16,24 @@ import time
 import numpy as np
 import pytest
 
+
+def _weights_on_disk() -> bool:
+  """True when a real checkpoint already sits in a known location — the test
+  then runs UNGATED (VERDICT r3 #3: no flag flips needed where weights
+  exist); the download path itself still needs XOT_REAL_MODEL=1 (network)."""
+  import sys
+  sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+  try:
+    import bench
+    return bench._find_real_model() is not None
+  except Exception:
+    return False
+
+
 pytestmark = pytest.mark.skipif(
-  os.getenv("XOT_REAL_MODEL", "0") != "1",
-  reason="real-model e2e needs network + disk; set XOT_REAL_MODEL=1 to run",
+  os.getenv("XOT_REAL_MODEL", "0") != "1" and not _weights_on_disk(),
+  reason="real-model e2e needs downloaded weights (none on disk) or network "
+         "(set XOT_REAL_MODEL=1 where HF is reachable)",
 )
 
 MODEL_ID = os.getenv("XOT_REAL_MODEL_ID", "llama-3.2-1b")

@@ -88,6 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="sequence-parallel width for long-prompt prefill: the from-zero "
                            "segment ring-attends over this many local chips (composes with "
                            "--serve-tp; power of two)")
+  parser.add_argument("--serve-ep", type=int, default=None,
+                      help="expert-parallel width for MoE models: expert weights distribute "
+                           "over this many local chips' HBM, each computing its resident "
+                           "experts (composes with --serve-tp; must divide the expert count)")
   return parser
 
 
@@ -107,6 +111,18 @@ def build_node(args) -> tuple:
     os.environ["XOT_SERVE_TP"] = str(args.serve_tp)
   if getattr(args, "serve_sp", None) is not None:
     os.environ["XOT_SERVE_SP"] = str(args.serve_sp)
+  if getattr(args, "serve_ep", None) is not None:
+    os.environ["XOT_SERVE_EP"] = str(args.serve_ep)
+
+  # Multi-host slice seam (SURVEY §2.9 north-star: no gRPC intra-slice):
+  # when the launcher provides slice membership (XOT_COORDINATOR/XOT_MULTIHOST),
+  # the co-hosted processes join one JAX distributed runtime BEFORE any
+  # device use, so every serving/training mesh spans the whole slice and its
+  # collectives ride ICI. The gRPC ring then connects only slice leaders.
+  from xotorch_tpu.parallel.multihost import init_multihost, multihost_requested
+  if multihost_requested():
+    n_proc, rank = init_multihost()
+    print(f"multi-host slice: process {rank}/{n_proc}")
 
   from xotorch_tpu.download import NoopShardDownloader
   from xotorch_tpu.download.hf_shard_download import HFShardDownloader

@@ -27,7 +27,7 @@ from xotorch_tpu.ops.sampling import sample_logits, sample_logits_logprobs
 @partial(
   jax.jit,
   static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode",
-                   "start_layer", "top_lp"),
+                   "start_layer", "top_lp", "moe_routed"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -50,6 +50,7 @@ def forward_sample(
   presence: float = 0.0,
   frequency: float = 0.0,
   top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
+  moe_routed: bool = True,  # static: False when experts shard over 'ep'
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
   ([B] int32 sampled token, updated cache) — with `top_lp >= 0`, instead
@@ -65,7 +66,7 @@ def forward_sample(
   """
   h, cache = forward_shard(params, x, cache, start_pos, cfg=cfg, is_first=is_first,
                            is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode,
-                           start_layer=start_layer)
+                           start_layer=start_layer, moe_routed=moe_routed)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
   if top_lp >= 0:
@@ -80,7 +81,8 @@ def forward_sample(
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "top_lp"),
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "top_lp",
+                   "moe_routed"),
   donate_argnames=("cache",),
 )
 def decode_chunk(
@@ -100,6 +102,7 @@ def decode_chunk(
   presence: float = 0.0,
   frequency: float = 0.0,
   top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
+  moe_routed: bool = True,  # static: False when experts shard over 'ep'
 ):
   """Generate `num_tokens` tokens in one device program.
 
@@ -121,7 +124,7 @@ def decode_chunk(
   def step(carry, _):
     tok, cache, pos, key, counts = carry
     logits, cache = forward_shard(params, tok, cache, pos, cfg=cfg, is_first=True, is_last=True,
-                                  use_flash_decode=use_flash_decode)
+                                  use_flash_decode=use_flash_decode, moe_routed=moe_routed)
     key, sub = jax.random.split(key)
     # counts=None (not the 0-d carry placeholder) when penalties are off:
     # the None/array split is what keeps the [B, V] penalty subtractions out
@@ -160,7 +163,8 @@ def decode_chunk(
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "start_layers"),
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "start_layers",
+                   "moe_routed"),
   donate_argnames=("caches",),
 )
 def decode_chunk_ring(
@@ -176,6 +180,7 @@ def decode_chunk_ring(
   top_p: float = 0.0,
   use_flash_decode: bool = False,
   start_layers: Tuple[int, ...] = (0,),
+  moe_routed: bool = True,
 ):
   """Fused multi-PARTITION decode: the whole ring's layer stacks run inside
   ONE device program, K tokens per dispatch.
@@ -204,7 +209,7 @@ def decode_chunk_ring(
     for i, params in enumerate(params_segs):
       h, c = forward_shard(params, h, caches[i], pos, cfg=cfg, is_first=(i == 0),
                            is_last=False, use_flash_decode=use_flash_decode,
-                           start_layer=start_layers[i])
+                           start_layer=start_layers[i], moe_routed=moe_routed)
       new_caches.append(c)
     logits = unembed(params_segs[-1], h, cfg)
     key, sub = jax.random.split(key)
@@ -218,7 +223,8 @@ def decode_chunk_ring(
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "pad_rows"),
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "pad_rows",
+                   "moe_routed"),
   donate_argnames=("caches",),
 )
 def decode_chunk_batched(
@@ -234,6 +240,7 @@ def decode_chunk_batched(
   top_p: float = 0.0,
   use_flash_decode: bool = False,
   pad_rows: int = 0,  # static: dummy rows padding B to a power of two
+  moe_routed: bool = True,  # static: False when experts shard over 'ep'
 ):
   """Batched fused decode for continuous batching, ONE executable end to
   end: stack the requests' caches along the batch axis, run the decode
@@ -262,7 +269,7 @@ def decode_chunk_batched(
     temps = jnp.concatenate([temps, jnp.broadcast_to(temps[:1], (pad_rows,))])
   out, cache_b = decode_chunk(
     params, toks, cache_b, pos_vec, key, cfg, num_tokens, temps, top_k, top_p,
-    use_flash_decode=use_flash_decode,
+    use_flash_decode=use_flash_decode, moe_routed=moe_routed,
   )
   split = tuple({name: cache_b[name][:, i:i + 1] for name in cache_b} for i in range(B))
   return out[:B], split

@@ -94,13 +94,95 @@ TPU_CHIP_SPECS: Dict[str, Dict[str, float]] = {
   "v6e": {"bf16": 918.0, "hbm_gb": 32, "hbm_gbps": 1638.0},
 }
 
-# Minimal GPU table for mixed dev rings (fallback path only).
+# Heterogeneous static TFLOPS table (VERDICT r3 #10): a TPU framework still
+# meets mixed dev rings (a Mac laptop + a CUDA workstation + a TPU VM in one
+# UDP discovery domain), and the RAM/HBM-weighted partitioner needs non-zero
+# planning numbers for the non-TPU peers. Values are from public vendor
+# specs (dense, no sparsity); fp16 means the chip's preferred half-precision
+# (bf16 where native). This is the same ROLE as the reference's ~80-chip
+# CHIP_FLOPS table (device_capabilities.py:54-164), rebuilt from public data
+# rather than ported. Matching is case-insensitive substring both ways
+# (lookup_chip_flops), so "NVIDIA GeForce RTX 4090" hits "RTX 4090".
 GPU_CHIP_FLOPS: Dict[str, DeviceFlops] = {
+  # datacenter
+  "NVIDIA B200": DeviceFlops(fp32=80.0 * TFLOPS, fp16=2250.0 * TFLOPS, int8=4500.0 * TFLOPS),
+  "NVIDIA H200": DeviceFlops(fp32=67.0 * TFLOPS, fp16=989.0 * TFLOPS, int8=1979.0 * TFLOPS),
   "NVIDIA H100": DeviceFlops(fp32=67.0 * TFLOPS, fp16=989.0 * TFLOPS, int8=1979.0 * TFLOPS),
   "NVIDIA A100": DeviceFlops(fp32=19.5 * TFLOPS, fp16=312.0 * TFLOPS, int8=624.0 * TFLOPS),
-  "NVIDIA RTX 4090": DeviceFlops(fp32=82.58 * TFLOPS, fp16=165.16 * TFLOPS, int8=330.32 * TFLOPS),
-  "NVIDIA RTX 3060": DeviceFlops(fp32=12.74 * TFLOPS, fp16=25.48 * TFLOPS, int8=50.96 * TFLOPS),
+  "NVIDIA A10": DeviceFlops(fp32=31.2 * TFLOPS, fp16=125.0 * TFLOPS, int8=250.0 * TFLOPS),
+  "NVIDIA L40S": DeviceFlops(fp32=91.6 * TFLOPS, fp16=366.0 * TFLOPS, int8=733.0 * TFLOPS),
+  "NVIDIA L4": DeviceFlops(fp32=30.3 * TFLOPS, fp16=121.0 * TFLOPS, int8=242.0 * TFLOPS),
+  "NVIDIA V100": DeviceFlops(fp32=15.7 * TFLOPS, fp16=125.0 * TFLOPS, int8=62.8 * TFLOPS),
+  "NVIDIA T4": DeviceFlops(fp32=8.1 * TFLOPS, fp16=65.0 * TFLOPS, int8=130.0 * TFLOPS),
+  "NVIDIA P100": DeviceFlops(fp32=9.3 * TFLOPS, fp16=18.7 * TFLOPS, int8=9.3 * TFLOPS),
+  "NVIDIA A6000": DeviceFlops(fp32=38.7 * TFLOPS, fp16=155.0 * TFLOPS, int8=310.0 * TFLOPS),
+  # consumer
+  "RTX 5090": DeviceFlops(fp32=104.8 * TFLOPS, fp16=209.6 * TFLOPS, int8=838.0 * TFLOPS),
+  "RTX 4090": DeviceFlops(fp32=82.6 * TFLOPS, fp16=165.2 * TFLOPS, int8=660.6 * TFLOPS),
+  "RTX 4080": DeviceFlops(fp32=48.7 * TFLOPS, fp16=97.5 * TFLOPS, int8=390.0 * TFLOPS),
+  "RTX 4070": DeviceFlops(fp32=29.2 * TFLOPS, fp16=58.3 * TFLOPS, int8=233.0 * TFLOPS),
+  "RTX 3090": DeviceFlops(fp32=35.6 * TFLOPS, fp16=71.2 * TFLOPS, int8=284.0 * TFLOPS),
+  "RTX 3080": DeviceFlops(fp32=29.8 * TFLOPS, fp16=59.5 * TFLOPS, int8=238.0 * TFLOPS),
+  "RTX 3070": DeviceFlops(fp32=20.3 * TFLOPS, fp16=40.6 * TFLOPS, int8=162.6 * TFLOPS),
+  "RTX 3060": DeviceFlops(fp32=12.7 * TFLOPS, fp16=25.5 * TFLOPS, int8=102.0 * TFLOPS),
+  "GTX 1080": DeviceFlops(fp32=8.9 * TFLOPS, fp16=0.14 * TFLOPS, int8=35.6 * TFLOPS),
+  "T1000": DeviceFlops(fp32=2.5 * TFLOPS, fp16=5.0 * TFLOPS, int8=10.0 * TFLOPS),
+  "Quadro M2000": DeviceFlops(fp32=1.8 * TFLOPS, fp16=0.03 * TFLOPS, int8=1.8 * TFLOPS),
+  "Quadro P400": DeviceFlops(fp32=0.6 * TFLOPS, fp16=0.01 * TFLOPS, int8=0.6 * TFLOPS),
+  # AMD
+  "AMD MI300X": DeviceFlops(fp32=163.4 * TFLOPS, fp16=1307.0 * TFLOPS, int8=2614.0 * TFLOPS),
+  "AMD MI250X": DeviceFlops(fp32=47.9 * TFLOPS, fp16=383.0 * TFLOPS, int8=383.0 * TFLOPS),
+  "Radeon RX 7900": DeviceFlops(fp32=61.4 * TFLOPS, fp16=122.8 * TFLOPS, int8=122.8 * TFLOPS),
+  # Jetson (edge)
+  "Jetson AGX Orin": DeviceFlops(fp32=5.3 * TFLOPS, fp16=10.6 * TFLOPS, int8=105.0 * TFLOPS),
+  "Jetson Orin Nano": DeviceFlops(fp32=1.3 * TFLOPS, fp16=2.6 * TFLOPS, int8=20.0 * TFLOPS),
+  "Jetson Xavier": DeviceFlops(fp32=1.4 * TFLOPS, fp16=2.8 * TFLOPS, int8=22.0 * TFLOPS),
 }
+
+# Apple silicon (GPU fp32; fp16 = 2x via the GPU's half-rate path; int8
+# planning number 2x fp16). Unified memory means the partitioner can weight
+# these peers by system RAM directly.
+APPLE_CHIP_FLOPS: Dict[str, DeviceFlops] = {
+  "Apple M1 Ultra": DeviceFlops(fp32=21.2 * TFLOPS, fp16=42.4 * TFLOPS, int8=84.8 * TFLOPS),
+  "Apple M1 Max": DeviceFlops(fp32=10.6 * TFLOPS, fp16=21.2 * TFLOPS, int8=42.4 * TFLOPS),
+  "Apple M1 Pro": DeviceFlops(fp32=5.3 * TFLOPS, fp16=10.6 * TFLOPS, int8=21.2 * TFLOPS),
+  "Apple M1": DeviceFlops(fp32=2.6 * TFLOPS, fp16=5.2 * TFLOPS, int8=10.4 * TFLOPS),
+  "Apple M2 Ultra": DeviceFlops(fp32=27.2 * TFLOPS, fp16=54.4 * TFLOPS, int8=108.8 * TFLOPS),
+  "Apple M2 Max": DeviceFlops(fp32=13.6 * TFLOPS, fp16=27.2 * TFLOPS, int8=54.4 * TFLOPS),
+  "Apple M2 Pro": DeviceFlops(fp32=6.8 * TFLOPS, fp16=13.6 * TFLOPS, int8=27.2 * TFLOPS),
+  "Apple M2": DeviceFlops(fp32=3.6 * TFLOPS, fp16=7.2 * TFLOPS, int8=14.4 * TFLOPS),
+  "Apple M3 Ultra": DeviceFlops(fp32=28.4 * TFLOPS, fp16=56.8 * TFLOPS, int8=113.6 * TFLOPS),
+  "Apple M3 Max": DeviceFlops(fp32=14.2 * TFLOPS, fp16=28.4 * TFLOPS, int8=56.8 * TFLOPS),
+  "Apple M3 Pro": DeviceFlops(fp32=7.1 * TFLOPS, fp16=14.2 * TFLOPS, int8=28.4 * TFLOPS),
+  "Apple M3": DeviceFlops(fp32=4.1 * TFLOPS, fp16=8.2 * TFLOPS, int8=16.4 * TFLOPS),
+  "Apple M4 Max": DeviceFlops(fp32=18.4 * TFLOPS, fp16=36.8 * TFLOPS, int8=73.6 * TFLOPS),
+  "Apple M4 Pro": DeviceFlops(fp32=9.2 * TFLOPS, fp16=18.4 * TFLOPS, int8=36.8 * TFLOPS),
+  "Apple M4": DeviceFlops(fp32=4.6 * TFLOPS, fp16=9.2 * TFLOPS, int8=18.4 * TFLOPS),
+}
+
+
+def lookup_chip_flops(name: str) -> Optional[DeviceFlops]:
+  """Case-insensitive match against the GPU and Apple tables.
+
+  Primary direction: the longest table KEY that is a substring of the
+  reported name — 'NVIDIA A100-SXM4-80GB' hits 'NVIDIA A100', and a plain
+  'Apple M1'/'NVIDIA A10' hits its own entry, never a longer sibling
+  ('M1 Ultra', 'A100'). Only when nothing hits does the reverse direction
+  run (a truncated reported name inside a longer key)."""
+  if not name:
+    return None
+  low = name.lower()
+  for contains_key in (True, False):
+    best = None
+    for table in (GPU_CHIP_FLOPS, APPLE_CHIP_FLOPS):
+      for key, flops in table.items():
+        kl = key.lower()
+        hit = (kl in low) if contains_key else (low in kl)
+        if hit and (best is None or len(kl) > best[0]):
+          best = (len(kl), flops)
+    if best is not None:
+      return best[1]
+  return None
 
 
 def _tpu_kind_to_key(kind: str) -> Optional[str]:
@@ -152,8 +234,7 @@ def _probe_jax_sync() -> Optional[DeviceCapabilities]:
     )
   if platform == "gpu":
     name = str(getattr(d0, "device_kind", "Unknown GPU"))
-    flops = next((f for k, f in GPU_CHIP_FLOPS.items() if k.lower() in name.lower() or name.lower() in k.lower()),
-                 DeviceFlops(fp32=10.0, fp16=20.0, int8=40.0))
+    flops = lookup_chip_flops(name) or DeviceFlops(fp32=10.0, fp16=20.0, int8=40.0)
     mem_mb = 8 * 1024
     try:
       stats = d0.memory_stats()
@@ -170,6 +251,41 @@ def _probe_jax_sync() -> Optional[DeviceCapabilities]:
   return None  # cpu platform -> use the host probe for better memory numbers
 
 
+def _probe_torch_cuda_sync() -> Optional[DeviceCapabilities]:
+  """torch-CUDA fallback for peers whose JAX is CPU-only but that carry a
+  CUDA GPU (the reference's primary probe path, device_capabilities.py:207-328
+  — here a fallback, since TPU peers probe through JAX first)."""
+  try:
+    import torch
+    if not torch.cuda.is_available():
+      return None
+    n = torch.cuda.device_count()
+    name = torch.cuda.get_device_name(0)
+    mem_mb = torch.cuda.get_device_properties(0).total_memory // (1024 * 1024)
+  except Exception:
+    return None
+  flops = lookup_chip_flops(name) or DeviceFlops(fp32=10.0, fp16=20.0, int8=40.0)
+  return DeviceCapabilities(
+    model=f"{name} x{n}", chip=name, memory=int(mem_mb) * n,
+    flops=DeviceFlops(fp32=flops.fp32 * n, fp16=flops.fp16 * n, int8=flops.int8 * n),
+    num_devices=n,
+  )
+
+
+def _apple_chip_name() -> Optional[str]:
+  """The marketing chip name ('Apple M2 Max') on macOS, or None."""
+  import platform as _platform
+  if _platform.system() != "Darwin":
+    return None
+  try:
+    import subprocess
+    out = subprocess.run(["sysctl", "-n", "machdep.cpu.brand_string"],
+                         capture_output=True, text=True, timeout=5).stdout.strip()
+    return out or None
+  except Exception:
+    return None
+
+
 def _probe_host_sync() -> DeviceCapabilities:
   import platform as _platform
   try:
@@ -178,6 +294,15 @@ def _probe_host_sync() -> DeviceCapabilities:
     cores = psutil.cpu_count(logical=False) or os.cpu_count() or 1
   except Exception:
     mem_mb, cores = 8 * 1024, os.cpu_count() or 1
+  # Apple silicon: unified memory + a real GPU — the static table gives the
+  # partitioner honest planning numbers for a Mac peer in a mixed ring.
+  apple = _apple_chip_name()
+  if apple:
+    flops = lookup_chip_flops(apple)
+    if flops is not None:
+      return DeviceCapabilities(
+        model=f"Mac ({apple})", chip=apple, memory=int(mem_mb), flops=flops, num_devices=1,
+      )
   # ~50 GFLOPS fp32/core is a serviceable planning number for modern x86/arm.
   per_core = 0.05
   return DeviceCapabilities(
@@ -244,8 +369,15 @@ async def device_capabilities() -> DeviceCapabilities:
 
 def device_capabilities_sync() -> DeviceCapabilities:
   caps = None
-  if os.getenv("XOT_SKIP_JAX_PROBE", "0") != "1":
+  skip_accel = os.getenv("XOT_SKIP_JAX_PROBE", "0") == "1"
+  if not skip_accel:
     caps = _probe_jax_sync()
+    if caps is None:
+      # torch is a heavyweight import: only pay it when it is installed AND
+      # the caller didn't ask for the instant-start path.
+      import importlib.util
+      if importlib.util.find_spec("torch") is not None:
+        caps = _probe_torch_cuda_sync()
   if caps is None:
     caps = _probe_host_sync()
   if DEBUG >= 1:
