@@ -1,0 +1,19 @@
+# Editable install into a venv on Windows (parity: /root/reference/install.ps1).
+# TPU serving is a Linux/Cloud story; a Windows peer still joins mixed dev
+# rings as a CPU (or CUDA, if a local jax[cuda] wheel is present) node.
+$ErrorActionPreference = "Stop"
+
+$py = "python"
+try {
+  $ver = & $py --version 2>&1
+  Write-Host "Using $ver"
+} catch {
+  Write-Error "Python not found on PATH. Install Python 3.10+ first."
+  exit 1
+}
+
+& $py -m venv .venv
+& .\.venv\Scripts\Activate.ps1
+pip install -e .
+
+Write-Host "Installed. Run '.\.venv\Scripts\Activate.ps1' then 'xot --help'."

@@ -159,6 +159,40 @@ async def test_ring_fused_respects_request_cap(tiny_model_dir):
   assert len(got) == 5
 
 
+async def test_ring_concurrent_requests_coalesce_and_match(tiny_model_dir):
+  """Concurrent requests on one co-located ring coalesce into batched
+  multi-segment dispatches (decode_chunk_ring_batched) and every stream
+  still equals its solo run. Stream equality is asserted on every attempt;
+  the coalescing-width check is timing-dependent (one request can finish
+  before the other's prefill lands), so it gets a bounded retry with a
+  longer generation."""
+  max_tokens = 24
+  prompts = ["first concurrent prompt", "a different second prompt here"]
+  solo = [await _solo_tokens(tiny_model_dir, p, max_tokens) for p in prompts]
+
+  for attempt in range(3):
+    nodes = _ring(tiny_model_dir, 2, max_tokens)
+    widths = []
+    for node in nodes:
+      eng = node.inference_engine
+      orig = eng._ring_batch_sync
+
+      def recording(items, *a, _orig=orig):
+        widths.append(len(items))
+        return _orig(items, *a)
+
+      eng._ring_batch_sync = recording
+
+    results = await asyncio.gather(
+      _generate(nodes[0], prompts[0], f"conc-0-{attempt}", watch=nodes[1:]),
+      _generate(nodes[0], prompts[1], f"conc-1-{attempt}", watch=nodes[1:]),
+    )
+    assert sorted(map(tuple, results)) == sorted(map(tuple, solo))
+    if widths and max(widths) >= 2:
+      return
+  raise AssertionError(f"ring chunks never coalesced in 3 attempts: {widths}")
+
+
 async def test_ring_sampling_extras_fall_back_to_per_token(tiny_model_dir):
   """OpenAI extras (logit_bias etc.) keep the per-token ring — the fused
   ring path must not engage, and the request still completes."""

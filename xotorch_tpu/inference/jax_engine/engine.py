@@ -140,9 +140,14 @@ class _DecodeBatcher:
   (per-step splits inside the scan); greedy decoding is unaffected and
   sampled streams stay independent via their distinct logits."""
 
-  def __init__(self, engine: "JAXShardInferenceEngine", ctx: "_ShardContext"):
+  def __init__(self, engine: "JAXShardInferenceEngine", ctx: "Optional[_ShardContext]",
+               dispatch=None):
     self.engine = engine
     self.ctx = ctx
+    # Optional async dispatch override (the fused-RING batcher reuses this
+    # collector with a different sync body; items' `state` slot then carries
+    # the request's seg list — opaque to the drain loop either way).
+    self.dispatch = dispatch
     self.pending: list = []
     self._draining = False
     self._drain_task = None  # strong ref: the loop only weakly holds tasks
@@ -195,10 +200,14 @@ class _DecodeBatcher:
           for off in range(0, len(items), cap):
             chunk_items = items[off:off + cap]
             try:
-              results = await self.engine._run(
-                self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k, top_p,
-                single_dispatch,
-              )
+              if self.dispatch is not None:
+                results = await self.dispatch(chunk_items, num_tokens, top_k, top_p,
+                                              single_dispatch)
+              else:
+                results = await self.engine._run(
+                  self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k, top_p,
+                  single_dispatch,
+                )
               for (*_, fut), toks in zip(chunk_items, results):
                 if not fut.done():
                   fut.set_result(toks)
@@ -294,6 +303,9 @@ class JAXShardInferenceEngine(InferenceEngine):
     # belong to peer engines' contexts — the ring loop is the request's sole
     # driver, so only this engine's executor ever resolves/rolls them back.
     self._ring_spec: Dict[str, dict] = {}
+    # Continuous-batching collectors for fused RING chunks, keyed by the
+    # co-located chain identity (one per served multi-partition model).
+    self._ring_batchers: Dict[tuple, Any] = {}
     self._overlap_hits = 0
     self._overlap_misses = 0
     self._overlap_batch_hits = 0
@@ -1177,12 +1189,121 @@ class JAXShardInferenceEngine(InferenceEngine):
       ctx.states.move_to_end(request_id)
       segs.append((eng, ctx, state))
 
+    if self._decode_batch_max() > 1:
+      # Continuous batching for ring chunks: concurrent requests on the SAME
+      # co-located chain coalesce into one batched multi-segment dispatch
+      # (decode_chunk_ring_batched) — B rows ride one weight read per
+      # segment, the same aggregate-throughput win as the single-shard
+      # batcher. The `state` slot of the shared collector carries the segs.
+      chain_key = tuple((id(eng), sh) for eng, sh in chain)
+      batcher = self._ring_batchers.get(chain_key)
+      if batcher is None:
+        async def dispatch(items, n, tk, tp, single, _self=self):
+          return await _self._run(_self._ring_batch_sync, items, n, tk, tp)
+
+        batcher = _DecodeBatcher(self, None, dispatch=dispatch)
+        self._ring_batchers[chain_key] = batcher
+      return await batcher.submit(request_id, segs, prev_token, num_tokens,
+                                  float(temp), int(top_k), float(top_p),
+                                  next_size=next_size)
+
     def _chunk() -> np.ndarray:
       return self._ring_chunk_sync(segs, request_id, int(prev_token), int(num_tokens),
                                    float(temp), int(top_k), float(top_p),
                                    int(next_size) if next_size else None)
 
     return await self._run(_chunk)
+
+  def _ring_batch_sync(self, items: list, num_tokens: int, top_k: int,
+                       top_p: float) -> list:
+    """Executor body for a coalesced ring batch. A batch of one delegates to
+    _ring_chunk_sync (keeping its speculative-overlap machinery); B > 1
+    stacks every segment's member caches and runs ONE
+    decode_chunk_ring_batched dispatch. Members whose segments lost pos
+    lockstep resolve to None (their node loops fall back per-token)."""
+    import jax
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import decode_chunk_ring_batched
+
+    if len(items) == 1:
+      rid, segs, prev_token, n, temp, *_rest = items[0]
+      next_size = items[0][7] if len(items[0]) > 8 else None
+      return [self._ring_chunk_sync(segs, rid, int(prev_token), int(n), float(temp),
+                                    int(top_k), float(top_p),
+                                    int(next_size) if next_size else None)]
+
+    # Batch membership supersedes any solo ring speculation: roll back.
+    members = []
+    results: list = [None] * len(items)
+    for i, it in enumerate(items):
+      rid, segs = it[0], it[1]
+      states = [st for _, _, st in segs]
+      spec = self._ring_spec.pop(rid, None)
+      if spec is not None:
+        self._overlap_misses += 1
+        for st in spec["states"]:
+          if st.pos == spec["pos"] + spec["n"]:
+            st.pos = spec["pos"]
+      if any(st.pos != states[0].pos for st in states):
+        continue  # lockstep broken: this member falls back (None result)
+      # Capacity guard (mirrors _ring_chunk_sync): a member whose cache
+      # can't hold the group's chunk is EXCLUDED — its node loop falls back
+      # to the per-token ring, which drains the cache tail and surfaces
+      # CacheExhausted gracefully. Without this, _grow_cache clamps at
+      # max_cache_len and dynamic_update_slice clamps the write start,
+      # silently overwriting earlier KV slots for every batch member.
+      max_len_i = min(c.max_cache_len for _, c, _ in segs)
+      if states[0].pos + num_tokens > max_len_i:
+        continue
+      members.append((i, it))
+    if not members:
+      return results
+
+    segs0 = members[0][1][1]
+    n_seg = len(segs0)
+    # Per segment: grow every member's cache to a common power-of-two length
+    # (one executable per (B, n, S...) tuple; same policy as the single-shard
+    # batched path).
+    for s in range(n_seg):
+      seg_states = [it[1][s][2] for _, it in members]
+      eng, ctx = segs0[s][0], segs0[s][1]
+      target = max(max(st.pos + num_tokens for st in seg_states),
+                   max(st.cache["k"].shape[2] for st in seg_states))
+      for _, it in members:
+        e_i, c_i, st_i = it[1][s]
+        if st_i.cache["k"].shape[2] < target:
+          e_i._grow_cache(c_i, st_i, target)
+
+    cfg = segs0[-1][1].cfg
+    S = members[0][1][1][0][2].cache["k"].shape[2]
+    use_fd = self._pallas_kernels_ok(cfg) and self._flash_decode_on(S)
+    B = len(members)
+    B_pad = _bucket(B, 1)
+    pos_vec = jnp.asarray([it[1][0][2].pos for _, it in members], jnp.int32)
+    temps = jnp.asarray([float(it[4]) for _, it in members], jnp.float32)
+    toks = jnp.asarray([[int(it[2])] for _, it in members], jnp.int32)
+    self._sample_calls += 1
+    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+    seg_caches = tuple(
+      tuple(it[1][s][2].cache for _, it in members) for s in range(n_seg)
+    )
+    out, new_seg_caches = decode_chunk_ring_batched(
+      tuple(ctx.params for _, ctx, _ in segs0), seg_caches, toks, pos_vec, key,
+      cfg, num_tokens, temps, top_k, top_p, use_flash_decode=use_fd,
+      start_layers=tuple(ctx.shard.start_layer for _, ctx, _ in segs0),
+      moe_routed=all(self._moe_routed_for(c) for _, c, _ in segs0),
+      pad_rows=B_pad - B,
+    )
+    out_np = np.asarray(out)
+    now = time.monotonic()
+    for b, (i, it) in enumerate(members):
+      for s in range(n_seg):
+        st = it[1][s][2]
+        st.cache = new_seg_caches[s][b]
+        st.pos = int(pos_vec[b]) + num_tokens
+        st.last_used = now
+      results[i] = out_np[b].astype(np.int64)
+    return results
 
   def _ring_chunk_sync(self, segs, request_id: str, prev_token: int, num_tokens: int,
                        temp: float, top_k: int, top_p: float,
@@ -1271,9 +1392,15 @@ class JAXShardInferenceEngine(InferenceEngine):
     # last token BEFORE fetching — the device crunches chunk N+1 while the
     # host ingests chunk N (EOS scan + broadcast), hiding the chunk-boundary
     # round-trip exactly like the single-shard overlap path. Solo requests
-    # only (ring decode has no batcher to coalesce into).
+    # only: under concurrency the next chunk coalesces into a ring BATCH
+    # (different executable/membership), so the solo speculation would miss
+    # every time — same measured rationale as the single-shard default.
+    now0 = time.monotonic()
+    last_ctx, last_state = segs[-1][1], states[-1]
+    others_active = any(st is not last_state and now0 - st.last_used < 1.0
+                        for st in last_ctx.states.values())
     spec_rec = None
-    if (next_size and self._overlap_on()
+    if (next_size and self._overlap_on() and not others_active
         and states[0].pos + next_size <= max_len):
       pos_before = states[0].pos
       ntoks = dispatch(toks[:, -1:].astype(jnp.int32), next_size)

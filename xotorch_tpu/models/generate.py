@@ -223,6 +223,72 @@ def decode_chunk_ring(
 
 @partial(
   jax.jit,
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "start_layers",
+                   "moe_routed", "pad_rows"),
+  donate_argnames=("seg_caches",),
+)
+def decode_chunk_ring_batched(
+  params_segs,  # tuple of per-partition param pytrees, ring order
+  seg_caches,  # tuple over segments of tuples over B requests of cache dicts
+  toks: jnp.ndarray,  # [B, 1] int32 — each request's last sampled token
+  pos_vec: jnp.ndarray,  # [B] int32 per-request positions
+  key: jax.Array,
+  cfg: ModelConfig,
+  num_tokens: int,
+  temps: jnp.ndarray,  # [B] per-request temperatures (traced)
+  top_k: int,
+  top_p: float = 0.0,
+  use_flash_decode: bool = False,
+  start_layers: Tuple[int, ...] = (0,),
+  moe_routed: bool = True,
+  pad_rows: int = 0,  # static: dummy rows padding B to a power of two
+):
+  """Continuous batching for the fused multi-partition ring: B concurrent
+  requests' chunks share ONE dispatch through every partition's layer stack
+  (same win as decode_chunk_batched — decode is weight-HBM-bound, so B rows
+  ride one weight read per segment instead of B). Stack each segment's
+  per-request caches along batch, scan the composite per-token step with
+  PER-ROW positions, split every segment's caches back — all inside one
+  compiled program. Returns ([B_real, num_tokens] tokens, tuple over
+  segments of tuples of B_real updated caches)."""
+  B = len(seg_caches[0])
+  stacked = []
+  for caches in seg_caches:
+    stacked.append({
+      name: jnp.concatenate([c[name] for c in caches]
+                            + [jnp.zeros_like(caches[0][name])] * pad_rows, axis=1)
+      for name in caches[0]
+    })
+  if pad_rows:
+    toks = jnp.concatenate([toks, jnp.broadcast_to(toks[:1], (pad_rows, 1))], axis=0)
+    pos_vec = jnp.concatenate([pos_vec, jnp.broadcast_to(pos_vec[:1], (pad_rows,))])
+    temps = jnp.concatenate([temps, jnp.broadcast_to(temps[:1], (pad_rows,))])
+
+  def step(carry, _):
+    tok, caches, pos, key = carry
+    h = tok
+    new_caches = []
+    for i, params in enumerate(params_segs):
+      h, c = forward_shard(params, h, caches[i], pos, cfg=cfg, is_first=(i == 0),
+                           is_last=False, use_flash_decode=use_flash_decode,
+                           start_layer=start_layers[i], moe_routed=moe_routed)
+      new_caches.append(c)
+    logits = unembed(params_segs[-1], h, cfg)
+    key, sub = jax.random.split(key)
+    nxt = sample_logits(logits[:, -1, :], sub, temp=temps, top_k=top_k, top_p=top_p)
+    return (nxt[:, None], tuple(new_caches), pos + 1, key), nxt
+
+  init = (toks.astype(jnp.int32), tuple(stacked), pos_vec.astype(jnp.int32), key)
+  (_, stacked, _, _), out = jax.lax.scan(step, init, None, length=num_tokens)
+  split = tuple(
+    tuple({name: seg[name][:, i:i + 1] for name in seg} for i in range(B))
+    for seg in stacked
+  )
+  return out.T[:B], split
+
+
+@partial(
+  jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "pad_rows",
                    "moe_routed"),
   donate_argnames=("caches",),

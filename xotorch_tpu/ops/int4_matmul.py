@@ -36,15 +36,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
+def _signext4(x: jnp.ndarray) -> jnp.ndarray:
+  # Branch-free sign extension of a 4-bit value sitting in an int32 lane:
+  # (x ^ 8) - 8 maps 0..7 -> 0..7 and 8..15 -> -8..-1 in two cheap integer
+  # ops (the compare+select formulation costs three and a mask register).
+  return (x ^ 8) - 8
+
+
 def _int4_matvec_kernel(he_ref, ho_ref, w_ref, gs_ref, o_ref):
   # f32 in-kernel math: measured FASTER than bf16 compute (275 vs 242
   # tok/s end to end — the extra converts cost more than the halved
   # elementwise bytes save on the VPU).
   packed = w_ref[...].astype(jnp.int32)  # [G, gs//2, block_out]
-  lo = packed & 0xF
-  hi = packed >> 4
-  lo = jnp.where(lo > 7, lo - 16, lo)
-  hi = jnp.where(hi > 7, hi - 16, hi)
+  lo = _signext4(packed & 0xF)
+  hi = _signext4(packed >> 4)
   scale = gs_ref[...].astype(jnp.float32)  # [G, 1, block_out]
   G, gs_half, block_out = packed.shape
   lo_f = (lo.astype(jnp.float32) * scale).reshape(G * gs_half, block_out)
@@ -59,18 +64,64 @@ def _int4_matvec_kernel(he_ref, ho_ref, w_ref, gs_ref, o_ref):
   o_ref[...] = acc.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_out", "interpret"))
+def _int4_matvec_kernel_v2(he_ref, ho_ref, w_ref, gs_ref, o_ref):
+  """Scale-after-dot variant: contract RAW sign-extended nibbles (no
+  per-weight-element scale multiply — that was a full [in/2, block_out] VPU
+  pass per nibble half in v1), then apply the [G, out] group scales to the
+  [G, rows, out] per-group partials and reduce over G. The per-group
+  contraction runs as ONE batched MXU dot (G batch dims), so the extra
+  work is a tiny [G*rows*out] multiply-add instead of two [in/2 * out]
+  multiplies. Selected via XOT_INT4_V=2 for on-chip A/B measurement."""
+  packed = w_ref[...].astype(jnp.int32)  # [G, gs//2, block_out]
+  lo_f = _signext4(packed & 0xF).astype(jnp.float32)
+  hi_f = _signext4(packed >> 4).astype(jnp.float32)
+  G, gs_half, block_out = packed.shape
+  rows = he_ref.shape[0]
+
+  # [rows, G*gs_half] -> [G, rows, gs_half] batched lhs. The transpose is on
+  # the TINY activation (rows <= 8), not the weight tile.
+  he = he_ref[...].astype(jnp.float32).reshape(rows, G, gs_half).transpose(1, 0, 2)
+  ho = ho_ref[...].astype(jnp.float32).reshape(rows, G, gs_half).transpose(1, 0, 2)
+  # Batched over G: [G, rows, gs_half] x [G, gs_half, block_out] -> [G, rows, block_out]
+  dims = (((2,), (1,)), ((0,), (0,)))
+  part = jax.lax.dot_general(he, lo_f, dims, preferred_element_type=jnp.float32)
+  part = part + jax.lax.dot_general(ho, hi_f, dims, preferred_element_type=jnp.float32)
+  scale = gs_ref[...].astype(jnp.float32)  # [G, 1, block_out] broadcasts over rows
+  o_ref[...] = (part * scale).sum(axis=0).astype(o_ref.dtype)
+
+
 def int4_grouped_matmul(
   h: jnp.ndarray,  # [rows, in] (rows small — decode)
   w_packed: jnp.ndarray,  # [G, gs // 2, out] uint8 (models/quantize.pack_int4)
   gscale: jnp.ndarray,  # [G, out]
   block_out: int = 1024,
   interpret: bool | None = None,
+  variant: int | None = None,  # 1 = scale-into-operand, 2 = scale-after-dot
 ) -> jnp.ndarray:
   """h @ dequant(w) with the nibble unpack fused into the kernel.
 
-  Returns [rows, out] in h.dtype.
+  Returns [rows, out] in h.dtype. `variant` (default env XOT_INT4_V, 1)
+  picks the kernel body for on-chip A/B measurement. The env is resolved
+  OUTSIDE the jitted impl so a direct caller always gets the current value;
+  when this runs inside an outer jit (the engine's decode executables) the
+  choice is baked at that outer trace — set XOT_INT4_V before first use.
   """
+  if variant is None:
+    import os
+    variant = int(os.getenv("XOT_INT4_V", "1"))
+  return _int4_grouped_matmul_impl(h, w_packed, gscale, block_out=block_out,
+                                   interpret=interpret, variant=variant)
+
+
+@functools.partial(jax.jit, static_argnames=("block_out", "interpret", "variant"))
+def _int4_grouped_matmul_impl(
+  h: jnp.ndarray,
+  w_packed: jnp.ndarray,
+  gscale: jnp.ndarray,
+  block_out: int = 1024,
+  interpret: bool | None = None,
+  variant: int = 1,
+) -> jnp.ndarray:
   rows, d_in = h.shape
   G, gs_half, d_out = w_packed.shape
   gs = gs_half * 2
@@ -96,7 +147,7 @@ def int4_grouped_matmul(
   gs3 = gscale.reshape(G, 1, d_out)
 
   out = pl.pallas_call(
-    _int4_matvec_kernel,
+    _int4_matvec_kernel_v2 if variant == 2 else _int4_matvec_kernel,
     grid=(d_out // block_out,),
     in_specs=[
       pl.BlockSpec((rows, G * gs_half), lambda j: (0, 0)),

@@ -115,7 +115,7 @@ GPU_CHIP_FLOPS: Dict[str, DeviceFlops] = {
   "NVIDIA V100": DeviceFlops(fp32=15.7 * TFLOPS, fp16=125.0 * TFLOPS, int8=62.8 * TFLOPS),
   "NVIDIA T4": DeviceFlops(fp32=8.1 * TFLOPS, fp16=65.0 * TFLOPS, int8=130.0 * TFLOPS),
   "NVIDIA P100": DeviceFlops(fp32=9.3 * TFLOPS, fp16=18.7 * TFLOPS, int8=9.3 * TFLOPS),
-  "NVIDIA A6000": DeviceFlops(fp32=38.7 * TFLOPS, fp16=155.0 * TFLOPS, int8=310.0 * TFLOPS),
+  "RTX A6000": DeviceFlops(fp32=38.7 * TFLOPS, fp16=155.0 * TFLOPS, int8=310.0 * TFLOPS),
   # consumer
   "RTX 5090": DeviceFlops(fp32=104.8 * TFLOPS, fp16=209.6 * TFLOPS, int8=838.0 * TFLOPS),
   "RTX 4090": DeviceFlops(fp32=82.6 * TFLOPS, fp16=165.2 * TFLOPS, int8=660.6 * TFLOPS),
@@ -129,9 +129,10 @@ GPU_CHIP_FLOPS: Dict[str, DeviceFlops] = {
   "T1000": DeviceFlops(fp32=2.5 * TFLOPS, fp16=5.0 * TFLOPS, int8=10.0 * TFLOPS),
   "Quadro M2000": DeviceFlops(fp32=1.8 * TFLOPS, fp16=0.03 * TFLOPS, int8=1.8 * TFLOPS),
   "Quadro P400": DeviceFlops(fp32=0.6 * TFLOPS, fp16=0.01 * TFLOPS, int8=0.6 * TFLOPS),
-  # AMD
-  "AMD MI300X": DeviceFlops(fp32=163.4 * TFLOPS, fp16=1307.0 * TFLOPS, int8=2614.0 * TFLOPS),
-  "AMD MI250X": DeviceFlops(fp32=47.9 * TFLOPS, fp16=383.0 * TFLOPS, int8=383.0 * TFLOPS),
+  # AMD (drivers report "AMD Instinct MI300X" — keys are the minimal
+  # distinctive substring so both torch and rocm-smi name forms hit)
+  "MI300X": DeviceFlops(fp32=163.4 * TFLOPS, fp16=1307.0 * TFLOPS, int8=2614.0 * TFLOPS),
+  "MI250X": DeviceFlops(fp32=47.9 * TFLOPS, fp16=383.0 * TFLOPS, int8=383.0 * TFLOPS),
   "Radeon RX 7900": DeviceFlops(fp32=61.4 * TFLOPS, fp16=122.8 * TFLOPS, int8=122.8 * TFLOPS),
   # Jetson (edge)
   "Jetson AGX Orin": DeviceFlops(fp32=5.3 * TFLOPS, fp16=10.6 * TFLOPS, int8=105.0 * TFLOPS),
