@@ -1,0 +1,111 @@
+"""One-shot TPU measurement session: harvest everything round 4 needs from
+a live tunnel window (the tunnel dies for hours at a stretch — when it is
+up, every pending measurement should land in one sitting).
+
+Runs, in order, each as a bench.py subprocess (so the parent watchdog and
+plausibility gates apply), each snapshotted to BENCH_TPU_r04_*.json:
+
+  1. main     — full flagship bench (bf16 + int8 + long-context + fused
+                ring2 + 8-stream concurrent + prefill MFU)
+  2. int4 A/B — the two Pallas int4 kernel variants (XOT_INT4_V=1/2)
+  3. flash sweep — prefill-MFU block-size configs for ops/flash_attention
+
+Aborts the remaining steps the moment a step lands on CPU (tunnel died) —
+partial TPU data beats a pile of CPU fallbacks.
+
+Usage: python scripts/tpu_session.py [--only main|int4|flash]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_bench(tag: str, extra_env: dict, timeout: float = 5400) -> dict | None:
+  """One bench.py run; returns the parsed result line (also snapshotted)."""
+  env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}}
+  print(f"[tpu-session] {tag}: {extra_env}", flush=True)
+  t0 = time.time()
+  try:
+    proc = subprocess.run([sys.executable, str(REPO / "bench.py")], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+  except subprocess.TimeoutExpired:
+    print(f"[tpu-session] {tag}: timed out after {timeout}s", flush=True)
+    return None
+  result = None
+  for ln in reversed(proc.stdout.strip().splitlines()):
+    try:
+      result = json.loads(ln)
+      break
+    except json.JSONDecodeError:
+      continue
+  if result is None:
+    print(f"[tpu-session] {tag}: no result (rc={proc.returncode})\n{proc.stderr[-2000:]}",
+          flush=True)
+    return None
+  result["session_tag"] = tag
+  result["elapsed_s"] = round(time.time() - t0, 1)
+  out = REPO / f"BENCH_TPU_r04_{tag}.json"
+  out.write_text(json.dumps(result, indent=2))
+  print(f"[tpu-session] {tag}: platform={result.get('platform')} "
+        f"tok_s={result.get('value')} -> {out.name} ({result['elapsed_s']}s)", flush=True)
+  return result
+
+
+def on_tpu(result: dict | None) -> bool:
+  return bool(result) and result.get("platform") == "tpu"
+
+
+def main() -> None:
+  only = sys.argv[sys.argv.index("--only") + 1] if "--only" in sys.argv else None
+
+  if only in (None, "main"):
+    main_res = run_bench("main", {"BENCH_TPU_TRIES": "2"})
+    if not on_tpu(main_res):
+      print("[tpu-session] tunnel dead at main stage; aborting session", flush=True)
+      if only is None:
+        return
+    if only == "main":
+      return
+
+  # Short config for the A/B and sweep stages: smoke skipped, no long/ring/
+  # concurrent repeats — the question is the relative kernel speed.
+  short = {
+    "BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_RING": "",
+    "BENCH_CONCURRENT": "0", "BENCH_LONG": "0",
+  }
+
+  if only in (None, "int4"):
+    results = {}
+    for v in (1, 2):
+      r = run_bench(f"int4v{v}", {**short, "BENCH_QUANT": "int4", "XOT_INT4_V": v})
+      if not on_tpu(r):
+        print("[tpu-session] tunnel dead during int4 A/B; aborting", flush=True)
+        return
+      results[v] = r.get("int4_tok_s")
+    print(f"[tpu-session] int4 A/B: v1={results.get(1)} v2={results.get(2)} tok/s", flush=True)
+
+  if only in (None, "flash"):
+    sweep = {}
+    for bq, bk in ((128, 128), (256, 256), (512, 512), (256, 512), (128, 512)):
+      r = run_bench(f"flash{bq}x{bk}", {
+        **short, "BENCH_QUANT": "", "BENCH_LONG": "16384", "BENCH_DECODE": "32",
+        "XOT_FLASH_BLOCK_Q": bq, "XOT_FLASH_BLOCK_K": bk,
+      })
+      if not on_tpu(r):
+        print("[tpu-session] tunnel dead during flash sweep; stopping", flush=True)
+        break
+      sweep[f"{bq}x{bk}"] = {"prefill_mfu_pct": r.get("prefill_mfu_pct"),
+                             "long_prefill_s": r.get("long_prefill_s")}
+    (REPO / "BENCH_TPU_r04_flashsweep.json").write_text(json.dumps(sweep, indent=2))
+    print(f"[tpu-session] flash sweep: {json.dumps(sweep)}", flush=True)
+
+
+if __name__ == "__main__":
+  main()

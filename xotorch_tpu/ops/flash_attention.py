@@ -163,8 +163,8 @@ def flash_attention(
   q: jnp.ndarray,  # [B, T, Hq, D]
   k: jnp.ndarray,  # [B, T, Hkv, D]
   v: jnp.ndarray,  # [B, T, Hkv, D]
-  block_q: int = 128,
-  block_k: int = 128,
+  block_q: int | None = None,  # default env XOT_FLASH_BLOCK_Q, else 128
+  block_k: int | None = None,  # default env XOT_FLASH_BLOCK_K, else 128
   interpret: bool | None = None,
   window: jnp.ndarray | None = None,  # traced scalar int32; None = global-only kernel
   softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
@@ -178,7 +178,16 @@ def flash_attention(
   power-of-two prefill buckets guarantee this. `window=None` (static)
   compiles the original non-prefetch kernel, so non-windowed families'
   executables are byte-identical to before.
+
+  Block sizes default from XOT_FLASH_BLOCK_Q/XOT_FLASH_BLOCK_K (else
+  128x128) — the prefill-MFU tuning knob (VERDICT r3 #5); read at trace
+  time, so set them before the engine compiles its executables.
   """
+  import os
+  if block_q is None:
+    block_q = int(os.getenv("XOT_FLASH_BLOCK_Q", "128"))
+  if block_k is None:
+    block_k = int(os.getenv("XOT_FLASH_BLOCK_K", "128"))
   B, T, Hq, D = q.shape
   Hkv = k.shape[2]
   groups = Hq // Hkv
