@@ -104,6 +104,21 @@ async def test_ep_with_int8_experts_matches_dense(monkeypatch):
   assert ep_stream == dense_stream
 
 
+async def test_ep_composes_with_tp(monkeypatch):
+  """ep x tp mesh: experts shard over 'ep' AND their inner dim over 'tp'
+  (attention fully tp): stream still equals the dense single-chip run."""
+  dense_stream, _, _ = await _serve_stream(monkeypatch, 0)
+  monkeypatch.setenv("XOT_SERVE_EP", "2")
+  monkeypatch.setenv("XOT_SERVE_TP", "2")
+  eng = JAXShardInferenceEngine(dtype="float32")
+  out, _ = await eng.infer_prompt("moe-eptp", SHARD, "route the experts please")
+  tok = int(np.argmax(np.asarray(out)[0, -1]))
+  chunk = await eng.generate_chunk("moe-eptp", SHARD, tok, 8, temp=0.0, top_k=0)
+  stream = [tok] + [int(t) for t in chunk]
+  assert eng._mesh is not None and eng._mesh.shape["ep"] == 2 and eng._mesh.shape["tp"] == 2
+  assert stream == dense_stream
+
+
 async def test_ep_reduces_to_divisor_of_expert_count(monkeypatch):
   """A requested ep that does not divide num_experts (4) reduces to the
   largest divisor instead of failing placement."""

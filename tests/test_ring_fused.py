@@ -193,6 +193,26 @@ async def test_ring_concurrent_requests_coalesce_and_match(tiny_model_dir):
   raise AssertionError(f"ring chunks never coalesced in 3 attempts: {widths}")
 
 
+async def test_ring_speculative_decoding(tiny_model_dir, monkeypatch):
+  """Prompt-lookup speculation on a multi-partition ring: the sampler peer
+  drafts from prompt+output (prompt ids ride the first hop's side-channel)
+  and verifies through the composite ring forward (verify_draft_ring) — the
+  stream must still equal the solo run exactly (accepted tokens are by
+  construction what sequential greedy decode produces)."""
+  monkeypatch.setenv("XOT_SPECULATE", "4")
+  max_tokens = 16
+  prompt = "the cat sat on the mat the cat sat on the mat the cat"
+  want = await _solo_tokens(tiny_model_dir, prompt, max_tokens)
+
+  nodes = _ring(tiny_model_dir, 2, max_tokens)
+  # Node reads XOT_SPECULATE at construction; _ring built them post-setenv.
+  assert nodes[0].speculate_tokens == 4
+  got = await _generate(nodes[0], prompt, "req-spec", watch=nodes[1:])
+  assert got == want
+  proposed = sum(n.inference_engine._spec_proposed for n in nodes)
+  assert proposed > 0, "ring verify never ran (no drafts proposed)"
+
+
 async def test_ring_sampling_extras_fall_back_to_per_token(tiny_model_dir):
   """OpenAI extras (logit_bias etc.) keep the per-token ring — the fused
   ring path must not engage, and the request still completes."""

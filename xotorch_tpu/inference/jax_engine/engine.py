@@ -1160,34 +1160,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     fused path needs no migration, and the per-token ring remains the
     fallback (returns None when the chain doesn't qualify). Called on the
     LAST shard's engine (the sampler peer drives generation)."""
-    if num_tokens < 1 or len(chain) < 2:
+    if num_tokens < 1:
       return None
-    shards = [s for _, s in chain]
-    if not (shards[0].is_first_layer and shards[-1].is_last_layer):
+    segs = self._resolve_ring_segs(request_id, chain)
+    if segs is None:
       return None
-    if any(b.start_layer != a.end_layer + 1 for a, b in zip(shards, shards[1:])):
-      return None  # non-contiguous coverage: not a whole-model chain
-    segs = []
-    for eng, sh in chain:
-      if not getattr(eng, "supports_ring_fusion", False) or not isinstance(eng, JAXShardInferenceEngine):
-        return None
-      ctx = eng._contexts.get(sh)
-      if ctx is None:
-        # Prefill created this context; its loss mid-generation means the KV
-        # cache is gone too — fail loudly (same contract as generate_chunk).
-        raise RequestStateLost(
-          f"request {request_id}: model context {sh.model_id} [{sh.start_layer}-{sh.end_layer}] "
-          f"evicted mid-generation on {eng!r}")
-      state = ctx.states.get(request_id)
-      if state is None:
-        raise RequestStateLost(
-          f"request {request_id}: device state for layers [{sh.start_layer}-{sh.end_layer}] "
-          f"evicted mid-generation")
-      if state.extras is not None:
-        return None  # sampling extras decode per-token (host-side bookkeeping)
-      eng._contexts.move_to_end(sh)
-      ctx.states.move_to_end(request_id)
-      segs.append((eng, ctx, state))
 
     if self._decode_batch_max() > 1:
       # Continuous batching for ring chunks: concurrent requests on the SAME
@@ -1213,6 +1190,124 @@ class JAXShardInferenceEngine(InferenceEngine):
                                    int(next_size) if next_size else None)
 
     return await self._run(_chunk)
+
+  def _resolve_ring_segs(self, request_id: str, chain) -> Optional[list]:
+    """Validate a co-located chain and resolve its [(engine, ctx, state)]
+    segments — ONE qualification rule shared by the fused-ring decode,
+    batch, and draft-verify paths. Returns None when the chain doesn't
+    qualify (caller falls back); raises RequestStateLost when a segment's
+    context/state was evicted mid-generation (same loud contract as
+    generate_chunk)."""
+    if len(chain) < 2:
+      return None
+    shards = [s for _, s in chain]
+    if not (shards[0].is_first_layer and shards[-1].is_last_layer):
+      return None
+    if any(b.start_layer != a.end_layer + 1 for a, b in zip(shards, shards[1:])):
+      return None  # non-contiguous coverage: not a whole-model chain
+    segs = []
+    for eng, sh in chain:
+      if not getattr(eng, "supports_ring_fusion", False) or not isinstance(eng, JAXShardInferenceEngine):
+        return None
+      ctx = eng._contexts.get(sh)
+      if ctx is None:
+        # Prefill created this context; its loss mid-generation means the KV
+        # cache is gone too — fail loudly.
+        raise RequestStateLost(
+          f"request {request_id}: model context {sh.model_id} [{sh.start_layer}-{sh.end_layer}] "
+          f"evicted mid-generation on {eng!r}")
+      state = ctx.states.get(request_id)
+      if state is None:
+        raise RequestStateLost(
+          f"request {request_id}: device state for layers [{sh.start_layer}-{sh.end_layer}] "
+          f"evicted mid-generation")
+      if state.extras is not None:
+        return None  # sampling extras decode per-token (host-side bookkeeping)
+      eng._contexts.move_to_end(sh)
+      ctx.states.move_to_end(request_id)
+      segs.append((eng, ctx, state))
+    return segs
+
+  async def verify_draft_ring(self, request_id: str, chain, prev_token: int,
+                              draft: list) -> Optional[list]:
+    """Greedy draft verification across a CO-LOCATED multi-partition ring:
+    one composite forward (models/generate.forward_argmax_ring) runs
+    [prev_token] + draft through every partition's layers and accepts the
+    longest matching prefix + bonus — prompt-lookup speculation works on
+    multi-partition rings exactly as on a single shard. Returns the accepted
+    tokens, or None when the fast path does not apply (caller decodes
+    normally)."""
+    if not draft:
+      return None
+    segs = self._resolve_ring_segs(request_id, chain)
+    if segs is None:
+      return None
+
+    def _verify():
+      return self._ring_verify_sync(segs, request_id, int(prev_token),
+                                    [int(t) for t in draft])
+
+    return await self._run(_verify)
+
+  def _ring_verify_sync(self, segs, request_id: str, prev_token: int,
+                        draft: list) -> Optional[list]:
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import forward_argmax_ring
+
+    states = [st for _, _, st in segs]
+    T = 1 + len(draft)
+    T_pad = _bucket(T)
+    max_len = min(ctx.max_cache_len for _, ctx, _ in segs)
+    # Room check against the COMMITTED position BEFORE touching the spec
+    # record: near the cache tail every iteration finds a draft and bails —
+    # popping first would throw away (and force recomputing) the in-flight
+    # speculative chunk each time, killing the overlap for the request's
+    # remainder (same ordering rule as verify_draft's _committed_pos check).
+    spec = self._ring_spec.get(request_id)
+    committed = (spec["pos"]
+                 if spec is not None and all(st.pos == spec["pos"] + spec["n"]
+                                             for st in spec["states"])
+                 else states[0].pos)
+    if committed + T_pad > max_len:
+      return None  # no room to verify: caller decodes normally, spec intact
+    # The verify supersedes any in-flight ring speculation: roll it back so
+    # pos below is the committed one.
+    spec = self._ring_spec.pop(request_id, None)
+    if spec is not None:
+      self._overlap_misses += 1
+      for st in spec["states"]:
+        if st.pos == spec["pos"] + spec["n"]:
+          st.pos = spec["pos"]
+    pos = states[0].pos
+    if any(st.pos != pos for st in states):
+      return None  # lockstep broken: plain decode path recovers
+    for eng, ctx, st in segs:
+      if st.cache["k"].shape[2] < pos + T_pad:
+        eng._grow_cache(ctx, st, pos + T_pad)
+    x = np.zeros((1, T_pad), dtype=np.int64)
+    x[0, :T] = [prev_token] + draft
+    S = states[0].cache["k"].shape[2]
+    use_fd = self._pallas_kernels_ok(segs[0][1].cfg) and self._flash_decode_on(S)
+    preds_dev, new_caches = forward_argmax_ring(
+      tuple(ctx.params for _, ctx, _ in segs), jnp.asarray(x, jnp.int32),
+      tuple(st.cache for st in states), jnp.int32(pos), segs[-1][1].cfg,
+      use_flash_decode=use_fd,
+      start_layers=tuple(ctx.shard.start_layer for _, ctx, _ in segs),
+      moe_routed=all(self._moe_routed_for(c) for _, c, _ in segs),
+    )
+    preds = np.asarray(preds_dev[0, :T]).astype(np.int64)
+    n_acc = 0
+    while n_acc < len(draft) and int(preds[n_acc]) == draft[n_acc]:
+      n_acc += 1
+    accepted = draft[:n_acc] + [int(preds[n_acc])]
+    now = time.monotonic()
+    for st, c in zip(states, new_caches):
+      st.cache = c
+      st.pos = pos + 1 + n_acc
+      st.last_used = now
+    self._spec_proposed += len(draft)
+    self._spec_accepted += n_acc
+    return accepted
 
   def _ring_batch_sync(self, items: list, num_tokens: int, top_k: int,
                        top_p: float) -> list:

@@ -223,6 +223,38 @@ def decode_chunk_ring(
 
 @partial(
   jax.jit,
+  static_argnames=("cfg", "use_flash_decode", "start_layers", "moe_routed"),
+  donate_argnames=("caches",),
+)
+def forward_argmax_ring(
+  params_segs,  # tuple of per-partition param pytrees, ring order
+  x: jnp.ndarray,  # [1, T_pad] int32 — [prev_token] + draft, zero-padded
+  caches,  # tuple of per-partition cache dicts
+  start_pos: jnp.ndarray,  # scalar int32
+  cfg: ModelConfig,
+  use_flash_decode: bool = False,
+  start_layers: Tuple[int, ...] = (0,),
+  moe_routed: bool = True,
+):
+  """One forward through EVERY co-located partition + per-position greedy
+  argmax: the ring twin of the draft-verification forward (engine
+  verify_draft) — a whole prompt-lookup draft verifies in ONE dispatch even
+  when the model spans partitions. Returns ([1, T_pad] int32 argmax,
+  updated caches); positions past the true draft length are padding (their
+  cache writes sit past the validity mask and get overwritten)."""
+  h = x
+  new_caches = []
+  for i, params in enumerate(params_segs):
+    h, c = forward_shard(params, h, caches[i], start_pos, cfg=cfg, is_first=(i == 0),
+                         is_last=False, use_flash_decode=use_flash_decode,
+                         start_layer=start_layers[i], moe_routed=moe_routed)
+    new_caches.append(c)
+  logits = unembed(params_segs[-1], h, cfg)
+  return jnp.argmax(logits, axis=-1).astype(jnp.int32), tuple(new_caches)
+
+
+@partial(
+  jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "start_layers",
                    "moe_routed", "pad_rows"),
   donate_argnames=("seg_caches",),

@@ -58,6 +58,11 @@ TOP_P_KEY = "xot_top_p"
 # (chatgpt_api.py): one JSON-safe dict {seed, logit_bias,
 # presence_penalty, frequency_penalty} applied on device by the sampler.
 SAMPLING_KEY = "xot_sampling"
+# Prompt token ids for prompt-lookup speculation on multi-partition rings:
+# mid-ring hops carry hidden states, so the SAMPLER peer (which drafts)
+# never sees the prompt tokens unless the first-layer owner sends them once
+# on the first hop. Only attached when XOT_SPECULATE > 0.
+PROMPT_TOKENS_KEY = "xot_prompt_tokens"
 
 
 _DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
@@ -369,6 +374,20 @@ class Node:
       request_id, shard, prompt, images=images,
       **self._keep_on_device_kwargs(shard),
     )
+    if (self.speculate_tokens > 0 and not shard.is_last_layer and not images
+        and self._inprocess_chain(base_shard) is not None):
+      # Ship the prompt ids to the sampler peer once (first hop's state):
+      # prompt-lookup drafting needs tokens, and mid-ring hops are hidden
+      # states only. Only for co-located chains — the fused ring (the only
+      # consumer of ring speculation) requires them, and a network ring
+      # would pay the wire bytes for nothing. The extra tokenize is the
+      # price of keeping engine.infer_prompt's one-call contract.
+      try:
+        toks = await self.inference_engine.encode(shard, prompt)
+        inference_state = {**(inference_state or {}),
+                           PROMPT_TOKENS_KEY: [int(t) for t in np.asarray(toks).reshape(-1)]}
+      except Exception:
+        pass  # speculation degrades to output-only drafting
     await self.process_inference_result(base_shard, result, request_id, inference_state)
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
@@ -403,6 +422,14 @@ class Node:
       s = inference_state.get(SAMPLING_KEY)
       if s:
         self._request_sampling[request_id] = dict(s)
+    if inference_state and request_id not in self._request_prompt_tokens:
+      # Only the SAMPLER (last-layer peer) consumes the prompt ids — a
+      # mid-ring node on a 3+-partition ring must forward them untouched or
+      # the drafting peer never sees them.
+      if shard.is_last_layer:
+        pt = inference_state.pop(PROMPT_TOKENS_KEY, None)  # consume: no more hops need it
+        if pt:
+          self._request_prompt_tokens[request_id] = [int(t) for t in pt]
     try:
       sampler = getattr(self.inference_engine, "infer_sample_tensor", None)
       fuse_sample = shard.is_last_layer and sampler is not None
@@ -561,11 +588,13 @@ class Node:
         # (engine.generate_chunk_ring) instead of one hop per partition per
         # token — the ring decodes at the fused rate. The sampler peer (last
         # layer) drives, same as it drives the per-token ring.
-        ring_gen = self._ring_fused_gen(base_shard, request_id)
-        if ring_gen is not None:
+        ring = self._ring_fused_gen(base_shard, request_id)
+        if ring is not None:
+          ring_gen, ring_verify = ring
           self._spawn(
             self._fused_decode_loop(base_shard, shard, request_id, buffered, inference_state,
-                                    ring_gen, allow_speculation=False)
+                                    ring_gen, allow_speculation=False,
+                                    ring_verify=ring_verify)
           )
           return
 
@@ -586,6 +615,27 @@ class Node:
     ring = getattr(self.inference_engine, "generate_chunk_ring", None)
     if ring is None:
       return None
+    chain = self._inprocess_chain(base_shard)
+    if chain is None:
+      return None
+
+    async def gen(rid, _shard, prev_token, num_tokens, temp, top_k, top_p=0.0, next_size=None):
+      return await ring(rid, chain, prev_token, num_tokens, temp=temp, top_k=top_k,
+                        top_p=top_p, next_size=next_size)
+
+    ring_verify_impl = getattr(self.inference_engine, "verify_draft_ring", None)
+    verify = None
+    if ring_verify_impl is not None:
+      async def verify(rid, _shard, prev_token, draft, _impl=ring_verify_impl):
+        return await _impl(rid, chain, prev_token, draft)
+
+    return gen, verify
+
+  def _inprocess_chain(self, base_shard: Shard):
+    """The ring-ordered [(engine, shard)] chain when EVERY partition is
+    served by a ring-fusion-capable engine in THIS process (self or an
+    in-process peer), else None. Shared by the fused-ring dispatch and the
+    prompt-token side-channel gating."""
     try:
       partitions = self.partitioning_strategy.partition(self.topology)
     except Exception:
@@ -603,21 +653,17 @@ class Node:
       if eng is None or not getattr(eng, "supports_ring_fusion", False):
         return None
       chain.append((eng, self.get_current_shard(base_shard, i)))
-
-    async def gen(rid, _shard, prev_token, num_tokens, temp, top_k, top_p=0.0, next_size=None):
-      return await ring(rid, chain, prev_token, num_tokens, temp=temp, top_k=top_k,
-                        top_p=top_p, next_size=next_size)
-
-    return gen
+    return chain
 
   async def _fused_decode_loop(self, base_shard: Shard, shard: Shard, request_id: str,
                                buffered: List[int], inference_state: Optional[dict], gen,
-                               allow_speculation: bool = True) -> None:
+                               allow_speculation: bool = True, ring_verify=None) -> None:
     """Chunked decode until EOS/cap; EOS/max checks happen between chunks and
     surplus tokens after EOS inside a chunk are discarded.
-    allow_speculation=False for the fused-RING path: verify_draft is a
-    single-shard executable and must not interleave with multi-segment
-    lockstep state."""
+    allow_speculation=False + ring_verify for the fused-RING path: the
+    single-shard verify_draft executable must not interleave with
+    multi-segment lockstep state, but the ring has its own composite
+    verifier (engine.verify_draft_ring) with the same contract."""
     # Speculation verifies drafts by plain greedy argmax — requests whose
     # extras RESHAPE the distribution (penalties/bias change even greedy
     # argmax) must not speculate or the verified tokens would ignore them;
@@ -627,9 +673,16 @@ class Node:
     # seed-only requests keep the speculation fast path.
     reshaping = set(self._request_sampling.get(request_id, ())) & {
       "presence_penalty", "frequency_penalty", "logit_bias", "logprobs"}
-    verify = (getattr(self.inference_engine, "verify_draft", None)
-              if (allow_speculation and self.speculate_tokens > 0
-                  and self._temp_for(request_id) == 0 and not reshaping) else None)
+    spec_wanted = (self.speculate_tokens > 0 and self._temp_for(request_id) == 0
+                   and not reshaping)
+    if not spec_wanted:
+      verify = None
+    elif ring_verify is not None:
+      verify = ring_verify
+    elif allow_speculation:
+      verify = getattr(self.inference_engine, "verify_draft", None)
+    else:
+      verify = None
     # Persistent draft context: prompt + generated tokens, appended as they
     # arrive (never rebuilt — a 32k prompt must not be re-copied per round).
     spec_context = (list(self._request_prompt_tokens.get(request_id, ())) + list(buffered)
