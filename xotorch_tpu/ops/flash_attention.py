@@ -42,6 +42,15 @@ def _softcap(s, cap: float):
   return s
 
 
+
+
+def _mxu_operand(x):
+  """MXU-ready operand dtype: bf16/f32 stay native (full-rate MXU, f32
+  accumulate via preferred_element_type); float16 — which Mosaic's matmul
+  lowering does not reliably support on all TPU generations — upcasts."""
+  return x.astype(jnp.float32) if x.dtype == jnp.float16 else x
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q, block_k,
                   scale, softcap):
   """Grid = (B, Hq, nQ, nK); nK innermost so the scratch accumulators carry
@@ -62,13 +71,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q,
 
   @pl.when(j * block_k <= q_last)
   def _compute():
-    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
-    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    # NATIVE-dtype operands with f32 accumulation: casting bf16 q/k/v up to
+    # f32 before the dot halves the MXU rate for zero accuracy gain (the
+    # accumulator is f32 either way) — on prefill, attention FLOPs are the
+    # MFU bill. Stats (max/exp/l/acc) stay f32.
+    q = _mxu_operand(q_ref[0, 0])  # [block_q, D]
+    k = _mxu_operand(k_ref[0, 0])  # [block_k, D]
+    v = _mxu_operand(v_ref[0, 0])  # [block_k, D]
 
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [block_q, block_k]
+    ) * scale  # [block_q, block_k] f32
     s = _softcap(s, softcap)
 
     # Elementwise causal mask (only the diagonal blocks actually cut).
@@ -83,8 +96,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q,
     p = jnp.exp(s - m_new)
 
     l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    # P in v's dtype for the second MXU dot (standard flash practice:
+    # probabilities are in [0, 1] where bf16 is dense; accumulate is f32).
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+      p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -122,9 +137,9 @@ def _flash_kernel_windowed(win_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, 
 
   @pl.when(block_visible)
   def _compute():
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    q = _mxu_operand(q_ref[0, 0])  # full-rate MXU, f32 accumulate (see above)
+    k = _mxu_operand(k_ref[0, 0])
+    v = _mxu_operand(v_ref[0, 0])
 
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -145,7 +160,7 @@ def _flash_kernel_windowed(win_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, 
 
     l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+      p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from xotorch_tpu.ops.flash_attention import _softcap
+from xotorch_tpu.ops.flash_attention import _mxu_operand, _softcap
 
 NEG_INF = -1e30
 
@@ -65,9 +65,12 @@ def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
 
   @pl.when(j * block_k <= q_last)
   def _compute():
-    q = q_ref[0, 0].astype(jnp.float32)  # [block_q * groups, D]
-    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    # Native-dtype MXU operands, f32 accumulate (pre-cast to f32 would
+    # halve the MXU rate — this kernel also serves pos>0 chunked-prefill
+    # segments, which are compute-bound).
+    q = _mxu_operand(q_ref[0, 0])  # [block_q * groups, D]
+    k = _mxu_operand(k_ref[0, 0])  # [block_k, D]
+    v = _mxu_operand(v_ref[0, 0])  # [block_k, D]
 
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -87,7 +90,7 @@ def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
 
     l_ref[:] = jnp.broadcast_to(alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+      p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
@@ -131,9 +134,12 @@ def _cached_kernel_windowed(start_ref, win_ref, q_ref, k_ref, v_ref, o_ref, acc_
 
   @pl.when(block_visible)
   def _compute():
-    q = q_ref[0, 0].astype(jnp.float32)  # [block_q * groups, D]
-    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    # Native-dtype MXU operands, f32 accumulate (pre-cast to f32 would
+    # halve the MXU rate — this kernel also serves pos>0 chunked-prefill
+    # segments, which are compute-bound).
+    q = _mxu_operand(q_ref[0, 0])  # [block_q * groups, D]
+    k = _mxu_operand(k_ref[0, 0])  # [block_k, D]
+    v = _mxu_operand(v_ref[0, 0])  # [block_k, D]
 
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -154,7 +160,7 @@ def _cached_kernel_windowed(start_ref, win_ref, q_ref, k_ref, v_ref, o_ref, acc_
 
     l_ref[:] = jnp.broadcast_to(alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+      p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 

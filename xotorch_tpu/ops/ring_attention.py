@@ -31,7 +31,11 @@ def _fold_chunk(q, k, v, acc, m, l, q_pos, k_pos, scale):
   q [B,Tq,Hkv,g,D]; k,v [B,Tk,Hkv,D]; q_pos [Tq], k_pos [Tk] absolute;
   acc [B,Tq,Hkv,g,D] f32; m,l [B,Tq,Hkv,g] f32.
   """
-  s = jnp.einsum("btkgd,bskd->btkgs", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+  # Native-dtype operands, f32 accumulate: a pre-cast to f32 would halve
+  # the MXU rate on bf16 inputs (same rule as the flash kernels).
+  from xotorch_tpu.ops.flash_attention import _mxu_operand
+  q, k, v = _mxu_operand(q), _mxu_operand(k), _mxu_operand(v)
+  s = jnp.einsum("btkgd,bskd->btkgs", q, k, preferred_element_type=jnp.float32) * scale
   visible = (k_pos[None, :] <= q_pos[:, None])[None, :, None, None, :]  # [1,Tq,1,1,Tk]
   s = jnp.where(visible, s, NEG_INF)
 
@@ -44,7 +48,8 @@ def _fold_chunk(q, k, v, acc, m, l, q_pos, k_pos, scale):
   p = jnp.where(visible, p, 0.0)
   alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - shift))
   l_new = alpha * l + jnp.sum(p, axis=-1)
-  acc_new = acc * alpha[..., None] + jnp.einsum("btkgs,bskd->btkgd", p, v.astype(jnp.float32))
+  acc_new = acc * alpha[..., None] + jnp.einsum(
+    "btkgs,bskd->btkgd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
   return acc_new, m_new, l_new
 
 
