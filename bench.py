@@ -319,6 +319,9 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     # baseline attention reads the FULL allocated cache per segment and
     # materialises [T, S] scores, which is what capped round 3's long
     # prefill at ~7% MFU (VERDICT r3 weak #3). Off-TPU both stay baseline.
+    use_scan = ((on_tpu_now or os.getenv("XOT_SCAN_PREFILL_FORCE") == "1")
+                and not quantize and long_ctx >= 2 * seg
+                and os.getenv("XOT_SCAN_PREFILL", "1") == "1")
     if on_tpu_now and not quantize:
       fwd_seg0 = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True,
                                  use_flash=True), donate_argnums=(2,))
@@ -326,20 +329,48 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
                                  use_flash_decode=True), donate_argnums=(2,))
     else:
       fwd_seg0 = fwd_segN = fwd
+
+    def _prefill_long(lcache):
+      """The serving-shaped long prefill (engine._scan_prefill): leading
+      full segments fold into fused scan-prefill executables (one dispatch
+      per power-of-two segment group — the host-side per-segment loop paid
+      one dispatch + one H2D round-trip per segment, which on the tunneled
+      chip rivalled the compute), then the FINAL segment runs through the
+      logits executable for the next-token distribution."""
+      if not use_scan:
+        for off in range(0, long_ctx, seg):
+          x = jnp.asarray(lprompt[:, off:off + seg], jnp.int32)
+          lg, lcache = (fwd_seg0 if off == 0 else fwd_segN)(params, x, lcache, jnp.int32(off))
+        return lg, lcache
+      from xotorch_tpu.models.generate import prefill_scan, scan_groups
+      split = long_ctx - seg
+      xdev = jnp.asarray(lprompt[:, :split], jnp.int32)  # ONE H2D for the scanned part
+      for off, g in scan_groups(split // seg):
+        _, lcache = prefill_scan(params, xdev[:, off * seg:(off + g) * seg], lcache,
+                                 jnp.int32(off * seg), cfg, g)
+      lg, lcache = fwd_segN(params, jnp.asarray(lprompt[:, split:], jnp.int32),
+                            lcache, jnp.int32(split))
+      return lg, lcache
+
     # Compile warm-up OUTSIDE the timed window (the long cache shape is new,
     # so the first segment call would otherwise bill XLA compile time as
-    # prefill throughput — every other metric here excludes compiles).
+    # prefill throughput — every other metric here excludes compiles). The
+    # scan path needs a full untimed pass (each power-of-two group is its
+    # own executable); the per-segment path warms with two segments as
+    # before (seg0 + one pos>0 segment cover both executables).
     lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16)
-    lg, lcache = fwd_seg0(params, jnp.asarray(lprompt[:, :seg], jnp.int32), lcache, jnp.int32(0))
-    if long_ctx > seg:  # warm the pos>0 executable too (distinct kernel path)
-      lg, lcache = fwd_segN(params, jnp.asarray(lprompt[:, seg:2 * seg], jnp.int32), lcache, jnp.int32(seg))
+    if use_scan:
+      lg, lcache = _prefill_long(lcache)
+    else:
+      lg, lcache = fwd_seg0(params, jnp.asarray(lprompt[:, :seg], jnp.int32), lcache, jnp.int32(0))
+      if long_ctx > seg:
+        lg, lcache = fwd_segN(params, jnp.asarray(lprompt[:, seg:2 * seg], jnp.int32),
+                              lcache, jnp.int32(seg))
     np.asarray(lg[:, -1, :1])
     del lcache
     lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16)
     t0 = time.time()
-    for off in range(0, long_ctx, seg):
-      x = jnp.asarray(lprompt[:, off:off + seg], jnp.int32)
-      lg, lcache = (fwd_seg0 if off == 0 else fwd_segN)(params, x, lcache, jnp.int32(off))
+    lg, lcache = _prefill_long(lcache)
     np.asarray(lg[:, -1, :1])  # host fetch: true barrier
     long_prefill_s = time.time() - t0
     ltok = jnp.argmax(lg[:, -1:], axis=-1).astype(jnp.int32)

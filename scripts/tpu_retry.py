@@ -1,0 +1,129 @@
+"""Persistent TPU harvest loop for the flaky tunnel.
+
+The tunnel dies for hours at a stretch and the one-shot session script
+(`tpu_session.py`) aborts when it does. This loop keeps probing and, each
+time the tunnel answers, runs whichever round-4 measurements are still
+missing, highest-value first:
+
+  1. rest   — the stages the stalled main run never reached: int8 flagship,
+              fused ring2, 8-stream concurrent (16k long stage disabled so
+              the window is spent on the missing numbers, not re-measuring
+              what BENCH_TPU_r04_main.json already holds)
+  2. int4v1 / int4v2 — the Pallas int4 kernel A/B
+  3. flash sweep — prefill-MFU block-size configs
+
+A step counts as landed once its BENCH_TPU_r04_<tag>.json records
+platform == "tpu". The loop exits when everything has landed.
+
+Usage: nohup python scripts/tpu_retry.py > tpu_retry.log 2>&1 &
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PROBE_INTERVAL_S = float(os.getenv("XOT_TPU_PROBE_INTERVAL", "720"))
+
+SHORT = {
+  "BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_RING": "",
+  "BENCH_CONCURRENT": "0", "BENCH_LONG": "0",
+}
+
+# (tag, env) in priority order; tag names the snapshot file.
+STEPS: list[tuple[str, dict]] = [
+  ("rest", {"BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_LONG": "0",
+            "BENCH_QUANT": "int8", "BENCH_RING": "2", "BENCH_CONCURRENT": "8"}),
+  ("int4v1", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "1"}),
+  ("int4v2", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "2"}),
+  ("flash256x256", {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384",
+                    "BENCH_DECODE": "32", "XOT_FLASH_BLOCK_Q": "256",
+                    "XOT_FLASH_BLOCK_K": "256"}),
+  ("flash512x512", {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384",
+                    "BENCH_DECODE": "32", "XOT_FLASH_BLOCK_Q": "512",
+                    "XOT_FLASH_BLOCK_K": "512"}),
+  ("flash256x512", {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384",
+                    "BENCH_DECODE": "32", "XOT_FLASH_BLOCK_Q": "256",
+                    "XOT_FLASH_BLOCK_K": "512"}),
+]
+
+
+def log(msg: str) -> None:
+  print(f"[tpu-retry {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def landed(tag: str) -> bool:
+  p = REPO / f"BENCH_TPU_r04_{tag}.json"
+  if not p.exists():
+    return False
+  try:
+    return json.loads(p.read_text()).get("platform") == "tpu"
+  except (json.JSONDecodeError, OSError):
+    return False
+
+
+def tunnel_alive() -> bool:
+  """Cheap probe: can a fresh process see the TPU inside 150 s?"""
+  code = "import jax; ds = jax.devices(); assert ds and ds[0].platform != 'cpu', ds"
+  try:
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=150)
+    return r.returncode == 0
+  except subprocess.TimeoutExpired:
+    return False
+
+
+def run_step(tag: str, extra_env: dict) -> bool:
+  env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}}
+  log(f"step {tag}: {extra_env}")
+  t0 = time.time()
+  try:
+    proc = subprocess.run([sys.executable, str(REPO / "bench.py")], env=env,
+                          capture_output=True, text=True, timeout=5400)
+  except subprocess.TimeoutExpired:
+    log(f"step {tag}: timed out")
+    return False
+  result = None
+  for ln in reversed(proc.stdout.strip().splitlines()):
+    try:
+      result = json.loads(ln)
+      break
+    except json.JSONDecodeError:
+      continue
+  if result is None:
+    log(f"step {tag}: no result line (rc={proc.returncode})\n{proc.stderr[-1500:]}")
+    return False
+  result["session_tag"] = tag
+  result["elapsed_s"] = round(time.time() - t0, 1)
+  (REPO / f"BENCH_TPU_r04_{tag}.json").write_text(json.dumps(result, indent=2))
+  ok = result.get("platform") == "tpu"
+  log(f"step {tag}: platform={result.get('platform')} tok_s={result.get('value')} "
+      f"ring2={result.get('ring2_tok_s')} int8={result.get('int8_tok_s')} "
+      f"int4={result.get('int4_tok_s')} ({result['elapsed_s']}s)")
+  return ok
+
+
+def main() -> None:
+  while True:
+    pending = [(t, e) for t, e in STEPS if not landed(t)]
+    if not pending:
+      log("all measurements landed; done")
+      return
+    log(f"pending: {[t for t, _ in pending]}")
+    if not tunnel_alive():
+      log(f"tunnel dead; sleeping {PROBE_INTERVAL_S:.0f}s")
+      time.sleep(PROBE_INTERVAL_S)
+      continue
+    log("tunnel live")
+    for tag, env in pending:
+      if not run_step(tag, env):
+        log("step fell off TPU; back to probing")
+        break
+
+
+if __name__ == "__main__":
+  main()

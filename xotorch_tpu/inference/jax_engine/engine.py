@@ -657,6 +657,54 @@ class JAXShardInferenceEngine(InferenceEngine):
     state.last_used = time.monotonic()
     return out, true_t
 
+  def _scan_prefill(self, ctx: _ShardContext, request_id: str, input_data,
+                    chunk: int, want_hidden: bool = False):
+    """Run a long prompt's leading FULL segments through the fused
+    scan-prefill executable (models/generate.prefill_scan): the segment
+    loop runs device-side under one `lax.scan`, so the dispatch + H2D bill
+    is one per power-of-two segment GROUP (log2 of the segment count)
+    instead of one of each per segment — on a tunneled/remote device the
+    per-segment round-trips rivalled the prefill compute itself.
+
+    Returns the [B, total, H] last-layer hidden states (device array) when
+    `want_hidden` (mid-shard ring forwarding), else True for a cache-only
+    fill; None/False when the path doesn't apply (Pallas decode kernel
+    gated off, kv-quant cache, or an sp ring prefill outranks it) so the
+    caller falls back to the per-segment loop. `input_data` length must be
+    a multiple of `chunk`."""
+    import jax
+    import jax.numpy as jnp
+    total = input_data.shape[1]
+    # Below 2 segments the per-segment loop already pays a single dispatch
+    # (and keeps the in-segment flash kernel for the from-zero case).
+    if os.getenv("XOT_SCAN_PREFILL", "1") != "1" or total % chunk or total < 2 * chunk:
+      return None
+    st = ctx.states.get(request_id)
+    pos0 = st.pos if st is not None else 0
+    if not (self._pallas_kernels_ok(ctx.cfg) and self._flash_decode_on(pos0 + total)):
+      return None
+    # Sequence-parallel prefill-from-zero shards the positions over chips —
+    # it outranks the single-chip scan (mirrors _forward_segment's ring_ok).
+    if (ctx.fill_jits is not None and "ring" in ctx.fill_jits and pos0 == 0
+        and input_data.ndim == 2 and total % ctx.mesh.shape["sp"] == 0):
+      return None
+    from xotorch_tpu.models.generate import prefill_scan, scan_groups
+    state = self._prep_state(ctx, request_id, total)
+    x = self._to_device_input(input_data)
+    outs = []
+    for off, g in scan_groups(total // chunk):
+      h, state.cache = prefill_scan(
+        ctx.params, x[:, off * chunk:(off + g) * chunk], state.cache, jnp.int32(state.pos),
+        ctx.cfg, g, is_first=(x.ndim == 2), start_layer=ctx.shard.start_layer,
+        moe_routed=self._moe_routed_for(ctx))
+      if want_hidden:
+        outs.append(h)
+      state.pos += g * chunk
+    state.last_used = time.monotonic()
+    if not want_hidden:
+      return True
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
   def _infer_sync(self, ctx: _ShardContext, request_id: str, input_data,
                   keep_on_device: bool = False):
     # Long prompts prefill in fixed segments: bounds the prefill-bucket
@@ -667,8 +715,19 @@ class JAXShardInferenceEngine(InferenceEngine):
     true_t = input_data.shape[1]
     chunk = self._prefill_chunk()
     if true_t > chunk:
+      # Mid-shard ring prefill (hidden outputs, no unembedding anywhere):
+      # the fused scan path covers the leading full segments in O(log)
+      # dispatches; the tail and any fallback take the per-segment loop.
       outs = []
-      for off in range(0, true_t, chunk):
+      off0 = 0
+      if not ctx.shard.is_last_layer:
+        split = ((true_t - 1) // chunk) * chunk
+        h = self._scan_prefill(ctx, request_id, input_data[:, :split], chunk,
+                               want_hidden=True)
+        if h is not None:
+          outs.append(h if keep_on_device else np.asarray(h))
+          off0 = split
+      for off in range(off0, true_t, chunk):
         out, t = self._forward_segment(ctx, request_id, input_data[:, off:off + chunk])
         # Padded tail positions carry garbage activations — slice them off.
         outs.append(out[:, :t] if keep_on_device else np.asarray(out[:, :t]))
@@ -817,8 +876,9 @@ class JAXShardInferenceEngine(InferenceEngine):
       # All but the final segment only fill the cache — hidden-only
       # executables, outputs dropped on device, never copied to host.
       split = ((true_t - 1) // chunk) * chunk
-      for off in range(0, split, chunk):
-        self._forward_segment(ctx, request_id, input_data[:, off:off + chunk], fill=True)
+      if not self._scan_prefill(ctx, request_id, input_data[:, :split], chunk):
+        for off in range(0, split, chunk):
+          self._forward_segment(ctx, request_id, input_data[:, off:off + chunk], fill=True)
       input_data = input_data[:, split:]
 
     x, seg_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)

@@ -200,9 +200,9 @@ def flash_attention(
   """
   import os
   if block_q is None:
-    block_q = int(os.getenv("XOT_FLASH_BLOCK_Q", "128"))
+    block_q = max(1, int(os.getenv("XOT_FLASH_BLOCK_Q", "128") or 128))
   if block_k is None:
-    block_k = int(os.getenv("XOT_FLASH_BLOCK_K", "128"))
+    block_k = max(1, int(os.getenv("XOT_FLASH_BLOCK_K", "128") or 128))
   B, T, Hq, D = q.shape
   Hkv = k.shape[2]
   groups = Hq // Hkv

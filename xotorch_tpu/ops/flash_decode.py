@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -178,8 +179,8 @@ def flash_cached_attention(
   k: jnp.ndarray,  # [B, S, Hkv, D] — full static cache buffer (segment already written)
   v: jnp.ndarray,  # [B, S, Hkv, D]
   q_start: jnp.ndarray,  # [B] int32 — absolute position of q[:, 0]
-  block_q: int = 128,
-  block_k: int = 256,
+  block_q: int | None = None,  # default env XOT_FD_BLOCK_Q, else 128
+  block_k: int | None = None,  # default env XOT_FD_BLOCK_K, else 256
   interpret: bool | None = None,
   window: jnp.ndarray | None = None,  # traced scalar int32; None = global-only kernel
   softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
@@ -195,6 +196,10 @@ def flash_cached_attention(
   B, T, Hq, D = q.shape
   S, Hkv = k.shape[1], k.shape[2]
   groups = Hq // Hkv
+  if block_q is None:
+    block_q = max(1, int(os.getenv("XOT_FD_BLOCK_Q", "128") or 128))
+  if block_k is None:
+    block_k = max(1, int(os.getenv("XOT_FD_BLOCK_K", "256") or 256))
   # Halve block sizes until they divide the actual T/S: cache lengths are
   # usually powers of two, but XOT_MAX_CACHE_LEN / cfg.max_seq_len clamps can
   # produce odd sizes — degrade block size instead of crashing the hot path.
