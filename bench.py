@@ -319,10 +319,13 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     # baseline attention reads the FULL allocated cache per segment and
     # materialises [T, S] scores, which is what capped round 3's long
     # prefill at ~7% MFU (VERDICT r3 weak #3). Off-TPU both stay baseline.
+    # The scan path serves quantized weights too (the kernels only touch
+    # q/k/v after the projections, so weight quantization is orthogonal) —
+    # matching engine._scan_prefill, which gates on the cache format only.
     use_scan = ((on_tpu_now or os.getenv("XOT_SCAN_PREFILL_FORCE") == "1")
-                and not quantize and long_ctx >= 2 * seg
+                and long_ctx >= 2 * seg
                 and os.getenv("XOT_SCAN_PREFILL", "1") == "1")
-    if on_tpu_now and not quantize:
+    if on_tpu_now:
       fwd_seg0 = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True,
                                  use_flash=True), donate_argnums=(2,))
       fwd_segN = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True,
@@ -403,6 +406,7 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
       "long_prefill_s": round(long_prefill_s, 2),
       "long_prefill_tok_s": round(long_ctx / long_prefill_s, 1),
       "prefill_mfu_pct": prefill_mfu,
+      "prefill_mode": "scan" if use_scan else "segmented",
       "long_tok_s": round(produced_l / (time.time() - t0), 2),
     }
     del lcache, lg, ltok, ltoks
@@ -1052,7 +1056,7 @@ def _emit(result: dict) -> None:
             "implausible", "diagnosis", "block_until_ready_ok", "roofline_tok_s",
             "ring2_tok_s", "ring2_per_token_ms", "ring2_ttft_ms", "ring2_error",
             "ring2_pertoken_tok_s", "ring2_fused_speedup", "ring2_tokens_verified",
-            "ring2_n_tokens", "long_prefill_tok_s", "prefill_mfu_pct",
+            "ring2_n_tokens", "long_prefill_tok_s", "prefill_mfu_pct", "prefill_mode",
             "real_model_id", "real_model_tok_s", "real_model_ttft_ms",
             "real_model_n_tokens", "real_model_text", "real_model_text_plausible",
             "real_model_error",

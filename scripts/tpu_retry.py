@@ -34,21 +34,34 @@ SHORT = {
   "BENCH_CONCURRENT": "0", "BENCH_LONG": "0",
 }
 
-# (tag, env) in priority order; tag names the snapshot file.
-STEPS: list[tuple[str, dict]] = [
+LONG = {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384", "BENCH_DECODE": "32"}
+
+# (tag, env, key_metric) in priority order; tag names the snapshot file and
+# key_metric is the field that must be PRESENT for the step to count as
+# landed — platform == "tpu" alone also matches a stalled partial record
+# (BENCH_TPU_r04_main.json is exactly that: tpu + error + missing stages).
+STEPS: list[tuple[str, dict, str]] = [
+  # The stages the stalled main run never reached (VERDICT r3 #1/#2).
   ("rest", {"BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_LONG": "0",
-            "BENCH_QUANT": "int8", "BENCH_RING": "2", "BENCH_CONCURRENT": "8"}),
-  ("int4v1", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "1"}),
-  ("int4v2", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "2"}),
-  ("flash256x256", {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384",
-                    "BENCH_DECODE": "32", "XOT_FLASH_BLOCK_Q": "256",
-                    "XOT_FLASH_BLOCK_K": "256"}),
-  ("flash512x512", {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384",
-                    "BENCH_DECODE": "32", "XOT_FLASH_BLOCK_Q": "512",
-                    "XOT_FLASH_BLOCK_K": "512"}),
-  ("flash256x512", {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384",
-                    "BENCH_DECODE": "32", "XOT_FLASH_BLOCK_Q": "256",
-                    "XOT_FLASH_BLOCK_K": "512"}),
+            "BENCH_QUANT": "int8", "BENCH_RING": "2", "BENCH_CONCURRENT": "8"},
+   "ring2_tok_s"),
+  # Fused scan-prefill headline (VERDICT r3 #5): prefill_mfu_pct with the
+  # whole segment loop in one executable, vs the per-segment path.
+  ("scan16k", LONG, "prefill_mfu_pct"),
+  ("scanoff16k", {**LONG, "XOT_SCAN_PREFILL": "0"}, "prefill_mfu_pct"),
+  ("int4v1", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "1"}, "int4_tok_s"),
+  ("int4v2", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "2"}, "int4_tok_s"),
+  ("int4v3", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "3"}, "int4_tok_s"),
+  # Cached-kernel block sweep: with scan-prefill the long stage runs on
+  # flash_decode (XOT_FD_BLOCK_*), not the in-segment flash kernel.
+  ("fd256x256", {**LONG, "XOT_FD_BLOCK_Q": "256", "XOT_FD_BLOCK_K": "256"},
+   "prefill_mfu_pct"),
+  ("fd256x512", {**LONG, "XOT_FD_BLOCK_Q": "256", "XOT_FD_BLOCK_K": "512"},
+   "prefill_mfu_pct"),
+  ("fd512x512", {**LONG, "XOT_FD_BLOCK_Q": "512", "XOT_FD_BLOCK_K": "512"},
+   "prefill_mfu_pct"),
+  ("fd128x512", {**LONG, "XOT_FD_BLOCK_Q": "128", "XOT_FD_BLOCK_K": "512"},
+   "prefill_mfu_pct"),
 ]
 
 
@@ -56,14 +69,15 @@ def log(msg: str) -> None:
   print(f"[tpu-retry {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
-def landed(tag: str) -> bool:
+def landed(tag: str, key_metric: str) -> bool:
   p = REPO / f"BENCH_TPU_r04_{tag}.json"
   if not p.exists():
     return False
   try:
-    return json.loads(p.read_text()).get("platform") == "tpu"
+    rec = json.loads(p.read_text())
   except (json.JSONDecodeError, OSError):
     return False
+  return rec.get("platform") == "tpu" and rec.get(key_metric) is not None
 
 
 def tunnel_alive() -> bool:
@@ -109,17 +123,17 @@ def run_step(tag: str, extra_env: dict) -> bool:
 
 def main() -> None:
   while True:
-    pending = [(t, e) for t, e in STEPS if not landed(t)]
+    pending = [(t, e, m) for t, e, m in STEPS if not landed(t, m)]
     if not pending:
       log("all measurements landed; done")
       return
-    log(f"pending: {[t for t, _ in pending]}")
+    log(f"pending: {[t for t, _, _ in pending]}")
     if not tunnel_alive():
       log(f"tunnel dead; sleeping {PROBE_INTERVAL_S:.0f}s")
       time.sleep(PROBE_INTERVAL_S)
       continue
     log("tunnel live")
-    for tag, env in pending:
+    for tag, env, _ in pending:
       if not run_step(tag, env):
         log("step fell off TPU; back to probing")
         break

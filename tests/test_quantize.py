@@ -317,9 +317,11 @@ async def test_engine_quantized_full_train_rejected(tmp_path):
     await eng.train_example("t", shard, x, x, np.array([8]))
 
 
-def test_int4_pallas_matvec_matches_dequant():
-  """The decode-path Pallas kernel (in-register nibble unpack,
-  ops/int4_matmul.py) must equal the full dequantize-then-matmul oracle
+@pytest.mark.parametrize("variant", [1, 2, 3])
+def test_int4_pallas_matvec_matches_dequant(variant):
+  """Every decode-path Pallas kernel variant (in-register nibble unpack,
+  ops/int4_matmul.py: v1 scale-into-operand, v2 scale-after-dot, v3
+  int8-shift unpack) must equal the full dequantize-then-matmul oracle
   for 1..8 rows and non-trivial group counts."""
   from xotorch_tpu.models.quantize import dequantize_tensor_grouped, quantize_tensor_grouped
   from xotorch_tpu.ops.int4_matmul import int4_grouped_matmul
@@ -331,6 +333,6 @@ def test_int4_pallas_matvec_matches_dequant():
     for rows in (1, 3, 8):
       h = jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(6), rows),
                             (rows, 256), jnp.float32)
-      got = int4_grouped_matmul(h, q[0], gscale[0], block_out=128)
+      got = int4_grouped_matmul(h, q[0], gscale[0], block_out=128, variant=variant)
       np.testing.assert_allclose(np.asarray(got), np.asarray(h @ ref_w),
                                  atol=1e-4, rtol=1e-4)

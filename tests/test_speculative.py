@@ -133,3 +133,100 @@ async def test_node_speculative_stream_identical(tiny_model_dir, monkeypatch):
   got, eng = await generate(6)
   assert got == want, f"speculative stream diverged: {got} != {want}"
   assert eng._spec_proposed > 0, "speculation never fired on a repetitive prompt"
+
+
+# --------------------------------------------------- draft-MODEL speculation
+
+
+def _register_card(monkeypatch, model_id, layers):
+  """Register a local-checkpoint card so registry.build_full_shard (the
+  engine's draft-model resolution path) can address the test model."""
+  from xotorch_tpu.models import registry
+  monkeypatch.setitem(registry.model_cards, model_id,
+                      {"layers": layers, "repo": {"JAXShardInferenceEngine": "local"}})
+
+
+async def test_draft_tokens_match_sequential_greedy(tiny_model_dir, monkeypatch):
+  """engine.draft_tokens with the TARGET model as its own draft must produce
+  exactly the sequential greedy continuation (the perfect-drafter identity),
+  including across incremental calls (only the unseen suffix is ingested)."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  _register_card(monkeypatch, "m", n)
+  monkeypatch.setenv("XOT_DRAFT_MODEL", "m")
+  shard = Shard("m", 0, n - 1, n)
+  ctx_tokens = [1, 5, 9, 200, 17, 3, 42]
+
+  ref_eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  tok, _ = await ref_eng.infer_sample_tensor("ref", shard, np.asarray([ctx_tokens]), temp=0.0)
+  ref = [int(tok)]
+  for _ in range(5):
+    tok, _ = await ref_eng.infer_sample_tensor("ref", shard, np.asarray([[ref[-1]]]), temp=0.0)
+    ref.append(int(tok))
+
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  draft = await eng.draft_tokens("r", ctx_tokens, 4)
+  assert draft == ref[:4], f"{draft} != {ref[:4]}"
+
+  # Incremental round: two "accepted" tokens extend the context; the draft
+  # cache ingests only the suffix and keeps matching the reference stream.
+  draft2 = await eng.draft_tokens("r", ctx_tokens + ref[:2], 4)
+  assert draft2 == ref[2:6], f"{draft2} != {ref[2:6]}"
+
+  # Cleanup releases the draft state (keyed under request#draft).
+  await eng.clear_request("r")
+  for ctx in eng._contexts.values():
+    assert "r#draft" not in ctx.states and "r" not in ctx.states
+
+
+async def test_draft_tokens_disabled_paths(tiny_model_dir, monkeypatch):
+  """Unknown draft model ids and k<2 must return [] (callers fall back to
+  plain decode), never raise."""
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  monkeypatch.delenv("XOT_DRAFT_MODEL", raising=False)
+  assert await eng.draft_tokens("r", [1, 2, 3], 4) == []
+  monkeypatch.setenv("XOT_DRAFT_MODEL", "no-such-model")
+  assert await eng.draft_tokens("r", [1, 2, 3], 4) == []
+  monkeypatch.setenv("XOT_DRAFT_MODEL", "m")
+  assert await eng.draft_tokens("r", [1, 2, 3], 1) == []
+
+
+async def test_node_draft_model_stream_identical(tiny_model_dir, monkeypatch):
+  """End-to-end with a draft MODEL (the target itself — every draft
+  accepted): the greedy stream is identical to no-speculation, and the
+  verify accounting shows model drafts were accepted."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+
+  async def generate(draft_model):
+    if draft_model:
+      _register_card(monkeypatch, "m", n)
+      monkeypatch.setenv("XOT_DRAFT_MODEL", draft_model)
+    else:
+      monkeypatch.delenv("XOT_DRAFT_MODEL", raising=False)
+    monkeypatch.delenv("XOT_SPECULATE", raising=False)
+    eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+    node = Node(
+      f"draft-{bool(draft_model)}", _NullServer(), eng, _NoDiscovery(), None,
+      RingMemoryWeightedPartitioningStrategy(),
+      max_generate_tokens=20, default_sample_temp=0.0, decode_chunk_size=4,
+    )
+    node.device_capabilities = DeviceCapabilities("t", "c", 1024, DeviceFlops(1, 2, 4))
+    node.topology.update_node(node.id, node.device_capabilities)
+    done = asyncio.Event()
+    out = {}
+
+    def on_token(request_id, tokens, is_finished):
+      out["tokens"] = list(tokens)
+      if is_finished:
+        done.set()
+
+    node.on_token.register("t").on_next(on_token)
+    # NON-repetitive prompt: prompt-lookup would never fire here — any
+    # speculation wins must come from the draft model.
+    await node.process_prompt(Shard("m", 0, n - 1, n), "one two three four five", "r")
+    await asyncio.wait_for(done.wait(), timeout=60)
+    return out["tokens"], eng
+
+  want, _ = await generate("")
+  got, eng = await generate("m")
+  assert got == want, f"draft-model stream diverged: {got} != {want}"
+  assert eng._spec_accepted > 0, "no model drafts were accepted"

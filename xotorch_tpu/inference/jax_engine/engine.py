@@ -984,6 +984,104 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._spec_accepted += n_acc
     return accepted
 
+  # ----------------------------------------------- draft-model speculation
+
+  @staticmethod
+  def _draft_rid(request_id: str) -> str:
+    """Draft-model cache states live in the DRAFT model's context under a
+    derived key: sharing the raw request_id would collide with the target
+    request's engine-global speculation records (_spec_next) — _prep_state
+    on the draft state would pop and mis-apply the target's in-flight
+    speculative-chunk rollback."""
+    return request_id + "#draft"
+
+  async def draft_tokens(self, request_id: str, context_tokens, k: int) -> list:
+    """Model-based speculative drafting (XOT_DRAFT_MODEL): greedy-generate
+    `k` candidate tokens from a small resident draft model, to be verified
+    by the target model's verify_draft / verify_draft_ring in ONE forward.
+
+    Where prompt-lookup drafting (orchestration/node._lookup_draft) only
+    fires when the text repeats an earlier n-gram, a draft model proposes on
+    EVERY round: decode is weight-HBM-bound, so a ~10x smaller draft's k
+    steps + one target verify forward stream far fewer weight bytes per
+    accepted token than k target steps. The reference has no speculation of
+    any kind (its decode loop is strictly per-token, node.py:109-147).
+
+    `context_tokens` is the full accepted sequence (prompt + generated).
+    The draft keeps its own per-request KV cache in the draft model's
+    context; only the yet-unseen suffix is fed each round (state.pos IS the
+    seen count), and rejected draft positions roll back for free exactly
+    like verify_draft — slots past the committed pos are invisible and get
+    overwritten. The draft model must share the target's tokenizer (the
+    standard speculative-decoding contract; e.g. llama-3.2-1b drafting for
+    llama-3.1-70b). Returns [] when drafting is off, capacity is exhausted,
+    or the draft model cannot load — callers fall back to plain decode."""
+    mid = os.getenv("XOT_DRAFT_MODEL", "")
+    if not mid or k < 2:
+      return []
+    from xotorch_tpu.models.registry import build_full_shard
+    shard = build_full_shard(mid, self.__class__.__name__)
+    if shard is None:
+      return []
+    try:
+      ctx = await self._ensure_ctx(shard)
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"draft model {mid} failed to load, disabling drafting: {e!r}")
+      os.environ["XOT_DRAFT_MODEL"] = ""
+      return []
+    return await self._run(self._draft_sync, ctx, self._draft_rid(request_id),
+                           list(context_tokens), k)
+
+  def _draft_sync(self, ctx: _ShardContext, rid: str, context: list, k: int) -> list:
+    import jax
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import decode_chunk
+    st = ctx.states.get(rid)
+    seen = st.pos if st is not None else 0
+    suffix = context[seen:]
+    if not suffix:
+      # The draft state is AHEAD of the accepted sequence (only possible
+      # after an LRU resurrection mismatch) — resync from scratch.
+      ctx.states.pop(rid, None)
+      seen, suffix = 0, list(context)
+    try:
+      # The whole body guards CacheExhausted, not just this first check: the
+      # fill segments below re-enter _prep_state with PADDED buckets, which
+      # can exhaust where the unpadded total fits (verify_draft's padded
+      # guard exists for the same reason). Escaping here would let the
+      # node's decode loop finish the TARGET request as length-capped
+      # because the DRAFT model's cache filled. A partial ingest before the
+      # raise is harmless — state.pos records exactly what landed.
+      state = self._prep_state(ctx, rid, len(suffix) + k)
+      # Ingest accepted-but-unseen tokens (all but the last) as cache fill:
+      # scan-prefill for the leading full segments, per-segment for the tail.
+      fill = np.asarray([suffix[:-1]], dtype=np.int64)
+      chunk = self._prefill_chunk()
+      done = 0
+      n_fill = fill.shape[1]
+      if n_fill:
+        split = (n_fill // chunk) * chunk
+        if split and self._scan_prefill(ctx, rid, fill[:, :split], chunk):
+          done = split
+        for off in range(done, n_fill, chunk):
+          self._forward_segment(ctx, rid, fill[:, off:off + chunk], fill=True)
+      # Fused greedy draft: ONE dispatch scans k forward+argmax steps.
+      pos = state.pos
+      use_fd = self._pallas_kernels_ok(ctx.cfg) and self._flash_decode_on(state.cache["k"].shape[2])
+      toks, state.cache = decode_chunk(
+        ctx.params, jnp.asarray([[suffix[-1]]], jnp.int32), state.cache, jnp.int32(pos),
+        jax.random.PRNGKey(0), ctx.cfg, k, 0.0, 0,
+        use_flash_decode=use_fd, moe_routed=self._moe_routed_for(ctx))
+    except CacheExhausted:
+      return []
+    draft = [int(t) for t in np.asarray(toks)[0]]
+    # Commit ONLY the real token's slot: the k drafted slots are scratch —
+    # the next round's fill overwrites whatever verification rejected.
+    state.pos = pos + 1
+    state.last_used = time.monotonic()
+    return draft
+
   # ----------------------------------------------------------- prefix cache
 
   def _prefix_cache_max(self) -> int:
@@ -2397,5 +2495,6 @@ class JAXShardInferenceEngine(InferenceEngine):
         # speculative batch can never resolve — roll the others back.
         self._discard_batch_spec_for(ctx, request_id)
         ctx.states.pop(request_id, None)
+        ctx.states.pop(self._draft_rid(request_id), None)  # draft-model KV
 
     await self._run(_clear, oom_as_cache_exhausted=False)

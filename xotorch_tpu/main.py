@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                       help="expert-parallel width for MoE models: expert weights distribute "
                            "over this many local chips' HBM, each computing its resident "
                            "experts (composes with --serve-tp; must divide the expert count)")
+  parser.add_argument("--draft-model", type=str, default=None,
+                      help="model id to greedy-draft speculative tokens with (must share the "
+                           "target's tokenizer, e.g. llama-3.2-1b for llama-3.1-70b); the "
+                           "target verifies each draft in one forward. Implies speculation "
+                           "on (depth XOT_SPECULATE, default 8)")
   return parser
 
 
@@ -107,6 +112,8 @@ def build_node(args) -> tuple:
     os.environ["XOT_QUANTIZE"] = args.quantize
   if getattr(args, "kv_quantize", None):
     os.environ["XOT_KV_QUANT"] = args.kv_quantize
+  if getattr(args, "draft_model", None):
+    os.environ["XOT_DRAFT_MODEL"] = args.draft_model
   if getattr(args, "serve_tp", None) is not None:
     os.environ["XOT_SERVE_TP"] = str(args.serve_tp)
   if getattr(args, "serve_sp", None) is not None:

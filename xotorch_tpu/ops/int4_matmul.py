@@ -90,6 +90,38 @@ def _int4_matvec_kernel_v2(he_ref, ho_ref, w_ref, gs_ref, o_ref):
   o_ref[...] = (part * scale).sum(axis=0).astype(o_ref.dtype)
 
 
+def _int4_matvec_kernel_v3(he_ref, ho_ref, w_ref, gs_ref, o_ref):
+  """int8-shift unpack + scale-after-dot: the v1/v2 unpack chain runs ~8
+  elementwise VPU passes over every packed element (i32 convert, mask,
+  shift, two-op sign extension each nibble, f32 converts, scales) — and the
+  VPU, not HBM, is what capped int4 decode at 26% of its roofline in round
+  3. Here the uint8 tile BITCASTS to int8 (modular astype) and the nibbles
+  sign-extend in pure int8 shift arithmetic:
+
+      lo = (p << 4) >> 4      (arithmetic shift sign-extends for free)
+      hi =  p >> 4
+
+  — three integer ops per packed element instead of seven, before the same
+  two f32 converts and the v2 batched-per-group MXU dot with the [G, out]
+  scales applied to the [G, rows, out] partials. Selected via XOT_INT4_V=3."""
+  packed8 = w_ref[...].astype(jnp.int8)  # modular: a bitcast of the uint8 tile
+  lo_f = ((packed8 << 4) >> 4).astype(jnp.float32)
+  hi_f = (packed8 >> 4).astype(jnp.float32)
+  G, gs_half, block_out = packed8.shape
+  rows = he_ref.shape[0]
+
+  he = he_ref[...].astype(jnp.float32).reshape(rows, G, gs_half).transpose(1, 0, 2)
+  ho = ho_ref[...].astype(jnp.float32).reshape(rows, G, gs_half).transpose(1, 0, 2)
+  dims = (((2,), (1,)), ((0,), (0,)))
+  part = jax.lax.dot_general(he, lo_f, dims, preferred_element_type=jnp.float32)
+  part = part + jax.lax.dot_general(ho, hi_f, dims, preferred_element_type=jnp.float32)
+  scale = gs_ref[...].astype(jnp.float32)  # [G, 1, block_out] broadcasts over rows
+  o_ref[...] = (part * scale).sum(axis=0).astype(o_ref.dtype)
+
+
+_KERNELS = {1: _int4_matvec_kernel, 2: _int4_matvec_kernel_v2, 3: _int4_matvec_kernel_v3}
+
+
 def int4_grouped_matmul(
   h: jnp.ndarray,  # [rows, in] (rows small — decode)
   w_packed: jnp.ndarray,  # [G, gs // 2, out] uint8 (models/quantize.pack_int4)
@@ -147,7 +179,7 @@ def _int4_grouped_matmul_impl(
   gs3 = gscale.reshape(G, 1, d_out)
 
   out = pl.pallas_call(
-    _int4_matvec_kernel_v2 if variant == 2 else _int4_matvec_kernel,
+    _KERNELS.get(variant, _int4_matvec_kernel),
     grid=(d_out // block_out,),
     in_specs=[
       pl.BlockSpec((rows, G * gs_half), lambda j: (0, 0)),

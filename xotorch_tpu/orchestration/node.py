@@ -192,7 +192,12 @@ class Node:
     # finish_request_state so a still-running loop (possibly on a REMOTE
     # sampler peer, marked via the finished broadcast) reliably observes it.
     self._cancelled: "OrderedDict[str, None]" = OrderedDict()
-    self.speculate_tokens = int(os.getenv("XOT_SPECULATE", "0"))
+    # Draft-MODEL speculation (XOT_DRAFT_MODEL): a small resident model
+    # proposes every round (engine.draft_tokens) where prompt-lookup only
+    # fires on n-gram repeats. Setting a draft model implies speculation on
+    # (default 8 draft tokens; XOT_SPECULATE still overrides the depth).
+    self.draft_model = os.getenv("XOT_DRAFT_MODEL", "")
+    self.speculate_tokens = int(os.getenv("XOT_SPECULATE", "8" if self.draft_model else "0"))
     # Strong refs to detached tasks (hops, fused loops, broadcasts): the
     # event loop holds tasks only weakly — a GC'd generation-driving task
     # would silently stall its request with no error.
@@ -700,11 +705,22 @@ class Node:
         limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
         remaining = max(1, limit - len(buffered))
         if verify is not None:
-          # Prompt-lookup speculation (greedy only): draft the continuation
-          # of the last n-gram's previous occurrence in prompt+output; ONE
-          # verify forward yields up to draft+1 tokens, each exactly what
-          # sequential greedy decode would produce (engine.verify_draft).
-          draft = _lookup_draft(spec_context, min(self.speculate_tokens, remaining))
+          # Speculation drafting (greedy only): a draft MODEL when
+          # configured (engine.draft_tokens — proposes every round), else
+          # prompt-lookup (the continuation of the last n-gram's previous
+          # occurrence in prompt+output — model-free, repeat-heavy text
+          # only). Either way ONE verify forward yields up to draft+1
+          # tokens, each exactly what sequential greedy decode would
+          # produce (engine.verify_draft).
+          k = min(self.speculate_tokens, remaining)
+          drafter = (getattr(self.inference_engine, "draft_tokens", None)
+                     if self.draft_model else None)
+          draft = list(await drafter(request_id, spec_context, k)) if drafter else []
+          if not draft:
+            # Prompt-lookup stays the fallback: the draft model may be
+            # unavailable (failed load self-disables it engine-side) or out
+            # of cache capacity — n-gram speculation still applies.
+            draft = _lookup_draft(spec_context, k)
           if len(draft) >= 2:
             accepted = await verify(request_id, shard, buffered[-1], draft)
             if accepted:
