@@ -302,14 +302,21 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   )
 
   # --- long-context decode (auto on TPU; BENCH_LONG=0 disables, =N sets
-  # the depth). Prefill runs in 2048-token chunked segments (the serving
-  # path's design — no [T, S] score blowup), then decode at depth measures
-  # the resident-cache read cost the short config can't see.
+  # the depth). Prefill runs in chunked segments (the serving path's design
+  # — no [T, S] score blowup; 2048 tokens by default, BENCH_LONG_SEG
+  # overrides), then decode at depth measures the resident-cache read cost
+  # the short config can't see.
   on_tpu_now = jax.devices()[0].platform == "tpu"
   long_ctx = int(os.getenv("BENCH_LONG", "16384" if on_tpu_now else "0") or 0) if long_stage else 0
   long_result = {}
   if long_ctx >= 2048:
-    seg = 2048
+    # Segment size: 2048 keeps r3 comparability; BENCH_LONG_SEG=4096 matches
+    # the engine's serving default (XOT_PREFILL_CHUNK) — fewer, larger
+    # dispatches with better MXU tiling per segment. Validated: rounded to
+    # a multiple of 256 (the flash kernel requires T % block == 0) and
+    # clamped to the depth (a seg > long_ctx would zero the whole stage).
+    seg = max(256, int(os.getenv("BENCH_LONG_SEG", "2048") or 2048) // 256 * 256)
+    seg = min(seg, long_ctx // 256 * 256)
     long_ctx -= long_ctx % seg  # whole segments: ONE executable serves all
     cache_shape_len = long_ctx + 4 * chunk + 64  # covers warm-up + all timed chunks
     lprompt = np.random.randint(0, cfg.vocab_size, (1, long_ctx))

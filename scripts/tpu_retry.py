@@ -62,6 +62,9 @@ STEPS: list[tuple[str, dict, str]] = [
    "prefill_mfu_pct"),
   ("fd128x512", {**LONG, "XOT_FD_BLOCK_Q": "128", "XOT_FD_BLOCK_K": "512"},
    "prefill_mfu_pct"),
+  # Serving-sized segments (engine XOT_PREFILL_CHUNK default): fewer,
+  # larger dispatches per 16k prefill than the r3-comparable 2048.
+  ("seg4096", {**LONG, "BENCH_LONG_SEG": "4096"}, "prefill_mfu_pct"),
 ]
 
 
