@@ -6,5 +6,13 @@ from xotorch_tpu.parallel.mesh import (
   shard_params,
   spec_for_param,
 )
+from xotorch_tpu.parallel.zero import (
+  moment_bytes_per_device,
+  zero1_constraint,
+  zero1_shard_opt_state,
+)
 
-__all__ = ["make_mesh", "shard_params", "shard_batch", "shard_cache", "param_specs_like", "spec_for_param"]
+__all__ = [
+  "make_mesh", "shard_params", "shard_batch", "shard_cache", "param_specs_like",
+  "spec_for_param", "zero1_shard_opt_state", "zero1_constraint", "moment_bytes_per_device",
+]

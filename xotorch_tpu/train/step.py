@@ -90,13 +90,18 @@ def make_train_step(
   optimizer: optax.GradientTransformation,
   loss_fn: Optional[Callable] = None,
   ring_mesh=None,
+  opt_sharding_fn: Optional[Callable] = None,
 ) -> Callable:
   """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss).
 
   `opt_state` must be built over trainable_subtree(params) — identical to
   `params` for float models; for an int8-quantized base it is the float
   leaves only (adapters/norms/scales), so the optimizer neither stores state
-  for nor rewrites the frozen base."""
+  for nor rewrites the frozen base.
+
+  `opt_sharding_fn` (ZeRO-1, parallel/zero.zero1_constraint): applied to the
+  updated optimizer state INSIDE the jit so the moments stay dp-sharded at
+  rest — XLA then derives the reduce-scatter/all-gather placement on ICI."""
   loss_fn = loss_fn or partial(full_model_loss, cfg=cfg, ring_mesh=ring_mesh)
 
   @jax.jit
@@ -111,6 +116,8 @@ def make_train_step(
     fl, nf = split_float(params)
     loss, grads = jax.value_and_grad(lambda f: loss_fn(merge_trees(f, nf), batch))(fl)
     updates, opt_state = optimizer.update(grads, opt_state, fl)
+    if opt_sharding_fn is not None:
+      opt_state = opt_sharding_fn(opt_state)
     return merge_trees(optax.apply_updates(fl, updates), nf), opt_state, loss
 
   return train_step
