@@ -318,6 +318,11 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     seg = max(256, int(os.getenv("BENCH_LONG_SEG", "2048") or 2048) // 256 * 256)
     seg = min(seg, long_ctx // 256 * 256)
     long_ctx -= long_ctx % seg  # whole segments: ONE executable serves all
+    # BENCH_KV_QUANT=int8: the long stage runs on an int8 KV cache — decode
+    # at depth is cache-bandwidth-bound, so the halved bytes/token (plus the
+    # cached kernel's in-tile dequant, ops/flash_decode._load_kv) is the
+    # measurable win. Serving-shaped: the kernel path serves int8 caches.
+    kvq = os.getenv("BENCH_KV_QUANT", "") == "int8"
     cache_shape_len = long_ctx + 4 * chunk + 64  # covers warm-up + all timed chunks
     lprompt = np.random.randint(0, cfg.vocab_size, (1, long_ctx))
     # Engine-shaped executables (engine._segment_setup's selection): the
@@ -368,7 +373,7 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     # scan path needs a full untimed pass (each power-of-two group is its
     # own executable); the per-segment path warms with two segments as
     # before (seg0 + one pos>0 segment cover both executables).
-    lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16)
+    lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16, kv_quant=kvq)
     if use_scan:
       lg, lcache = _prefill_long(lcache)
     else:
@@ -378,13 +383,15 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
                               lcache, jnp.int32(seg))
     np.asarray(lg[:, -1, :1])
     del lcache
-    lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16)
+    lcache = init_kv_cache(cfg, n, 1, cache_shape_len, jnp.bfloat16, kv_quant=kvq)
     t0 = time.time()
     lg, lcache = _prefill_long(lcache)
     np.asarray(lg[:, -1, :1])  # host fetch: true barrier
     long_prefill_s = time.time() - t0
     ltok = jnp.argmax(lg[:, -1:], axis=-1).astype(jnp.int32)
-    ltoks, lcache = decode_chunk(params, ltok, lcache, jnp.int32(long_ctx), key, cfg, chunk, 0.0, 0)
+    use_fd_l = kvq and on_tpu_now  # int8 cache decode rides the Pallas cached kernel
+    ltoks, lcache = decode_chunk(params, ltok, lcache, jnp.int32(long_ctx), key, cfg, chunk, 0.0, 0,
+                                 use_flash_decode=use_fd_l)
     np.asarray(ltoks)  # decode compile + first chunk
     t0 = time.time()
     produced_l = 0
@@ -394,7 +401,7 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     while produced_l < max(32, 3 * chunk):
       ltok = ltoks[:, -1:].astype(jnp.int32)
       nxt_l, lcache = decode_chunk(params, ltok, lcache, jnp.int32(long_ctx + chunk + produced_l),
-                                   key, cfg, chunk, 0.0, 0)
+                                   key, cfg, chunk, 0.0, 0, use_flash_decode=use_fd_l)
       np.asarray(ltoks)
       ltoks = nxt_l
       produced_l += chunk
@@ -415,6 +422,7 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
       "prefill_mfu_pct": prefill_mfu,
       "prefill_mode": "scan" if use_scan else "segmented",
       "long_tok_s": round(produced_l / (time.time() - t0), 2),
+      **({"long_kv_quant": "int8"} if kvq else {}),
     }
     del lcache, lg, ltok, ltoks
     _record(progress_path, f"{stage_prefix}:long_context", **long_result)

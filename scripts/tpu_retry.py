@@ -65,6 +65,10 @@ STEPS: list[tuple[str, dict, str]] = [
   # Serving-sized segments (engine XOT_PREFILL_CHUNK default): fewer,
   # larger dispatches per 16k prefill than the r3-comparable 2048.
   ("seg4096", {**LONG, "BENCH_LONG_SEG": "4096"}, "prefill_mfu_pct"),
+  # int8 KV cache at 16k depth through the Pallas cached kernel (in-tile
+  # dequant): decode at depth is cache-bandwidth-bound — the halved
+  # bytes/token is the measurable win vs scan16k's bf16 long_tok_s.
+  ("kvq16k", {**LONG, "BENCH_KV_QUANT": "int8"}, "long_tok_s"),
 ]
 
 

@@ -237,3 +237,39 @@ def test_cached_window_ignores_below_window_cache():
     # Sanity: without the window the corruption DOES leak in.
     out3 = flash_decode_attention(q, k2, v2, valid, block_k=32)
     assert not np.allclose(np.asarray(out1), np.asarray(out3), atol=1e-3)
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_cached_kernel_int8_kv_matches_dequant_oracle(window):
+  """int8-KV path (k_scale/v_scale operands, in-kernel per-tile dequant):
+  the kernel over RAW int8 buffers must equal the same kernel over the
+  pre-dequantized cache — both the global and windowed variants, for a
+  chunked segment and a decode step."""
+  from xotorch_tpu.models.transformer import _quantize_kv
+
+  with jax.default_matmul_precision("highest"):
+    key = jax.random.PRNGKey(31)
+    B, S, T, Hq, Hkv, D = 2, 256, 32, 4, 2, 64
+    k = jax.random.normal(key, (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 1), (B, S, Hkv, D), jnp.float32)
+    qk, ks = _quantize_kv(k, jnp.float32)
+    qv, vs = _quantize_kv(v, jnp.float32)
+    k_deq = qk.astype(jnp.float32) * ks[..., None]
+    v_deq = qv.astype(jnp.float32) * vs[..., None]
+    w = None if window is None else jnp.int32(window)
+
+    # Chunked segment at an offset.
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, T, Hq, D), jnp.float32)
+    start = jnp.asarray([160, 96], jnp.int32)
+    ref = flash_cached_attention(q, k_deq, v_deq, start, block_q=16, block_k=32, window=w)
+    out = flash_cached_attention(q, qk, qv, start, block_q=16, block_k=32, window=w,
+                                 k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    # Decode step (T == 1) at per-row depths.
+    q1 = jax.random.normal(jax.random.fold_in(key, 3), (B, 1, Hq, D), jnp.float32)
+    valid = jnp.asarray([200, 131], jnp.int32)
+    ref1 = flash_decode_attention(q1, k_deq, v_deq, valid, block_k=32, window=w)
+    out1 = flash_cached_attention(q1, qk, qv, valid - 1, block_q=1, block_k=32, window=w,
+                                  k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref1), atol=1e-5, rtol=1e-5)

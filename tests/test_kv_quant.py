@@ -130,17 +130,45 @@ async def test_kv_quant_with_prefix_cache(tmp_path, monkeypatch):
   assert first == second
 
 
-async def test_kv_quant_disables_flash_decode(tmp_path, monkeypatch):
+async def test_kv_quant_flash_decode_matches_xla_path(tmp_path, monkeypatch):
+  """int8 KV caches now TAKE the Pallas cached kernel (in-kernel per-tile
+  dequant, ops/flash_decode._load_kv): the engine must select it and the
+  logits must match the XLA dense path on the SAME quantized cache — the
+  dequant math is identical, only the attention implementation differs."""
+  import numpy as np
   from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
   from xotorch_tpu.download.shard_download import LocalShardDownloader
   from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+
+  model_dir = make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([np.arange(90) % 250], dtype=np.int64)
+
+  monkeypatch.setenv("XOT_PREFILL_CHUNK", "32")
+  monkeypatch.setenv("XOT_FLASH_DECODE", "0")
+  dense = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
+                                  kv_quant="int8")
+  ld, _ = await dense.infer_tensor("r", shard, prompt)
 
   monkeypatch.setenv("XOT_FLASH_DECODE", "1")
-  monkeypatch.setenv("XOT_FLASH_DECODE_MIN", "1")
-  model_dir = make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
-  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
-                                kv_quant="int8")
-  assert eng._flash_decode_on(10_000) is False
+  monkeypatch.setenv("XOT_FLASH_DECODE_MIN", "0")
+  flash = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32",
+                                  kv_quant="int8")
+  assert flash._flash_decode_on(10_000) is True
+  lf, _ = await flash.infer_tensor("r", shard, prompt)
+  np.testing.assert_allclose(lf, ld, atol=1e-4, rtol=1e-3)
+
+  # Decode steps over the quantized resident cache agree too. The engine
+  # reads XOT_FLASH_DECODE at CALL time, so the dense engine's step must run
+  # with it off — otherwise this would compare the flash path to itself.
+  tok = np.array([[int(np.argmax(ld[0, -1]))]], dtype=np.int64)
+  monkeypatch.setenv("XOT_FLASH_DECODE", "0")
+  dd, _ = await dense.infer_tensor("r", shard, tok)
+  monkeypatch.setenv("XOT_FLASH_DECODE", "1")
+  df, _ = await flash.infer_tensor("r", shard, tok)
+  np.testing.assert_allclose(df, dd, atol=1e-4, rtol=1e-3)
 
 
 async def test_flash_prefill_composes_with_int8_cache(tmp_path, monkeypatch):

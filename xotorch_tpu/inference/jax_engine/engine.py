@@ -382,11 +382,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     1 = force on (interpret mode off-TPU), 0 = off, unset = on real TPU when
     the resident cache is at least XOT_FLASH_DECODE_MIN (default 4096 —
     below that the fused XLA path is already bandwidth-optimal and the
-    kernel-launch overhead isn't worth it)."""
-    if self._kv_quant:
-      # The Pallas kernel reads raw bf16 cache buffers; int8 caches take
-      # the XLA path, whose fused dequant keeps HBM traffic int8.
-      return False
+    kernel-launch overhead isn't worth it). int8 caches qualify too: the
+    kernel takes their raw buffers + scales and dequantizes per tile
+    (ops/flash_decode._load_kv), keeping the int8 bandwidth AND the
+    occupancy DMA elision the XLA path lacks."""
     env = os.getenv("XOT_FLASH_DECODE")
     if env == "0":
       return False

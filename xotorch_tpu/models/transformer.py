@@ -226,24 +226,29 @@ def _attention_block(
     from xotorch_tpu.ops.flash_attention import flash_attention
     attn = flash_attention(q, k, v, window=window, softcap=cfg.attn_logit_softcap,
                            scale=attn_scale)
-  elif use_flash_decode and not kv_quant:
+  elif use_flash_decode:
     # Decode steps and chunked-prefill segments over a long resident cache:
     # Pallas kernel whose cost is proportional to the OCCUPIED prefix
     # (blocks past the causally visible region are never DMA'd) and whose
     # scores never leave VMEM — no [T, S] materialisation
     # (ops/flash_decode.py). q_start is already per-row. An int8 cache
-    # takes the XLA path instead (the kernel reads raw bf16 buffers; a
-    # pre-kernel dequant would materialise the full cache and forfeit the
-    # bandwidth win — the engine also gates flash_decode off under
-    # XOT_KV_QUANT). With a sliding window the visible range shrinks to
-    # min(window, occupied): blocks below the window re-map too.
+    # passes its raw buffers + per-(position, head) scales and dequantizes
+    # IN-KERNEL per tile — HBM streams int8 bytes AND keeps the
+    # occupancy/window DMA elision (the XLA path fused the dequant but read
+    # the entire static buffer). With a sliding window the visible range
+    # shrinks to min(window, occupied): blocks below the window re-map too.
     from xotorch_tpu.ops.flash_decode import flash_cached_attention
     q_start = (jnp.full((B,), start_pos, dtype=jnp.int32) if jnp.ndim(start_pos) == 0
                else start_pos.astype(jnp.int32))
-    attn = flash_cached_attention(q, layer_cache["k"].astype(q.dtype),
-                                  layer_cache["v"].astype(q.dtype), q_start,
+    if kv_quant:
+      kb, vb = layer_cache["k"], layer_cache["v"]  # raw int8
+    else:
+      kb, vb = layer_cache["k"].astype(q.dtype), layer_cache["v"].astype(q.dtype)
+    attn = flash_cached_attention(q, kb, vb, q_start,
                                   window=window, softcap=cfg.attn_logit_softcap,
-                                  scale=attn_scale)
+                                  scale=attn_scale,
+                                  k_scale=layer_cache.get("k_scale"),
+                                  v_scale=layer_cache.get("v_scale"))
   elif ring_mesh is not None:
     # Sequence-parallel training path (start_pos == 0, T sharded over 'sp'):
     # ring attention rotates KV chunks over ICI instead of materialising the

@@ -45,11 +45,31 @@ from xotorch_tpu.ops.flash_attention import _mxu_operand, _softcap
 NEG_INF = -1e30
 
 
-def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                   *, block_q: int, block_k: int, groups: int, scale: float,
-                   softcap: float = 0.0):
+def _load_kv(k_ref, v_ref, ks_ref, vs_ref, dt):
+  """Dequantize (or pass through) one kv tile pair. int8 caches carry one
+  scale per (position, head): the tile's [block_k] scale vector multiplies
+  in registers between the int8 DMA and the MXU dot, so HBM streams int8
+  bytes — the XLA fallback achieved the same fusion but read the ENTIRE
+  static buffer; here the occupancy/window DMA elision applies too.
+  Dequant runs in `dt` (the query's MXU dtype): identical math to the XLA
+  path's _cache_read, and the dot stays at full bf16 MXU rate."""
+  if ks_ref is None:
+    return _mxu_operand(k_ref[0, 0]), _mxu_operand(v_ref[0, 0])
+  k = k_ref[0, 0].astype(dt) * ks_ref[0, 0, 0].astype(dt)[:, None]
+  v = v_ref[0, 0].astype(dt) * vs_ref[0, 0, 0].astype(dt)[:, None]
+  return k, v
+
+
+def _cached_kernel(start_ref, *refs, block_q: int, block_k: int, groups: int, scale: float,
+                   softcap: float = 0.0, quant: bool = False):
   """Grid = (B, Hkv, nQ, nK); nK innermost so scratch carries the
-  online-softmax state across kv blocks of one (batch, kv-head, q-block)."""
+  online-softmax state across kv blocks of one (batch, kv-head, q-block).
+  `quant` (static) threads the int8 cache's per-(position, head) scale
+  tiles in as two extra operands."""
+  if quant:
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+  else:
+    (q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref), ks_ref, vs_ref = refs, None, None
   b = pl.program_id(0)
   i = pl.program_id(2)
   j = pl.program_id(3)
@@ -70,8 +90,7 @@ def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     # halve the MXU rate — this kernel also serves pos>0 chunked-prefill
     # segments, which are compute-bound).
     q = _mxu_operand(q_ref[0, 0])  # [block_q * groups, D]
-    k = _mxu_operand(k_ref[0, 0])  # [block_k, D]
-    v = _mxu_operand(v_ref[0, 0])  # [block_k, D]
+    k, v = _load_kv(k_ref, v_ref, ks_ref, vs_ref, q.dtype)
 
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -102,15 +121,18 @@ def _cached_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-def _cached_kernel_windowed(start_ref, win_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                            l_ref, *, block_q: int, block_k: int, groups: int, scale: float,
-                            softcap: float):
+def _cached_kernel_windowed(start_ref, win_ref, *refs, block_q: int, block_k: int, groups: int,
+                            scale: float, softcap: float, quant: bool = False):
   """Sliding-window variant: win_ref ([1] int32, 0 = global) is the
   per-LAYER window as a traced scalar-prefetch operand — one compiled
   kernel serves gemma2's alternating sliding/global layers. Cache blocks
   entirely below the window are skipped (and their DMAs elided via the
   BlockSpec re-map), so decode cost is proportional to min(window,
   occupied prefix) instead of the occupied prefix."""
+  if quant:
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+  else:
+    (q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref), ks_ref, vs_ref = refs, None, None
   b = pl.program_id(0)
   i = pl.program_id(2)
   j = pl.program_id(3)
@@ -139,8 +161,7 @@ def _cached_kernel_windowed(start_ref, win_ref, q_ref, k_ref, v_ref, o_ref, acc_
     # halve the MXU rate — this kernel also serves pos>0 chunked-prefill
     # segments, which are compute-bound).
     q = _mxu_operand(q_ref[0, 0])  # [block_q * groups, D]
-    k = _mxu_operand(k_ref[0, 0])  # [block_k, D]
-    v = _mxu_operand(v_ref[0, 0])  # [block_k, D]
+    k, v = _load_kv(k_ref, v_ref, ks_ref, vs_ref, q.dtype)
 
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -185,17 +206,23 @@ def flash_cached_attention(
   window: jnp.ndarray | None = None,  # traced scalar int32; None = global-only kernel
   softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
   scale: float | None = None,  # static score scale; None = D**-0.5
+  k_scale: jnp.ndarray | None = None,  # [B, S, Hkv] — int8 cache's per-(pos, head) scales
+  v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
   """Causal GQA attention of a query segment over the occupied cache prefix.
 
   Query t attends cache positions [max(0, q_start + t - window + 1),
   q_start + t] (window None/0 = the whole prefix). Returns [B, T, Hq, D].
   `window=None` (static) compiles the original kernel, so non-windowed
-  families' executables are unchanged.
+  families' executables are unchanged. With `k_scale`/`v_scale` the cache
+  buffers are raw int8 and dequantize IN-KERNEL per tile (models/
+  transformer._cache_read's math) — int8-KV long-context serving keeps both
+  the halved cache bandwidth and the occupancy/window DMA elision.
   """
   B, T, Hq, D = q.shape
   S, Hkv = k.shape[1], k.shape[2]
   groups = Hq // Hkv
+  quant = k_scale is not None
   if block_q is None:
     block_q = max(1, int(os.getenv("XOT_FD_BLOCK_Q", "128") or 128))
   if block_k is None:
@@ -218,74 +245,74 @@ def flash_cached_attention(
   kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, D]
   vt = v.transpose(0, 2, 1, 3)
   start = q_start.astype(jnp.int32)
+  if quant:
+    # [B, Hkv, 1, S]: the singleton sublane axis keeps the scale block's
+    # trailing dims inside the Mosaic layout rule (same trick as the int4
+    # kernel's group scales).
+    kst = k_scale.transpose(0, 2, 1).reshape(B, Hkv, 1, S)
+    vst = v_scale.transpose(0, 2, 1).reshape(B, Hkv, 1, S)
 
   rows = block_q * groups
   n_q = T // block_q
   n_k = S // block_k
-
-  def kv_index(b, h, i, j, start_ref):
-    # Blocks past this q block's last visible position re-map to the last
-    # visible block: the grid index stops changing, so Pallas elides the DMA.
-    last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
-    return (b, h, jnp.minimum(j, last), 0)
 
   scratch = [
     pltpu.VMEM((rows, D), jnp.float32),
     pltpu.VMEM((rows, 128), jnp.float32),
     pltpu.VMEM((rows, 128), jnp.float32),
   ]
+  q_block = pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, *_: (b, h, i, 0))
 
   if window is None:
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-      num_scalar_prefetch=1,
-      grid=(B, Hkv, n_q, n_k),
-      in_specs=[
-        pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, block_k, D), kv_index),
-        pl.BlockSpec((1, 1, block_k, D), kv_index),
-      ],
-      out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref: (b, h, i, 0)),
-      scratch_shapes=scratch,
-    )
-    out = pl.pallas_call(
-      functools.partial(_cached_kernel, block_q=block_q, block_k=block_k, groups=groups,
-                        scale=scale, softcap=float(softcap)),
-      grid_spec=grid_spec,
-      out_shape=jax.ShapeDtypeStruct((B, Hkv, T * groups, D), q.dtype),
-      interpret=interpret,
-    )(start, qt, kt, vt)
-    return out.reshape(B, Hkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, T, Hq, D)
+    def _kv_j(b, i, j, start_ref):
+      # Blocks past this q block's last visible position re-map to the last
+      # visible block: the grid index stops changing, so Pallas elides the
+      # DMA.
+      last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
+      return jnp.minimum(j, last)
 
-  win = jnp.asarray(window, jnp.int32).reshape(1)
+    prefetch, operands = 1, (start, qt, kt, vt)
+  else:
+    win = jnp.asarray(window, jnp.int32).reshape(1)
 
-  def kv_index_win(b, h, i, j, start_ref, win_ref):
-    # Clamp into the visible range: above the causal diagonal re-map down,
-    # below the sliding window re-map up — the repeated block index elides
-    # the DMA either way, so decode streams min(window, occupied) bytes.
-    last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
-    w = win_ref[0]
-    lo = jnp.where(w > 0,
-                   jnp.maximum(start_ref[b] + i * block_q - w + 1, 0) // block_k, 0)
-    return (b, h, jnp.clip(j, lo, last), 0)
+    def _kv_j(b, i, j, start_ref, win_ref):
+      # Clamp into the visible range: above the causal diagonal re-map down,
+      # below the sliding window re-map up — the repeated block index elides
+      # the DMA either way, so decode streams min(window, occupied) bytes.
+      last = (start_ref[b] + (i + 1) * block_q - 1) // block_k
+      w = win_ref[0]
+      lo = jnp.where(w > 0,
+                     jnp.maximum(start_ref[b] + i * block_q - w + 1, 0) // block_k, 0)
+      return jnp.clip(j, lo, last)
 
+    prefetch, operands = 2, (start, win, qt, kt, vt)
+
+  kv_block = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, i, j, *pf: (b, h, _kv_j(b, i, j, *pf), 0))
+  in_specs = [q_block, kv_block, kv_block]
+  if quant:
+    operands = operands + (kst, vst)
+    sc_block = pl.BlockSpec((1, 1, 1, block_k),
+                            lambda b, h, i, j, *pf: (b, h, 0, _kv_j(b, i, j, *pf)))
+    in_specs += [sc_block, sc_block]
+
+  kernel = (functools.partial(_cached_kernel, block_q=block_q, block_k=block_k,
+                              groups=groups, scale=scale, softcap=float(softcap), quant=quant)
+            if window is None else
+            functools.partial(_cached_kernel_windowed, block_q=block_q, block_k=block_k,
+                              groups=groups, scale=scale, softcap=float(softcap), quant=quant))
   grid_spec = pltpu.PrefetchScalarGridSpec(
-    num_scalar_prefetch=2,
+    num_scalar_prefetch=prefetch,
     grid=(B, Hkv, n_q, n_k),
-    in_specs=[
-      pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref, win_ref: (b, h, i, 0)),
-      pl.BlockSpec((1, 1, block_k, D), kv_index_win),
-      pl.BlockSpec((1, 1, block_k, D), kv_index_win),
-    ],
-    out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, i, j, start_ref, win_ref: (b, h, i, 0)),
+    in_specs=in_specs,
+    out_specs=q_block,
     scratch_shapes=scratch,
   )
   out = pl.pallas_call(
-    functools.partial(_cached_kernel_windowed, block_q=block_q, block_k=block_k, groups=groups,
-                      scale=scale, softcap=float(softcap)),
-    grid_spec=grid_spec,
+    kernel, grid_spec=grid_spec,
     out_shape=jax.ShapeDtypeStruct((B, Hkv, T * groups, D), q.dtype),
     interpret=interpret,
-  )(start, win, qt, kt, vt)
+  )(*operands)
   return out.reshape(B, Hkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(B, T, Hq, D)
 
 
