@@ -44,12 +44,14 @@ class LocalShardDownloader(ShardDownloader):
     self._on_progress: AsyncCallbackSystem = AsyncCallbackSystem()
 
   async def ensure_shard(self, shard: Shard, inference_engine_name: str) -> Path:
-    if shard.model_id in self.mapping:
-      return self.mapping[shard.model_id]
-    import os
-    root = os.getenv("XOT_MODEL_DIR")
-    if root and (Path(root) / shard.model_id).exists():
-      return Path(root) / shard.model_id
+    from xotorch_tpu.models.registry import split_adapter
+    for mid in (shard.model_id, split_adapter(shard.model_id)[0]):
+      if mid in self.mapping:
+        return self.mapping[mid]
+      import os
+      root = os.getenv("XOT_MODEL_DIR")
+      if root and (Path(root) / mid).exists():
+        return Path(root) / mid
     raise FileNotFoundError(f"No local model dir for {shard.model_id}")
 
   @property

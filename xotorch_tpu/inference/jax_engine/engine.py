@@ -668,9 +668,9 @@ class JAXShardInferenceEngine(InferenceEngine):
     Returns the [B, total, H] last-layer hidden states (device array) when
     `want_hidden` (mid-shard ring forwarding), else True for a cache-only
     fill; None/False when the path doesn't apply (Pallas decode kernel
-    gated off, kv-quant cache, or an sp ring prefill outranks it) so the
-    caller falls back to the per-segment loop. `input_data` length must be
-    a multiple of `chunk`."""
+    gated off, or an sp ring prefill outranks it — int8 KV caches qualify,
+    the cached kernel dequantizes per tile) so the caller falls back to the
+    per-segment loop. `input_data` length must be a multiple of `chunk`."""
     import jax
     import jax.numpy as jnp
     total = input_data.shape[1]
@@ -2070,9 +2070,43 @@ class JAXShardInferenceEngine(InferenceEngine):
       return ctx
 
   async def _load_shard(self, shard: Shard) -> _ShardContext:
+    from xotorch_tpu.models.registry import adapter_path, split_adapter
     card = get_model_card(shard.model_id) or {}
     synthetic_cfg = card.get("synthetic_config")
-    if synthetic_cfg is not None:
+    # Multi-LoRA serving: "base@name" ids address a registered adapter set
+    # (XOT_ADAPTERS) served over the base model — a distinct context whose
+    # BASE tensors are shared with any resident sibling (same HBM buffers).
+    base_id, adapter_name = split_adapter(shard.model_id)
+    adapter_ckpt = None
+    if adapter_name is not None:
+      ap = adapter_path(adapter_name)
+      if ap is None:
+        raise ValueError(
+          f"adapter {adapter_name!r} is not registered — set XOT_ADAPTERS="
+          f"'{adapter_name}=/path/to/adapter'")
+      p = Path(ap)
+      if not p.exists():
+        raise FileNotFoundError(f"adapter {adapter_name!r} path does not exist: {ap}")
+      adapter_ckpt = self._latest_shard_saves(p) if p.is_dir() else p
+      if not adapter_ckpt:
+        raise FileNotFoundError(f"no adapter checkpoint files under {ap}")
+
+    def _donor_ctx():
+      """A resident context over the same base + layer range whose params
+      (quantized, mesh-placed) this adapter context can alias."""
+      for s, c in self._contexts.items():
+        if (split_adapter(s.model_id)[0] == base_id
+            and (s.start_layer, s.end_layer) == (shard.start_layer, shard.end_layer)):
+          return c
+      return None
+
+    donor = _donor_ctx() if adapter_name is not None else None
+    if donor is not None:
+      # Tokenizer/vision resolution needs the BASE model dir even when the
+      # weights are aliased (a None here would silently hand the adapter
+      # context a DummyTokenizer).
+      model_dir = donor.model_dir
+    elif synthetic_cfg is not None:
       model_dir = None
     else:
       model_dir = await self.shard_downloader.ensure_shard(shard, self.__class__.__name__)
@@ -2083,37 +2117,54 @@ class JAXShardInferenceEngine(InferenceEngine):
       from xotorch_tpu.models.transformer import forward_shard, init_random_params
       from xotorch_tpu.models.weights import load_shard_params
 
-      if synthetic_cfg is not None:
-        cfg = config_from_hf_dict(synthetic_cfg)
-        # Per-layer key folding makes this shard's weights bit-identical to
-        # the same layer range of a full-model init — ring peers agree on
-        # synthetic weights while allocating only shard-sized HBM.
-        params = init_random_params(
-          cfg, shard.get_layer_count(), shard.is_first_layer, shard.is_last_layer,
-          jax.random.PRNGKey(0), dtype=self._dtype(), start_layer=shard.start_layer,
-        )
+      if donor is not None:
+        # Alias the donor's base tensors — one resident base serves every
+        # adapter; only the rank-r adapter leaves differ per context.
+        # Quantization and mesh placement are already applied to them.
+        cfg = donor.cfg
+        params = {**donor.params,
+                  "layers": {k: v for k, v in donor.params["layers"].items()
+                             if not k.startswith("lora_")}}
+        mesh = donor.mesh
       else:
-        cfg = load_model_config(model_dir)
-        params = load_shard_params(model_dir, cfg, shard, dtype=self._dtype())
+        if synthetic_cfg is not None:
+          cfg = config_from_hf_dict(synthetic_cfg)
+          # Per-layer key folding makes this shard's weights bit-identical to
+          # the same layer range of a full-model init — ring peers agree on
+          # synthetic weights while allocating only shard-sized HBM.
+          params = init_random_params(
+            cfg, shard.get_layer_count(), shard.is_first_layer, shard.is_last_layer,
+            jax.random.PRNGKey(0), dtype=self._dtype(), start_layer=shard.start_layer,
+          )
+        else:
+          cfg = load_model_config(model_dir)
+          params = load_shard_params(model_dir, cfg, shard, dtype=self._dtype())
 
-      if self._quantize:
-        from xotorch_tpu.models.quantize import quantize_params
-        params = quantize_params(params, self._quantize, scale_dtype=self._dtype())
+        if self._quantize:
+          from xotorch_tpu.models.quantize import quantize_params
+          params = quantize_params(params, self._quantize, scale_dtype=self._dtype())
 
-      mesh = self._serving_mesh(cfg, shard)
-      if mesh is not None:
-        # Place params per the Megatron partition rules; inside jit, XLA
-        # derives the tp all-reduces (over ICI) from these placements —
-        # computation follows data, no explicit collectives in model code.
-        from xotorch_tpu.parallel.mesh import shard_params
-        params = shard_params(params, mesh)
-        if self._quantize == "int4":
-          # The int4 decode Pallas kernel has no GSPMD partitioning rule:
-          # under tp it would all-gather the full packed weight per step,
-          # where the einsum path partitions into per-shard partial dots.
-          os.environ["XOT_INT4_KERNEL"] = "0"
+        mesh = self._serving_mesh(cfg, shard)
+        if mesh is not None:
+          # Place params per the Megatron partition rules; inside jit, XLA
+          # derives the tp all-reduces (over ICI) from these placements —
+          # computation follows data, no explicit collectives in model code.
+          from xotorch_tpu.parallel.mesh import shard_params
+          params = shard_params(params, mesh)
+          if self._quantize == "int4":
+            # The int4 decode Pallas kernel has no GSPMD partitioning rule:
+            # under tp it would all-gather the full packed weight per step,
+            # where the einsum path partitions into per-shard partial dots.
+            os.environ["XOT_INT4_KERNEL"] = "0"
+          if DEBUG >= 1:
+            print(f"Serving shard over local tp={mesh.shape['tp']} mesh")
+
+      if adapter_ckpt is not None:
+        # Merge the registered adapter set over the (possibly aliased) base.
+        from xotorch_tpu.train import lora as lora_mod
+        params = lora_mod.load_lora_checkpoint(params, shard, adapter_ckpt)
         if DEBUG >= 1:
-          print(f"Serving shard over local tp={mesh.shape['tp']} mesh")
+          print(f"LoRA adapter {adapter_name!r} attached over {base_id}")
 
       # LoRA fine-tuning (XOT_LORA_RANK / CLI --lora-rank): adapter tensors
       # join the stacked layers pytree (replicated under a tp mesh — they are
@@ -2174,7 +2225,9 @@ class JAXShardInferenceEngine(InferenceEngine):
         # Image prompts are the longest fresh-context prefills (576 patches
         # per image on llava-1.5) — they deserve the Pallas flash path too.
         forward_hidden_flash_jit = jax.jit(partial(hidden_fwd, use_flash=True), donate_argnums=(2,))
-        if model_dir is not None:
+        if donor is not None:
+          vision = donor.vision  # alias — LoRA never touches the tower
+        elif model_dir is not None:
           from xotorch_tpu.models.weights import load_vision_tower
           vision = load_vision_tower(model_dir, cfg, dtype=self._dtype())
       return (cfg, params, mesh, forward_jit, forward_flash_jit, forward_decode_flash_jit,

@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
                            "target's tokenizer, e.g. llama-3.2-1b for llama-3.1-70b); the "
                            "target verifies each draft in one forward. Implies speculation "
                            "on (depth XOT_SPECULATE, default 8)")
+  parser.add_argument("--adapters", type=str, default=None,
+                      help="multi-LoRA serving registry: 'name=/path/to/adapter,name2=/dir'. "
+                           "Requests select an adapter via the model id 'base@name'; all "
+                           "adapters share one resident base (adapter-only checkpoints from "
+                           "--lora-rank training)")
   return parser
 
 
@@ -114,6 +119,8 @@ def build_node(args) -> tuple:
     os.environ["XOT_KV_QUANT"] = args.kv_quantize
   if getattr(args, "draft_model", None):
     os.environ["XOT_DRAFT_MODEL"] = args.draft_model
+  if getattr(args, "adapters", None):
+    os.environ["XOT_ADAPTERS"] = args.adapters
   if getattr(args, "serve_tp", None) is not None:
     os.environ["XOT_SERVE_TP"] = str(args.serve_tp)
   if getattr(args, "serve_sp", None) is not None:

@@ -134,14 +134,38 @@ pretty_names: Dict[str, str] = {
 }
 
 
+def split_adapter(model_id: str) -> tuple:
+  """'base@adapter' -> (base_id, adapter_name); plain ids -> (id, None).
+
+  Multi-LoRA serving: an adapter-suffixed model id addresses a registered
+  LoRA adapter set (XOT_ADAPTERS) served over the base model's weights.
+  The FULL id flows through Shard/contexts (each adapter gets its own
+  engine context — with the base tensors shared, engine._load_shard), while
+  every card/repo/tokenizer lookup resolves to the base."""
+  base, sep, name = model_id.partition("@")
+  return (base, name) if sep and name else (model_id, None)
+
+
+def adapter_path(name: str) -> Optional[str]:
+  """Resolve a registered adapter name to its checkpoint path via
+  XOT_ADAPTERS ('name=/path/to/adapter.safetensors,name2=/dir')."""
+  import os
+  for entry in os.getenv("XOT_ADAPTERS", "").split(","):
+    key, sep, path = entry.strip().partition("=")
+    if sep and key == name:
+      return path
+  return None
+
+
 def get_model_card(model_id: str) -> Optional[Dict]:
-  return model_cards.get(model_id)
+  return model_cards.get(model_id) or model_cards.get(split_adapter(model_id)[0])
 
 
 NATIVE = "NativeSidecarInferenceEngine"
 
 
 def get_repo(model_id: str, inference_engine_classname: str) -> Optional[str]:
+  model_id = split_adapter(model_id)[0]
   repos = model_cards.get(model_id, {}).get("repo", {})
   repo = repos.get(inference_engine_classname)
   if repo is None and inference_engine_classname == NATIVE:
@@ -155,8 +179,10 @@ def get_repo(model_id: str, inference_engine_classname: str) -> Optional[str]:
 
 def build_base_shard(model_id: str, inference_engine_classname: str) -> Optional[Shard]:
   """start=end=0 sentinel shard used to address a model before the ring is
-  known (parity: models.py:252-257)."""
-  n_layers = model_cards.get(model_id, {}).get("layers", 0)
+  known (parity: models.py:252-257). Adapter-suffixed ids keep their FULL
+  id in the shard (distinct engine context per adapter) with the layer
+  count resolved from the base card."""
+  n_layers = (get_model_card(model_id) or {}).get("layers", 0)
   if n_layers < 1 or get_repo(model_id, inference_engine_classname) is None:
     return None
   return Shard(model_id, 0, 0, n_layers)
