@@ -554,6 +554,43 @@ def _bench_caps():
   return DeviceCapabilities("bench", "chip", 1024, DeviceFlops(1.0, 2.0, 4.0))
 
 
+async def _timed_generate(nodes, shard, prompt: str, request_id: str,
+                          timeout: float = 1800) -> dict:
+  """One greedy request through the Node serving loop, measured with the
+  chat-TUI method (ref chat_tui.py:121-128): a timestamp at every token
+  callback; steady tok/s drops the first token (prefill + compiles).
+  `nodes` — every ring member (the token broadcast may surface on any
+  peer). The ONE measurement body every Node-based runner shares
+  (_run_ring2, _run_spec, _run_real_model). Returns
+  {ttft_s, tok_s, n_tokens, tokens}."""
+  import asyncio
+
+  done = asyncio.Event()
+  stamps = []
+  final = {"tokens": []}
+
+  def on_token(rid, tokens, is_finished):
+    if rid != request_id:
+      return  # a straggler broadcast from a previous run must not leak in
+    stamps.append((time.time(), len(tokens)))
+    final["tokens"] = list(tokens)
+    if is_finished:
+      done.set()
+
+  for node in nodes:
+    node.on_token.register(f"cb-{request_id}-{node.id}").on_next(on_token)
+  t0 = time.time()
+  await nodes[0].process_prompt(shard, prompt, request_id)
+  await asyncio.wait_for(done.wait(), timeout=timeout)
+  for node in nodes:
+    node.on_token.deregister(f"cb-{request_id}-{node.id}")
+  n_toks = max(n for _, n in stamps)
+  after_first = [t for t, n in stamps if n > 1]
+  steady = (n_toks - 1) / (after_first[-1] - stamps[0][0]) if len(after_first) > 1 else 0.0
+  return {"ttft_s": stamps[0][0] - t0, "tok_s": steady, "n_tokens": n_toks,
+          "tokens": final["tokens"]}
+
+
 def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_path: str,
                pertoken_tokens: int = 16) -> dict:
   """2-partition same-process ring throughput: two engines in one process
@@ -600,31 +637,7 @@ def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_pat
     prompt = " ".join(["w"] * prefill_len)  # DummyTokenizer: 1 token/word
 
     async def generate(run_tag: str) -> dict:
-      done = asyncio.Event()
-      stamps = []
-      final = {"tokens": []}
-
-      def on_token(request_id, tokens, is_finished):
-        if request_id != f"bench-{run_tag}":
-          return  # a straggler broadcast from a previous run must not leak in
-        stamps.append((time.time(), len(tokens)))
-        final["tokens"] = list(tokens)
-        if is_finished:
-          done.set()
-
-      for node in nodes:
-        node.on_token.register(f"bench-{run_tag}-{node.id}").on_next(on_token)
-      t0 = time.time()
-      await nodes[0].process_prompt(shard, prompt, f"bench-{run_tag}")
-      await asyncio.wait_for(done.wait(), timeout=1800)
-      for node in nodes:
-        node.on_token.deregister(f"bench-{run_tag}-{node.id}")
-      n_toks = max(n for _, n in stamps)
-      # Steady-state decode rate: drop the first token (prefill + compiles).
-      after_first = [t for t, n in stamps if n > 1]
-      steady = (n_toks - 1) / (after_first[-1] - stamps[0][0]) if len(after_first) > 1 else 0.0
-      return {"ttft_s": stamps[0][0] - t0, "tok_s": steady, "n_tokens": n_toks,
-              "tokens": final["tokens"]}
+      return await _timed_generate(nodes, shard, prompt, f"bench-{run_tag}")
 
     warm = await generate(f"{tag}-warmup")  # compiles both shards' executables
     _record(progress_path, f"ring2:{tag}:warmup",
@@ -650,6 +663,77 @@ def _run_ring2(model_id: str, prefill_len: int, decode_tokens: int, progress_pat
                               if pertoken["tok_s"] else None),
       # Same-prefix self-validation as the single-shard token cross-check.
       "ring2_tokens_verified": bool(n_cmp > 0 and agree >= min(8, n_cmp)),
+    }
+
+  return asyncio.run(run())
+
+
+def _run_spec(model_id: str, prefill_len: int, decode_tokens: int, progress_path: str) -> dict:
+  """Prompt-lookup speculative decoding throughput (XOT_SPECULATE) through
+  the real Node serving loop, on a repeat-heavy prompt (the
+  summarisation/extraction workload shape prompt-lookup exists for).
+
+  Measures the same request with speculation ON vs OFF — chat-TUI method at
+  the token callback — plus the engine's draft accounting. The two greedy
+  streams must be IDENTICAL (spec_tokens_verified): speculation may never
+  change output, only its rate. Acceptance is data-dependent; whatever the
+  synthetic model's greedy text yields is reported honestly."""
+  import asyncio
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+  words = ("alpha", "beta", "gamma", "delta")
+  prompt = " ".join(words[i % len(words)] for i in range(prefill_len))
+
+  async def run_mode(spec: int, tag: str) -> dict:
+    prior = os.environ.get("XOT_SPECULATE")  # restore a user-set depth after
+    os.environ["XOT_SPECULATE"] = str(spec)
+    try:
+      eng = JAXShardInferenceEngine()
+      node = Node(f"spec-{tag}", _NullServer(), eng, _NoDiscovery(), None,
+                  RingMemoryWeightedPartitioningStrategy(),
+                  max_generate_tokens=decode_tokens, default_sample_temp=0.0,
+                  decode_chunk_size=int(os.getenv("XOT_DECODE_CHUNK", "8")))
+      node.device_capabilities = _bench_caps()
+      node.topology.update_node(node.id, _bench_caps())
+      shard = Shard(model_id, 0, n_layers - 1, n_layers)
+
+      warm = await _timed_generate([node], shard, prompt, f"bench-spec-{tag}-warmup")
+      _record(progress_path, f"spec:{tag}:warmup", tok_s=round(warm["tok_s"], 2))
+      # Draft accounting as DELTAS over the timed run only — the engine's
+      # counters are cumulative and include the warmup.
+      p0, a0 = getattr(eng, "_spec_proposed", 0), getattr(eng, "_spec_accepted", 0)
+      timed = await _timed_generate([node], shard, prompt, f"bench-spec-{tag}-timed")
+      timed["proposed"] = getattr(eng, "_spec_proposed", 0) - p0
+      timed["accepted"] = getattr(eng, "_spec_accepted", 0) - a0
+      _record(progress_path, f"spec:{tag}", tok_s=round(timed["tok_s"], 2),
+              proposed=timed["proposed"], accepted=timed["accepted"])
+      return timed
+    finally:
+      if prior is None:
+        os.environ.pop("XOT_SPECULATE", None)
+      else:
+        os.environ["XOT_SPECULATE"] = prior
+
+  async def run() -> dict:
+    on = await run_mode(8, "on")
+    off = await run_mode(0, "off")
+    return {
+      "spec_tok_s": round(on["tok_s"], 2),
+      "spec_off_tok_s": round(off["tok_s"], 2),
+      "spec_speedup": round(on["tok_s"] / off["tok_s"], 2) if off["tok_s"] else None,
+      "spec_proposed": on["proposed"],
+      "spec_accepted": on["accepted"],
+      "spec_accept_rate": (round(on["accepted"] / on["proposed"], 3)
+                           if on["proposed"] else None),
+      # IDENTITY, not common-prefix: speculation may never change output.
+      "spec_tokens_verified": bool(on["tokens"] and on["tokens"] == off["tokens"]),
     }
 
   return asyncio.run(run())
@@ -793,27 +877,7 @@ def _run_real_model(progress_path: str, decode_tokens: int = 64) -> dict:
     prompt = "The capital of France is"
 
     async def generate(tag: str) -> dict:
-      done = asyncio.Event()
-      stamps = []
-      out = {"tokens": []}
-
-      def on_token(request_id, tokens, is_finished):
-        if request_id != tag:
-          return
-        stamps.append((time.time(), len(tokens)))
-        out["tokens"] = list(tokens)
-        if is_finished:
-          done.set()
-
-      node.on_token.register(f"cb-{tag}").on_next(on_token)
-      t0 = time.time()
-      await node.process_prompt(shard, prompt, tag)
-      await asyncio.wait_for(done.wait(), timeout=1800)
-      node.on_token.deregister(f"cb-{tag}")
-      n = max(nn for _, nn in stamps)
-      after_first = [t for t, nn in stamps if nn > 1]
-      steady = (n - 1) / (after_first[-1] - stamps[0][0]) if len(after_first) > 1 else 0.0
-      return {"tok_s": steady, "ttft_s": stamps[0][0] - t0, "tokens": out["tokens"]}
+      return await _timed_generate([node], shard, prompt, tag)
 
     warm = await generate("real-warm")
     _record(progress_path, "real_model:warmup", tok_s=round(warm["tok_s"], 2))
@@ -937,6 +1001,13 @@ def child_main() -> None:
       res.update(_run_concurrent(model_id, min(prefill_len, 64), decode_tokens, n_conc, progress_path))
     except Exception as e:
       res["concurrent_error"] = repr(e)
+  # Speculative-decoding stage (opt-in: a repeat-heavy prompt through the
+  # Node loop with XOT_SPECULATE on vs off, streams cross-checked).
+  if os.getenv("BENCH_SPEC", "0") == "1":
+    try:
+      res.update(_run_spec(model_id, min(prefill_len, 128), decode_tokens, progress_path))
+    except Exception as e:
+      res["spec_error"] = repr(e)
   # Real-checkpoint stage: auto-runs whenever actual downloaded weights are
   # on disk (zero-egress containers without them skip silently).
   try:
@@ -1072,6 +1143,8 @@ def _emit(result: dict) -> None:
             "ring2_tok_s", "ring2_per_token_ms", "ring2_ttft_ms", "ring2_error",
             "ring2_pertoken_tok_s", "ring2_fused_speedup", "ring2_tokens_verified",
             "ring2_n_tokens", "long_prefill_tok_s", "prefill_mfu_pct", "prefill_mode",
+            "spec_tok_s", "spec_off_tok_s", "spec_speedup", "spec_proposed",
+            "spec_accepted", "spec_accept_rate", "spec_tokens_verified", "spec_error",
             "real_model_id", "real_model_tok_s", "real_model_ttft_ms",
             "real_model_n_tokens", "real_model_text", "real_model_text_plausible",
             "real_model_error",

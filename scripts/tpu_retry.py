@@ -69,6 +69,8 @@ STEPS: list[tuple[str, dict, str]] = [
   # dequant): decode at depth is cache-bandwidth-bound — the halved
   # bytes/token is the measurable win vs scan16k's bf16 long_tok_s.
   ("kvq16k", {**LONG, "BENCH_KV_QUANT": "int8"}, "long_tok_s"),
+  # Prompt-lookup speculation through the Node loop, streams cross-checked.
+  ("spec", {**SHORT, "BENCH_QUANT": "", "BENCH_SPEC": "1"}, "spec_tok_s"),
 ]
 
 
