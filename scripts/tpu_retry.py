@@ -9,7 +9,7 @@ missing, highest-value first:
               fused ring2, 8-stream concurrent (16k long stage disabled so
               the window is spent on the missing numbers, not re-measuring
               what BENCH_TPU_r04_main.json already holds)
-  2. int4v1 / int4v2 — the Pallas int4 kernel A/B
+  2. int4v1..v4 — the Pallas int4 kernel A/B (v4 = W4A8, approximate)
   3. flash sweep — prefill-MFU block-size configs
 
 A step counts as landed once its BENCH_TPU_r04_<tag>.json records
@@ -52,6 +52,7 @@ STEPS: list[tuple[str, dict, str]] = [
   ("int4v1", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "1"}, "int4_tok_s"),
   ("int4v2", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "2"}, "int4_tok_s"),
   ("int4v3", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "3"}, "int4_tok_s"),
+  ("int4v4", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "4"}, "int4_tok_s"),
   # Cached-kernel block sweep: with scan-prefill the long stage runs on
   # flash_decode (XOT_FD_BLOCK_*), not the in-segment flash kernel.
   ("fd256x256", {**LONG, "XOT_FD_BLOCK_Q": "256", "XOT_FD_BLOCK_K": "256"},
@@ -71,6 +72,8 @@ STEPS: list[tuple[str, dict, str]] = [
   ("kvq16k", {**LONG, "BENCH_KV_QUANT": "int8"}, "long_tok_s"),
   # Prompt-lookup speculation through the Node loop, streams cross-checked.
   ("spec", {**SHORT, "BENCH_QUANT": "", "BENCH_SPEC": "1"}, "spec_tok_s"),
+  # 32k depth: twice the r3-comparable context, scan prefill + decode.
+  ("long32k", {**LONG, "BENCH_LONG": "32768"}, "long_tok_s"),
 ]
 
 

@@ -317,12 +317,14 @@ async def test_engine_quantized_full_train_rejected(tmp_path):
     await eng.train_example("t", shard, x, x, np.array([8]))
 
 
-@pytest.mark.parametrize("variant", [1, 2, 3])
+@pytest.mark.parametrize("variant", [1, 2, 3, 4])
 def test_int4_pallas_matvec_matches_dequant(variant):
   """Every decode-path Pallas kernel variant (in-register nibble unpack,
   ops/int4_matmul.py: v1 scale-into-operand, v2 scale-after-dot, v3
-  int8-shift unpack) must equal the full dequantize-then-matmul oracle
-  for 1..8 rows and non-trivial group counts."""
+  int8-shift unpack, v4 W4A8 int8-MXU) must match the full
+  dequantize-then-matmul oracle for 1..8 rows and non-trivial group
+  counts — exactly for the weight-only v1-v3, to ~1% relative for v4
+  (its in-kernel activation quantization rounds to 8 bits by design)."""
   from xotorch_tpu.models.quantize import dequantize_tensor_grouped, quantize_tensor_grouped
   from xotorch_tpu.ops.int4_matmul import int4_grouped_matmul
 
@@ -334,5 +336,9 @@ def test_int4_pallas_matvec_matches_dequant(variant):
       h = jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(6), rows),
                             (rows, 256), jnp.float32)
       got = int4_grouped_matmul(h, q[0], gscale[0], block_out=128, variant=variant)
-      np.testing.assert_allclose(np.asarray(got), np.asarray(h @ ref_w),
-                                 atol=1e-4, rtol=1e-4)
+      ref = np.asarray(h @ ref_w)
+      if variant == 4:
+        err = np.linalg.norm(np.asarray(got) - ref) / np.linalg.norm(ref)
+        assert err < 0.01, f"v4 rel L2 {err:.4f} exceeds the A8 rounding budget"
+      else:
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4, rtol=1e-4)

@@ -119,7 +119,37 @@ def _int4_matvec_kernel_v3(he_ref, ho_ref, w_ref, gs_ref, o_ref):
   o_ref[...] = (part * scale).sum(axis=0).astype(o_ref.dtype)
 
 
-_KERNELS = {1: _int4_matvec_kernel, 2: _int4_matvec_kernel_v2, 3: _int4_matvec_kernel_v3}
+def _int4_matvec_kernel_v4(he_ref, ho_ref, hes_ref, hos_ref, w_ref, gs_ref, o_ref):
+  """W4A8: int8 x int8 MXU dot with int32 accumulation. v3 still pays two
+  full-tile f32 converts (one per nibble half) before the dot; here the
+  nibbles STAY int8 (the 3-op shift unpack) and the activations arrive
+  ALREADY row-quantized to int8 (done once outside the pallas_call — not
+  per out-block grid step), so the only per-weight-element work is the
+  unpack itself and the MXU consumes int8 at its doubled rate. Scales
+  compose after the dot: out = sum_G(part_i32 * a_scale[row] * gscale).
+
+  Activation quantization adds ~1/255 relative rounding per dot — an
+  APPROXIMATE variant (the weight-only v1-v3 are exact): selected only via
+  XOT_INT4_V=4, A/B'd on-chip like the others, oracle-tested to 1% rel L2
+  (the same budget the test asserts)."""
+  packed8 = w_ref[...].astype(jnp.int8)
+  lo8 = (packed8 << 4) >> 4
+  hi8 = packed8 >> 4
+  G, gs_half, block_out = packed8.shape
+  rows = he_ref.shape[0]
+  he = he_ref[...].reshape(rows, G, gs_half).transpose(1, 0, 2)  # [G, rows, gs_half]
+  ho = ho_ref[...].reshape(rows, G, gs_half).transpose(1, 0, 2)
+  dims = (((2,), (1,)), ((0,), (0,)))
+  pe = jax.lax.dot_general(he, lo8, dims, preferred_element_type=jnp.int32)
+  po = jax.lax.dot_general(ho, hi8, dims, preferred_element_type=jnp.int32)
+  scale = gs_ref[...].astype(jnp.float32)  # [G, 1, block_out]
+  part = (pe.astype(jnp.float32) * hes_ref[...][None]
+          + po.astype(jnp.float32) * hos_ref[...][None]) * scale
+  o_ref[...] = part.sum(axis=0).astype(o_ref.dtype)
+
+
+_KERNELS = {1: _int4_matvec_kernel, 2: _int4_matvec_kernel_v2, 3: _int4_matvec_kernel_v3,
+            4: _int4_matvec_kernel_v4}
 
 
 def int4_grouped_matmul(
@@ -128,7 +158,9 @@ def int4_grouped_matmul(
   gscale: jnp.ndarray,  # [G, out]
   block_out: int = 1024,
   interpret: bool | None = None,
-  variant: int | None = None,  # 1 = scale-into-operand, 2 = scale-after-dot
+  variant: int | None = None,  # 1 scale-into-operand, 2 scale-after-dot,
+  # 3 int8-shift unpack, 4 W4A8 int8-MXU (the only APPROXIMATE one:
+  # activations round to int8; v1-v3 are exact)
 ) -> jnp.ndarray:
   """h @ dequant(w) with the nibble unpack fused into the kernel.
 
@@ -162,10 +194,12 @@ def _int4_grouped_matmul_impl(
   block_out = min(block_out, d_out)
   while d_out % block_out:
     block_out //= 2
-  # VMEM bound: the kernel holds lo_f + hi_f at [d_in/2, block_out] f32
-  # (8 bytes per packed element). Cap their footprint at ~8 MB or the
-  # Mosaic compile blows VMEM on wide contractions (w_down: in=8192).
-  while block_out > 128 and (d_in // 2) * block_out * 8 > 8_000_000:
+  # VMEM bound: v1-v3 hold lo_f + hi_f at [d_in/2, block_out] f32 (8 bytes
+  # per packed element); v4's unpacked halves stay int8 (2 bytes). Cap the
+  # footprint at ~8 MB or the Mosaic compile blows VMEM on wide
+  # contractions (w_down: in=8192).
+  bytes_per_packed = 2 if variant == 4 else 8
+  while block_out > 128 and (d_in // 2) * block_out * bytes_per_packed > 8_000_000:
     block_out //= 2
   if interpret is None:
     interpret = jax.default_backend() != "tpu"
@@ -178,15 +212,36 @@ def _int4_grouped_matmul_impl(
   # equal the array's dimension).
   gs3 = gscale.reshape(G, 1, d_out)
 
+  act_block = pl.BlockSpec((rows, G * gs_half), lambda j: (0, 0))
+  w_blocks = [
+    pl.BlockSpec((G, gs_half, block_out), lambda j: (0, 0, j)),
+    pl.BlockSpec((G, 1, block_out), lambda j: (0, 0, j)),
+  ]
+  if variant == 4:
+    # Row-quantize the activations ONCE here (not per out-block grid step):
+    # the kernel receives int8 halves + their [rows, 1] scales as operands.
+    def q8(a):
+      a = a.astype(jnp.float32)
+      s = jnp.max(jnp.abs(a), axis=1, keepdims=True) / 127.0
+      s = jnp.where(s == 0.0, 1.0, s)
+      return jnp.round(a / s).astype(jnp.int8), s
+    he8, he_s = q8(h_even)
+    ho8, ho_s = q8(h_odd)
+    scale_block = pl.BlockSpec((rows, 1), lambda j: (0, 0))
+    out = pl.pallas_call(
+      _int4_matvec_kernel_v4,
+      grid=(d_out // block_out,),
+      in_specs=[act_block, act_block, scale_block, scale_block] + w_blocks,
+      out_specs=pl.BlockSpec((rows, block_out), lambda j: (0, j)),
+      out_shape=jax.ShapeDtypeStruct((rows, d_out), h.dtype),
+      interpret=interpret,
+    )(he8, ho8, he_s, ho_s, w_packed, gs3)
+    return out
+
   out = pl.pallas_call(
     _KERNELS.get(variant, _int4_matvec_kernel),
     grid=(d_out // block_out,),
-    in_specs=[
-      pl.BlockSpec((rows, G * gs_half), lambda j: (0, 0)),
-      pl.BlockSpec((rows, G * gs_half), lambda j: (0, 0)),
-      pl.BlockSpec((G, gs_half, block_out), lambda j: (0, 0, j)),
-      pl.BlockSpec((G, 1, block_out), lambda j: (0, 0, j)),
-    ],
+    in_specs=[act_block, act_block] + w_blocks,
     out_specs=pl.BlockSpec((rows, block_out), lambda j: (0, j)),
     out_shape=jax.ShapeDtypeStruct((rows, d_out), h.dtype),
     interpret=interpret,
