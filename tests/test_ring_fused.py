@@ -56,7 +56,8 @@ def _ring(model_dir, n_nodes, max_tokens, chunk=4):
   return nodes
 
 
-async def _generate(node, prompt_text, request_id, watch=(), **prompt_kwargs):
+async def _generate(node, prompt_text, request_id, watch=(), n_layers=N_LAYERS,
+                    **prompt_kwargs):
   done = asyncio.Event()
   out = {}
 
@@ -69,7 +70,7 @@ async def _generate(node, prompt_text, request_id, watch=(), **prompt_kwargs):
 
   for n in (node, *watch):
     n.on_token.register(f"t-{n.id}-{request_id}").on_next(on_token)
-  await node.process_prompt(Shard("m", 0, N_LAYERS - 1, N_LAYERS), prompt_text, request_id,
+  await node.process_prompt(Shard("m", 0, n_layers - 1, n_layers), prompt_text, request_id,
                             **prompt_kwargs)
   await asyncio.wait_for(done.wait(), timeout=120)
   for n in (node, *watch):
@@ -92,11 +93,11 @@ def _spy_ring_calls(nodes):
   return calls
 
 
-async def _solo_tokens(model_dir, prompt, max_tokens):
+async def _solo_tokens(model_dir, prompt, max_tokens, n_layers=N_LAYERS):
   solo = _node("solo", _engine(model_dir), max_tokens, chunk=4)
   solo.device_capabilities = _caps()
   solo.topology.update_node("solo", _caps())
-  return await _generate(solo, prompt, "req-solo")
+  return await _generate(solo, prompt, "req-solo", n_layers=n_layers)
 
 
 async def test_ring2_fused_matches_solo(tiny_model_dir):
@@ -240,3 +241,44 @@ async def test_ring_sampling_extras_fall_back_to_per_token(tiny_model_dir):
                         sampling={"logit_bias": {"7": 2.0}})
   assert len(got) == max_tokens
   assert calls == [], "extras request must not take the fused ring path"
+
+
+async def test_ring2_fused_gemma2_matches_solo(tmp_path):
+  """Sliding-window family through the fused ring: gemma2's alternating
+  per-layer windows + attention/final soft-caps + query_pre_attn scale ride
+  the composite ring executable with ABSOLUTE start_layers (the mid-ring
+  segment's window schedule must not restart at zero) — greedy stream
+  identical to a solo gemma2 node."""
+  from tests.test_model_equivalence import TINY_GEMMA2_CFG
+  ng = TINY_GEMMA2_CFG["num_hidden_layers"]
+  gdir = make_hf_checkpoint(tmp_path, TINY_GEMMA2_CFG, seed=9)
+  max_tokens = 12
+  # Prompt longer than the window (4) so the sliding mask actually bites.
+  prompt = "a b c d e f g h i j k l"
+
+  want = await _solo_tokens(gdir, prompt, max_tokens, n_layers=ng)
+  nodes = _ring(gdir, 2, max_tokens)
+  calls = _spy_ring_calls(nodes)
+  got = await _generate(nodes[0], prompt, "req-g2ring", watch=nodes[1:], n_layers=ng)
+  assert got == want, f"gemma2 ring stream {got} != solo {want}"
+  assert calls, "gemma2 ring never took the fused path"
+
+
+async def test_ring_draft_model_speculation(tiny_model_dir, monkeypatch):
+  """Draft-MODEL speculation composes with the fused ring: the sampler peer
+  drafts with its resident draft model (engine.draft_tokens) and
+  verify_draft_ring verifies the whole draft through every co-located
+  partition in ONE composite forward — stream identical to the
+  no-speculation solo run, with model drafts actually accepted."""
+  max_tokens = 12
+  want = await _solo_tokens(tiny_model_dir, "one two three four", max_tokens)
+
+  from xotorch_tpu.models import registry
+  monkeypatch.setitem(registry.model_cards, "m",
+                      {"layers": N_LAYERS, "repo": {"JAXShardInferenceEngine": "local"}})
+  monkeypatch.setenv("XOT_DRAFT_MODEL", "m")
+  nodes = _ring(tiny_model_dir, 2, max_tokens)
+  got = await _generate(nodes[0], "one two three four", "req-draft-ring", watch=nodes[1:])
+  assert got == want, f"draft-model ring stream {got} != solo {want}"
+  accepted = sum(getattr(n.inference_engine, "_spec_accepted", 0) for n in nodes)
+  assert accepted > 0, "no model drafts were accepted on the ring"

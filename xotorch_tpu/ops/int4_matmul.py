@@ -148,8 +148,9 @@ def _int4_matvec_kernel_v4(he_ref, ho_ref, hes_ref, hos_ref, w_ref, gs_ref, o_re
   o_ref[...] = part.sum(axis=0).astype(o_ref.dtype)
 
 
-_KERNELS = {1: _int4_matvec_kernel, 2: _int4_matvec_kernel_v2, 3: _int4_matvec_kernel_v3,
-            4: _int4_matvec_kernel_v4}
+# v4 is NOT in this table: its operand list differs (int8 activations + two
+# scale inputs), so it dispatches through its own pallas_call branch below.
+_KERNELS = {1: _int4_matvec_kernel, 2: _int4_matvec_kernel_v2, 3: _int4_matvec_kernel_v3}
 
 
 def int4_grouped_matmul(
