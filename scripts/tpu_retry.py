@@ -53,6 +53,9 @@ STEPS: list[tuple[str, dict, str]] = [
   ("int4v2", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "2"}, "int4_tok_s"),
   ("int4v3", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "3"}, "int4_tok_s"),
   ("int4v4", {**SHORT, "BENCH_QUANT": "int4", "XOT_INT4_V": "4"}, "int4_tok_s"),
+  # W8A8: int8 weights on the int8 MXU (ops/int8_matmul.py) vs the default
+  # fused-dequant path the rest step measures (r3: 56% of roofline).
+  ("int8k", {**SHORT, "BENCH_QUANT": "int8", "XOT_INT8_KERNEL": "1"}, "int8_tok_s"),
   # Cached-kernel block sweep: with scan-prefill the long stage runs on
   # flash_decode (XOT_FD_BLOCK_*), not the in-segment flash kernel.
   ("fd256x256", {**LONG, "XOT_FD_BLOCK_Q": "256", "XOT_FD_BLOCK_K": "256"},

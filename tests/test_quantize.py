@@ -342,3 +342,66 @@ def test_int4_pallas_matvec_matches_dequant(variant):
         assert err < 0.01, f"v4 rel L2 {err:.4f} exceeds the A8 rounding budget"
       else:
         np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4, rtol=1e-4)
+
+
+def test_int8_rowquant_matvec_close_to_dequant():
+  """The W8A8 decode kernel (ops/int8_matmul.py): int8 x int8 MXU dot with
+  row-quantized activations must track the exact fused-dequant path to
+  ~1% relative L2 (the A8 rounding budget) for 1..8 rows."""
+  from xotorch_tpu.models.quantize import quantize_tensor
+  from xotorch_tpu.ops.int8_matmul import int8_rowquant_matmul
+
+  w = jax.random.normal(jax.random.PRNGKey(15), (256, 384), jnp.float32)
+  q, scale = quantize_tensor(w, axis=0, scale_dtype=jnp.float32)
+  ref_w = q.astype(jnp.float32) * scale  # exact dequant
+  with jax.default_matmul_precision("highest"):
+    for rows in (1, 3, 8):
+      h = jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(16), rows),
+                            (rows, 256), jnp.float32)
+      got = np.asarray(int8_rowquant_matmul(h, q, scale.reshape(-1), block_out=128))
+      ref = np.asarray(h @ ref_w)
+      err = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+      assert err < 0.01, f"rows={rows}: rel L2 {err:.4f} exceeds the A8 budget"
+
+
+async def _kernel_engine_stream(tmp_path, monkeypatch, quantize, env, value, steps=5):
+  """Shared scaffold for the Pallas-kernel-vs-fallback engine stream tests:
+  tiny checkpoint, greedy prefill + `steps` decode tokens through
+  infer_sample_tensor under `env`=`value`."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+  from xotorch_tpu.download.shard_download import LocalShardDownloader
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+
+  model_dir = make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=3)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("m", 0, n - 1, n)
+  prompt = np.array([[1, 5, 9, 200, 17]], dtype=np.int64)
+  monkeypatch.setenv(env, value)
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}),
+                                dtype="float32", quantize=quantize)
+  tok, _ = await eng.infer_sample_tensor("r", shard, prompt, temp=0.0)
+  toks = [int(tok)]
+  for _ in range(steps):
+    tok, _ = await eng.infer_sample_tensor("r", shard, np.asarray([[toks[-1]]]), temp=0.0)
+    toks.append(int(tok))
+  return toks
+
+
+async def test_int8_kernel_engine_decode(tmp_path, monkeypatch):
+  """XOT_INT8_KERNEL=force (W8A8, interpret off-TPU) through the engine:
+  greedy stream identical to the fused-dequant path on the tiny model (A8
+  rounding is far inside its argmax margins)."""
+  off = await _kernel_engine_stream(tmp_path, monkeypatch, "int8", "XOT_INT8_KERNEL", "0")
+  on = await _kernel_engine_stream(tmp_path, monkeypatch, "int8", "XOT_INT8_KERNEL", "force")
+  assert on == off, f"int8 kernel stream {on} != fused-dequant {off}"
+
+
+@pytest.mark.parametrize("variant", ["1", "3"])
+async def test_int4_kernel_engine_decode(tmp_path, monkeypatch, variant):
+  """XOT_INT4_KERNEL=force engages the Pallas int4 decode matvec off-TPU
+  (interpret): the engine's greedy stream equals the einsum fallback's for
+  the exact kernel variants."""
+  monkeypatch.setenv("XOT_INT4_V", variant)
+  off = await _kernel_engine_stream(tmp_path, monkeypatch, "int4", "XOT_INT4_KERNEL", "0")
+  on = await _kernel_engine_stream(tmp_path, monkeypatch, "int4", "XOT_INT4_KERNEL", "force")
+  assert on == off, f"int4 v{variant} kernel stream {on} != einsum {off}"

@@ -2156,6 +2156,8 @@ class JAXShardInferenceEngine(InferenceEngine):
             # under tp it would all-gather the full packed weight per step,
             # where the einsum path partitions into per-shard partial dots.
             os.environ["XOT_INT4_KERNEL"] = "0"
+          if self._quantize == "int8":
+            os.environ["XOT_INT8_KERNEL"] = "0"  # same GSPMD rule gap
           if DEBUG >= 1:
             print(f"Serving shard over local tp={mesh.shape['tp']} mesh")
 

@@ -62,8 +62,8 @@ def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
     # int4 group-wise: w is PACKED uint8 [G, gs/2, out] (two nibbles per
     # byte — models/quantize.pack_int4), gscale [G, out].
     B, T, _ = h.shape
-    if (B * T <= 8 and jax.default_backend() == "tpu"
-        and os.getenv("XOT_INT4_KERNEL", "1") != "0"):
+    k4 = os.getenv("XOT_INT4_KERNEL", "1")
+    if B * T <= 8 and (k4 == "force" or (k4 != "0" and jax.default_backend() == "tpu")):
       # Decode hot path ON REAL TPU: Pallas kernel (ops/int4_matmul.py)
       # unpacks the nibbles IN REGISTERS between the packed-tile read and
       # the MXU dot, so HBM streams the promised 0.5 bytes/param — XLA's
@@ -89,6 +89,19 @@ def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
   scale = layer.get(slot + "_scale")
   if scale is None:
     return h @ w
+  B, T, _ = h.shape
+  k8 = os.getenv("XOT_INT8_KERNEL", "0")
+  if B * T <= 8 and (k8 == "force" or (k8 == "1" and jax.default_backend() == "tpu")):
+    # Opt-in W8A8 decode path (ops/int8_matmul.py): the MXU consumes int8
+    # weights directly (int32 accumulate) instead of the VPU running
+    # convert+scale passes over every element first. Activations
+    # row-quantize to int8 — approximate (~1/255), so the fused-dequant
+    # path below stays the default; A/B'd on-chip via XOT_INT8_KERNEL.
+    # The engine clears the flag under a tp mesh (no GSPMD rule, same as
+    # the int4 kernel).
+    from xotorch_tpu.ops.int8_matmul import int8_rowquant_matmul
+    out = int8_rowquant_matmul(h.reshape(B * T, h.shape[-1]), w, scale)
+    return out.reshape(B, T, -1).astype(h.dtype)
   return (h @ w.astype(h.dtype)) * scale.astype(h.dtype)
 
 

@@ -221,13 +221,10 @@ def _int4_grouped_matmul_impl(
   if variant == 4:
     # Row-quantize the activations ONCE here (not per out-block grid step):
     # the kernel receives int8 halves + their [rows, 1] scales as operands.
-    def q8(a):
-      a = a.astype(jnp.float32)
-      s = jnp.max(jnp.abs(a), axis=1, keepdims=True) / 127.0
-      s = jnp.where(s == 0.0, 1.0, s)
-      return jnp.round(a / s).astype(jnp.int8), s
-    he8, he_s = q8(h_even)
-    ho8, ho_s = q8(h_odd)
+    # The recipe is shared with the W8A8 kernel (ops/int8_matmul.py).
+    from xotorch_tpu.ops.int8_matmul import rowquant_int8
+    he8, he_s = rowquant_int8(h_even)
+    ho8, ho_s = rowquant_int8(h_odd)
     scale_block = pl.BlockSpec((rows, 1), lambda j: (0, 0))
     out = pl.pallas_call(
       _int4_matvec_kernel_v4,
