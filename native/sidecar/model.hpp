@@ -18,6 +18,7 @@
 
 #include <atomic>
 #include <cmath>
+#include <cstdlib>
 #include <condition_variable>
 #include <cstring>
 #include <fstream>
@@ -183,6 +184,17 @@ struct ModelConfig {
 
 // ----------------------------------------------------------------- weights
 
+// int8 weight-only quantization of one linear: per-out-row symmetric scale
+// (the JAX engine's per-channel layout, models/quantize.py). CPU GEMV is
+// memory-bandwidth-bound, so streaming 1 byte/weight instead of 4 is the
+// dominant win; accumulation stays fp32. Enabled via XOT_SIDECAR_QUANT=int8
+// (the fp32 rows are freed after conversion — 4x less resident memory).
+struct QLin {
+  std::vector<int8_t> q;   // [out, in] row-major
+  std::vector<float> s;    // [out]
+  bool used() const { return !q.empty(); }
+};
+
 struct LayerWeights {
   // Linears kept in HF [out, in] row-major: GEMV walks rows contiguously.
   std::vector<float> wq, wk, wv, wo;          // [out, hidden]
@@ -190,6 +202,7 @@ struct LayerWeights {
   std::vector<float> attn_norm, mlp_norm;     // [hidden]
   std::vector<float> q_norm, k_norm;          // optional qwen3 [head_dim]
   std::vector<float> w_gate, w_up, w_down;    // SwiGLU
+  QLin qwq, qwk, qwv, qwo, qgate, qup, qdown; // int8 twins (XOT_SIDECAR_QUANT)
 };
 
 struct ShardWeights {
@@ -222,6 +235,8 @@ class ShardModel {
     cache_len_ = std::min(cache_len, cfg_.max_seq_len);
     is_first_ = start_layer_ == 0;
     is_last_ = end_layer_ == cfg_.num_layers - 1;
+    const char* qenv = std::getenv("XOT_SIDECAR_QUANT");
+    quant_int8_ = qenv != nullptr && std::string(qenv) == "int8";
     load_weights(model_dir);
   }
 
@@ -289,6 +304,50 @@ class ShardModel {
     });
   }
 
+  // int8 GEMV: row dot in fp32 over int8 weights, per-row scale after.
+  void gemv_q8(const QLin& l, const float* x, float* y, int64_t out_dim, int64_t in_dim,
+               const float* bias) {
+    pool_->parallel_for(out_dim, [&](int64_t begin, int64_t end) {
+      for (int64_t o = begin; o < end; ++o) {
+        const int8_t* row = l.q.data() + o * in_dim;
+        float acc = 0.0f;
+        for (int64_t i = 0; i < in_dim; ++i) acc += static_cast<float>(row[i]) * x[i];
+        acc *= l.s[static_cast<size_t>(o)];
+        y[o] = bias ? acc + bias[o] : acc;
+      }
+    });
+  }
+
+  // Dispatch: the int8 twin when present, fp32 rows otherwise.
+  void lin(const std::vector<float>& w, const QLin& ql, const float* x, float* y,
+           int64_t out_dim, int64_t in_dim, const float* bias) {
+    if (ql.used()) gemv_q8(ql, x, y, out_dim, in_dim, bias);
+    else gemv(w.data(), x, y, out_dim, in_dim, bias);
+  }
+
+  // Symmetric per-out-row int8 conversion; frees the fp32 rows. Rows are
+  // independent — threaded over the pool so multi-GB loads convert at
+  // memory speed instead of one core.
+  void quantize_rows(std::vector<float>& w, QLin& out, int64_t out_dim,
+                     int64_t in_dim) {
+    out.q.resize(w.size());
+    out.s.resize(static_cast<size_t>(out_dim));
+    pool_->parallel_for(out_dim, [&](int64_t begin, int64_t end) {
+      for (int64_t o = begin; o < end; ++o) {
+        const float* row = &w[o * in_dim];
+        float m = 0.0f;
+        for (int64_t i = 0; i < in_dim; ++i) m = std::max(m, std::fabs(row[i]));
+        float s = m > 0.0f ? m / 127.0f : 1.0f;
+        out.s[static_cast<size_t>(o)] = s;
+        int8_t* qrow = out.q.data() + o * in_dim;
+        for (int64_t i = 0; i < in_dim; ++i)
+          qrow[i] = static_cast<int8_t>(std::lrintf(row[i] / s));
+      }
+    });
+    w.clear();
+    w.shrink_to_fit();
+  }
+
   void rmsnorm(float* x, const float* weight, int64_t n) const {
     float ss = 0.0f;
     for (int64_t i = 0; i < n; ++i) ss += x[i] * x[i];
@@ -343,9 +402,9 @@ class ShardModel {
       float* qt = &q[t * q_dim];
       float* kt = &s.k[l][pos * kv_dim];
       float* vt = &s.v[l][pos * kv_dim];
-      gemv(lw.wq.data(), normed.data(), qt, q_dim, H, lw.bq.empty() ? nullptr : lw.bq.data());
-      gemv(lw.wk.data(), normed.data(), kt, kv_dim, H, lw.bk.empty() ? nullptr : lw.bk.data());
-      gemv(lw.wv.data(), normed.data(), vt, kv_dim, H, lw.bv.empty() ? nullptr : lw.bv.data());
+      lin(lw.wq, lw.qwq, normed.data(), qt, q_dim, H, lw.bq.empty() ? nullptr : lw.bq.data());
+      lin(lw.wk, lw.qwk, normed.data(), kt, kv_dim, H, lw.bk.empty() ? nullptr : lw.bk.data());
+      lin(lw.wv, lw.qwv, normed.data(), vt, kv_dim, H, lw.bv.empty() ? nullptr : lw.bv.data());
 
       for (int64_t h = 0; h < NH; ++h) {
         if (cfg_.qk_norm) rmsnorm(qt + h * D, lw.q_norm.data(), D);
@@ -396,19 +455,19 @@ class ShardModel {
     std::vector<float> proj(static_cast<size_t>(H));
     std::vector<float> gate(static_cast<size_t>(I)), up(static_cast<size_t>(I));
     for (int64_t t = 0; t < T; ++t) {
-      gemv(lw.wo.data(), &attn_out[t * q_dim], proj.data(), H, q_dim, nullptr);
+      lin(lw.wo, lw.qwo, &attn_out[t * q_dim], proj.data(), H, q_dim, nullptr);
       for (int64_t i = 0; i < H; ++i) x[t * H + i] += proj[i];
 
       std::vector<float> normed(static_cast<size_t>(H));
       std::memcpy(normed.data(), &x[t * H], H * 4);
       rmsnorm(normed.data(), lw.mlp_norm.data(), H);
-      gemv(lw.w_gate.data(), normed.data(), gate.data(), I, H, nullptr);
-      gemv(lw.w_up.data(), normed.data(), up.data(), I, H, nullptr);
+      lin(lw.w_gate, lw.qgate, normed.data(), gate.data(), I, H, nullptr);
+      lin(lw.w_up, lw.qup, normed.data(), up.data(), I, H, nullptr);
       for (int64_t i = 0; i < I; ++i) {
         float g = gate[i];
         gate[i] = (g / (1.0f + std::exp(-g))) * up[i];  // silu(g) * up
       }
-      gemv(lw.w_down.data(), gate.data(), proj.data(), H, I, nullptr);
+      lin(lw.w_down, lw.qdown, gate.data(), proj.data(), H, I, nullptr);
       for (int64_t i = 0; i < H; ++i) x[t * H + i] += proj[i];
     }
   }
@@ -457,6 +516,18 @@ class ShardModel {
       lw.w_gate = load(p + "mlp.gate_proj.weight");
       lw.w_up = load(p + "mlp.up_proj.weight");
       lw.w_down = load(p + "mlp.down_proj.weight");
+      if (quant_int8_) {
+        int64_t H = cfg_.hidden_size, I = cfg_.intermediate_size;
+        int64_t q_dim = cfg_.num_heads * cfg_.head_dim;
+        int64_t kv_dim = cfg_.num_kv_heads * cfg_.head_dim;
+        quantize_rows(lw.wq, lw.qwq, q_dim, H);
+        quantize_rows(lw.wk, lw.qwk, kv_dim, H);
+        quantize_rows(lw.wv, lw.qwv, kv_dim, H);
+        quantize_rows(lw.wo, lw.qwo, H, q_dim);
+        quantize_rows(lw.w_gate, lw.qgate, I, H);
+        quantize_rows(lw.w_up, lw.qup, I, H);
+        quantize_rows(lw.w_down, lw.qdown, H, I);
+      }
     }
     if (is_first_ || (cfg_.tie_word_embeddings && is_last_)) {
       w_.has_embed = maybe_load("embed_tokens.weight", w_.embed);
@@ -474,6 +545,7 @@ class ShardModel {
 
   ModelConfig cfg_;
   int64_t start_layer_, end_layer_;
+  bool quant_int8_ = false;
   int64_t cache_len_;
   bool is_first_ = false, is_last_ = false;
   ShardWeights w_;

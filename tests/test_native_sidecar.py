@@ -155,3 +155,32 @@ async def test_sampling_temp0_is_argmax(llama_dir):
     await engine.stop()
   assert tok.shape == (1,)
   assert tok[0] == int(np.argmax(out[0, -1]))
+
+
+async def test_sidecar_int8_quantized_close_to_fp32(llama_dir, monkeypatch):
+  """XOT_SIDECAR_QUANT=int8: the sidecar quantizes its linears to int8 at
+  load (per-out-row scales, 4x less resident weight memory + bandwidth).
+  Logits must stay within int8 rounding distance of the fp32 sidecar and
+  agree on the greedy next token."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  shard = Shard("tiny-llama", 0, n - 1, n)
+  tokens = np.array([[5, 9, 42, 7, 101, 3]], dtype=np.int64)
+
+  engine = make_engine(llama_dir)
+  try:
+    ref, _ = await engine.infer_tensor("req-f32", shard, tokens)
+  finally:
+    await engine.stop()
+
+  monkeypatch.setenv("XOT_SIDECAR_QUANT", "int8")
+  qengine = make_engine(llama_dir)
+  try:
+    got, _ = await qengine.infer_tensor("req-q8", shard, tokens)
+  finally:
+    await qengine.stop()
+
+  rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+  # Nonzero delta proves the quantized path actually ran (a sidecar that
+  # ignored the flag would be bit-identical and pass the bounds trivially).
+  assert 0.0 < rel < 0.05, f"int8 sidecar rel L2 {rel:.5f} outside (0, 0.05)"
+  assert int(got[0, -1].argmax()) == int(ref[0, -1].argmax())
