@@ -142,3 +142,29 @@ async def test_midshard_scan_prefill_hidden_equality(tiny_model_dir, monkeypatch
   h_on, _ = await on_eng.infer_tensor("r", first, prompt)
 
   np.testing.assert_allclose(h_on, h_off, atol=1e-4, rtol=1e-3)
+
+
+async def test_scan_prefill_composes_with_prefix_cache(tiny_model_dir, monkeypatch):
+  """A prefix-cache hit seeds the cache at pos>0; the scan path must fill
+  the remaining FULL segments from that offset (prefill_scan at arbitrary
+  q_start) and produce the same greedy token as the scan-off engine."""
+  import numpy as np
+
+  common = list(np.arange(4 * 32) % 250)  # 4 full segments of shared prefix
+  p1 = np.array([common + [7, 9, 11]], dtype=np.int64)
+  p2 = np.array([common + list(np.arange(3 * 32) % 199) + [5]], dtype=np.int64)
+
+  async def run(scan: bool):
+    eng = _engine(tiny_model_dir, monkeypatch, scan=scan,
+                  XOT_PREFIX_CACHE="2", XOT_PREFIX_CACHE_MIN="8")
+    n = TINY_LLAMA_CFG["num_hidden_layers"]
+    shard = Shard("m", 0, n - 1, n)
+    t1, _ = await eng.infer_sample_tensor("ra", shard, p1, temp=0.0)
+    # Second request shares the 128-token prefix: seeds from the snapshot,
+    # then prefills its 97-token suffix (3 full segments + tail) at pos>0.
+    t2, _ = await eng.infer_sample_tensor("rb", shard, p2, temp=0.0)
+    return int(t1), int(t2)
+
+  on = await run(True)
+  off = await run(False)
+  assert on == off, f"prefix-cache + scan-prefill diverged: {on} != {off}"
