@@ -133,3 +133,52 @@ def test_registry_resolution(monkeypatch):
   assert registry.adapter_path("fin") == "/tmp/fin.safetensors"
   assert registry.adapter_path("med") == "/tmp/med"
   assert registry.adapter_path("nope") is None
+
+
+async def test_models_endpoint_lists_adapters(tiny_model_dir, tmp_path, monkeypatch):
+  """/v1/models advertises registered adapters as base@name variants of the
+  server's default model (discoverable by tinychat and API clients)."""
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  from tests.test_orchestration import _caps, _make_node
+
+  ckpt = _make_adapter(tmp_path / "fin.safetensors", seed=4)
+  monkeypatch.setenv("XOT_ADAPTERS", f"fin={ckpt}")
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("api-lora", engine)
+  node.topology.update_node("api-lora", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.get("/v1/models")
+    assert resp.status == 200
+    data = (await resp.json())["data"]
+    ids = [m["id"] for m in data]
+    assert "synthetic-tiny" in ids
+    assert "synthetic-tiny@fin" in ids
+    variant = next(m for m in data if m["id"] == "synthetic-tiny@fin")
+    assert variant["adapter_of"] == "synthetic-tiny"
+  finally:
+    await client.close()
+
+
+async def test_delete_refuses_adapter_ids(tiny_model_dir, tmp_path, monkeypatch):
+  """DELETE /v1/models/base@name must refuse: the id resolves to the BASE
+  repo, so deleting it would rmtree the weights every adapter shares."""
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  from tests.test_orchestration import _caps, _make_node
+
+  engine = JAXShardInferenceEngine()
+  node = await _make_node("api-lora-del", engine)
+  node.topology.update_node("api-lora-del", _caps())
+  api = ChatGPTAPI(node, "JAXShardInferenceEngine", default_model="synthetic-tiny")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.delete("/v1/models/synthetic-tiny@fin")
+    assert resp.status == 400
+    assert "adapter" in (await resp.json())["detail"]
+  finally:
+    await client.close()

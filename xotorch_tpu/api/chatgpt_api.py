@@ -227,6 +227,21 @@ class ChatGPTAPI:
       for model_id, card in model_cards.items()
       if self.inference_engine_classname in card.get("repo", {})
     ]
+    # Multi-LoRA serving: registered adapters (XOT_ADAPTERS) are selectable
+    # models in their own right. The registry format does not bind an
+    # adapter to a base, so variants are advertised under the server's
+    # DEFAULT model (the deployment they were registered for); any
+    # compatible base still accepts base@name directly. One shared parser
+    # (registry.registered_adapters) keeps this list and the engine's
+    # resolution in agreement.
+    from xotorch_tpu.models.registry import registered_adapters
+    base = self.default_model
+    if any(m["id"] == base for m in models):
+      models += [
+        {"id": f"{base}@{name}", "object": "model", "owned_by": "xotorch", "ready": True,
+         "adapter_of": base}
+        for name in registered_adapters()
+      ]
     return web.json_response({"object": "list", "data": models})
 
   async def handle_model_support(self, request):
@@ -258,6 +273,15 @@ class ChatGPTAPI:
 
   async def handle_delete_model(self, request):
     model_name = request.match_info["model_name"]
+    from xotorch_tpu.models.registry import split_adapter
+    if split_adapter(model_name)[1] is not None:
+      # An adapter id resolves to the BASE repo via get_repo — deleting it
+      # would rmtree the base weights every other adapter shares. Adapters
+      # are registered via XOT_ADAPTERS, not downloaded; refuse loudly.
+      return web.json_response(
+        {"detail": f"{model_name} is a LoRA adapter variant; deleting it would "
+                   "remove the shared base weights. Unregister it from "
+                   "XOT_ADAPTERS instead."}, status=400)
     if self.node.shard_downloader is None:
       return web.json_response({"detail": "No downloader"}, status=400)
     delete = getattr(self.node.shard_downloader, "delete_model", None)

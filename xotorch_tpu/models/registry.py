@@ -146,15 +146,24 @@ def split_adapter(model_id: str) -> tuple:
   return (base, name) if sep and name else (model_id, None)
 
 
-def adapter_path(name: str) -> Optional[str]:
-  """Resolve a registered adapter name to its checkpoint path via
-  XOT_ADAPTERS ('name=/path/to/adapter.safetensors,name2=/dir')."""
+def registered_adapters() -> Dict[str, str]:
+  """The XOT_ADAPTERS registry ('name=/path/to/adapter.safetensors,
+  name2=/dir') as {name: path}. The ONE parser — the API's model listing
+  and the engine's resolution must agree on what counts as registered
+  (whitespace-tolerant; empty names dropped)."""
   import os
+  out: Dict[str, str] = {}
   for entry in os.getenv("XOT_ADAPTERS", "").split(","):
-    key, sep, path = entry.strip().partition("=")
-    if sep and key == name:
-      return path
-  return None
+    key, sep, path = entry.partition("=")
+    key, path = key.strip(), path.strip()
+    if sep and key and path:
+      out[key] = path
+  return out
+
+
+def adapter_path(name: str) -> Optional[str]:
+  """Resolve a registered adapter name to its checkpoint path."""
+  return registered_adapters().get(name)
 
 
 def get_model_card(model_id: str) -> Optional[Dict]:
