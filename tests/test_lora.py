@@ -150,8 +150,10 @@ async def test_lora_coordinate_save_resume_roundtrip(tiny_model_dir, monkeypatch
     await eng.train_example(f"it{i}", shard, inputs, targets, lengths)
   await node.coordinate_save(shard, 4, str(tmp_path))
 
-  saved = list((tmp_path / "m").glob("*.safetensors"))
-  assert len(saved) == 1 and saved[0].name == "0-3-4.safetensors"
+  saved = sorted(p.name for p in (tmp_path / "m").glob("*.safetensors"))
+  # Adapter save + its AdamW moments for training resume (train/optstate.py;
+  # the moments are named after the specific save they belong to).
+  assert saved == ["0-3-4-opt.safetensors", "0-3-4.safetensors"], saved
 
   prompt = np.array([[1, 5, 9, 2]], dtype=np.int64)
   want, _ = await eng.infer_tensor("r", shard, prompt)
@@ -361,3 +363,53 @@ async def test_qlora_over_int8_base_end_to_end(tiny_model_dir, monkeypatch, tmp_
   assert is_quantized(fresh.params)
   got, _ = await fresh.infer_tensor("r", shard, prompt)
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+async def test_optimizer_state_resume_matches_uninterrupted(tiny_model_dir, monkeypatch, tmp_path):
+  """save/load_checkpoint persist the AdamW moments (train/optstate.py):
+  train 2 steps -> save -> FRESH engine -> load -> 2 more steps must land
+  exactly where 4 uninterrupted steps do. Without the moments the resumed
+  run re-warms Adam from zero and the trajectories diverge."""
+  inputs, targets, lengths = _batch()
+  shard = _full_shard()
+  ckpt_dir = tmp_path / "resume"
+  ckpt_dir.mkdir()
+
+  # Uninterrupted reference: 4 steps.
+  ref = _engine(tiny_model_dir, monkeypatch, rank=2)
+  for i in range(4):
+    await ref.train_example(f"ref{i}", shard, inputs, targets, lengths)
+  ref_adapters = {k: np.asarray(v) for k, v in ref.params["layers"].items()
+                  if k.startswith("lora_")}
+
+  # Interrupted: 2 steps, save (adapters + moments), resume in a fresh
+  # engine, 2 more steps.
+  a = _engine(tiny_model_dir, monkeypatch, rank=2)
+  for i in range(2):
+    await a.train_example(f"a{i}", shard, inputs, targets, lengths)
+  await a.save_checkpoint(shard, str(ckpt_dir / f"{shard.start_layer}-{shard.end_layer}-1.safetensors"))
+  opt_file = ckpt_dir / f"{shard.start_layer}-{shard.end_layer}-1-opt.safetensors"
+  assert opt_file.exists(), "optimizer moments were not saved"
+
+  b = _engine(tiny_model_dir, monkeypatch, rank=2)
+  await b.load_checkpoint(shard, str(ckpt_dir))
+  assert b._contexts[shard].opt_state is not None, "moments were not restored"
+  for i in range(2):
+    await b.train_example(f"b{i}", shard, inputs, targets, lengths)
+
+  for k, want in ref_adapters.items():
+    np.testing.assert_allclose(np.asarray(b.params["layers"][k]), want,
+                               atol=1e-5, rtol=1e-4, err_msg=k)
+
+  # Control: a resume WITHOUT the moments (file removed) must diverge —
+  # otherwise this test would pass even if restore were a no-op.
+  opt_file.unlink()
+  c = _engine(tiny_model_dir, monkeypatch, rank=2)
+  await c.load_checkpoint(shard, str(ckpt_dir))
+  for i in range(2):
+    await c.train_example(f"c{i}", shard, inputs, targets, lengths)
+  cold = any(
+    not np.allclose(np.asarray(c.params["layers"][k]), ref_adapters[k], atol=1e-5)
+    for k in ref_adapters
+  )
+  assert cold, "cold-restart trajectory matched the warm one — vacuous test"

@@ -2295,16 +2295,18 @@ class JAXShardInferenceEngine(InferenceEngine):
       return None
     sid = f"{shard.start_layer}-{shard.end_layer}"
     mine = sorted(
-      path.glob(f"{sid}-*.safetensors"),
+      (p for p in path.glob(f"{sid}-*.safetensors") if not p.stem.endswith("-opt")),
       key=lambda p: int(p.stem.rsplit("-", 1)[-1]) if p.stem.rsplit("-", 1)[-1].isdigit() else -1,
     )
     if mine:
       return mine[-1]
     # Never fall back to ANOTHER shard's save (a `{start}-{end}-{iter}` file
-    # for a different layer range would load garbage or KeyError); only
-    # non-shard-patterned files qualify as a generic fallback.
+    # for a different layer range would load garbage or KeyError) or to an
+    # optimizer-moments file ('*-opt.safetensors', train/optstate.py — its
+    # opt.{i} keys are not weights); only non-shard-patterned weight files
+    # qualify as a generic fallback.
     rest = sorted(p for p in path.glob("*.safetensors")
-                  if not SHARD_SAVE_RE.fullmatch(p.stem))
+                  if not SHARD_SAVE_RE.fullmatch(p.stem) and not p.stem.endswith("-opt"))
     return rest[0] if rest else None
 
   @staticmethod
@@ -2324,6 +2326,12 @@ class JAXShardInferenceEngine(InferenceEngine):
   async def load_checkpoint(self, shard: Shard, path: str) -> None:
     ctx = await self._ensure_ctx(shard)
 
+    # The moments file a resume may restore — set ONLY by the branches that
+    # load a trained save as-is (single adapter file, explicit shard save):
+    # a base reload or a multi-piece re-partition merge lands at a different
+    # parameter point than any one save's moments.
+    resume = {"opt": None}
+
     def _load():
       import jax
       from xotorch_tpu.train import lora as lora_mod
@@ -2332,6 +2340,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       ckpt = self._checkpoint_file_for(p, ctx.shard)
       if ckpt is not None and lora_mod.is_lora_checkpoint(ckpt):
         # Adapter-only checkpoint: merge into the (already loaded) base.
+        resume["opt"] = self._opt_state_file(ckpt, ctx.shard)
         return lora_mod.load_lora_checkpoint(ctx.params, ctx.shard, ckpt)
       if p.is_dir():
         # Re-partitioned resume: no save matches this exact layer range, but
@@ -2351,6 +2360,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       if explicit:
         params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype(),
                                    checkpoint_file=ckpt)
+        resume["opt"] = self._opt_state_file(ckpt, ctx.shard)
       elif (model_dir / "model.safetensors.index.json").exists() or (model_dir / "model.safetensors").exists():
         params = load_shard_params(model_dir, ctx.cfg, ctx.shard, dtype=self._dtype())
       elif ckpt is not None:
@@ -2374,11 +2384,38 @@ class JAXShardInferenceEngine(InferenceEngine):
         rank = int(ctx.params["layers"][lora_a_keys[0]].shape[-1])
         targets = tuple(k[len("lora_"):-len("_a")] for k in lora_a_keys)
         params = lora_mod.add_lora_params(params, rank, jax.random.PRNGKey(self._seed), targets)
+        # FRESH random adapters: any saved moments belong to a different
+        # parameter point — shapes would match, values would mislead.
+        resume["opt"] = None
       return params
 
-    ctx.params = await self._run(_load, oom_as_cache_exhausted=False)
-    ctx.opt_state = None  # optimizer state is invalid for reloaded weights
-    ctx.prefix_cache.clear()  # snapshots were computed under the old weights
+    def _load_and_restore():
+      # Params swap, optimizer reset, AND moments restore in ONE executor
+      # task: a second await window between them would let an interleaved
+      # train_example advance the fresh params before the checkpoint's
+      # moments land — params one step past the checkpoint with moments AT
+      # it. Every pos/params/opt mutation is serialized on this executor.
+      ctx.params = _load()
+      ctx.opt_state = None  # optimizer state is invalid for reloaded weights
+      ctx.prefix_cache.clear()  # snapshots were computed under the old weights
+
+      # Training resume: restore the moments saved WITH the checkpoint that
+      # was just loaded (the file name ties them — rolling back to
+      # iteration 2 never picks up iteration 4's moments). Any failure
+      # keeps the cold state: a truncated/mismatched moments file must
+      # never block loading perfectly valid weights.
+      opt_file = resume["opt"]
+      if (opt_file is not None and opt_file.exists()
+          and os.getenv("XOT_SAVE_OPT_STATE", "1") == "1"):
+        from xotorch_tpu.train.optstate import load_opt_state
+        self._ensure_optimizer(ctx)
+        try:
+          ctx.opt_state = load_opt_state(ctx.opt_state, opt_file)
+        except Exception as e:
+          print(f"optimizer state not restored ({e!r}); training resumes cold")
+          ctx.opt_state = None
+
+    await self._run(_load_and_restore, oom_as_cache_exhausted=False)
 
   async def save_checkpoint(self, shard: Shard, path: str) -> None:
     ctx = await self._ensure_ctx(shard)
@@ -2399,6 +2436,34 @@ class JAXShardInferenceEngine(InferenceEngine):
       save_shard_params(params, ctx.cfg, ctx.shard, Path(path))
 
     await self._run(_save, oom_as_cache_exhausted=False)
+
+    # Optimizer moments ride alongside (training resume without them
+    # restarts AdamW cold — the first steps after every restart regress).
+    # XOT_SAVE_OPT_STATE=0 opts out for inference-only checkpoints — and
+    # then any stale paired moments file is REMOVED: overwriting the
+    # weights while leaving an older save's moments next to them would
+    # pair moments from a different parameter point on the next resume.
+    opt_file = self._opt_state_file(Path(path), ctx.shard)
+
+    def _save_opt():
+      if ctx.opt_state is not None and os.getenv("XOT_SAVE_OPT_STATE", "1") == "1":
+        from xotorch_tpu.train.optstate import save_opt_state
+        save_opt_state(ctx.opt_state, opt_file)
+      elif opt_file.exists():
+        opt_file.unlink()
+
+    await self._run(_save_opt, oom_as_cache_exhausted=False)
+
+  @staticmethod
+  def _opt_state_file(path: Path, shard: Shard) -> Path:
+    """Moments ride NEXT TO the specific checkpoint they belong to
+    ('0-3-4.safetensors' -> '0-3-4-opt.safetensors'): a rollback to an
+    earlier save must never restore a later save's moments. Checkpoint
+    paths are concrete .safetensors files on both the save and load sides
+    (save_file requires one; load resolves via _checkpoint_file_for)."""
+    if path.suffix != ".safetensors":
+      raise ValueError(f"checkpoint path must be a .safetensors file, got {path}")
+    return path.with_name(path.stem + "-opt.safetensors")
 
   # -------------------------------------------------------------- training
 

@@ -1,0 +1,59 @@
+"""Optimizer-state persistence for training resume.
+
+A resumed fine-tune that re-initializes AdamW restarts with zero moments —
+the first steps after every restart are effectively un-adapted SGD and the
+loss trajectory jumps. The reference could not resume at all (its engine
+save_checkpoint was a no-op, inference_engine.py:34-41); here the moments
+ride alongside the weight/adapter checkpoint as one flat safetensors file.
+
+Format: leaves of the optax state in tree-flatten order, keyed "opt.{i}".
+Restore is SHAPE-CHECKED against a freshly initialized state over the
+loaded parameters — a checkpoint from a different optimizer, rank, or
+model shape refuses loudly instead of silently mis-applying moments.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def save_opt_state(opt_state: Any, path) -> None:
+  import jax
+  import jax.numpy as jnp
+  from safetensors.flax import save_file
+
+  leaves = jax.tree_util.tree_leaves(opt_state)
+  tensors = {f"opt.{i}": jnp.asarray(leaf) for i, leaf in enumerate(leaves)}
+  save_file(tensors, str(path))
+
+
+def load_opt_state(template: Any, path) -> Any:
+  """Rebuild `template`'s tree with the saved leaves. `template` must be a
+  freshly initialized state over the SAME trainable tree (the engine calls
+  optimizer.init first) — leaf count and shapes are verified."""
+  import jax
+  import jax.numpy as jnp
+  from safetensors import safe_open
+
+  leaves, treedef = jax.tree_util.tree_flatten(template)
+  with safe_open(str(path), framework="np") as f:
+    saved = {name: f.get_tensor(name) for name in f.keys()}
+  if len(saved) != len(leaves):
+    raise ValueError(
+      f"optimizer checkpoint {path} has {len(saved)} leaves; the current "
+      f"optimizer state has {len(leaves)} — different optimizer or model")
+  new_leaves = []
+  for i, leaf in enumerate(leaves):
+    t = saved.get(f"opt.{i}")
+    want = tuple(getattr(leaf, "shape", ()))
+    if t is None or tuple(t.shape) != want:
+      raise ValueError(
+        f"optimizer checkpoint {path}: leaf {i} shape "
+        f"{None if t is None else tuple(t.shape)} != expected {want}")
+    if jnp.dtype(t.dtype) != jnp.dtype(leaf.dtype):
+      # A dtype change (different compute dtype, optimizer config) means a
+      # different training setup — refuse rather than silently truncate.
+      raise ValueError(
+        f"optimizer checkpoint {path}: leaf {i} dtype {t.dtype} != "
+        f"expected {jnp.dtype(leaf.dtype)}")
+    new_leaves.append(jnp.asarray(t))
+  return jax.tree_util.tree_unflatten(treedef, new_leaves)
