@@ -185,3 +185,48 @@ async def test_frequency_penalty_exact_over_fused_chunks(tiny_model_dir):
     out = await plain_eng.generate_chunk("q", FULL, plain[-1], size, temp=0.0, top_k=0)
     plain.extend(int(t) for t in out)
   assert plain != got
+
+
+def test_min_p_mask_math():
+  """Op-level min-p: tokens below min_p * max-prob are masked; min_p=1.0
+  leaves only the argmax token so sampling at any temperature is
+  deterministic; min_p=None leaves the executables untouched."""
+  import jax
+  import jax.numpy as jnp
+  from xotorch_tpu.ops.sampling import sample_logits
+
+  logits = jnp.asarray([[2.0, 1.9, 0.0, -3.0]], jnp.float32)
+  key = jax.random.PRNGKey(0)
+  # min_p=1.0: only the max-prob token survives regardless of temperature.
+  for seed in range(5):
+    tok = sample_logits(logits, jax.random.PRNGKey(seed), temp=1.0, top_k=0,
+                        min_p=1.0)
+    assert int(tok[0]) == 0
+  # A mid cutoff keeps {0, 1} (p1/p0 = e^-0.1 ~ 0.90) and excludes the rest.
+  seen = {int(sample_logits(logits, jax.random.PRNGKey(s), temp=1.0, top_k=0,
+                            min_p=0.5)[0]) for s in range(64)}
+  assert seen <= {0, 1} and len(seen) == 2
+
+
+async def test_min_p_one_matches_greedy_through_api(tiny_model_dir):
+  """Serving path: min_p=1.0 at temperature 1.0 must reproduce the greedy
+  stream exactly (only the max-prob token ever survives the floor) — the
+  crisp end-to-end determinism check for the extras plumbing."""
+  greedy = _engine(tiny_model_dir)
+  tok, _ = await greedy.infer_sample_tensor("g", FULL, PROMPT, temp=0.0, top_k=0)
+  want = [int(tok)]
+  for _ in range(6):
+    tok, _ = await greedy.infer_sample_tensor("g", FULL,
+                                              np.asarray([[want[-1]]]), temp=0.0, top_k=0)
+    want.append(int(tok))
+
+  eng = _engine(tiny_model_dir)
+  tok, _ = await eng.infer_sample_tensor("m", FULL, PROMPT, temp=1.0, top_k=0,
+                                         sampling={"min_p": 1.0})
+  got = [int(tok)]
+  for _ in range(6):
+    tok, _ = await eng.infer_sample_tensor("m", FULL, np.asarray([[got[-1]]]),
+                                           temp=1.0, top_k=0,
+                                           sampling={"min_p": 1.0})
+    got.append(int(tok))
+  assert got == want, f"min_p=1 stream {got} != greedy {want}"

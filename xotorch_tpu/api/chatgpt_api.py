@@ -452,6 +452,17 @@ class ChatGPTAPI:
           {"error": {"type": "invalid_request_error",
                      "message": f"seed must be a 64-bit integer, got {seed!r}"}}, status=400)
       sampling["seed"] = seed
+    min_p = data.get("min_p")
+    if min_p is not None:
+      # min-p sampling (vLLM/llama.cpp extension; arXiv 2407.01082): a
+      # probability floor relative to the max-prob token.
+      if isinstance(min_p, bool) or not isinstance(min_p, (int, float)) or not (0 <= min_p <= 1):
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": f"min_p must be a number in [0, 1], got {min_p!r}"}},
+          status=400)
+      if min_p:
+        sampling["min_p"] = float(min_p)
     for pen_key in ("presence_penalty", "frequency_penalty"):
       pen = data.get(pen_key)
       if pen is not None:

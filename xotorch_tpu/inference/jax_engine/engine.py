@@ -772,6 +772,13 @@ class JAXShardInferenceEngine(InferenceEngine):
       "seed": sampling.get("seed"),
       "presence": float(sampling.get("presence_penalty") or 0.0),
       "frequency": float(sampling.get("frequency_penalty") or 0.0),
+      # min-p: None keeps every existing executable untouched (static
+      # presence in ops/sampling); the value itself is traced. Riding the
+      # extras lane is a DELIBERATE conservative choice: min_p requests
+      # decode in their own fused chunk (no continuous batching) — a [B]
+      # per-row vector through the batched executables would lift that, at
+      # the cost of an always-on softmax in every user's decode step.
+      "min_p": float(sampling["min_p"]) if sampling.get("min_p") else None,
       "bias": None, "counts": None,
     }
     lb = sampling.get("logit_bias")
@@ -894,6 +901,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       start_layer=ctx.shard.start_layer, moe_routed=self._moe_routed_for(ctx),
       bias=e.get("bias"), counts=e.get("counts"),
       presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+      min_p=e.get("min_p"),
       top_lp=-1 if want_lp is None else int(want_lp),
     )
     if want_lp is not None:
@@ -1794,6 +1802,7 @@ class JAXShardInferenceEngine(InferenceEngine):
           moe_routed=self._moe_routed_for(ctx),
           bias=e.get("bias"), counts=e.get("counts"),
           presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+          min_p=e.get("min_p"),
           top_lp=-1 if want_lp is None else int(want_lp),
         )
         out = list(out)

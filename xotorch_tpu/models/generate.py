@@ -51,6 +51,7 @@ def forward_sample(
   frequency: float = 0.0,
   top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
   moe_routed: bool = True,  # static: False when experts shard over 'ep'
+  min_p=None,  # min-p cutoff (traced; None = off) — ops/sampling
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
   ([B] int32 sampled token, updated cache) — with `top_lp >= 0`, instead
@@ -72,10 +73,11 @@ def forward_sample(
   if top_lp >= 0:
     out = sample_logits_logprobs(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p,
                                  bias=bias, counts=counts, presence=presence,
-                                 frequency=frequency, top_lp=top_lp)
+                                 frequency=frequency, top_lp=top_lp, min_p=min_p)
     return out, cache
   tok = sample_logits(logits[:, -1, :], key, temp=temp, top_k=top_k, top_p=top_p,
-                      bias=bias, counts=counts, presence=presence, frequency=frequency)
+                      bias=bias, counts=counts, presence=presence, frequency=frequency,
+                      min_p=min_p)
   return tok, cache
 
 
@@ -103,6 +105,7 @@ def decode_chunk(
   frequency: float = 0.0,
   top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
   moe_routed: bool = True,  # static: False when experts shard over 'ep'
+  min_p=None,  # min-p cutoff (traced; None = off) — ops/sampling
 ):
   """Generate `num_tokens` tokens in one device program.
 
@@ -133,12 +136,13 @@ def decode_chunk(
     if want_lp:
       nxt, lp, top_ids, top_lps = sample_logits_logprobs(
         logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p,
-        bias=bias, counts=step_counts, presence=presence, frequency=frequency, top_lp=top_lp)
+        bias=bias, counts=step_counts, presence=presence, frequency=frequency,
+        top_lp=top_lp, min_p=min_p)
       ys = (nxt, lp, top_ids, top_lps)
     else:
       nxt = sample_logits(logits[:, -1, :], sub, temp=temp, top_k=top_k, top_p=top_p,
                           bias=bias, counts=step_counts,
-                          presence=presence, frequency=frequency)
+                          presence=presence, frequency=frequency, min_p=min_p)
       ys = nxt
     if track_counts:
       rows = jnp.arange(counts.shape[0], dtype=jnp.int32)

@@ -41,6 +41,7 @@ def sample_logits(
   counts: jnp.ndarray = None,  # [B, V] int32 token counts of the text so far
   presence: float = 0.0,  # OpenAI presence_penalty (scalar or [B], traced)
   frequency: float = 0.0,  # OpenAI frequency_penalty (scalar or [B], traced)
+  min_p: float = None,  # min-p cutoff in (0, 1]; None = off (presence static)
 ) -> jnp.ndarray:
   """Returns [B] int32 sampled token ids.
 
@@ -72,6 +73,15 @@ def sample_logits(
     cutoff_idx = jnp.sum(cumulative < top_p, axis=-1, keepdims=True)
     cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
     logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+  if min_p is not None:
+    # min-p (arXiv 2407.01082; the vLLM/llama.cpp extension): keep tokens
+    # whose post-temperature probability is at least min_p * max prob — the
+    # cutoff ADAPTS to the distribution's confidence where top-p keeps a
+    # fixed mass. Presence is static (None = untouched executables); the
+    # value is traced, riding the sampling-extras path like penalties.
+    probs = jax.nn.softmax(logits, axis=-1)
+    cutoff = jnp.asarray(min_p, jnp.float32) * jnp.max(probs, axis=-1, keepdims=True)
+    logits = jnp.where(probs < cutoff, -jnp.inf, logits)
   # Gumbel-max sampling (same estimator as the reference's exponential trick).
   gumbel = jax.random.gumbel(key, logits.shape, dtype=jnp.float32)
   sampled = jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)
@@ -90,6 +100,7 @@ def sample_logits_logprobs(
   presence: float = 0.0,
   frequency: float = 0.0,
   top_lp: int = 0,  # static: how many top alternatives to report (0..20)
+  min_p: float = None,
 ):
   """sample_logits plus OpenAI logprob reporting, one dispatch: returns
   (tok [B] int32, lp [B] fp32, top_ids [B, top_lp] int32,
@@ -101,7 +112,7 @@ def sample_logits_logprobs(
   probabilities. top_lp == 0 returns empty [B, 0] alternative arrays (the
   OpenAI `logprobs: true` without `top_logprobs` shape)."""
   adj = _penalized(logits, bias, counts, presence, frequency)
-  tok = sample_logits(adj, key, temp=temp, top_k=top_k, top_p=top_p)
+  tok = sample_logits(adj, key, temp=temp, top_k=top_k, top_p=top_p, min_p=min_p)
   logp = jax.nn.log_softmax(adj.astype(jnp.float32), axis=-1)
   lp = jnp.take_along_axis(logp, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
   if top_lp > 0:

@@ -676,6 +676,9 @@ class Node:
     # so it would record no logprob entries for accepted drafts). A seed
     # alone is irrelevant at temp==0 (greedy is already deterministic), so
     # seed-only requests keep the speculation fast path.
+    # min_p is exempt like seed: speculation only runs at temp==0, where
+    # the argmax always satisfies the floor (p_max >= min_p * p_max) — the
+    # mask provably cannot change greedy output.
     reshaping = set(self._request_sampling.get(request_id, ())) & {
       "presence_penalty", "frequency_penalty", "logit_bias", "logprobs"}
     spec_wanted = (self.speculate_tokens > 0 and self._temp_for(request_id) == 0
