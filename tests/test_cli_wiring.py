@@ -134,3 +134,30 @@ def test_serve_flags_zero_reaches_engine(monkeypatch):
   build_node(args)
   assert os.environ["XOT_SERVE_TP"] == "0"
   assert os.environ["XOT_SERVE_SP"] == "0"
+
+
+async def test_eval_model_cli_reports_mean_loss(capsys):
+  """xot eval: iterates the test split through node.enqueue_example with
+  train=False and prints the mean loss — the reference's eval command
+  crashed at the engine boundary (no engine implemented evaluate;
+  SURVEY §0 dead-code table)."""
+  from xotorch_tpu.main import eval_model_cli
+
+  engine = DummyInferenceEngine()
+  seen = []
+
+  node = await _make_node("eval-node", engine)
+  node.topology.update_node("eval-node", _caps())
+  orig = node.enqueue_example
+
+  async def record(shard, ex, tgt, lengths, train=True):
+    seen.append(train)
+    return await orig(shard, ex, tgt, lengths, train=train)
+
+  node.enqueue_example = record
+  args = argparse.Namespace(data="xotorch_tpu/train/data/lora", batch_size=1,
+                            sequence_length=32)
+  await eval_model_cli(node, "DummyInferenceEngine", "dummy", args)
+  out = capsys.readouterr().out
+  assert "eval loss:" in out, out
+  assert seen and all(t is False for t in seen), "eval must never train"
