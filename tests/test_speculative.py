@@ -230,3 +230,74 @@ async def test_node_draft_model_stream_identical(tiny_model_dir, monkeypatch):
   got, eng = await generate("m")
   assert got == want, f"draft-model stream diverged: {got} != {want}"
   assert eng._spec_accepted > 0, "no model drafts were accepted"
+
+
+async def test_draft_model_stands_down_under_concurrency(tiny_model_dir, monkeypatch):
+  """With more than one outstanding request the node must NOT call the
+  draft model (per-request draft forwards would serialize extra executor
+  work the shared batched decode already amortizes); each concurrent
+  stream must equal its solo no-speculation reference."""
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  _register_card(monkeypatch, "m", n)
+  monkeypatch.setenv("XOT_DRAFT_MODEL", "m")
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}), dtype="float32")
+  node = Node(
+    "conc-draft", _NullServer(), eng, _NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=12, default_sample_temp=0.0, decode_chunk_size=4,
+  )
+  node.device_capabilities = DeviceCapabilities("t", "c", 1024, DeviceFlops(1, 2, 4))
+  node.topology.update_node(node.id, node.device_capabilities)
+
+  draft_calls = []
+  orig_draft = eng.draft_tokens
+
+  async def spy(rid, ctx_tokens, k):
+    draft_calls.append((rid, len(node.outstanding_requests)))
+    return await orig_draft(rid, ctx_tokens, k)
+
+  eng.draft_tokens = spy
+
+  done = {}
+  out = {}
+
+  def on_token(rid, tokens, fin):
+    out[rid] = list(tokens)
+    if fin and rid in done:
+      done[rid].set()
+
+  node.on_token.register("t").on_next(on_token)
+  shard = Shard("m", 0, n - 1, n)
+  done["ra"], done["rb"] = asyncio.Event(), asyncio.Event()
+  await asyncio.gather(
+    node.process_prompt(shard, "one two three", "ra"),
+    node.process_prompt(shard, "four five six seven", "rb"),
+  )
+  await asyncio.wait_for(asyncio.gather(done["ra"].wait(), done["rb"].wait()), timeout=60)
+  # Any draft calls that DID happen must have been while the request was
+  # alone; none with 2 outstanding.
+  assert all(n_out <= 1 for _, n_out in draft_calls), draft_calls
+
+  # Output parity: each concurrent stream equals a solo no-speculation run.
+  monkeypatch.delenv("XOT_DRAFT_MODEL", raising=False)
+  for prompt, rid in (("one two three", "ra"), ("four five six seven", "rb")):
+    solo_eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}),
+                                       dtype="float32")
+    solo = Node(f"solo-{rid}", _NullServer(), solo_eng, _NoDiscovery(), None,
+                RingMemoryWeightedPartitioningStrategy(),
+                max_generate_tokens=12, default_sample_temp=0.0, decode_chunk_size=4)
+    solo.device_capabilities = DeviceCapabilities("t", "c", 1024, DeviceFlops(1, 2, 4))
+    solo.topology.update_node(solo.id, solo.device_capabilities)
+    sdone = asyncio.Event()
+    sout = {}
+
+    def on_solo(srid, tokens, fin, _sout=sout, _sdone=sdone, _want=f"solo-{rid}-req"):
+      if srid == _want:
+        _sout["tokens"] = list(tokens)
+        if fin:
+          _sdone.set()
+
+    solo.on_token.register("s").on_next(on_solo)
+    await solo.process_prompt(shard, prompt, f"solo-{rid}-req")
+    await asyncio.wait_for(sdone.wait(), timeout=60)
+    assert out[rid] == sout["tokens"], f"{rid}: {out[rid]} != solo {sout['tokens']}"

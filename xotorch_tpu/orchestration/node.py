@@ -718,6 +718,13 @@ class Node:
           k = min(self.speculate_tokens, remaining)
           drafter = (getattr(self.inference_engine, "draft_tokens", None)
                      if self.draft_model else None)
+          if drafter is not None and len(self.outstanding_requests) > 1:
+            # Under concurrent load the batcher's shared weight read already
+            # amortizes decode; per-request draft forwards would serialize
+            # EXTRA executor dispatches — the same measured principle that
+            # disables batch-chunk speculation (PERF.md r3: 279 vs 357).
+            # Prompt-lookup below stays (its draft is host-side and free).
+            drafter = None
           draft = list(await drafter(request_id, spec_context, k)) if drafter else []
           if not draft:
             # Prompt-lookup stays the fallback: the draft model may be
