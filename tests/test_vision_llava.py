@@ -248,6 +248,8 @@ def test_projector_activation_from_config():
   # Exact-erf default matches torch's reference GELU.
   import torch
   import torch.nn.functional as F
-  t = torch.from_numpy(np.asarray(feats)) @ torch.from_numpy(np.asarray(pparams["w1"])) + torch.from_numpy(np.asarray(pparams["b1"]))
-  t = F.gelu(t) @ torch.from_numpy(np.asarray(pparams["w2"])) + torch.from_numpy(np.asarray(pparams["b2"]))
+  # np.array (copies) — torch.from_numpy on a jax-backed view is read-only
+  # and warns; a copy keeps the suite warning-free.
+  t = torch.from_numpy(np.array(feats)) @ torch.from_numpy(np.array(pparams["w1"])) + torch.from_numpy(np.array(pparams["b1"]))
+  t = F.gelu(t) @ torch.from_numpy(np.array(pparams["w2"])) + torch.from_numpy(np.array(pparams["b2"]))
   np.testing.assert_allclose(out_gelu, t.numpy(), atol=1e-5)
