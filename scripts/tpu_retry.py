@@ -41,10 +41,17 @@ LONG = {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384", "BENCH_DECODE": "32"}
 # landed — platform == "tpu" alone also matches a stalled partial record
 # (BENCH_TPU_r04_main.json is exactly that: tpu + error + missing stages).
 STEPS: list[tuple[str, dict, str]] = [
-  # The stages the stalled main run never reached (VERDICT r3 #1/#2).
+  # THE driver metric first, in the smallest possible window: short bf16
+  # measure + fused-vs-pertoken ring2, nothing else (~4-6 min on chip).
+  ("ring", {"BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_LONG": "0",
+            "BENCH_QUANT": "", "BENCH_RING": "2", "BENCH_CONCURRENT": "0",
+            "BENCH_DECODE": "32"},
+   "ring2_tok_s"),
+  # The remaining stages the stalled main run never reached (VERDICT r3
+  # #1/#2): int8 flagship + 8-stream concurrent (+ ring2 at full length).
   ("rest", {"BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_LONG": "0",
             "BENCH_QUANT": "int8", "BENCH_RING": "2", "BENCH_CONCURRENT": "8"},
-   "ring2_tok_s"),
+   "int8_tok_s"),
   # Fused scan-prefill headline (VERDICT r3 #5): prefill_mfu_pct with the
   # whole segment loop in one executable, vs the per-segment path.
   ("scan16k", LONG, "prefill_mfu_pct"),
