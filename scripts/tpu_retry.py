@@ -2,17 +2,17 @@
 
 The tunnel dies for hours at a stretch and the one-shot session script
 (`tpu_session.py`) aborts when it does. This loop keeps probing and, each
-time the tunnel answers, runs whichever round-4 measurements are still
+time the tunnel answers, runs whichever round-5 measurements are still
 missing, highest-value first:
 
   1. rest   — the stages the stalled main run never reached: int8 flagship,
               fused ring2, 8-stream concurrent (16k long stage disabled so
               the window is spent on the missing numbers, not re-measuring
-              what BENCH_TPU_r04_main.json already holds)
+              what round 4's BENCH_TPU_r04_main.json already holds)
   2. int4v1..v4 — the Pallas int4 kernel A/B (v4 = W4A8, approximate)
   3. flash sweep — prefill-MFU block-size configs
 
-A step counts as landed once its BENCH_TPU_r04_<tag>.json records
+A step counts as landed once its BENCH_TPU_r05_<tag>.json records
 platform == "tpu". The loop exits when everything has landed.
 
 Usage: nohup python scripts/tpu_retry.py > tpu_retry.log 2>&1 &
@@ -27,7 +27,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PROBE_INTERVAL_S = float(os.getenv("XOT_TPU_PROBE_INTERVAL", "720"))
+PROBE_INTERVAL_S = float(os.getenv("XOT_TPU_PROBE_INTERVAL", "420"))
 
 SHORT = {
   "BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_RING": "",
@@ -39,7 +39,7 @@ LONG = {**SHORT, "BENCH_QUANT": "", "BENCH_LONG": "16384", "BENCH_DECODE": "32"}
 # (tag, env, key_metric) in priority order; tag names the snapshot file and
 # key_metric is the field that must be PRESENT for the step to count as
 # landed — platform == "tpu" alone also matches a stalled partial record
-# (BENCH_TPU_r04_main.json is exactly that: tpu + error + missing stages).
+# (round 4's BENCH_TPU_r04_main.json is exactly that: tpu + error + missing stages).
 STEPS: list[tuple[str, dict, str]] = [
   # THE driver metric first, in the smallest possible window: short bf16
   # measure + fused-vs-pertoken ring2, nothing else (~4-6 min on chip).
@@ -92,7 +92,7 @@ def log(msg: str) -> None:
 
 
 def landed(tag: str, key_metric: str) -> bool:
-  p = REPO / f"BENCH_TPU_r04_{tag}.json"
+  p = REPO / f"BENCH_TPU_r05_{tag}.json"
   if not p.exists():
     return False
   try:
@@ -135,7 +135,7 @@ def run_step(tag: str, extra_env: dict) -> bool:
     return False
   result["session_tag"] = tag
   result["elapsed_s"] = round(time.time() - t0, 1)
-  (REPO / f"BENCH_TPU_r04_{tag}.json").write_text(json.dumps(result, indent=2))
+  (REPO / f"BENCH_TPU_r05_{tag}.json").write_text(json.dumps(result, indent=2))
   ok = result.get("platform") == "tpu"
   log(f"step {tag}: platform={result.get('platform')} tok_s={result.get('value')} "
       f"ring2={result.get('ring2_tok_s')} int8={result.get('int8_tok_s')} "

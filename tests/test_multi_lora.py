@@ -30,14 +30,16 @@ def tiny_model_dir(tmp_path):
   return make_hf_checkpoint(tmp_path, TINY_LLAMA_CFG, seed=7)
 
 
-def _make_adapter(path, seed: int, rank: int = 4):
+def _make_adapter(path, seed: int, rank: int = 4, hf_cfg: dict = None, n_layers: int = None):
   """Write an adapter-only checkpoint with NONZERO a and b (fresh-init
   adapters have b=0 — a zero delta would make the equality tests vacuous)."""
   from xotorch_tpu.models.config import config_from_hf_dict
   from xotorch_tpu.models.transformer import init_random_params
 
-  cfg = config_from_hf_dict(TINY_LLAMA_CFG)
-  params = init_random_params(cfg, N, True, True, jax.random.PRNGKey(0), dtype=jnp.float32)
+  hf_cfg = hf_cfg or TINY_LLAMA_CFG
+  n = n_layers or hf_cfg["num_hidden_layers"]
+  cfg = config_from_hf_dict(hf_cfg)
+  params = init_random_params(cfg, n, True, True, jax.random.PRNGKey(0), dtype=jnp.float32)
   params = lora_mod.add_lora_params(params, rank, jax.random.PRNGKey(seed))
   key = jax.random.PRNGKey(seed + 100)
   layers = dict(params["layers"])
@@ -46,7 +48,7 @@ def _make_adapter(path, seed: int, rank: int = 4):
       key, sub = jax.random.split(key)
       layers[k] = jax.random.normal(sub, layers[k].shape, jnp.float32) * 0.05
   params = {**params, "layers": layers}
-  lora_mod.save_lora_checkpoint(params, Shard("m", 0, N - 1, N), path)
+  lora_mod.save_lora_checkpoint(params, Shard("m", 0, n - 1, n), path)
   return path
 
 
@@ -78,6 +80,53 @@ async def test_adapter_id_serves_and_differs_from_base(tiny_model_dir, tmp_path,
   ctx.params = lora_mod.load_lora_checkpoint(ctx.params, base_shard, ckpt)
   lr, _ = await ref_eng.infer_tensor("rr", base_shard, prompt)
   np.testing.assert_allclose(la, lr, atol=1e-4, rtol=1e-3)
+
+
+async def test_lora_rank_does_not_clobber_adapter(tiny_model_dir, tmp_path, monkeypatch):
+  """ADVICE r4 medium: with --lora-rank set (fresh fine-tune adapters), a
+  'base@name' serving context must still serve the REGISTERED adapter's
+  weights — the fresh random-A/zero-B attach used to overwrite them, and a
+  zero-B adapter contributes nothing, silently serving plain base outputs."""
+  ckpt = _make_adapter(tmp_path / "ad1.safetensors", seed=1)
+  monkeypatch.setenv("XOT_LORA_RANK", "4")
+  eng = _engine(tiny_model_dir, monkeypatch, {"ad1": ckpt})
+  base_shard = Shard("m", 0, N - 1, N)
+  ad_shard = Shard("m@ad1", 0, N - 1, N)
+  prompt = np.array([[1, 5, 9, 200, 17, 3]], dtype=np.int64)
+
+  la, _ = await eng.infer_tensor("ra", ad_shard, prompt)
+
+  # Ground truth: base + checkpoint merge, NO fresh adapters anywhere.
+  monkeypatch.delenv("XOT_LORA_RANK")
+  ref_eng = JAXShardInferenceEngine(LocalShardDownloader({"m": tiny_model_dir}),
+                                    dtype="float32")
+  await ref_eng.ensure_shard(base_shard)
+  ctx = ref_eng._contexts[base_shard]
+  ctx.params = lora_mod.load_lora_checkpoint(ctx.params, base_shard, ckpt)
+  lr, _ = await ref_eng.infer_tensor("rr", base_shard, prompt)
+  np.testing.assert_allclose(la, lr, atol=1e-4, rtol=1e-3)
+
+
+def test_validate_adapter_file(tmp_path):
+  """Header-only compatibility check used by /v1/models (ADVICE r4 low)."""
+  ckpt = _make_adapter(tmp_path / "ok.safetensors", seed=3)
+  assert lora_mod.validate_adapter_file(ckpt, N) is None
+  # Trained for a 3-layer base, listed against a deeper one.
+  err = lora_mod.validate_adapter_file(ckpt, N + 2)
+  assert err is not None and "different base depth" in err
+  # Not an adapter file at all.
+  bad = tmp_path / "junk.safetensors"
+  bad.write_bytes(b"not safetensors")
+  assert "unreadable" in lora_mod.validate_adapter_file(bad, N)
+  # Directory form (registry-documented): resolves shard saves through the
+  # same rule the engine load path uses, validates the union coverage.
+  d = tmp_path / "ckpt_dir"
+  d.mkdir()
+  (d / f"0-{N - 1}-1.safetensors").write_bytes(ckpt.read_bytes())
+  assert lora_mod.validate_adapter_file(d, N) is None
+  empty = tmp_path / "empty_dir"
+  empty.mkdir()
+  assert "no adapter checkpoint files" in lora_mod.validate_adapter_file(empty, N)
 
 
 async def test_adapter_contexts_alias_base_tensors(tiny_model_dir, tmp_path, monkeypatch):
@@ -142,8 +191,13 @@ async def test_models_endpoint_lists_adapters(tiny_model_dir, tmp_path, monkeypa
   from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
   from tests.test_orchestration import _caps, _make_node
 
-  ckpt = _make_adapter(tmp_path / "fin.safetensors", seed=4)
-  monkeypatch.setenv("XOT_ADAPTERS", f"fin={ckpt}")
+  from xotorch_tpu.models.registry import model_cards
+  syn_cfg = model_cards["synthetic-tiny"]["synthetic_config"]
+  ckpt = _make_adapter(tmp_path / "fin.safetensors", seed=4, hf_cfg=syn_cfg)
+  # A second adapter trained for a DIFFERENT base depth: listed, but marked
+  # not-ready with the reason, instead of 500ing at request time (ADVICE r4).
+  bad = _make_adapter(tmp_path / "bad.safetensors", seed=5, hf_cfg=syn_cfg, n_layers=2)
+  monkeypatch.setenv("XOT_ADAPTERS", f"fin={ckpt},bad={bad}")
   engine = JAXShardInferenceEngine()
   node = await _make_node("api-lora", engine)
   node.topology.update_node("api-lora", _caps())
@@ -159,6 +213,9 @@ async def test_models_endpoint_lists_adapters(tiny_model_dir, tmp_path, monkeypa
     assert "synthetic-tiny@fin" in ids
     variant = next(m for m in data if m["id"] == "synthetic-tiny@fin")
     assert variant["adapter_of"] == "synthetic-tiny"
+    assert variant["ready"] is True and "error" not in variant
+    bad_v = next(m for m in data if m["id"] == "synthetic-tiny@bad")
+    assert bad_v["ready"] is False and "different base depth" in bad_v["error"]
   finally:
     await client.close()
 

@@ -221,6 +221,27 @@ class ChatGPTAPI:
     from xotorch_tpu.orchestration.tracing import stop_device_trace
     return web.json_response({"stopped": stop_device_trace()})
 
+  def _adapter_error(self, path: str, n_layers: int):
+    """Cached validate_adapter_file: /v1/models may be polled (tinychat
+    refreshes the list), and re-opening every safetensors header per request
+    would block the event loop on disk I/O for data that only changes when
+    the checkpoint changes. Keyed on the path's (mtime_ns, size) so a
+    rewritten checkpoint or repopulated directory re-validates."""
+    import os as _os
+    from xotorch_tpu.train.lora import validate_adapter_file
+    try:
+      st = _os.stat(path)
+      sig = (n_layers, st.st_mtime_ns, st.st_size)
+    except OSError:
+      sig = (n_layers, None, None)
+    cache = getattr(self, "_adapter_validation_cache", None)
+    if cache is None:
+      cache = self._adapter_validation_cache = {}
+    hit = cache.get(path)
+    if hit is None or hit[0] != sig:
+      cache[path] = hit = (sig, validate_adapter_file(path, n_layers))
+    return hit[1]
+
   async def handle_get_models(self, request):
     models = [
       {"id": model_id, "object": "model", "owned_by": "xotorch", "ready": True}
@@ -234,14 +255,20 @@ class ChatGPTAPI:
     # compatible base still accepts base@name directly. One shared parser
     # (registry.registered_adapters) keeps this list and the engine's
     # resolution in agreement.
-    from xotorch_tpu.models.registry import registered_adapters
+    from xotorch_tpu.models.registry import get_model_card, registered_adapters
     base = self.default_model
     if any(m["id"] == base for m in models):
-      models += [
-        {"id": f"{base}@{name}", "object": "model", "owned_by": "xotorch", "ready": True,
-         "adapter_of": base}
-        for name in registered_adapters()
-      ]
+      n_layers = (get_model_card(base) or {}).get("layers", 0)
+      for name, path in registered_adapters().items():
+        # Header-only shape/coverage check (ADVICE r4): an adapter trained
+        # for a different base is surfaced as ready=False with the reason
+        # here, instead of a request-time 500 deep in load_lora_checkpoint.
+        err = self._adapter_error(path, n_layers) if n_layers else None
+        entry = {"id": f"{base}@{name}", "object": "model", "owned_by": "xotorch",
+                 "ready": err is None, "adapter_of": base}
+        if err is not None:
+          entry["error"] = err
+        models.append(entry)
     return web.json_response({"object": "list", "data": models})
 
   async def handle_model_support(self, request):

@@ -59,8 +59,9 @@ MAX_RESIDENT_REQUESTS = int(os.getenv("XOT_MAX_RESIDENT_REQUESTS", "8"))
 MAX_RESIDENT_MODELS = int(os.getenv("XOT_MAX_RESIDENT_MODELS", "2"))
 
 # coordinate_save file naming: {start}-{end}-{iteration}.safetensors (stem).
-# The single source of truth for every "is this a shard save?" decision.
-SHARD_SAVE_RE = re.compile(r"(\d+)-(\d+)-(\d+)")
+# The single source of truth for every "is this a shard save?" decision
+# (defined beside the save/validate code; engine and API must agree).
+from xotorch_tpu.train.lora import SHARD_SAVE_RE  # noqa: E402
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -1024,7 +1025,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     llama-3.1-70b). Returns [] when drafting is off, capacity is exhausted,
     or the draft model cannot load — callers fall back to plain decode."""
     mid = os.getenv("XOT_DRAFT_MODEL", "")
-    if not mid or k < 2:
+    if not mid or k < 2 or time.monotonic() < getattr(self, "_draft_retry_at", 0.0):
       return []
     from xotorch_tpu.models.registry import build_full_shard
     shard = build_full_shard(mid, self.__class__.__name__)
@@ -1033,9 +1034,15 @@ class JAXShardInferenceEngine(InferenceEngine):
     try:
       ctx = await self._ensure_ctx(shard)
     except Exception as e:
+      cooldown = float(os.getenv("XOT_DRAFT_RETRY_S", "300"))
       if DEBUG >= 1:
-        print(f"draft model {mid} failed to load, disabling drafting: {e!r}")
-      os.environ["XOT_DRAFT_MODEL"] = ""
+        print(f"draft model {mid} failed to load, pausing drafting {cooldown:.0f}s: {e!r}")
+      # Per-engine cooldown, NOT os.environ: clearing the env var would turn
+      # drafting off for every engine in the process (bench ring2, tests)
+      # and erase the operator's configured value; a permanent flag would
+      # never recover from a transient failure (OOM pressure, download
+      # hiccup). Generation proceeds undrafted meanwhile.
+      self._draft_retry_at = time.monotonic() + cooldown
       return []
     return await self._run(self._draft_sync, ctx, self._draft_rid(request_id),
                            list(context_tokens), k)
@@ -2180,8 +2187,11 @@ class JAXShardInferenceEngine(InferenceEngine):
       # LoRA fine-tuning (XOT_LORA_RANK / CLI --lora-rank): adapter tensors
       # join the stacked layers pytree (replicated under a tp mesh — they are
       # rank-r slivers), the base stays frozen via the masked optimizer.
+      # A registered adapter checkpoint already carries its trained lora
+      # leaves — attaching fresh random-A/zero-B ones here would overwrite
+      # them and silently serve plain base outputs.
       lora_rank = int(os.getenv("XOT_LORA_RANK", "0"))
-      if lora_rank > 0:
+      if lora_rank > 0 and adapter_ckpt is None:
         from xotorch_tpu.train.lora import ATTN_SLOTS, MLP_SLOTS, add_lora_params
         targets = ATTN_SLOTS + (MLP_SLOTS if os.getenv("XOT_LORA_TARGETS", "") == "all" else ())
         params = add_lora_params(params, lora_rank, jax.random.PRNGKey(self._seed), targets)
@@ -2321,16 +2331,11 @@ class JAXShardInferenceEngine(InferenceEngine):
   @staticmethod
   def _latest_shard_saves(path: Path) -> list:
     """All `{start}-{end}-{iter}` saves in a directory, latest iteration per
-    layer range — the file set a re-partitioned ring merges adapters from."""
-    best = {}
-    for p in path.glob("*.safetensors"):
-      m = SHARD_SAVE_RE.fullmatch(p.stem)
-      if not m:
-        continue
-      sid, it = f"{m.group(1)}-{m.group(2)}", int(m.group(3))
-      if sid not in best or it > best[sid][0]:
-        best[sid] = (it, p)
-    return [p for _, p in sorted(best.values())]
+    layer range — the file set a re-partitioned ring merges adapters from.
+    Delegates to train.lora so the API's listing validation resolves
+    directories with the SAME rule the load path uses."""
+    from xotorch_tpu.train.lora import adapter_checkpoint_files
+    return adapter_checkpoint_files(path)
 
   async def load_checkpoint(self, shard: Shard, path: str) -> None:
     ctx = await self._ensure_ctx(shard)

@@ -18,6 +18,7 @@ alpha = 2r (scale 2.0), the common default.
 """
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, Iterable, Tuple
 
 import jax
@@ -137,6 +138,93 @@ def save_lora_checkpoint(params: Params, shard, out_path) -> None:
   out_path = Path(out_path)
   out_path.parent.mkdir(parents=True, exist_ok=True)
   save_file(flat, str(out_path))
+
+
+# `{start}-{end}-{iter}` shard-save stem — THE naming rule for sharded
+# adapter/checkpoint saves, shared with the engine's checkpoint code.
+SHARD_SAVE_RE = re.compile(r"(\d+)-(\d+)-(\d+)")
+
+
+def adapter_checkpoint_files(path) -> list:
+  """Resolve a registered adapter path to its checkpoint FILE list — the one
+  dir-to-files rule the engine's load path and the API's listing validation
+  share. A file resolves to itself; a directory resolves to all
+  `{start}-{end}-{iter}` shard saves, latest iteration per layer range (the
+  set a re-partitioned ring merges adapters from)."""
+  from pathlib import Path
+
+  p = Path(path)
+  if not p.is_dir():
+    return [p]
+  best: Dict[str, tuple] = {}
+  for f in p.glob("*.safetensors"):
+    m = SHARD_SAVE_RE.fullmatch(f.stem)
+    if not m:
+      continue
+    sid, it = f"{m.group(1)}-{m.group(2)}", int(m.group(3))
+    if sid not in best or it > best[sid][0]:
+      best[sid] = (it, f)
+  return [f for _, f in sorted(best.values())]
+
+
+def validate_adapter_file(path, n_layers: int) -> str | None:
+  """Listing/registration-time compatibility check for a registered adapter
+  (XOT_ADAPTERS) against a base model's card. Reads only the safetensors
+  HEADER (names + shapes), never tensor data, so it is cheap enough for
+  /v1/models. Returns an error string, or None when compatible.
+
+  `path` may be a single checkpoint file or a directory of shard saves
+  (both registry-documented forms) — directories resolve through the same
+  rule the engine's load path uses, and coverage is checked over the UNION
+  of the resolved file set. Checks everything knowable without loading the
+  base weights: tensor names parse as `lora.layers.{i}.{slot}_{a|b}`, slots
+  are from the known target set, every slot covers layers 0..n_layers-1
+  with BOTH a and b, and all slots agree on one rank. An adapter trained
+  for a different-depth base fails here with a clear message instead of a
+  request-time 500 deep in load_lora_checkpoint (ADVICE r4)."""
+  from safetensors import safe_open
+
+  known = {f"{s}_{ab}" for s in ATTN_SLOTS + MLP_SLOTS for ab in ("a", "b")}
+  files = adapter_checkpoint_files(path)
+  if not files:
+    return f"no adapter checkpoint files under {path}"
+  shapes: Dict[str, tuple] = {}
+  try:
+    for fp in files:
+      with safe_open(str(fp), framework="np") as f:
+        for n in f.keys():
+          shapes[n] = tuple(f.get_slice(n).get_shape())
+  except Exception as e:
+    return f"unreadable adapter checkpoint: {e}"
+  if not shapes:
+    return "adapter checkpoint is empty"
+  per_slot: Dict[str, set] = {}
+  ranks = set()
+  for name, shape in shapes.items():
+    parts = name.split(".", 3)
+    if len(parts) != 4 or parts[0] != "lora" or parts[1] != "layers" or not parts[2].isdigit():
+      return f"not an adapter tensor name: {name!r}"
+    slot = parts[3]
+    if slot not in known:
+      return f"unknown adapter slot {slot!r} (expected one of {sorted(known)})"
+    if len(shape) != 2:
+      return f"{name}: expected 2-D adapter tensor, got shape {shape}"
+    ranks.add(shape[1] if slot.endswith("_a") else shape[0])
+    per_slot.setdefault(slot, set()).add(int(parts[2]))
+  if len(ranks) != 1:
+    return f"inconsistent LoRA rank across tensors: {sorted(ranks)}"
+  want = set(range(n_layers))
+  for slot, got in per_slot.items():
+    if got != want:
+      missing = sorted(want - got)
+      extra = sorted(got - want)
+      detail = (f"missing layers {missing[:4]}{'...' if len(missing) > 4 else ''}" if missing
+                else f"covers layers beyond the base's {n_layers} ({extra[:4]}...)")
+      return f"slot {slot}: {detail} — adapter was trained for a different base depth"
+  for slot in {s.rsplit("_", 1)[0] for s in per_slot}:
+    if f"{slot}_a" not in per_slot or f"{slot}_b" not in per_slot:
+      return f"slot {slot}: missing its a/b pair"
+  return None
 
 
 def is_lora_checkpoint(path) -> bool:
