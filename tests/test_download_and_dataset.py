@@ -86,3 +86,42 @@ def test_bundled_lora_corpus_loads():
   from xotorch_tpu.train.dataset import load_dataset
   train, valid, test = load_dataset("xotorch_tpu/train/data/lora")
   assert len(train) >= 32
+
+
+def test_local_model_status_completeness(tmp_path, monkeypatch):
+  """/initial_models disk status: a sharded checkpoint reads downloaded only
+  when EVERY file its index names is present — config + one-of-N shards is
+  mid-download, not 'local' (tinychat renders this flag directly)."""
+  from xotorch_tpu.download.hf_shard_download import local_model_status
+
+  monkeypatch.setenv("XOT_HOME", str(tmp_path))
+  engine = "JAXShardInferenceEngine"
+
+  # nothing on disk
+  st = local_model_status("llama-3.2-1b", engine)
+  assert st["downloaded"] is False and st["total_downloaded"] == 0
+
+  target = tmp_path / "models" / "unsloth--Llama-3.2-1B-Instruct"
+  target.mkdir(parents=True)
+  (target / "config.json").write_text("{}")
+  (target / "model.safetensors.index.json").write_text(json.dumps({"weight_map": WEIGHT_MAP}))
+  (target / "model-00001.safetensors").write_bytes(b"x" * 64)
+  st = local_model_status("llama-3.2-1b", engine)
+  assert st["downloaded"] is False, "one of two index shards must not read complete"
+  assert st["total_downloaded"] > 0
+
+  (target / "model-00002.safetensors").write_bytes(b"y" * 64)
+  st = local_model_status("llama-3.2-1b", engine)
+  assert st["downloaded"] is True and st["download_percentage"] == 100
+
+  # single-file checkpoint: no index, one weights file
+  t2 = tmp_path / "models" / "Qwen--Qwen2.5-0.5B-Instruct"
+  t2.mkdir(parents=True)
+  (t2 / "config.json").write_text("{}")
+  st = local_model_status("qwen-2.5-0.5b", engine)
+  assert st["downloaded"] is False
+  (t2 / "model.safetensors").write_bytes(b"z" * 16)
+  assert local_model_status("qwen-2.5-0.5b", engine)["downloaded"] is True
+
+  # synthetic models never need a download
+  assert local_model_status("synthetic-tiny", engine)["downloaded"] is True

@@ -281,12 +281,22 @@ class ChatGPTAPI:
     return web.json_response({"model pool": models})
 
   async def handle_get_initial_models(self, request):
-    data = {
-      model_id: {"name": pretty_name(model_id), "downloaded": None, "download_percentage": None,
-                 "total_size": None, "total_downloaded": None}
-      for model_id, card in model_cards.items()
-      if self.inference_engine_classname in card.get("repo", {})
-    }
+    from xotorch_tpu.download.hf_shard_download import local_model_status
+
+    ids = [model_id for model_id, card in model_cards.items()
+           if self.inference_engine_classname in card.get("repo", {})]
+
+    def scan():
+      # Pure sync disk I/O — run off the event loop so a large models dir
+      # (or slow network storage) can't stall in-flight SSE streams.
+      return {mid: local_model_status(mid, self.inference_engine_classname) for mid in ids}
+
+    statuses = await asyncio.get_running_loop().run_in_executor(None, scan)
+    data = {}
+    for model_id in ids:
+      entry = {"name": pretty_name(model_id), "layers": model_cards[model_id].get("layers")}
+      entry.update(statuses[model_id])
+      data[model_id] = entry
     return web.json_response(data)
 
   async def handle_get_topology(self, request):

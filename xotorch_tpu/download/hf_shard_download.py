@@ -265,6 +265,60 @@ class HFShardDownloader(ShardDownloader):
     return False
 
 
+def local_model_status(model_id: str, inference_engine_name: str) -> Dict:
+  """On-disk download status for one registry model — what tinychat's model
+  list renders (downloaded flag, bytes on disk) without any network I/O.
+  Parity intent: the reference computes the same per-model status for its
+  /initial_models route (xotorch/api/chatgpt_api.py model listing +
+  new_shard_download status helpers); here it is a pure disk scan so it
+  works in zero-egress deployments too. Synthetic models need no download
+  and report downloaded=True with zero bytes."""
+  from xotorch_tpu.models.registry import get_repo
+
+  repo_id = get_repo(model_id, inference_engine_name)
+  if repo_id is None:
+    return {"downloaded": False, "download_percentage": None,
+            "total_size": None, "total_downloaded": 0}
+  if repo_id == "synthetic":
+    return {"downloaded": True, "download_percentage": 100,
+            "total_size": 0, "total_downloaded": 0}
+  target = models_dir() / repo_id.replace("/", "--")
+  if not target.exists():
+    return {"downloaded": False, "download_percentage": None,
+            "total_size": None, "total_downloaded": 0, "repo": repo_id}
+  total = 0
+  names = set()
+  for p in target.rglob("*"):
+    if not p.is_file():
+      continue
+    total += p.stat().st_size
+    names.add(p.relative_to(target).as_posix())
+  # Completeness: a sharded checkpoint's index enumerates every weight file
+  # it needs — a dir with config + one of four shards must NOT read as
+  # complete. Single-file checkpoints just need the one weights file.
+  has_config = "config.json" in names
+  index_name = next((n for n in names if n.endswith("model.safetensors.index.json")), None)
+  if index_name is not None:
+    try:
+      weight_map = json.loads((target / index_name).read_text()).get("weight_map", {})
+      prefix = index_name.rsplit("/", 1)[0] + "/" if "/" in index_name else ""
+      has_weights = bool(weight_map) and all(prefix + f in names for f in set(weight_map.values()))
+    except (OSError, json.JSONDecodeError):
+      has_weights = False
+  else:
+    has_weights = any(n.endswith(".safetensors") for n in names)
+  downloaded = has_weights and has_config
+  return {
+    "downloaded": downloaded,
+    # The true remote total is unknowable offline; report 100 for a
+    # complete-looking dir so the UI can label it, None mid-download.
+    "download_percentage": 100 if downloaded else None,
+    "total_size": total if downloaded else None,
+    "total_downloaded": total,
+    "repo": repo_id,
+  }
+
+
 async def seed_models(seed_dir: str) -> None:
   """Move pre-seeded model dirs into XOT_HOME (parity :51-70)."""
   source = Path(seed_dir)
