@@ -86,12 +86,12 @@ class GRPCPeerHandle(PeerHandle):
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                         images: Optional[list] = None, temperature: Optional[float] = None,
-                        top_p: Optional[float] = None) -> None:
+                        top_p: Optional[float] = None, ring_map: Optional[list] = None) -> None:
     tensors = {f"image_{i}": np.ascontiguousarray(img) for i, img in enumerate(images or [])}
     await self._call("SendPrompt", {
       "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
       "max_tokens": max_tokens, "n_images": len(tensors) or None, "temperature": temperature,
-      "top_p": top_p,
+      "top_p": top_p, "ring_map": ring_map,
     }, tensors or None)
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,

@@ -61,6 +61,7 @@ class GRPCServer(Server):
       shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
       max_tokens=fields.get("max_tokens"), images=images,
       temperature=fields.get("temperature"), top_p=fields.get("top_p"),
+      ring_map=fields.get("ring_map"),
     ))
     return encode_message({"ok": True})
 

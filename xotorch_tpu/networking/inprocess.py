@@ -67,12 +67,12 @@ class InProcessPeerHandle(PeerHandle):
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                         images: Optional[list] = None, temperature: Optional[float] = None,
-                        top_p: Optional[float] = None) -> None:
+                        top_p: Optional[float] = None, ring_map: Optional[list] = None) -> None:
     # Detached, like the gRPC server's ack-then-process: a hop must not hold
     # the sender's coroutine chain for the rest of the generation.
     self._spawn(self.node.process_prompt(
       shard, prompt, request_id, traceparent=traceparent, max_tokens=max_tokens, images=images,
-      temperature=temperature, top_p=top_p,
+      temperature=temperature, top_p=top_p, ring_map=ring_map,
     ))
 
   async def send_tensor(self, shard: Shard, tensor, request_id: Optional[str] = None,

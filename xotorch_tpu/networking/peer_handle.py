@@ -53,7 +53,7 @@ class PeerHandle(ABC):
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                         images: Optional[list] = None, temperature: Optional[float] = None,
-                        top_p: Optional[float] = None) -> None:
+                        top_p: Optional[float] = None, ring_map: Optional[list] = None) -> None:
     ...
 
   @abstractmethod

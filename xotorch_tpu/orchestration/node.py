@@ -63,6 +63,17 @@ SAMPLING_KEY = "xot_sampling"
 # never sees the prompt tokens unless the first-layer owner sends them once
 # on the first hop. Only attached when XOT_SPECULATE > 0.
 PROMPT_TOKENS_KEY = "xot_prompt_tokens"
+# Request-scoped partition map ("routing epoch"): [[node_id, start, end],
+# ...] in ring order, pinned ONCE by the node that originates a request and
+# carried on the prompt hop and every tensor hop. Every peer routes THIS
+# request by the map, not by its own live topology view — a peer that joined
+# moments ago (whose gossip/partition view still lags) would otherwise
+# recompute a DIFFERENT shard for the same request and serve the wrong layer
+# range: the observed failure was a just-joined peer prefilling the full
+# model into one engine context while the ring decoded through another,
+# silently diverging the stream. Membership changes mid-request still abort
+# via hop errors (the map names a peer that no longer answers).
+RING_MAP_KEY = "xot_ring_map"
 
 
 _DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
@@ -186,6 +197,9 @@ class Node:
     # Prompt token ids per request (sampler peer only): the draft source for
     # prompt-lookup speculative decoding (XOT_SPECULATE).
     self._request_prompt_tokens: Dict[str, List[int]] = {}
+    # Per-request partition map (RING_MAP_KEY): ring-ordered
+    # [node_id, start_layer, end_layer] rows, pinned at request origin.
+    self._request_ring_map: "OrderedDict[str, list]" = OrderedDict()
     # Client-cancelled requests (cancel_request): the decode loops stop at
     # the next token/chunk boundary instead of running to EOS/cap. Bounded
     # LRU rather than per-request cleanup: the flag must outlive
@@ -284,10 +298,18 @@ class Node:
                            images: Optional[List[np.ndarray]] = None,
                            temperature: Optional[float] = None,
                            top_p: Optional[float] = None,
-                           sampling: Optional[dict] = None) -> None:
-    shard = self.get_current_shard(base_shard)
+                           sampling: Optional[dict] = None,
+                           ring_map: Optional[list] = None) -> None:
     if request_id is None:
       request_id = str(uuid.uuid4())
+    if ring_map:
+      # Forwarded prompt: route by the SENDER's pinned map, not our own
+      # (possibly lagging) partition view — see RING_MAP_KEY.
+      if request_id not in self._request_ring_map:
+        self._set_ring_map(request_id, ring_map)
+    else:
+      self._pin_ring_map(base_shard, request_id)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     if max_tokens is not None:
       # Per-request completion cap (OpenAI max_tokens); the node-wide
       # max_generate_tokens stays the hard ceiling.
@@ -351,7 +373,7 @@ class Node:
 
   async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str,
                             images: Optional[List[np.ndarray]] = None) -> None:
-    shard = self.get_current_shard(base_shard)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     if not shard.is_first_layer:
       # Not our turn: hand the prompt to the partition-0 owner and stop.
       await self.forward_prompt(base_shard, prompt, request_id, 0, images)
@@ -377,10 +399,10 @@ class Node:
       return
     result, inference_state = await self.inference_engine.infer_prompt(
       request_id, shard, prompt, images=images,
-      **self._keep_on_device_kwargs(shard),
+      **self._keep_on_device_kwargs(shard, request_id),
     )
     if (self.speculate_tokens > 0 and not shard.is_last_layer and not images
-        and self._inprocess_chain(base_shard) is not None):
+        and self._inprocess_chain(base_shard, request_id) is not None):
       # Ship the prompt ids to the sampler peer once (first hop's state):
       # prompt-lookup drafting needs tokens, and mid-ring hops are hidden
       # states only. Only for co-located chains — the fused ring (the only
@@ -397,9 +419,13 @@ class Node:
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
                            inference_state: Optional[dict] = None) -> None:
-    shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
+    if inference_state and request_id not in self._request_ring_map:
+      m = inference_state.get(RING_MAP_KEY)
+      if m:
+        self._set_ring_map(request_id, m)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     start_ns = time.perf_counter_ns()
     self.outstanding_requests[request_id] = "processing tensor"
     self.metrics.active_requests.set(len(self.outstanding_requests))
@@ -455,7 +481,7 @@ class Node:
         else:
           result, inference_state = await self.inference_engine.infer_tensor(
             request_id, shard, tensor, inference_state,
-            **self._keep_on_device_kwargs(shard),
+            **self._keep_on_device_kwargs(shard, request_id),
           )
       self.metrics.hop_latency.observe((time.perf_counter_ns() - start_ns) / 1e9)
       if fuse_sample:
@@ -541,11 +567,13 @@ class Node:
   async def process_inference_result(self, base_shard: Shard, result: np.ndarray, request_id: str,
                                      inference_state: Optional[dict] = None) -> None:
     """The token-ring decode driver (parity node.py:109-147)."""
-    shard = self.get_current_shard(base_shard)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     if not shard.is_last_layer:
       # Mid-ring: forward the hidden state (bf16 numpy) to the next partition.
       self.outstanding_requests[request_id] = "waiting"
-      await self.forward_tensor(base_shard, result, request_id, self.get_partition_index(offset=1), inference_state)
+      await self.forward_tensor(base_shard, result, request_id,
+                                self.get_partition_index(offset=1, request_id=request_id),
+                                inference_state)
       return
 
     # Last layer: sample, then continue via the shared token path.
@@ -562,7 +590,7 @@ class Node:
     """Buffer/broadcast a freshly sampled token and either stop (EOS/cap) or
     keep the ring turning. Shared by the sample-on-host path
     (process_inference_result) and the fused on-device sampler."""
-    shard = self.get_current_shard(base_shard)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     if request_id not in self.buffered_token_output:
       self.buffered_token_output[request_id] = ([], False)
     buffered, _ = self.buffered_token_output[request_id]
@@ -620,7 +648,7 @@ class Node:
     ring = getattr(self.inference_engine, "generate_chunk_ring", None)
     if ring is None:
       return None
-    chain = self._inprocess_chain(base_shard)
+    chain = self._inprocess_chain(base_shard, request_id)
     if chain is None:
       return None
 
@@ -636,28 +664,33 @@ class Node:
 
     return gen, verify
 
-  def _inprocess_chain(self, base_shard: Shard):
+  def _inprocess_chain(self, base_shard: Shard, request_id: Optional[str] = None):
     """The ring-ordered [(engine, shard)] chain when EVERY partition is
     served by a ring-fusion-capable engine in THIS process (self or an
     in-process peer), else None. Shared by the fused-ring dispatch and the
-    prompt-token side-channel gating."""
-    try:
-      partitions = self.partitioning_strategy.partition(self.topology)
-    except Exception:
-      return None
-    if len(partitions) < 2:
+    prompt-token side-channel gating. Ring-mapped requests bind THEIR
+    pinned partition table, not the live view."""
+    entries = self._ring_entries(request_id)
+    if entries is not None:
+      node_ids = [n for n, _, _ in entries]
+    else:
+      try:
+        node_ids = [p.node_id for p in self.partitioning_strategy.partition(self.topology)]
+      except Exception:
+        return None
+    if len(node_ids) < 2:
       return None
     chain = []
-    for i, part in enumerate(partitions):
-      if part.node_id == self.id:
+    for i, node_id in enumerate(node_ids):
+      if node_id == self.id:
         eng = self.inference_engine
       else:
-        peer = next((p for p in self.peers if p.id() == part.node_id), None)
+        peer = next((p for p in self.peers if p.id() == node_id), None)
         node = getattr(peer, "node", None)  # InProcessPeerHandle only
         eng = getattr(node, "inference_engine", None) if node is not None else None
       if eng is None or not getattr(eng, "supports_ring_fusion", False):
         return None
-      chain.append((eng, self.get_current_shard(base_shard, i)))
+      chain.append((eng, self.get_current_shard(base_shard, i, request_id=request_id)))
     return chain
 
   async def _fused_decode_loop(self, base_shard: Shard, shard: Shard, request_id: str,
@@ -808,7 +841,7 @@ class Node:
       return True
     eos = self._request_eos.get(request_id)
     if eos is None:
-      eos = self._eos_token_ids(base_shard)
+      eos = self._eos_token_ids(base_shard, request_id)
       if eos:
         # Only cache a RESOLVED set: an empty result may mean the tokenizer
         # wasn't ready yet, and freezing that for the request's lifetime
@@ -899,15 +932,17 @@ class Node:
   def _clamp_max_tokens(self, cap: Any) -> int:
     return max(1, min(int(cap), self.max_generate_tokens))
 
-  def _eos_token_ids(self, base_shard: Optional[Shard] = None) -> Tuple[int, ...]:
+  def _eos_token_ids(self, base_shard: Optional[Shard] = None,
+                     request_id: Optional[str] = None) -> Tuple[int, ...]:
     """EOS ids for the REQUEST's model. With per-model engine contexts, the
     engine's active tokenizer/cfg may belong to a different in-flight model —
     resolve per shard when the engine supports it, never from whichever
-    model happens to be active."""
+    model happens to be active. Ring-mapped requests resolve their PINNED
+    shard (the engine context key), not the live view's."""
     per_shard = getattr(self.inference_engine, "eos_token_ids_for", None)
     if base_shard is not None and per_shard is not None:
       try:
-        ids = per_shard(self.get_current_shard(base_shard))
+        ids = per_shard(self.get_current_shard(base_shard, request_id=request_id))
         # Empty means "context not resident / tokenizer unresolved", not
         # "this model has no EOS" — fall through to the engine-level lookup
         # rather than silently disabling EOS detection.
@@ -923,7 +958,46 @@ class Node:
 
   # -------------------------------------------------------------- routing
 
-  def get_partition_index(self, offset: int = 0) -> int:
+  def _set_ring_map(self, request_id: str, ring_map) -> None:
+    """Record a request's pinned partition map (bounded LRU — an abandoned
+    request must not leak its row forever; finish_request_state pops it on
+    the normal path)."""
+    rows = [(str(n), int(s), int(e)) for n, s, e in ring_map]
+    self._request_ring_map[request_id] = rows
+    self._request_ring_map.move_to_end(request_id)
+    while len(self._request_ring_map) > 512:
+      self._request_ring_map.popitem(last=False)
+
+  def _ring_entries(self, request_id: Optional[str]):
+    """The request's pinned [node_id, start, end] rows, or None when the
+    request predates the map (old peer on the wire) / isn't ring-routed.
+    Reads refresh the LRU: a long-lived streaming request must not lose its
+    map to 512 newer requests and silently fall back to live-view routing."""
+    if not request_id:
+      return None
+    rows = self._request_ring_map.get(request_id)
+    if rows is not None:
+      self._request_ring_map.move_to_end(request_id)
+    return rows
+
+  def _pin_ring_map(self, base_shard: Shard, request_id: str) -> None:
+    """Originate a request's routing epoch from THIS node's current view.
+    Called exactly once, by the node that first accepts the request."""
+    if request_id in self._request_ring_map or not self.partitioning_strategy:
+      return
+    partitions = self.partitioning_strategy.partition(self.topology)
+    shards = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)
+    self._set_ring_map(request_id, [
+      (p.node_id, s.start_layer, s.end_layer) for p, s in zip(partitions, shards)
+    ])
+
+  def get_partition_index(self, offset: int = 0, request_id: Optional[str] = None) -> int:
+    entries = self._ring_entries(request_id)
+    if entries is not None:
+      current = next((i for i, (n, _, _) in enumerate(entries) if n == self.id), None)
+      if current is None:
+        raise ValueError(f"Node {self.id} is not in request {request_id}'s ring map")
+      return (current + offset) % len(entries)
     if not self.partitioning_strategy:
       return 0
     partitions = self.partitioning_strategy.partition(self.topology)
@@ -934,23 +1008,36 @@ class Node:
 
   def get_partition_index_of_first_layer(self) -> int:
     # map_partitions_to_shards assigns layer 0 to partitions[0] by
-    # construction, so the first-layer owner is always ring index 0.
+    # construction, so the first-layer owner is always ring index 0 — in the
+    # live view AND in any pinned ring map (rows preserve partition order).
     return 0
 
-  def get_current_shard(self, base_shard: Shard, index: Optional[int] = None) -> Shard:
+  def get_current_shard(self, base_shard: Shard, index: Optional[int] = None,
+                        request_id: Optional[str] = None) -> Shard:
+    entries = self._ring_entries(request_id)
+    if entries is not None:
+      if index is None:
+        index = self.get_partition_index(request_id=request_id)
+      _, start, end = entries[index]
+      return Shard(base_shard.model_id, start, end, base_shard.n_layers)
     if index is None:
       index = self.get_partition_index()
     partitions = self.partitioning_strategy.partition(self.topology)
     shards = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)
     return shards[index]
 
+  def _ring_target_id(self, target_index: int, request_id: Optional[str]) -> str:
+    entries = self._ring_entries(request_id)
+    if entries is not None:
+      return entries[target_index][0]
+    return self.partitioning_strategy.partition(self.topology)[target_index].node_id
+
   async def forward_prompt(self, base_shard: Shard, prompt: str, request_id: str, target_index: int,
                            images: Optional[List[np.ndarray]] = None) -> None:
     if DEBUG >= 1:
       print(f"Forwarding prompt [{request_id}] to partition {target_index}")
-    partitions = self.partitioning_strategy.partition(self.topology)
-    target_id = partitions[target_index].node_id
-    next_shard = self.get_current_shard(base_shard, target_index)
+    target_id = self._ring_target_id(target_index, request_id)
+    next_shard = self.get_current_shard(base_shard, target_index, request_id=request_id)
     if target_id == self.id:
       await self._process_prompt(base_shard, prompt, request_id, images)
       return
@@ -963,9 +1050,10 @@ class Node:
                            max_tokens=self._request_max_tokens.get(request_id),
                            images=images,
                            temperature=self._request_temp.get(request_id),
-                           top_p=self._request_top_p.get(request_id))
+                           top_p=self._request_top_p.get(request_id),
+                           ring_map=self._ring_entries(request_id))
 
-  def _keep_on_device_kwargs(self, shard: Shard) -> dict:
+  def _keep_on_device_kwargs(self, shard: Shard, request_id: Optional[str] = None) -> dict:
     """Engine kwargs for a mid-ring hop: request device-resident output when
     the engine supports it AND the next partition is co-located (self or an
     in-process peer — the fast path that keeps hidden states in HBM across
@@ -974,11 +1062,8 @@ class Node:
     if shard.is_last_layer or not getattr(self.inference_engine, "supports_device_io", False):
       return {}
     try:
-      partitions = self.partitioning_strategy.partition(self.topology)
-      current = next((i for i, p in enumerate(partitions) if p.node_id == self.id), None)
-      if current is None:
-        return {}
-      target_id = partitions[(current + 1) % len(partitions)].node_id
+      target_id = self._ring_target_id(
+        self.get_partition_index(offset=1, request_id=request_id), request_id)
     except Exception:
       return {}
     if target_id == self.id:
@@ -990,14 +1075,16 @@ class Node:
 
   async def forward_tensor(self, base_shard: Shard, tensor, request_id: str, target_index: int,
                            inference_state: Optional[dict] = None) -> None:
-    partitions = self.partitioning_strategy.partition(self.topology)
-    target_id = partitions[target_index].node_id
-    next_shard = self.get_current_shard(base_shard, target_index)
+    target_id = self._ring_target_id(target_index, request_id)
+    next_shard = self.get_current_shard(base_shard, target_index, request_id=request_id)
     # Inject the trace context so the receiving peer's hop span joins this
     # request's trace (rides the existing inference_state side-channel).
     ctx = self._request_trace_ctx.get(request_id)
     if ctx is not None:
       inference_state = {**(inference_state or {}), TRACEPARENT_KEY: ctx.traceparent()}
+    ring_rows = self._ring_entries(request_id)
+    if ring_rows is not None:
+      inference_state = {**(inference_state or {}), RING_MAP_KEY: ring_rows}
     cap = self._request_max_tokens.get(request_id)
     if cap is not None:
       inference_state = {**(inference_state or {}), MAX_TOKENS_KEY: cap}
@@ -1242,6 +1329,7 @@ class Node:
     self._request_sampling.pop(request_id, None)
     self._request_eos.pop(request_id, None)
     self._request_prompt_tokens.pop(request_id, None)
+    self._request_ring_map.pop(request_id, None)
 
   def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
     self.on_token.trigger_all(request_id, tokens, is_finished)
