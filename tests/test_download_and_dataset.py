@@ -104,6 +104,7 @@ def test_local_model_status_completeness(tmp_path, monkeypatch):
   target = tmp_path / "models" / "unsloth--Llama-3.2-1B-Instruct"
   target.mkdir(parents=True)
   (target / "config.json").write_text("{}")
+  (target / "tokenizer.json").write_text("{}")
   (target / "model.safetensors.index.json").write_text(json.dumps({"weight_map": WEIGHT_MAP}))
   (target / "model-00001.safetensors").write_bytes(b"x" * 64)
   st = local_model_status("llama-3.2-1b", engine)
@@ -114,14 +115,25 @@ def test_local_model_status_completeness(tmp_path, monkeypatch):
   st = local_model_status("llama-3.2-1b", engine)
   assert st["downloaded"] is True and st["download_percentage"] == 100
 
+  # tokenizer_config.json alone is NOT a loadable tokenizer artifact
+  (target / "tokenizer.json").unlink()
+  (target / "tokenizer_config.json").write_text("{}")
+  assert local_model_status("llama-3.2-1b", engine)["downloaded"] is False
+  (target / "tokenizer.json").write_text("{}")
+
   # single-file checkpoint: no index, one weights file
   t2 = tmp_path / "models" / "Qwen--Qwen2.5-0.5B-Instruct"
   t2.mkdir(parents=True)
   (t2 / "config.json").write_text("{}")
+  (t2 / "tokenizer.json").write_text("{}")
   st = local_model_status("qwen-2.5-0.5b", engine)
   assert st["downloaded"] is False
   (t2 / "model.safetensors").write_bytes(b"z" * 16)
   assert local_model_status("qwen-2.5-0.5b", engine)["downloaded"] is True
+  # an interrupted no-index download (.partial leftover) is NOT complete
+  (t2 / "model2.safetensors.partial").write_bytes(b"q")
+  assert local_model_status("qwen-2.5-0.5b", engine)["downloaded"] is False
+  (t2 / "model2.safetensors.partial").unlink()
 
   # synthetic models never need a download
   assert local_model_status("synthetic-tiny", engine)["downloaded"] is True

@@ -147,6 +147,16 @@ class HFShardDownloader(ShardDownloader):
     target_dir = models_dir() / repo_id.replace("/", "--")
     target_dir.mkdir(parents=True, exist_ok=True)
 
+    if self._local_complete(target_dir, shard):
+      # Seeded / previously-downloaded checkpoint already holds everything
+      # this shard needs: serve it without touching the network, so seeded
+      # and air-gapped deployments work. Parity intent:
+      # /root/reference/xotorch/download/new_shard_download.py:181-194
+      # (local file set checked against the allow-patterns before fetching).
+      if DEBUG >= 2:
+        print(f"Local checkpoint complete for {shard}; skipping download")
+      return target_dir
+
     timeout = aiohttp.ClientTimeout(total=3600, connect=30)
     async with aiohttp.ClientSession(timeout=timeout) as session:
       file_list = await fetch_file_list(session, repo_id)
@@ -170,6 +180,10 @@ class HFShardDownloader(ShardDownloader):
 
       await asyncio.gather(*(fetch(f) for f in wanted))
     return target_dir
+
+  @staticmethod
+  def _local_complete(target_dir: Path, shard: Shard) -> bool:
+    return checkpoint_complete(target_dir, shard)
 
   async def _weight_map(self, session, repo_id: str, target_dir: Path, file_list: List[Dict]) -> Optional[Dict[str, str]]:
     index_name = "model.safetensors.index.json"
@@ -265,6 +279,59 @@ class HFShardDownloader(ShardDownloader):
     return False
 
 
+def has_tokenizer_artifact(target_dir: Path) -> bool:
+  """A file AutoTokenizer can actually BUILD a tokenizer from.
+  tokenizer_config.json alone is not one — treating it as sufficient would
+  redirect resolution to a dir that then fails to load (ADVISOR: a
+  hash-mismatch-deleted tokenizer.model leaves exactly that state)."""
+  return any((target_dir / t).exists()
+             for t in ("tokenizer.json", "tokenizer.model", "vocab.json", "spiece.model"))
+
+
+def _find_index(target_dir: Path) -> Optional[Path]:
+  """The safetensors index, top-level or one subdir down (some repos nest
+  their weights)."""
+  top = target_dir / "model.safetensors.index.json"
+  if top.exists():
+    return top
+  return next(target_dir.glob("*/model.safetensors.index.json"), None)
+
+
+def checkpoint_complete(target_dir: Path, shard: Optional[Shard] = None) -> bool:
+  """THE on-disk completeness rule, shared by the downloader's offline fast
+  path (shard-filtered) and the UI's model status (whole repo, shard=None).
+
+  Complete means: config.json, a loadable tokenizer artifact, and full
+  weight coverage — with a safetensors index, every file the index names
+  (filtered to the shard's allow-patterns when a shard is given); without
+  one, at least one .safetensors AND no interrupted .partial leftovers (a
+  multi-file no-index repo killed between files is indistinguishable from
+  complete offline — the .partial check catches the common
+  killed-mid-file case, and the conservative default is the network path,
+  which verifies per file)."""
+  if not (target_dir / "config.json").exists():
+    return False
+  if not has_tokenizer_artifact(target_dir):
+    return False
+  index = _find_index(target_dir)
+  if index is not None:
+    try:
+      weight_map = json.loads(index.read_text()).get("weight_map", {})
+    except (OSError, json.JSONDecodeError):
+      return False
+    if not weight_map:
+      return False
+    files = set(weight_map.values())
+    if shard is not None:
+      patterns = get_allow_patterns(weight_map, shard)
+      files = {f for f in files if _matches(f, patterns)}
+    base = index.parent
+    return bool(files) and all((base / f).exists() for f in files)
+  if any(target_dir.rglob("*.partial")):
+    return False
+  return any(p.suffix == ".safetensors" for p in target_dir.iterdir() if p.is_file())
+
+
 def local_model_status(model_id: str, inference_engine_name: str) -> Dict:
   """On-disk download status for one registry model — what tinychat's model
   list renders (downloaded flag, bytes on disk) without any network I/O.
@@ -286,28 +353,10 @@ def local_model_status(model_id: str, inference_engine_name: str) -> Dict:
   if not target.exists():
     return {"downloaded": False, "download_percentage": None,
             "total_size": None, "total_downloaded": 0, "repo": repo_id}
-  total = 0
-  names = set()
-  for p in target.rglob("*"):
-    if not p.is_file():
-      continue
-    total += p.stat().st_size
-    names.add(p.relative_to(target).as_posix())
-  # Completeness: a sharded checkpoint's index enumerates every weight file
-  # it needs — a dir with config + one of four shards must NOT read as
-  # complete. Single-file checkpoints just need the one weights file.
-  has_config = "config.json" in names
-  index_name = next((n for n in names if n.endswith("model.safetensors.index.json")), None)
-  if index_name is not None:
-    try:
-      weight_map = json.loads((target / index_name).read_text()).get("weight_map", {})
-      prefix = index_name.rsplit("/", 1)[0] + "/" if "/" in index_name else ""
-      has_weights = bool(weight_map) and all(prefix + f in names for f in set(weight_map.values()))
-    except (OSError, json.JSONDecodeError):
-      has_weights = False
-  else:
-    has_weights = any(n.endswith(".safetensors") for n in names)
-  downloaded = has_weights and has_config
+  total = sum(p.stat().st_size for p in target.rglob("*") if p.is_file())
+  # ONE completeness rule with the downloader's offline fast path — a model
+  # the UI shows as "local" is exactly one ensure_shard serves offline.
+  downloaded = checkpoint_complete(target)
   return {
     "downloaded": downloaded,
     # The true remote total is unknowable offline; report 100 for a

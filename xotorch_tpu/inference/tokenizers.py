@@ -39,7 +39,25 @@ class DummyTokenizer:
 async def resolve_tokenizer(model_id_or_path: Union[str, "os.PathLike"], allow_dummy: bool = True):
   if str(model_id_or_path) in ("dummy", "dummy-model") and allow_dummy:
     return DummyTokenizer()
-  return await _resolve_hf_tokenizer(str(model_id_or_path))
+  return await _resolve_hf_tokenizer(_prefer_local_dir(str(model_id_or_path)))
+
+
+def _prefer_local_dir(repo_or_path: str) -> str:
+  """Map an HF repo id to its already-downloaded local dir when that dir
+  holds tokenizer files. AutoProcessor/AutoTokenizer given a repo ID probe
+  the Hub with retries even when everything sits on disk — in an air-gapped
+  or seeded deployment (see HFShardDownloader._local_complete) that is
+  minutes of retry stalls followed by failure, for files we already have."""
+  if os.path.sep in repo_or_path and os.path.isdir(repo_or_path):
+    return repo_or_path  # already a path
+  try:
+    from xotorch_tpu.download.hf_shard_download import has_tokenizer_artifact, models_dir
+    local = models_dir() / repo_or_path.replace("/", "--")
+    if local.is_dir() and has_tokenizer_artifact(local):
+      return str(local)
+  except Exception:
+    pass
+  return repo_or_path
 
 
 async def _resolve_hf_tokenizer(repo_or_path: str):

@@ -327,6 +327,12 @@ async def eval_model_cli(node: Node, engine_classname: str, model_name: str, arg
 
 
 async def async_main(args) -> None:
+  if args.models_seed_dir:
+    # Move pre-seeded checkpoint dirs into XOT_HOME before anything resolves
+    # models, so ensure_shard's local-complete fast path and tokenizer
+    # resolution find them (parity reference main.py:251-255).
+    from xotorch_tpu.download.hf_shard_download import seed_models
+    await seed_models(args.models_seed_dir)
   node, engine, engine_classname, api, topology_viz = build_node(args)
   loop = asyncio.get_running_loop()
   for sig in (signal.SIGINT, signal.SIGTERM):
