@@ -62,3 +62,138 @@ def test_host_probe_reports_nonzero_flops():
   caps = _probe_host_sync()
   assert caps.flops.fp16 > 0
   assert caps.memory > 0
+
+
+class _FakeCudaProps:
+  total_memory = 8 * 1024**3
+
+
+class _FakeCuda:
+  @staticmethod
+  def is_available(): return True
+  @staticmethod
+  def device_count(): return 1
+  @staticmethod
+  def get_device_name(i): return "Orin (nvgpu)"
+  @staticmethod
+  def get_device_properties(i): return _FakeCudaProps()
+
+
+def test_jetson_probe_uses_unified_memory(tmp_path, monkeypatch):
+  """Jetson (Orin): memory must come from /proc/meminfo (unified), not the
+  CUDA carve-out, and FLOPS resolve by family (parity: reference
+  get_jetson_device_meminfo, device_capabilities.py:182-205)."""
+  import sys
+  import types
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+
+  meminfo = tmp_path / "meminfo"
+  meminfo.write_text("MemTotal:       67108864 kB\nMemFree:  1 kB\n")
+  monkeypatch.setattr(dc, "MEMINFO_PATH", str(meminfo))
+  fake_torch = types.SimpleNamespace(cuda=_FakeCuda())
+  monkeypatch.setitem(sys.modules, "torch", fake_torch)
+
+  caps = dc._probe_torch_cuda_sync()
+  assert caps is not None
+  assert caps.memory == 65536, caps  # 64 GB unified, not the 8 GB carve-out
+  assert "Orin" in caps.chip
+  assert caps.flops == dc.GPU_CHIP_FLOPS["Jetson AGX Orin"]
+
+
+def test_amd_probe_pyamdgpuinfo(monkeypatch):
+  import sys
+  import types
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+
+  gpu = types.SimpleNamespace(name="AMD Radeon RX 7900 XTX",
+                              memory_info={"vram_size": 24 * 1024**3})
+  fake = types.SimpleNamespace(get_gpu=lambda i: gpu, detect_gpus=lambda: 1)
+  monkeypatch.setitem(sys.modules, "pyamdgpuinfo", fake)
+
+  caps = dc._probe_amd_sync()
+  assert caps is not None
+  assert caps.memory == 24 * 1024
+  assert caps.flops == dc.GPU_CHIP_FLOPS["Radeon RX 7900"]
+
+
+def test_amd_probe_rocm_smi_fallback(monkeypatch):
+  """Without pyamdgpuinfo, `rocm-smi --json` supplies name + VRAM."""
+  import subprocess
+  import sys
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+
+  monkeypatch.setitem(sys.modules, "pyamdgpuinfo", None)  # import -> error
+
+  smi = {"card0": {"Card series": "AMD Instinct MI300X",
+                   "VRAM Total Memory (B)": str(192 * 1024**3)}}
+  def fake_run(cmd, **kw):
+    assert cmd[0] == "rocm-smi"
+    import json as j
+    import types
+    return types.SimpleNamespace(stdout=j.dumps(smi), returncode=0)
+  monkeypatch.setattr(subprocess, "run", fake_run)
+
+  caps = dc._probe_amd_sync()
+  assert caps is not None
+  assert caps.memory == 192 * 1024
+  assert caps.flops == dc.GPU_CHIP_FLOPS["MI300X"]
+
+
+def test_amd_probe_absent_returns_none(monkeypatch):
+  import subprocess
+  import sys
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+
+  monkeypatch.setitem(sys.modules, "pyamdgpuinfo", None)
+  def no_smi(cmd, **kw):
+    raise FileNotFoundError("rocm-smi")
+  monkeypatch.setattr(subprocess, "run", no_smi)
+  assert dc._probe_amd_sync() is None
+
+
+def test_mac_probe_system_profiler(monkeypatch):
+  """macOS: model id, chip and memory from system_profiler JSON (parity:
+  reference get_mac_system_info, device_capabilities.py:350-378)."""
+  import json as j
+  import platform
+  import subprocess
+  import types
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+
+  monkeypatch.setattr(platform, "system", lambda: "Darwin")
+  hw = {"SPHardwareDataType": [{
+    "machine_model": "Mac14,6", "chip_type": "Apple M2 Max",
+    "physical_memory": "32 GB"}]}
+  def fake_run(cmd, **kw):
+    assert cmd[0] == "system_profiler"
+    return types.SimpleNamespace(stdout=j.dumps(hw), returncode=0)
+  monkeypatch.setattr(subprocess, "run", fake_run)
+
+  caps = dc._probe_mac_sync()
+  assert caps is not None
+  assert caps.model == "Mac14,6" and caps.chip == "Apple M2 Max"
+  assert caps.memory == 32 * 1024
+  assert caps.flops == dc.APPLE_CHIP_FLOPS["Apple M2 Max"]
+
+
+def test_mac_probe_off_macos_is_none():
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+  import platform
+  if platform.system() != "Darwin":
+    assert dc._probe_mac_sync() is None
+
+
+def test_jetson_flops_family_resolution(tmp_path, monkeypatch):
+  """'Orin' alone is ambiguous across a ~4x perf range: the device-tree
+  model string decides, then unified-memory size separates AGX from Nano."""
+  dc = __import__("importlib").import_module("xotorch_tpu.topology.device_capabilities")
+
+  dt = tmp_path / "model"
+  dt.write_text("NVIDIA Jetson Orin Nano Developer Kit\x00")
+  monkeypatch.setattr(dc, "DEVICE_TREE_MODEL_PATH", str(dt))
+  assert dc._jetson_flops("Orin (nvgpu)", 64 * 1024) == dc.GPU_CHIP_FLOPS["Jetson Orin Nano"]
+
+  monkeypatch.setattr(dc, "DEVICE_TREE_MODEL_PATH", str(tmp_path / "missing"))
+  assert dc._jetson_flops("Orin (nvgpu)", 64 * 1024) == dc.GPU_CHIP_FLOPS["Jetson AGX Orin"]
+  assert dc._jetson_flops("Orin (nvgpu)", 8 * 1024) == dc.GPU_CHIP_FLOPS["Jetson Orin Nano"]
+  assert dc._jetson_flops("Xavier (nvgpu)", 16 * 1024) == dc.GPU_CHIP_FLOPS["Jetson Xavier"]

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import re
 from dataclasses import dataclass, field, asdict
 from typing import Any, Dict, List, Optional
 
@@ -252,10 +253,54 @@ def _probe_jax_sync() -> Optional[DeviceCapabilities]:
   return None  # cpu platform -> use the host probe for better memory numbers
 
 
+MEMINFO_PATH = "/proc/meminfo"  # module constant so tests can point elsewhere
+
+
+def _jetson_total_mem_mb() -> Optional[int]:
+  """Jetson boards have UNIFIED memory: the CUDA device property reports a
+  carve-out, not what the model planner can actually use — /proc/meminfo
+  MemTotal is the honest number (parity: reference
+  device_capabilities.py:182-205 get_jetson_device_meminfo)."""
+  try:
+    with open(MEMINFO_PATH) as fp:
+      first = fp.readline()
+    m = re.search(r"\d+", first)
+    return int(m.group()) // 1024 if m else None  # kB -> MB
+  except OSError:
+    return None
+
+
+DEVICE_TREE_MODEL_PATH = "/proc/device-tree/model"
+
+
+def _jetson_flops(cuda_name: str, mem_mb: int) -> DeviceFlops:
+  """Resolve a Jetson board's FLOPS. CUDA reports the bare SoC name ('Orin')
+  for the whole family, which spans a ~4x perf range — the device-tree
+  model string names the actual board; failing that, unified-memory size
+  separates AGX (32/64 GB) from Nano-class (4-8 GB) boards."""
+  try:
+    with open(DEVICE_TREE_MODEL_PATH) as fp:
+      board = fp.read().strip("\x00 \n")
+    hit = lookup_chip_flops(board)
+    if hit is not None:
+      return hit
+  except OSError:
+    pass
+  hit = lookup_chip_flops(cuda_name)
+  if hit is not None:
+    return hit
+  if "xavier" in cuda_name.lower():
+    return GPU_CHIP_FLOPS["Jetson Xavier"]
+  key = "Jetson AGX Orin" if mem_mb >= 24 * 1024 else "Jetson Orin Nano"
+  return GPU_CHIP_FLOPS[key]
+
+
 def _probe_torch_cuda_sync() -> Optional[DeviceCapabilities]:
   """torch-CUDA fallback for peers whose JAX is CPU-only but that carry a
   CUDA GPU (the reference's primary probe path, device_capabilities.py:207-328
-  — here a fallback, since TPU peers probe through JAX first)."""
+  — here a fallback, since TPU peers probe through JAX first). Jetson
+  (Orin/Xavier) devices take their memory from /proc/meminfo — unified
+  memory — and resolve their FLOPS by family name."""
   try:
     import torch
     if not torch.cuda.is_available():
@@ -265,9 +310,62 @@ def _probe_torch_cuda_sync() -> Optional[DeviceCapabilities]:
     mem_mb = torch.cuda.get_device_properties(0).total_memory // (1024 * 1024)
   except Exception:
     return None
+  if any(k in name.lower() for k in ("orin", "xavier", "jetson")):
+    unified = _jetson_total_mem_mb()
+    if unified:
+      mem_mb = unified
+    flops = _jetson_flops(name, int(mem_mb))
+    return DeviceCapabilities(
+      model=f"Jetson ({name})", chip=name, memory=int(mem_mb),
+      flops=flops, num_devices=n,
+    )
   flops = lookup_chip_flops(name) or DeviceFlops(fp32=10.0, fp16=20.0, int8=40.0)
   return DeviceCapabilities(
     model=f"{name} x{n}", chip=name, memory=int(mem_mb) * n,
+    flops=DeviceFlops(fp32=flops.fp32 * n, fp16=flops.fp16 * n, int8=flops.int8 * n),
+    num_devices=n,
+  )
+
+
+def _probe_amd_sync() -> Optional[DeviceCapabilities]:
+  """AMD GPU probe: pyamdgpuinfo when installed (parity: reference
+  device_capabilities.py:330-348), else `rocm-smi --json`. Returns None on
+  hosts without AMD tooling — the chain falls through to the host probe."""
+  try:
+    import pyamdgpuinfo  # optional dep, present on AMD hosts that set it up
+    # detect_gpus() must run BEFORE get_gpu() — the library builds its
+    # device list there (same order the reference relies on).
+    n = max(int(pyamdgpuinfo.detect_gpus()), 1)
+    gpu = pyamdgpuinfo.get_gpu(0)
+    name = gpu.name
+    mem_mb = int(gpu.memory_info["vram_size"]) // (1024 * 1024)
+  except Exception:
+    name = mem_mb = None
+    n = 1
+  if name is None:
+    try:
+      import json as _json
+      import subprocess
+      out = subprocess.run(
+        ["rocm-smi", "--showproductname", "--showmeminfo", "vram", "--json"],
+        capture_output=True, text=True, timeout=10)
+      data = _json.loads(out.stdout)
+      cards = [v for k, v in sorted(data.items()) if k.lower().startswith("card")]
+      if not cards:
+        return None
+      c0 = cards[0]
+      name = (c0.get("Card series") or c0.get("Card SKU")
+              or c0.get("Card model") or "AMD GPU")
+      vram = c0.get("VRAM Total Memory (B)") or c0.get("vram Total Memory (B)")
+      mem_mb = int(vram) // (1024 * 1024) if vram else None
+      n = len(cards)
+    except Exception:
+      return None
+  if mem_mb is None:
+    return None
+  flops = lookup_chip_flops(str(name)) or DeviceFlops(fp32=10.0, fp16=20.0, int8=40.0)
+  return DeviceCapabilities(
+    model=f"{name} x{n}" if n > 1 else str(name), chip=str(name), memory=int(mem_mb) * n,
     flops=DeviceFlops(fp32=flops.fp32 * n, fp16=flops.fp16 * n, int8=flops.int8 * n),
     num_devices=n,
   )
@@ -287,6 +385,51 @@ def _apple_chip_name() -> Optional[str]:
     return None
 
 
+def _probe_mac_sync(quick: bool = False) -> Optional[DeviceCapabilities]:
+  """macOS probe (parity: reference device_capabilities.py:350-378
+  get_mac_system_info): model identifier ('Mac15,6'), chip name and
+  physical memory from `system_profiler SPHardwareDataType -json`, with the
+  sysctl brand string as the fallback chip source. Returns None off macOS.
+
+  quick=True skips the system_profiler subprocess (seconds) and resolves
+  from sysctl + psutil only — the instant-start path and the async-timeout
+  host fallback both go through here so ONE implementation owns the
+  Apple-silicon mapping."""
+  import platform as _platform
+  if _platform.system() != "Darwin":
+    return None
+  model_id, chip, mem_mb = None, None, None
+  if not quick:
+    try:
+      import json as _json
+      import subprocess
+      out = subprocess.run(["system_profiler", "SPHardwareDataType", "-json"],
+                           capture_output=True, text=True, timeout=15)
+      hw = _json.loads(out.stdout)["SPHardwareDataType"][0]
+      model_id = hw.get("machine_model")
+      chip = hw.get("chip_type")  # e.g. "Apple M2 Max"
+      phys = hw.get("physical_memory", "")  # e.g. "32 GB"
+      m = re.search(r"(\d+)\s*GB", str(phys))
+      if m:
+        mem_mb = int(m.group(1)) * 1024
+    except Exception:
+      pass
+  chip = chip or _apple_chip_name()
+  if chip is None:
+    return None
+  if mem_mb is None:
+    try:
+      import psutil
+      mem_mb = psutil.virtual_memory().total // (1024 * 1024)
+    except Exception:
+      mem_mb = 16 * 1024
+  flops = lookup_chip_flops(chip) or DeviceFlops(fp32=2.0, fp16=4.0, int8=8.0)
+  return DeviceCapabilities(
+    model=model_id or f"Mac ({chip})", chip=chip, memory=int(mem_mb),
+    flops=flops, num_devices=1,
+  )
+
+
 def _probe_host_sync() -> DeviceCapabilities:
   import platform as _platform
   try:
@@ -297,13 +440,11 @@ def _probe_host_sync() -> DeviceCapabilities:
     mem_mb, cores = 8 * 1024, os.cpu_count() or 1
   # Apple silicon: unified memory + a real GPU — the static table gives the
   # partitioner honest planning numbers for a Mac peer in a mixed ring.
-  apple = _apple_chip_name()
-  if apple:
-    flops = lookup_chip_flops(apple)
-    if flops is not None:
-      return DeviceCapabilities(
-        model=f"Mac ({apple})", chip=apple, memory=int(mem_mb), flops=flops, num_devices=1,
-      )
+  # quick=True: no subprocess; this path must return instantly (it also
+  # serves as the async-timeout fallback).
+  mac = _probe_mac_sync(quick=True)
+  if mac is not None and mac.flops.fp16 > 0:
+    return mac
   # ~50 GFLOPS fp32/core is a serviceable planning number for modern x86/arm.
   per_core = 0.05
   return DeviceCapabilities(
@@ -369,6 +510,12 @@ async def device_capabilities() -> DeviceCapabilities:
 
 
 def device_capabilities_sync() -> DeviceCapabilities:
+  """Probe priority (jax-first — the inversion this framework exists for),
+  then the reference's per-OS chain (device_capabilities.py:167-396):
+  torch-CUDA (incl. Jetson unified memory) -> AMD (pyamdgpuinfo/rocm-smi)
+  -> macOS system_profiler -> generic host. Windows follows the same chain
+  as the reference's windows_device_capabilities (cuda -> amd -> cpu); the
+  host probe names the OS."""
   caps = None
   skip_accel = os.getenv("XOT_SKIP_JAX_PROBE", "0") == "1"
   if not skip_accel:
@@ -379,6 +526,13 @@ def device_capabilities_sync() -> DeviceCapabilities:
       import importlib.util
       if importlib.util.find_spec("torch") is not None:
         caps = _probe_torch_cuda_sync()
+    if caps is None:
+      caps = _probe_amd_sync()
+    if caps is None:
+      # Full macOS probe (runs a subprocess — never on the instant-start
+      # path; skip_accel runs fall through to the host probe's quick
+      # sysctl-based Apple branch instead).
+      caps = _probe_mac_sync()
   if caps is None:
     caps = _probe_host_sync()
   if DEBUG >= 1:
