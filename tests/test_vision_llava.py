@@ -24,41 +24,66 @@ from xotorch_tpu.models.weights import load_shard_params, load_vision_tower
 IMAGE_TOKEN = 250
 N_PATCHES = 4  # (28/14)^2
 
-TINY_LLAVA_CFG = {
-  "architectures": ["LlavaForConditionalGeneration"],
-  "model_type": "llava",
-  "image_token_index": IMAGE_TOKEN,
-  "vision_feature_layer": -2,
-  "vision_feature_select_strategy": "default",
-  "projector_hidden_act": "gelu",
-  "vision_config": {
-    "model_type": "clip_vision_model",
-    "hidden_size": 32,
-    "intermediate_size": 64,
-    "num_hidden_layers": 3,
-    "num_attention_heads": 2,
-    "image_size": 28,
-    "patch_size": 14,
-    "layer_norm_eps": 1e-5,
-    "hidden_act": "quick_gelu",
-    "projection_dim": 32,
-  },
-  "text_config": {
-    "model_type": "llama",
-    "hidden_size": 64,
-    "intermediate_size": 128,
-    "num_attention_heads": 4,
-    "num_key_value_heads": 2,
-    "num_hidden_layers": 3,
-    "vocab_size": 256,
-    "max_position_embeddings": 128,
-    "rms_norm_eps": 1e-5,
-    "rope_theta": 10000.0,
-    "tie_word_embeddings": False,
+
+def tiny_llava_cfg(n_text_layers=3, vocab=256, image_token_index=IMAGE_TOKEN,
+                   max_position_embeddings=128):
+  """ONE tiny-llava shape for every llava test (this file's oracle tests
+  and the checkpoint drill) — change the vision/text geometry here only."""
+  return {
+    "architectures": ["LlavaForConditionalGeneration"],
+    "model_type": "llava",
+    "image_token_index": image_token_index,
+    "vision_feature_layer": -2,
+    "vision_feature_select_strategy": "default",
+    "projector_hidden_act": "gelu",
+    "vision_config": {
+      "model_type": "clip_vision_model",
+      "hidden_size": 32,
+      "intermediate_size": 64,
+      "num_hidden_layers": 3,
+      "num_attention_heads": 2,
+      "image_size": 28,
+      "patch_size": 14,
+      "layer_norm_eps": 1e-5,
+      "hidden_act": "quick_gelu",
+      "projection_dim": 32,
+    },
+    "text_config": {
+      "model_type": "llama",
+      "hidden_size": 64,
+      "intermediate_size": 128,
+      "num_attention_heads": 4,
+      "num_key_value_heads": 2,
+      "num_hidden_layers": n_text_layers,
+      "vocab_size": vocab,
+      "max_position_embeddings": max_position_embeddings,
+      "rms_norm_eps": 1e-5,
+      "rope_theta": 10000.0,
+      "tie_word_embeddings": False,
+      "torch_dtype": "float32",
+      "bos_token_id": 1,
+      "eos_token_id": 2,
+    },
     "torch_dtype": "float32",
-  },
-  "torch_dtype": "float32",
-}
+  }
+
+
+def save_tiny_llava(d, cfg, seed=7):
+  """save_pretrained with the REAL llava tensor layout (optionally sharded
+  via max_shard_size) + the exact config dict on disk."""
+  import json as _json
+  import torch
+  from transformers import LlavaConfig, LlavaForConditionalGeneration
+
+  torch.manual_seed(seed)
+  config = LlavaConfig(**{k: v for k, v in cfg.items() if k != "architectures"})
+  model = LlavaForConditionalGeneration(config).to(torch.float32).eval()
+  model.save_pretrained(d, safe_serialization=True, max_shard_size="2MB")
+  with open(d / "config.json", "w") as f:
+    _json.dump(cfg, f)
+
+
+TINY_LLAVA_CFG = tiny_llava_cfg()
 
 
 @pytest.fixture(scope="module")
