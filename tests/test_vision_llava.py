@@ -278,3 +278,53 @@ def test_projector_activation_from_config():
   t = torch.from_numpy(np.array(feats)) @ torch.from_numpy(np.array(pparams["w1"])) + torch.from_numpy(np.array(pparams["b1"]))
   t = F.gelu(t) @ torch.from_numpy(np.array(pparams["w2"])) + torch.from_numpy(np.array(pparams["b2"]))
   np.testing.assert_allclose(out_gelu, t.numpy(), atol=1e-5)
+
+
+async def test_vision_request_logprobs_align_with_tokens(llava_dir):
+  """A multimodal request's FIRST token is sampled on the host path
+  (engine.sample); with per-request extras threaded through it, the logprob
+  store must hold exactly one entry per generated token — a missing first
+  entry would silently shift every logprob onto the wrong token in the API's
+  zip (same misalignment class the ring map fixed)."""
+  import asyncio
+
+  from xotorch_tpu.download.shard_download import LocalShardDownloader
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from tests.test_orchestration import _make_node
+
+  eng = JAXShardInferenceEngine(LocalShardDownloader({"llava": llava_dir}), dtype="float32")
+  cfg = load_model_config(llava_dir)
+  shard = Shard("llava", 0, cfg.num_layers - 1, cfg.num_layers)
+  await eng.ensure_shard(shard)
+  eng.tokenizer = _LlavaStubTokenizer()
+
+  node = await _make_node("vision-lp", eng)
+  node.topology.update_node("vision-lp", __import__("tests.test_orchestration", fromlist=["_caps"])._caps())
+
+  done = asyncio.Event()
+  tokens = {}
+
+  def on_token(rid, toks, finished):
+    tokens[rid] = list(toks)
+    if finished:
+      done.set()
+
+  node.on_token.register("t").on_next(on_token)
+  rng = np.random.RandomState(1)
+  img = rng.randint(0, 255, (28, 28, 3), dtype=np.uint8)
+  await node.process_prompt(shard, "ignored", "vreq", max_tokens=4,
+                            temperature=0.0, sampling={"logprobs": 2},
+                            images=[img])
+  await asyncio.wait_for(done.wait(), timeout=120)
+  toks = tokens["vreq"]
+  entries = node.pop_request_logprobs("vreq")
+  # At least one entry per kept token (a fused chunk may record a surplus
+  # token past the cap; the API's zip drops the tail) — and each entry must
+  # be THE entry for its token: at temperature 0 the sampled token is the
+  # top-1 alternative, so a missing first entry (the old bug: the host-path
+  # prefill sample recorded nothing) would break alignment at i=0.
+  assert entries is not None and len(entries) >= len(toks), (len(entries or []), len(toks))
+  for i, tok in enumerate(toks):
+    top = entries[i]["top"]
+    assert top[0][0] == tok, f"entry {i} aligned to wrong token: {top[0][0]} != {tok}"
+    assert len(top) <= 2

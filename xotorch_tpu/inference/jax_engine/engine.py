@@ -548,18 +548,63 @@ class JAXShardInferenceEngine(InferenceEngine):
     return tokenizer.decode(np.asarray(tokens).reshape(-1).tolist())
 
   async def sample(self, x: np.ndarray, temp: float = DEFAULT_TEMP, top_k: int = DEFAULT_TOP_K,
-                   top_p: float = 0.0) -> np.ndarray:
+                   top_p: float = 0.0, request_id: Optional[str] = None,
+                   sampling: Optional[dict] = None,
+                   sample_index: Optional[int] = None) -> np.ndarray:
+    """Host-path sampling. On THIS engine it runs exactly once per request —
+    the first token of a multimodal prefill (ring decode hops sample via the
+    fused infer_sample_tensor, which owns penalties/counts). It honors the
+    per-request extras the fused sampler supports at token 1 — seed,
+    logit_bias, min_p, and logprob recording — so a vision request's first
+    token follows the request's sampling rules and its logprob entries
+    align 1:1 with its tokens in the API's zip. presence/frequency count
+    previously SAMPLED tokens, so they are no-ops at token 1 by definition;
+    attach_sampling() then seeds the decode-state counts WITH this token so
+    later fused chunks penalize it like the text path does.
+
+    `sample_index` (the number of tokens sampled before this one) makes a
+    seeded request reproducible: the key derives from (seed, sample_index),
+    never from the engine-global call counter, which depends on unrelated
+    concurrent traffic."""
     def _sample() -> np.ndarray:
       import jax
-      from xotorch_tpu.ops.sampling import sample_logits
+      import jax.numpy as jnp
+      from xotorch_tpu.ops.sampling import sample_logits, sample_logits_logprobs
       logits = np.asarray(x)
       if logits.ndim == 3:
         logits = logits[:, -1, :]
       elif logits.ndim == 1:
         logits = logits[None, :]
       self._sample_calls += 1
-      key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
-      out = sample_logits(jax.numpy.asarray(logits), key, temp=temp, top_k=top_k, top_p=top_p)
+      s = sampling or {}
+      seed = s.get("seed")
+      if seed is not None:
+        key = jax.random.fold_in(jax.random.PRNGKey(int(seed)),
+                                 sample_index if sample_index is not None else 0)
+      else:
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+      bias = None
+      lb = s.get("logit_bias")
+      if lb:
+        V = logits.shape[-1]
+        pairs = [(int(t), float(v)) for t, v in lb.items() if 0 <= int(t) < V]
+        if pairs:
+          dense = np.zeros((1, V), np.float32)
+          dense[0, [p[0] for p in pairs]] = [p[1] for p in pairs]
+          bias = jnp.asarray(dense)
+      min_p = float(s["min_p"]) if s.get("min_p") else None
+      want_lp = s.get("logprobs")
+      jl = jnp.asarray(logits)
+      if want_lp is not None and request_id is not None:
+        tok, lp, top_ids, top_lps = sample_logits_logprobs(
+          jl, key, temp=temp, top_k=top_k, top_p=top_p, bias=bias,
+          min_p=min_p, top_lp=int(want_lp))
+        self._record_logprobs(request_id, np.asarray(lp), np.asarray(top_ids),
+                              np.asarray(top_lps))
+        out = tok
+      else:
+        out = sample_logits(jl, key, temp=temp, top_k=top_k, top_p=top_p,
+                            bias=bias, min_p=min_p)
       return np.asarray(out).astype(np.int64)
 
     return await self._run(_sample)
@@ -1228,6 +1273,38 @@ class JAXShardInferenceEngine(InferenceEngine):
     state.pos += true_t
     state.last_used = time.monotonic()
     return np.asarray(out[:, :true_t])
+
+  async def attach_sampling(self, shard: Shard, request_id: str, sampling: dict,
+                            sampled_tokens=()) -> None:
+    """Bind a request's sampling extras (seed/bias/penalties/logprobs) to
+    its decode state when the PREFILL path couldn't — the multimodal prefill
+    samples its first token on the host (engine.sample), so state.extras was
+    never built and the fused decode chunks would otherwise run extras-free
+    (no bias, no logprob recording) for the rest of the stream.
+    `sampled_tokens` are tokens already sampled outside the extras state
+    (the host-sampled first token): they seed the penalty counts so
+    presence/frequency treat them exactly as the text path does (which
+    counts its prefill-sampled token before decode). Idempotent; no-op when
+    the state is unknown or extras already exist."""
+    ctx = self._contexts.get(shard)
+    if ctx is None:
+      return
+    state = ctx.states.get(request_id)
+    if state is None or state.extras is not None:
+      return
+
+    def _attach() -> None:
+      if state.extras is not None:
+        return
+      extras = self._build_extras(ctx, sampling)
+      counts = extras.get("counts")
+      if counts is not None:
+        for t in sampled_tokens:
+          counts = counts.at[0, int(t) % ctx.cfg.vocab_size].add(1)
+        extras["counts"] = counts
+      state.extras = extras
+
+    await self._run(_attach)
 
   async def generate_chunk(
     self, request_id: str, shard: Shard, prev_token: int, num_tokens: int,

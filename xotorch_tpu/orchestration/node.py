@@ -576,10 +576,19 @@ class Node:
                                 inference_state)
       return
 
-    # Last layer: sample, then continue via the shared token path.
+    # Last layer: sample, then continue via the shared token path. Engines
+    # with the extras-aware host sampler get the request's sampling config
+    # (seed/bias/min_p/logprob recording) — the vision first-token path and
+    # fused decode then agree on sampling rules AND logprob entry counts.
+    sample_kwargs = {}
+    if self._host_sample_accepts_extras():
+      n_sampled = len(self.buffered_token_output.get(request_id, ((), 0))[0])
+      sample_kwargs = {"request_id": request_id,
+                       "sampling": self._request_sampling.get(request_id),
+                       "sample_index": n_sampled}
     token = await self.inference_engine.sample(
       result, temp=self._temp_for(request_id), top_k=self.default_sample_top_k,
-      top_p=self._top_p_for(request_id),
+      top_p=self._top_p_for(request_id), **sample_kwargs,
     )
     await self.process_sampled_token(
       base_shard, int(np.asarray(token).reshape(-1)[0]), request_id, inference_state
@@ -702,6 +711,18 @@ class Node:
     single-shard verify_draft executable must not interleave with
     multi-segment lockstep state, but the ring has its own composite
     verifier (engine.verify_draft_ring) with the same contract."""
+    s = self._request_sampling.get(request_id)
+    if s and ring_verify is None:
+      # A prefill that sampled on the host (multimodal) never bound the
+      # request's extras to its decode state — bind them now so the fused
+      # chunks apply bias/seed and record logprobs like any text request.
+      attach = getattr(self.inference_engine, "attach_sampling", None)
+      if attach is not None:
+        try:
+          await attach(shard, request_id, s, sampled_tokens=tuple(buffered))
+        except Exception as e:
+          if DEBUG >= 1:
+            print(f"[{request_id}] attach_sampling failed: {e!r}")
     # Speculation verifies drafts by plain greedy argmax — requests whose
     # extras RESHAPE the distribution (penalties/bias change even greedy
     # argmax) must not speculate or the verified tokens would ignore them;
@@ -919,6 +940,20 @@ class Node:
       except (TypeError, ValueError):
         self._engine_accepts_sampling = False
     return {"sampling": s} if self._engine_accepts_sampling else {}
+
+  def _host_sample_accepts_extras(self) -> bool:
+    """Does engine.sample accept request_id/sampling? Same cached signature
+    inspection as _sampling_kwargs, for the host sampling path."""
+    if getattr(self, "_host_sample_extras", None) is None:
+      import inspect
+      try:
+        params = inspect.signature(self.inference_engine.sample).parameters
+        self._host_sample_extras = (
+          "sampling" in params
+          or any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()))
+      except (TypeError, ValueError):
+        self._host_sample_extras = False
+    return self._host_sample_extras
 
   def pop_request_logprobs(self, request_id: str, n: Optional[int] = None) -> Optional[list]:
     """Drain the engine's recorded logprob entries for a request (OpenAI
