@@ -28,6 +28,8 @@ import urllib.request
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+from tests.xproc_harness import http_get, spawn_node, wait_for  # noqa: E402
 API_A, API_B = 52474, 52475
 UDP_A, UDP_B = 52484, 52485
 GRPC_A, GRPC_B = 52494, 52495
@@ -36,46 +38,19 @@ DECODE_TOKENS = int(os.getenv("XPROC_DECODE", "64"))
 
 
 def _spawn(node_id, api, listen, bcast, grpc, logfile):
-  env = {
-    **os.environ,
-    "PYTHONPATH": str(REPO),
-    "XOT_PLATFORM": "cpu",
-    "XOT_SKIP_JAX_PROBE": "1",
-    "PALLAS_AXON_POOL_IPS": "",
-    "JAX_COMPILATION_CACHE_DIR": os.environ.get(
-      "JAX_COMPILATION_CACHE_DIR", "/root/.cache/xot_jax_cache"),
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-    "PYTHONUNBUFFERED": "1",
-    # Per-token ring is the DELIBERATE subject: disable chunked decode so
-    # every token pays the wire (the co-located fused path would hide it).
-    "XOT_DECODE_CHUNK": "1",
-  }
-  return subprocess.Popen(
-    [sys.executable, "-m", "xotorch_tpu.main",
-     "--node-id", node_id, "--disable-tui", "--inference-engine", "jax",
-     "--default-model", MODEL,
-     "--chatgpt-api-port", str(api),
-     "--listen-port", str(listen), "--broadcast-port", str(bcast),
-     "--node-port", str(grpc), "--discovery-timeout", "8",
-     "--chatgpt-api-response-timeout", "600"],
-    env=env, stdout=logfile, stderr=subprocess.STDOUT, cwd=str(REPO))
+  # Per-token ring is the DELIBERATE subject: disable chunked decode so
+  # every token pays the wire (the co-located fused path would hide it).
+  return spawn_node(node_id, api, listen, bcast, grpc, logfile,
+                    model=MODEL, discovery_timeout=8, response_timeout=600,
+                    extra_env={"XOT_DECODE_CHUNK": "1"})
 
 
 def _get(port, path, timeout=5.0):
-  with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
-    return json.loads(r.read())
+  return http_get(port, path, timeout)
 
 
-def _wait(predicate, deadline_s, what):
-  t0 = time.monotonic()
-  while time.monotonic() - t0 < deadline_s:
-    try:
-      if predicate():
-        return
-    except Exception:
-      pass
-    time.sleep(1.0)
-  raise TimeoutError(what)
+def _wait(predicate, deadline_s, what, log_path=None, proc=None):
+  wait_for(predicate, deadline_s, what, log_path=log_path, proc=proc)
 
 
 def _decode_tok_s(port, n_tokens) -> float:
@@ -106,7 +81,8 @@ def main() -> None:
     logs["a"] = open("/tmp/xpb_a.log", "w")
     a = _spawn("xpb-a", API_A, UDP_A, UDP_B, GRPC_A, logs["a"])
     procs.append(a)
-    _wait(lambda: _get(API_A, "/healthcheck").get("status") == "ok", 90, "A health")
+    _wait(lambda: _get(API_A, "/healthcheck").get("status") == "ok", 90, "A health",
+          log_path="/tmp/xpb_a.log", proc=a)
     _wait(lambda: len(_get(API_A, "/v1/topology")["nodes"]) == 1, 30, "A solo topo")
     solo = _decode_tok_s(API_A, DECODE_TOKENS)
     result["solo_tok_s"] = round(solo, 2)
@@ -115,9 +91,11 @@ def main() -> None:
     logs["b"] = open("/tmp/xpb_b.log", "w")
     b = _spawn("xpb-b", API_B, UDP_B, UDP_A, GRPC_B, logs["b"])
     procs.append(b)
-    _wait(lambda: _get(API_B, "/healthcheck").get("status") == "ok", 90, "B health")
+    _wait(lambda: _get(API_B, "/healthcheck").get("status") == "ok", 90, "B health",
+          log_path="/tmp/xpb_b.log", proc=b)
     _wait(lambda: len(_get(API_A, "/v1/topology")["nodes"]) == 2
-          and len(_get(API_B, "/v1/topology")["nodes"]) == 2, 60, "2-node ring")
+          and len(_get(API_B, "/v1/topology")["nodes"]) == 2, 60, "2-node ring",
+          log_path="/tmp/xpb_b.log", proc=b)
     ring = _decode_tok_s(API_A, DECODE_TOKENS)
     result["ring2_xproc_tok_s"] = round(ring, 2)
     wire_ms = 1000.0 / ring - 1000.0 / solo

@@ -46,36 +46,10 @@ GRPC_A, GRPC_B = 52490, 52491
 
 def _spawn(node_id: str, api_port: int, listen: int, broadcast: int, grpc_port: int,
            logfile):
-  env = {
-    **os.environ,
-    "PYTHONPATH": str(REPO),
-    "XOT_PLATFORM": "cpu",
-    "XOT_SKIP_JAX_PROBE": "1",
-    # These CPU-pinned nodes must never touch a remote-TPU tunnel: the
-    # container's sitecustomize registers the tunneled backend in EVERY
-    # python process when this var is set, and its in-process relay can
-    # wedge the child when the tunnel is dead/contended (observed: chat
-    # requests hanging forever with axon relay threads in the process).
-    "PALLAS_AXON_POOL_IPS": "",
-    # Share the suite's persistent compile cache so each node's first
-    # forward loads the executable instead of recompiling.
-    "JAX_COMPILATION_CACHE_DIR": os.environ.get(
-      "JAX_COMPILATION_CACHE_DIR", "/root/.cache/xot_jax_cache"),
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-    "PYTHONFAULTHANDLER": "1",  # SIGABRT dumps all thread stacks to the log
-    "PYTHONUNBUFFERED": "1",    # node prints reach the log as they happen
-    "DEBUG": os.environ.get("XOT_XPROC_DEBUG", "0"),
-  }
-  return subprocess.Popen(
-    [sys.executable, "-m", "xotorch_tpu.main",
-     "--node-id", node_id, "--disable-tui",
-     "--inference-engine", "jax", "--default-model", "synthetic-tiny",
-     "--chatgpt-api-port", str(api_port),
-     "--listen-port", str(listen), "--broadcast-port", str(broadcast),
-     "--node-port", str(grpc_port),
-     "--discovery-timeout", "6",
-     "--chatgpt-api-response-timeout", "120"],
-    env=env, stdout=logfile, stderr=subprocess.STDOUT, cwd=str(REPO),
+  from tests.xproc_harness import spawn_node
+  return spawn_node(
+    node_id, api_port, listen, broadcast, grpc_port, logfile,
+    extra_env={"DEBUG": os.environ.get("XOT_XPROC_DEBUG", "0")},
   )
 
 
