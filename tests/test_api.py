@@ -642,3 +642,27 @@ async def test_image_generations_honest_501():
     assert "not supported" in (await resp.json())["error"]["message"]
   finally:
     await client.close()
+
+
+async def test_modelpool_streams_sse_status():
+  """/modelpool is an SSE stream of per-model download status ending with
+  [DONE] (reference wire shape, chatgpt_api.py:268-283; tinychat's
+  pollModelPool consumes it via EventSource)."""
+  import json as _json
+
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.get("/modelpool")
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    body = (await resp.read()).decode()
+    events = [ln[len("data: "):] for ln in body.split("\n\n") if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    seen = {}
+    for e in events[:-1]:
+      seen.update(_json.loads(e))
+    assert "dummy" in seen
+    entry = seen["dummy"]
+    assert {"name", "layers", "downloaded"} <= set(entry)
+  finally:
+    await client.close()

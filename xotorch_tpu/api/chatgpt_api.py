@@ -164,6 +164,19 @@ class ChatGPTAPI:
     response.headers["Access-Control-Allow-Headers"] = "*"
     return response
 
+  @staticmethod
+  def _sse_headers() -> dict:
+    """Headers for PREPARED StreamResponses: response.prepare() sends the
+    header block immediately, so the CORS middleware's post-handler header
+    mutation never reaches the wire — every SSE endpoint must carry the
+    permissive-CORS set itself (a cross-origin EventSource fails its CORS
+    check otherwise)."""
+    return {
+      "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+      "Access-Control-Allow-Origin": "*", "Access-Control-Allow-Methods": "*",
+      "Access-Control-Allow-Headers": "*",
+    }
+
   # --------------------------------------------------------------- routes
 
   async def handle_root(self, request):
@@ -272,13 +285,30 @@ class ChatGPTAPI:
     return web.json_response({"object": "list", "data": models})
 
   async def handle_model_support(self, request):
-    models = {}
-    for model_id in self.node.get_supported_models_for_cluster():
-      card = get_model_card(model_id) or {}
-      if self.inference_engine_classname not in card.get("repo", {}):
-        continue
-      models[model_id] = {"name": pretty_name(model_id), "layers": card.get("layers")}
-    return web.json_response({"model pool": models})
+    """/modelpool: SSE stream of per-model download status ending with
+    [DONE] — the reference's wire shape (chatgpt_api.py:268-283, EventSource
+    consumer index.js:92-118). Each event is {model_id: {name, layers,
+    downloaded, download_percentage, total_size, total_downloaded}}; status
+    comes from the shared on-disk completeness rule, scanned off the event
+    loop like /initial_models."""
+    from xotorch_tpu.download.hf_shard_download import local_model_status
+
+    cards = [(model_id, get_model_card(model_id) or {})
+             for model_id in self.node.get_supported_models_for_cluster()]
+    cards = [(m, c) for m, c in cards
+             if self.inference_engine_classname in c.get("repo", {})]
+    response = web.StreamResponse(status=200, headers=self._sse_headers())
+    await response.prepare(request)
+    loop = asyncio.get_running_loop()
+    for model_id, card in cards:
+      status = await loop.run_in_executor(
+        None, local_model_status, model_id, self.inference_engine_classname)
+      event = {model_id: {"name": pretty_name(model_id), "layers": card.get("layers"),
+                          **status}}
+      await response.write(f"data: {json.dumps(event)}\n\n".encode())
+    await response.write(b"data: [DONE]\n\n")
+    await response.write_eof()
+    return response
 
   async def handle_get_initial_models(self, request):
     from xotorch_tpu.download.hf_shard_download import local_model_status
@@ -683,9 +713,7 @@ class ChatGPTAPI:
     choice finishes, a tail of max(len(stop))-1 chars is held back so a
     stop split across chunks is caught before any of it reaches the
     client; `sent[i]` tracks what choice i emitted."""
-    response = web.StreamResponse(status=200, headers={
-      "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
-    })
+    response = web.StreamResponse(status=200, headers=self._sse_headers())
     await response.prepare(request)
     eos_ids = self._eos_ids(tokenizer)
     acc = ["" for _ in request_ids]

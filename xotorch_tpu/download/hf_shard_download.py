@@ -346,7 +346,7 @@ def local_model_status(model_id: str, inference_engine_name: str) -> Dict:
   if repo_id is None:
     return {"downloaded": False, "download_percentage": None,
             "total_size": None, "total_downloaded": 0}
-  if repo_id == "synthetic":
+  if repo_id in ("synthetic", "dummy"):
     return {"downloaded": True, "download_percentage": 100,
             "total_size": 0, "total_downloaded": 0}
   target = models_dir() / repo_id.replace("/", "--")
