@@ -1,0 +1,67 @@
+"""Registry model-support negotiation + tokenizer round-trip.
+
+Parity: /root/reference/test/test_model_helpers.py (the get_supported_models
+case matrix — intersection over per-peer engine lists, short AND class
+names) and the round-trip property of /root/reference/test/test_tokenizers.py
+(decode(encode(text)) reconstructs the text) — theirs loops over live HF
+repos; this container is zero-egress, so the round-trip runs against the
+checkpoint drill's real on-disk fast tokenizer instead.
+"""
+import pytest
+
+from xotorch_tpu.models.registry import get_supported_models, model_cards
+
+
+def _expand(engine_lists):
+  from xotorch_tpu.inference.engine import inference_engine_classes
+  return [[inference_engine_classes.get(e, e) for e in lst] for lst in engine_lists]
+
+
+CASES = [
+  # (name, engine_lists, must_contain, min_count, exact_count)
+  ("single_jax_engine", [["jax"]],
+   ["llama-3.2-1b", "llama-3.1-70b", "mistral-nemo"], 10, None),
+  ("multiple_engines_or", [["jax", "dummy"], ["jax"]],
+   ["llama-3.2-1b", "llama-3.2-3b", "mistral-nemo"], 10, None),
+  ("no_engines", [], None, None, len(model_cards)),
+  ("nonexistent_engine", [["NonexistentEngine"]], [], None, 0),
+  ("dummy_engine", [["dummy"]], ["dummy"], None, 1),
+]
+
+
+@pytest.mark.parametrize("name,lists,contains,min_count,exact", CASES,
+                         ids=[c[0] for c in CASES])
+def test_get_supported_models_short_and_class_names(name, lists, contains, min_count, exact):
+  for variant in (lists, _expand(lists)):
+    result = get_supported_models(variant)
+    for model in contains or []:
+      assert model in result, (name, model)
+    if min_count is not None:
+      assert len(result) > min_count, (name, len(result))
+    if exact is not None:
+      assert len(result) == exact, (name, len(result))
+
+
+def test_heterogeneous_peers_intersect():
+  """Intersection semantics: a jax peer and a dummy-only peer share NO
+  servable model (no card carries both engines), and a peer offering both
+  engines intersected with a jax-only peer yields exactly the jax set."""
+  assert get_supported_models([["jax"], ["dummy"]]) == []
+  both = get_supported_models([["jax", "dummy"], ["jax"]])
+  assert both == get_supported_models([["jax"]])
+
+
+async def test_tokenizer_roundtrip_on_disk(tmp_path):
+  """resolve_tokenizer on a seeded real-file tokenizer reconstructs the
+  input text token-by-token (the reference's tokenizer suite property)."""
+  from tests.test_checkpoint_drill import _write_tokenizer
+  from xotorch_tpu.inference.tokenizers import resolve_tokenizer
+
+  _write_tokenizer(tmp_path)
+  tok = await resolve_tokenizer(str(tmp_path))
+  text = "hello world ring check ok yes no"
+  encoded = tok.encode(text)
+  assert len(encoded) == len(text.split())
+  assert tok.decode(encoded) == text
+  reconstructed = " ".join(tok.decode([t]) for t in encoded)
+  assert reconstructed == text
