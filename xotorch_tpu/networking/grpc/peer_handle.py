@@ -21,6 +21,11 @@ from xotorch_tpu.topology.topology import Topology
 from xotorch_tpu.utils.helpers import DEBUG
 
 
+# In-flight graceful channel closes (see disconnect): strong refs so the
+# drain tasks survive GC for their full grace window.
+_GRACEFUL_CLOSES: set = set()
+
+
 class GRPCPeerHandle(PeerHandle):
   def __init__(self, _id: str, address: str, desc: str, device_capabilities: DeviceCapabilities):
     self._id = _id
@@ -68,11 +73,25 @@ class GRPCPeerHandle(PeerHandle):
   async def is_connected(self) -> bool:
     return self.channel is not None and self.channel.get_state() == grpc.ChannelConnectivity.READY
 
-  async def disconnect(self) -> None:
-    if self.channel is not None:
-      await self.channel.close()
-    self.channel = None
-    self._stubs = {}
+  async def disconnect(self, grace: Optional[float] = None) -> None:
+    """Close the channel. With `grace`, the close happens on a DETACHED task
+    that lets in-flight RPCs drain first (grpc.aio cancels every active call
+    the moment a channel closes): discovery replacing a peer's address
+    mid-request — e.g. the same peer re-seen via a higher-priority NIC —
+    must not kill a pipelined training step or a long hop riding the old
+    channel. New calls go through the replacement handle either way."""
+    ch, self.channel, self._stubs = self.channel, None, {}
+    if ch is None:
+      return
+    if grace:
+      # Strong-ref the drain task: the loop only holds weak refs, and a
+      # GC'd task would tear the channel down mid-drain — the exact
+      # cancellation the grace path exists to prevent.
+      task = asyncio.get_running_loop().create_task(ch.close(grace))
+      _GRACEFUL_CLOSES.add(task)
+      task.add_done_callback(_GRACEFUL_CLOSES.discard)
+    else:
+      await ch.close()
 
   async def health_check(self) -> bool:
     try:

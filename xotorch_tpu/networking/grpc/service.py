@@ -32,6 +32,15 @@ CHANNEL_OPTIONS = [
   ("grpc.keepalive_time_ms", 10000),
   ("grpc.keepalive_timeout_ms", 5000),
   ("grpc.http2.max_pings_without_data", 0),
+  # Server-side ping policing must PERMIT the 10 s client keepalive during
+  # long unary calls that stream no DATA frames for minutes (a pipelined
+  # train step compiles + runs for tens of seconds): without these, the
+  # server's default 5-minute minimum ping interval counts each keepalive
+  # as a strike and GOAWAYs the channel with ENHANCE_YOUR_CALM
+  # ("too_many_pings"), killing the in-flight RPC.
+  ("grpc.keepalive_permit_without_calls", 1),
+  ("grpc.http2.min_ping_interval_without_data_ms", 5000),
+  ("grpc.http2.max_ping_strikes", 0),
   ("grpc.max_concurrent_streams", -1),
   ("grpc.tcp_nodelay", 1),
   ("grpc.optimization_target", "throughput"),

@@ -58,7 +58,7 @@ class InProcessPeerHandle(PeerHandle):
   async def is_connected(self) -> bool:
     return True
 
-  async def disconnect(self) -> None:
+  async def disconnect(self, grace=None) -> None:
     pass
 
   async def health_check(self) -> bool:

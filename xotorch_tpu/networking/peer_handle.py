@@ -42,7 +42,7 @@ class PeerHandle(ABC):
     ...
 
   @abstractmethod
-  async def disconnect(self) -> None:
+  async def disconnect(self, grace: "Optional[float]" = None) -> None:
     ...
 
   @abstractmethod

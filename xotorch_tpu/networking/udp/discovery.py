@@ -256,7 +256,11 @@ class UDPDiscovery(Discovery):
       return
     if replacing is not None:
       try:
-        await replacing.disconnect()
+        # Graceful: the SAME peer re-admitted via a better interface must
+        # not cancel RPCs still riding the old channel (a pipelined train
+        # step or a slow first hop compiles for tens of seconds) — the old
+        # channel drains detached while new calls use the new handle.
+        await replacing.disconnect(grace=600.0)
       except Exception:
         pass
     self.known_peers[peer_id] = (handle, message.get("interface_name", "?"), time.time(), priority)

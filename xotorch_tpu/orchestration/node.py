@@ -1262,7 +1262,23 @@ class Node:
     next_ids = {p.id() for p in next_peers}
     peers_added = [p for p in next_peers if p.id() not in current_ids]
     peers_removed = [p for p in self.peers if p.id() not in next_ids]
-    peers_kept = [p for p in self.peers if p.id() in next_ids]
+    # Keep known peers, but ADOPT discovery's replacement handle when the
+    # peer's address changed (re-admitted via a better NIC): the old handle
+    # was gracefully disconnected by discovery and reconnecting it would
+    # dial the address that just lost. Adopted handles lazy-connect on
+    # first call.
+    by_id = {p.id(): p for p in next_peers}
+    peers_kept = []
+    for p in self.peers:
+      if p.id() not in next_ids:
+        continue
+      replacement = by_id[p.id()]
+      if replacement is not p and replacement.addr() != p.addr():
+        if DEBUG >= 1:
+          print(f"Peer {p.id()} address changed {p.addr()} -> {replacement.addr()}; adopting new handle")
+        peers_kept.append(replacement)
+      else:
+        peers_kept.append(p)
 
     async def _connect(peer):
       try:
@@ -1275,7 +1291,11 @@ class Node:
 
     async def _disconnect(peer):
       try:
-        await asyncio.wait_for(peer.disconnect(), timeout=5.0)
+        # Graceful: eviction can race an in-flight RPC on a peer that is
+        # flapping rather than dead; cancelling it mid-call would abort a
+        # healthy request. Returns immediately (the drain runs detached),
+        # so no timeout is needed here.
+        await peer.disconnect(grace=600.0)
       except Exception as e:
         if DEBUG >= 2:
           print(f"Failed to disconnect {peer.id()}: {e!r}")
