@@ -122,10 +122,11 @@ class GRPCPeerHandle(PeerHandle):
     )
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
-                         train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
+                         train: bool, request_id: Optional[str] = None,
+                         ring_map: Optional[list] = None) -> Optional[Tuple[float, np.ndarray]]:
     fields, tensors = await self._call(
       "SendExample",
-      {"shard": shard.to_dict(), "train": train, "request_id": request_id},
+      {"shard": shard.to_dict(), "train": train, "request_id": request_id, "ring_map": ring_map},
       {"example": example, "target": target, "length": length},
       timeout=600.0,
     )

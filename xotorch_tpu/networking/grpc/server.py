@@ -77,7 +77,8 @@ class GRPCServer(Server):
     fields, tensors = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
     loss, grads = await self.node.process_example(
-      shard, tensors["example"], tensors["target"], tensors["length"], fields["train"], fields.get("request_id")
+      shard, tensors["example"], tensors["target"], tensors["length"], fields["train"],
+      fields.get("request_id"), ring_map=fields.get("ring_map"),
     )
     if grads is None:
       return encode_message({"loss": float(loss)})

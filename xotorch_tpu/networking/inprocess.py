@@ -82,8 +82,10 @@ class InProcessPeerHandle(PeerHandle):
     self._spawn(self.node.process_tensor(shard, tensor, request_id, inference_state))
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
-                         train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
-    loss, grads = await self.node.process_example(shard, example, target, length, train, request_id)
+                         train: bool, request_id: Optional[str] = None,
+                         ring_map: Optional[list] = None) -> Optional[Tuple[float, np.ndarray]]:
+    loss, grads = await self.node.process_example(shard, example, target, length, train, request_id,
+                                                  ring_map=ring_map)
     return (loss, grads) if loss is not None else None
 
   async def send_result(self, request_id: str, result, is_finished: bool,

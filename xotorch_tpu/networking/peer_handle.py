@@ -63,7 +63,8 @@ class PeerHandle(ABC):
 
   @abstractmethod
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
-                         train: bool, request_id: Optional[str] = None) -> Optional[Tuple[float, np.ndarray]]:
+                         train: bool, request_id: Optional[str] = None,
+                         ring_map: Optional[list] = None) -> Optional[Tuple[float, np.ndarray]]:
     ...
 
   @abstractmethod

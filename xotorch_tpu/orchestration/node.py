@@ -1151,29 +1151,49 @@ class Node:
   async def enqueue_example(self, base_shard: Shard, example: np.ndarray, target: np.ndarray,
                             length: np.ndarray, train: bool = False,
                             request_id: Optional[str] = None) -> Tuple[float, Optional[np.ndarray]]:
-    """Route an example to the partition-0 owner (parity node.py:210-228)."""
-    shard = self.get_current_shard(base_shard)
+    """Route an example to the partition-0 owner (parity node.py:210-228).
+    Pins the example's ring map (RING_MAP_KEY) like a serving request: every
+    peer must run the layer range THIS node's view assigns, or a peer whose
+    gossip lags processes the example against the wrong partitioning — the
+    observed failure was a peer running the FULL model for an example the
+    origin had pipelined, silently applying its optimizer update to an
+    orphaned context."""
+    if request_id is None:
+      request_id = str(uuid.uuid4())
+    self._pin_ring_map(base_shard, request_id)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     if shard.is_first_layer:
       return await self.process_example(base_shard, example, target, length, train, request_id)
     index = self.get_partition_index_of_first_layer()
-    partitions = self.partitioning_strategy.partition(self.topology)
-    target_id = partitions[index].node_id
+    target_id = self._ring_target_id(index, request_id)
     peer = next((p for p in self.peers if p.id() == target_id), None)
     if peer is None:
       raise ValueError(f"No peer for first-layer partition {index}")
-    result = await peer.send_example(self.get_current_shard(base_shard, index), example, target, length, train, request_id)
+    try:
+      result = await peer.send_example(
+        self.get_current_shard(base_shard, index, request_id=request_id),
+        example, target, length, train, request_id,
+        ring_map=self._ring_entries(request_id))
+    finally:
+      # Training is strictly request/response: the pinned row is dead once
+      # the example returns, and leaving it would churn the bounded LRU
+      # under long training loops (evicting live SERVING requests' maps).
+      self._request_ring_map.pop(request_id, None)
     if result is None:
       raise RuntimeError(f"Peer {target_id} returned no loss for example {request_id}")
     return result
 
   async def process_example(self, base_shard: Shard, example: np.ndarray, target: np.ndarray,
                             length: np.ndarray, train: bool = False,
-                            request_id: Optional[str] = None) -> Tuple[float, Optional[np.ndarray]]:
+                            request_id: Optional[str] = None,
+                            ring_map: Optional[list] = None) -> Tuple[float, Optional[np.ndarray]]:
     """Run this shard's slice of a training/eval example; recurse down the
     ring and chain gradients back up (parity node.py:254-345)."""
-    shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
+    if ring_map and request_id not in self._request_ring_map:
+      self._set_ring_map(request_id, ring_map)
+    shard = self.get_current_shard(base_shard, request_id=request_id)
     start_ns = time.perf_counter_ns()
     status_kind = "train_example" if train else "eval_example"
     self._spawn(self.broadcast_opaque_status(request_id, json.dumps({
@@ -1194,6 +1214,7 @@ class Node:
         )
         return loss, None
     finally:
+      self._request_ring_map.pop(request_id, None)  # request/response: row is dead
       self._spawn(self.broadcast_opaque_status(request_id, json.dumps({
         "type": "node_status", "node_id": self.id, "status": f"end_{status_kind}",
         "request_id": request_id, "elapsed_time_ns": time.perf_counter_ns() - start_ns,
@@ -1203,16 +1224,16 @@ class Node:
     """Downstream hop for pipelined training: ships activations to the next
     partition, returns (loss, grad_wrt_activations)."""
     async def forward(activations: np.ndarray, target: np.ndarray, length: np.ndarray, train: bool):
-      next_index = self.get_partition_index(offset=1)
-      partitions = self.partitioning_strategy.partition(self.topology)
-      target_id = partitions[next_index].node_id
-      next_shard = self.get_current_shard(base_shard, next_index)
+      next_index = self.get_partition_index(offset=1, request_id=request_id)
+      target_id = self._ring_target_id(next_index, request_id)
+      next_shard = self.get_current_shard(base_shard, next_index, request_id=request_id)
       if target_id == self.id:
         return await self.process_example(base_shard, activations, target, length, train, request_id)
       peer = next((p for p in self.peers if p.id() == target_id), None)
       if peer is None:
         raise ValueError(f"No peer for partition {next_index}")
-      result = await peer.send_example(next_shard, activations, target, length, train, request_id)
+      result = await peer.send_example(next_shard, activations, target, length, train, request_id,
+                                       ring_map=self._ring_entries(request_id))
       if result is None:
         raise RuntimeError(f"Peer {target_id} returned no loss for example {request_id}")
       return result
