@@ -85,11 +85,11 @@ def _wait_nodes(port: int, n: int, deadline_s: float = 60.0) -> None:
   raise TimeoutError(f":{port} topology never reached {n} nodes (last: {last})")
 
 
-def _chat_tokens(port: int, timeout: float = 180.0) -> list:
+def _chat_tokens(port: int, timeout: float = 180.0, content: str = "ring check") -> list:
   """Greedy completion -> token ids via logprobs (deterministic at temp 0)."""
   body = json.dumps({
     "model": "synthetic-tiny",
-    "messages": [{"role": "user", "content": "ring check"}],
+    "messages": [{"role": "user", "content": content}],
     "max_tokens": 8, "temperature": 0, "logprobs": True,
   }).encode()
   req = urllib.request.Request(
@@ -153,19 +153,8 @@ def test_ring_reconnect_stream_equality(tmp_path):
     t_reformed = _chat_tokens(API_A)
     assert t_reformed == t_solo, "reformed ring diverged"
   finally:
-    for p in procs.values():
-      if p.poll() is None:
-        p.terminate()
-    for p in procs.values():
-      try:
-        p.wait(timeout=10)
-      except subprocess.TimeoutExpired:
-        p.kill()
-    for f in logs.values():
-      try:
-        f.close()
-      except Exception:
-        pass
+    from tests.xproc_harness import teardown_nodes
+    teardown_nodes(procs, logs)
 
 
 def _run_train(extra_args, api, listen, bcast, grpc, logpath, timeout=420):
@@ -220,3 +209,42 @@ def test_two_process_pipelined_training_matches_solo(tmp_path):
       except subprocess.TimeoutExpired:
         a.kill()
   assert ring == solo, f"pipelined losses diverged: {ring} vs {solo}"
+
+
+def test_concurrent_requests_through_xproc_ring(tmp_path):
+  """Six concurrent chat requests through a 2-process gRPC ring: hops from
+  different requests interleave on both peers, and every stream must equal
+  the sequential answer (continuous batching + per-request ring maps must
+  not cross wires under real network concurrency)."""
+  import concurrent.futures
+
+  from tests.xproc_harness import http_get, teardown_nodes, wait_for
+
+  logs = {}
+  procs = {}
+  try:
+    for name, api, listen, bcast, grpc in (
+        ("xcc-a", 52466, 52456, 52457, 52446), ("xcc-b", 52467, 52457, 52456, 52447)):
+      logs[name] = open(tmp_path / f"{name}.log", "w")
+      procs[name] = _spawn(name, api, listen, bcast, grpc, logs[name])
+    wait_for(lambda: http_get(52466, "/healthcheck").get("status") == "ok", 90,
+             "A health", log_path=tmp_path / "xcc-a.log", proc=procs["xcc-a"])
+    wait_for(lambda: http_get(52467, "/healthcheck").get("status") == "ok", 90,
+             "B health", log_path=tmp_path / "xcc-b.log", proc=procs["xcc-b"])
+    wait_for(lambda: len(http_get(52466, "/v1/topology")["nodes"]) == 2
+             and len(http_get(52467, "/v1/topology")["nodes"]) == 2, 60,
+             "2-node ring", log_path=tmp_path / "xcc-b.log")
+
+    def chat(i):
+      return _chat_tokens(52466, timeout=240.0, content=f"concurrent probe {i % 2}")
+
+    seq0, seq1 = chat(0), chat(1)   # sequential ground truth (also warmup)
+    assert len(seq0) == 8 and len(seq1) == 8
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as pool:
+      results = list(pool.map(chat, range(6)))
+    for i, r in enumerate(results):
+      want = seq0 if i % 2 == 0 else seq1
+      assert r == want, f"concurrent stream {i} diverged:\n{r}\nvs\n{want}"
+  finally:
+    teardown_nodes(procs, logs)

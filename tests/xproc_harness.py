@@ -92,3 +92,21 @@ def wait_for(predicate, deadline_s: float, what: str, log_path=None,
       pass
     time.sleep(1.0)
   raise TimeoutError(f"{what} (after {deadline_s:.0f}s){_log_tail(log_path)}")
+
+
+def teardown_nodes(procs, logs) -> None:
+  """Uniform child teardown: terminate all, wait-or-kill all, close logs.
+  Shared by every multi-process test so a teardown fix lands once."""
+  for p in procs.values():
+    if p.poll() is None:
+      p.terminate()
+  for p in procs.values():
+    try:
+      p.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+      p.kill()
+  for f in logs.values():
+    try:
+      f.close()
+    except Exception:
+      pass
