@@ -45,11 +45,11 @@ GRPC_A, GRPC_B = 52490, 52491
 
 
 def _spawn(node_id: str, api_port: int, listen: int, broadcast: int, grpc_port: int,
-           logfile):
+           logfile, debug: str = None):
   from tests.xproc_harness import spawn_node
   return spawn_node(
     node_id, api_port, listen, broadcast, grpc_port, logfile,
-    extra_env={"DEBUG": os.environ.get("XOT_XPROC_DEBUG", "0")},
+    extra_env={"DEBUG": debug or os.environ.get("XOT_XPROC_DEBUG", "0")},
   )
 
 
@@ -246,5 +246,64 @@ def test_concurrent_requests_through_xproc_ring(tmp_path):
     for i, r in enumerate(results):
       want = seq0 if i % 2 == 0 else seq1
       assert r == want, f"concurrent stream {i} diverged:\n{r}\nvs\n{want}"
+  finally:
+    teardown_nodes(procs, logs)
+
+
+def test_three_process_ring_with_mid_relay(tmp_path):
+  """3-process ring: the middle partition holds neither embedding nor
+  sampler — it relays hidden states over gRPC in both its in- and out-hops.
+  The full greedy stream must equal the solo answer, and all three nodes'
+  views must converge (4-layer model -> 2/1/1 layer split)."""
+  from tests.xproc_harness import http_get, teardown_nodes, wait_for
+
+  # All three nodes share ONE discovery port (SO_REUSEPORT + broadcast
+  # datagrams reach every binder): the realistic same-LAN config, and the
+  # only one that gives full-mesh peer handles — a directed a->b->c->a
+  # port ring would leave each node with a single inbound peer.
+  ports = {  # name -> (api, listen, bcast, grpc)
+    "x3-a": (52440, 52430, 52430, 52420),
+    "x3-b": (52441, 52430, 52430, 52421),
+    "x3-c": (52442, 52430, 52430, 52422),
+  }
+  logs = {}
+  procs = {}
+  try:
+    # Solo ground truth from a single node first.
+    name = "x3-a"
+    api, listen, bcast, grpc = ports[name]
+    logs[name] = open(tmp_path / f"{name}.log", "w")
+    procs[name] = _spawn(name, api, listen, bcast, grpc, logs[name], debug="1")
+    wait_for(lambda: http_get(api, "/healthcheck").get("status") == "ok", 90,
+             "A health", log_path=tmp_path / f"{name}.log", proc=procs[name])
+    t_solo = _chat_tokens(api)
+
+    for name in ("x3-b", "x3-c"):
+      napi, nlisten, nbcast, ngrpc = ports[name]
+      logs[name] = open(tmp_path / f"{name}.log", "w")
+      procs[name] = _spawn(name, napi, nlisten, nbcast, ngrpc, logs[name], debug="1")
+    for name, (napi, *_rest) in ports.items():
+      wait_for(lambda p=napi: len(http_get(p, "/v1/topology")["nodes"]) == 3, 90,
+               f"{name} sees 3 nodes", log_path=tmp_path / f"{name}.log",
+               proc=procs[name])
+
+    t_ring3 = _chat_tokens(api, timeout=240.0)
+    assert t_ring3 == t_solo, f"3-process ring diverged:\n{t_ring3}\nvs\n{t_solo}"
+
+    # Pin the claimed coverage: the three engines really served a 3-way
+    # split of the 4 layers with a STRICT middle partition (neither
+    # embedding nor sampler) — the relay path, not some degenerate layout.
+    import re as _re
+    shards = set()
+    for name in ports:
+      logs[name].flush()
+      for m in _re.finditer(r"ready for Shard\(model_id='synthetic-tiny', start_layer=(\d+), end_layer=(\d+)",
+                            (tmp_path / f"{name}.log").read_text()):
+        shards.add((int(m.group(1)), int(m.group(2))))
+    ring_shards = sorted(s_ for s_ in shards if s_ != (0, 3))  # drop the solo-phase full shard
+    assert len(ring_shards) == 3, f"expected a 3-way split, saw {sorted(shards)}"
+    assert ring_shards[0][0] == 0 and ring_shards[-1][1] == 3
+    mid = ring_shards[1]
+    assert mid[0] > 0 and mid[1] < 3, f"no strict mid relay partition: {ring_shards}"
   finally:
     teardown_nodes(procs, logs)
