@@ -102,6 +102,39 @@ def landed(tag: str, key_metric: str) -> bool:
   return rec.get("platform") == "tpu" and rec.get(key_metric) is not None
 
 
+def foreign_bench_running() -> bool:
+  """True when a bench.py WE didn't spawn is running — the driver's official
+  end-of-round run. Only one process may claim the tunneled TPU at a time
+  (concurrent claimers queue/hang), so the harvest loop must stand down
+  rather than contend with the run that produces BENCH_r05.json."""
+  me = os.getpid()
+  for entry in os.listdir("/proc"):
+    if not entry.isdigit() or int(entry) == me:
+      continue
+    try:
+      with open(f"/proc/{entry}/cmdline", "rb") as fp:
+        argv = fp.read().decode(errors="replace").split("\0")
+      with open(f"/proc/{entry}/stat") as fp:
+        stat = fp.read()
+      # stat format: pid (comm) state ppid ... — comm may contain spaces,
+      # so split only AFTER the closing paren.
+      ppid = int(stat.rsplit(") ", 1)[1].split()[1])
+    except (OSError, ValueError, IndexError):
+      continue  # raced a process exit / unparseable
+    # A real interpreter invocation of THE bench script (argv[0] is python,
+    # some arg's basename is exactly bench.py) — not a shell whose -c
+    # string mentions it, and not e.g. xproc_ring_bench.py (CPU-only).
+    if not (argv and "python" in os.path.basename(argv[0])
+            and any(os.path.basename(a) == "bench.py" for a in argv[1:])):
+      continue
+    if ppid == me:
+      continue  # our own harvest child
+    if "--child" in argv and ppid == 1:
+      continue  # orphaned bench worker (reparented to init), not a driver run
+    return True
+  return False
+
+
 def tunnel_alive() -> bool:
   """Cheap probe: can a fresh process see the TPU inside 150 s?"""
   code = "import jax; ds = jax.devices(); assert ds and ds[0].platform != 'cpu', ds"
@@ -117,12 +150,23 @@ def run_step(tag: str, extra_env: dict) -> bool:
   env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}}
   log(f"step {tag}: {extra_env}")
   t0 = time.time()
+  # Own process group so a timeout kills bench.py AND its --child worker —
+  # an orphaned worker would otherwise trip foreign_bench_running forever.
+  popen = subprocess.Popen([sys.executable, str(REPO / "bench.py")], env=env,
+                           stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                           text=True, start_new_session=True)
   try:
-    proc = subprocess.run([sys.executable, str(REPO / "bench.py")], env=env,
-                          capture_output=True, text=True, timeout=5400)
+    stdout, stderr = popen.communicate(timeout=5400)
   except subprocess.TimeoutExpired:
+    import signal as _signal
+    try:
+      os.killpg(popen.pid, _signal.SIGKILL)
+    except OSError:
+      pass
+    popen.wait()
     log(f"step {tag}: timed out")
     return False
+  proc = subprocess.CompletedProcess(popen.args, popen.returncode, stdout, stderr)
   result = None
   for ln in reversed(proc.stdout.strip().splitlines()):
     try:
@@ -150,12 +194,19 @@ def main() -> None:
       log("all measurements landed; done")
       return
     log(f"pending: {[t for t, _, _ in pending]}")
+    if foreign_bench_running():
+      log("driver bench.py running; standing down for 120s")
+      time.sleep(120)
+      continue
     if not tunnel_alive():
       log(f"tunnel dead; sleeping {PROBE_INTERVAL_S:.0f}s")
       time.sleep(PROBE_INTERVAL_S)
       continue
     log("tunnel live")
     for tag, env, _ in pending:
+      if foreign_bench_running():
+        log("driver bench.py appeared; standing down mid-harvest")
+        break
       if not run_step(tag, env):
         log("step fell off TPU; back to probing")
         break
