@@ -168,7 +168,7 @@ def _run_train(extra_args, api, listen, bcast, grpc, logpath, timeout=420):
        "--save-every", "0",
        "--chatgpt-api-port", str(api),
        "--listen-port", str(listen), "--broadcast-port", str(bcast),
-       "--node-port", str(grpc), "--discovery-timeout", "6",
+       "--node-port", str(grpc), "--discovery-timeout", "15",
        *extra_args],
       env=node_env(DEBUG=os.environ.get("XOT_XPROC_DEBUG", "0")), stdout=lf, stderr=subprocess.STDOUT, cwd=str(REPO),
       timeout=timeout,

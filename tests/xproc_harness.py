@@ -45,7 +45,7 @@ def node_env(**overrides) -> dict:
 
 def spawn_node(node_id: str, api_port: int, listen: int, broadcast: int,
                grpc_port: int, logfile, *, model: str = "synthetic-tiny",
-               discovery_timeout: int = 6, response_timeout: int = 120,
+               discovery_timeout: int = 15, response_timeout: int = 120,
                extra_args=(), extra_env=None) -> subprocess.Popen:
   env = node_env(**(extra_env or {}))
   return subprocess.Popen(

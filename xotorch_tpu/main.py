@@ -211,7 +211,7 @@ def _wire_events(node: Node, engine, engine_classname: str, topology_viz, downlo
         base_shard = Shard.from_dict(data.get("base_shard", {}))
         if data.get("node_id") != node.id:
           current = node.get_current_shard(base_shard)
-          asyncio.create_task(engine.ensure_shard(current))
+          node._spawn(engine.ensure_shard(current))
     except Exception as e:
       if DEBUG >= 2:
         print(f"preemptive load error: {e!r}")
@@ -227,7 +227,7 @@ def _wire_events(node: Node, engine, engine_classname: str, topology_viz, downlo
       return
     last_broadcast["t"] = now
     payload = event.to_dict() if hasattr(event, "to_dict") else dict(event)
-    asyncio.create_task(node.broadcast_opaque_status("", json.dumps({
+    node._spawn(node.broadcast_opaque_status("", json.dumps({
       "type": "download_progress", "node_id": node.id, "progress": payload,
     })))
 

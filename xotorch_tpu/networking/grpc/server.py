@@ -17,7 +17,7 @@ from xotorch_tpu.networking.codec import decode_message, encode_message
 from xotorch_tpu.networking.grpc.service import CHANNEL_OPTIONS, METHODS, SERVICE_NAME
 from xotorch_tpu.networking.server import Server
 from xotorch_tpu.topology.topology import Topology
-from xotorch_tpu.utils.helpers import DEBUG
+from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
 
 
 class GRPCServer(Server):
@@ -26,6 +26,12 @@ class GRPCServer(Server):
     self.host = host
     self.port = port
     self.server: Optional[grpc.aio.Server] = None
+    # Strong refs for detached hop tasks (asyncio keeps only weak refs; a
+    # GC'd task would silently drop an in-flight prompt/tensor hop).
+    self._detached: set = set()
+
+  def _spawn(self, coro) -> "asyncio.Task":
+    return spawn_detached(coro, self._detached)
 
   async def start(self) -> None:
     self.server = grpc.aio.server(options=CHANNEL_OPTIONS)
@@ -57,7 +63,7 @@ class GRPCServer(Server):
     fields, tensors = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
     images = [tensors[f"image_{i}"] for i in range(fields.get("n_images") or 0)] or None
-    asyncio.create_task(self.node.process_prompt(
+    self._spawn(self.node.process_prompt(
       shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
       max_tokens=fields.get("max_tokens"), images=images,
       temperature=fields.get("temperature"), top_p=fields.get("top_p"),
@@ -68,7 +74,7 @@ class GRPCServer(Server):
   async def _rpc_send_tensor(self, request: bytes, context) -> bytes:
     fields, tensors = decode_message(request)
     shard = Shard.from_dict(fields["shard"])
-    asyncio.create_task(self.node.process_tensor(
+    self._spawn(self.node.process_tensor(
       shard, tensors["tensor"], fields.get("request_id"), fields.get("inference_state")
     ))
     return encode_message({"ok": True})

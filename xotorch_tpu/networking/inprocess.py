@@ -22,6 +22,7 @@ import numpy as np
 
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.networking.peer_handle import PeerHandle
+from xotorch_tpu.utils.helpers import spawn_detached
 from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
 from xotorch_tpu.topology.topology import Topology
 
@@ -36,9 +37,7 @@ class InProcessPeerHandle(PeerHandle):
     self._tasks: set = set()
 
   def _spawn(self, coro) -> None:
-    task = asyncio.create_task(coro)
-    self._tasks.add(task)
-    task.add_done_callback(self._tasks.discard)
+    spawn_detached(coro, self._tasks)
 
   def id(self) -> str:
     return self.node.id

@@ -23,6 +23,7 @@ from xotorch_tpu.topology.device_capabilities import (
 )
 from xotorch_tpu.utils.helpers import (
   DEBUG_DISCOVERY,
+  spawn_detached,
   get_all_ip_addresses_and_interfaces,
   get_interface_priority_and_type,
 )
@@ -33,6 +34,9 @@ _PeerEntry = Tuple[PeerHandle, str, float, int]
 
 class ListenProtocol(asyncio.DatagramProtocol):
   def __init__(self, on_message: Callable[[bytes, Tuple[str, int]], None]):
+    # Strong refs for per-datagram dispatch tasks: the loop holds only weak
+    # refs, and a GC'd task would silently drop a discovery message.
+    self._inflight: set = set()
     super().__init__()
     self.on_message = on_message
     self.loop = asyncio.get_event_loop()
@@ -41,7 +45,7 @@ class ListenProtocol(asyncio.DatagramProtocol):
     self.transport = transport
 
   def datagram_received(self, data, addr):
-    asyncio.create_task(self.on_message(data, addr))
+    spawn_detached(self.on_message(data, addr), self._inflight)
 
 
 def subnet_broadcast_address(ip_addr: str) -> Optional[str]:

@@ -42,7 +42,7 @@ from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitio
 from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
 from xotorch_tpu.orchestration.metrics import NodeMetrics
 from xotorch_tpu.topology.topology import Topology
-from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
+from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem, spawn_detached
 
 # inference_state side-channel key carrying the per-request completion cap to
 # the last-layer peer (companion to tracing.TRACEPARENT_KEY).
@@ -218,10 +218,7 @@ class Node:
     self._detached_tasks: set = set()
 
   def _spawn(self, coro) -> "asyncio.Task":
-    task = asyncio.create_task(coro)
-    self._detached_tasks.add(task)
-    task.add_done_callback(self._detached_tasks.discard)
-    return task
+    return spawn_detached(coro, self._detached_tasks)
 
   # ------------------------------------------------------------- lifecycle
 
@@ -1061,6 +1058,25 @@ class Node:
     shards = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)
     return shards[index]
 
+  async def _peer_by_id(self, target_id: str):
+    """Resolve a hop's peer handle, healing transient peer-set lag: the
+    peer set is reconciled on a background cadence, and a hop can race a
+    window where discovery knows the peer but self.peers briefly doesn't
+    (a replaced handle whose connect timed out once, an admission that
+    finished after the last reconcile). One on-demand reconcile turns that
+    race into a served request instead of an abort; a peer that is GONE
+    still fails (update_peers can't resurrect it) and keeps the abort
+    semantics."""
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if peer is not None:
+      return peer
+    try:
+      await self.update_peers()
+    except Exception as e:
+      if DEBUG >= 2:
+        print(f"on-demand peer reconcile failed: {e!r}")
+    return next((p for p in self.peers if p.id() == target_id), None)
+
   def _ring_target_id(self, target_index: int, request_id: Optional[str]) -> str:
     entries = self._ring_entries(request_id)
     if entries is not None:
@@ -1076,7 +1092,7 @@ class Node:
     if target_id == self.id:
       await self._process_prompt(base_shard, prompt, request_id, images)
       return
-    peer = next((p for p in self.peers if p.id() == target_id), None)
+    peer = await self._peer_by_id(target_id)
     if peer is None:
       raise ValueError(f"Peer for {target_index} ({target_id}) not found")
     ctx = self._request_trace_ctx.get(request_id)
@@ -1137,7 +1153,7 @@ class Node:
       # chain per token and blow the recursion limit on long generations.
       self._spawn(self.process_tensor(base_shard, tensor, request_id, inference_state))
       return
-    peer = next((p for p in self.peers if p.id() == target_id), None)
+    peer = await self._peer_by_id(target_id)
     if peer is None:
       raise ValueError(f"Peer for {target_index} ({target_id}) not found")
     if not getattr(peer, "accepts_device_arrays", False) and not isinstance(tensor, np.ndarray):
@@ -1166,7 +1182,7 @@ class Node:
       return await self.process_example(base_shard, example, target, length, train, request_id)
     index = self.get_partition_index_of_first_layer()
     target_id = self._ring_target_id(index, request_id)
-    peer = next((p for p in self.peers if p.id() == target_id), None)
+    peer = await self._peer_by_id(target_id)
     if peer is None:
       raise ValueError(f"No peer for first-layer partition {index}")
     try:
@@ -1229,7 +1245,7 @@ class Node:
       next_shard = self.get_current_shard(base_shard, next_index, request_id=request_id)
       if target_id == self.id:
         return await self.process_example(base_shard, activations, target, length, train, request_id)
-      peer = next((p for p in self.peers if p.id() == target_id), None)
+      peer = await self._peer_by_id(target_id)
       if peer is None:
         raise ValueError(f"No peer for partition {next_index}")
       result = await peer.send_example(next_shard, activations, target, length, train, request_id,
@@ -1277,7 +1293,17 @@ class Node:
   # ------------------------------------------------------------- topology
 
   async def update_peers(self, wait_for_peers: int = 0) -> bool:
-    """Reconcile the peer set against discovery (parity node.py:462-511)."""
+    """Reconcile the peer set against discovery (parity node.py:462-511).
+    Serialized: the read-modify-write of self.peers spans awaits (connects/
+    disconnects), and callers now include on-demand hop-time reconciles
+    (_peer_by_id) racing the periodic loop — unsynchronized runs would
+    clobber each other's peer-set assignment."""
+    if not hasattr(self, "_update_peers_lock"):
+      self._update_peers_lock = asyncio.Lock()
+    async with self._update_peers_lock:
+      return await self._update_peers_locked(wait_for_peers)
+
+  async def _update_peers_locked(self, wait_for_peers: int = 0) -> bool:
     next_peers = await self.discovery.discover_peers(wait_for_peers)
     current_ids = {p.id() for p in self.peers}
     next_ids = {p.id() for p in next_peers}

@@ -49,7 +49,7 @@ class AsyncCallback(Generic[T]):
     self.result = args
     for observer in self.observers:
       observer(*args)
-    asyncio.create_task(self._notify())
+    spawn_detached(self._notify())
 
   async def _notify(self) -> None:
     async with self.condition:
@@ -99,6 +99,23 @@ class PrefixDict(Generic[K, T]):
     if not matches:
       return None
     return max(matches, key=lambda x: len(x[0]))
+
+
+_DETACHED_TASKS: set = set()
+
+
+def spawn_detached(coro, registry: Optional[set] = None) -> "asyncio.Task":
+  """create_task with a STRONG reference (asyncio keeps only weak refs to
+  tasks — an untracked fire-and-forget task can be garbage-collected
+  mid-flight, silently dropping the work). One helper so every
+  fire-and-forget site shares the same idiom; pass `registry` to scope the
+  refs to an owner (e.g. a server's in-flight hops), else a module-global
+  set holds them until done."""
+  reg = registry if registry is not None else _DETACHED_TASKS
+  task = asyncio.create_task(coro)
+  reg.add(task)
+  task.add_done_callback(reg.discard)
+  return task
 
 
 def is_port_available(port: int, host: str = "") -> bool:
