@@ -21,7 +21,7 @@ from aiohttp import web
 from xotorch_tpu.inference.engine import inference_engine_classes
 from xotorch_tpu.inference.tokenizers import resolve_tokenizer
 from xotorch_tpu.models.registry import build_base_shard, get_model_card, get_repo, model_cards, pretty_name
-from xotorch_tpu.utils.helpers import DEBUG
+from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
 
 WEB_DIR = Path(__file__).parent.parent / "tinychat"
 
@@ -368,7 +368,7 @@ class ChatGPTAPI:
     if self.node.shard_downloader is None:
       return web.json_response({"detail": "No shard downloader configured on this node"}, status=503)
     shard = build_base_shard(model_id, self.inference_engine_classname)
-    asyncio.create_task(self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname))
+    spawn_detached(self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname))
     return web.json_response({"status": "success", "message": f"Download started: {model_id}"})
 
   async def handle_post_image_generations(self, request):

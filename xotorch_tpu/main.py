@@ -34,6 +34,7 @@ from xotorch_tpu.utils.helpers import (
   get_all_ip_addresses_and_interfaces,
   get_or_create_node_id,
   shutdown,
+  spawn_detached,
 )
 
 
@@ -337,7 +338,7 @@ async def async_main(args) -> None:
   loop = asyncio.get_running_loop()
   for sig in (signal.SIGINT, signal.SIGTERM):
     try:
-      loop.add_signal_handler(sig, lambda s=sig: asyncio.create_task(shutdown(s, loop, node.server)))
+      loop.add_signal_handler(sig, lambda s=sig: spawn_detached(shutdown(s, loop, node.server)))
     except NotImplementedError:
       pass
 

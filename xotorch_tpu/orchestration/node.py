@@ -200,6 +200,8 @@ class Node:
     # Per-request partition map (RING_MAP_KEY): ring-ordered
     # [node_id, start_layer, end_layer] rows, pinned at request origin.
     self._request_ring_map: "OrderedDict[str, list]" = OrderedDict()
+    # Serializes peer-set reconciliation (periodic loop + hop-time heals).
+    self._update_peers_lock = asyncio.Lock()
     # Client-cancelled requests (cancel_request): the decode loops stop at
     # the next token/chunk boundary instead of running to EOS/cap. Bounded
     # LRU rather than per-request cleanup: the flag must outlive
@@ -1298,8 +1300,6 @@ class Node:
     disconnects), and callers now include on-demand hop-time reconciles
     (_peer_by_id) racing the periodic loop — unsynchronized runs would
     clobber each other's peer-set assignment."""
-    if not hasattr(self, "_update_peers_lock"):
-      self._update_peers_lock = asyncio.Lock()
     async with self._update_peers_lock:
       return await self._update_peers_locked(wait_for_peers)
 
