@@ -533,3 +533,31 @@ async def test_temperature_rides_the_ring_side_channel():
   await asyncio.wait_for(done.wait(), timeout=30)
   assert seen and all(t == 0.0 for t in seen), \
     f"sampler used {seen} instead of the request's 0.0 (node default is 0.6)"
+
+
+async def test_hop_heals_transient_peer_set_lag():
+  """A hop whose ring-mapped target is missing from self.peers (admission
+  raced the last reconcile) must trigger ONE on-demand update_peers and
+  serve the request instead of aborting — the cross-process E2E hit this
+  window live; this pins the heal in-process."""
+  from xotorch_tpu.inference.dummy import DummyInferenceEngine
+  from xotorch_tpu.networking.inprocess import InProcessPeerHandle
+
+  a = await _make_node("heal-a", DummyInferenceEngine())
+  b = await _make_node("heal-b", DummyInferenceEngine())
+  for n in (a, b):
+    for o in (a, b):
+      n.topology.update_node(o.id, _caps())
+  # discovery KNOWS b, but a's reconciled peer set lags (empty).
+  a.discovery = StaticDiscovery([InProcessPeerHandle(b)])
+  a.peers = []
+  b.peers = [InProcessPeerHandle(a)]
+
+  peer = await a._peer_by_id("heal-b")
+  assert peer is not None and peer.id() == "heal-b"
+  assert [p.id() for p in a.peers] == ["heal-b"], "reconcile should adopt the handle"
+
+  # A peer that is GONE still fails after the reconcile (abort semantics).
+  a.discovery = StaticDiscovery([])
+  a.peers = []
+  assert await a._peer_by_id("heal-b") is None
