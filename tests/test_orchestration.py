@@ -540,22 +540,26 @@ async def test_hop_heals_transient_peer_set_lag():
   raced the last reconcile) must trigger ONE on-demand update_peers and
   serve the request instead of aborting — the cross-process E2E hit this
   window live; this pins the heal in-process."""
-  from xotorch_tpu.inference.dummy import DummyInferenceEngine
+  from unittest.mock import AsyncMock
+
   from xotorch_tpu.networking.inprocess import InProcessPeerHandle
 
   a = await _make_node("heal-a", DummyInferenceEngine())
   b = await _make_node("heal-b", DummyInferenceEngine())
-  for n in (a, b):
-    for o in (a, b):
-      n.topology.update_node(o.id, _caps())
   # discovery KNOWS b, but a's reconciled peer set lags (empty).
   a.discovery = StaticDiscovery([InProcessPeerHandle(b)])
   a.peers = []
-  b.peers = [InProcessPeerHandle(a)]
+  reconcile = AsyncMock(wraps=a.update_peers)
+  a.update_peers = reconcile
 
   peer = await a._peer_by_id("heal-b")
   assert peer is not None and peer.id() == "heal-b"
   assert [p.id() for p in a.peers] == ["heal-b"], "reconcile should adopt the handle"
+  reconcile.assert_awaited_once()
+
+  # A present peer resolves WITHOUT another reconcile (fast path).
+  assert (await a._peer_by_id("heal-b")).id() == "heal-b"
+  reconcile.assert_awaited_once()
 
   # A peer that is GONE still fails after the reconcile (abort semantics).
   a.discovery = StaticDiscovery([])
