@@ -91,3 +91,32 @@ def test_prefix_dict():
 def test_pretty_bytes():
   assert pretty_bytes(512) == "512 B"
   assert pretty_bytes(2 * 1024 * 1024) == "2.00 MB"
+
+
+async def test_spawn_detached_holds_and_releases_refs():
+  """spawn_detached must strong-ref the task until completion (asyncio holds
+  tasks weakly — an unreferenced fire-and-forget task can be GC'd mid-run)
+  and release the ref once done; a caller-scoped registry is honored."""
+  import asyncio
+
+  from xotorch_tpu.utils.helpers import _DETACHED_TASKS, spawn_detached
+
+  ran = asyncio.Event()
+
+  async def work():
+    await asyncio.sleep(0.01)
+    ran.set()
+
+  task = spawn_detached(work())
+  assert task in _DETACHED_TASKS, "task must be strong-ref'd while running"
+  await asyncio.wait_for(ran.wait(), timeout=5)
+  await task
+  await asyncio.sleep(0)  # let the done-callback run
+  assert task not in _DETACHED_TASKS, "ref must be released after completion"
+
+  scoped: set = set()
+  t2 = spawn_detached(asyncio.sleep(0.01), scoped)
+  assert t2 in scoped and t2 not in _DETACHED_TASKS
+  await t2
+  await asyncio.sleep(0)
+  assert not scoped
