@@ -93,12 +93,11 @@ def test_pretty_bytes():
   assert pretty_bytes(2 * 1024 * 1024) == "2.00 MB"
 
 
+@pytest.mark.asyncio
 async def test_spawn_detached_holds_and_releases_refs():
   """spawn_detached must strong-ref the task until completion (asyncio holds
   tasks weakly — an unreferenced fire-and-forget task can be GC'd mid-run)
   and release the ref once done; a caller-scoped registry is honored."""
-  import asyncio
-
   from xotorch_tpu.utils.helpers import _DETACHED_TASKS, spawn_detached
 
   ran = asyncio.Event()
