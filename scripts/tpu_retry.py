@@ -52,6 +52,13 @@ STEPS: list[tuple[str, dict, str]] = [
   ("rest", {"BENCH_TPU_TRIES": "1", "BENCH_SKIP_SMOKE": "1", "BENCH_LONG": "0",
             "BENCH_QUANT": "int8", "BENCH_RING": "2", "BENCH_CONCURRENT": "8"},
    "int8_tok_s"),
+  # Paged KV A/B (ISSUE r6): the 8-stream concurrent aggregate with the
+  # shared page pool + ragged paged-attention decode vs `rest`'s contiguous
+  # number — mixed-length batches stop paying common-length growth and
+  # max-row cache reads. Kernel auto-selects on real TPU (XOT_PAGED_KERNEL).
+  ("paged", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "8",
+             "XOT_PAGED_KV": "1"},
+   "concurrent_tok_s"),
   # Fused scan-prefill headline (VERDICT r3 #5): prefill_mfu_pct with the
   # whole segment loop in one executable, vs the per-segment path.
   ("scan16k", LONG, "prefill_mfu_pct"),

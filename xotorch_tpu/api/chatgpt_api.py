@@ -208,6 +208,8 @@ class ChatGPTAPI:
       ("_prefix_tokens_saved", "xot_prefix_tokens_saved_total", "Prompt tokens whose prefill was skipped"),
       ("_spec_proposed", "xot_spec_tokens_proposed_total", "Speculative draft tokens proposed"),
       ("_spec_accepted", "xot_spec_tokens_accepted_total", "Speculative draft tokens accepted"),
+      ("_grow_copies", "xot_kv_grow_copies_total",
+       "Contiguous KV grow-copies (zero under XOT_PAGED_KV decode)"),
     ):
       val = getattr(eng, attr, None)
       if val is not None:
@@ -238,13 +240,27 @@ class ChatGPTAPI:
     """Cached validate_adapter_file: /v1/models may be polled (tinychat
     refreshes the list), and re-opening every safetensors header per request
     would block the event loop on disk I/O for data that only changes when
-    the checkpoint changes. Keyed on the path's (mtime_ns, size) so a
-    rewritten checkpoint or repopulated directory re-validates."""
+    the checkpoint changes. Keyed on the path's (mtime_ns, size) — and, for
+    DIRECTORY adapters, on the resolved checkpoint files' own
+    (name, mtime_ns, size): rewriting a shard save IN PLACE leaves the
+    directory's stat unchanged (ADVICE r5 #1), so the dir stat alone would
+    serve a stale verdict until restart. adapter_checkpoint_files is the
+    same cheap resolution rule the load path uses."""
     import os as _os
-    from xotorch_tpu.train.lora import validate_adapter_file
+    from pathlib import Path as _Path
+    from xotorch_tpu.train.lora import adapter_checkpoint_files, validate_adapter_file
     try:
       st = _os.stat(path)
       sig = (n_layers, st.st_mtime_ns, st.st_size)
+      if _Path(path).is_dir():
+        files = []
+        for f in adapter_checkpoint_files(path):
+          try:
+            fst = _os.stat(f)
+            files.append((f.name, fst.st_mtime_ns, fst.st_size))
+          except OSError:
+            files.append((f.name, None, None))
+        sig = sig + (tuple(files),)
     except OSError:
       sig = (n_layers, None, None)
     cache = getattr(self, "_adapter_validation_cache", None)

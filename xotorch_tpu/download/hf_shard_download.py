@@ -167,6 +167,11 @@ class HFShardDownloader(ShardDownloader):
       else:
         patterns = ["*"]
       wanted = [f for f in file_list if _matches(f["path"], patterns)]
+      if not weight_map:
+        # No-index repo: record the full intended file set BEFORE fetching.
+        # checkpoint_complete requires every listed file, so a kill between
+        # files can't pass the offline fast path as "complete".
+        write_download_manifest(target_dir, [f["path"] for f in wanted])
       if DEBUG >= 2:
         print(f"Downloading {len(wanted)}/{len(file_list)} files for {shard}")
 
@@ -279,6 +284,22 @@ class HFShardDownloader(ShardDownloader):
     return False
 
 
+# Completion manifest for NO-INDEX repos: written by the downloader BEFORE
+# it starts fetching (listing every file it intends to fetch) so a download
+# killed between files can never masquerade as complete — offline, a
+# multi-file no-index repo with some files missing is otherwise
+# indistinguishable from a complete one (ADVICE r5 #2). Seeded /
+# hand-populated dirs have no manifest and keep the old heuristic.
+MANIFEST_NAME = ".xot_download_manifest.json"
+
+
+def write_download_manifest(target_dir: Path, file_paths: List[str]) -> None:
+  try:
+    (target_dir / MANIFEST_NAME).write_text(json.dumps({"files": sorted(file_paths)}))
+  except OSError:
+    pass  # best-effort: a read-only dir just keeps the network-verify path
+
+
 def has_tokenizer_artifact(target_dir: Path) -> bool:
   """A file AutoTokenizer can actually BUILD a tokenizer from.
   tokenizer_config.json alone is not one — treating it as sufficient would
@@ -304,11 +325,11 @@ def checkpoint_complete(target_dir: Path, shard: Optional[Shard] = None) -> bool
   Complete means: config.json, a loadable tokenizer artifact, and full
   weight coverage — with a safetensors index, every file the index names
   (filtered to the shard's allow-patterns when a shard is given); without
-  one, at least one .safetensors AND no interrupted .partial leftovers (a
-  multi-file no-index repo killed between files is indistinguishable from
-  complete offline — the .partial check catches the common
-  killed-mid-file case, and the conservative default is the network path,
-  which verifies per file)."""
+  one, every file our download MANIFEST names when one exists (written
+  before fetching starts, so a download killed BETWEEN files leaves it
+  unsatisfied instead of masquerading as complete — ADVICE r5 #2), else
+  (seeded / hand-populated dirs, which have no manifest) at least one
+  .safetensors AND no interrupted .partial leftovers."""
   if not (target_dir / "config.json").exists():
     return False
   if not has_tokenizer_artifact(target_dir):
@@ -329,6 +350,13 @@ def checkpoint_complete(target_dir: Path, shard: Optional[Shard] = None) -> bool
     return bool(files) and all((base / f).exists() for f in files)
   if any(target_dir.rglob("*.partial")):
     return False
+  manifest = target_dir / MANIFEST_NAME
+  if manifest.exists():
+    try:
+      files = json.loads(manifest.read_text()).get("files", [])
+    except (OSError, json.JSONDecodeError):
+      return False  # unreadable manifest: let the network path re-verify
+    return bool(files) and all((target_dir / f).exists() for f in files)
   return any(p.suffix == ".safetensors" for p in target_dir.iterdir() if p.is_file())
 
 

@@ -73,9 +73,14 @@ def _bucket(n: int, minimum: int = 16) -> int:
 
 @dataclass
 class _RequestState:
-  cache: Any  # device pytree {"k","v"}
+  cache: Any  # device pytree {"k","v"}; None once committed to the page pool
   pos: int  # tokens already resident in this shard's cache
   last_used: float
+  # Paged KV (XOT_PAGED_KV): ordered page ids into the context's PagePool
+  # arena once the request's cache is committed (cache is then None), and
+  # prefix-shared pages held (incref'd) before commit. See _commit_state_to_pages.
+  pages: Optional[list] = None
+  paged_seed: Optional[list] = None
   # OpenAI sampling extras (seed / logit_bias / presence+frequency penalties):
   # {"seed": int|None, "bias": [1,V] device array|None, "counts": [1,V] int32
   #  device array|None, "presence": float, "frequency": float}. None = plain
@@ -118,7 +123,13 @@ class _ShardContext:
   # hash — a new prompt sharing a long common prefix (system prompt,
   # multi-turn history) seeds its cache from the snapshot and prefills only
   # the suffix. LRU bounded by XOT_PREFIX_CACHE entries (device HBM!).
+  # Under XOT_PAGED_KV entries are {"pages": [...], "len": n} markers that
+  # SHARE the pool's pages (incref) instead of holding a snapshot copy.
   prefix_cache: "OrderedDict[int, Tuple[np.ndarray, Any]]" = field(default_factory=OrderedDict)
+  # Paged KV-cache pool (XOT_PAGED_KV=1): lazy paged_cache.PagePool — one
+  # shared K/V arena + free-list/refcount metadata for every resident
+  # request of this context.
+  page_pool: Any = None
 
 
 class _DecodeBatcher:
@@ -271,6 +282,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._seed = int(os.getenv("XOT_SEED", str(int(time.time()))))
     self._sample_calls = 0
     self._oom_count = 0
+    # Contiguous-cache grow-copies (each a full device-side copy of a
+    # request's KV). The paged path (XOT_PAGED_KV) appends into pool pages
+    # instead — its tests assert this stays ZERO across decode.
+    self._grow_copies = 0
     # Prefix-cache observability (tests + /metrics): hits and tokens whose
     # prefill was skipped entirely.
     self._prefix_hits = 0
@@ -526,6 +541,10 @@ class JAXShardInferenceEngine(InferenceEngine):
         self._states_lost_to_oom[rid] = None
       n_state += len(ctx.states)
       ctx.states.clear()
+      # Paged KV: the arena and its refcount metadata go wholesale — every
+      # referencing state/prefix entry was just dropped above, and the next
+      # paged request rebuilds a fresh (empty) pool.
+      ctx.page_pool = None
     while len(self._states_lost_to_oom) > 512:
       self._states_lost_to_oom.popitem(last=False)
     for shard in [s for s, c in self._contexts.items() if c is not self._active]:
@@ -1174,6 +1193,37 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax
     _, snap = ctx.prefix_cache[best_key]
     ctx.prefix_cache.move_to_end(best_key)
+    if isinstance(snap, dict) and "pages" in snap:
+      # Paged entry: gather the shared pages into the fresh prefill buffer
+      # (the same copy the snapshot path pays) and HOLD them (incref) so
+      # commit can put them at the head of this request's page table
+      # instead of re-copying — N warm requests share one arena copy of
+      # the prefix. Reuse is rounded DOWN to whole pages: the suffix
+      # prefill and later appends then only ever write pages past the
+      # shared ones.
+      pool = ctx.page_pool
+      page = pool.page_size
+      consumed = (min(best_len, snap["len"]) // page) * page
+      if consumed < self._prefix_cache_min():
+        return 0
+      ids = list(snap["pages"][:consumed // page])
+      from xotorch_tpu.inference.jax_engine.paged_cache import gather_pages
+      state = self._get_or_create_state(ctx, request_id, min_len=toks.shape[0])
+      gathered = gather_pages(pool.arena, np.asarray(ids, np.int32))
+      state.cache = {
+        name: jax.lax.dynamic_update_slice(
+          state.cache[name], gathered[name][:, :, :consumed].astype(state.cache[name].dtype),
+          (0,) * state.cache[name].ndim)
+        for name in state.cache
+      }
+      pool.incref(ids)
+      state.paged_seed = ids
+      state.pos = consumed
+      self._prefix_hits += 1
+      self._prefix_tokens_saved += consumed
+      if DEBUG >= 2:
+        print(f"[{request_id}] prefix cache hit: {consumed} tokens reused ({len(ids)} shared pages)")
+      return consumed
     state = self._get_or_create_state(ctx, request_id, min_len=toks.shape[0])
     state.cache = {
       # Rank-generic: int8-KV scale leaves are rank 4 ([L, B, S, Hkv]),
@@ -1207,20 +1257,49 @@ class JAXShardInferenceEngine(InferenceEngine):
     if key in ctx.prefix_cache:
       ctx.prefix_cache.move_to_end(key)
       return
-    import jax.numpy as jnp
+    if self._paged_on() and self._paged_ok(ctx) and state.extras is None:
+      # Paged mode: SHARE the prefill's full pages (incref) instead of
+      # snapshotting a whole cache copy — the arena holds one copy of a hot
+      # system prompt no matter how many requests and entries reference it.
+      # Shared pages are read-only by construction: decode appends always
+      # land at page index pos // page_size, past every full prefix page,
+      # so divergence after the shared prefix is copy-on-write with the
+      # "copy" limited to the partial tail page each request already owns.
+      # Extras-bearing requests decode contiguous (_use_paged) — committing
+      # them here would just be unpaged back on their first chunk, so they
+      # take the snapshot branch below instead.
+      try:
+        pool = self._ensure_page_pool(ctx)
+        if state.pages is None:
+          self._commit_state_to_pages(ctx, state)
+      except CacheExhausted:
+        # Caching is best-effort: a full pool must never fail a request
+        # whose prefill already succeeded. The decode path re-attempts the
+        # commit and surfaces capacity errors where the contiguous path does.
+        return
+      n_full = T // pool.page_size
+      if n_full <= 0:
+        return
+      ids = list(state.pages[:n_full])
+      pool.incref(ids)
+      ctx.prefix_cache[key] = (toks, {"pages": ids, "len": n_full * pool.page_size})
+    else:
+      import jax.numpy as jnp
 
-    def snap(buf):
-      # A FULL slice (T == buffer length, e.g. a prompt landing exactly on
-      # its power-of-two bucket) returns the SAME array object in JAX — and
-      # the live cache is donated into the next decode dispatch, which would
-      # delete the "snapshot" out from under future reuse. Force a copy in
-      # exactly that case.
-      s = buf[:, :, :T]
-      return jnp.copy(s) if s is buf else s
+      def snap(buf):
+        # A FULL slice (T == buffer length, e.g. a prompt landing exactly on
+        # its power-of-two bucket) returns the SAME array object in JAX — and
+        # the live cache is donated into the next decode dispatch, which would
+        # delete the "snapshot" out from under future reuse. Force a copy in
+        # exactly that case.
+        s = buf[:, :, :T]
+        return jnp.copy(s) if s is buf else s
 
-    ctx.prefix_cache[key] = (toks, {name: snap(buf) for name, buf in state.cache.items()})
+      ctx.prefix_cache[key] = (toks, {name: snap(buf) for name, buf in state.cache.items()})
     while len(ctx.prefix_cache) > self._prefix_cache_max():
-      ctx.prefix_cache.popitem(last=False)
+      _, (_, evicted) = ctx.prefix_cache.popitem(last=False)
+      if ctx.page_pool is not None and isinstance(evicted, dict) and "pages" in evicted:
+        ctx.page_pool.decref(evicted["pages"])
 
   async def infer_prompt(
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
@@ -1840,7 +1919,17 @@ class JAXShardInferenceEngine(InferenceEngine):
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import decode_chunk
 
+    if self._use_paged(ctx, items):
+      # Paged KV (XOT_PAGED_KV): chunks index the shared page arena through
+      # per-request page tables — one dispatch, no stack/split/growth.
+      return self._decode_batch_paged_sync(ctx, items, num_tokens, top_k, float(top_p))
+
     states = [it[1] for it in items]
+    for state in states:
+      if state.cache is None and state.pages is not None:
+        # A previously-paged request fell back to the contiguous path (env
+        # change, late-attached extras): gather its pages back first.
+        self._unpage_state(ctx, state, min_len=state.pos + num_tokens)
 
     if len(items) == 1:
       rid, state = items[0][0], states[0]
@@ -2033,12 +2122,218 @@ class JAXShardInferenceEngine(InferenceEngine):
       state.last_used = now
     return [out_np[i].astype(np.int64) for i in range(len(states))]
 
+  # -------------------------------------------------------------- paged KV
+  #
+  # XOT_PAGED_KV=1: requests' KV lives as fixed-size pages in ONE shared
+  # arena per context (paged_cache.PagePool) instead of per-request
+  # contiguous buffers. Prefill still runs on the contiguous buffer (its
+  # executables are untouched); the buffer is committed into pages when
+  # decode starts and freed. Decode chunks then index the arena through
+  # per-request page tables (models/generate.decode_chunk_paged): batch
+  # membership is metadata, appends allocate pages instead of grow-copying,
+  # and attention reads only each row's occupied pages. Contiguous remains
+  # the default until on-chip A/B numbers land (scripts/tpu_retry.py
+  # `paged` stage).
+
+  def _paged_on(self) -> bool:
+    return os.getenv("XOT_PAGED_KV", "0") == "1"
+
+  def _paged_ok(self, ctx: _ShardContext) -> bool:
+    """Families the paged path serves: sliding-window configs keep the
+    contiguous kernels (the ragged kernel has no window re-map yet), and
+    int8 KV stays contiguous (per-(position, head) scale pages unplumbed)."""
+    return not ctx.cfg.uses_sliding_window and self._kv_quant is None
+
+  def _paged_kernel_on(self) -> bool:
+    """XOT_PAGED_KERNEL: 1 = force the Pallas ragged kernel (interpret mode
+    off-TPU), 0 = force the jnp.take XLA fallback, unset = kernel on real
+    TPU only."""
+    env = os.getenv("XOT_PAGED_KERNEL")
+    if env is not None:
+      return env == "1"
+    return self._jax().default_backend() == "tpu"
+
+  def _ensure_page_pool(self, ctx: _ShardContext):
+    if ctx.page_pool is None:
+      from xotorch_tpu.inference.jax_engine.paged_cache import PagePool
+      page = int(os.getenv("XOT_KV_PAGE", "128"))
+      tokens = int(os.getenv("XOT_KV_POOL_TOKENS", "0") or 0)
+      if tokens <= 0:
+        # Room for one max-length context plus a typical batch of
+        # initial-allocation-sized requests; ceil'd to whole pages.
+        tokens = ctx.max_cache_len + MAX_RESIDENT_REQUESTS * ctx.cache_len
+      num_pages = -(-tokens // page) + 1  # +1: reserved scratch page 0
+      ctx.page_pool = PagePool(ctx.cfg, ctx.shard.get_layer_count(), num_pages,
+                               page, self._dtype(), mesh=ctx.mesh)
+      if DEBUG >= 1:
+        print(f"KV page pool ready: {num_pages - 1} pages x {page} tokens")
+    return ctx.page_pool
+
+  def _pool_alloc(self, ctx: _ShardContext, pool, n: int) -> list:
+    """pool.alloc with reclaim: prefix entries are CACHES — under pool
+    pressure they must yield to live requests, not pin pages until clients
+    see 'pool exhausted' errors the contiguous path never produces. Evict
+    oldest-first (decref) and retry; entries whose pages are still shared
+    with live requests free nothing (ref > 1) and the loop keeps going.
+    Only when no entry is left to evict does the exhaustion surface."""
+    while True:
+      try:
+        return pool.alloc(n)
+      except CacheExhausted:
+        evicted = False
+        while ctx.prefix_cache and not evicted:
+          _, (_, entry) = ctx.prefix_cache.popitem(last=False)
+          if isinstance(entry, dict) and "pages" in entry:
+            pool.decref(entry["pages"])
+            evicted = True
+        if not evicted:
+          raise
+
+  def _commit_state_to_pages(self, ctx: _ShardContext, state: _RequestState) -> None:
+    """Move a prefilled request's contiguous KV into pool pages and free the
+    buffer. Prefix-shared pages held in `paged_seed` (already incref'd, page
+    -aligned below pos by construction) become the table's head; only the
+    suffix is copied. From here on the request decodes via the paged path;
+    contiguous code paths that touch it later un-page it (_unpage_state)."""
+    from xotorch_tpu.inference.jax_engine.paged_cache import commit_pages
+    pool = self._ensure_page_pool(ctx)
+    n = pool.pages_for(state.pos)
+    seed = list(state.paged_seed or [])
+    fresh = self._pool_alloc(ctx, pool, n - len(seed))
+    if fresh:
+      pool.arena = commit_pages(pool.arena, state.cache, np.asarray(fresh, np.int32),
+                                start_page=len(seed))
+    state.pages = seed + fresh
+    state.paged_seed = None
+    state.cache = None
+
+  def _unpage_state(self, ctx: _ShardContext, state: _RequestState,
+                    min_len: int = 0) -> None:
+    """Gather a paged request back into a contiguous buffer (the reverse of
+    commit): segment forwards, draft verification, and per-token decode all
+    assume `state.cache`. The request's pages are released; the next paged
+    chunk re-commits. Cold-path by design — steady-state decode never calls
+    this."""
+    import jax
+    from xotorch_tpu.inference.jax_engine.paged_cache import gather_pages
+    pool = ctx.page_pool
+    need = min(max(min_len, state.pos, 1), ctx.max_cache_len)
+    length = ctx.cache_len
+    while length < need and length < ctx.max_cache_len:
+      length *= 2
+    length = min(length, ctx.max_cache_len)
+    cache = self._new_cache(ctx, length)
+    gathered = gather_pages(pool.arena, np.asarray(state.pages, np.int32))
+    cut = min(len(state.pages) * pool.page_size, length)
+    state.cache = {
+      name: jax.lax.dynamic_update_slice(
+        cache[name], gathered[name][:, :, :cut].astype(cache[name].dtype),
+        (0,) * cache[name].ndim)
+      for name in cache
+    }
+    pool.decref(state.pages)
+    state.pages = None
+
+  def _release_state_pages(self, ctx: _ShardContext, state: _RequestState) -> None:
+    """Drop a finished/evicted request's page references (committed table
+    AND any not-yet-committed prefix-seed holds). Pages shared with the
+    prefix cache or other requests survive via their own refs."""
+    pool = ctx.page_pool
+    if pool is None:
+      return
+    if state.pages is not None:
+      pool.decref(state.pages)
+      state.pages = None
+    if state.paged_seed:
+      pool.decref(state.paged_seed)
+      state.paged_seed = None
+
+  def _clear_prefix_cache(self, ctx: _ShardContext) -> None:
+    """Drop every prefix entry, returning paged entries' page references to
+    the pool (a bare .clear() would leak their refcounts)."""
+    pool = ctx.page_pool
+    for _, entry in ctx.prefix_cache.values():
+      if pool is not None and isinstance(entry, dict) and "pages" in entry:
+        pool.decref(entry["pages"])
+    ctx.prefix_cache.clear()
+
+  def _use_paged(self, ctx: _ShardContext, items: list) -> bool:
+    """One qualification rule for routing a decode dispatch to the paged
+    path. Requests with sampling extras decode contiguous (their in-chunk
+    counts/logprob plumbing isn't wired through the paged executable) —
+    they never commit, so the split is stable per request."""
+    if not (self._paged_on() and self._paged_ok(ctx)):
+      return False
+    return all(it[1].extras is None for it in items)
+
+  def _decode_batch_paged_sync(self, ctx: _ShardContext, items: list, num_tokens: int,
+                               top_k: int, top_p: float = 0.0) -> list:
+    """Paged twin of the batched fused chunk: commit any member still on its
+    prefill buffer, append pages to cover the chunk, and run ONE
+    decode_chunk_paged dispatch indexing the shared arena — no cache
+    stack/split, no common-length growth, no grow-copies. The page-table
+    width is bucketed to a power of two so executables stay logarithmic in
+    the longest resident context."""
+    import jax
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import decode_chunk_paged
+    pool = self._ensure_page_pool(ctx)
+    states = [it[1] for it in items]
+    for it in items:
+      # Any leftover speculation records belong to the contiguous path —
+      # supersede them before touching positions.
+      self._discard_spec(it[0], it[1])
+      self._discard_batch_spec_for(ctx, it[0])
+    # max_cache_len backstop (generate_chunk already guards per request
+    # before submitting): positions past the model's max context would get
+    # out-of-range RoPE AND drain the SHARED pool — shrink to the tightest
+    # member's tail (largest po2, same ladder as generate_chunk), and fail
+    # loudly if a member has no room at all.
+    for it in items:
+      if it[1].pos + 1 > ctx.max_cache_len:
+        raise CacheExhausted(
+          f"request {it[0]}: cache full at {it[1].pos}/{ctx.max_cache_len}")
+    tail = min(ctx.max_cache_len - s.pos for s in states)
+    if num_tokens > tail:
+      num_tokens = 1 << (tail.bit_length() - 1)
+    for state in states:
+      if state.pages is None:
+        self._commit_state_to_pages(ctx, state)
+      need = pool.pages_for(state.pos + num_tokens)
+      if need > len(state.pages):
+        state.pages.extend(self._pool_alloc(ctx, pool, need - len(state.pages)))
+    B = len(states)
+    maxp = _bucket(max(len(s.pages) for s in states), 1)
+    table = np.zeros((B, maxp), np.int32)  # 0-padded: the scratch page, masked
+    for i, s in enumerate(states):
+      table[i, :len(s.pages)] = s.pages
+    B_pad = _bucket(B, 1)
+    pos_vec = jnp.asarray([s.pos for s in states], jnp.int32)
+    temps = jnp.asarray([float(it[4]) for it in items], jnp.float32)
+    toks = jnp.asarray([[int(it[2])] for it in items], jnp.int32)
+    self._sample_calls += 1
+    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
+    out, pool.arena = decode_chunk_paged(
+      ctx.params, pool.arena, jnp.asarray(table), toks, pos_vec, key, ctx.cfg,
+      num_tokens, temps, top_k, top_p, use_kernel=self._paged_kernel_on(),
+      pad_rows=B_pad - B, moe_routed=self._moe_routed_for(ctx))
+    out_np = np.asarray(out)
+    now = time.monotonic()
+    for state in states:
+      state.pos += num_tokens
+      state.last_used = now
+    return [out_np[i].astype(np.int64) for i in range(B)]
+
   def _prep_state(self, ctx: _ShardContext, request_id: str, bucket: int) -> _RequestState:
     """State + capacity for `bucket` more tokens. Checks are against the
     padded bucket, not true_t: dynamic_update_slice CLAMPS out-of-range
     starts, which would silently overwrite earlier cache slots. Runs on the
     engine executor (it may touch the device to grow the cache)."""
     state = self._get_or_create_state(ctx, request_id, min_len=bucket)
+    if state.cache is None and state.pages is not None:
+      # A contiguous code path (segment forward, draft verify, per-token
+      # decode) is touching a paged request: gather it back first.
+      self._unpage_state(ctx, state, min_len=state.pos + bucket)
     # A segment forward (prefill, per-token ring, draft verify) supersedes
     # any speculatively dispatched chunk: commit the rolled-back position
     # before capacity math.
@@ -2060,6 +2355,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     logarithmic; contents are preserved, tail slots zero-padded."""
     import jax
     import jax.numpy as jnp
+    self._grow_copies += 1
     S = state.cache["k"].shape[2]
     new_len = S
     while new_len < needed:
@@ -2102,7 +2398,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       state = _RequestState(cache=self._new_cache(ctx, length), pos=0, last_used=time.monotonic())
       ctx.states[request_id] = state
       while len(ctx.states) > MAX_RESIDENT_REQUESTS:
-        evicted, _ = ctx.states.popitem(last=False)
+        evicted, est = ctx.states.popitem(last=False)
+        self._release_state_pages(ctx, est)
         if DEBUG >= 2:
           print(f"Evicted request state {evicted}")
     # True LRU: refresh recency on every touch, not just creation.
@@ -2488,7 +2785,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       # it. Every pos/params/opt mutation is serialized on this executor.
       ctx.params = _load()
       ctx.opt_state = None  # optimizer state is invalid for reloaded weights
-      ctx.prefix_cache.clear()  # snapshots were computed under the old weights
+      self._clear_prefix_cache(ctx)  # snapshots were computed under the old weights
 
       # Training resume: restore the moments saved WITH the checkpoint that
       # was just loaded (the file name ties them — rolling back to
@@ -2612,7 +2909,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         fl, nf = split_float(ctx.params)
         updates, ctx.opt_state = optimizer.update(param_grads, ctx.opt_state, fl)
         ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
-        ctx.prefix_cache.clear()  # prefill snapshots are stale under new weights
+        self._clear_prefix_cache(ctx)  # prefill snapshots are stale under new weights
         return float(loss), np.asarray(x_grad)
       return await self._run(_last, oom_as_cache_exhausted=False)
 
@@ -2659,7 +2956,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       fl, nf = split_float(ctx.params)
       updates, ctx.opt_state = optimizer.update(float_grads, ctx.opt_state, fl)
       ctx.params = merge_trees(optax.apply_updates(fl, updates), nf)
-      ctx.prefix_cache.clear()  # prefill snapshots are stale under new weights
+      self._clear_prefix_cache(ctx)  # prefill snapshots are stale under new weights
       return x_grad
 
     x_grad = await self._run(_bwd_apply, oom_as_cache_exhausted=False)
@@ -2704,7 +3001,11 @@ class JAXShardInferenceEngine(InferenceEngine):
         # A member finished: the batch's membership changes, so the
         # speculative batch can never resolve — roll the others back.
         self._discard_batch_spec_for(ctx, request_id)
-        ctx.states.pop(request_id, None)
-        ctx.states.pop(self._draft_rid(request_id), None)  # draft-model KV
+        for rid in (request_id, self._draft_rid(request_id)):
+          st = ctx.states.pop(rid, None)
+          if st is not None:
+            # Return the request's page references to the pool; pages shared
+            # with the prefix cache or other requests survive via their refs.
+            self._release_state_pages(ctx, st)
 
     await self._run(_clear, oom_as_cache_exhausted=False)

@@ -1,0 +1,189 @@
+"""Paged KV-cache pool: one fixed arena per shard context, per-request page
+tables (XOT_PAGED_KV=1).
+
+The contiguous design allocates one [L, 1, S, Hkv, D] buffer PER REQUEST and
+grows it by power-of-two doubling (engine._grow_cache) — every growth is a
+full device-side copy, the po2 rounding overshoots by up to 2x, and the
+batched decode path grows every co-batched member to a COMMON length, so one
+16 k-context request forces every short request in its batch to pad, copy,
+and stream a 16 k cache. Ragged Paged Attention (PAPERS.md: arxiv
+2604.15464) and vTensor (arxiv 2407.15309) show the fix: a shared fixed-size
+page pool plus per-request page tables makes batch membership an O(1)
+metadata change, removes grow-copies entirely (decode APPENDS into pages),
+and lets the attention op read only each row's occupied pages.
+
+Layout mirrors the contiguous cache so existing placement rules apply
+unchanged: arena leaves are [L, num_pages, page_size, Hkv, D] — rank 5 with
+Hkv at index 3, exactly what parallel/mesh.cache_spec shards over 'tp'.
+Page 0 is a reserved SCRATCH page, never allocated: page tables are padded
+with 0 (reads masked by per-row length) and the batched executable's dummy
+pad rows write their garbage there (their page table is all zeros).
+
+Allocation metadata (free list, refcounts) is host-side numpy — page churn
+is request-rate, not token-rate. Refcounts let the prefix cache share a
+completed prefill's full pages with later requests instead of snapshotting
+whole caches: shared pages are read-only by construction (decode only ever
+writes at page index pos // page_size, past every shared full page), so
+copy-on-write degenerates to share-full-pages / copy-the-partial-tail.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from xotorch_tpu.inference.engine import CacheExhausted
+
+
+class PagePool:
+  """Fixed-size K/V page arena + free-list allocator with refcounts.
+
+  One pool per (model, layer-range) context. `arena` holds every resident
+  request's KV; requests reference it through ordered page-id lists (their
+  page tables). All mutation happens on the engine's single-worker executor
+  thread, so no locking is needed (same discipline as _RequestState)."""
+
+  def __init__(self, cfg, num_layers: int, num_pages: int, page_size: int, dtype,
+               mesh=None):
+    import jax.numpy as jnp
+    if num_pages < 2:
+      raise ValueError(f"page pool needs >= 2 pages (1 scratch + 1 usable), got {num_pages}")
+    shape = (num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    self.arena: Dict[str, Any] = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if mesh is not None:
+      from xotorch_tpu.parallel.mesh import shard_cache
+      self.arena = shard_cache(self.arena, mesh)
+    self.page_size = int(page_size)
+    self.num_pages = int(num_pages)
+    # Page 0 is the scratch page: permanently "allocated" (ref 1) so it can
+    # never be handed out — padding and dummy-row writes land there.
+    self._ref = np.zeros(num_pages, np.int32)
+    self._ref[0] = 1
+    # Pop from the END yields ascending ids (nicer to read in debug dumps).
+    self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+  # ------------------------------------------------------------- bookkeeping
+
+  @property
+  def free_pages(self) -> int:
+    return len(self._free)
+
+  @property
+  def pages_in_use(self) -> int:
+    return self.num_pages - 1 - len(self._free)  # scratch page excluded
+
+  def pages_for(self, tokens: int) -> int:
+    """Pages needed to hold `tokens` cache slots."""
+    return -(-int(tokens) // self.page_size)
+
+  def refcount(self, page_id: int) -> int:
+    return int(self._ref[page_id])
+
+  def alloc(self, n: int) -> List[int]:
+    """Allocate `n` pages (ref 1 each). Raises CacheExhausted when the pool
+    cannot satisfy the request — the engine's graceful length/400 path, the
+    same contract as contiguous-cache capacity failures."""
+    if n <= 0:
+      return []
+    if n > len(self._free):
+      raise CacheExhausted(
+        f"KV page pool exhausted: need {n} pages, {len(self._free)} free "
+        f"of {self.num_pages - 1} (page_size={self.page_size})")
+    ids = [self._free.pop() for _ in range(n)]
+    for p in ids:
+      self._ref[p] = 1
+    return ids
+
+  def incref(self, page_ids) -> None:
+    for p in page_ids:
+      if self._ref[p] <= 0:
+        raise AssertionError(f"incref of free page {p}")
+      self._ref[p] += 1
+
+  def decref(self, page_ids) -> None:
+    """Drop one reference per page; pages reaching zero return to the free
+    list. Their contents are NOT zeroed — page tables are the only way to
+    reach a page, and a freshly allocated page is fully overwritten before
+    its positions become visible (reads are masked by per-row length)."""
+    for p in page_ids:
+      if p == 0:
+        raise AssertionError("decref of the reserved scratch page")
+      if self._ref[p] <= 0:
+        raise AssertionError(f"decref of free page {p}")
+      self._ref[p] -= 1
+      if self._ref[p] == 0:
+        self._free.append(int(p))
+
+
+# --------------------------------------------------------------- device ops
+#
+# Lazily-jitted (jax imports are deferred everywhere in the engine). Both
+# retrace per distinct (cache length, page count) pair — trivial copy
+# programs, and the count is bounded by the po2 prompt buckets.
+
+_JITS: Dict[str, Any] = {}
+
+
+def _commit_jit():
+  fn = _JITS.get("commit")
+  if fn is None:
+    import jax
+    import jax.numpy as jnp
+
+    def commit(arena, cache, page_ids, start_page: int, n: int, page: int):
+      out = {}
+      for name, buf in arena.items():
+        src = cache[name][:, 0]  # [L, S, Hkv, D]
+        lo, hi = start_page * page, (start_page + n) * page
+        if src.shape[1] < hi:
+          pad = [(0, 0)] * src.ndim
+          pad[1] = (0, hi - src.shape[1])
+          src = jnp.pad(src, pad)
+        seg = src[:, lo:hi].reshape(src.shape[0], n, page, *src.shape[2:])
+        out[name] = buf.at[:, page_ids].set(seg.astype(buf.dtype))
+      return out
+
+    fn = _JITS["commit"] = jax.jit(
+      commit, donate_argnames=("arena",), static_argnames=("start_page", "n", "page"))
+  return fn
+
+
+def commit_pages(arena: Dict[str, Any], cache: Dict[str, Any], page_ids,
+                 start_page: int) -> Dict[str, Any]:
+  """Copy contiguous cache pages [start_page, start_page + len(page_ids))
+  into the arena at `page_ids`. `cache` leaves are [L, 1, S, Hkv, D] (the
+  per-request prefill buffer); positions past the request's pos may be
+  garbage — they are copied but never read (masked by per-row length).
+  Returns the updated arena (input donated)."""
+  import jax.numpy as jnp
+  n = int(np.asarray(page_ids).shape[0])
+  if n == 0:
+    return arena
+  page = arena["k"].shape[2]
+  return _commit_jit()(arena, cache, jnp.asarray(page_ids, jnp.int32),
+                       int(start_page), n, page)
+
+
+def _gather_jit():
+  fn = _JITS.get("gather")
+  if fn is None:
+    import jax
+
+    def gather(arena, page_ids):
+      out = {}
+      for name, buf in arena.items():
+        g = buf[:, page_ids]  # [L, n, page, Hkv, D]
+        out[name] = g.reshape(g.shape[0], 1, g.shape[1] * g.shape[2], *g.shape[3:])
+      return out
+
+    fn = _JITS["gather"] = jax.jit(gather)
+  return fn
+
+
+def gather_pages(arena: Dict[str, Any], page_ids) -> Dict[str, Any]:
+  """Gather `page_ids` back into contiguous form: leaves [L, 1, n*page,
+  Hkv, D]. Used to seed a fresh request's prefill buffer from shared prefix
+  pages, and to un-page a request that falls back to a contiguous code path
+  (draft verification, per-token segment forwards)."""
+  import jax.numpy as jnp
+  return _gather_jit()(arena, jnp.asarray(page_ids, jnp.int32))

@@ -47,11 +47,21 @@ def _prefer_local_dir(repo_or_path: str) -> str:
   holds tokenizer files. AutoProcessor/AutoTokenizer given a repo ID probe
   the Hub with retries even when everything sits on disk — in an air-gapped
   or seeded deployment (see HFShardDownloader._local_complete) that is
-  minutes of retry stalls followed by failure, for files we already have."""
-  if os.path.sep in repo_or_path and os.path.isdir(repo_or_path):
-    return repo_or_path  # already a path
+  minutes of retry stalls followed by failure, for files we already have.
+
+  An existing directory is only taken as a LOCAL PATH when it actually
+  holds a tokenizer artifact (ADVICE r5 #3): an HF repo id like 'org/name'
+  is also a valid relative path, and a same-named artifact-less directory
+  in the CWD would otherwise shadow the Hub repo and fail to load."""
   try:
+    from pathlib import Path
     from xotorch_tpu.download.hf_shard_download import has_tokenizer_artifact, models_dir
+  except Exception:
+    return repo_or_path
+  if (os.path.sep in repo_or_path and os.path.isdir(repo_or_path)
+      and has_tokenizer_artifact(Path(repo_or_path))):
+    return repo_or_path  # a real local tokenizer dir
+  try:
     local = models_dir() / repo_or_path.replace("/", "--")
     if local.is_dir() and has_tokenizer_artifact(local):
       return str(local)

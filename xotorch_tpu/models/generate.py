@@ -394,6 +394,65 @@ def decode_chunk_ring_batched(
 
 @partial(
   jax.jit,
+  static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_kernel", "pad_rows",
+                   "moe_routed"),
+  donate_argnames=("arena",),
+)
+def decode_chunk_paged(
+  params,
+  arena: Dict[str, jnp.ndarray],  # shared page arena: [L, P, page, Hkv, D] leaves
+  page_table: jnp.ndarray,  # [B, max_pages] int32 physical page ids (0-padded)
+  toks: jnp.ndarray,  # [B, 1] int32 — each request's last sampled token
+  pos_vec: jnp.ndarray,  # [B] int32 per-request positions
+  key: jax.Array,
+  cfg: ModelConfig,
+  num_tokens: int,
+  temps: jnp.ndarray,  # [B] per-request temperatures (traced)
+  top_k: int,
+  top_p: float = 0.0,
+  use_kernel: bool = False,  # static: Pallas ragged kernel vs XLA gather
+  pad_rows: int = 0,  # static: dummy rows padding B to a power of two
+  moe_routed: bool = True,
+):
+  """Batched fused decode over the PAGED KV pool, ONE executable end to end.
+
+  Where decode_chunk_batched must first grow every member to a common
+  contiguous length, then stack B caches and split them back per chunk,
+  here batch membership is pure metadata: rows index the ONE shared arena
+  through their page tables, writes scatter into each row's current page,
+  and reads stop at each row's own occupied pages (ops/paged_attention) —
+  no per-chunk stack/split, no common-length growth, no grow-copies.
+
+  Dummy pad rows carry an all-zero page table: their writes land in the
+  pool's reserved scratch page 0 (never allocated to a request) and their
+  outputs are discarded — same log2(max batch) executable bounding as the
+  contiguous batched path, without donating a real buffer twice. Returns
+  ([B_real, num_tokens] int32 tokens, updated arena).
+  """
+  B = toks.shape[0]
+  if pad_rows:
+    page_table = jnp.concatenate(
+      [page_table, jnp.zeros((pad_rows, page_table.shape[1]), page_table.dtype)], axis=0)
+    toks = jnp.concatenate([toks, jnp.broadcast_to(toks[:1], (pad_rows, 1))], axis=0)
+    pos_vec = jnp.concatenate([pos_vec, jnp.zeros((pad_rows,), pos_vec.dtype)])
+    temps = jnp.concatenate([temps, jnp.broadcast_to(temps[:1], (pad_rows,))])
+
+  def step(carry, _):
+    tok, arena, pos, key = carry
+    logits, arena = forward_shard(params, tok, arena, pos, cfg=cfg, is_first=True,
+                                  is_last=True, moe_routed=moe_routed,
+                                  page_table=page_table, paged_kernel=use_kernel)
+    key, sub = jax.random.split(key)
+    nxt = sample_logits(logits[:, -1, :], sub, temp=temps, top_k=top_k, top_p=top_p)
+    return (nxt[:, None], arena, pos + 1, key), nxt
+
+  init = (toks.astype(jnp.int32), arena, pos_vec.astype(jnp.int32), key)
+  (_, arena, _, _), out = jax.lax.scan(step, init, None, length=num_tokens)
+  return out.T[:B], arena
+
+
+@partial(
+  jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "pad_rows",
                    "moe_routed"),
   donate_argnames=("caches",),

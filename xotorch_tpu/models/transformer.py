@@ -199,6 +199,8 @@ def _attention_block(
   cfg: ModelConfig, inv_freq: jnp.ndarray, use_flash: bool = False,
   ring_mesh=None, use_flash_decode: bool = False,
   window: Optional[jnp.ndarray] = None,  # per-layer scalar, 0 = global
+  page_table: Optional[jnp.ndarray] = None,  # [B, max_pages]: paged-KV decode
+  paged_kernel: bool = False,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -217,6 +219,39 @@ def _attention_block(
     k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps, cfg.norm_offset)
   q = apply_rope(q, positions, inv_freq)
   k = apply_rope(k, positions, inv_freq)
+  if page_table is not None:
+    # Paged-KV decode (engine XOT_PAGED_KV): layer_cache leaves are one
+    # layer's slice of the shared page arena ([P, page, Hkv, D]); this
+    # request batch reaches its tokens through `page_table`. The fresh
+    # K/V scatter into each row's CURRENT page (index pos // page); reads
+    # go through ops/paged_attention, which stops at each ROW's occupied
+    # pages instead of the batch maximum. T == 1 by contract (decode
+    # steps; prefill stays contiguous and is committed to pages after).
+    if T != 1:
+      raise ValueError(f"paged attention serves decode steps only, got T={T}")
+    from xotorch_tpu.ops.paged_attention import paged_decode_attention
+    page = layer_cache["k"].shape[1]
+    # mode="clip": dummy pad rows (all-zero table, pos from 0) can step their
+    # page index past the table width inside a chunk — clamping keeps them on
+    # a real table slot, which for them is always the scratch page.
+    pidx = jnp.take_along_axis(
+      page_table, (start_pos.astype(jnp.int32) // page)[:, None], axis=1,
+      mode="clip")[:, 0]
+    off = start_pos.astype(jnp.int32) % page
+    layer_cache = {
+      "k": layer_cache["k"].at[pidx, off].set(k[:, 0].astype(layer_cache["k"].dtype)),
+      "v": layer_cache["v"].at[pidx, off].set(v[:, 0].astype(layer_cache["v"].dtype)),
+    }
+    attn_scale_p = cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar else None
+    attn = paged_decode_attention(
+      q, layer_cache["k"], layer_cache["v"], page_table, kv_valid_len,
+      softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
+      use_kernel=paged_kernel)
+    attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+    out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
+    if cfg.sandwich_norms:
+      out = rms_norm(out, layer["post_attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+    return out, layer_cache
   layer_cache = _cache_write(layer_cache, k, v, start_pos)
   kv_quant = "k_scale" in layer_cache
   if (window is not None or cfg.attn_logit_softcap or cfg.query_pre_attn_scalar) \
@@ -365,8 +400,16 @@ def forward_shard(
   use_flash_decode: bool = False,
   start_layer: int = 0,
   moe_routed: bool = True,
+  page_table: Optional[jnp.ndarray] = None,  # [B, max_pages]: paged-KV decode
+  paged_kernel: bool = False,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
+
+  With `page_table`, `cache` is the shared page ARENA (leaves
+  [L, num_pages, page_size, Hkv, D] — paged_cache.PagePool) and start_pos
+  is the [B] per-row position vector: decode steps write into each row's
+  current page and attend only its occupied pages (ops/paged_attention).
+  The page table is closed over rather than scanned (it has no L axis).
 
   moe_routed (static): decode-sized MoE inputs take the top-k gather path;
   the engine passes False when expert weights are sharded over an 'ep' mesh
@@ -421,6 +464,10 @@ def forward_shard(
     import numpy as _np
     windows = jnp.asarray(
       _np.array([cfg.layer_window(start_layer + i) for i in range(L)], _np.int32))
+  if page_table is not None and windows is not None:
+    # The engine gates windowed families off the paged path; keep the
+    # invariant loud if a future caller slips one through.
+    raise ValueError("paged KV does not support sliding-window configs")
 
   def layer_body(h, xs):
     if windows is None:
@@ -431,6 +478,7 @@ def forward_shard(
     attn_out, layer_cache = _attention_block(
       layer, h, layer_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
       ring_mesh, use_flash_decode, window=window,
+      page_table=page_table, paged_kernel=paged_kernel,
     )
     h = h + attn_out
     mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)

@@ -26,6 +26,25 @@ from xotorch_tpu.utils.helpers import DEBUG
 _GRACEFUL_CLOSES: set = set()
 
 
+async def drain_graceful_closes(timeout: float = 3.0) -> None:
+  """Settle detached graceful channel drains at shutdown (ADVICE r5 #4):
+  give in-flight RPCs a short window to finish, then cancel the rest so
+  process exit never destroys pending tasks ('task was destroyed but it is
+  pending') and channels close deterministically. Called from Node.stop;
+  idempotent and safe with no drains outstanding."""
+  tasks = [t for t in _GRACEFUL_CLOSES if not t.done()]
+  if not tasks:
+    return
+  _, pending = await asyncio.wait(tasks, timeout=timeout)
+  for t in pending:
+    t.cancel()
+  if pending:
+    # Cancellation closes the channel immediately (the drain's whole point
+    # was a longer grace) — acceptable at shutdown, and awaited so the
+    # cancellation actually lands before the loop dies.
+    await asyncio.gather(*pending, return_exceptions=True)
+
+
 class GRPCPeerHandle(PeerHandle):
   def __init__(self, _id: str, address: str, desc: str, device_capabilities: DeviceCapabilities):
     self._id = _id

@@ -1,0 +1,192 @@
+"""Ragged paged-attention decode: queries over a shared KV page arena.
+
+The paged KV pool (inference/jax_engine/paged_cache.py) stores every
+resident request's cache as fixed-size pages in ONE arena per layer; each
+batch row reaches its tokens through a page table. Decode attention then has
+two jobs the contiguous kernels don't: indirect the KV reads through the
+table, and stop at each ROW's own occupied page count instead of the batch
+maximum — a 16 k-context row co-batched with 512-token rows must not make
+the short rows stream (or even DMA) 16 k of cache.
+
+Two implementations, one contract:
+
+- `_paged_attention_xla`: pure-XLA `jnp.take` gather of each row's pages +
+  the shared gqa_attention mask math (ops/attention.py). Runs anywhere,
+  reference for correctness tests, and the CPU-serving fallback.
+- `_paged_attention_kernel`: Pallas TPU kernel following the
+  flash_decode.py occupancy-DMA pattern. Grid = (B, Hkv, max_pages); the
+  page table and per-row lengths are scalar-prefetch operands so the kv
+  BlockSpec index map can resolve LOGICAL page j to its PHYSICAL arena page
+  — and clamp j past the row's last occupied page to that last page
+  (`_logical_page_index`): the repeated block index makes Pallas elide the
+  DMA, so each row streams ceil(len_b / page) pages from HBM, not
+  max_pages. Unallocated/padded table slots are never touched.
+
+T == 1 only (the decode step); chunked prefill stays on the contiguous
+buffer and is committed to pages when decode starts (engine
+_commit_state_to_pages). On CPU the kernel runs in interpret mode so tests
+exercise the same code path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from xotorch_tpu.ops.flash_attention import _mxu_operand, _softcap
+
+NEG_INF = -1e30
+
+
+def _logical_page_index(j, length, page_size: int):
+  """Logical kv-page index a grid step `j` should read for a row holding
+  `length` tokens: j itself while occupied, else saturating at the row's
+  LAST occupied page. The saturation is the ragged skip — consecutive grid
+  steps mapping to the same page make Pallas elide the DMA, so a row's HBM
+  reads stop at ceil(length / page_size) pages regardless of the batch
+  maximum. Exposed for tests (per-row-read assertion without a TPU)."""
+  last = jnp.maximum(length - 1, 0) // page_size
+  return jnp.minimum(j, last)
+
+
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page: int, groups: int,
+                  scale: float, softcap: float):
+  """Grid = (B, Hkv, n_pages); the page axis innermost so VMEM scratch
+  carries the online-softmax state across one (batch, kv-head)'s pages.
+  Rows of a tile are the `groups` query heads sharing this kv head (the
+  T == 1 specialisation of flash_decode's GQA packing)."""
+  b = pl.program_id(0)
+  j = pl.program_id(2)
+  n_j = pl.num_programs(2)
+  length = len_ref[b]
+
+  @pl.when(j == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  @pl.when(j * page < length)
+  def _compute():
+    q = _mxu_operand(q_ref[0, 0])  # [groups, D]
+    k = _mxu_operand(k_ref[0, 0])  # [page, D]
+    v = _mxu_operand(v_ref[0, 0])
+    s = jax.lax.dot_general(
+      q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [groups, page]
+    s = _softcap(s, softcap)
+    # The decode query sits at position length - 1: every occupied position
+    # is causally visible, so the mask is occupancy alone.
+    k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos < length, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[:] = jnp.broadcast_to(
+      alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+      p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+  @pl.when(j == n_j - 1)
+  def _finalize():
+    l = l_ref[:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
+                            scale: float, softcap: float,
+                            interpret: bool | None) -> jnp.ndarray:
+  B, T, Hq, D = q.shape
+  _, page, Hkv, _ = k_pages.shape
+  groups = Hq // Hkv
+  maxp = page_table.shape[1]
+  if interpret is None:
+    interpret = jax.default_backend() != "tpu"
+
+  qt = q[:, 0].reshape(B, Hkv, groups, D)  # head h_q = kv * groups + g
+  kt = k_pages.transpose(2, 0, 1, 3)  # [Hkv, P, page, D]
+  vt = v_pages.transpose(2, 0, 1, 3)
+  pt = page_table.astype(jnp.int32)
+  lens = lengths.astype(jnp.int32)
+
+  def _kv_map(b, h, j, pt_ref, len_ref):
+    jj = _logical_page_index(j, len_ref[b], page)
+    return (h, pt_ref[b, jj], 0, 0)
+
+  q_block = pl.BlockSpec((1, 1, groups, D), lambda b, h, j, *_: (b, h, 0, 0))
+  kv_block = pl.BlockSpec((1, 1, page, D), _kv_map)
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=2,
+    grid=(B, Hkv, maxp),
+    in_specs=[q_block, kv_block, kv_block],
+    out_specs=q_block,
+    scratch_shapes=[
+      pltpu.VMEM((groups, D), jnp.float32),
+      pltpu.VMEM((groups, 128), jnp.float32),
+      pltpu.VMEM((groups, 128), jnp.float32),
+    ],
+  )
+  out = pl.pallas_call(
+    functools.partial(_paged_kernel, page=page, groups=groups,
+                      scale=scale, softcap=float(softcap)),
+    grid_spec=grid_spec,
+    out_shape=jax.ShapeDtypeStruct((B, Hkv, groups, D), q.dtype),
+    interpret=interpret,
+  )(pt, lens, qt, kt, vt)
+  return out.reshape(B, 1, Hq, D)
+
+
+def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                         scale: float, softcap: float) -> jnp.ndarray:
+  """`jnp.take`-based fallback: gather each row's pages into a per-row
+  contiguous view, then run the shared masked-softmax math. Padded table
+  slots gather the scratch page; their positions sit at or past the row's
+  length and mask out."""
+  from xotorch_tpu.ops.attention import gqa_attention
+  B = q.shape[0]
+  maxp, page = page_table.shape[1], k_pages.shape[1]
+  k = jnp.take(k_pages, page_table, axis=0)  # [B, maxp, page, Hkv, D]
+  v = jnp.take(v_pages, page_table, axis=0)
+  k = k.reshape(B, maxp * page, *k.shape[3:])
+  v = v.reshape(B, maxp * page, *v.shape[3:])
+  q_positions = (lengths.astype(jnp.int32) - 1)[:, None]  # [B, 1]
+  return gqa_attention(q, k, v, q_positions, kv_valid_len=lengths.astype(jnp.int32),
+                       scale=scale, softcap=softcap)
+
+
+def paged_decode_attention(
+  q: jnp.ndarray,  # [B, 1, Hq, D] — each row's decode query
+  k_pages: jnp.ndarray,  # [P, page, Hkv, D] — one layer's K arena
+  v_pages: jnp.ndarray,  # [P, page, Hkv, D]
+  page_table: jnp.ndarray,  # [B, max_pages] int32 physical page ids (0-padded)
+  lengths: jnp.ndarray,  # [B] int32 — occupied positions incl. this step
+  softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
+  scale: float | None = None,  # static score scale; None = D**-0.5
+  use_kernel: bool = False,
+  interpret: bool | None = None,
+) -> jnp.ndarray:
+  """Causal GQA decode attention over each row's occupied pages.
+
+  Row b's query (at absolute position lengths[b] - 1) attends positions
+  [0, lengths[b]) reached through page_table[b]. Returns [B, 1, Hq, D].
+  `use_kernel` (static) selects the Pallas path; the default XLA gather
+  path is the correctness reference and the off-TPU fallback.
+  """
+  D = q.shape[-1]
+  scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+  if use_kernel:
+    return _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
+                                   scale, float(softcap), interpret)
+  return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                              scale, float(softcap))

@@ -243,6 +243,13 @@ class Node:
         pass
     await self.discovery.stop()
     await self.server.stop()
+    # Detached graceful channel drains (peer replacement mid-request) must
+    # not outlive the node: settle them with a short grace, cancel the rest.
+    try:
+      from xotorch_tpu.networking.grpc.peer_handle import drain_graceful_closes
+      await drain_graceful_closes()
+    except ImportError:
+      pass  # grpc-less deployments (in-process ring) have none
 
   # ----------------------------------------------------------- status bus
 
