@@ -806,15 +806,180 @@ def _run_concurrent(model_id: str, prefill_len: int, decode_tokens: int, n_conc:
     max_width = max(widths) if widths else 0
     _record(progress_path, "concurrent:aggregate", n=n_conc, tok_s=round(agg_tok_s, 2),
             dispatches=len(widths), max_batch_width=max_width)
-    return {
+    out = {
       "concurrent_n": n_conc,
       "concurrent_tok_s": round(agg_tok_s, 2),
       "single_stream_tok_s": round(single_tok_s, 2),
       "concurrency_speedup": round(agg_tok_s / single_tok_s, 2) if single_tok_s else None,
       "concurrent_max_batch_width": max_width,
     }
+    out.update(_kv_pool_metrics(engine))
+    return out
 
   return asyncio.run(run())
+
+
+def _kv_pool_metrics(engine) -> dict:
+  """Paged-KV observability snapshot for bench records (mirrors the /metrics
+  gauges/counters): pool occupancy + the commit/grow copy counters the
+  paged-native path must keep at zero. Empty when no pool exists (XOT_PAGED_KV
+  off)."""
+  stats = engine.page_pool_stats() if hasattr(engine, "page_pool_stats") else None
+  if stats is None:
+    return {}
+  return {
+    "kv_pool_pages_in_use": stats["pages_in_use"],
+    "kv_pool_free_pages": stats["free_pages"],
+    "kv_commit_copy_bytes": int(getattr(engine, "_commit_copy_bytes", 0)),
+    "kv_grow_copies": int(getattr(engine, "_grow_copies", 0)),
+  }
+
+
+def _run_prefill_interference(model_id: str, prefill_len: int, decode_tokens: int,
+                              n_conc: int, progress_path: str) -> dict:
+  """Mixed 16 k-prefill-under-N-stream-decode A/B (ISSUE 2 `pagedfill`):
+  the serving pattern every prior PERF number ignored — PERF's 8-stream
+  aggregate was measured with no prefill interference, so real mixed
+  traffic was strictly worse than anything recorded. N short-prompt decode
+  streams run; mid-decode, one long prompt arrives. Records the long
+  prompt's TTFT and the decode streams' stall (inter-chunk gap p50/max
+  during the prefill window), co-scheduled (XOT_PREFILL_COSCHED=1) vs
+  monolithic (=0), and cross-checks the long prompt's greedy token stream
+  between the two runs — byte inequality feeds the implausibility gate
+  (co-scheduling must reorder work, never change it)."""
+  import asyncio
+  import statistics
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+
+  async def run_once(tag: str) -> dict:
+    engine = JAXShardInferenceEngine()
+    node = Node(f"bench-pagedfill-{tag}", _NullServer(), engine, _NoDiscovery(), None,
+                RingMemoryWeightedPartitioningStrategy(),
+                max_generate_tokens=decode_tokens, default_sample_temp=0.0,
+                decode_chunk_size=16)
+    node.device_capabilities = _bench_caps()
+    node.topology.update_node(node.id, node.device_capabilities)
+    shard = Shard(model_id, 0, n_layers - 1, n_layers)
+
+    stamps: dict = {}  # rid -> [monotonic time per token callback]
+    tokens: dict = {}  # rid -> final token list
+
+    async def generate(rid: str, n_words: int):
+      done = asyncio.Event()
+
+      def on_token(request_id, toks, is_finished):
+        if request_id != rid:
+          return
+        stamps.setdefault(rid, []).append(time.monotonic())
+        tokens[rid] = [int(t) for t in toks]
+        if is_finished:
+          done.set()
+
+      node.on_token.register(f"cb-{rid}").on_next(on_token)
+      await node.process_prompt(shard, " ".join(["w"] * n_words), rid)
+      await asyncio.wait_for(done.wait(), timeout=3600)
+      node.on_token.deregister(f"cb-{rid}")
+
+    async def mixed(round_tag: str) -> dict:
+      """One mixed round: n_conc decode streams; once every stream has its
+      first token, the long prompt fires. Returns TTFT + stall stats."""
+      stamps.clear()
+      tokens.clear()
+      dec = [f"{round_tag}-dec-{i}" for i in range(n_conc)]
+      long_rid = f"{round_tag}-long"
+
+      async def long_after_decode_starts():
+        # Fire the long prompt only once every decode stream has produced
+        # its first token — the interference being measured is prefill vs
+        # STEADY-STATE decode.
+        while len([r for r in stamps if r in dec]) < n_conc:
+          await asyncio.sleep(0.01)
+        t0 = time.monotonic()
+        await generate(long_rid, prefill_len)
+        return t0
+
+      t_start = time.monotonic()
+      results = await asyncio.gather(
+        *(generate(r, 48) for r in dec), long_after_decode_starts())
+      t_long_start = results[-1]
+      t_first_long = stamps[long_rid][0]
+
+      # Decode stall: inter-callback gaps of the decode streams inside the
+      # long prompt's prefill window (start -> first long token).
+      gaps = []
+      for rid in dec:
+        ts = stamps.get(rid, [])
+        prior = [t for t in ts if t <= t_long_start]
+        window = ([prior[-1]] if prior else []) + \
+                 [t for t in ts if t_long_start < t <= t_first_long]
+        gaps.extend(b - a for a, b in zip(window, window[1:]))
+      return {
+        "ttft_s": round(t_first_long - t_long_start, 3),
+        "stall_p50_ms": round(1000 * statistics.median(gaps), 1) if gaps else None,
+        "stall_max_ms": round(1000 * max(gaps), 1) if gaps else None,
+        "decode_chunks_during_prefill": sum(
+          1 for rid in dec for t in stamps.get(rid, [])
+          if t_long_start < t <= t_first_long),
+        "long_tokens": list(tokens.get(long_rid, [])),
+        "elapsed_s": round(time.monotonic() - t_start, 1),
+      }
+
+    # Warmup round compiles everything the measured round dispatches —
+    # including the co-scheduled slice executables, which only exist under
+    # live decode interference (a solo long prompt would warm the
+    # monolithic path instead).
+    await mixed("warm")
+    out = await mixed("meas")
+    out.update(_kv_pool_metrics(engine))
+    _record(progress_path, f"pagedfill:{tag}",
+            **{k: v for k, v in out.items() if k != "long_tokens"})
+    return out
+
+  # The warm round uses byte-identical prompts, so the prefix cache (2
+  # entries by default) would collapse the MEASURED round's prefill to a
+  # warm-prefix hit — TTFT/stall would record a no-op and the A/B would be
+  # vacuous. This stage measures prefill interference, not prefix reuse:
+  # disable the cache for both runs.
+  prev = {k: os.environ.get(k) for k in ("XOT_PREFILL_COSCHED", "XOT_PREFIX_CACHE")}
+  try:
+    os.environ["XOT_PREFIX_CACHE"] = "0"
+    os.environ["XOT_PREFILL_COSCHED"] = "1"
+    cos = asyncio.run(run_once("cosched"))
+    os.environ["XOT_PREFILL_COSCHED"] = "0"
+    mono = asyncio.run(run_once("monolithic"))
+  finally:
+    for k, v in prev.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+
+  # Greedy streams must be byte-equal: co-scheduling reorders executor work
+  # between requests, never the tokens of any one request.
+  n_cmp = min(len(cos["long_tokens"]), len(mono["long_tokens"]), 32)
+  verified = bool(n_cmp > 0 and cos["long_tokens"][:n_cmp] == mono["long_tokens"][:n_cmp])
+  return {
+    "pagedfill_prefill_len": prefill_len,
+    "pagedfill_n_streams": n_conc,
+    "pagedfill_ttft_s": cos["ttft_s"],
+    "pagedfill_stall_p50_ms": cos["stall_p50_ms"],
+    "pagedfill_stall_max_ms": cos["stall_max_ms"],
+    "pagedfill_decode_chunks_during_prefill": cos["decode_chunks_during_prefill"],
+    "pagedfill_nocosched_ttft_s": mono["ttft_s"],
+    "pagedfill_nocosched_stall_p50_ms": mono["stall_p50_ms"],
+    "pagedfill_nocosched_stall_max_ms": mono["stall_max_ms"],
+    "pagedfill_nocosched_decode_chunks_during_prefill": mono["decode_chunks_during_prefill"],
+    "pagedfill_tokens_verified": verified,
+    **{f"pagedfill_{k}": v for k, v in cos.items() if k.startswith("kv_")},
+  }
 
 
 def _find_real_model() -> "tuple[str, str] | None":
@@ -1001,6 +1166,26 @@ def child_main() -> None:
       res.update(_run_concurrent(model_id, min(prefill_len, 64), decode_tokens, n_conc, progress_path))
     except Exception as e:
       res["concurrent_error"] = repr(e)
+  # Prefill-interference stage (opt-in: BENCH_PAGEDFILL=1 — the tpu_retry
+  # `pagedfill` step): long-prompt prefill under N decode streams, TTFT +
+  # decode-stall p50, co-scheduled vs monolithic, streams cross-checked.
+  if os.getenv("BENCH_PAGEDFILL", "0") == "1":
+    try:
+      pf_prefill = int(os.getenv("BENCH_PAGEDFILL_PREFILL", "16384"))
+      pf_decode = int(os.getenv("BENCH_PAGEDFILL_DECODE", "256"))
+      pf_streams = max(2, int(os.getenv("BENCH_CONCURRENT", conc_default) or 8))
+      res.update(_run_prefill_interference(model_id, pf_prefill, pf_decode,
+                                           pf_streams, progress_path))
+      # The paged-prefill/co-scheduling token stream feeds the same
+      # measurement-integrity gate as the fused/per-token cross-check: a
+      # scheduler that changes tokens is lying about its numbers.
+      if res.get("pagedfill_tokens_verified") is False:
+        res["implausible"] = True
+        res["diagnosis"] = "; ".join(filter(None, [
+          res.get("diagnosis"),
+          "co-scheduled vs monolithic prefill token streams disagree"]))
+    except Exception as e:
+      res["pagedfill_error"] = repr(e)
   # Speculative-decoding stage (opt-in: a repeat-heavy prompt through the
   # Node loop with XOT_SPECULATE on vs off, streams cross-checked).
   if os.getenv("BENCH_SPEC", "0") == "1":
@@ -1156,8 +1341,10 @@ def _emit(result: dict) -> None:
       out[k] = result[k]
   # Quantized-flagship fields (int8_tok_s, int8_speedup, int8_error, ...)
   # pass through as a family keyed off the ATTEMPTED format, so even an
-  # unsupported-format failure surfaces its <fmt>_error diagnostic.
-  prefixes = set(QUANT_PREFIXES)
+  # unsupported-format failure surfaces its <fmt>_error diagnostic. The
+  # pagedfill_* (prefill-interference A/B) and kv_* (page-pool
+  # observability) families ride the same mechanism.
+  prefixes = set(QUANT_PREFIXES) | {"pagedfill", "kv"}
   if result.get("quant_fmt"):
     out["quant_fmt"] = result["quant_fmt"]
     prefixes.add(result["quant_fmt"])

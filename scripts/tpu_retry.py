@@ -59,6 +59,14 @@ STEPS: list[tuple[str, dict, str]] = [
   ("paged", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "8",
              "XOT_PAGED_KV": "1"},
    "concurrent_tok_s"),
+  # Paged-native prefill + co-scheduling A/B (ISSUE 2 `pagedfill`): a 16 k
+  # prompt prefills UNDER 8 steady-state decode streams — records the long
+  # prompt's TTFT and the decode streams' stall p50/max with co-scheduling
+  # on vs off (BENCH_PAGEDFILL), greedy streams cross-checked. This is the
+  # mixed-traffic number PERF's prefill-free 8-stream aggregate hid.
+  ("pagedfill", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "8",
+                 "XOT_PAGED_KV": "1", "BENCH_PAGEDFILL": "1"},
+   "pagedfill_ttft_s"),
   # Fused scan-prefill headline (VERDICT r3 #5): prefill_mfu_pct with the
   # whole segment loop in one executable, vs the per-segment path.
   ("scan16k", LONG, "prefill_mfu_pct"),

@@ -514,10 +514,14 @@ async def test_stop_sequences_truncate_and_cancel(monkeypatch):
 
 
 async def test_metrics_include_engine_serving_counters(monkeypatch):
-  """/metrics surfaces the engine's prefix-cache and speculation counters."""
+  """/metrics surfaces the engine's prefix-cache and speculation counters,
+  and — under XOT_PAGED_KV — the page-pool gauges and the commit-copy-bytes
+  counter (zero: paged-native prefill never commit-copies)."""
   from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
 
   monkeypatch.setenv("XOT_PREFIX_CACHE_MIN", "8")
+  monkeypatch.setenv("XOT_PAGED_KV", "1")
+  monkeypatch.setenv("XOT_KV_PAGE", "8")  # prefix sharing is whole-page
   engine = JAXShardInferenceEngine()
   node = await _make_node("api-metrics", engine, max_generate_tokens=3,
                           default_sample_temp=0.0, decode_chunk_size=1)
@@ -535,6 +539,9 @@ async def test_metrics_include_engine_serving_counters(monkeypatch):
     text = await resp.text()
     assert "xot_prefix_cache_hits_total 1" in text, text.splitlines()[-8:]
     assert "xot_spec_tokens_proposed_total" in text
+    assert "xot_kv_commit_copy_bytes_total 0" in text, text.splitlines()[-12:]
+    assert "xot_kv_pool_pages_in_use" in text
+    assert "xot_kv_pool_free_pages" in text
   finally:
     await client.close()
 

@@ -1,0 +1,323 @@
+"""Paged-NATIVE prefill + chunked prefill/decode co-scheduling (ISSUE 2).
+
+PR 1's page arena covered decode only: prefill filled a contiguous buffer
+and paid a full device copy into pages on the first decode chunk
+(engine._commit_state_to_pages), with both copies resident during the
+window, and a warm prefix hit gathered shared pages BACK into a contiguous
+buffer before committing them again. This PR makes the arena the request's
+home for its whole lifetime. Correctness bars:
+
+- paged-prefill ON == OFF token streams, with the page size NOT dividing
+  the prefill segment length (ragged segment/page boundaries) and through
+  both the XLA gather read and the cached-kernel read;
+- an e2e streamed request — cold AND warm-prefix — finishes with
+  xot_kv_commit_copy_bytes_total == 0 and xot_kv_grow_copies_total == 0:
+  no contiguous buffer ever exists, the warm request increfs the matched
+  pages in place (zero gather, zero commit);
+- pool exhaustion MID-PREFILL raises CacheExhausted for the incoming
+  request only — co-batched decode streams keep producing byte-identical
+  tokens and the failed request's partial pages drain on clear;
+- co-scheduling: decode chunks keep resolving while a long prompt
+  prefills (bounded per-cycle stall — the batcher admits one bounded slice
+  per drain cycle), with every stream byte-equal to the solo references.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.engine import CacheExhausted
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+# Long-context variant: the co-scheduling prompts exceed the tiny config's
+# default 128-position window.
+PF_CFG = dict(TINY_LLAMA_CFG, max_position_embeddings=2048)
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+  return make_hf_checkpoint(tmp_path_factory.mktemp("pagedfill"), PF_CFG, seed=5)
+
+
+def _full_shard():
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  return Shard("m", 0, n - 1, n)
+
+
+def _env(monkeypatch, **extra):
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  # Page size 16 with a 24-token prefill chunk: segment boundaries land
+  # MID-PAGE (24 % 16 != 0), the ragged case the scatter write-through must
+  # serve exactly.
+  monkeypatch.setenv("XOT_KV_PAGE", "16")
+  monkeypatch.setenv("XOT_PREFILL_CHUNK", "24")
+  monkeypatch.setenv("XOT_KV_POOL_TOKENS", "1024")
+  for k, v in extra.items():
+    monkeypatch.setenv(k, str(v))
+
+
+def _engine(model_dir):
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+async def _generate(eng, rid, prompt, chunks=3, chunk_size=8, shard=None):
+  """Serving-shaped stream: fused prefill+sample, then fused decode chunks."""
+  shard = shard or _full_shard()
+  tok, _ = await eng.infer_sample_tensor(rid, shard, prompt, temp=0.0)
+  toks = [int(tok)]
+  for _ in range(chunks):
+    out = await eng.generate_chunk(rid, shard, toks[-1], chunk_size, temp=0.0)
+    toks.extend(int(t) for t in out)
+  return toks
+
+
+# 60 tokens = 2 full 24-token segments + a 12-token tail; neither the
+# segments nor the total align to the 16-token page.
+_LONG = np.array([np.arange(60) % 250 + 1], dtype=np.int64)
+
+
+# ------------------------------------------------- stream equality (ragged)
+
+
+async def test_paged_prefill_stream_equal_ragged_boundaries(tiny_model_dir, monkeypatch):
+  """Paged-native prefill ON == OFF greedy streams with page_size NOT
+  dividing the segment length, and zero commit/grow copies on the paged
+  run — the whole request lives in the arena from its first segment."""
+  _env(monkeypatch, XOT_PAGED_KV="0")
+  want = await _generate(_engine(tiny_model_dir), "r", _LONG)
+
+  _env(monkeypatch, XOT_PAGED_KV="1")
+  eng = _engine(tiny_model_dir)
+  got = await _generate(eng, "r", _LONG)
+  assert got == want, f"paged-native {got} != contiguous {want}"
+  assert eng._commit_copy_bytes == 0, "paged-native prefill must never commit-copy"
+  assert eng._grow_copies == 0
+
+  st = eng._contexts[_full_shard()].states["r"]
+  assert st.cache is None and st.pages, "request must be page-resident end to end"
+
+  # The old prefill-then-commit path (XOT_PAGED_PREFILL=0) still works and
+  # still matches — but PAYS the commit copy the native path killed.
+  _env(monkeypatch, XOT_PAGED_KV="1", XOT_PAGED_PREFILL="0")
+  eng_commit = _engine(tiny_model_dir)
+  assert await _generate(eng_commit, "r", _LONG) == want
+  assert eng_commit._commit_copy_bytes > 0
+
+
+async def test_paged_prefill_kernel_read_stream_equal(tiny_model_dir, monkeypatch):
+  """XOT_PAGED_KERNEL=1 routes the paged prefill read through the
+  occupancy-aware cached kernel over the gathered pages (interpret mode
+  off-TPU) — streams must stay byte-equal to the contiguous reference."""
+  _env(monkeypatch, XOT_PAGED_KV="0")
+  want = await _generate(_engine(tiny_model_dir), "r", _LONG, chunks=2)
+
+  _env(monkeypatch, XOT_PAGED_KV="1", XOT_PAGED_KERNEL="1")
+  eng = _engine(tiny_model_dir)
+  got = await _generate(eng, "r", _LONG, chunks=2)
+  assert got == want
+  assert eng._commit_copy_bytes == 0
+
+
+# ------------------------------------------- warm prefix: zero-copy reuse
+
+
+async def test_warm_prefix_zero_copy_zero_commit(tiny_model_dir, monkeypatch):
+  """Cold AND warm-prefix e2e streams finish with zero commit-copy bytes
+  and zero grow-copies: the warm request's table heads with the entry's
+  shared pages IN PLACE (incref, no gather-back), and only the suffix
+  prefills — into fresh pages."""
+  prompt_a = np.array([np.arange(48) % 250 + 1], dtype=np.int64)
+  prompt_b = np.concatenate([prompt_a, np.array([[99, 98, 97, 96, 95, 94]])], axis=1)
+
+  _env(monkeypatch, XOT_PAGED_KV="0", XOT_PREFIX_CACHE="0")
+  ref = _engine(tiny_model_dir)
+  want_a = await _generate(ref, "ca", prompt_a)
+  want_b = await _generate(ref, "cb", prompt_b)
+
+  _env(monkeypatch, XOT_PAGED_KV="1", XOT_PREFIX_CACHE="2", XOT_PREFIX_CACHE_MIN="16")
+  eng = _engine(tiny_model_dir)
+  got_a = await _generate(eng, "ra", prompt_a)
+  assert got_a == want_a
+
+  ctx = eng._contexts[_full_shard()]
+  pool = ctx.page_pool
+  (_, (_, entry)), = ctx.prefix_cache.items()
+  shared = list(entry["pages"])
+  assert entry["len"] == 48 and len(shared) == 3  # 48 tokens -> 3 full 16-pages
+  shared_before = np.asarray(pool.arena["k"][:, np.asarray(shared)])
+
+  got_b = await _generate(eng, "rb", prompt_b)
+  assert got_b == want_b, f"warm paged-native stream {got_b} != contiguous {want_b}"
+  assert eng._prefix_hits == 1 and eng._prefix_tokens_saved == 48
+  # THE acceptance bar: cold and warm requests both finished with zero
+  # commit-copy bytes and zero grow-copies.
+  assert eng._commit_copy_bytes == 0
+  assert eng._grow_copies == 0
+  # The warm table heads with the shared ids; the shared pages' contents
+  # never changed (suffix + decode wrote only fresh pages past them).
+  assert ctx.states["rb"].pages[:3] == shared
+  np.testing.assert_array_equal(shared_before,
+                                np.asarray(pool.arena["k"][:, np.asarray(shared)]))
+
+  await eng.clear_request("ra")
+  await eng.clear_request("rb")
+  eng._clear_prefix_cache(ctx)
+  assert pool.pages_in_use == 0
+
+
+# ------------------------------------- pool pressure mid-prefill isolation
+
+
+async def test_pool_exhaustion_mid_prefill_spares_decode_streams(tiny_model_dir, monkeypatch):
+  """A pool too small for an incoming long prompt raises CacheExhausted for
+  THAT request only, before any shared state is touched: co-batched decode
+  streams keep producing byte-identical tokens, and the failed request's
+  partial pages drain on clear."""
+  # 8 usable pages of 16 tokens. Two short decode streams take ~2 pages
+  # each; a 100-token prompt needs ceil(128/16) = 8 pages for its padded
+  # bucket — impossible mid-stream.
+  _env(monkeypatch, XOT_PAGED_KV="1", XOT_KV_POOL_TOKENS="128", XOT_PREFIX_CACHE="0")
+  shard = _full_shard()
+  s1 = np.array([[7, 3, 11, 2, 9]], dtype=np.int64)
+  s2 = np.array([[42, 17, 5, 9, 1, 13]], dtype=np.int64)
+  big = np.array([np.arange(100) % 250 + 1], dtype=np.int64)
+
+  # Reference streams: the same engine/workload WITHOUT the doomed request.
+  ref = _engine(tiny_model_dir)
+  want1 = await _generate(ref, "s1", s1, chunks=2)
+  want2 = await _generate(ref, "s2", s2, chunks=2)
+
+  eng = _engine(tiny_model_dir)
+  tok1, _ = await eng.infer_sample_tensor("s1", shard, s1, temp=0.0)
+  tok2, _ = await eng.infer_sample_tensor("s2", shard, s2, temp=0.0)
+  toks1, toks2 = [int(tok1)], [int(tok2)]
+
+  async def decode_some(chunks):
+    for _ in range(chunks):
+      o1, o2 = await asyncio.gather(
+        eng.generate_chunk("s1", shard, toks1[-1], 8, temp=0.0),
+        eng.generate_chunk("s2", shard, toks2[-1], 8, temp=0.0))
+      toks1.extend(int(t) for t in o1)
+      toks2.extend(int(t) for t in o2)
+
+  await decode_some(1)
+  with pytest.raises(CacheExhausted):
+    await eng.infer_sample_tensor("big", shard, big, temp=0.0)
+  # The dead prefill's partial pages were released AT the failure — the
+  # decode streams' next pages never contend with a doomed request.
+  ctx = eng._contexts[shard]
+  assert "big" not in ctx.states
+  await decode_some(1)
+
+  assert toks1 == want1, "decode stream s1 diverged after a neighbour's pool exhaustion"
+  assert toks2 == want2, "decode stream s2 diverged after a neighbour's pool exhaustion"
+
+  pool = ctx.page_pool
+  held = pool.pages_in_use
+  await eng.clear_request("s1")
+  await eng.clear_request("s2")
+  assert pool.pages_in_use == 0 and held > 0
+
+
+# ------------------------------------------------ prefill/decode co-scheduling
+
+
+@pytest.mark.parametrize("paged", ["1", "0"])
+async def test_cosched_decode_progresses_during_long_prefill(tiny_model_dir, monkeypatch, paged):
+  """While a long prompt prefills, a co-resident decode stream's chunks
+  keep resolving BETWEEN the prompt's slices (bounded per-cycle stall
+  instead of head-of-line blocking), and both streams stay byte-equal to
+  their solo references. Under paged KV the commit/grow counters stay zero;
+  the contiguous variant proves co-scheduling is paging-independent (its
+  first slice RESERVES the whole prompt so slicing adds no grow-copies
+  beyond the monolithic path's)."""
+  _env(monkeypatch, XOT_PAGED_KV="0")
+  long_prompt = np.array([np.arange(6 * 24 + 13) % 250 + 1], dtype=np.int64)
+  short = np.array([[7, 3, 11, 2]], dtype=np.int64)
+  ref = _engine(tiny_model_dir)
+  want_short = await _generate(ref, "a", short, chunks=6, chunk_size=4)
+  want_long = await _generate(ref, "b", long_prompt, chunks=2, chunk_size=4)
+
+  _env(monkeypatch, XOT_PAGED_KV=paged)
+  eng = _engine(tiny_model_dir)
+  shard = _full_shard()
+
+  # Instrument the slice boundary: every co-scheduled prefill slice records
+  # how many decode chunks had completed when it ran.
+  slice_marks = []
+  real_fill = eng._prefill_fill_sync
+  decode_done = {"n": 0}
+
+  def marking_fill(ctx, rid, sl, paged_native, *rest):
+    slice_marks.append(decode_done["n"])
+    return real_fill(ctx, rid, sl, paged_native, *rest)
+
+  eng._prefill_fill_sync = marking_fill
+
+  tok_a, _ = await eng.infer_sample_tensor("a", shard, short, temp=0.0)
+  toks_a = [int(tok_a)]
+
+  async def decode_a():
+    for _ in range(6):
+      out = await eng.generate_chunk("a", shard, toks_a[-1], 4, temp=0.0)
+      toks_a.extend(int(t) for t in out)
+      decode_done["n"] += 1
+
+  async def prefill_b():
+    tok, _ = await eng.infer_sample_tensor("b", shard, long_prompt, temp=0.0)
+    toks_b = [int(tok)]
+    for _ in range(2):
+      out = await eng.generate_chunk("b", shard, toks_b[-1], 4, temp=0.0)
+      toks_b.extend(int(t) for t in out)
+    return toks_b
+
+  results = await asyncio.gather(decode_a(), prefill_b())
+  toks_b = results[1]
+
+  assert toks_a == want_short, f"decode stream {toks_a} != solo {want_short}"
+  assert toks_b == want_long, f"co-scheduled prefill stream {toks_b} != solo {want_long}"
+  if paged == "1":
+    assert eng._commit_copy_bytes == 0 and eng._grow_copies == 0
+  # The long prompt actually went through the sliced lane (6 full segments
+  # at budget 1 = 6 fill slices)...
+  assert len(slice_marks) >= 2, f"prefill was not co-scheduled: {slice_marks}"
+  # ...and decode chunks resolved WHILE it prefilled: the decode-completion
+  # count strictly advanced between the first and last slice.
+  assert slice_marks[-1] > slice_marks[0], (
+    f"no decode chunk resolved during the prefill window: {slice_marks}")
+
+
+async def test_cosched_off_restores_monolithic_prefill(tiny_model_dir, monkeypatch):
+  """XOT_PREFILL_COSCHED=0: the sliced lane never engages even under
+  concurrent decode — one executor call per prompt, streams unchanged."""
+  _env(monkeypatch, XOT_PAGED_KV="1", XOT_PREFILL_COSCHED="0")
+  eng = _engine(tiny_model_dir)
+  shard = _full_shard()
+  short = np.array([[7, 3, 11, 2]], dtype=np.int64)
+  long_prompt = np.array([np.arange(3 * 24) % 250 + 1], dtype=np.int64)
+
+  called = []
+  real = eng._prefill_fill_sync
+  eng._prefill_fill_sync = lambda *a: (called.append(1), real(*a))[1]
+
+  tok_a, _ = await eng.infer_sample_tensor("a", shard, short, temp=0.0)
+
+  async def decode_a():
+    out = await eng.generate_chunk("a", shard, int(tok_a), 8, temp=0.0)
+    return [int(t) for t in out]
+
+  async def prefill_b():
+    tok, _ = await eng.infer_sample_tensor("b", shard, long_prompt, temp=0.0)
+    return int(tok)
+
+  await asyncio.gather(decode_a(), prefill_b())
+  # The monolithic path calls _prefill_fill_sync ONCE (inside
+  # _infer_sample_sync), never through the batcher's prefill lane.
+  assert len(called) == 1
+  assert not (eng._contexts[shard].batcher and eng._contexts[shard].batcher.pending_prefill)

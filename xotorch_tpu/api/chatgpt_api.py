@@ -210,10 +210,22 @@ class ChatGPTAPI:
       ("_spec_accepted", "xot_spec_tokens_accepted_total", "Speculative draft tokens accepted"),
       ("_grow_copies", "xot_kv_grow_copies_total",
        "Contiguous KV grow-copies (zero under XOT_PAGED_KV decode)"),
+      ("_commit_copy_bytes", "xot_kv_commit_copy_bytes_total",
+       "Device bytes copied committing contiguous prefill KV into pool pages "
+       "(zero under paged-native prefill, XOT_PAGED_PREFILL)"),
     ):
       val = getattr(eng, attr, None)
       if val is not None:
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} counter\n{name} {val}\n")
+    # Page-pool occupancy gauges (XOT_PAGED_KV; absent until a pool exists).
+    stats_fn = getattr(eng, "page_pool_stats", None)
+    stats = stats_fn() if stats_fn is not None else None
+    if stats is not None:
+      for key, name, help_text in (
+        ("pages_in_use", "xot_kv_pool_pages_in_use", "KV pool pages currently referenced"),
+        ("free_pages", "xot_kv_pool_free_pages", "KV pool pages on the free list"),
+      ):
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {stats[key]}\n")
     if extra:
       body = body + "".join(extra).encode()
     # aiohttp's content_type kwarg rejects parameters; set the full

@@ -150,7 +150,14 @@ class _DecodeBatcher:
   idle server runs batches of one with zero added latency, a loaded one
   converges to full-width batches. Rows share one sampling key per chunk
   (per-step splits inside the scan); greedy decoding is unaffected and
-  sampled streams stay independent via their distinct logits."""
+  sampled streams stay independent via their distinct logits.
+
+  The drain cycle also CO-SCHEDULES prefill: `pending_prefill` holds
+  bounded prompt slices (engine _prefill_and_sample splits a long prompt
+  into XOT_PREFILL_CHUNK_BUDGET-segment thunks) and each cycle runs the
+  decode dispatches first, then admits ONE slice — so a 16 k prompt's
+  prefill interleaves with decode instead of monopolising the single-worker
+  executor, and resident streams stall at most one slice per cycle."""
 
   def __init__(self, engine: "JAXShardInferenceEngine", ctx: "Optional[_ShardContext]",
                dispatch=None):
@@ -161,8 +168,22 @@ class _DecodeBatcher:
     # the request's seg list — opaque to the drain loop either way).
     self.dispatch = dispatch
     self.pending: list = []
+    self.pending_prefill: list = []  # (sync thunk, future) prompt slices
     self._draining = False
     self._drain_task = None  # strong ref: the loop only weakly holds tasks
+
+  async def submit_prefill(self, fn) -> Any:
+    """Admit one bounded prefill slice into the drain-cycle rotation. FIFO
+    across requests; a single request's slices stay ordered because its
+    driver awaits each before submitting the next. With an idle decode side
+    the loop degenerates to back-to-back slices (one event-loop tick of
+    overhead per slice — noise next to segment compute)."""
+    fut = asyncio.get_running_loop().create_future()
+    self.pending_prefill.append((fn, fut))
+    if not self._draining:
+      self._draining = True
+      self._drain_task = asyncio.create_task(self._drain())
+    return await fut
 
   async def submit(self, request_id: str, state: "_RequestState", prev_token: int,
                    num_tokens: int, temp: float, top_k: int, top_p: float = 0.0,
@@ -185,7 +206,7 @@ class _DecodeBatcher:
         window = 0.0
       await asyncio.sleep(window)
       batch: list = []
-      while self.pending:
+      while self.pending or self.pending_prefill:
         batch, self.pending = self.pending, []
         # Only (top_k, top_p) are compile-time sampling constants:
         # temperature is TRACED per row (ops/sampling.sample_logits), so
@@ -227,6 +248,20 @@ class _DecodeBatcher:
               for *_, fut in chunk_items:
                 if not fut.done():
                   fut.set_exception(e)
+        # Co-scheduling: decode dispatched first, now admit ONE prefill
+        # slice — the decode stall this cycle is bounded by that slice
+        # (XOT_PREFILL_CHUNK_BUDGET segments), never a whole prompt. Slice
+        # errors (pool exhaustion, capacity) land on the slice's own future
+        # and fail only its request; the drain loop keeps serving.
+        if self.pending_prefill:
+          fn, fut = self.pending_prefill.pop(0)
+          try:
+            res = await self.engine._run(fn)
+            if not fut.done():
+              fut.set_result(res)
+          except Exception as e:
+            if not fut.done():
+              fut.set_exception(e)
         # Let the resolved requests' loops ingest tokens and re-submit before
         # the next take, so steady-state batches stay wide.
         await asyncio.sleep(0)
@@ -234,15 +269,20 @@ class _DecodeBatcher:
       # A failure OUTSIDE the per-group dispatch (whose errors already land
       # on their futures) must fail every affected submitter loudly — both
       # the not-yet-taken `pending` AND the taken-but-undispatched remainder
-      # of `batch`. A hanging `await fut` with no error would freeze the
-      # whole server. set_exception is idempotent via the done() check.
+      # of `batch`, and any queued prefill slices. A hanging `await fut`
+      # with no error would freeze the whole server. set_exception is
+      # idempotent via the done() check.
       failed, self.pending = self.pending, []
+      failed_prefill, self.pending_prefill = self.pending_prefill, []
       for *_, fut in batch + failed:
+        if not fut.done():
+          fut.set_exception(e)
+      for _, fut in failed_prefill:
         if not fut.done():
           fut.set_exception(e)
     finally:
       self._draining = False
-      if self.pending:
+      if self.pending or self.pending_prefill:
         # A submit slipped in between the empty-check and here; it saw
         # _draining=True and didn't start a drain — do it for them.
         self._draining = True
@@ -286,6 +326,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     # request's KV). The paged path (XOT_PAGED_KV) appends into pool pages
     # instead — its tests assert this stays ZERO across decode.
     self._grow_copies = 0
+    # Device bytes copied moving prefilled contiguous KV into pool pages
+    # (_commit_state_to_pages). Paged-NATIVE prefill (XOT_PAGED_PREFILL)
+    # scatters segments straight into pages, so a plain paged request keeps
+    # this at ZERO end to end — the tests' acceptance bar.
+    self._commit_copy_bytes = 0
     # Prefix-cache observability (tests + /metrics): hits and tokens whose
     # prefill was skipped entirely.
     self._prefix_hits = 0
@@ -819,9 +864,93 @@ class JAXShardInferenceEngine(InferenceEngine):
     ctx = await self._ensure_ctx(shard)
     if not shard.is_last_layer:
       raise ValueError(f"infer_sample_tensor requires the last-layer shard, got {shard}")
-    tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data, float(temp),
-                          int(top_k), float(top_p), sampling)
+    tok = await self._prefill_and_sample(ctx, request_id, input_data, float(temp),
+                                         int(top_k), float(top_p), sampling)
     return tok, inference_state
+
+  def _cosched_on(self) -> bool:
+    """XOT_PREFILL_COSCHED: admit a long prompt's prefill slices into the
+    decode batcher's drain cycles (default on) so resident decode streams
+    keep producing while the prompt prefills — per-cycle decode stall is
+    bounded by ONE slice (XOT_PREFILL_CHUNK_BUDGET segments), not one
+    prompt. 0 restores the monolithic one-executor-call prefill."""
+    return os.getenv("XOT_PREFILL_COSCHED", "1") == "1"
+
+  def _prefill_chunk_budget(self) -> int:
+    """Prefill segments admitted per batcher drain cycle (co-scheduling
+    slice size). 1 = finest interleaving (one XOT_PREFILL_CHUNK segment of
+    decode stall per cycle); larger trades decode latency for prefill
+    dispatch amortisation (slices use the fused scan executables)."""
+    return max(1, int(os.getenv("XOT_PREFILL_CHUNK_BUDGET", "1")))
+
+  async def _prefill_and_sample(self, ctx: _ShardContext, request_id: str, input_data,
+                                temp: float, top_k: int, top_p: float,
+                                sampling: Optional[dict]) -> int:
+    """Prefill + first-token sampling driver. Short prompts (and every
+    non-co-scheduled configuration) run the whole thing as ONE executor
+    call, exactly as before. A multi-segment prompt with co-scheduling on
+    instead splits into bounded slices awaited through the decode batcher's
+    prefill lane: the engine executor alternates decode dispatches and
+    prefill slices, so a 16 k prompt no longer head-of-line-blocks every
+    co-resident decode stream for its whole prefill."""
+    chunk = self._prefill_chunk()
+    # Co-scheduling engages only when there is concurrent activity to
+    # protect (the same others-active heuristic as chunk overlap): an idle
+    # engine keeps the monolithic path — one executor call, fused scan
+    # grouping intact. Under load, the sliced path trades that amortisation
+    # for bounded decode stall — exactly the serving-side deal.
+    now = time.monotonic()
+    # list() snapshot: this runs on the EVENT-LOOP thread while the executor
+    # thread inserts/evicts states — iterating the live dict could raise
+    # "dictionary changed size during iteration" under exactly the
+    # concurrent load this path exists for (list(d.items()) is atomic in
+    # CPython; the generator over it is not exposed to mutation).
+    others_active = (
+      (ctx.batcher is not None and bool(ctx.batcher.pending or ctx.batcher.pending_prefill))
+      or any(now - st.last_used < 1.0
+             for rid, st in list(ctx.states.items()) if rid != request_id))
+    cosched = (self._cosched_on() and self._decode_batch_max() > 1 and others_active
+               and getattr(input_data, "ndim", 0) == 2 and input_data.shape[0] == 1
+               and input_data.shape[1] > chunk)
+    if not cosched:
+      return await self._run(self._infer_sample_sync, ctx, request_id, input_data,
+                             temp, top_k, top_p, sampling)
+    if ctx.batcher is None:
+      ctx.batcher = _DecodeBatcher(self, ctx)
+    batcher = ctx.batcher
+    paged_native = self._paged_prefill_ok(ctx, request_id, input_data, sampling)
+    is_fresh = request_id not in ctx.states
+    full_prompt, consumed = await self._run(
+      self._prefill_begin_sync, ctx, request_id, input_data, paged_native)
+    if consumed:
+      input_data = input_data[:, consumed:]
+    try:
+      true_t = input_data.shape[1]
+      split = ((true_t - 1) // chunk) * chunk if true_t > chunk else 0
+      step = self._prefill_chunk_budget() * chunk
+      for off in range(0, split, step):
+        sl = input_data[:, off:min(off + step, split)]
+        # expected_pos guards slice continuity: only the very first slice of
+        # an unseeded request may create the state; every later slice must
+        # find it exactly where the previous slice left it (LRU churn
+        # between slices otherwise silently restarts at pos 0). The first
+        # slice reserves capacity for the WHOLE remaining prompt so the
+        # contiguous path allocates once instead of grow-copying per slice.
+        expected = consumed + off if (consumed or off) else None
+        await batcher.submit_prefill(
+          partial(self._prefill_fill_sync, ctx, request_id, sl, paged_native,
+                  expected, true_t if off == 0 else None))
+      return await batcher.submit_prefill(
+        partial(self._prefill_sample_sync, ctx, request_id, input_data[:, split:],
+                temp, top_k, top_p, sampling, paged_native, full_prompt,
+                consumed + split if (consumed or split) else None))
+    except CacheExhausted:
+      # Pool/capacity exhaustion mid-prefill kills only THIS request: its
+      # partial pages return to the pool at once, so the co-scheduled
+      # decode streams it was interleaving with never feel the pressure.
+      if paged_native and is_fresh:
+        await self._run(self._abort_paged_prefill, ctx, request_id)
+      raise
 
   def _build_extras(self, ctx: _ShardContext, sampling: dict) -> Dict[str, Any]:
     """Materialise a request's sampling extras on device: a dense [1, V]
@@ -920,38 +1049,111 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._sample_calls += 1
     return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
 
-  def _infer_sample_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
-                         temp: float, top_k: int, top_p: float = 0.0,
-                         sampling: Optional[dict] = None) -> int:
-    import jax
-    import jax.numpy as jnp
-    from xotorch_tpu.models.generate import forward_sample
-
-    # Automatic prefix cache: a fresh token prefill sharing a long common
-    # prefix with a stored snapshot seeds its KV from it and runs only the
-    # suffix. Full-model text path only (mid-shards see hidden states, not
-    # tokens, so they cannot key a prefix).
-    full_prompt = None
+  def _prefill_begin_sync(self, ctx: _ShardContext, request_id: str, input_data,
+                          paged_native: bool) -> Tuple[Optional[np.ndarray], int]:
+    """Prefill prologue (executor-side): automatic prefix-cache reuse for a
+    fresh token prefill sharing a long common prefix with a stored entry —
+    full-model text path only (mid-shards see hidden states, not tokens, so
+    they cannot key a prefix). Returns (full prompt for the later
+    _prefix_store, positions consumed by reuse)."""
     is_prefill = (getattr(input_data, "ndim", 0) == 2 and input_data.shape[1] > 1
                   and input_data.shape[0] == 1  # snapshots are keyed batch-1
                   and ctx.shard.is_first_layer and request_id not in ctx.states)
-    if is_prefill:
-      full_prompt = np.asarray(input_data)
-      consumed = self._prefix_reuse(ctx, request_id, full_prompt)
-      if consumed:
-        input_data = input_data[:, consumed:]
+    if not is_prefill:
+      return None, 0
+    full_prompt = np.asarray(input_data)
+    return full_prompt, self._prefix_reuse(ctx, request_id, full_prompt,
+                                           paged_native=paged_native)
 
-    true_t = input_data.shape[1]
+  def _check_prefill_continuity(self, ctx: _ShardContext, request_id: str,
+                                expected_pos: Optional[int]) -> None:
+    """Between co-scheduled slices the engine serves other requests, so a
+    burst of new states can LRU-evict a mid-prefill request. A later slice
+    must NOT silently recreate it at pos 0 and scatter its segment there —
+    fail loudly instead (the node aborts the request, same contract as
+    mid-generation eviction). `expected_pos` is None for the slice allowed
+    to create the state (the first, with no prefix reuse)."""
+    if expected_pos is None:
+      return
+    st = ctx.states.get(request_id)
+    if st is None or st.pos != expected_pos:
+      raise RequestStateLost(
+        f"request {request_id}: prefill state evicted mid-co-scheduled prefill "
+        f"(expected pos {expected_pos}, found {st.pos if st else 'no state'})")
+
+  def _prefill_fill_sync(self, ctx: _ShardContext, request_id: str, input_data,
+                         paged_native: bool, expected_pos: Optional[int] = None,
+                         reserve: Optional[int] = None) -> None:
+    """Cache-fill forward of a prompt slice whose length is a multiple of
+    the prefill chunk — hidden-only executables, outputs dropped on device,
+    never copied to host. The unit of work the co-scheduling lane admits
+    between decode dispatches (_DecodeBatcher.submit_prefill). `reserve`
+    (first slice of a co-scheduled CONTIGUOUS prefill) pre-sizes the cache
+    for the whole remaining prompt, exactly as the monolithic path's
+    one-shot prep does — without it every later slice would trigger a
+    _grow_cache full-buffer copy (the paged side appends pages, no copy,
+    and needs no reservation)."""
+    self._check_prefill_continuity(ctx, request_id, expected_pos)
+    if paged_native:
+      self._paged_fill_sync(ctx, request_id, input_data)
+      return
+    if reserve and reserve > input_data.shape[1]:
+      self._prep_state(ctx, request_id, reserve)
     chunk = self._prefill_chunk()
-    if true_t > chunk:
-      # All but the final segment only fill the cache — hidden-only
-      # executables, outputs dropped on device, never copied to host.
-      split = ((true_t - 1) // chunk) * chunk
-      if not self._scan_prefill(ctx, request_id, input_data[:, :split], chunk):
-        for off in range(0, split, chunk):
-          self._forward_segment(ctx, request_id, input_data[:, off:off + chunk], fill=True)
-      input_data = input_data[:, split:]
+    if not self._scan_prefill(ctx, request_id, input_data, chunk):
+      for off in range(0, input_data.shape[1], chunk):
+        self._forward_segment(ctx, request_id, input_data[:, off:off + chunk], fill=True)
 
+  def _abort_paged_prefill(self, ctx: _ShardContext, request_id: str) -> None:
+    """Release a paged-native prefill that died on pool exhaustion: the
+    request can never produce a token, so its partially-filled pages go
+    back to the pool IMMEDIATELY — co-resident decode streams must not
+    starve on capacity a dead request is holding. (A fresh prefill only;
+    a page-backed state that already streamed tokens keeps its pages and
+    fails through the normal length path.)"""
+    st = ctx.states.get(request_id)
+    if st is not None and st.cache is None:
+      ctx.states.pop(request_id, None)
+      self._release_state_pages(ctx, st)
+
+  def _infer_sample_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
+                         temp: float, top_k: int, top_p: float = 0.0,
+                         sampling: Optional[dict] = None) -> int:
+    paged_native = self._paged_prefill_ok(ctx, request_id, input_data, sampling)
+    is_fresh = request_id not in ctx.states
+    full_prompt, consumed = self._prefill_begin_sync(ctx, request_id, input_data, paged_native)
+    if consumed:
+      input_data = input_data[:, consumed:]
+
+    try:
+      true_t = input_data.shape[1]
+      chunk = self._prefill_chunk()
+      if true_t > chunk:
+        split = ((true_t - 1) // chunk) * chunk
+        self._prefill_fill_sync(ctx, request_id, input_data[:, :split], paged_native)
+        input_data = input_data[:, split:]
+      return self._prefill_sample_sync(ctx, request_id, input_data, temp, top_k, top_p,
+                                       sampling, paged_native, full_prompt)
+    except CacheExhausted:
+      if paged_native and is_fresh:
+        self._abort_paged_prefill(ctx, request_id)
+      raise
+
+  def _prefill_sample_sync(self, ctx: _ShardContext, request_id: str, input_data,
+                           temp: float, top_k: int, top_p: float,
+                           sampling: Optional[dict], paged_native: bool,
+                           full_prompt: Optional[np.ndarray],
+                           expected_pos: Optional[int] = None) -> int:
+    """Final prefill segment: forward + ON-DEVICE sampling of the first
+    token (the epilogue of infer_sample_tensor, shared by the one-shot and
+    co-scheduled drivers)."""
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import forward_sample
+
+    self._check_prefill_continuity(ctx, request_id, expected_pos)
+    if paged_native:
+      return self._paged_sample_sync(ctx, request_id, input_data, temp, top_k, top_p,
+                                     full_prompt)
     x, seg_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
     if sampling and state.extras is None:
       state.extras = self._build_extras(ctx, sampling)
@@ -1171,10 +1373,14 @@ class JAXShardInferenceEngine(InferenceEngine):
   def _prefix_cache_min(self) -> int:
     return int(os.getenv("XOT_PREFIX_CACHE_MIN", "32"))
 
-  def _prefix_reuse(self, ctx: _ShardContext, request_id: str, tokens_2d: np.ndarray) -> int:
+  def _prefix_reuse(self, ctx: _ShardContext, request_id: str, tokens_2d: np.ndarray,
+                    paged_native: bool = False) -> int:
     """Seed a fresh request's cache from the stored snapshot with the
     longest common token prefix (causality makes positions < common valid
-    regardless of what follows). Returns positions consumed (0 = no hit)."""
+    regardless of what follows). Returns positions consumed (0 = no hit).
+    With `paged_native` (paged-native prefill will serve this request) a
+    paged entry is reused with ZERO copies: the matched full pages are
+    incref'd in place as the request's page-table head."""
     if self._prefix_cache_max() <= 0 or not ctx.prefix_cache:
       return 0
     toks = np.asarray(tokens_2d).reshape(-1).astype(np.int64)
@@ -1207,6 +1413,22 @@ class JAXShardInferenceEngine(InferenceEngine):
       if consumed < self._prefix_cache_min():
         return 0
       ids = list(snap["pages"][:consumed // page])
+      if paged_native:
+        # Zero-gather, zero-commit warm start: the matched full pages become
+        # this request's page-table head IN PLACE (incref'd — read-only by
+        # construction, decode/suffix writes land past them in fresh pages).
+        # N warm requests share one arena copy of a hot prefix and never
+        # touch a contiguous buffer at all.
+        state = self._get_or_create_paged_state(ctx, request_id)
+        pool.incref(ids)
+        state.pages = ids
+        state.pos = consumed
+        self._prefix_hits += 1
+        self._prefix_tokens_saved += consumed
+        if DEBUG >= 2:
+          print(f"[{request_id}] prefix cache hit: {consumed} tokens reused in place "
+                f"({len(ids)} shared pages, zero copy)")
+        return consumed
       from xotorch_tpu.inference.jax_engine.paged_cache import gather_pages
       state = self._get_or_create_state(ctx, request_id, min_len=toks.shape[0])
       gathered = gather_pages(pool.arena, np.asarray(ids, np.int32))
@@ -2126,14 +2348,21 @@ class JAXShardInferenceEngine(InferenceEngine):
   #
   # XOT_PAGED_KV=1: requests' KV lives as fixed-size pages in ONE shared
   # arena per context (paged_cache.PagePool) instead of per-request
-  # contiguous buffers. Prefill still runs on the contiguous buffer (its
-  # executables are untouched); the buffer is committed into pages when
-  # decode starts and freed. Decode chunks then index the arena through
-  # per-request page tables (models/generate.decode_chunk_paged): batch
-  # membership is metadata, appends allocate pages instead of grow-copying,
-  # and attention reads only each row's occupied pages. Contiguous remains
-  # the default until on-chip A/B numbers land (scripts/tpu_retry.py
-  # `paged` stage).
+  # contiguous buffers. The page arena is the request's home for its WHOLE
+  # lifetime: paged-NATIVE prefill (XOT_PAGED_PREFILL, default on) scatters
+  # every segment's K/V straight into pool pages (prefill_scan /
+  # forward_sample with a page table), so there is no contiguous prefill
+  # buffer, no commit copy, and no double-residency window — and a warm
+  # prefix hit increfs the matched full pages in place instead of gathering
+  # them back. Decode chunks index the arena through per-request page
+  # tables (models/generate.decode_chunk_paged): batch membership is
+  # metadata, appends allocate pages instead of grow-copying, and attention
+  # reads only each row's occupied pages. _commit_state_to_pages remains
+  # for requests that still prefill contiguous (sampling extras, hidden
+  # input, XOT_PAGED_PREFILL=0) and counts its copied bytes
+  # (_commit_copy_bytes — zero for the native path). Contiguous remains the
+  # default until on-chip A/B numbers land (scripts/tpu_retry.py `paged` /
+  # `pagedfill` stages).
 
   def _paged_on(self) -> bool:
     return os.getenv("XOT_PAGED_KV", "0") == "1"
@@ -2203,6 +2432,9 @@ class JAXShardInferenceEngine(InferenceEngine):
     if fresh:
       pool.arena = commit_pages(pool.arena, state.cache, np.asarray(fresh, np.int32),
                                 start_page=len(seed))
+      leaf = pool.arena["k"]  # [L, P, page, Hkv, D]
+      self._commit_copy_bytes += (2 * len(fresh) * leaf.shape[0] * leaf.shape[2]
+                                  * leaf.shape[3] * leaf.shape[4] * leaf.dtype.itemsize)
     state.pages = seed + fresh
     state.paged_seed = None
     state.cache = None
@@ -2223,6 +2455,12 @@ class JAXShardInferenceEngine(InferenceEngine):
       length *= 2
     length = min(length, ctx.max_cache_len)
     cache = self._new_cache(ctx, length)
+    if not state.pages:
+      # A page-backed state that never wrote anything (pos 0): nothing to
+      # gather — hand back a fresh buffer.
+      state.cache = cache
+      state.pages = None
+      return
     gathered = gather_pages(pool.arena, np.asarray(state.pages, np.int32))
     cut = min(len(state.pages) * pool.page_size, length)
     state.cache = {
@@ -2233,6 +2471,156 @@ class JAXShardInferenceEngine(InferenceEngine):
     }
     pool.decref(state.pages)
     state.pages = None
+
+  # ------------------------------------------------- paged-NATIVE prefill
+
+  def _paged_prefill_on(self) -> bool:
+    """XOT_PAGED_PREFILL: prefill segments scatter straight into pool pages
+    (default on under XOT_PAGED_KV — no contiguous buffer, no commit copy,
+    no double-residency window). 0 restores prefill-then-commit."""
+    return os.getenv("XOT_PAGED_PREFILL", "1") == "1"
+
+  def _paged_prefill_ok(self, ctx: _ShardContext, request_id: str, input_data,
+                        sampling: Optional[dict]) -> bool:
+    """Qualification rule for paged-native prefill: the paged families only
+    (no sliding window / int8 KV — _paged_ok), token input on a full-model
+    shard (mid-ring shards see hidden states), batch 1, no sampling extras
+    (extras decode contiguous per _use_paged — native-paging them would
+    just be unpaged back on their first chunk), no sp ring prefill (which
+    shards positions over chips and outranks), and a state that is either
+    fresh or already page-backed (a contiguous state keeps its path)."""
+    if not (self._paged_on() and self._paged_ok(ctx) and self._paged_prefill_on()
+            and not sampling
+            and ctx.shard.is_first_layer and ctx.shard.is_last_layer
+            and getattr(input_data, "ndim", 0) == 2 and input_data.shape[0] == 1
+            and not (ctx.fill_jits is not None and "ring" in ctx.fill_jits)):
+      return False
+    st = ctx.states.get(request_id)
+    return st is None or (st.cache is None and st.pages is not None and st.extras is None)
+
+  def _get_or_create_paged_state(self, ctx: _ShardContext, request_id: str) -> _RequestState:
+    """Page-backed twin of _get_or_create_state: the state NEVER owns a
+    contiguous buffer — its KV lives in pool pages from the first prefill
+    segment on (cache=None, pages=[])."""
+    state = ctx.states.get(request_id)
+    if state is None:
+      if request_id in self._states_lost_to_oom:
+        raise RequestStateLost(
+          f"request {request_id}: device state dropped by OOM recovery")
+      state = _RequestState(cache=None, pos=0, last_used=time.monotonic(), pages=[])
+      ctx.states[request_id] = state
+      while len(ctx.states) > MAX_RESIDENT_REQUESTS:
+        evicted, est = ctx.states.popitem(last=False)
+        self._release_state_pages(ctx, est)
+        if DEBUG >= 2:
+          print(f"Evicted request state {evicted}")
+    ctx.states.move_to_end(request_id)
+    return state
+
+  def _prep_state_paged(self, ctx: _ShardContext, request_id: str, bucket: int) -> _RequestState:
+    """Page-backed twin of _prep_state: capacity for `bucket` more tokens is
+    PAGES, not a buffer grow. The table must cover the padded bucket — its
+    tail-padding garbage writes land in pages this request owns (masked by
+    per-row length, overwritten by later writes at the same positions);
+    _paged_sample_sync trims the overshoot back to pages_for(pos) after the
+    prompt lands. Pool exhaustion raises CacheExhausted BEFORE any device
+    work, for the incoming request only — co-resident decode streams' pages
+    are untouched."""
+    pool = self._ensure_page_pool(ctx)
+    state = self._get_or_create_paged_state(ctx, request_id)
+    if state.pages is None:
+      raise AssertionError(f"request {request_id}: paged prefill on a contiguous state")
+    self._discard_spec(request_id, state)
+    self._discard_batch_spec_for(ctx, request_id)
+    needed = state.pos + bucket
+    if needed > ctx.max_cache_len:
+      raise CacheExhausted(
+        f"Request {request_id}: {bucket} new tokens at pos {state.pos} "
+        f"exceed max cache length {ctx.max_cache_len}")
+    need_pages = pool.pages_for(needed)
+    if need_pages > len(state.pages):
+      state.pages.extend(self._pool_alloc(ctx, pool, need_pages - len(state.pages)))
+    return state
+
+  def _paged_table_for(self, state: _RequestState):
+    """The request's [1, maxp] device page table, width bucketed to a power
+    of two (0-padded — the scratch page, masked) so the prefill executables
+    stay logarithmic in context length."""
+    import jax.numpy as jnp
+    maxp = _bucket(max(len(state.pages), 1), 1)
+    table = np.zeros((1, maxp), np.int32)
+    table[0, :len(state.pages)] = state.pages
+    return jnp.asarray(table)
+
+  def _paged_fill_sync(self, ctx: _ShardContext, request_id: str, input_data) -> None:
+    """Fill-only paged-native prefill of `input_data` (length a multiple of
+    the prefill chunk): segments scatter straight into pool pages under the
+    fused scan executable — the paged twin of _scan_prefill, with the same
+    power-of-two group decomposition (log dispatches, bounded executables).
+    No contiguous buffer exists at any point."""
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import prefill_scan, scan_groups
+    chunk = self._prefill_chunk()
+    total = int(input_data.shape[1])
+    state = self._prep_state_paged(ctx, request_id, total)
+    pool = ctx.page_pool
+    x = self._to_device_input(input_data)
+    table = self._paged_table_for(state)
+    use_kernel = self._paged_kernel_on()
+    for off, g in scan_groups(total // chunk):
+      _, pool.arena = prefill_scan(
+        ctx.params, x[:, off * chunk:(off + g) * chunk], pool.arena, jnp.int32(state.pos),
+        ctx.cfg, g, is_first=True, start_layer=ctx.shard.start_layer,
+        moe_routed=self._moe_routed_for(ctx),
+        page_table=table, paged_kernel=use_kernel)
+      state.pos += g * chunk
+    state.last_used = time.monotonic()
+
+  def _paged_sample_sync(self, ctx: _ShardContext, request_id: str, input_data,
+                         temp: float, top_k: int, top_p: float,
+                         full_prompt: Optional[np.ndarray]) -> int:
+    """Final paged-native prefill segment + ON-DEVICE first-token sampling:
+    forward_sample over the page arena. After the prompt lands the request
+    is ALREADY page-resident — its first decode chunk is pure metadata
+    (no _commit_state_to_pages copy, no freed buffer)."""
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import forward_sample
+    true_t = int(input_data.shape[1])
+    bucket = 1 if true_t == 1 else _bucket(true_t)
+    state = self._prep_state_paged(ctx, request_id, bucket)
+    pool = ctx.page_pool
+    x = self._to_device_input(input_data)
+    if bucket != true_t:
+      x = jnp.pad(x, [(0, 0), (0, bucket - true_t)])
+    table = self._paged_table_for(state)
+    key = self._extras_key(state, None, request_id=request_id,
+                           sample_pos=state.pos + true_t - 1)
+    tok, pool.arena = forward_sample(
+      ctx.params, x, pool.arena, jnp.int32(state.pos), jnp.int32(true_t - 1), key,
+      ctx.cfg, True, temp, top_k, top_p,
+      start_layer=ctx.shard.start_layer, moe_routed=self._moe_routed_for(ctx),
+      page_table=table, paged_kernel=self._paged_kernel_on())
+    state.pos += true_t
+    # Trim the padded bucket's overshoot: pages past pages_for(pos) hold
+    # only padding garbage and are exclusively ours (fresh-allocated; the
+    # shared prefix sits below pos) — return them to the pool.
+    keep = pool.pages_for(state.pos)
+    if len(state.pages) > keep:
+      pool.decref(state.pages[keep:])
+      del state.pages[keep:]
+    state.last_used = time.monotonic()
+    if full_prompt is not None:
+      self._prefix_store(ctx, request_id, full_prompt)
+    return int(np.asarray(tok).reshape(-1)[0])
+
+  def page_pool_stats(self) -> Optional[Dict[str, int]]:
+    """Aggregate page-pool occupancy across resident contexts, or None when
+    no pool exists (the /metrics gauges appear only under XOT_PAGED_KV)."""
+    pools = [c.page_pool for c in self._contexts.values() if c.page_pool is not None]
+    if not pools:
+      return None
+    return {"pages_in_use": sum(p.pages_in_use for p in pools),
+            "free_pages": sum(p.free_pages for p in pools)}
 
   def _release_state_pages(self, ctx: _ShardContext, state: _RequestState) -> None:
     """Drop a finished/evicted request's page references (committed table

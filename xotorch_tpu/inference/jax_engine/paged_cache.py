@@ -25,6 +25,12 @@ completed prefill's full pages with later requests instead of snapshotting
 whole caches: shared pages are read-only by construction (decode only ever
 writes at page index pos // page_size, past every shared full page), so
 copy-on-write degenerates to share-full-pages / copy-the-partial-tail.
+
+Since paged-NATIVE prefill (engine XOT_PAGED_PREFILL) the arena is a
+request's home for its WHOLE lifetime: prefill segments scatter K/V
+straight into pages and a warm prefix hit increfs the matched pages in
+place as the new request's table head — commit_pages/gather_pages below
+serve only the contiguous-fallback paths.
 """
 from __future__ import annotations
 
@@ -120,6 +126,13 @@ class PagePool:
 # Lazily-jitted (jax imports are deferred everywhere in the engine). Both
 # retrace per distinct (cache length, page count) pair — trivial copy
 # programs, and the count is bounded by the po2 prompt buckets.
+#
+# With paged-NATIVE prefill (XOT_PAGED_PREFILL, default on) these are COLD
+# paths: prefill segments scatter straight into arena pages
+# (transformer._attention_block's page branch), so commit_pages runs only
+# for requests that still prefill contiguous (sampling extras, hidden
+# input, the env off), and gather_pages only when a contiguous-only code
+# path (draft verify, extras decode) un-pages a resident request.
 
 _JITS: Dict[str, Any] = {}
 

@@ -27,7 +27,7 @@ from xotorch_tpu.ops.sampling import sample_logits, sample_logits_logprobs
 @partial(
   jax.jit,
   static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode",
-                   "start_layer", "top_lp", "moe_routed"),
+                   "start_layer", "top_lp", "moe_routed", "paged_kernel"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -52,10 +52,15 @@ def forward_sample(
   top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
   moe_routed: bool = True,  # static: False when experts shard over 'ep'
   min_p=None,  # min-p cutoff (traced; None = off) — ops/sampling
+  page_table: jnp.ndarray = None,  # [1, max_pages]: paged-NATIVE prefill — `cache` is the arena
+  paged_kernel: bool = False,
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
   ([B] int32 sampled token, updated cache) — with `top_lp >= 0`, instead
   ((tok, lp, top_ids, top_lps), cache) per ops/sampling.sample_logits_logprobs.
+  With `page_table`, `cache` is the shared page ARENA and the segment's K/V
+  scatter straight into pool pages (transformer.forward_shard paged prefill);
+  the donated/returned cache is then the updated arena.
 
   Two wins over infer_tensor-then-sample (VERDICT r1 weak #3):
   - the host never sees the [B, T, vocab] fp32 logits (~0.5 MB/token for a
@@ -67,7 +72,8 @@ def forward_sample(
   """
   h, cache = forward_shard(params, x, cache, start_pos, cfg=cfg, is_first=is_first,
                            is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode,
-                           start_layer=start_layer, moe_routed=moe_routed)
+                           start_layer=start_layer, moe_routed=moe_routed,
+                           page_table=page_table, paged_kernel=paged_kernel)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
   if top_lp >= 0:
@@ -181,7 +187,7 @@ def scan_groups(n_segs: int):
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "n_segs", "is_first", "start_layer", "moe_routed"),
+  static_argnames=("cfg", "n_segs", "is_first", "start_layer", "moe_routed", "paged_kernel"),
   donate_argnames=("cache",),
 )
 def prefill_scan(
@@ -194,6 +200,8 @@ def prefill_scan(
   is_first: bool = True,
   start_layer: int = 0,
   moe_routed: bool = True,
+  page_table: jnp.ndarray = None,  # [1, max_pages]: paged-NATIVE prefill — `cache` is the arena
+  paged_kernel: bool = False,
 ):
   """Chunked long-prompt prefill as ONE device program: `lax.scan` over the
   prompt's fixed-size segments, each step = forward_shard over the
@@ -218,6 +226,11 @@ def prefill_scan(
   the output shape identical to the per-segment path, so ring forwarding
   (non-last shards hand hidden states to the next partition) and the
   fused-sample tail both consume it unchanged.
+
+  With `page_table`, `cache` is the shared page ARENA: every segment's K/V
+  scatter straight into pool pages (paged-NATIVE prefill — the table must
+  already cover start_pos + T), and the donated/returned cache is the
+  updated arena. The table is closed over by the scan body (no L axis).
   """
   B, T = x.shape[0], x.shape[1]
   seg = T // n_segs
@@ -227,7 +240,8 @@ def prefill_scan(
     cache, pos = carry
     h, cache = forward_shard(params, x_seg, cache, pos, cfg=cfg, is_first=is_first,
                              is_last=False, use_flash_decode=True,
-                             start_layer=start_layer, moe_routed=moe_routed)
+                             start_layer=start_layer, moe_routed=moe_routed,
+                             page_table=page_table, paged_kernel=paged_kernel)
     return (cache, pos + seg), h
 
   (cache, _), hs = jax.lax.scan(step, (cache, start_pos.astype(jnp.int32)), xs)

@@ -220,33 +220,56 @@ def _attention_block(
   q = apply_rope(q, positions, inv_freq)
   k = apply_rope(k, positions, inv_freq)
   if page_table is not None:
-    # Paged-KV decode (engine XOT_PAGED_KV): layer_cache leaves are one
-    # layer's slice of the shared page arena ([P, page, Hkv, D]); this
-    # request batch reaches its tokens through `page_table`. The fresh
-    # K/V scatter into each row's CURRENT page (index pos // page); reads
-    # go through ops/paged_attention, which stops at each ROW's occupied
-    # pages instead of the batch maximum. T == 1 by contract (decode
-    # steps; prefill stays contiguous and is committed to pages after).
-    if T != 1:
-      raise ValueError(f"paged attention serves decode steps only, got T={T}")
-    from xotorch_tpu.ops.paged_attention import paged_decode_attention
+    # Paged KV (engine XOT_PAGED_KV): layer_cache leaves are one layer's
+    # slice of the shared page arena ([P, page, Hkv, D]); this request
+    # batch reaches its tokens through `page_table`. The fresh K/V scatter
+    # straight into pool pages — position p lands at table[p // page] slot
+    # p % page — so decode appends AND prefill segments are page-native
+    # (no contiguous buffer, no commit copy). Reads go through
+    # ops/paged_attention, which stops at each ROW's occupied pages instead
+    # of the batch maximum.
+    from xotorch_tpu.ops.paged_attention import paged_decode_attention, paged_prefill_attention
     page = layer_cache["k"].shape[1]
-    # mode="clip": dummy pad rows (all-zero table, pos from 0) can step their
-    # page index past the table width inside a chunk — clamping keeps them on
-    # a real table slot, which for them is always the scratch page.
-    pidx = jnp.take_along_axis(
-      page_table, (start_pos.astype(jnp.int32) // page)[:, None], axis=1,
-      mode="clip")[:, 0]
-    off = start_pos.astype(jnp.int32) % page
-    layer_cache = {
-      "k": layer_cache["k"].at[pidx, off].set(k[:, 0].astype(layer_cache["k"].dtype)),
-      "v": layer_cache["v"].at[pidx, off].set(v[:, 0].astype(layer_cache["v"].dtype)),
-    }
     attn_scale_p = cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar else None
-    attn = paged_decode_attention(
-      q, layer_cache["k"], layer_cache["v"], page_table, kv_valid_len,
-      softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
-      use_kernel=paged_kernel)
+    if T == 1:
+      # Decode step: [B] per-row positions (scalar normalised — a 1-token
+      # paged prefill is the same write).
+      sp = (jnp.full((B,), start_pos, jnp.int32) if jnp.ndim(start_pos) == 0
+            else start_pos.astype(jnp.int32))
+      # mode="clip": dummy pad rows (all-zero table, pos from 0) can step
+      # their page index past the table width inside a chunk — clamping
+      # keeps them on a real table slot, which for them is always the
+      # scratch page.
+      pidx = jnp.take_along_axis(page_table, (sp // page)[:, None], axis=1,
+                                 mode="clip")[:, 0]
+      off = sp % page
+      layer_cache = {
+        "k": layer_cache["k"].at[pidx, off].set(k[:, 0].astype(layer_cache["k"].dtype)),
+        "v": layer_cache["v"].at[pidx, off].set(v[:, 0].astype(layer_cache["v"].dtype)),
+      }
+      attn = paged_decode_attention(
+        q, layer_cache["k"], layer_cache["v"], page_table, kv_valid_len,
+        softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
+        use_kernel=paged_kernel)
+    else:
+      # Paged-native prefill segment: every position scatters into its own
+      # (page, slot). B == 1 by contract (per-request prefill); the engine
+      # allocates the table to cover the PADDED segment, so bucket-padding
+      # garbage lands in pages this request owns (masked by kv_valid_len,
+      # overwritten by later writes at the same positions).
+      if B != 1:
+        raise ValueError(f"paged prefill serves per-request segments (B == 1), got B={B}")
+      pos_vec = positions[0].astype(jnp.int32)  # [T] absolute positions
+      pidx = jnp.take(page_table[0], pos_vec // page, mode="clip")
+      off = pos_vec % page
+      layer_cache = {
+        "k": layer_cache["k"].at[pidx, off].set(k[0].astype(layer_cache["k"].dtype)),
+        "v": layer_cache["v"].at[pidx, off].set(v[0].astype(layer_cache["v"].dtype)),
+      }
+      attn = paged_prefill_attention(
+        q, layer_cache["k"], layer_cache["v"], page_table, positions, kv_valid_len,
+        softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
+        use_kernel=paged_kernel)
     attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
     out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
     if cfg.sandwich_norms:
@@ -406,9 +429,11 @@ def forward_shard(
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
 
   With `page_table`, `cache` is the shared page ARENA (leaves
-  [L, num_pages, page_size, Hkv, D] — paged_cache.PagePool) and start_pos
-  is the [B] per-row position vector: decode steps write into each row's
-  current page and attend only its occupied pages (ops/paged_attention).
+  [L, num_pages, page_size, Hkv, D] — paged_cache.PagePool). Decode steps
+  (T == 1, [B] per-row start_pos) write into each row's current page and
+  attend only its occupied pages; prefill segments (T > 1, B == 1, scalar
+  start_pos) scatter every position straight into its page — paged-NATIVE
+  prefill, no contiguous buffer and no commit copy (ops/paged_attention).
   The page table is closed over rather than scanned (it has no L axis).
 
   moe_routed (static): decode-sized MoE inputs take the top-k gather path;

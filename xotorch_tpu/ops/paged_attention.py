@@ -22,10 +22,16 @@ Two implementations, one contract:
   DMA, so each row streams ceil(len_b / page) pages from HBM, not
   max_pages. Unallocated/padded table slots are never touched.
 
-T == 1 only (the decode step); chunked prefill stays on the contiguous
-buffer and is committed to pages when decode starts (engine
-_commit_state_to_pages). On CPU the kernel runs in interpret mode so tests
-exercise the same code path.
+`paged_decode_attention` is T == 1 only (the decode step).
+`paged_prefill_attention` serves chunked-prefill SEGMENTS (T > 1) whose K/V
+were scattered straight into pool pages (transformer._attention_block's
+paged write-through): it gathers the row's pages into a contiguous view and
+runs either the shared masked-softmax math (XLA reference, CPU fallback) or
+the occupancy-aware cached-attention kernel (ops/flash_decode.py) over the
+gathered view — the sanctioned "cached kernel gathers from pages" shape; a
+true ragged-prefill Pallas kernel (no gather materialisation) is future
+work (ROADMAP). On CPU the kernels run in interpret mode so tests exercise
+the same code paths.
 """
 from __future__ import annotations
 
@@ -162,6 +168,48 @@ def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
   v = v.reshape(B, maxp * page, *v.shape[3:])
   q_positions = (lengths.astype(jnp.int32) - 1)[:, None]  # [B, 1]
   return gqa_attention(q, k, v, q_positions, kv_valid_len=lengths.astype(jnp.int32),
+                       scale=scale, softcap=softcap)
+
+
+def paged_prefill_attention(
+  q: jnp.ndarray,  # [B, T, Hq, D] — a prefill segment's queries (B == 1)
+  k_pages: jnp.ndarray,  # [P, page, Hkv, D] — one layer's K arena
+  v_pages: jnp.ndarray,  # [P, page, Hkv, D]
+  page_table: jnp.ndarray,  # [B, max_pages] int32 physical page ids (0-padded)
+  q_positions: jnp.ndarray,  # [B, T] int32 absolute positions of the queries
+  kv_valid_len: jnp.ndarray,  # [B] int32 — occupied positions incl. this segment
+  softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
+  scale: float | None = None,  # static score scale; None = D**-0.5
+  use_kernel: bool = False,
+  interpret: bool | None = None,
+) -> jnp.ndarray:
+  """Causal GQA attention of a prefill segment over its row's occupied pages.
+
+  Query t (absolute position q_positions[:, t]) attends every occupied
+  position <= it, reached through `page_table`. Both paths first gather the
+  table's pages into a contiguous [B, max_pages*page] view — the copy the
+  issue blesses ("the cached-attention kernel gathers from pages"); padded
+  table slots gather the scratch page, whose positions sit at or past
+  kv_valid_len and mask out. `use_kernel` (static) runs the occupancy-aware
+  flash_cached kernel over the gathered view (its DMA stops at the occupied
+  prefix, and in-kernel scores never materialise [T, S]); the default XLA
+  path is the correctness reference and the off-TPU fallback.
+  Returns [B, T, Hq, D].
+  """
+  from xotorch_tpu.ops.attention import gqa_attention
+  B, T = q.shape[0], q.shape[1]
+  maxp, page = page_table.shape[1], k_pages.shape[1]
+  k = jnp.take(k_pages, page_table, axis=0)  # [B, maxp, page, Hkv, D]
+  v = jnp.take(v_pages, page_table, axis=0)
+  k = k.reshape(B, maxp * page, *k.shape[3:])
+  v = v.reshape(B, maxp * page, *v.shape[3:])
+  if use_kernel:
+    from xotorch_tpu.ops.flash_decode import flash_cached_attention
+    q_start = kv_valid_len.astype(jnp.int32) - T
+    return flash_cached_attention(q, k, v, q_start, softcap=softcap, scale=scale,
+                                  interpret=interpret)
+  return gqa_attention(q, k, v, q_positions.astype(jnp.int32),
+                       kv_valid_len=kv_valid_len.astype(jnp.int32),
                        scale=scale, softcap=softcap)
 
 
