@@ -982,6 +982,121 @@ def _run_prefill_interference(model_id: str, prefill_len: int, decode_tokens: in
   }
 
 
+def _run_kv_host(model_id: str, prefill_len: int, decode_tokens: int,
+                 progress_path: str) -> dict:
+  """Cold vs HBM-warm vs host-warm TTFT A/B (ISSUE 3 `kvhost`): the same
+  prompt served three ways — cold prefill, HBM prefix-cache hit, and a
+  host-tier restore after a forced OOM recovery (_free_device_memory
+  spill-then-drop). The host-warm number is the whole point of the tier:
+  strictly better than cold (the prefix streams back over PCIe instead of
+  re-prefilling) while strictly worse than an HBM hit (the H2D copy is not
+  free). All three greedy streams must be byte-identical — a tier that
+  changes tokens is corruption, and the inequality feeds the bench's
+  implausibility gate exactly like the fused/per-token cross-check."""
+  import asyncio
+
+  import numpy as np
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+
+  # TOKEN-level prompts, engine-direct: the synthetic models' dummy
+  # tokenizer maps every word to the same id, so word-varied Node prompts
+  # would all share one token stream and the warmup would silently warm the
+  # "cold" run (the pagedfill stage sidesteps the same trap by disabling
+  # the prefix cache — here the cache IS the measurand). Distinct modular
+  # patterns diverge at token 0, so warmups never seed a measured prefix.
+  def pattern(seed: int) -> np.ndarray:
+    return ((np.arange(prefill_len) * (seed * 2 + 3) + seed) % 200 + 3)[None, :].astype(np.int64)
+
+  async def run() -> dict:
+    engine = JAXShardInferenceEngine()
+    shard = Shard(model_id, 0, n_layers - 1, n_layers)
+
+    async def generate(rid: str, prompt: np.ndarray):
+      """One greedy request: TTFT is the prefill-to-first-sampled-token
+      wall time (infer_sample_tensor), then a few fused chunks for the
+      cross-checkable stream."""
+      t0 = time.monotonic()
+      tok, _ = await engine.infer_sample_tensor(rid, shard, prompt, temp=0.0)
+      ttft = time.monotonic() - t0
+      toks = [int(tok)]
+      for _ in range(max(1, decode_tokens // 16)):
+        out = await engine.generate_chunk(rid, shard, toks[-1], 16, temp=0.0)
+        toks.extend(int(t) for t in out)
+      await engine.clear_request(rid)
+      return round(ttft, 3), toks
+
+    # Compile warmups on a DISTINCT prefix: run it twice so BOTH the cold
+    # path and the warm path (prefix hit + suffix-only prefill — different
+    # executable shapes) are compiled before anything is measured.
+    await generate("kvhost-warmexe", pattern(1))
+    await generate("kvhost-warmexe2", pattern(1))
+    cold_ttft, cold_toks = await generate("kvhost-cold", pattern(0))
+    _record(progress_path, "kvhost:cold", ttft_s=cold_ttft)
+    hbm_ttft, hbm_toks = await generate("kvhost-hbm", pattern(0))
+    _record(progress_path, "kvhost:hbm", ttft_s=hbm_ttft)
+
+    # Forced OOM recovery: every HBM prefix entry spills to the host tier,
+    # then drops (spill-then-drop). jax.clear_caches() inside recovery also
+    # drops compiled executables — re-warm on a fresh distinct prefix so
+    # the host-warm TTFT measures the H2D restore, not recompilation.
+    engine._free_device_memory()
+    host_stats = engine.host_kv_stats() or {"bytes": 0, "entries": 0}
+    # jax.clear_caches() inside recovery dropped every compiled executable:
+    # re-warm on the WARMUP prefix — which is itself in the host tier now,
+    # so this run exercises the full restore machinery (scatter jit, warm
+    # suffix prefill, decode) and the measured run below pays only the
+    # actual H2D restore, not recompilation.
+    await generate("kvhost-rewarm", pattern(1))
+    hits0, fetch0 = engine._host_kv_hits, engine._host_fetch_bytes
+    host_ttft, host_toks = await generate("kvhost-host", pattern(0))
+    _record(progress_path, "kvhost:host", ttft_s=host_ttft,
+            host_entries=host_stats["entries"], host_hits=engine._host_kv_hits)
+
+    n_cmp = min(len(cold_toks), len(hbm_toks), len(host_toks), 32)
+    verified = bool(n_cmp > 0 and cold_toks[:n_cmp] == hbm_toks[:n_cmp] == host_toks[:n_cmp])
+    return {
+      "kvhost_prefill_len": prefill_len,
+      "kvhost_cold_ttft_s": cold_ttft,
+      "kvhost_hbm_ttft_s": hbm_ttft,
+      "kvhost_host_ttft_s": host_ttft,
+      # The acceptance shape: HBM-warm <= host-warm <= cold. Recorded, not
+      # gated — CPU-fallback runs are too noisy to fail the round on.
+      "kvhost_ordering_ok": bool(hbm_ttft <= host_ttft <= cold_ttft),
+      "kvhost_tokens_verified": verified,
+      "kvhost_host_entries_after_free": host_stats["entries"],
+      "kvhost_host_bytes_after_free": host_stats["bytes"],
+      # Measured-run deltas: exactly one host hit whose fetched bytes are
+      # the restored prefix entry — the e2e observability the /metrics
+      # counters expose in production.
+      "kvhost_host_hits": int(engine._host_kv_hits - hits0),
+      "kvhost_fetch_bytes": int(engine._host_fetch_bytes - fetch0),
+      "kvhost_spill_bytes": int(engine._host_spill_bytes),
+      "kvhost_oom_recoveries": int(engine._oom_count),
+    }
+
+  # The tier must be ON for this stage regardless of ambient env; prefix
+  # caching likewise (it is the thing being spilled/restored).
+  prev = {k: os.environ.get(k) for k in ("XOT_KV_HOST_BYTES", "XOT_PREFIX_CACHE")}
+  try:
+    if int(os.environ.get("XOT_KV_HOST_BYTES") or 0) <= 0:
+      os.environ["XOT_KV_HOST_BYTES"] = str(1 << 30)
+    if int(os.environ.get("XOT_PREFIX_CACHE") or 2) <= 0:
+      os.environ["XOT_PREFIX_CACHE"] = "2"
+    return asyncio.run(run())
+  finally:
+    for k, v in prev.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+
+
 def _find_real_model() -> "tuple[str, str] | None":
   """(model_id, dir) of a REAL downloaded checkpoint, if one exists on disk.
 
@@ -1186,6 +1301,24 @@ def child_main() -> None:
           "co-scheduled vs monolithic prefill token streams disagree"]))
     except Exception as e:
       res["pagedfill_error"] = repr(e)
+  # Host-tier KV offload stage (opt-in: BENCH_KVHOST=1 — the tpu_retry
+  # `kvhost` step): cold vs HBM-warm vs host-warm TTFT for one prompt, the
+  # host-warm run restored from a forced _free_device_memory spill.
+  if os.getenv("BENCH_KVHOST", "0") == "1":
+    try:
+      kh_prefill = int(os.getenv("BENCH_KVHOST_PREFILL", "2048"))
+      res.update(_run_kv_host(model_id, kh_prefill, min(decode_tokens, 64),
+                              progress_path))
+      # Same measurement-integrity contract as the fused/per-token and
+      # pagedfill cross-checks: a KV tier that changes greedy tokens is
+      # corrupting caches, and its timings are meaningless.
+      if res.get("kvhost_tokens_verified") is False:
+        res["implausible"] = True
+        res["diagnosis"] = "; ".join(filter(None, [
+          res.get("diagnosis"),
+          "cold vs HBM-warm vs host-warm token streams disagree"]))
+    except Exception as e:
+      res["kvhost_error"] = repr(e)
   # Speculative-decoding stage (opt-in: a repeat-heavy prompt through the
   # Node loop with XOT_SPECULATE on vs off, streams cross-checked).
   if os.getenv("BENCH_SPEC", "0") == "1":

@@ -7,13 +7,18 @@ import sys
 
 
 def main() -> int:
-  targets = sys.argv[1:] or ["xotorch_tpu", "tests", "bench.py", "__graft_entry__.py"]
+  args = sys.argv[1:]
+  # --check (CI gate): diff mode, nonzero exit when any file would change —
+  # the tree must already be formatted, nothing is rewritten.
+  check = "--check" in args
+  targets = [a for a in args if a != "--check"] or [
+    "xotorch_tpu", "tests", "bench.py", "__graft_entry__.py"]
   try:
     import yapf  # noqa: F401
   except ImportError:
     print("yapf is not installed; run `pip install yapf` (style: .style.yapf)")
     return 1
-  return subprocess.call([sys.executable, "-m", "yapf", "-ri", *targets])
+  return subprocess.call([sys.executable, "-m", "yapf", "-rd" if check else "-ri", *targets])
 
 
 if __name__ == "__main__":

@@ -67,6 +67,15 @@ STEPS: list[tuple[str, dict, str]] = [
   ("pagedfill", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "8",
                  "XOT_PAGED_KV": "1", "BENCH_PAGEDFILL": "1"},
    "pagedfill_ttft_s"),
+  # Host-tier KV offload A/B (ISSUE 3 `kvhost`): cold vs HBM-warm vs
+  # host-warm TTFT for one long prompt — the host-warm run restores the
+  # prefix from host RAM after a forced OOM recovery spilled it
+  # (XOT_KV_HOST_BYTES spill-then-drop), with all three greedy streams
+  # cross-checked into the implausibility gate. Host-warm must land
+  # strictly between HBM-warm and cold (kvhost_ordering_ok).
+  ("kvhost", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "0",
+              "XOT_PAGED_KV": "1", "BENCH_KVHOST": "1"},
+   "kvhost_host_ttft_s"),
   # Fused scan-prefill headline (VERDICT r3 #5): prefill_mfu_pct with the
   # whole segment loop in one executable, vs the per-segment path.
   ("scan16k", LONG, "prefill_mfu_pct"),

@@ -542,6 +542,20 @@ async def test_metrics_include_engine_serving_counters(monkeypatch):
     assert "xot_kv_commit_copy_bytes_total 0" in text, text.splitlines()[-12:]
     assert "xot_kv_pool_pages_in_use" in text
     assert "xot_kv_pool_free_pages" in text
+    # Host-tier counters are always exported; OOM recoveries start at zero.
+    assert "xot_oom_recoveries_total 0" in text
+    assert "xot_prefix_evictions_total 0" in text
+    assert "xot_kv_host_hits_total 0" in text
+    assert "xot_kv_spill_bytes_total 0" in text
+    assert "xot_kv_fetch_bytes_total 0" in text
+    # The occupancy gauges appear once a spill populates the tier: force the
+    # OOM-recovery path (spill-then-drop) and re-scrape.
+    engine._free_device_memory()
+    resp = await client.get("/metrics")
+    text = await resp.text()
+    assert "xot_kv_host_entries 1" in text, text.splitlines()[-8:]
+    assert "xot_kv_host_bytes" in text
+    assert "xot_prefix_evictions_total 1" in text
   finally:
     await client.close()
 

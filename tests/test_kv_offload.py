@@ -1,0 +1,329 @@
+"""Host-tier KV offload & prefetch (kv_offload.HostKVStore, XOT_KV_HOST_BYTES).
+
+Correctness bars:
+- store invariants: byte-budget LRU, longest-common-prefix match, atomic
+  replace, per-context invalidation;
+- SPILL-THEN-DROP through OOM recovery: after a forced _free_device_memory
+  the host tier is non-empty (proven by assertion, not eyeball), previously
+  warm prefixes restore from it BYTE-IDENTICALLY to a cold prefill — in
+  both the paged and contiguous layouts — and a touched lost request still
+  raises RequestStateLost (serveability is restored for NEW requests, never
+  by silently resurrecting dead ones);
+- degrade-safe restore: a restore that races pool pressure mid-prefetch
+  falls back to a cold prefill with no error (entry retained), and a torn
+  host entry is dropped and falls back cold — never a wrong token;
+- lifecycle: weight swaps invalidate the tier (stale KV must never serve),
+  and XOT_KV_HOST_BYTES=0 restores the old destroy-on-evict behavior.
+"""
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.engine import CacheExhausted, RequestStateLost
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.jax_engine.kv_offload import HostKVStore
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+  return make_hf_checkpoint(tmp_path_factory.mktemp("kvoff"), TINY_LLAMA_CFG, seed=3)
+
+
+def _full_shard():
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  return Shard("m", 0, n - 1, n)
+
+
+def _engine(model_dir):
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+def _env(monkeypatch, paged: bool, **extra):
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  monkeypatch.setenv("XOT_PREFIX_CACHE_MIN", "16")
+  monkeypatch.setenv("XOT_KV_HOST_BYTES", str(64 << 20))
+  monkeypatch.setenv("XOT_PAGED_KV", "1" if paged else "0")
+  monkeypatch.setenv("XOT_KV_PAGE", "16")
+  monkeypatch.setenv("XOT_KV_POOL_TOKENS", "512")
+  for k, v in extra.items():
+    monkeypatch.setenv(k, v)
+
+
+PROMPT_A = np.array([np.arange(44) % 250 + 1], dtype=np.int64)
+# Shares A's 44-token prefix, then diverges: the restore covers the common
+# full pages and only the suffix prefills.
+PROMPT_B = np.concatenate([PROMPT_A, np.array([[99, 98, 97, 96]])], axis=1)
+
+
+async def _generate(eng, rid, prompt, chunks=2, chunk_size=8):
+  shard = _full_shard()
+  tok, _ = await eng.infer_sample_tensor(rid, shard, prompt, temp=0.0)
+  toks = [int(tok)]
+  for _ in range(chunks):
+    out = await eng.generate_chunk(rid, shard, toks[-1], chunk_size, temp=0.0)
+    toks.extend(int(t) for t in out)
+  return toks
+
+
+# The cold PROMPT_B reference stream, computed once per module (greedy at
+# XOT_SEED=7 — byte-identical across paged/contiguous, which the paged
+# suite proves independently; every test here compares against it).
+_COLD = {}
+
+
+async def _cold_b(model_dir):
+  if "b" not in _COLD:
+    _COLD["b"] = await _generate(_engine(model_dir), "cold-ref", PROMPT_B)
+  return _COLD["b"]
+
+
+# ------------------------------------------------------------ store basics
+
+
+def test_host_store_budget_lru_match_invariants():
+  ctx = "ctx-a"
+  toks1 = np.arange(64, dtype=np.int64)
+  toks2 = np.arange(64, dtype=np.int64) + 100
+  data = lambda fill: {"k": np.full((2, 1, 32, 2, 4), fill, np.float32),
+                       "v": np.full((2, 1, 32, 2, 4), fill, np.float32)}
+  one = sum(a.nbytes for a in data(0).values()) + toks1.nbytes
+
+  store = HostKVStore(max_bytes=2 * one + 64)
+  assert store.put(ctx, toks1, data(1.0), 32) == one
+  assert store.put(ctx, toks2, data(2.0), 32) == one
+  assert len(store) == 2 and store.total_bytes == 2 * one
+
+  # Longest-common-prefix match, capped at limit; misses other contexts.
+  entry, common = store.match(ctx, np.arange(80, dtype=np.int64), limit=79)
+  assert entry is not None and common == 64 and entry.data["k"][0, 0, 0, 0, 0] == 1.0
+  assert store.match("ctx-b", np.arange(80, dtype=np.int64), 79) == (None, 0)
+  # Diverging tokens stop the match at the divergence point.
+  probe = np.arange(80, dtype=np.int64)
+  probe[10] = 999
+  _, common = store.match(ctx, probe, 79)
+  assert common == 10
+
+  # match refreshed toks1's LRU slot, so inserting a third entry over
+  # budget evicts toks2 (oldest), not toks1.
+  toks3 = np.arange(64, dtype=np.int64) + 200
+  assert store.put(ctx, toks3, data(3.0), 32) == one
+  assert len(store) == 2
+  assert store.match(ctx, toks2, 63) == (None, 0)
+  entry, _ = store.match(ctx, toks1, 63)
+  assert entry is not None
+
+  # Replace in place: same toks, refreshed data, no byte-count drift.
+  assert store.put(ctx, toks1, data(9.0), 32) == one
+  assert store.total_bytes == 2 * one
+  entry, _ = store.match(ctx, toks1, 63)
+  assert entry.data["k"][0, 0, 0, 0, 0] == 9.0
+
+  # An entry alone over the budget is rejected, never thrashes the arena.
+  small = HostKVStore(max_bytes=one - 1)
+  assert small.put(ctx, toks1, data(1.0), 32) == 0
+  assert len(small) == 0
+
+  # Per-context invalidation.
+  assert store.drop_ctx(ctx) == 2
+  assert len(store) == 0 and store.total_bytes == 0
+
+
+# ------------------------------------- OOM recovery: spill-then-drop, e2e
+
+
+async def test_oom_spill_restores_warm_prefix_paged(tiny_model_dir, monkeypatch):
+  """Paged mode: a forced _free_device_memory spills the warm prefix to the
+  host tier (non-empty tier proven by assertion); a later request sharing
+  the prefix restores it into fresh pool pages and streams byte-identically
+  to a cold prefill, with the fetch counter matching the restored entry and
+  the dead request still failing loudly."""
+  _env(monkeypatch, paged=False)
+  want_b = await _cold_b(tiny_model_dir)
+
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  ctx = eng._contexts[_full_shard()]
+  assert len(ctx.prefix_cache) == 1
+
+  eng._free_device_memory()
+  # Spill-then-drop: the HBM warm set is gone, the host tier holds it.
+  assert not ctx.prefix_cache and ctx.page_pool is None
+  assert eng._host_kv is not None and len(eng._host_kv) == 1
+  assert eng._host_spill_bytes > 0
+  assert eng._prefix_evictions >= 1
+  (entry, common) = eng._host_kv.match(ctx.shard, PROMPT_B.reshape(-1), 47)
+  assert common == 44 and entry.length == 32  # full 16-token pages only
+  entry_bytes = entry.nbytes
+
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert got_b == want_b, f"host-warm {got_b} != cold {want_b}"
+  assert eng._host_kv_hits == 1
+  assert eng._host_fetch_bytes == entry_bytes
+  assert eng._prefix_hits == 1 and eng._prefix_tokens_saved == 32
+  # The restore re-created a native HBM entry sharing pages with rb (rb's
+  # own completed prefill stored a second entry over the same head pages).
+  restored = next(e for _, e in ctx.prefix_cache.values()
+                  if isinstance(e, dict) and e.get("len") == 32)
+  assert ctx.states["rb"].pages[:2] == list(restored["pages"])
+  pool = ctx.page_pool
+  # restored entry + rb's table + rb's own prefix entry all hold the pages
+  assert all(pool.refcount(p) == 3 for p in restored["pages"])
+
+  # The OOM-lost request must still fail loudly — the host tier restores
+  # SERVEABILITY, it must never resurrect a dead request's state.
+  with pytest.raises(RequestStateLost):
+    await eng.generate_chunk("ra", _full_shard(), 1, 4, temp=0.0)
+
+
+async def test_oom_spill_restores_warm_prefix_contiguous(tiny_model_dir, monkeypatch):
+  """Contiguous (snapshot) layout: the same spill-then-drop and
+  byte-identical host-warm restore, with no page pool in play."""
+  _env(monkeypatch, paged=False)
+  want_b = await _cold_b(tiny_model_dir)
+
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  ctx = eng._contexts[_full_shard()]
+  assert len(ctx.prefix_cache) == 1
+  eng._free_device_memory()
+  assert not ctx.prefix_cache
+  assert eng._host_kv is not None and len(eng._host_kv) == 1
+
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert got_b == want_b, f"host-warm {got_b} != cold {want_b}"
+  assert eng._host_kv_hits == 1
+  assert eng._prefix_hits == 1 and eng._prefix_tokens_saved == 44
+
+
+async def test_cross_layout_restore_contiguous_spill_paged_engine(
+    tiny_model_dir, monkeypatch):
+  """The canonical host layout composes across cache layouts: a prefix
+  spilled by a CONTIGUOUS engine restores into a PAGED engine's pool pages
+  (same store, same bytes) and still streams byte-identically."""
+  _env(monkeypatch, paged=False)
+  want_b = await _cold_b(tiny_model_dir)
+
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  eng._free_device_memory()
+  assert len(eng._host_kv) == 1
+
+  # Flip the SAME engine to paged for the restore (env is read per call).
+  monkeypatch.setenv("XOT_PAGED_KV", "1")
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert got_b == want_b
+  assert eng._host_kv_hits == 1
+  ctx = eng._contexts[_full_shard()]
+  assert ctx.states["rb"].pages is not None  # truly restored as pages
+  assert eng._prefix_tokens_saved == 32  # whole pages under the paged layout
+
+
+async def test_cross_layout_restore_paged_spill_contiguous_engine(
+    tiny_model_dir, monkeypatch):
+  """Reverse cross-layout direction: a prefix spilled by a PAGED engine
+  covers whole pages only (32 of PROMPT_A's 44 tokens) while keeping the
+  full 44 prompt toks. Restored into a CONTIGUOUS engine it must be
+  truncated to the covered tokens — claiming the uncovered tail as cached
+  would serve zero KV as valid positions (silently wrong tokens)."""
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  eng._free_device_memory()
+  assert len(eng._host_kv) == 1
+  (entry, _) = eng._host_kv.match(_full_shard(), PROMPT_A.reshape(-1), 43)
+  assert entry.length == 32 and entry.toks.shape[0] == 44
+
+  monkeypatch.setenv("XOT_PAGED_KV", "0")
+  want_b = await _cold_b(tiny_model_dir)
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert got_b == want_b, f"host-warm {got_b} != cold {want_b}"
+  assert eng._host_kv_hits == 1
+  # Only the covered 32 tokens count as reused; the tail re-prefilled.
+  assert eng._prefix_tokens_saved == 32
+
+
+# ------------------------------------------------------- degrade-safe paths
+
+
+async def test_restore_racing_pool_pressure_falls_back_cold(tiny_model_dir, monkeypatch):
+  """A restore that cannot get pool pages (pressure from live requests)
+  must fall back to a cold prefill — same tokens, no error — and keep the
+  entry for a calmer moment."""
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  eng._free_device_memory()
+  assert len(eng._host_kv) == 1
+
+  want_b = await _cold_b(tiny_model_dir)
+
+  real_alloc = eng._pool_alloc
+  blown = {"n": 0}
+
+  def failing_alloc(ctx, pool, n):
+    if blown["n"] == 0:  # the promote's allocation only
+      blown["n"] += 1
+      raise CacheExhausted("pool exhausted (injected mid-prefetch)")
+    return real_alloc(ctx, pool, n)
+
+  monkeypatch.setattr(eng, "_pool_alloc", failing_alloc)
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert blown["n"] == 1, "the injected pressure must have hit the promote path"
+  assert got_b == want_b, f"cold fallback {got_b} != cold {want_b}"
+  assert eng._host_kv_hits == 0 and eng._prefix_hits == 0
+  assert len(eng._host_kv) == 1  # a capacity race never costs the entry
+
+
+async def test_torn_host_entry_falls_back_cold_and_drops(tiny_model_dir, monkeypatch):
+  """A torn/corrupt host entry (wrong leaf shape) is detected at restore
+  time: the entry is dropped, the request prefills cold, tokens stay
+  correct — never a wrong token, never a client-visible error."""
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  eng._free_device_memory()
+
+  # Tear the stored KV: truncate the token axis below the declared length.
+  ((key, entry),) = list(eng._host_kv._entries.items())
+  entry.data = {name: arr[:, :, :8] for name, arr in entry.data.items()}
+
+  want_b = await _cold_b(tiny_model_dir)
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert got_b == want_b
+  assert eng._host_kv_hits == 0
+  assert len(eng._host_kv) == 0, "a torn entry must never be offered again"
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+async def test_weight_change_invalidates_host_tier(tiny_model_dir, monkeypatch):
+  """_clear_prefix_cache (weight swap/train step) must drop the context's
+  host-tier entries too — stale KV under new weights is silent corruption."""
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  eng._free_device_memory()
+  assert len(eng._host_kv) == 1
+  ctx = eng._contexts[_full_shard()]
+  eng._clear_prefix_cache(ctx)
+  assert len(eng._host_kv) == 0
+
+
+async def test_zero_budget_disables_tier(tiny_model_dir, monkeypatch):
+  """XOT_KV_HOST_BYTES=0: evictions destroy entries exactly as before —
+  no store is ever allocated, no spill bytes counted."""
+  _env(monkeypatch, paged=True, XOT_KV_HOST_BYTES="0")
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  eng._free_device_memory()
+  assert eng._host_kv is None
+  assert eng._host_spill_bytes == 0
+  got = await _generate(eng, "rb", PROMPT_B)
+  assert eng._host_kv_hits == 0 and eng._prefix_hits == 0
+  assert got == await _cold_b(tiny_model_dir)

@@ -329,6 +329,12 @@ async def test_pool_pressure_evicts_prefix_entries_not_requests(tiny_model_dir, 
   pool = ctx.page_pool
   # A's entry was reclaimed; only B's own entry (over B's pages) survives.
   assert len(ctx.prefix_cache) == 1
+  # Spill-then-drop: the reclaim demoted A's warm prefix to the host tier
+  # (kv_offload) instead of destroying it, and counted the eviction.
+  assert eng._prefix_evictions >= 1
+  assert eng._host_kv is not None and eng._host_spill_bytes > 0
+  host_entry, common = eng._host_kv.match(ctx.shard, prompt_a.reshape(-1), 43)
+  assert host_entry is not None and common == 43
   (_, (_, entry)), = ctx.prefix_cache.items()
   assert set(entry["pages"]) <= set(ctx.states["rb"].pages)
   await eng.clear_request("rb")

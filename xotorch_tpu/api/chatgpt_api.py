@@ -213,6 +213,16 @@ class ChatGPTAPI:
       ("_commit_copy_bytes", "xot_kv_commit_copy_bytes_total",
        "Device bytes copied committing contiguous prefill KV into pool pages "
        "(zero under paged-native prefill, XOT_PAGED_PREFILL)"),
+      ("_oom_count", "xot_oom_recoveries_total",
+       "HBM-exhaustion recoveries (engine._free_device_memory invocations)"),
+      ("_prefix_evictions", "xot_prefix_evictions_total",
+       "Prefix-cache entries evicted (LRU bound, pool pressure, OOM recovery)"),
+      ("_host_kv_hits", "xot_kv_host_hits_total",
+       "Prefix lookups served from the host KV tier (XOT_KV_HOST_BYTES)"),
+      ("_host_spill_bytes", "xot_kv_spill_bytes_total",
+       "Bytes spilled D2H into the host KV tier by prefix evictions"),
+      ("_host_fetch_bytes", "xot_kv_fetch_bytes_total",
+       "Bytes restored H2D from the host KV tier on warm-prefix admission"),
     ):
       val = getattr(eng, attr, None)
       if val is not None:
@@ -226,6 +236,16 @@ class ChatGPTAPI:
         ("free_pages", "xot_kv_pool_free_pages", "KV pool pages on the free list"),
       ):
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {stats[key]}\n")
+    # Host-tier KV occupancy gauges (XOT_KV_HOST_BYTES; absent until a
+    # prefix eviction first touches the tier).
+    host_fn = getattr(eng, "host_kv_stats", None)
+    host = host_fn() if host_fn is not None else None
+    if host is not None:
+      for key, name, help_text in (
+        ("bytes", "xot_kv_host_bytes", "Host-RAM bytes held by spilled prefix KV"),
+        ("entries", "xot_kv_host_entries", "Prefix entries resident in the host KV tier"),
+      ):
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {host[key]}\n")
     if extra:
       body = body + "".join(extra).encode()
     # aiohttp's content_type kwarg rejects parameters; set the full

@@ -332,9 +332,20 @@ class JAXShardInferenceEngine(InferenceEngine):
     # this at ZERO end to end — the tests' acceptance bar.
     self._commit_copy_bytes = 0
     # Prefix-cache observability (tests + /metrics): hits and tokens whose
-    # prefill was skipped entirely.
+    # prefill was skipped entirely, plus entries evicted (LRU bound, pool
+    # pressure, OOM recovery — the events the host tier exists to absorb).
     self._prefix_hits = 0
     self._prefix_tokens_saved = 0
+    self._prefix_evictions = 0
+    # Host-tier KV offload (kv_offload.HostKVStore, XOT_KV_HOST_BYTES):
+    # evicted prefix entries spill D2H instead of being destroyed, and a
+    # prefix lookup that misses HBM but hits the host tier streams the KV
+    # back into fresh pool pages before prefilling only the suffix. Lazy —
+    # engines that never evict a prefix never allocate the store.
+    self._host_kv = None
+    self._host_kv_hits = 0
+    self._host_spill_bytes = 0
+    self._host_fetch_bytes = 0
     # Speculative-decode observability: drafted vs model-confirmed tokens.
     self._spec_proposed = 0
     self._spec_accepted = 0
@@ -551,7 +562,6 @@ class JAXShardInferenceEngine(InferenceEngine):
       return await asyncio.get_running_loop().run_in_executor(self.executor, fn, *args)
     except Exception as e:
       if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
-        self._oom_count += 1
         try:
           # Runs ON the event loop, no awaits: cooperative scheduling makes
           # the dict mutations atomic w.r.t. every other coroutine, and the
@@ -570,8 +580,20 @@ class JAXShardInferenceEngine(InferenceEngine):
     snapshot, every resident request state, and all but the active model
     context. Cleared requests are remembered (bounded) so their next touch
     fails loudly with RequestStateLost instead of silently restarting from
-    an empty cache."""
-    n_snap = n_state = n_ctx = 0
+    an empty cache.
+
+    SPILL-THEN-DROP: before a prefix entry is destroyed its KV is copied
+    D2H into the host tier (kv_offload.HostKVStore), so recovery frees the
+    same HBM as before but the warm set survives — the next request sharing
+    a spilled prefix restores it into fresh pool pages instead of paying a
+    cold 16 k prefill. Best-effort per entry: the device is mid-OOM, so a
+    spill whose own gather fails is simply skipped (recovery must free
+    memory above all else)."""
+    # Counted HERE (not at _run's catch site) so forced/direct invocations
+    # — bench's kvhost stage, tests — are visible in
+    # xot_oom_recoveries_total exactly as the metric's help text promises.
+    self._oom_count += 1
+    n_snap = n_state = n_ctx = n_spill = 0
     # In-flight speculative chunks hold device token arrays and reference
     # the states being dropped — release them too (their requests are lost
     # to OOM anyway, and a stale record must never resolve against a
@@ -580,7 +602,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._ring_spec.clear()
     for ctx in self._contexts.values():
       ctx.batch_spec = None
+      for _, (toks, entry) in ctx.prefix_cache.items():
+        if self._spill_prefix_entry(ctx, toks, entry):
+          n_spill += 1
       n_snap += len(ctx.prefix_cache)
+      self._prefix_evictions += len(ctx.prefix_cache)
       ctx.prefix_cache.clear()
       for rid in ctx.states:
         self._states_lost_to_oom[rid] = None
@@ -597,7 +623,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       n_ctx += 1
     import jax
     jax.clear_caches()  # drop compiled executables' scratch allocations too
-    return f"{n_snap} prefix snapshots, {n_state} request states, {n_ctx} model contexts"
+    return (f"{n_snap} prefix snapshots ({n_spill} spilled to host tier), "
+            f"{n_state} request states, {n_ctx} model contexts")
 
   # ------------------------------------------------------------- public API
 
@@ -920,8 +947,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     batcher = ctx.batcher
     paged_native = self._paged_prefill_ok(ctx, request_id, input_data, sampling)
     is_fresh = request_id not in ctx.states
-    full_prompt, consumed = await self._run(
-      self._prefill_begin_sync, ctx, request_id, input_data, paged_native)
+    # The prologue rides the prefill lane too: prefix reuse may restore a
+    # spilled prefix from the HOST tier (H2D stream into fresh pool pages,
+    # _host_promote) — admitted as one bounded drain-cycle unit, decode
+    # dispatches first, so co-resident streams never stall on the copy.
+    full_prompt, consumed = await batcher.submit_prefill(
+      partial(self._prefill_begin_sync, ctx, request_id, input_data, paged_native))
     if consumed:
       input_data = input_data[:, consumed:]
     try:
@@ -1373,6 +1404,23 @@ class JAXShardInferenceEngine(InferenceEngine):
   def _prefix_cache_min(self) -> int:
     return int(os.getenv("XOT_PREFIX_CACHE_MIN", "32"))
 
+  @staticmethod
+  def _best_hbm_prefix(ctx: _ShardContext, toks: np.ndarray,
+                       limit: int) -> Tuple[Optional[int], int]:
+    """(entry key, common length) of the resident HBM prefix entry with the
+    longest common token prefix for `toks` — the single scan shared by
+    _prefix_reuse (pick the entry to seed from) and _host_promote (only
+    promote a host entry that beats every resident one). Matching rule
+    itself lives in kv_offload.common_prefix_len, shared with the host
+    tier's own match."""
+    from xotorch_tpu.inference.jax_engine.kv_offload import common_prefix_len
+    best_key, best_len = None, 0
+    for key, (ptoks, _) in ctx.prefix_cache.items():
+      common = common_prefix_len(ptoks, toks, limit)
+      if common > best_len:
+        best_key, best_len = key, common
+    return best_key, best_len
+
   def _prefix_reuse(self, ctx: _ShardContext, request_id: str, tokens_2d: np.ndarray,
                     paged_native: bool = False) -> int:
     """Seed a fresh request's cache from the stored snapshot with the
@@ -1381,19 +1429,18 @@ class JAXShardInferenceEngine(InferenceEngine):
     With `paged_native` (paged-native prefill will serve this request) a
     paged entry is reused with ZERO copies: the matched full pages are
     incref'd in place as the request's page-table head."""
-    if self._prefix_cache_max() <= 0 or not ctx.prefix_cache:
+    if self._prefix_cache_max() <= 0:
       return 0
     toks = np.asarray(tokens_2d).reshape(-1).astype(np.int64)
+    # Host-tier consult: a prefix that was spilled (pool pressure, OOM
+    # recovery) restores into the HBM cache here — after which the scan
+    # below serves it exactly like a native warm hit (same incref/seed
+    # paths, same accounting).
+    self._host_promote(ctx, toks)
+    if not ctx.prefix_cache:
+      return 0
     limit = toks.shape[0] - 1  # at least one token must still be forwarded
-    best_key, best_len = None, 0
-    for key, (ptoks, _) in ctx.prefix_cache.items():
-      n = min(ptoks.shape[0], limit)
-      if n <= best_len:
-        continue
-      neq = np.nonzero(ptoks[:n] != toks[:n])[0]
-      common = int(neq[0]) if neq.size else n
-      if common > best_len:
-        best_key, best_len = key, common
+    best_key, best_len = self._best_hbm_prefix(ctx, toks, limit)
     if best_key is None or best_len < self._prefix_cache_min():
       return 0
     import jax
@@ -1519,9 +1566,197 @@ class JAXShardInferenceEngine(InferenceEngine):
 
       ctx.prefix_cache[key] = (toks, {name: snap(buf) for name, buf in state.cache.items()})
     while len(ctx.prefix_cache) > self._prefix_cache_max():
-      _, (_, evicted) = ctx.prefix_cache.popitem(last=False)
+      _, (etoks, evicted) = ctx.prefix_cache.popitem(last=False)
+      # LRU overflow is an eviction like any other: spill the entry D2H so
+      # the warm set outlives the HBM bound, THEN release the device copy.
+      self._spill_prefix_entry(ctx, etoks, evicted)
+      self._prefix_evictions += 1
       if ctx.page_pool is not None and isinstance(evicted, dict) and "pages" in evicted:
         ctx.page_pool.decref(evicted["pages"])
+
+  # ------------------------------------------------- host-tier KV offload
+  #
+  # A second KV tier under the HBM prefix cache (kv_offload.HostKVStore,
+  # bounded by XOT_KV_HOST_BYTES, LRU by prefix key). Every prefix-entry
+  # eviction — LRU overflow in _prefix_store, pool-pressure reclaim in
+  # _pool_alloc, OOM recovery in _free_device_memory — spills the entry's
+  # KV D2H before the device copy is released (spill-then-drop), and
+  # _prefix_reuse consults the tier whenever the HBM cache misses (or
+  # matches shorter): a host hit allocates fresh pool pages, streams the KV
+  # back H2D, and re-creates the HBM entry IN PLACE, so the request then
+  # takes the exact native warm path (incref'd shared pages / snapshot
+  # seed) and prefills only its suffix. Entries live in one canonical
+  # contiguous layout, so spills and restores compose across both cache
+  # layouts and across page-size changes. Degrade-safe by construction:
+  # any validation or capacity failure during restore falls back to a cold
+  # prefill — never a wrong token, never a client-visible error.
+
+  def _host_kv_max_bytes(self) -> int:
+    """XOT_KV_HOST_BYTES: host-RAM budget for spilled prefix KV (0
+    disables the tier). Default 256 MiB — enough for tens of long warm
+    prefixes of a 1B-class model, noise next to the host RAM that backs a
+    TPU VM."""
+    try:
+      return int(os.getenv("XOT_KV_HOST_BYTES", str(256 << 20)))
+    except ValueError:
+      return 0
+
+  def _host_kv_store(self):
+    """The engine-wide host tier, or None when disabled. One store for all
+    contexts (entries are namespaced by Shard), sized once at first use."""
+    max_bytes = self._host_kv_max_bytes()
+    if max_bytes <= 0:
+      return None
+    if self._host_kv is None:
+      from xotorch_tpu.inference.jax_engine.kv_offload import HostKVStore
+      self._host_kv = HostKVStore(max_bytes)
+    return self._host_kv
+
+  def host_kv_stats(self) -> Optional[Dict[str, int]]:
+    """Occupancy of the host tier for /metrics gauges, or None while no
+    store exists (disabled, or nothing ever spilled)."""
+    store = self._host_kv
+    if store is None:
+      return None
+    return {"bytes": store.total_bytes, "entries": len(store)}
+
+  def _cache_leaf_names(self) -> set:
+    """Leaf names a restored snapshot must carry to seed the CURRENT cache
+    config (transformer.init_kv_cache): plain bf16/f32 K/V, or K/V + their
+    scale leaves under int8 KV. A host entry spilled under a different
+    config fails this check and is treated as a miss."""
+    names = {"k", "v"}
+    if self._kv_quant is not None:
+      names |= {"k_scale", "v_scale"}
+    return names
+
+  def _spill_prefix_entry(self, ctx: _ShardContext, toks, entry) -> bool:
+    """Copy one evicted prefix entry D2H into the host tier (best-effort:
+    spilling is pure copy-out — live requests sharing the entry's pages
+    keep their own refs and are never touched; a failed spill only means
+    the entry dies the way it always used to). Paged entries gather their
+    full pages into the canonical contiguous layout; snapshot entries copy
+    leaf-for-leaf."""
+    store = self._host_kv_store()
+    if store is None:
+      return False
+    try:
+      toks = np.asarray(toks).reshape(-1).astype(np.int64)
+      if isinstance(entry, dict) and "pages" in entry:
+        pool = ctx.page_pool
+        if pool is None:
+          return False
+        from xotorch_tpu.inference.jax_engine.paged_cache import gather_pages
+        g = gather_pages(pool.arena, np.asarray(entry["pages"], np.int32))
+        data = {name: np.asarray(buf) for name, buf in g.items()}
+        length = int(entry["len"])
+      else:
+        data = {name: np.asarray(buf) for name, buf in entry.items()}
+        length = int(data["k"].shape[2])
+      n = store.put(ctx.shard, toks, data, length)
+      if n > 0:
+        self._host_spill_bytes += n
+        if DEBUG >= 2:
+          print(f"prefix entry spilled to host tier: {length} tokens, {n} bytes")
+      return n > 0
+    except Exception as e:
+      # The spill path runs inside eviction and OOM recovery — it must
+      # never turn a cleanup into a failure.
+      if DEBUG >= 1:
+        print(f"host KV spill failed (entry dropped): {e!r}")
+      return False
+
+  def _host_promote(self, ctx: _ShardContext, toks: np.ndarray) -> None:
+    """If the host tier holds a strictly longer usable prefix for `toks`
+    than any resident HBM entry, stream it back and re-create the HBM
+    entry: fresh pool pages + H2D scatter under XOT_PAGED_KV (the entry
+    then shares pages with the request exactly like a native hit), or a
+    device_put snapshot on the contiguous path. Runs on the engine
+    executor; under co-scheduling the caller rides the _DecodeBatcher
+    prefill lane, so co-resident decode dispatches first and never stalls
+    on the copy. Every failure mode degrades to a cold prefill."""
+    store = self._host_kv_store()
+    if store is None or len(store) == 0:
+      return
+    limit = toks.shape[0] - 1
+    _, hbm_best = self._best_hbm_prefix(ctx, toks, limit)
+    entry, common = store.match(ctx.shard, toks, limit)
+    if entry is None:
+      return
+    usable = min(common, entry.length)
+    want_paged = (self._paged_on() and self._paged_ok(ctx)
+                  and set(entry.data) == {"k", "v"})
+    try:
+      if set(entry.data) != self._cache_leaf_names() and not want_paged:
+        # Spilled under an incompatible cache config (e.g. int8-KV scales
+        # missing/extra): unusable here, and keeping it would shadow
+        # fresher compatible entries.
+        store.drop(ctx.shard, entry.toks)
+        return
+      if want_paged:
+        pool = self._ensure_page_pool(ctx)
+        page = pool.page_size
+        if (usable // page) * page <= max(hbm_best, self._prefix_cache_min() - 1):
+          return  # whatever we restored, the scan below would not use it
+        n_full = entry.length // page
+        leaf = entry.data["k"]
+        if (n_full <= 0 or leaf.ndim != 5 or leaf.shape[2] < n_full * page
+            or leaf.shape[0] != pool.arena["k"].shape[0]
+            or leaf.shape[3:] != pool.arena["k"].shape[3:]):
+          store.drop(ctx.shard, entry.toks)  # torn or config-mismatched
+          return
+        from xotorch_tpu.inference.jax_engine.paged_cache import scatter_pages
+        ids = self._pool_alloc(ctx, pool, n_full)
+        try:
+          pool.arena = scatter_pages(pool.arena, entry.data, np.asarray(ids, np.int32))
+        except Exception:
+          pool.decref(ids)
+          raise
+        restored = (entry.toks, {"pages": ids, "len": n_full * page})
+      else:
+        if usable <= max(hbm_best, self._prefix_cache_min() - 1):
+          return
+        leaf = entry.data["k"]
+        if leaf.ndim != 5 or leaf.shape[2] < entry.length:
+          store.drop(ctx.shard, entry.toks)
+          return
+        import jax.numpy as jnp
+        # Truncate toks to the KV the entry actually COVERS: a paged spill
+        # keeps the full prompt toks but only whole pages of KV
+        # (entry.length < len(toks)), and a snapshot entry keyed on the
+        # longer toks would let _prefix_reuse mark the uncovered tail as
+        # cached — zero KV served as valid positions, silently wrong
+        # tokens. (The paged restore branch caps via its "len" field.)
+        restored = (np.ascontiguousarray(entry.toks[:entry.length]),
+                    {name: jnp.asarray(arr[:, :, :entry.length])
+                     for name, arr in entry.data.items()})
+    except CacheExhausted:
+      # Restore raced pool pressure (live requests hold every page): the
+      # entry stays in the host tier for a calmer moment; this request
+      # prefills cold.
+      return
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"host KV restore failed (entry dropped, cold prefill): {e!r}")
+      store.drop(ctx.shard, entry.toks)
+      return
+    key = hash(np.ascontiguousarray(restored[0]).tobytes())
+    old = ctx.prefix_cache.pop(key, None)
+    if old is not None and ctx.page_pool is not None \
+       and isinstance(old[1], dict) and "pages" in old[1]:
+      ctx.page_pool.decref(old[1]["pages"])
+    ctx.prefix_cache[key] = restored
+    while len(ctx.prefix_cache) > self._prefix_cache_max():
+      _, (etoks, evicted) = ctx.prefix_cache.popitem(last=False)
+      self._spill_prefix_entry(ctx, etoks, evicted)
+      self._prefix_evictions += 1
+      if ctx.page_pool is not None and isinstance(evicted, dict) and "pages" in evicted:
+        ctx.page_pool.decref(evicted["pages"])
+    self._host_kv_hits += 1
+    self._host_fetch_bytes += entry.nbytes
+    if DEBUG >= 2:
+      print(f"host KV tier hit: {entry.length}-token prefix restored "
+            f"({entry.nbytes} bytes H2D)")
 
   async def infer_prompt(
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
@@ -2404,14 +2639,18 @@ class JAXShardInferenceEngine(InferenceEngine):
     see 'pool exhausted' errors the contiguous path never produces. Evict
     oldest-first (decref) and retry; entries whose pages are still shared
     with live requests free nothing (ref > 1) and the loop keeps going.
-    Only when no entry is left to evict does the exhaustion surface."""
+    Only when no entry is left to evict does the exhaustion surface.
+    Evicted entries SPILL to the host tier first (spill-then-drop): pool
+    pressure demotes the warm set one level instead of destroying it."""
     while True:
       try:
         return pool.alloc(n)
       except CacheExhausted:
         evicted = False
         while ctx.prefix_cache and not evicted:
-          _, (_, entry) = ctx.prefix_cache.popitem(last=False)
+          _, (etoks, entry) = ctx.prefix_cache.popitem(last=False)
+          self._spill_prefix_entry(ctx, etoks, entry)
+          self._prefix_evictions += 1
           if isinstance(entry, dict) and "pages" in entry:
             pool.decref(entry["pages"])
             evicted = True
@@ -2638,12 +2877,18 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _clear_prefix_cache(self, ctx: _ShardContext) -> None:
     """Drop every prefix entry, returning paged entries' page references to
-    the pool (a bare .clear() would leak their refcounts)."""
+    the pool (a bare .clear() would leak their refcounts). Every caller
+    clears because the entries became INVALID (weight swap, adapter churn)
+    — so the host tier's entries for this context are dropped too, never
+    spilled: serving a stale prefix under new weights would be silently
+    wrong tokens, the one failure mode the tier must never have."""
     pool = ctx.page_pool
     for _, entry in ctx.prefix_cache.values():
       if pool is not None and isinstance(entry, dict) and "pages" in entry:
         pool.decref(entry["pages"])
     ctx.prefix_cache.clear()
+    if self._host_kv is not None:
+      self._host_kv.drop_ctx(ctx.shard)
 
   def _use_paged(self, ctx: _ShardContext, items: list) -> bool:
     """One qualification rule for routing a decode dispatch to the paged

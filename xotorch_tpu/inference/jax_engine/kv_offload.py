@@ -1,0 +1,166 @@
+"""Host-tier KV offload: spill warm prefix pages to host RAM instead of
+destroying them (XOT_KV_HOST_BYTES).
+
+The serving stack used to keep exactly ONE KV tier — HBM. Under pool
+pressure the prefix cache destroyed its entries (engine._pool_alloc's
+reclaim loop), and OOM recovery (engine._free_device_memory) dropped every
+warm prefix outright, so one burst of long prompts erased the whole warm
+set and every returning user paid a cold 16 k prefill again. PRESERVE
+(arXiv:2501.08192) shows prefetching KV back ahead of admission hides the
+transfer, and vTensor (arXiv:2407.15309) shows the enabler: decouple the
+cache's LOGICAL identity (the token prefix) from its PHYSICAL residence
+(which pages, which tier) — exactly the split the paged pool's page tables
+already provide.
+
+`HostKVStore` is that second tier: a bounded host-RAM arena, LRU by prefix
+key, holding evicted prefix entries as plain numpy. Entries are stored in
+ONE canonical layout — contiguous [L, 1, T, Hkv, D] per cache leaf — so a
+spill from either device layout (paged page-gather D2H or contiguous
+snapshot) restores into either (paged scatter H2D into fresh pool pages,
+or a contiguous snapshot device_put), independent of the page size in
+force at spill time. The store itself never touches the device: the engine
+does the D2H gather on spill and the H2D scatter on restore
+(engine._spill_prefix_entry / engine._host_promote), and the restore rides
+the _DecodeBatcher prefill lane so co-resident decode never stalls on the
+copy.
+
+Integrity over availability: entries are inserted atomically under the
+lock (a reader can never observe a torn entry), `match` only reports the
+verified common token prefix, and the engine validates leaf shapes/names
+against the live cache config before restoring — any mismatch drops the
+entry and falls back to a cold prefill, never a wrong token.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def common_prefix_len(stored: np.ndarray, probe: np.ndarray, limit: int) -> int:
+  """Length of the common token prefix of `stored` and `probe`, capped at
+  min(len(stored), limit). THE matching rule — the HBM prefix cache scan
+  (engine._best_hbm_prefix) and the host tier's `match` both call this, so
+  the two tiers can never drift on what counts as a hit."""
+  n = min(int(stored.shape[0]), int(limit))
+  if n <= 0:
+    return 0
+  neq = np.nonzero(stored[:n] != probe[:n])[0]
+  return int(neq[0]) if neq.size else n
+
+
+@dataclass
+class HostKVEntry:
+  """One spilled prefix: `toks` is the full prompt that stored it, `data`
+  the canonical [L, 1, T, ...] host copies of every cache leaf, `length`
+  the token count actually covered (paged spills cover full pages only, so
+  length <= toks.shape[0])."""
+  toks: np.ndarray
+  data: Dict[str, np.ndarray]
+  length: int
+  nbytes: int
+
+
+class HostKVStore:
+  """Bounded host-RAM tier under the HBM prefix cache.
+
+  Keys are (ctx_key, prefix_key) — ctx_key is the engine's Shard (one
+  namespace per (model, layer-range), surviving context eviction and
+  rebuild), prefix_key the same token hash the HBM prefix cache uses.
+  All methods are thread-safe: spills/restores run on the engine executor
+  while /metrics reads stats from the event loop, and OOM recovery runs on
+  the event loop with the executor idle."""
+
+  def __init__(self, max_bytes: int):
+    self.max_bytes = int(max_bytes)
+    self._entries: "OrderedDict[Tuple[Any, int], HostKVEntry]" = OrderedDict()
+    self._bytes = 0
+    self._lock = threading.Lock()
+
+  # ------------------------------------------------------------------ stats
+
+  @property
+  def total_bytes(self) -> int:
+    with self._lock:
+      return self._bytes
+
+  def __len__(self) -> int:
+    with self._lock:
+      return len(self._entries)
+
+  # ------------------------------------------------------------------ write
+
+  def put(self, ctx_key: Any, toks: np.ndarray, data: Dict[str, np.ndarray],
+          length: int) -> int:
+    """Insert (or refresh) an entry; LRU-evict until the arena fits the
+    budget. Returns the bytes newly stored (0 when the entry alone exceeds
+    the budget and is rejected — a host tier that thrashes on one giant
+    entry protects nothing)."""
+    toks = np.ascontiguousarray(np.asarray(toks).reshape(-1).astype(np.int64))
+    nbytes = int(sum(int(a.nbytes) for a in data.values()) + toks.nbytes)
+    if nbytes > self.max_bytes:
+      return 0
+    entry = HostKVEntry(toks=toks, data=dict(data), length=int(length), nbytes=nbytes)
+    key = (ctx_key, hash(toks.tobytes()))
+    with self._lock:
+      old = self._entries.pop(key, None)
+      if old is not None:
+        self._bytes -= old.nbytes
+      self._entries[key] = entry
+      self._bytes += nbytes
+      while self._bytes > self.max_bytes and len(self._entries) > 1:
+        _, evicted = self._entries.popitem(last=False)
+        self._bytes -= evicted.nbytes
+    return nbytes
+
+  # ------------------------------------------------------------------- read
+
+  def match(self, ctx_key: Any, toks: np.ndarray,
+            limit: int) -> Tuple[Optional[HostKVEntry], int]:
+    """Best entry for this context by longest common token prefix (capped
+    at `limit` — at least one token must remain to forward, same rule as
+    the HBM scan). Refreshes the winner's LRU slot. Returns (entry, common
+    length) or (None, 0)."""
+    toks = np.asarray(toks).reshape(-1).astype(np.int64)
+    with self._lock:
+      best_key, best, best_len = None, None, 0
+      for key, entry in self._entries.items():
+        if key[0] != ctx_key:
+          continue
+        common = common_prefix_len(entry.toks, toks, limit)
+        if common > best_len:
+          best_key, best, best_len = key, entry, common
+      if best_key is not None:
+        self._entries.move_to_end(best_key)
+      return best, best_len
+
+  # ------------------------------------------------------------- invalidate
+
+  def drop(self, ctx_key: Any, toks: np.ndarray) -> None:
+    """Remove one entry (torn/mismatched data discovered at restore time —
+    it must never be offered again)."""
+    key = (ctx_key, hash(np.ascontiguousarray(
+      np.asarray(toks).reshape(-1).astype(np.int64)).tobytes()))
+    with self._lock:
+      entry = self._entries.pop(key, None)
+      if entry is not None:
+        self._bytes -= entry.nbytes
+
+  def drop_ctx(self, ctx_key: Any) -> int:
+    """Invalidate every entry of one context — weight swaps (checkpoint
+    load, train step) make spilled KV semantically stale; serving it would
+    be silently wrong tokens, the one failure mode this tier must never
+    have. Returns entries dropped."""
+    with self._lock:
+      dead = [k for k in self._entries if k[0] == ctx_key]
+      for k in dead:
+        self._bytes -= self._entries.pop(k).nbytes
+      return len(dead)
+
+  def clear(self) -> None:
+    with self._lock:
+      self._entries.clear()
+      self._bytes = 0

@@ -200,3 +200,38 @@ def gather_pages(arena: Dict[str, Any], page_ids) -> Dict[str, Any]:
   (draft verification, per-token segment forwards)."""
   import jax.numpy as jnp
   return _gather_jit()(arena, jnp.asarray(page_ids, jnp.int32))
+
+
+def _scatter_jit():
+  fn = _JITS.get("scatter")
+  if fn is None:
+    import jax
+
+    def scatter(arena, pages, page_ids):
+      out = {}
+      for name, buf in arena.items():
+        out[name] = buf.at[:, page_ids].set(pages[name].astype(buf.dtype))
+      return out
+
+    fn = _JITS["scatter"] = jax.jit(scatter, donate_argnames=("arena",))
+  return fn
+
+
+def scatter_pages(arena: Dict[str, Any], host_kv: Dict[str, np.ndarray],
+                  page_ids) -> Dict[str, Any]:
+  """Restore host-tier KV (kv_offload canonical layout: [L, 1, n*page, Hkv,
+  D] numpy per leaf) into the arena at freshly-allocated `page_ids` — the
+  H2D inverse of the spill's gather. The reshape to page granularity is
+  host-side (free: dim 1 is contiguous); the device sees one async
+  device_put + scatter, so the copy overlaps with whatever the executor
+  dispatches next. Returns the updated arena (input donated)."""
+  import jax.numpy as jnp
+  n = int(np.asarray(page_ids).shape[0])
+  if n == 0:
+    return arena
+  page = arena["k"].shape[2]
+  paged = {}
+  for name, arr in host_kv.items():
+    a = np.asarray(arr)[:, 0, :n * page]  # [L, n*page, Hkv, D]
+    paged[name] = jnp.asarray(a.reshape(a.shape[0], n, page, *a.shape[2:]))
+  return _scatter_jit()(arena, paged, jnp.asarray(page_ids, jnp.int32))
