@@ -41,6 +41,9 @@ import pytest
 
 def pytest_configure(config):
   config.addinivalue_line("markers", "asyncio: run the test inside a fresh asyncio event loop")
+  config.addinivalue_line(
+    "markers", "faults: fault-injection suite (runs as a dedicated CI step; "
+               "knobs are monkeypatch-scoped so the injector never leaks into the plain run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -3,6 +3,7 @@
 Parity intent: SURVEY §7.2.6 gate — streaming + JSON responses through the
 actual aiohttp app (aiohttp test utils), not mocked routes.
 """
+import asyncio
 import json
 
 import numpy as np
@@ -557,6 +558,59 @@ async def test_metrics_include_engine_serving_counters(monkeypatch):
     assert "xot_kv_host_bytes" in text
     assert "xot_prefix_evictions_total 1" in text
   finally:
+    await client.close()
+
+
+async def test_metrics_export_survivability_counters(monkeypatch):
+  """/metrics exports the five ring-survivability counters, and the ones an
+  injected fault exercises (hop retries, dedup drops) actually move."""
+  from xotorch_tpu.networking import faults
+  from xotorch_tpu.networking.inprocess import InProcessPeerHandle
+
+  monkeypatch.setenv("XOT_HOP_RETRIES", "2")
+  monkeypatch.setenv("XOT_HOP_BACKOFF_S", "0.01")
+  retries_before = faults.COUNTERS["hop_retries"]
+  a = await _make_node("sv-a", DummyInferenceEngine())
+  b = await _make_node("sv-b", DummyInferenceEngine())
+  for node in (a, b):
+    for other in (a, b):
+      node.topology.update_node(other.id, _caps())
+  a.peers = [InProcessPeerHandle(b)]
+  b.peers = [InProcessPeerHandle(a)]
+  # sv-b owns partition 0 and feeds hidden states to the sampler sv-a: a
+  # lost ack on a SendTensor TO sv-a forces a retried delivery that sv-a's
+  # dedup (whose registry /metrics serves) must drop.
+  faults.install(faults.FaultInjector([
+    {"rpc": "SendTensor", "peer": "sv-a", "nth": 2, "action": "lost_ack"},
+  ]))
+  api = ChatGPTAPI(a, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+  from aiohttp.test_utils import TestClient, TestServer
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "messages": [{"role": "user", "content": "hello"}],
+    })
+    assert resp.status == 200
+    # The lost-ack RETRY runs concurrently with the continuing generation
+    # (its first delivery was processed), so the redelivery — and the dedup
+    # drop it triggers — can land after the response; poll briefly.
+    import time as _time
+    deadline = _time.monotonic() + 5
+    while (int(a.metrics.dedup_drops_total._value.get()) < 1
+           and _time.monotonic() < deadline):
+      await asyncio.sleep(0.05)
+    text = await (await client.get("/metrics")).text()
+    for name in ("xot_hop_retries_total", "xot_watchdog_aborts_total",
+                 "xot_peer_evictions_total", "xot_request_restarts_total",
+                 "xot_dedup_drops_total", "xot_health_check_failures_total"):
+      assert name in text, f"{name} missing from /metrics"
+    assert faults.COUNTERS["hop_retries"] > retries_before
+    dedup_line = next(l for l in text.splitlines()
+                      if l.startswith("xot_dedup_drops_total{"))
+    assert float(dedup_line.rsplit(" ", 1)[1]) >= 1.0, dedup_line
+  finally:
+    faults.install(None)
     await client.close()
 
 

@@ -106,6 +106,52 @@ async def test_grpc_server_and_peer_handle_roundtrip():
     await server.stop()
 
 
+async def test_grpc_connect_recreates_defunct_channel():
+  """connect() on a SHUTDOWN channel must recreate it instead of waiting
+  the full 10 s on a channel that can never become ready again."""
+  import time
+
+  from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
+  from xotorch_tpu.networking.grpc.server import GRPCServer
+  from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES
+
+  node = _mock_node()
+  port = find_available_port()
+  server = GRPCServer(node, "localhost", port)
+  await server.start()
+  try:
+    peer = GRPCPeerHandle("peer1", f"localhost:{port}", "test", UNKNOWN_DEVICE_CAPABILITIES)
+    await peer.connect()
+    defunct = peer.channel
+    await defunct.close()  # channel is now SHUTDOWN forever
+    t0 = time.monotonic()
+    await peer.connect()
+    assert time.monotonic() - t0 < 5, "waited on a defunct channel"
+    assert peer.channel is not defunct
+    assert await peer.health_check()
+    await peer.disconnect()
+  finally:
+    await server.stop()
+
+
+async def test_drain_graceful_closes_cancels_stuck_drains():
+  """The pending-cancel branch: a drain that outlives the shutdown grace is
+  cancelled (and awaited) rather than destroyed mid-flight."""
+  from xotorch_tpu.networking.grpc.peer_handle import _GRACEFUL_CLOSES, drain_graceful_closes
+
+  async def stuck_drain():
+    await asyncio.sleep(60)
+
+  task = asyncio.get_running_loop().create_task(stuck_drain())
+  _GRACEFUL_CLOSES.add(task)
+  task.add_done_callback(_GRACEFUL_CLOSES.discard)
+  await drain_graceful_closes(timeout=0.05)
+  assert task.cancelled()
+  assert task not in _GRACEFUL_CLOSES
+  # Idempotent with nothing outstanding.
+  await drain_graceful_closes(timeout=0.05)
+
+
 async def test_grpc_health_check_fails_after_server_stop():
   from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
   from xotorch_tpu.networking.grpc.server import GRPCServer

@@ -293,6 +293,39 @@ async def test_hop_error_aborts_request_on_all_nodes():
     await _stop_ring(node_a, node_b)
 
 
+async def test_abort_request_still_notifies_surviving_peers():
+  """_abort_request's peer-notify path: one peer erroring mid-broadcast must
+  not stop the finish from reaching the others, and local cleanup + error
+  recording happen regardless."""
+  node = await _make_node("abrt", DummyInferenceEngine())
+
+  def _peer(peer_id, send_result):
+    handle = mock.MagicMock()
+    handle.id.return_value = peer_id
+    handle.send_result = send_result
+    handle.send_opaque_status = mock.AsyncMock(return_value=None)
+    return handle
+
+  bad = _peer("bad-peer", mock.AsyncMock(side_effect=RuntimeError("peer wire down")))
+  good = _peer("good-peer", mock.AsyncMock(return_value={"ok": True, "applied": True, "have": 2}))
+  node.peers = [bad, good]
+  node.outstanding_requests["r-abrt"] = "waiting"
+  node.buffered_token_output["r-abrt"] = ([1, 2], False)
+  finished = []
+  node.on_token.register("t").on_next(lambda rid, toks, fin: finished.append((list(toks), fin)))
+
+  await node._abort_request("r-abrt", "engine exploded")
+
+  bad.send_result.assert_awaited()
+  good.send_result.assert_awaited()  # the bad peer didn't short-circuit the fan-out
+  err_kwargs = good.send_result.await_args.kwargs
+  assert err_kwargs.get("error") == "engine exploded"
+  assert finished and finished[-1][1] is True  # local listeners saw the finish
+  assert node.request_errors["r-abrt"] == "engine exploded"
+  assert node.outstanding_requests == {}
+  assert "r-abrt" not in node.buffered_token_output
+
+
 async def test_prompt_error_aborts_request():
   """An engine failure during prefill must finish the request (callbacks get
   is_finished) instead of leaving API clients hanging until timeout."""

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import uuid
 from pathlib import Path
@@ -653,21 +654,57 @@ class ChatGPTAPI:
                    "message": f"n must be an integer in [1, 8], got {n!r}"}},
         status=400,
       )
-    request_ids = [request_id] if n == 1 else [f"{request_id}#{i}" for i in range(n)]
-    for rid in request_ids:
-      self.token_queues[rid] = asyncio.Queue()
+    # One-shot transparent restart (XOT_REQUEST_RESTARTS, default 0 = off):
+    # a request killed by a transient ring failure (hop error, stall
+    # abort, evicted peer) is resubmitted ONCE under a fresh request id
+    # (cold prefill) on the healed ring instead of surfacing a 500.
+    # Non-streaming only: an SSE stream may have already emitted content
+    # chunks the restart would contradict. Deadline-respecting: no restart
+    # once XOT_REQUEST_DEADLINE_S of wall time is spent.
+    restart_budget = 0 if stream else max(0, int(os.getenv("XOT_REQUEST_RESTARTS", "0") or 0))
+    deadline_s = float(os.getenv("XOT_REQUEST_DEADLINE_S", "0") or 0)
+    t0 = time.monotonic()
+    base_request_id = request_id
+    all_rids: List[str] = []
     try:
-      for rid in request_ids:
-        await self.node.process_prompt(shard, prompt, rid, max_tokens=max_tokens, images=images,
-                                       temperature=temperature, top_p=top_p,
-                                       sampling=sampling or None)
-      if stream:
-        return await self._stream_response(request, request_ids, model, tokenizer, stop=stop,
-                                           logprobs=bool(want_logprobs))
-      return await self._full_response(request_ids, model, tokenizer, prompt, stop=stop,
-                                       logprobs=bool(want_logprobs))
+      attempt = 0
+      while True:
+        request_ids = [base_request_id] if n == 1 else [f"{base_request_id}#{i}" for i in range(n)]
+        all_rids.extend(request_ids)
+        for rid in request_ids:
+          self.token_queues[rid] = asyncio.Queue()
+        for rid in request_ids:
+          await self.node.process_prompt(shard, prompt, rid, max_tokens=max_tokens, images=images,
+                                         temperature=temperature, top_p=top_p,
+                                         sampling=sampling or None)
+        if stream:
+          return await self._stream_response(request, request_ids, model, tokenizer, stop=stop,
+                                             logprobs=bool(want_logprobs))
+        eos_ids = self._eos_ids(tokenizer)
+        try:
+          results = await asyncio.gather(*(
+            self._await_completion(rid, tokenizer, eos_ids, stop) for rid in request_ids
+          ))
+        except asyncio.TimeoutError:
+          return web.json_response({"detail": "Response timed out"}, status=408)
+        error = next((err for _, err in results if err), None)
+        if (error is not None and attempt < restart_budget and self._restartable(error)
+            and (deadline_s <= 0 or time.monotonic() - t0 < deadline_s)):
+          attempt += 1
+          self.node.metrics.request_restarts_total.inc()
+          if DEBUG >= 1:
+            print(f"restarting request {base_request_id} after: {error}")
+          base_request_id = str(uuid.uuid4())
+          try:
+            await self.node.heal_ring()
+          except Exception as e:
+            if DEBUG >= 1:
+              print(f"ring heal before restart failed: {e!r}")
+          continue
+        return self._build_full_response(request_ids, results, error, model, tokenizer, prompt,
+                                         eos_ids, stop=stop, logprobs=bool(want_logprobs))
     finally:
-      for rid in request_ids:
+      for rid in all_rids:
         self.token_queues.pop(rid, None)
         self.prev_token_lens.pop(rid, None)
         # A sub-request abandoned early (peer error, timeout, client gone,
@@ -678,6 +715,12 @@ class ChatGPTAPI:
           await self.node.cancel_request(rid)
         except Exception:
           pass
+
+  @staticmethod
+  def _restartable(error: str) -> bool:
+    # Client errors and blown deadlines are final; infra failures (hop
+    # errors, stalls, evicted peers) qualify for the one-shot restart.
+    return not error.startswith(("context_length_exceeded", "deadline_exceeded"))
 
   async def _tokenizer_for(self, model: str, shard):
     if model.startswith("synthetic") or model == "dummy":
@@ -879,16 +922,12 @@ class ChatGPTAPI:
       deadline = time.monotonic() + self.response_timeout
     return tokens, self.node.request_errors.pop(request_id, None)
 
-  async def _full_response(self, request_ids: List[str], model: str, tokenizer, prompt: str,
+  def _build_full_response(self, request_ids: List[str], results, error: Optional[str],
+                           model: str, tokenizer, prompt: str, eos_ids: set,
                            stop: Optional[List[str]] = None, logprobs: bool = False):
-    eos_ids = self._eos_ids(tokenizer)
-    try:
-      results = await asyncio.gather(*(
-        self._await_completion(rid, tokenizer, eos_ids, stop) for rid in request_ids
-      ))
-    except asyncio.TimeoutError:
-      return web.json_response({"detail": "Response timed out"}, status=408)
-    error = next((err for _, err in results if err), None)
+    """Build the JSON completion from collected sub-request results (the
+    gather lives in handle_post_chat_completions so its restart loop can
+    inspect the error before a response is committed)."""
     if error is not None:
       if error.startswith("context_length_exceeded"):
         # The prompt didn't fit the model's KV budget — 400, like OpenAI's

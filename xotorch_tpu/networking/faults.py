@@ -1,0 +1,221 @@
+"""Hop survivability policy + test-only fault injection for the transport layer.
+
+Two jobs, one module because they share the transient-failure vocabulary:
+
+1. **Retry policy** (`with_hop_retries`): bounded retries with exponential
+   backoff + jitter on *transient* hop failures (`XOT_HOP_RETRIES`, default
+   0 = today's fail-fast; `XOT_HOP_BACKOFF_S` base). Both peer handles
+   (gRPC and in-process) drive their sends through it. Retried deliveries
+   are made safe by receiver-side dedup: senders attach a per-hop sequence
+   id and `Node.note_hop_delivery` drops redeliveries, so a retry after a
+   lost ack never double-decodes a position.
+
+2. **Fault injector** (`FaultInjector`): a deterministic, test-only tap at
+   the peer-handle boundary that can drop/delay/error the Nth call of a
+   given RPC, lose an ack after delivery, silently sink a delivery (the
+   peer-died-after-acking case the stall watchdog exists for), or kill a
+   peer outright. Installed programmatically (`install`) or via the
+   `XOT_FAULT_SPEC` env var (JSON list of rules) so every survivability
+   behavior is provable in tier-1 CPU tests. With no injector installed and
+   no spec set, the hot-path cost is one `os.getenv` per hop.
+
+Process-wide survivability counters live here too (`COUNTERS`): peer
+handles have no Node back-reference, so per-node prometheus registries
+can't own them; `NodeMetrics.exposition` appends them as plain lines.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+from typing import Optional
+
+
+class TransientHopError(Exception):
+  """A hop failure of the class retries may heal: injected faults, dropped
+  frames, lost acks, a peer mid-restart. Real gRPC failures map onto the
+  same class via is_transient()."""
+
+
+# Process-wide survivability counters (see module docstring).
+COUNTERS = {"hop_retries": 0, "health_check_failures": 0}
+
+
+def bump(name: str, n: int = 1) -> None:
+  COUNTERS[name] = COUNTERS.get(name, 0) + n
+
+
+def hop_retries() -> int:
+  return max(0, int(os.getenv("XOT_HOP_RETRIES", "0") or 0))
+
+
+def hop_backoff_s() -> float:
+  return max(0.0, float(os.getenv("XOT_HOP_BACKOFF_S", "0.05") or 0))
+
+
+def is_transient(exc: BaseException) -> bool:
+  """Failures a retry may heal. Non-transient errors (codec bugs, engine
+  exceptions, cancellation) always propagate on the first attempt."""
+  if isinstance(exc, TransientHopError):
+    return True
+  if isinstance(exc, (ConnectionError, asyncio.TimeoutError)):
+    return True
+  try:
+    import grpc
+  except ImportError:
+    return False
+  if isinstance(exc, grpc.aio.AioRpcError):
+    # UNAVAILABLE: channel reconnect / peer restarting. DEADLINE_EXCEEDED:
+    # the ack never came back — the receiver may or may not have processed,
+    # which is exactly what receiver-side dedup makes safe to retry.
+    return exc.code() in (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+  return False
+
+
+async def with_hop_retries(attempt_fn, retriable: bool = True):
+  """Run one hop attempt, retrying transient failures up to XOT_HOP_RETRIES
+  times with exponential backoff + jitter. retriable=False (SendExample:
+  a training step is not idempotent) runs exactly one attempt. With
+  XOT_HOP_RETRIES unset this is a single attempt whose exceptions propagate
+  untouched — byte-identical to the fail-fast path."""
+  retries = hop_retries() if retriable else 0
+  base = hop_backoff_s()
+  attempt = 0
+  while True:
+    try:
+      return await attempt_fn()
+    except Exception as e:
+      if attempt >= retries or not is_transient(e):
+        raise
+      bump("hop_retries")
+      await asyncio.sleep(base * (2 ** attempt) * (0.5 + random.random()))
+      attempt += 1
+
+
+class _Rule:
+  """One injection rule: fire `action` on matching calls nth..nth+times-1.
+
+  Spec keys: rpc (None = any), peer (None = any), nth (1-based, default 1),
+  action, times (default 1), delay_s (delay action, default 0.05).
+  Actions: "drop"/"error" (fail before delivery), "delay" (sleep, then
+  deliver), "lost_ack" (deliver, then fail — exercises dedup), "sink"
+  (silently swallow the delivery but ack success — the silent-death case
+  the stall watchdog catches), "kill" (peer dead from this call on)."""
+
+  def __init__(self, spec: dict):
+    self.rpc: Optional[str] = spec.get("rpc")
+    self.peer: Optional[str] = spec.get("peer")
+    self.nth = int(spec.get("nth", 1))
+    self.action = str(spec["action"])
+    self.times = int(spec.get("times", 1))
+    self.delay_s = float(spec.get("delay_s", 0.05))
+    self.calls = 0
+
+  def matches(self, rpc: str, peer_id: Optional[str]) -> bool:
+    if self.rpc is not None and self.rpc != rpc:
+      return False
+    if self.peer is not None and peer_id is not None and self.peer != peer_id:
+      return False
+    return True
+
+  @property
+  def firing(self) -> bool:
+    return self.nth <= self.calls < self.nth + self.times
+
+
+class FaultInjector:
+  def __init__(self, rules):
+    self.rules = [_Rule(dict(r)) for r in rules]
+    self.dead_peers: set = set()
+
+  def kill_peer(self, peer_id: str) -> None:
+    self.dead_peers.add(peer_id)
+
+  def is_dead(self, peer_id: Optional[str]) -> bool:
+    return peer_id in self.dead_peers
+
+  async def apply(self, rpc: str, peer_id: Optional[str]) -> dict:
+    """Run matching rules for one call attempt. Raises TransientHopError for
+    pre-delivery failures (drop/error/kill/dead peer); sleeps for delays;
+    returns {"lost_ack": bool, "sink": bool} flags the caller applies after
+    delivering. A retried attempt re-consults the rules, so a one-shot rule
+    lets the retry through."""
+    if peer_id in self.dead_peers:
+      raise TransientHopError(f"peer {peer_id} is dead (injected kill)")
+    flags = {"lost_ack": False, "sink": False}
+    for rule in self.rules:
+      if not rule.matches(rpc, peer_id):
+        continue
+      rule.calls += 1
+      if not rule.firing:
+        continue
+      if rule.action == "kill":
+        self.dead_peers.add(rule.peer or peer_id)
+        raise TransientHopError(f"peer {peer_id} killed (injected, {rpc} call {rule.calls})")
+      if rule.action in ("drop", "error"):
+        raise TransientHopError(f"injected {rule.action} on {rpc} call {rule.calls} to {peer_id}")
+      if rule.action == "delay":
+        await asyncio.sleep(rule.delay_s)
+      elif rule.action == "lost_ack":
+        flags["lost_ack"] = True
+      elif rule.action == "sink":
+        flags["sink"] = True
+    return flags
+
+
+_installed: Optional[FaultInjector] = None
+_env_spec: Optional[str] = None
+_env_injector: Optional[FaultInjector] = None
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+  """Install (or with None, remove) a process-wide injector. Takes
+  precedence over XOT_FAULT_SPEC."""
+  global _installed
+  _installed = injector
+
+
+def active() -> Optional[FaultInjector]:
+  global _env_spec, _env_injector
+  if _installed is not None:
+    return _installed
+  spec = os.getenv("XOT_FAULT_SPEC")
+  if not spec:
+    # Drop the cache when the var is unset: re-setting the SAME spec later
+    # must yield a fresh injector, not one with spent rule counters and
+    # stale dead_peers.
+    _env_spec = _env_injector = None
+    return None
+  if spec != _env_spec:
+    rules = json.loads(spec)
+    _env_injector = FaultInjector(rules if isinstance(rules, list) else [rules])
+    _env_spec = spec
+  return _env_injector
+
+
+async def apply(rpc: str, peer_id: Optional[str]) -> dict:
+  inj = active()
+  if inj is None:
+    return {"lost_ack": False, "sink": False}
+  return await inj.apply(rpc, peer_id)
+
+
+def peer_killed(peer_id: str) -> bool:
+  inj = active()
+  return inj is not None and inj.is_dead(peer_id)
+
+
+def hop_seqs_enabled() -> bool:
+  """Attach per-hop sequence ids only when a redelivery is possible (retries
+  on, or an injector that could force one): the id is what makes retries
+  idempotent, and defaults-off stays byte-identical without it."""
+  return hop_retries() > 0 or active() is not None
+
+
+def hop_seq() -> Optional[str]:
+  """A fresh id per LOGICAL send (None when redelivery is impossible).
+  Retried attempts must reuse the value from the first attempt so the
+  receiver's note_hop_delivery can drop the redelivery."""
+  import uuid
+  return uuid.uuid4().hex if hop_seqs_enabled() else None

@@ -13,6 +13,7 @@ import grpc
 import numpy as np
 
 from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking import faults
 from xotorch_tpu.networking.codec import decode_message, encode_message
 from xotorch_tpu.networking.grpc.service import CHANNEL_OPTIONS, method_path
 from xotorch_tpu.networking.peer_handle import PeerHandle
@@ -67,6 +68,21 @@ class GRPCPeerHandle(PeerHandle):
     return self._device_capabilities
 
   async def connect(self) -> None:
+    if self.channel is not None:
+      # A channel in SHUTDOWN can never become ready again, and one parked
+      # in TRANSIENT_FAILURE (peer restarted; gRPC sitting out a reconnect
+      # backoff) can burn the whole 10 s below for nothing. Recreate the
+      # channel instead of waiting on a defunct one.
+      try:
+        state = self.channel.get_state()
+      except Exception:
+        state = grpc.ChannelConnectivity.SHUTDOWN
+      if state in (grpc.ChannelConnectivity.SHUTDOWN, grpc.ChannelConnectivity.TRANSIENT_FAILURE):
+        defunct, self.channel, self._stubs = self.channel, None, {}
+        try:
+          await defunct.close()
+        except Exception:
+          pass
     if self.channel is None:
       self.channel = grpc.aio.insecure_channel(
         self.address, options=CHANNEL_OPTIONS, compression=grpc.Compression.Gzip
@@ -83,11 +99,30 @@ class GRPCPeerHandle(PeerHandle):
       self._stubs[method] = self.channel.unary_unary(method_path(method))
     return self._stubs[method]
 
-  async def _call(self, method: str, fields: dict, tensors: Optional[dict] = None, timeout: float = 15.0):
-    await self._ensure_connected()
+  async def _call(self, method: str, fields: dict, tensors: Optional[dict] = None,
+                  timeout: float = 15.0, retriable: bool = True):
+    """One RPC, retried on transient failures per XOT_HOP_RETRIES (faults.
+    with_hop_retries). The payload is encoded ONCE so a retried delivery
+    carries the identical frame — including the hop_seq that lets the
+    receiver dedup it. retriable=False for non-idempotent RPCs
+    (SendExample runs a training step)."""
     payload = encode_message(fields, tensors)
-    response = await self._stub(method)(payload, timeout=timeout)
-    return decode_message(bytes(response))
+
+    async def attempt():
+      flags = await faults.apply(method, self._id)
+      await self._ensure_connected()
+      if flags["sink"]:
+        # Injected silent loss: the "peer died after acking" case — the
+        # sender sees success, nothing was delivered (watchdog territory).
+        return {"ok": True}, {}
+      response = await self._stub(method)(payload, timeout=timeout)
+      if flags["lost_ack"]:
+        # Delivered, but the ack "never came back": the retry must
+        # redeliver and the receiver's dedup must drop it.
+        raise faults.TransientHopError(f"injected lost ack on {method} to {self._id}")
+      return decode_message(bytes(response))
+
+    return await faults.with_hop_retries(attempt, retriable=retriable)
 
   async def is_connected(self) -> bool:
     return self.channel is not None and self.channel.get_state() == grpc.ChannelConnectivity.READY
@@ -114,7 +149,11 @@ class GRPCPeerHandle(PeerHandle):
 
   async def health_check(self) -> bool:
     try:
-      fields, _ = await asyncio.wait_for(self._call("HealthCheck", {}, timeout=5.0), timeout=5.0)
+      # ONE total 5 s bound, covering connect + RPC (the old shape stacked
+      # an outer wait_for(5.0) on an inner RPC timeout=5.0 — redundant, and
+      # neither alone capped a slow connect). The inner default is inert.
+      fields, _ = await asyncio.wait_for(
+        self._call("HealthCheck", {}, retriable=False), timeout=5.0)
       return bool(fields.get("is_healthy"))
     except Exception as e:
       if DEBUG >= 4:
@@ -124,19 +163,21 @@ class GRPCPeerHandle(PeerHandle):
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                         images: Optional[list] = None, temperature: Optional[float] = None,
-                        top_p: Optional[float] = None, ring_map: Optional[list] = None) -> None:
+                        top_p: Optional[float] = None, ring_map: Optional[list] = None,
+                        deadline: Optional[float] = None) -> None:
     tensors = {f"image_{i}": np.ascontiguousarray(img) for i, img in enumerate(images or [])}
     await self._call("SendPrompt", {
       "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
       "max_tokens": max_tokens, "n_images": len(tensors) or None, "temperature": temperature,
-      "top_p": top_p, "ring_map": ring_map,
+      "top_p": top_p, "ring_map": ring_map, "deadline": deadline, "hop_seq": faults.hop_seq(),
     }, tensors or None)
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
                         inference_state: Optional[dict] = None) -> None:
     await self._call(
       "SendTensor",
-      {"shard": shard.to_dict(), "request_id": request_id, "inference_state": inference_state},
+      {"shard": shard.to_dict(), "request_id": request_id, "inference_state": inference_state,
+       "hop_seq": faults.hop_seq()},
       {"tensor": tensor},
     )
 
@@ -147,7 +188,7 @@ class GRPCPeerHandle(PeerHandle):
       "SendExample",
       {"shard": shard.to_dict(), "train": train, "request_id": request_id, "ring_map": ring_map},
       {"example": example, "target": target, "length": length},
-      timeout=600.0,
+      timeout=600.0, retriable=False,  # a training step is not idempotent
     )
     loss = fields.get("loss")
     return (loss, tensors.get("grads")) if loss is not None else None

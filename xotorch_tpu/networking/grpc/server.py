@@ -56,23 +56,36 @@ class GRPCServer(Server):
 
   # ------------------------------------------------------------------ RPCs
 
+  def _is_duplicate_hop(self, fields: dict) -> bool:
+    """Receiver-side dedup for retried hop deliveries: because this server
+    acks and processes in the BACKGROUND, a sender can lose the ack after
+    the work was already queued — its retry must not double-decode the
+    position. The seq check runs before the spawn, so a redelivery is a
+    pure ack."""
+    seq = fields.get("hop_seq")
+    return seq is not None and not self.node.note_hop_delivery(fields.get("request_id"), seq)
+
   async def _rpc_send_prompt(self, request: bytes, context) -> bytes:
     # Ack immediately and process in the background: a ring hop's RPC must
     # not stay open for the remainder of the generation (the chain would
     # otherwise exceed any sane deadline and couple peer lifetimes).
     fields, tensors = decode_message(request)
+    if self._is_duplicate_hop(fields):
+      return encode_message({"ok": True, "dup": True})
     shard = Shard.from_dict(fields["shard"])
     images = [tensors[f"image_{i}"] for i in range(fields.get("n_images") or 0)] or None
     self._spawn(self.node.process_prompt(
       shard, fields["prompt"], fields.get("request_id"), traceparent=fields.get("traceparent"),
       max_tokens=fields.get("max_tokens"), images=images,
       temperature=fields.get("temperature"), top_p=fields.get("top_p"),
-      ring_map=fields.get("ring_map"),
+      ring_map=fields.get("ring_map"), deadline=fields.get("deadline"),
     ))
     return encode_message({"ok": True})
 
   async def _rpc_send_tensor(self, request: bytes, context) -> bytes:
     fields, tensors = decode_message(request)
+    if self._is_duplicate_hop(fields):
+      return encode_message({"ok": True, "dup": True})
     shard = Shard.from_dict(fields["shard"])
     self._spawn(self.node.process_tensor(
       shard, tensors["tensor"], fields.get("request_id"), fields.get("inference_state")

@@ -21,6 +21,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.networking import faults
 from xotorch_tpu.networking.peer_handle import PeerHandle
 from xotorch_tpu.utils.helpers import spawn_detached
 from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
@@ -61,28 +62,51 @@ class InProcessPeerHandle(PeerHandle):
     pass
 
   async def health_check(self) -> bool:
-    return True
+    # The transport can't fail in-process; only an injected kill can.
+    return not faults.peer_killed(self.node.id)
 
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                         images: Optional[list] = None, temperature: Optional[float] = None,
-                        top_p: Optional[float] = None, ring_map: Optional[list] = None) -> None:
+                        top_p: Optional[float] = None, ring_map: Optional[list] = None,
+                        deadline: Optional[float] = None) -> None:
     # Detached, like the gRPC server's ack-then-process: a hop must not hold
-    # the sender's coroutine chain for the rest of the generation.
-    self._spawn(self.node.process_prompt(
-      shard, prompt, request_id, traceparent=traceparent, max_tokens=max_tokens, images=images,
-      temperature=temperature, top_p=top_p, ring_map=ring_map,
-    ))
+    # the sender's coroutine chain for the rest of the generation. The hop
+    # seq + dedup + retry wrapper mirror the gRPC handle so injected faults
+    # exercise the identical survivability machinery in-process.
+    seq = faults.hop_seq()
+
+    async def attempt():
+      flags = await faults.apply("SendPrompt", self.node.id)
+      if not flags["sink"] and self.node.note_hop_delivery(request_id, seq):
+        self._spawn(self.node.process_prompt(
+          shard, prompt, request_id, traceparent=traceparent, max_tokens=max_tokens, images=images,
+          temperature=temperature, top_p=top_p, ring_map=ring_map, deadline=deadline,
+        ))
+      if flags["lost_ack"]:
+        raise faults.TransientHopError(f"injected lost ack on SendPrompt to {self.node.id}")
+
+    await faults.with_hop_retries(attempt)
 
   async def send_tensor(self, shard: Shard, tensor, request_id: Optional[str] = None,
                         inference_state: Optional[dict] = None) -> None:
     # `tensor` may be a jax device array — passed through untouched; the
     # receiving engine consumes it without a host copy.
-    self._spawn(self.node.process_tensor(shard, tensor, request_id, inference_state))
+    seq = faults.hop_seq()
+
+    async def attempt():
+      flags = await faults.apply("SendTensor", self.node.id)
+      if not flags["sink"] and self.node.note_hop_delivery(request_id, seq):
+        self._spawn(self.node.process_tensor(shard, tensor, request_id, inference_state))
+      if flags["lost_ack"]:
+        raise faults.TransientHopError(f"injected lost ack on SendTensor to {self.node.id}")
+
+    await faults.with_hop_retries(attempt)
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
                          train: bool, request_id: Optional[str] = None,
                          ring_map: Optional[list] = None) -> Optional[Tuple[float, np.ndarray]]:
+    await faults.apply("SendExample", self.node.id)  # killed peers must fail training hops too
     loss, grads = await self.node.process_example(shard, example, target, length, train, request_id,
                                                   ring_map=ring_map)
     return (loss, grads) if loss is not None else None
@@ -90,14 +114,25 @@ class InProcessPeerHandle(PeerHandle):
   async def send_result(self, request_id: str, result, is_finished: bool,
                         error: Optional[str] = None,
                         total_len: Optional[int] = None) -> Optional[dict]:
-    tokens = [int(t) for t in (result if not isinstance(result, np.ndarray) else result.reshape(-1))]
-    applied, have = await self.node.ingest_remote_result(
-      request_id, tokens, total_len, is_finished, error=error,
-    )
-    return {"ok": True, "applied": applied, "have": have}
+    async def attempt():
+      flags = await faults.apply("SendResult", self.node.id)
+      if flags["sink"]:
+        return {"ok": True}
+      tokens = [int(t) for t in (result if not isinstance(result, np.ndarray) else result.reshape(-1))]
+      applied, have = await self.node.ingest_remote_result(
+        request_id, tokens, total_len, is_finished, error=error,
+      )
+      if flags["lost_ack"]:
+        # Redelivery is already idempotent here: ingest's monotonic guard.
+        raise faults.TransientHopError(f"injected lost ack on SendResult to {self.node.id}")
+      return {"ok": True, "applied": applied, "have": have}
+
+    return await faults.with_hop_retries(attempt)
 
   async def send_opaque_status(self, request_id: str, status: str) -> None:
+    await faults.apply("SendOpaqueStatus", self.node.id)
     self.node.on_opaque_status.trigger_all(request_id, status)
 
   async def collect_topology(self, visited: set, max_depth: int) -> Topology:
+    await faults.apply("CollectTopology", self.node.id)
     return await self.node.collect_topology(set(visited), max_depth)

@@ -53,7 +53,11 @@ class PeerHandle(ABC):
   async def send_prompt(self, shard: Shard, prompt: str, request_id: Optional[str] = None,
                         traceparent: Optional[str] = None, max_tokens: Optional[int] = None,
                         images: Optional[list] = None, temperature: Optional[float] = None,
-                        top_p: Optional[float] = None, ring_map: Optional[list] = None) -> None:
+                        top_p: Optional[float] = None, ring_map: Optional[list] = None,
+                        deadline: Optional[float] = None) -> None:
+    """`deadline` is the request's REMAINING end-to-end budget in seconds at
+    send time (monotonic clocks don't compare across hosts, so the absolute
+    deadline never crosses the wire)."""
     ...
 
   @abstractmethod

@@ -40,10 +40,43 @@ class NodeMetrics:
       "xot_hop_seconds", "Per-hop processing time (infer_tensor)", ["node_id"], registry=self.registry,
       buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
     ).labels(**labels)
+    # Request-survivability counters (ring survivability layer): watchdog
+    # aborts, health-driven evictions, API-side transparent restarts, and
+    # retried hop deliveries dropped by receiver-side dedup.
+    self.watchdog_aborts_total = Counter(
+      "xot_watchdog_aborts_total", "Requests aborted by the deadline/stall watchdog",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
+    self.peer_evictions_total = Counter(
+      "xot_peer_evictions_total", "Peers evicted after failed health checks",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
+    self.request_restarts_total = Counter(
+      "xot_request_restarts_total", "Requests transparently restarted by the API after a ring failure",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
+    self.dedup_drops_total = Counter(
+      "xot_dedup_drops_total", "Retried hop deliveries dropped by receiver-side dedup",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
 
   def exposition(self) -> bytes:
     from prometheus_client import generate_latest
-    return generate_latest(self.registry)
+    body = generate_latest(self.registry)
+    # Transport-layer survivability counters are process-wide (peer handles
+    # have no Node back-reference, so no per-node registry can own them);
+    # appended as plain exposition lines, like the engine counters the API
+    # appends.
+    from xotorch_tpu.networking.faults import COUNTERS
+    extra = []
+    for key, name, help_text in (
+      ("hop_retries", "xot_hop_retries_total",
+       "Transient hop failures retried by peer handles (XOT_HOP_RETRIES)"),
+      ("health_check_failures", "xot_health_check_failures_total",
+       "Peer health checks that failed (health monitor sweeps)"),
+    ):
+      extra.append(f"# HELP {name} {help_text}\n# TYPE {name} counter\n{name} {COUNTERS.get(key, 0)}\n")
+    return body + "".join(extra).encode()
 
   def exposition_with_content_type(self) -> tuple:
     """(body, content_type) pair using the library's exposition constant so

@@ -25,6 +25,7 @@ import json
 import os
 import time
 import uuid
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -74,6 +75,12 @@ PROMPT_TOKENS_KEY = "xot_prompt_tokens"
 # silently diverging the stream. Membership changes mid-request still abort
 # via hop errors (the map names a peer that no longer answers).
 RING_MAP_KEY = "xot_ring_map"
+# Remaining end-to-end deadline budget (seconds at send time), riding the
+# inference_state side-channel like the traceparent: every peer that touches
+# the request derives its own absolute deadline from it, so the watchdog can
+# abort a blown request ANYWHERE on the ring (monotonic clocks don't compare
+# across hosts — the absolute value never crosses the wire).
+DEADLINE_KEY = "xot_deadline_s"
 
 
 _DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
@@ -186,7 +193,6 @@ class Node:
     # by signature inspection on first extras request (None = not yet).
     self._engine_accepts_sampling: Optional[bool] = None
     # Why a request aborted (bounded LRU; API pops entries when reporting).
-    from collections import OrderedDict
     self.request_errors: "OrderedDict[str, str]" = OrderedDict()
     # Request ids whose finish broadcast was applied here (bounded): shields
     # against out-of-order straggler deltas resurrecting finished requests.
@@ -219,6 +225,32 @@ class Node:
     # would silently stall its request with no error.
     self._detached_tasks: set = set()
 
+    # ---- request survivability (deadlines, watchdog, eviction) ----
+    # End-to-end request deadline (0 disables); remaining budget rides the
+    # hops (DEADLINE_KEY / send_prompt's deadline field).
+    self.request_deadline_s = float(os.getenv("XOT_REQUEST_DEADLINE_S", "0") or 0)
+    # Stall watchdog: abort any request whose last observed progress (hop
+    # received / token sampled / broadcast delta applied) is older than
+    # this (0 disables) — a peer that dies AFTER acking a tensor otherwise
+    # stalls the request forever with no error anywhere.
+    self.stall_timeout_s = float(os.getenv("XOT_STALL_TIMEOUT_S", "0") or 0)
+    # Periodic peer health monitor (0 disables): a peer failing
+    # XOT_HEALTH_FAILS consecutive checks is evicted and the topology
+    # repartitioned; eviction holds for XOT_EVICT_COOLDOWN_S so discovery
+    # can't immediately re-admit a corpse.
+    self.health_interval_s = float(os.getenv("XOT_HEALTH_INTERVAL_S", "0") or 0)
+    self.health_fail_threshold = max(1, int(os.getenv("XOT_HEALTH_FAILS", "2") or 2))
+    self.evict_cooldown_s = float(os.getenv("XOT_EVICT_COOLDOWN_S", "30") or 30)
+    self._request_deadline: Dict[str, float] = {}
+    self._last_progress: Dict[str, float] = {}
+    # Receiver-side hop dedup: per-request bounded seen-sets of hop seq ids
+    # (note_hop_delivery) — what makes retried deliveries idempotent.
+    self._hop_seen: "OrderedDict[str, OrderedDict]" = OrderedDict()
+    self._health_fails: Dict[str, int] = {}
+    self._evicted_until: Dict[str, float] = {}
+    self._watchdog_task: Optional[asyncio.Task] = None
+    self._health_task: Optional[asyncio.Task] = None
+
   def _spawn(self, coro) -> "asyncio.Task":
     return spawn_detached(coro, self._detached_tasks)
 
@@ -231,16 +263,21 @@ class Node:
     await self.update_peers(wait_for_peers)
     await self.collect_topology(set())
     self._topology_task = asyncio.create_task(self.periodic_topology_collection(topology_interval))
+    self.start_watchdog()
+    self.start_health_monitor()
     if DEBUG >= 1:
       print(f"Node {self.id} started; topology: {self.topology}")
 
   async def stop(self) -> None:
-    if self._topology_task is not None:
-      self._topology_task.cancel()
-      try:
-        await self._topology_task
-      except asyncio.CancelledError:
-        pass
+    for attr in ("_topology_task", "_watchdog_task", "_health_task"):
+      task = getattr(self, attr)
+      if task is not None:
+        task.cancel()
+        try:
+          await task
+        except asyncio.CancelledError:
+          pass
+        setattr(self, attr, None)
     await self.discovery.stop()
     await self.server.stop()
     # Detached graceful channel drains (peer replacement mid-request) must
@@ -250,6 +287,161 @@ class Node:
       await drain_graceful_closes()
     except ImportError:
       pass  # grpc-less deployments (in-process ring) have none
+
+  # ------------------------------------------------------- survivability
+
+  def start_watchdog(self) -> None:
+    """Arm the deadline/stall watchdog (no-op when nothing needs it).
+    Also called lazily from _note_progress / deadline adoption so Nodes
+    driven without start() — the test harness pattern — still get
+    coverage, and a peer whose OWN knobs are off still enforces a deadline
+    that arrived via hop metadata (the origin may be the node that died)."""
+    if self._watchdog_task is None and (
+        self.stall_timeout_s > 0 or self.request_deadline_s > 0 or self._request_deadline):
+      self._watchdog_task = self._spawn(self._watchdog_loop())
+
+  def start_health_monitor(self) -> None:
+    if self._health_task is None and self.health_interval_s > 0:
+      self._health_task = self._spawn(self._health_monitor_loop())
+
+  def _note_progress(self, request_id: str) -> None:
+    self._last_progress[request_id] = time.monotonic()
+    self.start_watchdog()
+
+  def note_hop_delivery(self, request_id: Optional[str], hop_seq: Optional[str]) -> bool:
+    """Receiver-side dedup for retried hops: True admits the delivery, False
+    means this (request, seq) was already delivered — the sender's ack got
+    lost and its retry redelivered; processing it again would double-decode
+    a position. Bounded per-request seen-sets (retries land close in time,
+    so a small window suffices); rows age out of the bounded LRU rather
+    than dying at finish, so a retry landing after the request completed is
+    still dropped instead of resurrecting state for a dead request."""
+    if hop_seq is None:
+      return True
+    key = request_id or ""
+    seen = self._hop_seen.get(key)
+    if seen is None:
+      seen = self._hop_seen[key] = OrderedDict()
+      while len(self._hop_seen) > 256:
+        self._hop_seen.popitem(last=False)
+    self._hop_seen.move_to_end(key)
+    if hop_seq in seen:
+      self.metrics.dedup_drops_total.inc()
+      if DEBUG >= 2:
+        print(f"[{request_id}] duplicate hop delivery {hop_seq} dropped")
+      return False
+    seen[hop_seq] = None
+    while len(seen) > 128:
+      seen.popitem(last=False)
+    return True
+
+  async def _watchdog_loop(self) -> None:
+    """Abort requests that blew their end-to-end deadline or stopped making
+    progress. Today's alternative is a silent forever-hang: a peer that
+    dies after acking a tensor raises no error anywhere. Aborting rides the
+    existing _abort_request path, so the finish broadcast cleans up
+    bookkeeping and KV on every surviving peer too."""
+    bounds = [t for t in (self.stall_timeout_s, self.request_deadline_s) if t > 0]
+    tick = min(1.0, max(0.02, min(bounds) / 4)) if bounds else 1.0
+    while True:
+      await asyncio.sleep(tick)
+      now = time.monotonic()
+      try:
+        for rid, dl in list(self._request_deadline.items()):
+          if now <= dl:
+            continue
+          if rid in self.outstanding_requests or rid in self.buffered_token_output:
+            self.metrics.watchdog_aborts_total.inc()
+            await self._abort_request(rid, f"deadline_exceeded: request blew its deadline on {self.id}")
+          else:
+            self._request_deadline.pop(rid, None)  # finished elsewhere; GC the row
+        if self.stall_timeout_s > 0:
+          # Sweep every request with a progress row, not just locally
+          # outstanding ones: the ORIGIN of a forwarded prompt returns
+          # right after the forward (it is never "outstanding" here), yet a
+          # silently lost prompt chain must still end at its deadline
+          # instead of riding the API timeout. Rows die at finish, so a
+          # completed request can't false-abort.
+          for rid in set(self.outstanding_requests) | set(self._last_progress):
+            last = self._last_progress.get(rid)
+            if last is None:
+              self._last_progress[rid] = now
+            elif now - last > self.stall_timeout_s:
+              self.metrics.watchdog_aborts_total.inc()
+              await self._abort_request(
+                rid, f"stalled: no progress for {now - last:.2f}s on {self.id} "
+                     f"(stall timeout {self.stall_timeout_s:g}s)")
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"watchdog error: {e!r}")
+
+  async def _health_monitor_loop(self) -> None:
+    """Periodic wiring for the (previously never-called) peer health_check:
+    evict peers that fail repeatedly and repartition, so the NEXT request
+    pins a ring of live peers instead of routing into a corpse."""
+    while True:
+      await asyncio.sleep(self.health_interval_s)
+      try:
+        await self._health_sweep(self.health_fail_threshold)
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"health monitor error: {e!r}")
+
+  async def _health_sweep(self, evict_after: int) -> None:
+    for peer in list(self.peers):
+      try:
+        ok = await peer.health_check()
+      except Exception:
+        ok = False
+      if ok:
+        self._health_fails.pop(peer.id(), None)
+        continue
+      from xotorch_tpu.networking import faults
+      faults.bump("health_check_failures")
+      fails = self._health_fails.get(peer.id(), 0) + 1
+      self._health_fails[peer.id()] = fails
+      if fails >= evict_after:
+        await self._evict_peer(peer)
+
+  async def _evict_peer(self, peer) -> None:
+    if DEBUG >= 1:
+      print(f"Evicting unhealthy peer {peer.id()}@{peer.addr()}")
+    self.peers = [p for p in self.peers if p.id() != peer.id()]
+    self._evicted_until[peer.id()] = time.monotonic() + self.evict_cooldown_s
+    self._health_fails.pop(peer.id(), None)
+    self.metrics.peer_evictions_total.inc()
+    self.metrics.peers.set(len(self.peers))
+    try:
+      await peer.disconnect()
+    except Exception:
+      pass
+    try:
+      # Repartition NOW: the dead peer must leave the partition table before
+      # any new (or restarted) request pins its ring map.
+      await self.collect_topology(set())
+    except Exception:
+      pass
+
+  def _is_evicted(self, peer_id: str) -> bool:
+    until = self._evicted_until.get(peer_id)
+    if until is None:
+      return False
+    if time.monotonic() >= until:
+      self._evicted_until.pop(peer_id, None)
+      return False
+    return True
+
+  async def heal_ring(self) -> None:
+    """Aggressive one-shot heal for the API's request-restart path: a
+    request just died, so a single failed check is enough to evict; then
+    re-derive the partition table so the restarted request pins a live
+    ring. Peers that pass stay — an engine-side failure must not cost a
+    healthy peer its seat."""
+    await self._health_sweep(evict_after=1)
+    try:
+      await self.collect_topology(set())
+    except Exception:
+      pass
 
   # ----------------------------------------------------------- status bus
 
@@ -305,9 +497,18 @@ class Node:
                            temperature: Optional[float] = None,
                            top_p: Optional[float] = None,
                            sampling: Optional[dict] = None,
-                           ring_map: Optional[list] = None) -> None:
+                           ring_map: Optional[list] = None,
+                           deadline: Optional[float] = None) -> None:
     if request_id is None:
       request_id = str(uuid.uuid4())
+    if request_id not in self._request_deadline:
+      # A forwarded prompt carries the origin's REMAINING budget; an origin
+      # request starts a fresh one from the node knob.
+      if deadline is not None:
+        self._request_deadline[request_id] = time.monotonic() + max(0.0, float(deadline))
+      elif self.request_deadline_s > 0:
+        self._request_deadline[request_id] = time.monotonic() + self.request_deadline_s
+    self._note_progress(request_id)
     if ring_map:
       # Forwarded prompt: route by the SENDER's pinned map, not our own
       # (possibly lagging) partition view — see RING_MAP_KEY.
@@ -436,6 +637,12 @@ class Node:
     self.outstanding_requests[request_id] = "processing tensor"
     self.metrics.active_requests.set(len(self.outstanding_requests))
     self.metrics.tensor_hops_total.inc()
+    self._note_progress(request_id)
+    if inference_state and request_id not in self._request_deadline:
+      d = inference_state.get(DEADLINE_KEY)
+      if d is not None:
+        self._request_deadline[request_id] = time.monotonic() + max(0.0, float(d))
+        self.start_watchdog()  # a hop-carried deadline must be enforced HERE too
     # Join the request's trace: the traceparent rides the inference_state
     # side-channel across peers (W3C propagation, reference tracing.py:36-70).
     ctx = self._request_trace_ctx.get(request_id)
@@ -520,6 +727,11 @@ class Node:
     string rides the broadcast so API nodes surface a real error instead of
     an empty successful completion."""
     self.record_request_error(request_id, error)
+    # Watchdog/deadline aborts can fire while the request's driving task is
+    # still alive (a hung engine call, a loop awaiting a dead peer): the
+    # cancel flag makes any late-completing local work stop at its next
+    # boundary instead of resurrecting popped state.
+    self._mark_cancelled(request_id)
     tokens, _ = self.buffered_token_output.get(request_id, ([], False))
     self.trigger_on_token_callbacks(request_id, tokens, True)
     try:
@@ -877,6 +1089,7 @@ class Node:
     limit = self._request_max_tokens.get(request_id, self.max_generate_tokens)
     trace_ctx = self._request_trace_ctx.get(request_id)
     now = time.monotonic()
+    self._note_progress(request_id)
     last = self._last_token_time.get(request_id)
     appended = 0
     finished = False
@@ -1105,13 +1318,15 @@ class Node:
     if peer is None:
       raise ValueError(f"Peer for {target_index} ({target_id}) not found")
     ctx = self._request_trace_ctx.get(request_id)
+    dl = self._request_deadline.get(request_id)
     await peer.send_prompt(next_shard, prompt, request_id,
                            traceparent=ctx.traceparent() if ctx else None,
                            max_tokens=self._request_max_tokens.get(request_id),
                            images=images,
                            temperature=self._request_temp.get(request_id),
                            top_p=self._request_top_p.get(request_id),
-                           ring_map=self._ring_entries(request_id))
+                           ring_map=self._ring_entries(request_id),
+                           deadline=max(0.0, dl - time.monotonic()) if dl is not None else None)
 
   def _keep_on_device_kwargs(self, shard: Shard, request_id: Optional[str] = None) -> dict:
     """Engine kwargs for a mid-ring hop: request device-resident output when
@@ -1157,6 +1372,9 @@ class Node:
     s = self._request_sampling.get(request_id)
     if s is not None:
       inference_state = {**(inference_state or {}), SAMPLING_KEY: s}
+    dl = self._request_deadline.get(request_id)
+    if dl is not None:
+      inference_state = {**(inference_state or {}), DEADLINE_KEY: max(0.0, dl - time.monotonic())}
     if target_id == self.id:
       # Schedule rather than await: a direct call would grow one coroutine
       # chain per token and blow the recursion limit on long generations.
@@ -1312,6 +1530,9 @@ class Node:
 
   async def _update_peers_locked(self, wait_for_peers: int = 0) -> bool:
     next_peers = await self.discovery.discover_peers(wait_for_peers)
+    # Health-evicted peers stay out for their cooldown even when discovery
+    # still lists them (its liveness view can lag a death by many seconds).
+    next_peers = [p for p in next_peers if not self._is_evicted(p.id())]
     current_ids = {p.id() for p in self.peers}
     next_ids = {p.id() for p in next_peers}
     peers_added = [p for p in next_peers if p.id() not in current_ids]
@@ -1356,7 +1577,11 @@ class Node:
 
     connected = await asyncio.gather(*(_connect(p) for p in peers_added))
     await asyncio.gather(*(_disconnect(p) for p in peers_removed))
-    self.peers = peers_kept + [p for p, ok in zip(peers_added, connected) if ok]
+    # Re-filter at assignment: an eviction can land during the awaits above
+    # (the health monitor doesn't hold this lock) and must not be undone by
+    # this read-modify-write completing with its stale snapshot.
+    self.peers = [p for p in peers_kept + [p for p, ok in zip(peers_added, connected) if ok]
+                  if not self._is_evicted(p.id())]
     self.metrics.peers.set(len(self.peers))
     return bool(peers_added or peers_removed)
 
@@ -1439,6 +1664,12 @@ class Node:
     self._request_eos.pop(request_id, None)
     self._request_prompt_tokens.pop(request_id, None)
     self._request_ring_map.pop(request_id, None)
+    self._request_deadline.pop(request_id, None)
+    self._last_progress.pop(request_id, None)
+    # _hop_seen rows deliberately OUTLIVE the request (they age out of the
+    # bounded LRU instead): a slow retry can land after the request
+    # finished, and admitting it as fresh would resurrect per-request state
+    # for a dead request.
 
   def trigger_on_token_callbacks(self, request_id: str, tokens: List[int], is_finished: bool) -> None:
     self.on_token.trigger_all(request_id, tokens, is_finished)
@@ -1522,6 +1753,10 @@ class Node:
       # Record before triggering so API consumers see the cause when the
       # finished callback lands.
       self.record_request_error(request_id, error)
+    # Applied deltas are progress for THIS peer's stall watchdog: mid-ring
+    # nodes see no hops during a healthy generation — the sampler's token
+    # broadcasts are their only heartbeat.
+    self._note_progress(request_id)
     self.buffered_token_output[request_id] = (merged, is_finished)
     self.trigger_on_token_callbacks(request_id, merged, is_finished)
     if is_finished:
