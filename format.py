@@ -12,7 +12,7 @@ def main() -> int:
   # the tree must already be formatted, nothing is rewritten.
   check = "--check" in args
   targets = [a for a in args if a != "--check"] or [
-    "xotorch_tpu", "tests", "bench.py", "__graft_entry__.py"]
+    "xotorch_tpu", "tests", "tools", "bench.py", "__graft_entry__.py"]
   try:
     import yapf  # noqa: F401
   except ImportError:

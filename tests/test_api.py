@@ -741,3 +741,38 @@ async def test_modelpool_streams_sse_status():
     assert {"name", "layers", "downloaded"} <= set(entry)
   finally:
     await client.close()
+
+
+def test_exposition_counters_reachable_from_xotlint_extraction():
+  """Ties the linter to the runtime surface: every `xot_*` series a real
+  NodeMetrics.exposition emits (registry metrics + the appended process
+  counters) must be present in the metrics-consistency checker's statically
+  extracted exported set — if the checker's parse ever drifts from what the
+  runtime actually serves, this fails before CI green-lights a stale lint."""
+  import re
+  import sys
+  from pathlib import Path
+
+  root = Path(__file__).resolve().parent.parent
+  if str(root) not in sys.path:
+    sys.path.insert(0, str(root))
+  from tools.xotlint.core import Repo
+  from tools.xotlint.metrics_consistency import exported_metrics
+
+  from xotorch_tpu.orchestration.metrics import NodeMetrics
+
+  extracted = exported_metrics(Repo(str(root)))
+  text = NodeMetrics(node_id="lint-tie").exposition().decode()
+  served = set()
+  for line in text.splitlines():
+    m = re.match(r"^(xot_[a-z0-9_]+?)(?:_bucket|_sum|_count|_created)?\{? ", line.replace("{", "{ "))
+    if m and not line.startswith("#"):
+      served.add(m.group(1))
+  assert served, text
+  for name in sorted(served):
+    # Library-derived series: histograms emit _bucket/_sum/_count, counters
+    # an extra `<base>_created` where base drops the `_total` suffix.
+    base = re.sub(r"_(bucket|sum|count|created)$", "", name)
+    assert name in extracted or base in extracted or f"{base}_total" in extracted, (
+      f"{name} served by NodeMetrics.exposition but invisible to the "
+      f"metrics-consistency checker (extracted: {sorted(extracted)})")

@@ -119,3 +119,50 @@ async def test_spawn_detached_holds_and_releases_refs():
   await t2
   await asyncio.sleep(0)
   assert not scoped
+
+
+async def test_spawn_detached_reports_only_unobserved_exceptions(capsys):
+  """A detached task's exception is printed deterministically when nothing
+  awaits it — and NOT printed when an awaiter retrieves and handles it (the
+  download dedup / API pump pattern), so handled failures stay quiet."""
+  from xotorch_tpu.utils.helpers import spawn_detached
+
+  async def boom():
+    raise ValueError("observed")
+
+  task = spawn_detached(boom())
+  try:
+    await task
+  except ValueError:
+    pass
+  await asyncio.sleep(0.05)  # both done-callback ticks
+  assert "observed" not in capsys.readouterr().err
+
+  async def boom2():
+    raise ValueError("unobserved")
+
+  spawn_detached(boom2())
+  await asyncio.sleep(0.05)
+  err = capsys.readouterr().err
+  assert "unobserved" in err and "detached task" in err
+
+
+def test_knob_empty_value_semantics(monkeypatch):
+  """Set-but-EMPTY keeps the historical per-type meaning: tri-state raw()
+  returns it verbatim (so `XOT_FLASH_ATTENTION=` still forces the kernel
+  OFF, not auto), numeric accessors treat it as unset (the `or 0` idiom),
+  and get_bool reads it as False."""
+  from xotorch_tpu.utils import knobs
+
+  monkeypatch.setenv("XOT_FLASH_ATTENTION", "")
+  assert knobs.raw("XOT_FLASH_ATTENTION") == ""  # set: forces the != "1" branch
+  monkeypatch.delenv("XOT_FLASH_ATTENTION")
+  assert knobs.raw("XOT_FLASH_ATTENTION") is None  # unset: auto-select
+
+  monkeypatch.setenv("XOT_HOP_RETRIES", "")
+  assert knobs.get_int("XOT_HOP_RETRIES") == 0  # empty -> registered default
+  monkeypatch.setenv("XOT_HEALTH_FAILS", "")
+  assert knobs.get_int("XOT_HEALTH_FAILS") == 2
+
+  monkeypatch.setenv("XOT_PAGED_KV", "")
+  assert knobs.get_bool("XOT_PAGED_KV") is False

@@ -1,0 +1,43 @@
+"""xotlint: repo-native static analysis for the xotorch_tpu runtime.
+
+Five checkers, each a module exposing `check(repo) -> list[Finding]`:
+
+- async-safety        blocking calls / sync locks / raw create_task in async code
+- knob-registry       every XOT_* env read routes through utils/knobs.py
+- doc-drift           README knob reference matches the registry
+- metrics-consistency incremented counters are exported, `_total` convention
+- exception-hygiene   no silent `except Exception: pass` on serving paths
+
+Run as `python -m tools.xotlint`; see `--help` for baseline management and
+`--knob-docs` for README generation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from tools.xotlint.core import Finding, Repo
+from tools.xotlint import (  # noqa: E402  (registry of checker modules)
+  async_safety,
+  doc_drift,
+  exception_hygiene,
+  knob_registry,
+  metrics_consistency,
+)
+
+CHECKERS = {
+  async_safety.CHECKER: async_safety,
+  knob_registry.CHECKER: knob_registry,
+  doc_drift.CHECKER: doc_drift,
+  metrics_consistency.CHECKER: metrics_consistency,
+  exception_hygiene.CHECKER: exception_hygiene,
+}
+
+
+def run_checkers(repo: Repo, only: Optional[Sequence[str]] = None) -> List[Finding]:
+  findings: List[Finding] = []
+  for name, module in CHECKERS.items():
+    if only and name not in only:
+      continue
+    findings.extend(module.check(repo))
+  findings.sort(key=lambda f: (f.path, f.line, f.checker, f.code, f.key))
+  return findings

@@ -1,0 +1,79 @@
+"""CLI: `python -m tools.xotlint` — run all checkers, compare to baseline.
+
+Exit codes: 0 = no non-baselined findings, 1 = findings, 2 = usage/config
+error. `--knob-docs` prints the generated README knob section and exits.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.xotlint import CHECKERS, run_checkers
+from tools.xotlint import doc_drift
+from tools.xotlint.core import Repo, load_baseline, write_baseline
+
+DEFAULT_BASELINE = os.path.join("tools", "xotlint", "baseline.json")
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+    prog="python -m tools.xotlint",
+    description="Repo-native static analysis: async-safety, knob registry, "
+                "doc drift, metrics consistency, exception hygiene.",
+  )
+  parser.add_argument("--root", default=".", help="repo root (default: cwd)")
+  parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                      help="baseline file of grandfathered findings")
+  parser.add_argument("--write-baseline", action="store_true",
+                      help="write the current findings as the new baseline and exit")
+  parser.add_argument("--no-baseline", action="store_true",
+                      help="ignore the baseline (report every finding)")
+  parser.add_argument("--knob-docs", action="store_true",
+                      help="print the generated README knob-reference section and exit")
+  parser.add_argument("--checker", action="append", default=None,
+                      help="run only this checker (repeatable)")
+  args = parser.parse_args(argv)
+
+  repo = Repo(args.root)
+  if args.knob_docs:
+    print(doc_drift.generated_section(repo))
+    return 0
+
+  unknown = [c for c in (args.checker or []) if c not in CHECKERS]
+  if unknown:
+    # A typo'd name silently running zero checkers would read as "clean".
+    print(f"unknown checker(s): {', '.join(unknown)} "
+          f"(available: {', '.join(CHECKERS)})", file=sys.stderr)
+    return 2
+
+  findings = run_checkers(repo, only=args.checker)
+
+  baseline_path = os.path.join(args.root, args.baseline)
+  if args.write_baseline:
+    write_baseline(baseline_path, findings)
+    print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+    return 0
+
+  baseline = set() if args.no_baseline else set(load_baseline(baseline_path))
+  fresh = [f for f in findings if f.identity not in baseline]
+  stale = baseline - {f.identity for f in findings}
+
+  for f in fresh:
+    print(f.render())
+  if stale:
+    print(f"note: {len(stale)} baseline entr{'y is' if len(stale) == 1 else 'ies are'} "
+          "stale (finding fixed — remove from baseline):", file=sys.stderr)
+    for identity in sorted(stale):
+      print(f"  {identity}", file=sys.stderr)
+  if fresh:
+    print(f"\nxotlint: {len(fresh)} finding(s) "
+          f"({len(findings) - len(fresh)} baselined)", file=sys.stderr)
+    return 1
+  print(f"xotlint: clean ({len(findings)} baselined finding(s))"
+        if findings else "xotlint: clean")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -1,0 +1,148 @@
+"""async-safety checker: the event loop must never block.
+
+The ring runtime's liveness model makes a blocked loop indistinguishable
+from a dead peer (the stall watchdog and health monitor both run ON the
+loop), so three classes of finding:
+
+- `blocking-call`: a known-blocking call lexically inside `async def`
+  (`time.sleep`, sync HTTP, `subprocess.*`, `.block_until_ready()`,
+  `open()` file I/O). Sync helpers *called from* async code are out of
+  scope — route real work through an executor and the call site is clean.
+- `lock-across-await`: a synchronous (threading) lock held across an
+  `await` — the loop parks with the lock taken and every executor thread
+  contending on it deadlocks the process.
+- `raw-create-task`: `asyncio.create_task` / `ensure_future` outside the
+  strong-ref wrapper (`utils/helpers.py` `spawn_detached`). The loop keeps
+  only weak refs to tasks: a fire-and-forget task can be GC'd mid-flight
+  and its exception silently lost.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.xotlint.core import Finding, Repo, dotted_name
+
+CHECKER = "async-safety"
+
+# Dotted-call names that block the calling thread. Matched against the
+# resolved attribute chain, so aliasing (`import time as t`) escapes the
+# net — acceptable for a repo-native linter that also bans the alias idiom
+# in review.
+_BLOCKING_CALLS = {
+  "time.sleep",
+  "subprocess.run", "subprocess.call", "subprocess.check_call",
+  "subprocess.check_output", "subprocess.Popen",
+  "os.system", "os.waitpid",
+  "requests.get", "requests.post", "requests.put", "requests.delete",
+  "requests.head", "requests.patch", "requests.request",
+  "urllib.request.urlopen",
+  "socket.create_connection", "socket.getaddrinfo", "socket.gethostbyname",
+}
+
+# Attribute-only patterns: blocking regardless of receiver.
+_BLOCKING_ATTRS = {"block_until_ready"}
+
+# Names that mark a context-manager expression as a synchronous lock.
+_LOCKY = ("lock", "mutex", "cond", "sema")
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+  name = dotted_name(node)
+  if not name and isinstance(node, ast.Call):
+    name = dotted_name(node.func)
+  tail = name.rsplit(".", 1)[-1].lower()
+  return any(tok in tail for tok in _LOCKY)
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+  def __init__(self, sf, findings: List[Finding]):
+    self.sf = sf
+    self.findings = findings
+    self.async_depth = 0
+    self.func_stack: List[str] = []
+
+  # --- scope tracking ---------------------------------------------------
+
+  def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+    self.func_stack.append(node.name)
+    prev, self.async_depth = self.async_depth, 0  # sync body: loop not implied
+    self.generic_visit(node)
+    self.async_depth = prev
+    self.func_stack.pop()
+
+  def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+    self.func_stack.append(node.name)
+    self.async_depth += 1
+    self.generic_visit(node)
+    self.async_depth -= 1
+    self.func_stack.pop()
+
+  def visit_Lambda(self, node: ast.Lambda) -> None:
+    prev, self.async_depth = self.async_depth, 0
+    self.generic_visit(node)
+    self.async_depth = prev
+
+  # --- findings ---------------------------------------------------------
+
+  def _emit(self, code: str, node: ast.AST, message: str, key: str) -> None:
+    if self.sf.suppressed(node.lineno, CHECKER):
+      return
+    self.findings.append(Finding(
+      checker=CHECKER, code=code, path=self.sf.relpath, line=node.lineno,
+      message=message, key=key,
+    ))
+
+  def _scope(self) -> str:
+    return ".".join(self.func_stack) or "<module>"
+
+  def visit_Call(self, node: ast.Call) -> None:
+    name = dotted_name(node.func)
+    in_wrapper = self.sf.relpath.endswith("utils/helpers.py")
+    if name.endswith(("create_task", "ensure_future")) and not in_wrapper \
+        and (name.startswith("asyncio.") or ".loop." in f".{name}" or name.startswith("loop.")):
+      self._emit(
+        "raw-create-task", node,
+        f"raw `{name}` — route through utils.helpers.spawn_detached so the task "
+        "holds a strong ref and its exception is logged, never silently dropped",
+        key=f"{self._scope()}:{name.rsplit('.', 1)[-1]}",
+      )
+    if self.async_depth > 0:
+      blocking = name in _BLOCKING_CALLS
+      attr = name.rsplit(".", 1)[-1] if name else (
+        node.func.attr if isinstance(node.func, ast.Attribute) else "")
+      if not blocking and attr in _BLOCKING_ATTRS:
+        blocking, name = True, attr
+      if not blocking and name == "open":
+        blocking = True
+        name = "open"
+      if blocking:
+        self._emit(
+          "blocking-call", node,
+          f"blocking `{name}(...)` inside `async def {self._scope()}` — the event "
+          "loop (and every watchdog on it) stalls; use the async equivalent or "
+          "run it in an executor",
+          key=f"{self._scope()}:{name}",
+        )
+    self.generic_visit(node)
+
+  def visit_With(self, node: ast.With) -> None:
+    if self.async_depth > 0 and any(_is_lock_expr(item.context_expr) for item in node.items):
+      if any(isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+             for child in node.body for n in ast.walk(child)):
+        self._emit(
+          "lock-across-await", node,
+          f"synchronous lock held across `await` in `async def {self._scope()}` — "
+          "the loop parks holding the lock; use asyncio.Lock or release before awaiting",
+          key=self._scope(),
+        )
+    self.generic_visit(node)
+
+
+def check(repo: Repo) -> List[Finding]:
+  findings: List[Finding] = []
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    _AsyncVisitor(sf, findings).visit(sf.tree)
+  return findings

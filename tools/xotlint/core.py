@@ -1,0 +1,185 @@
+"""xotlint core: repo model, findings, suppression comments, baseline.
+
+The linter is AST-based and import-free for the tree it scans (it loads
+`xotorch_tpu/utils/knobs.py` standalone — that module imports only the
+stdlib — but never imports the package under lint, so a tree with a broken
+import still lints).
+
+Finding identity is line-number-free (`checker:code:path:key`) so the
+committed baseline doesn't churn when unrelated edits move code. Inline
+suppressions use a trailing comment on the offending line:
+
+    risky_call()  # xotlint: disable=async-safety (reason why this is fine)
+
+A suppression must name the checker; a parenthesized reason is convention,
+enforced by review rather than the tool.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+_DISABLE_RE = re.compile(r"#\s*xotlint:\s*disable=([a-z0-9_,-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+  checker: str  # e.g. "async-safety"
+  code: str     # e.g. "blocking-call"
+  path: str     # repo-relative, forward slashes
+  line: int     # 1-based; informational only (not part of identity)
+  message: str
+  key: str      # stable detail (symbol-ish) completing the baseline identity
+
+  @property
+  def identity(self) -> str:
+    return f"{self.checker}:{self.code}:{self.path}:{self.key}"
+
+  def render(self) -> str:
+    return f"{self.path}:{self.line}: [{self.checker}/{self.code}] {self.message}"
+
+
+class SourceFile:
+  def __init__(self, root: str, relpath: str):
+    self.relpath = relpath.replace(os.sep, "/")
+    self.abspath = os.path.join(root, relpath)
+    with open(self.abspath, "r", encoding="utf-8") as f:
+      self.text = f.read()
+    self.lines = self.text.splitlines()
+    self.tree: Optional[ast.AST] = None
+    self.parse_error: Optional[SyntaxError] = None
+    try:
+      self.tree = ast.parse(self.text, filename=self.relpath)
+    except SyntaxError as e:
+      self.parse_error = e
+
+  def line_text(self, line: int) -> str:
+    if 1 <= line <= len(self.lines):
+      return self.lines[line - 1]
+    return ""
+
+  def suppressed(self, line: int, checker: str) -> bool:
+    m = _DISABLE_RE.search(self.line_text(line))
+    if m is None:
+      return False
+    names = {n.strip() for n in m.group(1).split(",")}
+    return checker in names or "all" in names
+
+
+class Repo:
+  """The tree under lint plus the well-known paths checkers consult.
+
+  Tests point this at fixture trees; defaults describe the real repo.
+  """
+
+  def __init__(
+    self,
+    root: str,
+    py_roots: Sequence[str] = ("xotorch_tpu",),
+    knobs_path: str = "xotorch_tpu/utils/knobs.py",
+    metrics_path: str = "xotorch_tpu/orchestration/metrics.py",
+    api_metrics_path: str = "xotorch_tpu/api/chatgpt_api.py",
+    readme_path: str = "README.md",
+    helpers_path: str = "xotorch_tpu/utils/helpers.py",
+  ):
+    self.root = os.path.abspath(root)
+    self.py_roots = tuple(py_roots)
+    self.knobs_path = knobs_path
+    self.metrics_path = metrics_path
+    self.api_metrics_path = api_metrics_path
+    self.readme_path = readme_path
+    self.helpers_path = helpers_path
+    self._files: Optional[List[SourceFile]] = None
+    self._by_path: Dict[str, SourceFile] = {}
+    self._knobs_module = None
+
+  def files(self) -> List[SourceFile]:
+    if self._files is None:
+      found: List[SourceFile] = []
+      for py_root in self.py_roots:
+        base = os.path.join(self.root, py_root)
+        for dirpath, dirnames, filenames in os.walk(base):
+          dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+          for name in sorted(filenames):
+            if name.endswith(".py"):
+              rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+              found.append(SourceFile(self.root, rel))
+      self._files = found
+      self._by_path = {f.relpath: f for f in found}
+    return self._files
+
+  def file(self, relpath: str) -> Optional[SourceFile]:
+    self.files()
+    relpath = relpath.replace(os.sep, "/")
+    sf = self._by_path.get(relpath)
+    if sf is None and os.path.isfile(os.path.join(self.root, relpath)):
+      sf = SourceFile(self.root, relpath)
+      self._by_path[relpath] = sf
+    return sf
+
+  def read_text(self, relpath: str) -> Optional[str]:
+    path = os.path.join(self.root, relpath)
+    if not os.path.isfile(path):
+      return None
+    with open(path, "r", encoding="utf-8") as f:
+      return f.read()
+
+  def knobs_module(self):
+    """The knob registry loaded standalone (stdlib-only module, so this
+    never imports jax or the rest of the package)."""
+    if self._knobs_module is None:
+      path = os.path.join(self.root, self.knobs_path)
+      spec = importlib.util.spec_from_file_location("_xotlint_knobs", path)
+      module = importlib.util.module_from_spec(spec)
+      sys.modules[spec.name] = module  # dataclasses resolves __module__ here
+      spec.loader.exec_module(module)
+      self._knobs_module = module
+    return self._knobs_module
+
+
+def dotted_name(node: ast.AST) -> str:
+  """`os.environ.get` for Attribute/Name chains, "" for anything dynamic."""
+  parts: List[str] = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name):
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+  return ""
+
+
+def str_arg(call: ast.Call, index: int = 0) -> Optional[str]:
+  if len(call.args) > index and isinstance(call.args[index], ast.Constant) \
+      and isinstance(call.args[index].value, str):
+    return call.args[index].value
+  return None
+
+
+def load_baseline(path: str) -> List[str]:
+  if not os.path.isfile(path):
+    return []
+  with open(path, "r", encoding="utf-8") as f:
+    data = json.load(f)
+  return list(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+  identities = sorted({f.identity for f in findings})
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w", encoding="utf-8") as f:
+    json.dump(
+      {
+        "comment": "Grandfathered xotlint findings. Entries here do not fail CI; "
+                   "fix the code and remove the entry rather than adding new ones.",
+        "findings": identities,
+      },
+      f, indent=2,
+    )
+    f.write("\n")

@@ -1,0 +1,86 @@
+"""doc-drift checker: the README knob reference must match the registry.
+
+The README section between the `BEGIN/END XOT KNOBS` markers is generated
+(`python -m tools.xotlint --knob-docs`); this checker re-renders the table
+from the live registry and compares per knob, so a knob added, removed,
+re-defaulted, or re-documented in code without regenerating the README
+fails CI with a per-knob message instead of a wall of diff.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.xotlint.core import Finding, Repo
+
+CHECKER = "doc-drift"
+
+BEGIN_MARK = "<!-- BEGIN XOT KNOBS (generated: python -m tools.xotlint --knob-docs) -->"
+END_MARK = "<!-- END XOT KNOBS -->"
+
+_ROW_RE = re.compile(r"^\|\s*`(XOT_[A-Z0-9_]+)`\s*\|\s*(\S+)\s*\|\s*(.*?)\s*\|\s*(.*?)\s*\|$")
+
+
+def generated_section(repo: Repo) -> str:
+  """The full replacement text between (and including) the markers."""
+  table = repo.knobs_module().knob_table_markdown()
+  return f"{BEGIN_MARK}\n\n{table}\n{END_MARK}"
+
+
+def _parse_rows(section: str) -> Dict[str, Tuple[str, str, str]]:
+  rows: Dict[str, Tuple[str, str, str]] = {}
+  for line in section.splitlines():
+    m = _ROW_RE.match(line.strip())
+    if m:
+      rows[m.group(1)] = (m.group(2), m.group(3), m.group(4))
+  return rows
+
+
+def _find_section(text: str) -> Optional[str]:
+  start = text.find(BEGIN_MARK)
+  end = text.find(END_MARK)
+  if start < 0 or end < 0 or end < start:
+    return None
+  return text[start:end + len(END_MARK)]
+
+
+def check(repo: Repo) -> List[Finding]:
+  readme = repo.read_text(repo.readme_path)
+  if readme is None:
+    return [Finding(CHECKER, "missing-readme", repo.readme_path, 1,
+                    f"{repo.readme_path} not found", key="readme")]
+  section = _find_section(readme)
+  if section is None:
+    return [Finding(
+      CHECKER, "missing-section", repo.readme_path, 1,
+      f"{repo.readme_path} has no `{BEGIN_MARK}` ... `{END_MARK}` block — "
+      "add one and fill it with `python -m tools.xotlint --knob-docs`",
+      key="section",
+    )]
+  documented = _parse_rows(section)
+  expected = _parse_rows(generated_section(repo))
+  findings: List[Finding] = []
+  line_of = {name: i + 1 for i, line in enumerate(readme.splitlines())
+             for name in documented if f"`{name}`" in line}
+  for name, row in expected.items():
+    if name not in documented:
+      findings.append(Finding(
+        CHECKER, "undocumented-knob", repo.readme_path, 1, key=name,
+        message=f"`{name}` is registered but missing from the README knob table "
+                "— regenerate with `python -m tools.xotlint --knob-docs`",
+      ))
+    elif documented[name] != row:
+      findings.append(Finding(
+        CHECKER, "stale-doc", repo.readme_path, line_of.get(name, 1), key=name,
+        message=f"`{name}` README row (type/default/doc) differs from the registry "
+                "— regenerate with `python -m tools.xotlint --knob-docs`",
+      ))
+  for name in documented:
+    if name not in expected:
+      findings.append(Finding(
+        CHECKER, "unknown-documented-knob", repo.readme_path,
+        line_of.get(name, 1), key=name,
+        message=f"README documents `{name}` but the registry has no such knob "
+                "— remove the row or register the knob",
+      ))
+  return findings

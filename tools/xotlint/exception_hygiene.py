@@ -1,0 +1,87 @@
+"""exception-hygiene checker: no silent `except Exception: pass` on serving paths.
+
+Scope: orchestration/, networking/, api/, utils/ — the paths where a
+swallowed exception turns a diagnosable failure into a silent hang or a
+quietly-degraded ring. A handler is flagged when it catches `Exception` /
+`BaseException` / bare `except:` and its body is nothing but `pass` (or
+`...`): no log line, no fallback assignment, no re-raise — the reader (and
+the operator) can't distinguish "intentionally ignored, here's why" from
+"bug". A DEBUG-gated print, a narrowed exception type, or an inline
+`# xotlint: disable=exception-hygiene (reason)` all satisfy it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.xotlint.core import Finding, Repo
+
+CHECKER = "exception-hygiene"
+
+_SCOPES = ("orchestration/", "networking/", "api/", "utils/")
+_BROAD = {"Exception", "BaseException"}
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+  t = handler.type
+  if t is None:
+    return True  # bare except
+  names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+  for name in names:
+    last = name.attr if isinstance(name, ast.Attribute) else (
+      name.id if isinstance(name, ast.Name) else "")
+    if last in _BROAD:
+      return True
+  return False
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+  for stmt in handler.body:
+    if isinstance(stmt, ast.Pass):
+      continue
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+      continue  # docstring / ellipsis
+    return False
+  return True
+
+
+def _walk_scoped(node: ast.AST, scope: str):
+  """(handler, enclosing_scope) pairs, scope = dotted def/class path. The
+  scope anchors baseline identity: an unrelated handler added elsewhere in
+  the file must not renumber (and so un-grandfather) existing findings.
+  Known residual churn: adding/removing a SILENT handler earlier in the
+  same scope still shifts later ordinals — acceptable because identical
+  `except Exception: pass` bodies offer nothing else to key on, and policy
+  keeps the baseline empty anyway."""
+  for child in ast.iter_child_nodes(node):
+    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+      yield from _walk_scoped(child, f"{scope}.{child.name}" if scope else child.name)
+      continue
+    if isinstance(child, ast.ExceptHandler):
+      yield child, scope
+    yield from _walk_scoped(child, scope)
+
+
+def check(repo: Repo) -> List[Finding]:
+  findings: List[Finding] = []
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    if not any(f"/{scope}" in f"/{sf.relpath}" for scope in _SCOPES):
+      continue
+    per_scope: dict = {}
+    for node, scope in _walk_scoped(sf.tree, ""):
+      if not (_catches_broad(node) and _body_is_silent(node)):
+        continue
+      scope = scope or "<module>"
+      per_scope[scope] = per_scope.get(scope, 0) + 1
+      if sf.suppressed(node.lineno, CHECKER):
+        continue
+      findings.append(Finding(
+        checker=CHECKER, code="swallowed-exception", path=sf.relpath,
+        line=node.lineno, key=f"{scope}:{per_scope[scope]}",
+        message="`except Exception: pass` with no logged reason — log it "
+                "(DEBUG-gated is fine), narrow the type, or add "
+                "`# xotlint: disable=exception-hygiene (reason)`",
+      ))
+  return findings

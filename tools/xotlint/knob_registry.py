@@ -1,0 +1,74 @@
+"""knob-registry checker: every `XOT_*` env read must be a registered knob.
+
+Reads are found in three shapes: `os.getenv("XOT_X", ...)`,
+`os.environ.get("XOT_X", ...)` / `os.environ["XOT_X"]` (load context), and
+the typed accessors (`knobs.get_int("XOT_X")`, `raw("XOT_X")`, ...). A name
+absent from `xotorch_tpu/utils/knobs.py` is either a typo or an
+undocumented knob — both fail. Env *writes* (`os.environ["XOT_X"] = ...`)
+are not reads and pass.
+
+Two codes:
+
+- `unregistered-knob`: the read names a knob the registry doesn't know.
+- `direct-env-read`: a registered knob read via bare `os.getenv` /
+  `os.environ` outside the registry module itself — route it through the
+  typed accessors so defaults and parsing live in exactly one place.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from tools.xotlint.core import Finding, Repo, dotted_name, str_arg
+
+CHECKER = "knob-registry"
+
+_KNOB_RE = re.compile(r"^XOT_[A-Z0-9_]+$")
+_ACCESSORS = {"get_int", "get_float", "get_bool", "get_str", "raw"}
+
+
+def _registered_names(repo: Repo) -> set:
+  return set(repo.knobs_module().REGISTRY)
+
+
+def check(repo: Repo) -> List[Finding]:
+  registered = _registered_names(repo)
+  findings: List[Finding] = []
+  for sf in repo.files():
+    if sf.tree is None or sf.relpath == repo.knobs_path:
+      continue
+    for node in ast.walk(sf.tree):
+      name = None
+      direct = False
+      if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("os.getenv", "os.environ.get", "environ.get", "getenv"):
+          name, direct = str_arg(node), True
+        elif fn.rsplit(".", 1)[-1] in _ACCESSORS and (
+            "knobs" in fn or fn in _ACCESSORS):
+          name = str_arg(node)
+      elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        if dotted_name(node.value) in ("os.environ", "environ"):
+          sub = node.slice
+          if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            name, direct = sub.value, True
+      if name is None or not _KNOB_RE.match(name):
+        continue
+      if sf.suppressed(node.lineno, CHECKER):
+        continue
+      if name not in registered:
+        findings.append(Finding(
+          checker=CHECKER, code="unregistered-knob", path=sf.relpath,
+          line=node.lineno, key=name,
+          message=f"`{name}` is read here but not registered in {repo.knobs_path} "
+                  "— register it (typo'd knobs silently serve defaults forever)",
+        ))
+      elif direct:
+        findings.append(Finding(
+          checker=CHECKER, code="direct-env-read", path=sf.relpath,
+          line=node.lineno, key=name,
+          message=f"direct env read of `{name}` — use the typed accessors in "
+                  f"{repo.knobs_path} (xotorch_tpu.utils.knobs) instead",
+        ))
+  return findings

@@ -1,0 +1,240 @@
+"""metrics-consistency checker: what's incremented is what's exported.
+
+The exported surface has three layers, all parsed statically:
+
+- `NodeMetrics` registry metrics: `self.<attr> = Counter|Gauge|Histogram(
+  "xot_...", ...)` in orchestration/metrics.py — yields attr -> (name, type);
+- exposition-appended process counters in metrics.py (`("bump_key",
+  "xot_..._total", help)` tuples over `faults.COUNTERS`);
+- engine counters/gauges the API appends in chatgpt_api.py
+  (`("_attr", "xot_...", help)` tuples in handle_get_metrics), typed by
+  the `# TYPE ... counter|gauge` f-string inside the same loop.
+
+Checks:
+
+- `unknown-metric-attr`: `.inc()/.observe()/.set()` on `metrics.<attr>`
+  where NodeMetrics defines no such attr — the increment raises (or worse,
+  targets a metric that exists nowhere) at runtime;
+- `counter-name-convention`: a counter not ending `_total`, or a
+  gauge/histogram ending `_total`;
+- `unexported-counter`: a `faults.bump("key")` whose `xot_<key>_total`
+  line no NodeMetrics.exposition appends;
+- `dead-exported-counter`: an engine counter attr the API exports but no
+  engine code ever increments (`self.<attr> += ...`).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.xotlint.core import Finding, Repo, dotted_name, str_arg
+
+CHECKER = "metrics-consistency"
+
+_METRIC_NAME_RE = re.compile(r"^xot_[a-z0-9_]+$")
+_CTORS = {"Counter": "counter", "Gauge": "gauge", "Histogram": "histogram"}
+
+
+def _inner_ctor(node: ast.AST) -> Optional[Tuple[str, str]]:
+  """(metric_name, metric_type) from a `Counter("name", ...)...` chain."""
+  for call in ast.walk(node):
+    if isinstance(call, ast.Call):
+      fn = dotted_name(call.func).rsplit(".", 1)[-1]
+      if fn in _CTORS:
+        name = str_arg(call)
+        if name is not None:
+          return name, _CTORS[fn]
+  return None
+
+
+def registry_metrics(repo: Repo) -> Dict[str, Tuple[str, str]]:
+  """attr -> (metric_name, metric_type) from NodeMetrics.__init__."""
+  sf = repo.file(repo.metrics_path)
+  out: Dict[str, Tuple[str, str]] = {}
+  if sf is None or sf.tree is None:
+    return out
+  for node in ast.walk(sf.tree):
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+      target = node.targets[0]
+      if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+          and target.value.id == "self":
+        ctor = _inner_ctor(node.value)
+        if ctor is not None:
+          out[target.attr] = ctor
+  return out
+
+
+def _tuple_table(tree: ast.AST) -> List[Tuple[ast.For, List[Tuple[str, str, int]]]]:
+  """For-loops iterating literal ((key, "xot_name", help), ...) tables:
+  [(loop, [(key, metric_name, line), ...]), ...]."""
+  out = []
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.For):
+      continue
+    rows: List[Tuple[str, str, int]] = []
+    for tup in ast.walk(node.iter):
+      if isinstance(tup, ast.Tuple) and len(tup.elts) >= 2:
+        first, second = tup.elts[0], tup.elts[1]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+            and isinstance(second, ast.Constant) and isinstance(second.value, str) \
+            and _METRIC_NAME_RE.match(second.value):
+          rows.append((first.value, second.value, tup.lineno))
+    if rows:
+      out.append((node, rows))
+  return out
+
+
+def _loop_metric_type(loop: ast.For) -> Optional[str]:
+  """counter/gauge from the `# TYPE {name} counter` f-string in the body.
+  F-strings split their literal text across Constant pieces, so join each
+  JoinedStr before matching."""
+  texts = []
+  for node in ast.walk(loop):
+    if isinstance(node, ast.JoinedStr):
+      texts.append("".join(
+        v.value for v in node.values
+        if isinstance(v, ast.Constant) and isinstance(v.value, str)))
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+      texts.append(node.value)
+  for text in texts:
+    if "TYPE" in text and " counter" in text:
+      return "counter"
+    if "TYPE" in text and " gauge" in text:
+      return "gauge"
+  return None
+
+
+def exported_metrics(repo: Repo) -> Dict[str, str]:
+  """metric_name -> type across the whole exported surface."""
+  exported: Dict[str, str] = {}
+  for attr, (name, mtype) in registry_metrics(repo).items():
+    exported[name] = mtype
+  for path in (repo.metrics_path, repo.api_metrics_path):
+    sf = repo.file(path)
+    if sf is None or sf.tree is None:
+      continue
+    for loop, rows in _tuple_table(sf.tree):
+      mtype = _loop_metric_type(loop) or "counter"
+      for _, name, _ in rows:
+        exported[name] = mtype
+  return exported
+
+
+def _bump_sites(repo: Repo) -> List[Tuple[str, str, int]]:
+  """(key, path, line) for every faults.bump("key") call."""
+  sites = []
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    for node in ast.walk(sf.tree):
+      if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn == "bump" or fn.endswith(".bump"):
+          key = str_arg(node)
+          if key is not None:
+            sites.append((key, sf.relpath, node.lineno))
+  return sites
+
+
+def _metrics_attr_calls(repo: Repo) -> List[Tuple[str, str, str, int]]:
+  """(attr, method, path, line) for `<x>.metrics.<attr>.inc/observe/set(...)`."""
+  calls = []
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    for node in ast.walk(sf.tree):
+      if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+          and node.func.attr in ("inc", "observe", "set", "dec"):
+        chain = dotted_name(node.func)
+        parts = chain.split(".")
+        if len(parts) >= 3 and parts[-3] == "metrics":
+          calls.append((parts[-2], node.func.attr, sf.relpath, node.lineno))
+  return calls
+
+
+def _engine_aug_attrs(repo: Repo) -> Set[str]:
+  """self.<attr> names actually INCREMENTED anywhere in the tree: `+=`, or
+  an assignment whose RHS reads the same attr (`x.a = x.a + n`). A plain
+  initialization (`self._oom_count = 0`) is not an increment — counting it
+  would let a counter whose only remaining reference is its __init__ zero
+  keep passing the dead-exported-counter check forever."""
+  attrs: Set[str] = set()
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    for node in ast.walk(sf.tree):
+      if isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Attribute):
+        attrs.add(node.target.attr)
+      elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+          and isinstance(node.targets[0], ast.Attribute):
+        attr = node.targets[0].attr
+        if any(isinstance(n, ast.Attribute) and n.attr == attr
+               for n in ast.walk(node.value)):
+          attrs.add(attr)
+  return attrs
+
+
+def check(repo: Repo) -> List[Finding]:
+  findings: List[Finding] = []
+  reg = registry_metrics(repo)
+  exported = exported_metrics(repo)
+
+  # Name conventions across the whole exported surface.
+  metrics_sf = repo.file(repo.metrics_path)
+  for name, mtype in sorted(exported.items()):
+    is_counter_name = name.endswith("_total")
+    if mtype == "counter" and not is_counter_name:
+      findings.append(Finding(
+        CHECKER, "counter-name-convention", repo.metrics_path, 1, key=name,
+        message=f"counter `{name}` must end in `_total` (prometheus counter convention)",
+      ))
+    elif mtype in ("gauge", "histogram") and is_counter_name:
+      findings.append(Finding(
+        CHECKER, "counter-name-convention", repo.metrics_path, 1, key=name,
+        message=f"{mtype} `{name}` must not end in `_total` — that suffix promises a counter",
+      ))
+
+  # Every metrics.<attr> touch resolves to a NodeMetrics attribute.
+  for attr, method, path, line in _metrics_attr_calls(repo):
+    sf = repo.file(path)
+    if sf is not None and sf.suppressed(line, CHECKER):
+      continue
+    if attr not in reg:
+      findings.append(Finding(
+        CHECKER, "unknown-metric-attr", path, line, key=f"{attr}.{method}",
+        message=f"`metrics.{attr}.{method}()` but NodeMetrics defines no `{attr}` "
+                "— this raises AttributeError on the serving path",
+      ))
+
+  # Every bump("key") is exported as xot_<key>_total by the exposition.
+  exposition_names = set(exported)
+  for key, path, line in _bump_sites(repo):
+    sf = repo.file(path)
+    if sf is not None and sf.suppressed(line, CHECKER):
+      continue
+    want = f"xot_{key}_total"
+    if want not in exposition_names:
+      findings.append(Finding(
+        CHECKER, "unexported-counter", path, line, key=key,
+        message=f"`bump(\"{key}\")` increments a process counter but "
+                f"NodeMetrics.exposition never appends `{want}` — the count is invisible",
+      ))
+
+  # Engine counters the API exports must be incremented somewhere.
+  api_sf = repo.file(repo.api_metrics_path)
+  if api_sf is not None and api_sf.tree is not None:
+    incremented = _engine_aug_attrs(repo)
+    for loop, rows in _tuple_table(api_sf.tree):
+      if (_loop_metric_type(loop) or "counter") != "counter":
+        continue
+      for attr, name, line in rows:
+        if api_sf.suppressed(line, CHECKER):
+          continue
+        if attr.startswith("_") and attr not in incremented:
+          findings.append(Finding(
+            CHECKER, "dead-exported-counter", repo.api_metrics_path, line, key=name,
+            message=f"API exports `{name}` from engine attr `{attr}` but nothing "
+                    "in the tree increments that attr — stale exposition row",
+          ))
+  return findings

@@ -22,6 +22,7 @@ from aiohttp import web
 from xotorch_tpu.inference.engine import inference_engine_classes
 from xotorch_tpu.inference.tokenizers import resolve_tokenizer
 from xotorch_tpu.models.registry import build_base_shard, get_model_card, get_repo, model_cards, pretty_name
+from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
 
 WEB_DIR = Path(__file__).parent.parent / "tinychat"
@@ -661,8 +662,8 @@ class ChatGPTAPI:
     # Non-streaming only: an SSE stream may have already emitted content
     # chunks the restart would contradict. Deadline-respecting: no restart
     # once XOT_REQUEST_DEADLINE_S of wall time is spent.
-    restart_budget = 0 if stream else max(0, int(os.getenv("XOT_REQUEST_RESTARTS", "0") or 0))
-    deadline_s = float(os.getenv("XOT_REQUEST_DEADLINE_S", "0") or 0)
+    restart_budget = 0 if stream else max(0, knobs.get_int("XOT_REQUEST_RESTARTS"))
+    deadline_s = knobs.get_float("XOT_REQUEST_DEADLINE_S")
     t0 = time.monotonic()
     base_request_id = request_id
     all_rids: List[str] = []
@@ -713,8 +714,9 @@ class ChatGPTAPI:
         # no-op.
         try:
           await self.node.cancel_request(rid)
-        except Exception:
-          pass
+        except Exception as e:
+          if DEBUG >= 1:
+            print(f"[{rid}] post-response cancel failed: {e!r}")
 
   @staticmethod
   def _restartable(error: str) -> bool:
@@ -739,8 +741,10 @@ class ChatGPTAPI:
       try:
         local = await self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname)
         return await resolve_tokenizer(local)
-      except Exception:
-        pass
+      except Exception as e:
+        # Fall through to resolving from the hub repo id below.
+        if DEBUG >= 1:
+          print(f"local tokenizer resolve for {model} failed ({e!r}); trying {target}")
     return await resolve_tokenizer(target)
 
   def _delta_tokens(self, request_id: str, tokens: List[int]) -> List[int]:
@@ -821,7 +825,7 @@ class ChatGPTAPI:
           await merged.put((idx, rid, payload, fin))
           if fin:
             return
-      return asyncio.create_task(run())
+      return spawn_detached(run())
 
     pumps = [_pump(i, rid) for i, rid in enumerate(request_ids)]
     try:

@@ -24,11 +24,12 @@ from xotorch_tpu.download.download_progress import RepoFileProgressEvent, RepoPr
 from xotorch_tpu.download.shard_download import ShardDownloader
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.models.registry import get_model_card, get_repo
-from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem
+from xotorch_tpu.utils import knobs
+from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem, spawn_detached
 
 
 def xot_home() -> Path:
-  return Path(os.getenv("XOT_HOME", Path.home() / ".xot_tpu"))
+  return Path(knobs.get_str("XOT_HOME", None) or (Path.home() / ".xot_tpu"))
 
 
 def models_dir() -> Path:
@@ -131,7 +132,7 @@ class HFShardDownloader(ShardDownloader):
       return self.completed[key]
     if key in self.active_downloads:
       return await asyncio.shield(self.active_downloads[key])
-    task = asyncio.create_task(self._download_shard(shard, inference_engine_name))
+    task = spawn_detached(self._download_shard(shard, inference_engine_name))
     self.active_downloads[key] = task
     try:
       path = await asyncio.shield(task)
@@ -231,7 +232,9 @@ class HFShardDownloader(ShardDownloader):
         mode = "ab" if downloaded and resp.status == 206 else "wb"
         if mode == "wb":
           downloaded = 0
-        with open(partial_path, mode) as f:
+        # Page-cache writes of 1 MiB chunks between awaited network reads:
+        # the loop never waits on disk in practice.
+        with open(partial_path, mode) as f:  # xotlint: disable=async-safety (buffered chunk writes)
           async for chunk in resp.content.iter_chunked(1024 * 1024):
             f.write(chunk)
             downloaded += len(chunk)
@@ -240,16 +243,22 @@ class HFShardDownloader(ShardDownloader):
             self._emit(repo_id, progress, shard, started, total_files=None)
         # Hash-verify when the etag is a content hash (parity :141-168).
         if etag and len(etag) in (40, 64) and all(c in "0123456789abcdef" for c in etag.lower()):
-          algo = hashlib.sha1 if len(etag) == 40 else hashlib.sha256
-          h = algo()
-          if len(etag) == 40:  # git blob sha1
-            h.update(f"blob {partial_path.stat().st_size}\0".encode())
-          with open(partial_path, "rb") as f:
-            for block in iter(lambda: f.read(1024 * 1024), b""):
-              h.update(block)
-          if h.hexdigest() != etag:
+          def _verify_hash() -> str:
+            # Runs in an executor: hashing a multi-GB checkpoint shard
+            # would otherwise block the event loop (and every concurrent
+            # download's progress) for seconds.
+            algo = hashlib.sha1 if len(etag) == 40 else hashlib.sha256
+            h = algo()
+            if len(etag) == 40:  # git blob sha1
+              h.update(f"blob {partial_path.stat().st_size}\0".encode())
+            with open(partial_path, "rb") as f:
+              for block in iter(lambda: f.read(1024 * 1024), b""):
+                h.update(block)
+            return h.hexdigest()
+          digest = await asyncio.get_running_loop().run_in_executor(None, _verify_hash)
+          if digest != etag:
             partial_path.unlink(missing_ok=True)
-            raise ValueError(f"Hash mismatch for {file_path}: {h.hexdigest()} != {etag}")
+            raise ValueError(f"Hash mismatch for {file_path}: {digest} != {etag}")
     if partial_path.exists():
       partial_path.rename(out_path)
     progress[file_path] = RepoFileProgressEvent(repo_id, file_path, total, total, 0, "complete")

@@ -48,8 +48,8 @@ class LocalShardDownloader(ShardDownloader):
     for mid in (shard.model_id, split_adapter(shard.model_id)[0]):
       if mid in self.mapping:
         return self.mapping[mid]
-      import os
-      root = os.getenv("XOT_MODEL_DIR")
+      from xotorch_tpu.utils import knobs
+      root = knobs.get_str("XOT_MODEL_DIR", None)
       if root and (Path(root) / mid).exists():
         return Path(root) / mid
     raise FileNotFoundError(f"No local model dir for {shard.model_id}")

@@ -37,6 +37,7 @@ from xotorch_tpu.inference.engine import InferenceEngine
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.inference.tokenizers import DummyTokenizer, resolve_tokenizer
 from xotorch_tpu.ops.sampling import DEFAULT_TEMP, DEFAULT_TOP_K
+from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG
 
 _REPO_ROOT = Path(__file__).resolve().parents[3]
@@ -45,7 +46,7 @@ _DEFAULT_BINARY = _REPO_ROOT / "native" / "build" / "xot-sidecar"
 
 def ensure_sidecar_binary() -> Path:
   """Locate (or build via make) the sidecar binary."""
-  env = os.getenv("XOT_SIDECAR_BIN")
+  env = knobs.get_str("XOT_SIDECAR_BIN", None)
   if env:
     p = Path(env)
     if not p.exists():
@@ -94,7 +95,9 @@ class SidecarClient:
     cmd = [str(binary), "--socket", socket_path]
     if threads:
       cmd += ["--threads", str(threads)]
-    proc = subprocess.Popen(cmd, stderr=subprocess.DEVNULL if DEBUG < 2 else None)
+    # Fork+exec of the sidecar binary: sub-millisecond, once per engine —
+    # not worth an executor round-trip.
+    proc = subprocess.Popen(cmd, stderr=subprocess.DEVNULL if DEBUG < 2 else None)  # xotlint: disable=async-safety (one-shot spawn)
     client = cls(socket_path, proc)
     deadline = time.monotonic() + 15.0
     while time.monotonic() < deadline:
@@ -162,9 +165,9 @@ class NativeSidecarInferenceEngine(InferenceEngine):
     self.tokenizer = None
     self.client: Optional[SidecarClient] = None
     self._threads = threads
-    self._cache_len = int(os.getenv("XOT_CACHE_LEN", "2048"))
+    self._cache_len = knobs.get_int("XOT_CACHE_LEN")
     self._shard_lock = asyncio.Lock()
-    self._rng = np.random.default_rng(int(os.getenv("XOT_SEED", str(int(time.time())))))
+    self._rng = np.random.default_rng(knobs.get_int("XOT_SEED", int(time.time())))
     self._model_dir: Optional[Path] = None
     self._is_last = False
 

@@ -28,6 +28,7 @@ from xotorch_tpu.networking.grpc.peer_handle import GRPCPeerHandle
 from xotorch_tpu.networking.grpc.server import GRPCServer
 from xotorch_tpu.orchestration.node import Node
 from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import (
   DEBUG,
   find_available_port,
@@ -382,9 +383,10 @@ def run() -> None:
   # XOT_PLATFORM=cpu|tpu pins the JAX platform even when a site hook
   # pre-registered another backend (env JAX_PLATFORMS can be overridden by
   # such hooks; the config update after import cannot).
-  if os.getenv("XOT_PLATFORM"):
+  platform = knobs.get_str("XOT_PLATFORM", None)
+  if platform:
     import jax
-    jax.config.update("jax_platforms", os.environ["XOT_PLATFORM"])
+    jax.config.update("jax_platforms", platform)
   args = build_parser().parse_args()
   try:
     asyncio.run(async_main(args))

@@ -208,8 +208,8 @@ def load_model_config(model_dir: Path, max_seq_len_override: Optional[int] = Non
   parity: llm_utils.py:120-122)."""
   with open(Path(model_dir) / "config.json") as f:
     cfg = config_from_hf_dict(json.load(f))
-  import os
-  override = max_seq_len_override or (int(os.environ["XOT_MAX_SEQ_LEN"]) if os.getenv("XOT_MAX_SEQ_LEN") else None)
+  from xotorch_tpu.utils import knobs
+  override = max_seq_len_override or knobs.get_int("XOT_MAX_SEQ_LEN", None)
   if override:
     cfg = replace(cfg, max_seq_len=min(cfg.max_seq_len, override))
   return cfg

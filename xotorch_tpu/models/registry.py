@@ -151,9 +151,9 @@ def registered_adapters() -> Dict[str, str]:
   name2=/dir') as {name: path}. The ONE parser — the API's model listing
   and the engine's resolution must agree on what counts as registered
   (whitespace-tolerant; empty names dropped)."""
-  import os
+  from xotorch_tpu.utils import knobs
   out: Dict[str, str] = {}
-  for entry in os.getenv("XOT_ADAPTERS", "").split(","):
+  for entry in knobs.get_str("XOT_ADAPTERS", "").split(","):
     key, sep, path = entry.partition("=")
     key, path = key.strip(), path.strip()
     if sep and key and path:

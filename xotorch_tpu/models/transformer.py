@@ -21,7 +21,6 @@ llm_utils.py:502-590).
 """
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -30,6 +29,7 @@ import jax.numpy as jnp
 
 from xotorch_tpu.models.config import ModelConfig
 from xotorch_tpu.ops.attention import gqa_attention
+from xotorch_tpu.utils import knobs
 from xotorch_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = Dict[str, Any]
@@ -62,7 +62,7 @@ def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
     # int4 group-wise: w is PACKED uint8 [G, gs/2, out] (two nibbles per
     # byte — models/quantize.pack_int4), gscale [G, out].
     B, T, _ = h.shape
-    k4 = os.getenv("XOT_INT4_KERNEL", "1")
+    k4 = knobs.get_str("XOT_INT4_KERNEL")
     if B * T <= 8 and (k4 == "force" or (k4 != "0" and jax.default_backend() == "tpu")):
       # Decode hot path ON REAL TPU: Pallas kernel (ops/int4_matmul.py)
       # unpacks the nibbles IN REGISTERS between the packed-tile read and
@@ -90,7 +90,7 @@ def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
   if scale is None:
     return h @ w
   B, T, _ = h.shape
-  k8 = os.getenv("XOT_INT8_KERNEL", "0")
+  k8 = knobs.get_str("XOT_INT8_KERNEL")
   if B * T <= 8 and (k8 == "force" or (k8 == "1" and jax.default_backend() == "tpu")):
     # Opt-in W8A8 decode path (ops/int8_matmul.py): the MXU consumes int8
     # weights directly (int32 accumulate) instead of the VPU running

@@ -27,9 +27,10 @@ from __future__ import annotations
 
 import asyncio
 import json
-import os
 import random
 from typing import Optional
+
+from xotorch_tpu.utils import knobs
 
 
 class TransientHopError(Exception):
@@ -47,11 +48,11 @@ def bump(name: str, n: int = 1) -> None:
 
 
 def hop_retries() -> int:
-  return max(0, int(os.getenv("XOT_HOP_RETRIES", "0") or 0))
+  return max(0, knobs.get_int("XOT_HOP_RETRIES"))
 
 
 def hop_backoff_s() -> float:
-  return max(0.0, float(os.getenv("XOT_HOP_BACKOFF_S", "0.05") or 0))
+  return max(0.0, knobs.get_float("XOT_HOP_BACKOFF_S"))
 
 
 def is_transient(exc: BaseException) -> bool:
@@ -180,7 +181,7 @@ def active() -> Optional[FaultInjector]:
   global _env_spec, _env_injector
   if _installed is not None:
     return _installed
-  spec = os.getenv("XOT_FAULT_SPEC")
+  spec = knobs.get_str("XOT_FAULT_SPEC", None)
   if not spec:
     # Drop the cache when the var is unset: re-setting the SAME spec later
     # must yield a fresh injector, not one with spent rule counters and

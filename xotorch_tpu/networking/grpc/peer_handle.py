@@ -81,8 +81,11 @@ class GRPCPeerHandle(PeerHandle):
         defunct, self.channel, self._stubs = self.channel, None, {}
         try:
           await defunct.close()
-        except Exception:
-          pass
+        except Exception as e:
+          # Best-effort: the channel is already defunct; a close error must
+          # not block creating its replacement below.
+          if DEBUG >= 2:
+            print(f"closing defunct channel to {self.address} failed: {e!r}")
     if self.channel is None:
       self.channel = grpc.aio.insecure_channel(
         self.address, options=CHANNEL_OPTIONS, compression=grpc.Compression.Gzip

@@ -14,7 +14,7 @@ from xotorch_tpu.networking.discovery import Discovery
 from xotorch_tpu.networking.manual.network_topology_config import NetworkTopology
 from xotorch_tpu.networking.peer_handle import PeerHandle
 from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
-from xotorch_tpu.utils.helpers import DEBUG_DISCOVERY
+from xotorch_tpu.utils.helpers import DEBUG_DISCOVERY, spawn_detached
 
 
 class ManualDiscovery(Discovery):
@@ -35,7 +35,7 @@ class ManualDiscovery(Discovery):
     self._task: Optional[asyncio.Task] = None
 
   async def start(self) -> None:
-    self._task = asyncio.create_task(self._poll_loop())
+    self._task = spawn_detached(self._poll_loop())
 
   async def stop(self) -> None:
     if self._task is not None:

@@ -120,9 +120,9 @@ class UDPDiscovery(Discovery):
       from xotorch_tpu.topology import device_capabilities as probe
       self.device_capabilities = await probe()
     self._tasks = [
-      asyncio.create_task(self._broadcast_presence()),
-      asyncio.create_task(self._listen_for_peers()),
-      asyncio.create_task(self._cleanup_peers()),
+      spawn_detached(self._broadcast_presence()),
+      spawn_detached(self._listen_for_peers()),
+      spawn_detached(self._cleanup_peers()),
     ]
 
   async def stop(self) -> None:
@@ -255,8 +255,9 @@ class UDPDiscovery(Discovery):
       if disconnect is not None:
         try:
           await disconnect()
-        except Exception:
-          pass
+        except Exception as e:
+          if DEBUG_DISCOVERY >= 2:
+            print(f"closing unadmitted handle for {peer_id} failed: {e!r}")
       return
     if replacing is not None:
       try:
@@ -265,8 +266,9 @@ class UDPDiscovery(Discovery):
         # step or a slow first hop compiles for tens of seconds) — the old
         # channel drains detached while new calls use the new handle.
         await replacing.disconnect(grace=600.0)
-      except Exception:
-        pass
+      except Exception as e:
+        if DEBUG_DISCOVERY >= 1:
+          print(f"graceful drain of replaced channel for {peer_id} failed: {e!r}")
     self.known_peers[peer_id] = (handle, message.get("interface_name", "?"), time.time(), priority)
     if DEBUG_DISCOVERY >= 1:
       print(f"Discovered peer {peer_id}@{host}:{port} prio={priority}")

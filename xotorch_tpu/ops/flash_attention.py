@@ -198,11 +198,11 @@ def flash_attention(
   128x128) — the prefill-MFU tuning knob (VERDICT r3 #5); read at trace
   time, so set them before the engine compiles its executables.
   """
-  import os
+  from xotorch_tpu.utils import knobs
   if block_q is None:
-    block_q = max(1, int(os.getenv("XOT_FLASH_BLOCK_Q", "128") or 128))
+    block_q = max(1, knobs.get_int("XOT_FLASH_BLOCK_Q"))
   if block_k is None:
-    block_k = max(1, int(os.getenv("XOT_FLASH_BLOCK_K", "128") or 128))
+    block_k = max(1, knobs.get_int("XOT_FLASH_BLOCK_K"))
   B, T, Hq, D = q.shape
   Hkv = k.shape[2]
   groups = Hq // Hkv

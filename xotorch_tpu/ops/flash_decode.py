@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -223,10 +222,11 @@ def flash_cached_attention(
   S, Hkv = k.shape[1], k.shape[2]
   groups = Hq // Hkv
   quant = k_scale is not None
+  from xotorch_tpu.utils import knobs
   if block_q is None:
-    block_q = max(1, int(os.getenv("XOT_FD_BLOCK_Q", "128") or 128))
+    block_q = max(1, knobs.get_int("XOT_FD_BLOCK_Q"))
   if block_k is None:
-    block_k = max(1, int(os.getenv("XOT_FD_BLOCK_K", "256") or 256))
+    block_k = max(1, knobs.get_int("XOT_FD_BLOCK_K"))
   # Halve block sizes until they divide the actual T/S: cache lengths are
   # usually powers of two, but XOT_MAX_CACHE_LEN / cfg.max_seq_len clamps can
   # produce odd sizes — degrade block size instead of crashing the hot path.

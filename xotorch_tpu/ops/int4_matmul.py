@@ -172,8 +172,8 @@ def int4_grouped_matmul(
   choice is baked at that outer trace — set XOT_INT4_V before first use.
   """
   if variant is None:
-    import os
-    variant = int(os.getenv("XOT_INT4_V", "1"))
+    from xotorch_tpu.utils import knobs
+    variant = knobs.get_int("XOT_INT4_V")
   return _int4_grouped_matmul_impl(h, w_packed, gscale, block_out=block_out,
                                    interpret=interpret, variant=variant)
 

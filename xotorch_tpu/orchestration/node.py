@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import os
 import time
 import uuid
 from collections import OrderedDict
@@ -43,6 +42,7 @@ from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitio
 from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
 from xotorch_tpu.orchestration.metrics import NodeMetrics
 from xotorch_tpu.topology.topology import Topology
+from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem, spawn_detached
 
 # inference_state side-channel key carrying the per-request completion cap to
@@ -83,7 +83,7 @@ RING_MAP_KEY = "xot_ring_map"
 DEADLINE_KEY = "xot_deadline_s"
 
 
-_DRAFT_SCAN_WINDOW = int(os.getenv("XOT_SPECULATE_WINDOW", "2048"))
+_DRAFT_SCAN_WINDOW = knobs.get_int("XOT_SPECULATE_WINDOW")
 
 
 def _lookup_draft(context: List[int], k: int) -> List[int]:
@@ -147,7 +147,7 @@ class Node:
     # and the EOS overshoot (tokens computed past EOS are discarded).
     self.decode_chunk_size = (
       decode_chunk_size if decode_chunk_size is not None
-      else int(os.getenv("XOT_DECODE_CHUNK", "8"))
+      else knobs.get_int("XOT_DECODE_CHUNK")
     )
     # Adaptive growth ceiling: each fused dispatch doubles the chunk up to
     # this cap, so long generations amortise the per-dispatch host sync
@@ -155,7 +155,7 @@ class Node:
     # streaming latency and short replies never overshoot far past EOS.
     # Power-of-two ladder => bounded executable count per (B, size) pair.
     self.max_decode_chunk_size = max(
-      self.decode_chunk_size, int(os.getenv("XOT_DECODE_CHUNK_MAX", "64"))
+      self.decode_chunk_size, knobs.get_int("XOT_DECODE_CHUNK_MAX")
     )
 
     self.peers: List[PeerHandle] = []
@@ -218,8 +218,8 @@ class Node:
     # proposes every round (engine.draft_tokens) where prompt-lookup only
     # fires on n-gram repeats. Setting a draft model implies speculation on
     # (default 8 draft tokens; XOT_SPECULATE still overrides the depth).
-    self.draft_model = os.getenv("XOT_DRAFT_MODEL", "")
-    self.speculate_tokens = int(os.getenv("XOT_SPECULATE", "8" if self.draft_model else "0"))
+    self.draft_model = knobs.get_str("XOT_DRAFT_MODEL", "")
+    self.speculate_tokens = knobs.get_int("XOT_SPECULATE", 8 if self.draft_model else 0)
     # Strong refs to detached tasks (hops, fused loops, broadcasts): the
     # event loop holds tasks only weakly — a GC'd generation-driving task
     # would silently stall its request with no error.
@@ -228,19 +228,19 @@ class Node:
     # ---- request survivability (deadlines, watchdog, eviction) ----
     # End-to-end request deadline (0 disables); remaining budget rides the
     # hops (DEADLINE_KEY / send_prompt's deadline field).
-    self.request_deadline_s = float(os.getenv("XOT_REQUEST_DEADLINE_S", "0") or 0)
+    self.request_deadline_s = knobs.get_float("XOT_REQUEST_DEADLINE_S")
     # Stall watchdog: abort any request whose last observed progress (hop
     # received / token sampled / broadcast delta applied) is older than
     # this (0 disables) — a peer that dies AFTER acking a tensor otherwise
     # stalls the request forever with no error anywhere.
-    self.stall_timeout_s = float(os.getenv("XOT_STALL_TIMEOUT_S", "0") or 0)
+    self.stall_timeout_s = knobs.get_float("XOT_STALL_TIMEOUT_S")
     # Periodic peer health monitor (0 disables): a peer failing
     # XOT_HEALTH_FAILS consecutive checks is evicted and the topology
     # repartitioned; eviction holds for XOT_EVICT_COOLDOWN_S so discovery
     # can't immediately re-admit a corpse.
-    self.health_interval_s = float(os.getenv("XOT_HEALTH_INTERVAL_S", "0") or 0)
-    self.health_fail_threshold = max(1, int(os.getenv("XOT_HEALTH_FAILS", "2") or 2))
-    self.evict_cooldown_s = float(os.getenv("XOT_EVICT_COOLDOWN_S", "30") or 30)
+    self.health_interval_s = knobs.get_float("XOT_HEALTH_INTERVAL_S")
+    self.health_fail_threshold = max(1, knobs.get_int("XOT_HEALTH_FAILS"))
+    self.evict_cooldown_s = knobs.get_float("XOT_EVICT_COOLDOWN_S")
     self._request_deadline: Dict[str, float] = {}
     self._last_progress: Dict[str, float] = {}
     # Receiver-side hop dedup: per-request bounded seen-sets of hop seq ids
@@ -262,7 +262,7 @@ class Node:
     await self.discovery.start()
     await self.update_peers(wait_for_peers)
     await self.collect_topology(set())
-    self._topology_task = asyncio.create_task(self.periodic_topology_collection(topology_interval))
+    self._topology_task = self._spawn(self.periodic_topology_collection(topology_interval))
     self.start_watchdog()
     self.start_health_monitor()
     if DEBUG >= 1:
@@ -413,14 +413,16 @@ class Node:
     self.metrics.peers.set(len(self.peers))
     try:
       await peer.disconnect()
-    except Exception:
-      pass
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"evicted peer {peer.id()} disconnect failed (already dead?): {e!r}")
     try:
       # Repartition NOW: the dead peer must leave the partition table before
       # any new (or restarted) request pins its ring map.
       await self.collect_topology(set())
-    except Exception:
-      pass
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"post-eviction repartition failed (next periodic sweep retries): {e!r}")
 
   def _is_evicted(self, peer_id: str) -> bool:
     until = self._evicted_until.get(peer_id)
@@ -440,8 +442,9 @@ class Node:
     await self._health_sweep(evict_after=1)
     try:
       await self.collect_topology(set())
-    except Exception:
-      pass
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"heal_ring repartition failed (restart will pin the stale map): {e!r}")
 
   # ----------------------------------------------------------- status bus
 
@@ -620,8 +623,11 @@ class Node:
         toks = await self.inference_engine.encode(shard, prompt)
         inference_state = {**(inference_state or {}),
                            PROMPT_TOKENS_KEY: [int(t) for t in np.asarray(toks).reshape(-1)]}
-      except Exception:
-        pass  # speculation degrades to output-only drafting
+      except Exception as e:
+        # Speculation degrades to output-only drafting; the request itself
+        # is unaffected, but log why draft acceptance just dropped.
+        if DEBUG >= 1:
+          print(f"[{request_id}] prompt tokenize for speculation failed: {e!r}")
     await self.process_inference_result(base_shard, result, request_id, inference_state)
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
@@ -736,8 +742,11 @@ class Node:
     self.trigger_on_token_callbacks(request_id, tokens, True)
     try:
       await self.broadcast_result(request_id, tokens, True, error=error)
-    except Exception:
-      pass
+    except Exception as e:
+      # Abort-path broadcast: peers that answered are cleaned up, the dead
+      # one is why we're here — local finish below must still run.
+      if DEBUG >= 1:
+        print(f"[{request_id}] abort broadcast partially failed: {e!r}")
     await self._finish_generation(request_id)
 
   async def cancel_request(self, request_id: str) -> None:
@@ -771,8 +780,9 @@ class Node:
     self.trigger_on_token_callbacks(request_id, tokens, True)
     try:
       await self.broadcast_result(request_id, tokens, True)
-    except Exception:
-      pass
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"[{request_id}] length-finish broadcast partially failed: {e!r}")
     await self._finish_generation(request_id)
 
   def record_request_error(self, request_id: str, error: str) -> None:
@@ -1202,8 +1212,10 @@ class Node:
         # rather than silently disabling EOS detection.
         if ids:
           return ids
-      except Exception:
-        pass
+      except Exception as e:
+        # Fall through to the engine-level tokenizer lookup below.
+        if DEBUG >= 2:
+          print(f"per-shard EOS lookup failed ({e!r}); using engine tokenizer")
     tokenizer = getattr(self.inference_engine, "tokenizer", None)
     eos = getattr(tokenizer, "eos_token_id", None) if tokenizer else None
     cfg = getattr(self.inference_engine, "cfg", None)
@@ -1629,8 +1641,11 @@ class Node:
     if self.topology_viz is not None:
       try:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
-      except Exception:
-        pass
+      except Exception as e:
+        # Viz is cosmetic; a TUI paint error must never break topology
+        # collection — but don't hide it from whoever is debugging the TUI.
+        if DEBUG >= 2:
+          print(f"topology viz update failed: {e!r}")
     return next_topology
 
   async def select_best_inference_engine(self) -> None:

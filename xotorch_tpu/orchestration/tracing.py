@@ -20,13 +20,14 @@ On-TPU device traces: `start_device_trace`/`stop_device_trace` wrap
 """
 from __future__ import annotations
 
-import os
 import secrets
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from xotorch_tpu.utils import knobs
 
 TRACEPARENT_KEY = "traceparent"
 _TOKEN_GROUP_SIZE = 10  # parity: reference tracing.py:72-103
@@ -113,7 +114,7 @@ class Tracer:
 
   def __init__(self, node_id: str = "", max_spans: int = 4096):
     self.node_id = node_id
-    self.enabled = os.getenv("XOT_TRACING", "1") == "1"
+    self.enabled = knobs.get_bool("XOT_TRACING")
     self._finished: deque = deque(maxlen=max_spans)
     self._lock = threading.Lock()
     self._token_groups: Dict[str, Span] = {}

@@ -28,15 +28,16 @@ Simulatable without hardware: two CPU processes with crossed env vars form a
 """
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
+
+from xotorch_tpu.utils import knobs
 
 
 def multihost_requested() -> bool:
   """The seam turns on explicitly — via a coordinator address or the
   TPU-metadata self-discovery flag — never implicitly (a dev laptop must not
   hang waiting for a phantom coordinator)."""
-  return bool(os.getenv("XOT_COORDINATOR")) or os.getenv("XOT_MULTIHOST") == "1"
+  return bool(knobs.get_str("XOT_COORDINATOR", None)) or knobs.get_bool("XOT_MULTIHOST")
 
 
 def init_multihost() -> Tuple[int, int]:
@@ -48,12 +49,12 @@ def init_multihost() -> Tuple[int, int]:
   if getattr(init_multihost, "_done", False):
     return jax.process_count(), jax.process_index()
 
-  coordinator = os.getenv("XOT_COORDINATOR")
+  coordinator = knobs.get_str("XOT_COORDINATOR", None)
   if coordinator:
     jax.distributed.initialize(
       coordinator_address=coordinator,
-      num_processes=int(os.environ["XOT_NUM_PROCESSES"]),
-      process_id=int(os.environ["XOT_PROCESS_ID"]),
+      num_processes=knobs.get_int("XOT_NUM_PROCESSES"),
+      process_id=knobs.get_int("XOT_PROCESS_ID"),
     )
   else:
     # XOT_MULTIHOST=1 on a real TPU pod: every argument self-discovers from

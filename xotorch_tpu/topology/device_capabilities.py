@@ -19,6 +19,7 @@ import re
 from dataclasses import dataclass, field, asdict
 from typing import Any, Dict, List, Optional
 
+from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG
 
 TFLOPS = 1.00
@@ -471,7 +472,7 @@ async def device_capabilities() -> DeviceCapabilities:
   global _cached_capabilities, _probe_future
   if _cached_capabilities is not None:
     return _cached_capabilities
-  timeout = float(os.getenv("XOT_PROBE_TIMEOUT", "120"))
+  timeout = knobs.get_float("XOT_PROBE_TIMEOUT")
   loop = asyncio.get_running_loop()
   if _probe_future is None:
     # Single in-flight probe on a DAEMON thread: JAX backend init is not
@@ -517,7 +518,7 @@ def device_capabilities_sync() -> DeviceCapabilities:
   as the reference's windows_device_capabilities (cuda -> amd -> cpu); the
   host probe names the OS."""
   caps = None
-  skip_accel = os.getenv("XOT_SKIP_JAX_PROBE", "0") == "1"
+  skip_accel = knobs.get_bool("XOT_SKIP_JAX_PROBE")
   if not skip_accel:
     caps = _probe_jax_sync()
     if caps is None:

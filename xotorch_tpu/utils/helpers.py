@@ -11,9 +11,12 @@ import asyncio
 import os
 import random
 import socket
+import sys
 import tempfile
 import uuid
 from typing import Awaitable, Callable, Dict, Generic, List, Optional, Tuple, TypeVar
+
+from xotorch_tpu.utils import knobs
 
 DEBUG = int(os.getenv("DEBUG", "0"))
 DEBUG_DISCOVERY = int(os.getenv("DEBUG_DISCOVERY", "0"))
@@ -104,17 +107,44 @@ class PrefixDict(Generic[K, T]):
 _DETACHED_TASKS: set = set()
 
 
+def _report_task_exception(task: "asyncio.Task") -> None:
+  """Done-callback: a detached task that died of an exception is logged
+  deterministically at the next loop tick — not maybe-later at GC time via
+  asyncio's "Task exception was never retrieved" handler (which fires only
+  if the loop is still running when the ref drops). Some spawn sites DO
+  await the task (download dedup, the API token pumps) and handle its
+  exception themselves; deferring one tick lets their wakeup retrieve it
+  first (retrieval clears the task's traceback-log flag), so only truly
+  unobserved failures are reported."""
+  if task.cancelled():
+    return
+
+  def _check() -> None:
+    if getattr(task, "_log_traceback", True) is False:
+      return  # an awaiter retrieved the exception and owns handling it
+    exc = task.exception()
+    if exc is not None:
+      print(f"detached task {task.get_name()} failed: {exc!r}", file=sys.stderr)
+
+  try:
+    asyncio.get_running_loop().call_soon(_check)
+  except RuntimeError:  # loop already closed: report synchronously
+    _check()
+
+
 def spawn_detached(coro, registry: Optional[set] = None) -> "asyncio.Task":
   """create_task with a STRONG reference (asyncio keeps only weak refs to
   tasks — an untracked fire-and-forget task can be garbage-collected
-  mid-flight, silently dropping the work). One helper so every
-  fire-and-forget site shares the same idiom; pass `registry` to scope the
-  refs to an owner (e.g. a server's in-flight hops), else a module-global
-  set holds them until done."""
+  mid-flight, silently dropping the work) and deterministic exception
+  logging. One helper so every fire-and-forget site shares the same idiom
+  (xotlint's async-safety checker bans raw create_task outside this
+  module); pass `registry` to scope the refs to an owner (e.g. a server's
+  in-flight hops), else a module-global set holds them until done."""
   reg = registry if registry is not None else _DETACHED_TASKS
   task = asyncio.create_task(coro)
   reg.add(task)
   task.add_done_callback(reg.discard)
+  task.add_done_callback(_report_task_exception)
   return task
 
 
@@ -160,8 +190,9 @@ def find_available_port(host: str = "", min_port: int = 49152, max_port: int = 6
 
 def get_or_create_node_id() -> str:
   """Persistent per-machine node UUID (parity: helpers.py:182-205)."""
-  if os.getenv("XOT_UUID"):
-    return os.environ["XOT_UUID"]
+  override = knobs.get_str("XOT_UUID", None)
+  if override:
+    return override
   id_file = os.path.join(tempfile.gettempdir(), ".xot_tpu_node_id")
   try:
     if os.path.isfile(id_file):
@@ -193,8 +224,12 @@ def get_all_ip_addresses_and_interfaces() -> List[Tuple[str, str]]:
     pairs.sort(key=lambda p: p[0].startswith("127."))
     if pairs:
       return pairs
-  except Exception:
-    pass
+  except Exception as e:
+    # No psutil / permission-denied NIC enumeration: single-machine dev
+    # still works off loopback, but say so — a silent fallback here makes
+    # "discovery finds nobody" undiagnosable on multi-NIC hosts.
+    if DEBUG >= 1:
+      print(f"NIC enumeration failed ({e!r}); falling back to loopback only")
   return [("127.0.0.1", "lo")]
 
 

@@ -1,0 +1,211 @@
+"""Central registry of every `XOT_*` environment knob.
+
+Single source of truth for the knob surface: name, type, default (in env-var
+string form), and one doc line per knob. Three consumers:
+
+- runtime code reads knobs through the typed accessors (`get_int`,
+  `get_float`, `get_bool`, `get_str`, `raw`) — a typo'd name raises
+  `UnknownKnobError` at the read site instead of silently returning the
+  default forever;
+- `tools/xotlint` loads this module standalone (it imports only the stdlib,
+  never the package) and fails CI on any `XOT_*` env read whose name is not
+  registered here;
+- the README "Environment knob reference" table is GENERATED from this
+  registry (`python -m tools.xotlint --knob-docs`) and drift between the
+  two is a lint failure.
+
+Keep `_DEFS` declarative: one `Knob(...)` literal per knob, string-literal
+arguments only, so the linter can read it without importing the package.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+class UnknownKnobError(KeyError):
+  """An env read referenced an `XOT_*` name that is not registered."""
+
+
+@dataclass(frozen=True)
+class Knob:
+  name: str
+  kind: str  # "int" | "float" | "bool" | "str" | "json" | "path"
+  default: Optional[str]  # env-string form; None = unset (auto/disabled)
+  doc: str
+  section: str = "General"
+
+
+# NOTE for editors: keep every field a plain literal (no computed defaults,
+# no conditionals) — the registry doubles as documentation and the linter's
+# ground truth, so a value a reader can't see at a glance defeats both.
+_DEFS: Tuple[Knob, ...] = (
+  # ----------------------------------------------------------- engine core
+  Knob("XOT_DTYPE", "str", "bfloat16", "Model compute/weight dtype for the JAX engine.", "Engine"),
+  Knob("XOT_QUANTIZE", "str", None, "Weight quantization mode (`int8` or `int4`); unset serves full precision.", "Engine"),
+  Knob("XOT_KV_QUANT", "str", None, "KV-cache quantization mode (`int8`); unset keeps KV in compute dtype.", "Engine"),
+  Knob("XOT_SEED", "int", None, "Sampling PRNG seed; unset derives one from wall-clock time.", "Engine"),
+  Knob("XOT_CACHE_LEN", "int", "2048", "Initial per-request KV-cache length (tokens); grows geometrically when exceeded.", "Engine"),
+  Knob("XOT_MAX_CACHE_LEN", "int", "32768", "Hard ceiling for per-request KV-cache growth (tokens).", "Engine"),
+  Knob("XOT_MAX_RESIDENT_REQUESTS", "int", "8", "Max request states resident per shard context before LRU eviction.", "Engine"),
+  Knob("XOT_MAX_RESIDENT_MODELS", "int", "2", "Max model shard contexts resident before LRU eviction of whole models.", "Engine"),
+  Knob("XOT_PREFILL_CHUNK", "int", "4096", "Prefill chunk length (tokens): prompts longer than this prefill in chunks.", "Engine"),
+  Knob("XOT_SCAN_PREFILL", "bool", "1", "Use the lax.scan prefill over equal chunks (one compile for any chunk count).", "Engine"),
+  Knob("XOT_DECODE_BATCH", "int", "8", "Max concurrent requests fused into one batched decode dispatch.", "Engine"),
+  Knob("XOT_BATCH_WINDOW_MS", "float", "0", "Batching window (ms) the decode batcher waits to coalesce submitters; 0 = one event-loop tick.", "Engine"),
+  Knob("XOT_DECODE_CHUNK", "int", "8", "Tokens per fused decode dispatch on a single-partition ring; 1 = per-token ring.", "Engine"),
+  Knob("XOT_DECODE_CHUNK_MAX", "int", "64", "Adaptive fused-decode chunk ceiling (doubles per dispatch up to this).", "Engine"),
+  Knob("XOT_OVERLAP_CHUNKS", "bool", "1", "Overlap fused-decode chunk N+1 dispatch with chunk N host readback.", "Engine"),
+  Knob("XOT_OVERLAP_BATCH", "bool", "0", "Overlap batched-decode dispatch with readback (two in-flight batches).", "Engine"),
+  # ------------------------------------------------------------- paged KV
+  Knob("XOT_PAGED_KV", "bool", "0", "Serve decode from the shared paged KV pool instead of contiguous per-request caches.", "Paged KV"),
+  Knob("XOT_KV_PAGE", "int", "128", "Page size (tokens) of the paged KV pool.", "Paged KV"),
+  Knob("XOT_KV_POOL_TOKENS", "int", "0", "Total paged-pool capacity in tokens; 0 sizes it automatically.", "Paged KV"),
+  Knob("XOT_PAGED_KERNEL", "bool", None, "Force the Pallas ragged paged-attention kernel on/off; unset auto-selects by backend.", "Paged KV"),
+  Knob("XOT_PAGED_PREFILL", "bool", "1", "Prefill straight into pool pages under XOT_PAGED_KV (no contiguous commit copy).", "Paged KV"),
+  Knob("XOT_PREFILL_COSCHED", "bool", "1", "Co-schedule chunked prefill slices through the decode batcher's drain cycle.", "Paged KV"),
+  Knob("XOT_PREFILL_CHUNK_BUDGET", "int", "1", "Prefill segments admitted per decode drain cycle under co-scheduling.", "Paged KV"),
+  Knob("XOT_KV_HOST_BYTES", "int", "268435456", "Host-RAM budget (bytes) for the spilled warm-prefix KV tier; 0 disables.", "Paged KV"),
+  # --------------------------------------------------------- prefix cache
+  Knob("XOT_PREFIX_CACHE", "int", "2", "Prefix-cache entries kept per context (LRU); 0 disables prefix caching.", "Prefix cache"),
+  Knob("XOT_PREFIX_CACHE_MIN", "int", "32", "Minimum matched prefix length (tokens) worth reusing from the cache.", "Prefix cache"),
+  # ---------------------------------------------------- attention kernels
+  Knob("XOT_FLASH_ATTENTION", "bool", None, "Force the Pallas flash-attention prefill kernel on/off; unset auto-selects by backend.", "Kernels"),
+  Knob("XOT_FLASH_BLOCK_Q", "int", "128", "Flash-attention query block size.", "Kernels"),
+  Knob("XOT_FLASH_BLOCK_K", "int", "128", "Flash-attention key/value block size.", "Kernels"),
+  Knob("XOT_FLASH_DECODE", "bool", None, "Force the Pallas flash-decode kernel on/off; unset auto-selects by backend and length.", "Kernels"),
+  Knob("XOT_FLASH_DECODE_MIN", "int", "4096", "Minimum KV length (tokens) before flash-decode engages.", "Kernels"),
+  Knob("XOT_FD_BLOCK_Q", "int", "128", "Flash-decode query-head block size.", "Kernels"),
+  Knob("XOT_FD_BLOCK_K", "int", "256", "Flash-decode key/value block size.", "Kernels"),
+  Knob("XOT_INT4_KERNEL", "str", "1", "Fused int4 matmul kernel: `1` on real TPU, `0` off, `force` even off-TPU.", "Kernels"),
+  Knob("XOT_INT4_V", "int", "1", "Int4 kernel variant selector (1 or 2).", "Kernels"),
+  Knob("XOT_INT8_KERNEL", "str", "0", "Fused int8 matmul kernel: `1` on real TPU, `0` off, `force` even off-TPU.", "Kernels"),
+  # ----------------------------------------------------------- speculative
+  Knob("XOT_SPECULATE", "int", "0", "Speculative draft depth (tokens per round); 0 disables (8 implied by XOT_DRAFT_MODEL).", "Speculative"),
+  Knob("XOT_SPECULATE_WINDOW", "int", "2048", "Backward scan window (tokens) for prompt-lookup draft matching.", "Speculative"),
+  Knob("XOT_DRAFT_MODEL", "str", None, "Resident draft model id for model-based speculative decoding.", "Speculative"),
+  Knob("XOT_DRAFT_RETRY_S", "float", "300", "Cooldown (s) before retrying a draft model that failed to load.", "Speculative"),
+  # ------------------------------------------------------------- sharding
+  Knob("XOT_SERVE_TP", "int", None, "Tensor-parallel degree for serving; unset auto-selects from local devices.", "Sharding"),
+  Knob("XOT_SERVE_SP", "int", "0", "Sequence-parallel degree for long-prompt serving prefill.", "Sharding"),
+  Knob("XOT_SERVE_EP", "int", "0", "Expert-parallel degree for MoE serving.", "Sharding"),
+  Knob("XOT_MAX_SEQ_LEN", "int", None, "Override the model's maximum sequence length (RoPE/table sizing).", "Sharding"),
+  # ------------------------------------------------------- training / LoRA
+  Knob("XOT_LORA_RANK", "int", "0", "LoRA adapter rank for training; 0 trains/serves without LoRA.", "Training"),
+  Knob("XOT_LORA_TARGETS", "str", None, "LoRA target set; `all` extends adapters to MLP slots (default attention-only).", "Training"),
+  Knob("XOT_ADAPTERS", "str", None, "Comma-separated `name=path` list of LoRA adapters to serve (multi-LoRA).", "Training"),
+  Knob("XOT_LR", "float", "1e-5", "Training learning rate.", "Training"),
+  Knob("XOT_SAVE_OPT_STATE", "bool", "1", "Persist/restore optimizer state across training checkpoints.", "Training"),
+  # ------------------------------------------------- ring / survivability
+  Knob("XOT_HOP_RETRIES", "int", "0", "Retries per ring hop on transient transport failures; 0 = fail-fast.", "Survivability"),
+  Knob("XOT_HOP_BACKOFF_S", "float", "0.05", "Base backoff (s) for hop retries (exponential + jitter).", "Survivability"),
+  Knob("XOT_REQUEST_DEADLINE_S", "float", "0", "End-to-end request deadline (s); remaining budget rides the hops. 0 disables.", "Survivability"),
+  Knob("XOT_STALL_TIMEOUT_S", "float", "0", "Per-node stall watchdog: abort a request with no progress for this long. 0 disables.", "Survivability"),
+  Knob("XOT_HEALTH_INTERVAL_S", "float", "0", "Peer health-check cadence (s); 0 disables the health monitor.", "Survivability"),
+  Knob("XOT_HEALTH_FAILS", "int", "2", "Consecutive failed health checks before a peer is evicted.", "Survivability"),
+  Knob("XOT_EVICT_COOLDOWN_S", "float", "30", "Seconds an evicted peer stays barred from re-admission by discovery.", "Survivability"),
+  Knob("XOT_REQUEST_RESTARTS", "int", "0", "One-shot transparent API restarts after a ring failure (non-streaming).", "Survivability"),
+  Knob("XOT_FAULT_SPEC", "json", None, "Test-only: JSON fault-injection rules applied at the peer-handle boundary.", "Survivability"),
+  # ------------------------------------------------------------- topology
+  Knob("XOT_COORDINATOR", "str", None, "JAX multi-host coordinator address (`host:port`); setting it implies multi-host.", "Topology"),
+  Knob("XOT_MULTIHOST", "bool", "0", "Force JAX multi-host initialization.", "Topology"),
+  Knob("XOT_NUM_PROCESSES", "int", None, "Process count for JAX multi-host init (required with XOT_COORDINATOR).", "Topology"),
+  Knob("XOT_PROCESS_ID", "int", None, "This process's index for JAX multi-host init (required with XOT_COORDINATOR).", "Topology"),
+  Knob("XOT_PROBE_TIMEOUT", "float", "120", "Timeout (s) for the device-capability accelerator probe subprocess.", "Topology"),
+  Knob("XOT_SKIP_JAX_PROBE", "bool", "0", "Skip the JAX accelerator probe (report CPU-only capabilities).", "Topology"),
+  Knob("XOT_PLATFORM", "str", None, "Force the JAX platform (`cpu`/`tpu`/`gpu`) before first device touch.", "Topology"),
+  # ------------------------------------------------------ paths / identity
+  Knob("XOT_HOME", "path", None, "Root directory for downloads and state; unset uses `~/.xot_tpu`.", "Paths"),
+  Knob("XOT_MODEL_DIR", "path", None, "Local directory of model checkpoints (offline serving).", "Paths"),
+  Knob("XOT_UUID", "str", None, "Override the persistent per-machine node id.", "Paths"),
+  # ------------------------------------------------------- native sidecar
+  Knob("XOT_SIDECAR_BIN", "path", None, "Path to a prebuilt native sidecar binary (skips the make step).", "Sidecar"),
+  Knob("XOT_SIDECAR_QUANT", "str", None, "Native sidecar weight quantization (`int8`); read by the C++ engine.", "Sidecar"),
+  # ------------------------------------------------------------ observability
+  Knob("XOT_TRACING", "bool", "1", "Record request/hop spans in the in-process tracer (served at /v1/traces).", "Observability"),
+)
+
+REGISTRY: Dict[str, Knob] = {k.name: k for k in _DEFS}
+
+_UNSET = object()
+_FALSE_STRINGS = frozenset(("", "0", "false", "no", "off"))
+
+
+def _lookup(name: str) -> Knob:
+  try:
+    return REGISTRY[name]
+  except KeyError:
+    raise UnknownKnobError(
+      f"{name} is not a registered knob — add it to xotorch_tpu/utils/knobs.py"
+    ) from None
+
+
+def raw(name: str, default=_UNSET) -> Optional[str]:
+  """The env value as a string, or the registered default (which may be
+  None = unset) — the exact-substitute for `os.getenv` that still fails
+  loudly on typo'd knob names. A set-but-EMPTY value is returned verbatim:
+  tri-state call sites distinguish `XOT_X=` (set: forces the non-"1"
+  branch, e.g. kernel off) from `XOT_X` absent (auto-select); the numeric
+  accessors below map empty to the default instead (the historical
+  `... or 0` idiom)."""
+  knob = _lookup(name)
+  value = os.environ.get(name)
+  if value is None:
+    return knob.default if default is _UNSET else default
+  return value
+
+
+def get_str(name: str, default=_UNSET) -> Optional[str]:
+  return raw(name, default)
+
+
+def _required(name: str):
+  raise RuntimeError(f"knob {name} has no default and is not set in the environment")
+
+
+def _numeric(name: str, default, cast):
+  value = raw(name, default)
+  if isinstance(value, str) and value.strip() == "":
+    # Empty value == unset for numbers (`XOT_X= prog` must not crash).
+    knob = _lookup(name)
+    value = knob.default if default is _UNSET else default
+  if value is None:
+    return None if default is not _UNSET else _required(name)
+  return cast(value)
+
+
+def get_int(name: str, default=_UNSET) -> Optional[int]:
+  return _numeric(name, default, int)
+
+
+def get_float(name: str, default=_UNSET) -> Optional[float]:
+  return _numeric(name, default, float)
+
+
+def get_bool(name: str, default=_UNSET) -> Optional[bool]:
+  """Truthiness matching the historical call sites: "0"/"false"/"no"/"off"
+  (any case) and set-but-empty are False, any other set value is True."""
+  value = raw(name, default)
+  if value is None:
+    return None if default is not _UNSET else _required(name)
+  if isinstance(value, bool):
+    return value
+  return str(value).strip().lower() not in _FALSE_STRINGS
+
+
+def knob_table_markdown() -> str:
+  """The README "Environment knob reference" section body — generated so
+  docs can never drift from the registry (xotlint's doc-drift checker
+  compares this rendering against the committed README)."""
+  lines = []
+  section = None
+  for knob in _DEFS:
+    if knob.section != section:
+      section = knob.section
+      lines.append(f"\n**{section}**\n")
+      lines.append("| Knob | Type | Default | Description |")
+      lines.append("| --- | --- | --- | --- |")
+    default = "_unset_" if knob.default is None else f"`{knob.default}`"
+    lines.append(f"| `{knob.name}` | {knob.kind} | {default} | {knob.doc} |")
+  return "\n".join(lines).strip() + "\n"
