@@ -233,6 +233,23 @@ async def test_killed_peer_evicted_and_request_restarted(monkeypatch):
     assert "xot_hop_retries_total" in text
     assert "xot_health_check_failures_total" in text
 
+    # Flight recorder: the abort froze a per-request snapshot (here the hop
+    # error beats the stall watchdog to the kill, so the timeline shows
+    # admission -> armed watchdog -> hop activity -> abort; the fired pair
+    # is proven in the sink scenario below), and the eviction froze a
+    # node-scope snapshot with the peer.evicted transition — both served
+    # over the API.
+    data = await (await client.get("/v1/debug/flight")).json()
+    assert data["snapshots"], "no flight snapshots after abort + eviction"
+    req_snaps = [s for s in data["snapshots"] if s["request_id"]]
+    assert req_snaps, "no per-request snapshot for the aborted request"
+    events = [e["event"] for e in req_snaps[0]["events"]]
+    assert "request.admitted" in events and "watchdog.armed" in events, events
+    assert "request.aborted" in events, events
+    assert events.index("watchdog.armed") < events.index("request.aborted")
+    assert any("peer.evicted" in [e["event"] for e in s["events"]]
+               for s in data["snapshots"]), "eviction transition not captured"
+
     # Cooldown: discovery still lists the corpse, reconcile must not re-add.
     await a.update_peers()
     assert a.peers == []
@@ -266,6 +283,16 @@ async def test_silently_sunk_hop_hits_stall_watchdog(monkeypatch):
     assert any(e and "stalled" in e for e in errors.values()), errors
     aborts = sum(int(n.metrics.watchdog_aborts_total._value.get()) for n in (a, b))
     assert aborts >= 1
+    # Flight-recorder postmortem: the aborting node froze a snapshot whose
+    # timeline covers admission/arrival -> watchdog arming -> firing ->
+    # abort for the failed request.
+    snaps = [s for s in (n.flight.snapshot("sink-req") for n in (a, b)) if s is not None]
+    assert snaps, "no flight snapshot frozen for the watchdog-aborted request"
+    events = [e["event"] for e in snaps[0]["events"]]
+    assert any(e in ("request.admitted", "hop.recv") for e in events), events
+    assert "watchdog.armed" in events and "watchdog.fired" in events, events
+    assert events.index("watchdog.armed") < events.index("watchdog.fired")
+    assert "request.aborted" in events
     _assert_no_leaks(a, b)
   finally:
     await a.stop()

@@ -1,0 +1,145 @@
+"""Flight recorder: bounded always-on event ring, frozen anomaly snapshots,
+and the /v1/debug/flight + /v1/cluster/metrics API surface."""
+import asyncio
+
+import pytest
+
+from xotorch_tpu.inference.dummy import DummyInferenceEngine
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.orchestration.flight import EVENTS, FlightRecorder
+
+from tests.test_orchestration import _caps, _make_node
+
+
+# ----------------------------------------------------------------- unit
+
+def test_record_and_tail_bounded():
+  fl = FlightRecorder(node_id="n", capacity=32)
+  for i in range(100):
+    fl.record("hop.recv", f"r{i % 4}", layers="0-8")
+  assert len(fl.tail()) == 32  # ring bound holds
+  assert fl.stats()["events_recorded"] == 100
+  assert fl.tail(5)[-1]["request_id"] == "r3"
+
+
+def test_unknown_event_raises():
+  fl = FlightRecorder()
+  with pytest.raises(ValueError):
+    fl.record("bogus.event")
+
+
+def test_event_vocabulary_shape():
+  # Closed vocabulary: every name is `<subsystem>.<event>` and unique (the
+  # lint checker and dashboards both key off this).
+  assert all("." in e and e == e.lower() for e in EVENTS)
+  assert len(set(EVENTS)) == len(EVENTS)
+
+
+def test_freeze_filters_request_and_node_scope():
+  fl = FlightRecorder(node_id="n")
+  fl.record("request.admitted", "r1", model="m")
+  fl.record("request.admitted", "r2", model="m")
+  fl.record("watchdog.armed", None, stall_s=1)
+  fl.record("watchdog.fired", "r1", kind="stall")
+  snap = fl.freeze("r1", reason="stalled")
+  # r2's events are excluded; node-scoped (request_id=None) context stays.
+  assert [e["event"] for e in snap["events"]] == [
+    "request.admitted", "watchdog.armed", "watchdog.fired"]
+  assert all(e["request_id"] in ("r1", None) for e in snap["events"])
+  assert fl.snapshot("r1")["reason"] == "stalled"
+  assert fl.snapshot("r2") is None
+
+
+def test_snapshot_store_bounded():
+  fl = FlightRecorder(max_snapshots=3)
+  for i in range(6):
+    fl.record("request.aborted", f"r{i}", error="x")
+    fl.freeze(f"r{i}", reason="x")
+  assert len(fl.snapshots()) == 3
+  assert fl.snapshot("r0") is None and fl.snapshot("r5") is not None
+
+
+def test_disabled_records_nothing(monkeypatch):
+  monkeypatch.setenv("XOT_FLIGHT", "0")
+  fl = FlightRecorder()
+  fl.record("request.admitted", "r")
+  assert fl.tail() == []
+  assert fl.freeze("r") is None
+  assert fl.snapshots() == []
+
+
+# ------------------------------------------------------------ integration
+
+async def test_flight_and_cluster_endpoints():
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+
+  engine = DummyInferenceEngine()
+  node = await _make_node("fr-solo", engine)
+  node.topology.update_node("fr-solo", _caps())
+  # The node attached its observability hooks to the engine at construction.
+  assert engine.flight is node.flight and engine.metrics is node.metrics
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy")
+
+  done = asyncio.Event()
+  node.on_token.register("t").on_next(lambda r, t, f: done.set() if f else None)
+  await node.process_prompt(Shard("dummy", 0, 0, 8), "hi", "fr-req")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  await asyncio.sleep(0.2)
+
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.get("/v1/debug/flight?live=all")
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["enabled"] and data["node_id"] == "fr-solo"
+    events = [e["event"] for e in data["events"]]
+    assert "request.admitted" in events and "request.finished" in events
+    assert data["snapshots"] == []  # no anomaly yet: nothing frozen
+    assert (await client.get("/v1/debug/flight?request_id=fr-req")).status == 404
+    assert (await client.get("/v1/debug/flight?live=nope")).status == 400
+
+    # An abort freezes a snapshot, served by request id.
+    await node.process_prompt(Shard("dummy", 0, 0, 8), "hi again", "fr-req2")
+    await node._abort_request("fr-req2", "synthetic: test abort")
+    resp = await client.get("/v1/debug/flight?request_id=fr-req2")
+    assert resp.status == 200
+    snap = await resp.json()
+    assert snap["reason"].startswith("synthetic")
+    assert any(e["event"] == "request.aborted" for e in snap["events"])
+    assert any(e["event"] == "request.admitted" for e in snap["events"])
+
+    # Cluster rollup: a solo node reports itself; counters + SLO histograms.
+    resp = await client.get("/v1/cluster/metrics")
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["count"] == 1
+    me = data["nodes"]["fr-solo"]
+    assert me["requests"] >= 1
+    assert me["ttft_seconds"]["count"] >= 1
+    assert me["request_seconds"]["count"] >= 1
+    assert "queue_wait_decode_seconds" in me
+  finally:
+    await client.close()
+    await node.stop()
+
+
+async def test_peer_metrics_ingestion_feeds_cluster_view():
+  node = await _make_node("fr-ingest", DummyInferenceEngine())
+  try:
+    node.ingest_peer_metrics("peer-1", {"requests": 7, "ts": 1.0})
+    summary = node.metrics_summary()
+    assert summary["node_id"] == "fr-ingest" and "ts" in summary
+    assert node.peer_metrics["peer-1"]["requests"] == 7
+    # Bus delivery path: a node_metrics status from a peer lands in the map;
+    # one from ourselves is ignored.
+    import json
+    node.on_node_status("", json.dumps(
+      {"type": "node_metrics", "node_id": "peer-2", "metrics": {"requests": 3}}))
+    node.on_node_status("", json.dumps(
+      {"type": "node_metrics", "node_id": "fr-ingest", "metrics": {"requests": 999}}))
+    assert node.peer_metrics["peer-2"] == {"requests": 3}
+    assert "fr-ingest" not in node.peer_metrics
+  finally:
+    await node.stop()

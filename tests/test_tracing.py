@@ -4,6 +4,8 @@ SURVEY §0, §5), implemented and tested for real here.
 """
 import asyncio
 import json
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -83,6 +85,91 @@ def test_tracer_disabled_records_nothing(monkeypatch):
   assert tracer.export() == []
 
 
+def test_traceparent_sampled_flag_roundtrip():
+  ctx = TraceContext.new()
+  ctx.sampled = False
+  assert ctx.traceparent().endswith("-00")
+  parsed = TraceContext.from_traceparent(ctx.traceparent())
+  assert parsed is not None and parsed.sampled is False
+
+
+def test_unsampled_parent_records_no_spans():
+  """W3C `sampled` honored for real: flag `00` means no span is buffered
+  anywhere in the trace, but call sites still get live span objects."""
+  tracer = Tracer(node_id="n1")
+  ctx = TraceContext.from_traceparent(f"00-{'a' * 32}-{'b' * 16}-00")
+  assert ctx is not None and not ctx.sampled
+  with tracer.start_span("root", parent=ctx) as root:
+    assert not root.sampled
+    assert not root.context().sampled  # children inherit the decision
+    with tracer.start_span("child", parent=root.context()) as child:
+      child.set_attribute("still", "usable")
+  for _ in range(15):
+    tracer.record_token("req", ctx)  # token groups skipped too
+  tracer.finish_request("req")
+  assert tracer.export() == []
+  # A sampled trace on the same tracer still records.
+  with tracer.start_span("kept"):
+    pass
+  assert [s["name"] for s in tracer.export()] == ["kept"]
+
+
+def test_ingest_adopts_and_dedups_remote_spans():
+  tracer = Tracer(node_id="local")
+  remote = Tracer(node_id="remote")
+  with remote.start_span("remote_work") as span:
+    pass
+  exported = remote.export()
+  assert tracer.ingest(exported) == 1
+  assert tracer.ingest(exported) == 0  # bus fan-out redelivery: deduped
+  spans = tracer.export(trace_id=span.trace_id)
+  assert [s["name"] for s in spans] == ["remote_work"]
+  # node_id filter: the rollup flush must not re-broadcast ingested spans.
+  assert tracer.export(node_id="local") == []
+  assert [s["name"] for s in tracer.export(node_id="remote")] == ["remote_work"]
+
+
+def test_device_trace_start_stop_thread_safe(monkeypatch):
+  """Two concurrent API calls must not double-start jax.profiler: the
+  module-global flag is now guarded by a lock held across the profiler
+  call itself."""
+  import jax
+
+  from xotorch_tpu.orchestration import tracing
+
+  calls = {"start": 0, "stop": 0}
+
+  class FakeProfiler:
+    @staticmethod
+    def start_trace(logdir):
+      calls["start"] += 1
+      time.sleep(0.05)  # widen the race window the lock must close
+
+    @staticmethod
+    def stop_trace():
+      calls["stop"] += 1
+
+  monkeypatch.setattr(jax, "profiler", FakeProfiler)
+  monkeypatch.setattr(tracing, "_profiling", False)
+  results = []
+  barrier = threading.Barrier(4)
+
+  def go():
+    barrier.wait()
+    results.append(tracing.start_device_trace("/tmp/xot_trace_race"))
+
+  threads = [threading.Thread(target=go) for _ in range(4)]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  assert results.count(True) == 1, results
+  assert calls["start"] == 1, "profiler started more than once"
+  assert tracing.stop_device_trace() is True
+  assert tracing.stop_device_trace() is False
+  assert calls["stop"] == 1
+
+
 def test_export_filter_and_clear():
   tracer = Tracer()
   with tracer.start_span("a") as a:
@@ -151,7 +238,9 @@ async def test_ring_spans_share_one_trace_and_metrics_count():
   try:
     spans_a = node_a.tracer.export()
     spans_b = node_b.tracer.export()
-    all_spans = spans_a + spans_b
+    # The cluster rollup means each node may ALSO hold the other's spans;
+    # dedup by span id — exactly one logical root exists either way.
+    all_spans = list({s["spanId"]: s for s in spans_a + spans_b}.values())
     assert all_spans, "no spans recorded"
     roots = [s for s in all_spans if s["name"] == "process_prompt"]
     assert len(roots) == 1
@@ -196,6 +285,56 @@ async def test_ring_releases_per_request_state_on_all_nodes():
     await node_b.stop()
 
 
+def _span_node_ids(spans):
+  out = set()
+  for s in spans:
+    attrs = {a["key"]: a["value"] for a in s["attributes"]}
+    out.add(attrs.get("node.id"))
+  return out
+
+
+async def test_cross_node_trace_rollup_single_export():
+  """Cluster trace assembly: after a two-node ring request, the ORIGIN's
+  single /v1/traces export contains spans from BOTH node ids under one
+  trace_id (peers flush their shard of the trace over the status bus at
+  finish)."""
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+
+  node_a, node_b = await _run_two_node_ring()
+  try:
+    # The rollup flush is detached (and deliberately delayed a beat to let
+    # enclosing spans close) — poll until node a holds node b's spans.
+    deadline = time.monotonic() + 8
+    spans = []
+    while time.monotonic() < deadline:
+      spans = node_a.tracer.export()
+      if {"a", "b"} <= _span_node_ids(spans):
+        break
+      await asyncio.sleep(0.05)
+    assert {"a", "b"} <= _span_node_ids(spans), \
+      f"origin never assembled the ring trace (nodes seen: {_span_node_ids(spans)})"
+    roots = [s for s in spans if s["name"] == "process_prompt"]
+    assert roots
+    trace_id = roots[0]["traceId"]
+
+    api = ChatGPTAPI(node_a, "DummyInferenceEngine", default_model="dummy")
+    client = TestClient(TestServer(api.app))
+    await client.start_server()
+    try:
+      resp = await client.get(f"/v1/traces?trace_id={trace_id}")
+      data = await resp.json()
+      assert data["count"] > 0
+      assert all(s["traceId"] == trace_id for s in data["spans"])
+      assert {"a", "b"} <= _span_node_ids(data["spans"]), \
+        "one /v1/traces call must return the WHOLE ring's spans"
+    finally:
+      await client.close()
+  finally:
+    await node_a.stop()
+    await node_b.stop()
+
+
 async def test_api_traces_and_metrics_endpoints():
   from aiohttp.test_utils import TestClient, TestServer
   from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
@@ -228,5 +367,12 @@ async def test_api_traces_and_metrics_endpoints():
     text = await resp.text()
     assert "xot_requests_total" in text
     assert "xot_token_seconds" in text
+    # SLO histograms export, and the per-request ones MOVED for the
+    # completed request (count > 0); the queue-wait family is present with
+    # both lanes even while idle.
+    assert 'xot_ttft_seconds_count{node_id="solo"} 1.0' in text
+    assert 'xot_request_seconds_count{node_id="solo"} 1.0' in text
+    assert 'xot_queue_wait_seconds_count{lane="decode",node_id="solo"}' in text
+    assert 'xot_queue_wait_seconds_count{lane="prefill",node_id="solo"}' in text
   finally:
     await client.close()

@@ -329,6 +329,101 @@ def test_metrics_init_assignment_is_not_an_increment(tmp_path):
   assert findings_by(repo, "metrics-consistency", "dead-exported-counter") == []
 
 
+# ----------------------------------------------- flight-event consistency
+
+FIXTURE_FLIGHT = '''
+EVENTS = (
+  "request.admitted",
+  "watchdog.fired",
+)
+_EVENT_SET = frozenset(EVENTS)
+
+class FlightRecorder:
+  def record(self, event, request_id=None, **attrs):
+    pass
+'''
+
+
+def test_flight_events_clean_fixture(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/flight.py": FIXTURE_FLIGHT,
+    "xotorch_tpu/orchestration/node.py": (
+      "class Node:\n"
+      "  def admit(self):\n"
+      "    self.flight.record('request.admitted', 'r1')\n"
+      "    self.flight.record('watchdog.fired', 'r1', kind='stall')\n"
+      # Non-`a.b` record() calls (an unrelated recorder API) are not flight
+      # sites and must not be matched against the vocabulary.
+      "    self.audio.record('wav')\n"
+    ),
+  })
+  assert findings_by(repo, "metrics-consistency") == []
+
+
+def test_flight_events_flags_typo_and_dead(tmp_path):
+  """A typo'd event literal raises at runtime on the serving path — it must
+  fail lint instead; and the event the typo orphaned is now dead (declared
+  but never recorded), which is the same drift seen from the other side."""
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/flight.py": FIXTURE_FLIGHT,
+    "xotorch_tpu/orchestration/node.py": (
+      "class Node:\n"
+      "  def admit(self):\n"
+      "    self.flight.record('request.admited', 'r1')\n"  # typo
+      "    self.flight.record('watchdog.fired', 'r1')\n"
+    ),
+  })
+  found = {(f.code, f.key) for f in findings_by(repo, "metrics-consistency")}
+  assert found == {
+    ("unknown-flight-event", "request.admited"),
+    ("dead-flight-event", "request.admitted"),
+  }
+
+
+def test_flight_events_absent_module_skips_checks(tmp_path):
+  """Trees without orchestration/flight.py (every other fixture here) have
+  no vocabulary to check against: `.record("a.b")` calls pass silently
+  instead of all being flagged unknown."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/node.py": (
+    "class Node:\n"
+    "  def f(self):\n"
+    "    self.flight.record('any.thing')\n"
+  )})
+  assert findings_by(repo, "metrics-consistency") == []
+
+
+def test_metrics_registry_resolves_labeled_histogram_family(tmp_path):
+  """The shared-parent registry shape — one Histogram local, several
+  `self.attr = var.labels(...)` — must register every attr, or the
+  queue-wait lanes would read as unknown-metric-attr at their observe()
+  sites."""
+  metrics = FIXTURE_METRICS.replace(
+    "from prometheus_client import CollectorRegistry, Counter, Gauge",
+    "from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram",
+  ).replace(
+    "  def exposition(self):",
+    '    qw = Histogram(\n'
+    '      "xot_queue_wait_seconds", "Waits", ["node_id", "lane"],\n'
+    '      registry=self.registry)\n'
+    '    self.queue_wait_decode = qw.labels(node_id=node_id, lane="decode")\n'
+    '    self.queue_wait_prefill = qw.labels(node_id=node_id, lane="prefill")\n\n'
+    "  def exposition(self):",
+  )
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/metrics.py": metrics,
+    "xotorch_tpu/orchestration/node.py": (
+      "class Node:\n"
+      "  def f(self):\n"
+      "    self.metrics.queue_wait_decode.observe(0.1)\n"
+      "    self.metrics.queue_wait_prefill.observe(0.2)\n"
+    ),
+  })
+  assert findings_by(repo, "metrics-consistency") == []
+  reg = metrics_consistency.registry_metrics(repo)
+  assert reg["queue_wait_decode"] == ("xot_queue_wait_seconds", "histogram")
+  assert reg["queue_wait_prefill"] == ("xot_queue_wait_seconds", "histogram")
+
+
 # -------------------------------------------------------- exception-hygiene
 
 def test_exception_hygiene_flags_silent_pass_in_scope(tmp_path):

@@ -87,6 +87,7 @@ class Repo:
     api_metrics_path: str = "xotorch_tpu/api/chatgpt_api.py",
     readme_path: str = "README.md",
     helpers_path: str = "xotorch_tpu/utils/helpers.py",
+    flight_path: str = "xotorch_tpu/orchestration/flight.py",
   ):
     self.root = os.path.abspath(root)
     self.py_roots = tuple(py_roots)
@@ -95,6 +96,7 @@ class Repo:
     self.api_metrics_path = api_metrics_path
     self.readme_path = readme_path
     self.helpers_path = helpers_path
+    self.flight_path = flight_path
     self._files: Optional[List[SourceFile]] = None
     self._by_path: Dict[str, SourceFile] = {}
     self._knobs_module = None

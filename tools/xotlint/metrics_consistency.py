@@ -20,7 +20,13 @@ Checks:
 - `unexported-counter`: a `faults.bump("key")` whose `xot_<key>_total`
   line no NodeMetrics.exposition appends;
 - `dead-exported-counter`: an engine counter attr the API exports but no
-  engine code ever increments (`self.<attr> += ...`).
+  engine code ever increments (`self.<attr> += ...`);
+- `unknown-flight-event` / `dead-flight-event`: every
+  `<recorder>.record("<subsystem>.<event>", ...)` literal must be declared
+  in orchestration/flight.py's `EVENTS` tuple (a typo'd string raises at
+  runtime — fail it in CI instead), and every declared event must be
+  recorded somewhere (a dead name means the instrumentation it documents
+  was removed or never landed).
 """
 from __future__ import annotations
 
@@ -34,6 +40,11 @@ CHECKER = "metrics-consistency"
 
 _METRIC_NAME_RE = re.compile(r"^xot_[a-z0-9_]+$")
 _CTORS = {"Counter": "counter", "Gauge": "gauge", "Histogram": "histogram"}
+# Flight events are `<subsystem>.<event>` — distinctive enough that any
+# `.record("a.b", ...)` call is treated as a flight-recorder site
+# regardless of how the receiver is spelled (self.flight.record, a local
+# alias, a peer handle's attached recorder).
+_FLIGHT_EVENT_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
 
 
 def _inner_ctor(node: ast.AST) -> Optional[Tuple[str, str]]:
@@ -49,19 +60,35 @@ def _inner_ctor(node: ast.AST) -> Optional[Tuple[str, str]]:
 
 
 def registry_metrics(repo: Repo) -> Dict[str, Tuple[str, str]]:
-  """attr -> (metric_name, metric_type) from NodeMetrics.__init__."""
+  """attr -> (metric_name, metric_type) from NodeMetrics.__init__.
+
+  Two assignment shapes are resolved: the direct chain
+  `self.x = Histogram("xot_...", ...).labels(...)`, and the shared-parent
+  shape for labeled families — `h = Histogram("xot_...", ["node_id",
+  "lane"], ...)` followed by `self.a = h.labels(lane="decode")` — where
+  several attrs expose one metric name under different label values."""
   sf = repo.file(repo.metrics_path)
   out: Dict[str, Tuple[str, str]] = {}
   if sf is None or sf.tree is None:
     return out
+  var_ctors: Dict[str, Tuple[str, str]] = {}
   for node in ast.walk(sf.tree):
-    if isinstance(node, ast.Assign) and len(node.targets) == 1:
-      target = node.targets[0]
-      if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
-          and target.value.id == "self":
-        ctor = _inner_ctor(node.value)
-        if ctor is not None:
-          out[target.attr] = ctor
+    if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+      continue
+    target = node.targets[0]
+    ctor = _inner_ctor(node.value)
+    if isinstance(target, ast.Name) and ctor is not None:
+      var_ctors[target.id] = ctor
+    elif isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+        and target.value.id == "self":
+      if ctor is not None:
+        out[target.attr] = ctor
+      else:
+        # `self.attr = <var>.labels(...)`: resolve through the local ctor.
+        for name in ast.walk(node.value):
+          if isinstance(name, ast.Name) and name.id in var_ctors:
+            out[target.attr] = var_ctors[name.id]
+            break
   return out
 
 
@@ -119,6 +146,37 @@ def exported_metrics(repo: Repo) -> Dict[str, str]:
       for _, name, _ in rows:
         exported[name] = mtype
   return exported
+
+
+def flight_events(repo: Repo) -> Dict[str, int]:
+  """name -> declaration line for the `EVENTS` literal tuple in flight.py
+  (empty when the tree has no flight module — fixture repos)."""
+  sf = repo.file(repo.flight_path)
+  out: Dict[str, int] = {}
+  if sf is None or sf.tree is None:
+    return out
+  for node in ast.walk(sf.tree):
+    if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+        and isinstance(node.targets[0], ast.Name) and node.targets[0].id == "EVENTS":
+      for elt in ast.walk(node.value):
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+          out[elt.value] = elt.lineno
+  return out
+
+
+def _flight_record_sites(repo: Repo) -> List[Tuple[str, str, int]]:
+  """(event, path, line) for every `<recorder>.record("<a>.<b>", ...)`."""
+  sites = []
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    for node in ast.walk(sf.tree):
+      if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+          and node.func.attr == "record":
+        event = str_arg(node)
+        if event is not None and _FLIGHT_EVENT_RE.match(event):
+          sites.append((event, sf.relpath, node.lineno))
+  return sites
 
 
 def _bump_sites(repo: Repo) -> List[Tuple[str, str, int]]:
@@ -220,6 +278,30 @@ def check(repo: Repo) -> List[Finding]:
         message=f"`bump(\"{key}\")` increments a process counter but "
                 f"NodeMetrics.exposition never appends `{want}` — the count is invisible",
       ))
+
+  # Flight events: every record-site literal is declared in EVENTS, and
+  # every declared event is recorded somewhere in the tree.
+  declared = flight_events(repo)
+  if declared:
+    recorded: Set[str] = set()
+    for event, path, line in _flight_record_sites(repo):
+      sf = repo.file(path)
+      if sf is not None and sf.suppressed(line, CHECKER):
+        continue
+      recorded.add(event)
+      if event not in declared:
+        findings.append(Finding(
+          CHECKER, "unknown-flight-event", path, line, key=event,
+          message=f"`.record(\"{event}\")` but orchestration/flight.py EVENTS does "
+                  "not declare it — this raises ValueError on the serving path",
+        ))
+    for event, line in sorted(declared.items()):
+      if event not in recorded:
+        findings.append(Finding(
+          CHECKER, "dead-flight-event", repo.flight_path, line, key=event,
+          message=f"flight event `{event}` is declared but nothing records it — "
+                  "remove it or restore the instrumentation",
+        ))
 
   # Engine counters the API exports must be incremented somewhere.
   api_sf = repo.file(repo.api_metrics_path)

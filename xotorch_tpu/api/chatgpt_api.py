@@ -129,6 +129,10 @@ class ChatGPTAPI:
     # (the reference declared both intents but wired neither — SURVEY §0, §5).
     r.add_get("/v1/traces", self.handle_get_traces)
     r.add_get("/metrics", self.handle_get_metrics)
+    # Flight-recorder snapshots (frozen on watchdog abort / deadline expiry /
+    # peer eviction / OOM recovery) + the cluster-wide metric rollup.
+    r.add_get("/v1/debug/flight", self.handle_get_flight)
+    r.add_get("/v1/cluster/metrics", self.handle_get_cluster_metrics)
     r.add_post("/v1/trace/device/start", self.handle_device_trace_start)
     r.add_post("/v1/trace/device/stop", self.handle_device_trace_stop)
     r.add_get("/", self.handle_root)
@@ -198,6 +202,41 @@ class ChatGPTAPI:
     spans = self.node.tracer.export(trace_id=trace_id, clear=clear)
     return web.json_response({"spans": spans, "count": len(spans)})
 
+  async def handle_get_flight(self, request):
+    """Flight-recorder postmortems. No params: every frozen snapshot plus
+    recorder stats. `?request_id=` serves one snapshot (404 when none was
+    frozen for that request). `?live=N` additionally returns the last N
+    events of the LIVE ring (N=0 / `live=all` for everything) — the
+    pre-anomaly view, for debugging a hang that hasn't aborted yet."""
+    fl = self.node.flight
+    rid = request.query.get("request_id")
+    if rid:
+      snap = fl.snapshot(rid)
+      if snap is None:
+        return web.json_response(
+          {"detail": f"no flight snapshot frozen for request {rid}"}, status=404)
+      return web.json_response(snap)
+    body = {"node_id": self.node.id, **fl.stats(), "snapshots": fl.snapshots()}
+    live = request.query.get("live")
+    if live is not None:
+      try:
+        n = 0 if live in ("", "all") else max(0, int(live))
+      except ValueError:
+        return web.json_response(
+          {"detail": f"live must be an integer or 'all', got {live!r}"}, status=400)
+      body["events"] = fl.tail(n)
+    return web.json_response(body)
+
+  async def handle_get_cluster_metrics(self, request):
+    """Cluster metric rollup: this node's summary plus the latest summary
+    each peer broadcast over the status bus — one scrape sees every peer.
+    Peers' rows carry their own `ts`; a stale row means a quiet (or dead)
+    peer, which is itself signal."""
+    nodes = {self.node.id: self.node.metrics_summary()}
+    for node_id, summary in self.node.peer_metrics.items():
+      nodes.setdefault(node_id, summary)
+    return web.json_response({"nodes": nodes, "count": len(nodes)})
+
   async def handle_get_metrics(self, request):
     body, content_type = self.node.metrics.exposition_with_content_type()
     # Engine-level serving counters (prefix cache, speculative decoding):
@@ -225,6 +264,11 @@ class ChatGPTAPI:
        "Bytes spilled D2H into the host KV tier by prefix evictions"),
       ("_host_fetch_bytes", "xot_kv_fetch_bytes_total",
        "Bytes restored H2D from the host KV tier on warm-prefix admission"),
+      ("_jit_first_dispatches", "xot_jit_first_dispatch_total",
+       "Device dispatches whose executable identity was first seen (jit cache miss: "
+       "pays XLA compilation)"),
+      ("_jit_cached_dispatches", "xot_jit_cached_dispatch_total",
+       "Device dispatches that hit an already-compiled executable"),
     ):
       val = getattr(eng, attr, None)
       if val is not None:
@@ -236,8 +280,11 @@ class ChatGPTAPI:
       for key, name, help_text in (
         ("pages_in_use", "xot_kv_pool_pages_in_use", "KV pool pages currently referenced"),
         ("free_pages", "xot_kv_pool_free_pages", "KV pool pages on the free list"),
+        ("peak_pages_in_use", "xot_kv_pool_peak_pages",
+         "High-water mark of concurrently referenced KV pool pages"),
       ):
-        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {stats[key]}\n")
+        if key in stats:
+          extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {stats[key]}\n")
     # Host-tier KV occupancy gauges (XOT_KV_HOST_BYTES; absent until a
     # prefix eviction first touches the tier).
     host_fn = getattr(eng, "host_kv_stats", None)

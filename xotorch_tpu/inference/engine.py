@@ -34,6 +34,17 @@ class InferenceEngine(ABC):
 
   session: Dict[str, Any]
 
+  # Observability hooks, installed by the owning Node (orchestration/node.py):
+  # `flight` is the node's FlightRecorder, `metrics` its NodeMetrics,
+  # `tracer` its Tracer, and `trace_ctx` a request-id -> TraceContext
+  # resolver so engine-depth child spans join the request's trace. All
+  # duck-typed and None by default — a standalone engine (tests, bench)
+  # records nothing and pays only a None check.
+  flight = None
+  metrics = None
+  tracer = None
+  trace_ctx = None
+
   @abstractmethod
   async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
     ...

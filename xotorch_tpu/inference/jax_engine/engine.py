@@ -180,7 +180,7 @@ class _DecodeBatcher:
     the loop degenerates to back-to-back slices (one event-loop tick of
     overhead per slice — noise next to segment compute)."""
     fut = asyncio.get_running_loop().create_future()
-    self.pending_prefill.append((fn, fut))
+    self.pending_prefill.append((fn, fut, time.monotonic()))
     if not self._draining:
       self._draining = True
       self._drain_task = spawn_detached(self._drain())
@@ -190,8 +190,11 @@ class _DecodeBatcher:
                    num_tokens: int, temp: float, top_k: int, top_p: float = 0.0,
                    next_size: Optional[int] = None) -> np.ndarray:
     fut = asyncio.get_running_loop().create_future()
+    # Enqueue timestamp rides the item (index 8, always just before fut) so
+    # the drain loop can observe true queue wait per lane — the
+    # xot_queue_wait_seconds SLO signal admission control keys off.
     self.pending.append((request_id, state, prev_token, num_tokens, temp, top_k, top_p,
-                         next_size, fut))
+                         next_size, time.monotonic(), fut))
     if not self._draining:
       self._draining = True
       self._drain_task = spawn_detached(self._drain())
@@ -209,6 +212,11 @@ class _DecodeBatcher:
       batch: list = []
       while self.pending or self.pending_prefill:
         batch, self.pending = self.pending, []
+        m = self.engine.metrics
+        if m is not None and batch:
+          take_t = time.monotonic()
+          for it in batch:
+            m.queue_wait_decode.observe(take_t - it[8])
         # Only (top_k, top_p) are compile-time sampling constants:
         # temperature is TRACED per row (ops/sampling.sample_logits), so
         # requests at different temperatures — and different points of the
@@ -234,6 +242,7 @@ class _DecodeBatcher:
           for off in range(0, len(items), cap):
             chunk_items = items[off:off + cap]
             try:
+              t0 = time.monotonic()
               if self.dispatch is not None:
                 results = await self.dispatch(chunk_items, num_tokens, top_k, top_p,
                                               single_dispatch)
@@ -242,6 +251,28 @@ class _DecodeBatcher:
                   self.engine._decode_batch_sync, self.ctx, chunk_items, num_tokens, top_k, top_p,
                   single_dispatch,
                 )
+              secs = time.monotonic() - t0
+              fl = self.engine.flight
+              if fl is not None:
+                # Node-scoped (request_id=None) so the event survives into
+                # EVERY co-batched request's frozen snapshot — a stalled
+                # member's postmortem must show the dispatches that ran
+                # while it was resident, whichever request led the chunk.
+                fl.record("batcher.dispatch", None,
+                          lead=chunk_items[0][0], batch=len(chunk_items),
+                          tokens=num_tokens, secs=round(secs, 6))
+              # First-compile classification: a new (padded batch width,
+              # chunk size, sampling constants) tuple means a fresh
+              # executable — the compile stall the watchdog soak needs to
+              # see. The width is padded to the same power-of-two bucket
+              # the decode paths compile for (B_pad), so a batch of 3
+              # riding the padded-4 executable counts as the cache hit it
+              # is.
+              self.engine._observe_dispatch(
+                "decode", ("decode", self.dispatch is not None,
+                           _bucket(len(chunk_items), 1),
+                           num_tokens, int(top_k), float(top_p)),
+                secs, batch=len(chunk_items), tokens=num_tokens)
               for (*_, fut), toks in zip(chunk_items, results):
                 if not fut.done():
                   fut.set_result(toks)
@@ -255,9 +286,16 @@ class _DecodeBatcher:
         # errors (pool exhaustion, capacity) land on the slice's own future
         # and fail only its request; the drain loop keeps serving.
         if self.pending_prefill:
-          fn, fut = self.pending_prefill.pop(0)
+          fn, fut, enq_t = self.pending_prefill.pop(0)
+          if m is not None:
+            m.queue_wait_prefill.observe(time.monotonic() - enq_t)
           try:
+            t0 = time.monotonic()
             res = await self.engine._run(fn)
+            fl = self.engine.flight
+            if fl is not None:
+              fl.record("batcher.prefill_slice", None,
+                        secs=round(time.monotonic() - t0, 6))
             if not fut.done():
               fut.set_result(res)
           except Exception as e:
@@ -278,7 +316,7 @@ class _DecodeBatcher:
       for *_, fut in batch + failed:
         if not fut.done():
           fut.set_exception(e)
-      for _, fut in failed_prefill:
+      for _, fut, _enq in failed_prefill:
         if not fut.done():
           fut.set_exception(e)
     finally:
@@ -383,6 +421,15 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._overlap_misses = 0
     self._overlap_batch_hits = 0
     self._overlap_batch_misses = 0
+    # First-compile observability: executable identity keys already
+    # dispatched once. The FIRST dispatch of a new key pays XLA compilation
+    # (the stall that can false-trip the PR 4 watchdog on compile-heavy
+    # first requests); later dispatches hit the jit cache. Split counters
+    # export via /metrics, and each miss records an `engine.compile` flight
+    # event carrying the observed wall time.
+    self._exec_seen: set = set()
+    self._jit_first_dispatches = 0
+    self._jit_cached_dispatches = 0
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -549,6 +596,38 @@ class JAXShardInferenceEngine(InferenceEngine):
     axes["tp"] = max(t, 1)
     return make_mesh(axes, jax.local_devices())
 
+  def _engine_span(self, name: str, request_id: Optional[str],
+                   attributes: Optional[dict] = None):
+    """A child span of the request's trace for an engine-depth phase, or a
+    no-op context when tracing is off / no trace context exists (an orphan
+    engine span without a request parent would pollute the buffer with
+    single-span traces)."""
+    from contextlib import nullcontext
+    tr = self.tracer
+    if tr is None or not tr.enabled or self.trace_ctx is None or not request_id:
+      return nullcontext()
+    ctx = self.trace_ctx(request_id)
+    if ctx is None:
+      return nullcontext()
+    return tr.start_span(name, parent=ctx,
+                         attributes={"request.id": request_id, **(attributes or {})})
+
+  def _observe_dispatch(self, kind: str, key: tuple, seconds: float,
+                        batch: int = 1, tokens: int = 0) -> None:
+    """Classify one device dispatch as jit-cache miss (first sighting of
+    this executable identity key) or hit, and record the miss — with its
+    wall time, which includes the compile — as a flight event. The key is a
+    static-shape proxy for the executable (batch width, chunk/bucket size,
+    sampling constants): exactly the tuple a recompile keys off."""
+    if key not in self._exec_seen:
+      self._exec_seen.add(key)
+      self._jit_first_dispatches += 1
+      if self.flight is not None:
+        self.flight.record("engine.compile", None, kind=kind, batch=batch,
+                           tokens=tokens, secs=round(seconds, 4))
+    else:
+      self._jit_cached_dispatches += 1
+
   async def _run(self, fn, *args, oom_as_cache_exhausted: bool = True):
     """Every device computation funnels through the single-worker executor.
     HBM exhaustion is caught HERE: the engine frees what it can (prefix
@@ -624,8 +703,19 @@ class JAXShardInferenceEngine(InferenceEngine):
       n_ctx += 1
     import jax
     jax.clear_caches()  # drop compiled executables' scratch allocations too
-    return (f"{n_snap} prefix snapshots ({n_spill} spilled to host tier), "
-            f"{n_state} request states, {n_ctx} model contexts")
+    # clear_caches also wiped the jit cache: every executable identity is
+    # about to compile again — reset the first-dispatch classifier so the
+    # recompiles are counted as misses, not silently misread as hits.
+    self._exec_seen.clear()
+    freed = (f"{n_snap} prefix snapshots ({n_spill} spilled to host tier), "
+             f"{n_state} request states, {n_ctx} model contexts")
+    if self.flight is not None:
+      self.flight.record("engine.oom_recovery", None, recovery=self._oom_count,
+                         freed=freed)
+      # OOM recovery is a terminal anomaly for every resident request:
+      # freeze the whole ring so the postmortem shows what led up to it.
+      self.flight.freeze(None, reason=f"oom_recovery:{self._oom_count}")
+    return freed
 
   # ------------------------------------------------------------- public API
 
@@ -940,7 +1030,21 @@ class JAXShardInferenceEngine(InferenceEngine):
     cosched = (self._cosched_on() and self._decode_batch_max() > 1 and others_active
                and getattr(input_data, "ndim", 0) == 2 and input_data.shape[0] == 1
                and input_data.shape[1] > chunk)
+    tokens_in = int(input_data.shape[1]) if getattr(input_data, "ndim", 0) == 2 else 0
     if not cosched:
+      # T==1 is a per-token decode step riding this entry point, not a
+      # prefill — a span per token would swamp the trace buffer.
+      if tokens_in > 1:
+        t0 = time.monotonic()
+        with self._engine_span("engine.prefill", request_id,
+                               {"tokens": tokens_in, "cosched": False}):
+          tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data,
+                                temp, top_k, top_p, sampling)
+        self._observe_dispatch("prefill",
+                               ("prefill", _bucket(tokens_in), int(top_k),
+                                float(top_p)),
+                               time.monotonic() - t0, tokens=tokens_in)
+        return tok
       return await self._run(self._infer_sample_sync, ctx, request_id, input_data,
                              temp, top_k, top_p, sampling)
     if ctx.batcher is None:
@@ -948,41 +1052,43 @@ class JAXShardInferenceEngine(InferenceEngine):
     batcher = ctx.batcher
     paged_native = self._paged_prefill_ok(ctx, request_id, input_data, sampling)
     is_fresh = request_id not in ctx.states
-    # The prologue rides the prefill lane too: prefix reuse may restore a
-    # spilled prefix from the HOST tier (H2D stream into fresh pool pages,
-    # _host_promote) — admitted as one bounded drain-cycle unit, decode
-    # dispatches first, so co-resident streams never stall on the copy.
-    full_prompt, consumed = await batcher.submit_prefill(
-      partial(self._prefill_begin_sync, ctx, request_id, input_data, paged_native))
-    if consumed:
-      input_data = input_data[:, consumed:]
-    try:
-      true_t = input_data.shape[1]
-      split = ((true_t - 1) // chunk) * chunk if true_t > chunk else 0
-      step = self._prefill_chunk_budget() * chunk
-      for off in range(0, split, step):
-        sl = input_data[:, off:min(off + step, split)]
-        # expected_pos guards slice continuity: only the very first slice of
-        # an unseeded request may create the state; every later slice must
-        # find it exactly where the previous slice left it (LRU churn
-        # between slices otherwise silently restarts at pos 0). The first
-        # slice reserves capacity for the WHOLE remaining prompt so the
-        # contiguous path allocates once instead of grow-copying per slice.
-        expected = consumed + off if (consumed or off) else None
-        await batcher.submit_prefill(
-          partial(self._prefill_fill_sync, ctx, request_id, sl, paged_native,
-                  expected, true_t if off == 0 else None))
-      return await batcher.submit_prefill(
-        partial(self._prefill_sample_sync, ctx, request_id, input_data[:, split:],
-                temp, top_k, top_p, sampling, paged_native, full_prompt,
-                consumed + split if (consumed or split) else None))
-    except CacheExhausted:
-      # Pool/capacity exhaustion mid-prefill kills only THIS request: its
-      # partial pages return to the pool at once, so the co-scheduled
-      # decode streams it was interleaving with never feel the pressure.
-      if paged_native and is_fresh:
-        await self._run(self._abort_paged_prefill, ctx, request_id)
-      raise
+    with self._engine_span("engine.prefill", request_id,
+                           {"tokens": tokens_in, "cosched": True}):
+      # The prologue rides the prefill lane too: prefix reuse may restore a
+      # spilled prefix from the HOST tier (H2D stream into fresh pool pages,
+      # _host_promote) — admitted as one bounded drain-cycle unit, decode
+      # dispatches first, so co-resident streams never stall on the copy.
+      full_prompt, consumed = await batcher.submit_prefill(
+        partial(self._prefill_begin_sync, ctx, request_id, input_data, paged_native))
+      if consumed:
+        input_data = input_data[:, consumed:]
+      try:
+        true_t = input_data.shape[1]
+        split = ((true_t - 1) // chunk) * chunk if true_t > chunk else 0
+        step = self._prefill_chunk_budget() * chunk
+        for off in range(0, split, step):
+          sl = input_data[:, off:min(off + step, split)]
+          # expected_pos guards slice continuity: only the very first slice of
+          # an unseeded request may create the state; every later slice must
+          # find it exactly where the previous slice left it (LRU churn
+          # between slices otherwise silently restarts at pos 0). The first
+          # slice reserves capacity for the WHOLE remaining prompt so the
+          # contiguous path allocates once instead of grow-copying per slice.
+          expected = consumed + off if (consumed or off) else None
+          await batcher.submit_prefill(
+            partial(self._prefill_fill_sync, ctx, request_id, sl, paged_native,
+                    expected, true_t if off == 0 else None))
+        return await batcher.submit_prefill(
+          partial(self._prefill_sample_sync, ctx, request_id, input_data[:, split:],
+                  temp, top_k, top_p, sampling, paged_native, full_prompt,
+                  consumed + split if (consumed or split) else None))
+      except CacheExhausted:
+        # Pool/capacity exhaustion mid-prefill kills only THIS request: its
+        # partial pages return to the pool at once, so the co-scheduled
+        # decode streams it was interleaving with never feel the pressure.
+        if paged_native and is_fresh:
+          await self._run(self._abort_paged_prefill, ctx, request_id)
+        raise
 
   def _build_extras(self, ctx: _ShardContext, sampling: dict) -> Dict[str, Any]:
     """Materialise a request's sampling extras on device: a dense [1, V]
@@ -1611,7 +1717,15 @@ class JAXShardInferenceEngine(InferenceEngine):
     if self._host_kv is None:
       from xotorch_tpu.inference.jax_engine.kv_offload import HostKVStore
       self._host_kv = HostKVStore(max_bytes)
+      self._host_kv.observer = self._host_evict_event
     return self._host_kv
+
+  def _host_evict_event(self, entries: int, nbytes: int) -> None:
+    """HostKVStore budget-eviction callback: the tier silently dropping warm
+    prefixes to fit its budget is exactly the kind of invisible decision the
+    flight recorder exists to capture."""
+    if self.flight is not None:
+      self.flight.record("host.evict", None, entries=entries, bytes=nbytes)
 
   def host_kv_stats(self) -> Optional[Dict[str, int]]:
     """Occupancy of the host tier for /metrics gauges, or None while no
@@ -1642,6 +1756,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     if store is None:
       return False
     try:
+      t0 = time.monotonic()
       toks = np.asarray(toks).reshape(-1).astype(np.int64)
       if isinstance(entry, dict) and "pages" in entry:
         pool = ctx.page_pool
@@ -1657,6 +1772,9 @@ class JAXShardInferenceEngine(InferenceEngine):
       n = store.put(ctx.shard, toks, data, length)
       if n > 0:
         self._host_spill_bytes += n
+        if self.flight is not None:
+          self.flight.record("host.spill", None, tokens=length, bytes=n,
+                             secs=round(time.monotonic() - t0, 4))
         if DEBUG >= 2:
           print(f"prefix entry spilled to host tier: {length} tokens, {n} bytes")
       return n > 0
@@ -1684,6 +1802,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     entry, common = store.match(ctx.shard, toks, limit)
     if entry is None:
       return
+    t0 = time.monotonic()
     usable = min(common, entry.length)
     want_paged = (self._paged_on() and self._paged_ok(ctx)
                   and set(entry.data) == {"k", "v"})
@@ -1755,6 +1874,9 @@ class JAXShardInferenceEngine(InferenceEngine):
         ctx.page_pool.decref(evicted["pages"])
     self._host_kv_hits += 1
     self._host_fetch_bytes += entry.nbytes
+    if self.flight is not None:
+      self.flight.record("host.restore", None, tokens=entry.length,
+                         bytes=entry.nbytes, secs=round(time.monotonic() - t0, 4))
     if DEBUG >= 2:
       print(f"host KV tier hit: {entry.length}-token prefix restored "
             f"({entry.nbytes} bytes H2D)")
@@ -2645,8 +2767,14 @@ class JAXShardInferenceEngine(InferenceEngine):
     pressure demotes the warm set one level instead of destroying it."""
     while True:
       try:
-        return pool.alloc(n)
+        ids = pool.alloc(n)
+        if self.flight is not None and n > 0:
+          self.flight.record("pool.alloc", None, pages=n, free=pool.free_pages)
+        return ids
       except CacheExhausted:
+        if self.flight is not None:
+          self.flight.record("pool.pressure", None, need=n, free=pool.free_pages,
+                             in_use=pool.pages_in_use)
         evicted = False
         while ctx.prefix_cache and not evicted:
           _, (etoks, entry) = ctx.prefix_cache.popitem(last=False)
@@ -2860,7 +2988,8 @@ class JAXShardInferenceEngine(InferenceEngine):
     if not pools:
       return None
     return {"pages_in_use": sum(p.pages_in_use for p in pools),
-            "free_pages": sum(p.free_pages for p in pools)}
+            "free_pages": sum(p.free_pages for p in pools),
+            "peak_pages_in_use": sum(p.peak_pages_in_use for p in pools)}
 
   def _release_state_pages(self, ctx: _ShardContext, state: _RequestState) -> None:
     """Drop a finished/evicted request's page references (committed table

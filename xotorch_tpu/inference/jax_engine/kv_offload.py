@@ -79,6 +79,11 @@ class HostKVStore:
     self._entries: "OrderedDict[Tuple[Any, int], HostKVEntry]" = OrderedDict()
     self._bytes = 0
     self._lock = threading.Lock()
+    # Optional eviction callback `(entries_dropped, bytes_dropped)`, invoked
+    # OUTSIDE the lock after a put() had to LRU-evict to fit the budget —
+    # the engine wires it to the flight recorder so silent tier churn is
+    # visible in postmortems.
+    self.observer = None
 
   # ------------------------------------------------------------------ stats
 
@@ -105,6 +110,7 @@ class HostKVStore:
       return 0
     entry = HostKVEntry(toks=toks, data=dict(data), length=int(length), nbytes=nbytes)
     key = (ctx_key, hash(toks.tobytes()))
+    dropped, dropped_bytes = 0, 0
     with self._lock:
       old = self._entries.pop(key, None)
       if old is not None:
@@ -114,6 +120,13 @@ class HostKVStore:
       while self._bytes > self.max_bytes and len(self._entries) > 1:
         _, evicted = self._entries.popitem(last=False)
         self._bytes -= evicted.nbytes
+        dropped += 1
+        dropped_bytes += evicted.nbytes
+    if dropped and self.observer is not None:
+      try:
+        self.observer(dropped, dropped_bytes)
+      except Exception:
+        pass  # observability must never fail a spill
     return nbytes
 
   # ------------------------------------------------------------------- read

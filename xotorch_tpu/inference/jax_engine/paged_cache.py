@@ -67,6 +67,9 @@ class PagePool:
     self._ref[0] = 1
     # Pop from the END yields ascending ids (nicer to read in debug dumps).
     self._free: List[int] = list(range(num_pages - 1, 0, -1))
+    # High-water mark of concurrently referenced pages: the pool-sizing
+    # signal (XOT_KV_POOL_TOKENS) — exported as xot_kv_pool_peak_pages.
+    self.peak_pages_in_use = 0
 
   # ------------------------------------------------------------- bookkeeping
 
@@ -98,6 +101,8 @@ class PagePool:
     ids = [self._free.pop() for _ in range(n)]
     for p in ids:
       self._ref[p] = 1
+    if self.pages_in_use > self.peak_pages_in_use:
+      self.peak_pages_in_use = self.pages_in_use
     return ids
 
   def incref(self, page_ids) -> None:

@@ -169,18 +169,24 @@ class GRPCPeerHandle(PeerHandle):
                         top_p: Optional[float] = None, ring_map: Optional[list] = None,
                         deadline: Optional[float] = None) -> None:
     tensors = {f"image_{i}": np.ascontiguousarray(img) for i, img in enumerate(images or [])}
+    seq = faults.hop_seq()
+    if self.flight is not None:
+      self.flight.record("hop.send", request_id, rpc="SendPrompt", peer=self._id, seq=seq)
     await self._call("SendPrompt", {
       "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
       "max_tokens": max_tokens, "n_images": len(tensors) or None, "temperature": temperature,
-      "top_p": top_p, "ring_map": ring_map, "deadline": deadline, "hop_seq": faults.hop_seq(),
+      "top_p": top_p, "ring_map": ring_map, "deadline": deadline, "hop_seq": seq,
     }, tensors or None)
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
                         inference_state: Optional[dict] = None) -> None:
+    seq = faults.hop_seq()
+    if self.flight is not None:
+      self.flight.record("hop.send", request_id, rpc="SendTensor", peer=self._id, seq=seq)
     await self._call(
       "SendTensor",
       {"shard": shard.to_dict(), "request_id": request_id, "inference_state": inference_state,
-       "hop_seq": faults.hop_seq()},
+       "hop_seq": seq},
       {"tensor": tensor},
     )
 

@@ -75,6 +75,8 @@ class InProcessPeerHandle(PeerHandle):
     # seq + dedup + retry wrapper mirror the gRPC handle so injected faults
     # exercise the identical survivability machinery in-process.
     seq = faults.hop_seq()
+    if self.flight is not None:
+      self.flight.record("hop.send", request_id, rpc="SendPrompt", peer=self.node.id, seq=seq)
 
     async def attempt():
       flags = await faults.apply("SendPrompt", self.node.id)
@@ -93,6 +95,8 @@ class InProcessPeerHandle(PeerHandle):
     # `tensor` may be a jax device array — passed through untouched; the
     # receiving engine consumes it without a host copy.
     seq = faults.hop_seq()
+    if self.flight is not None:
+      self.flight.record("hop.send", request_id, rpc="SendTensor", peer=self.node.id, seq=seq)
 
     async def attempt():
       flags = await faults.apply("SendTensor", self.node.id)

@@ -17,6 +17,12 @@ from xotorch_tpu.topology.topology import Topology
 
 
 class PeerHandle(ABC):
+  # Owning node's FlightRecorder (attached at peer-set assignment,
+  # Node._update_peers_locked): ring-hop sends record `hop.send` events —
+  # with their dedup seq ids — into the SENDER's timeline. None until a
+  # node adopts the handle; handles used standalone record nothing.
+  flight = None
+
   @abstractmethod
   def id(self) -> str:
     ...

@@ -40,6 +40,29 @@ class NodeMetrics:
       "xot_hop_seconds", "Per-hop processing time (infer_tensor)", ["node_id"], registry=self.registry,
       buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
     ).labels(**labels)
+    # SLO histograms: the latencies admission control / the replicated-rings
+    # router will actually route on. TTFT and whole-request latency are
+    # observed by whichever node samples/finishes (per-node view, labeled);
+    # queue wait is observed by the engine's decode batcher, split by lane
+    # (decode chunk vs co-scheduled prefill slice).
+    self.ttft = Histogram(
+      "xot_ttft_seconds", "Time from prompt acceptance to the first sampled token",
+      ["node_id"], registry=self.registry,
+      buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+    ).labels(**labels)
+    self.request_latency = Histogram(
+      "xot_request_seconds", "Whole-request wall time (first touch to finish, any outcome)",
+      ["node_id"], registry=self.registry,
+      buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+    ).labels(**labels)
+    queue_wait = Histogram(
+      "xot_queue_wait_seconds",
+      "Time a decode chunk or prefill slice waited in the engine batcher before dispatch",
+      ["node_id", "lane"], registry=self.registry,
+      buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+    )
+    self.queue_wait_decode = queue_wait.labels(node_id=node_id, lane="decode")
+    self.queue_wait_prefill = queue_wait.labels(node_id=node_id, lane="prefill")
     # Request-survivability counters (ring survivability layer): watchdog
     # aborts, health-driven evictions, API-side transparent restarts, and
     # retried hop deliveries dropped by receiver-side dedup.
@@ -83,3 +106,44 @@ class NodeMetrics:
     scrapers see a conforming endpoint."""
     from prometheus_client import CONTENT_TYPE_LATEST
     return self.exposition(), CONTENT_TYPE_LATEST
+
+  def summary(self) -> dict:
+    """Compact JSON-safe summary for the cluster metrics rollup: counters as
+    numbers, histograms as {sum, count}. Rides the opaque-status bus so one
+    /v1/cluster/metrics scrape on any node sees every peer. Reads the
+    client library's value cells directly (the same access the test suite
+    uses); a field whose cell shape ever changes is omitted, never wrong."""
+    def counter(metric):
+      try:
+        return metric._value.get()
+      except AttributeError:
+        return None
+
+    def hist(metric):
+      try:
+        return {"sum": metric._sum.get(), "count": sum(b.get() for b in metric._buckets)}
+      except AttributeError:
+        return None
+
+    out = {}
+    for key, metric in (
+      ("requests", self.requests_total), ("tokens", self.tokens_total),
+      ("tensor_hops", self.tensor_hops_total), ("active_requests", self.active_requests),
+      ("peers", self.peers), ("watchdog_aborts", self.watchdog_aborts_total),
+      ("peer_evictions", self.peer_evictions_total),
+      ("request_restarts", self.request_restarts_total),
+      ("dedup_drops", self.dedup_drops_total),
+    ):
+      v = counter(metric)
+      if v is not None:
+        out[key] = v
+    for key, metric in (
+      ("ttft_seconds", self.ttft), ("request_seconds", self.request_latency),
+      ("queue_wait_decode_seconds", self.queue_wait_decode),
+      ("queue_wait_prefill_seconds", self.queue_wait_prefill),
+      ("token_seconds", self.token_latency), ("hop_seconds", self.hop_latency),
+    ):
+      v = hist(metric)
+      if v is not None:
+        out[key] = v
+    return out

@@ -41,6 +41,7 @@ from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES
 from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitions_to_shards
 from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
 from xotorch_tpu.orchestration.metrics import NodeMetrics
+from xotorch_tpu.orchestration.flight import FlightRecorder
 from xotorch_tpu.topology.topology import Topology
 from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG, AsyncCallbackSystem, spawn_detached
@@ -174,11 +175,36 @@ class Node:
     self.outstanding_requests: Dict[str, str] = {}
 
     # Observability: real spans + real prometheus metrics for the intents the
-    # reference declared but never wired (SURVEY §0, §5).
+    # reference declared but never wired (SURVEY §0, §5), plus the always-on
+    # flight recorder whose frozen snapshots turn watchdog aborts into
+    # replayable timelines (/v1/debug/flight).
     self.tracer = Tracer(node_id=self.id)
     self.metrics = NodeMetrics(node_id=self.id)
+    self.flight = FlightRecorder(node_id=self.id)
     self._request_trace_ctx: Dict[str, Any] = {}
     self._last_token_time: Dict[str, float] = {}
+    # First-touch monotonic timestamp per request — feeds the TTFT and
+    # whole-request SLO histograms (each node observes its own view).
+    self._request_started: Dict[str, float] = {}
+    # Latest metric summaries received from peers over the status bus
+    # (type "node_metrics"); served by /v1/cluster/metrics so one scrape
+    # sees the whole ring. Bounded by cluster size in practice; the LRU
+    # guard protects against id churn.
+    self.peer_metrics: "OrderedDict[str, dict]" = OrderedDict()
+    # Engine-depth observability: hand the engine this node's recorder,
+    # metrics registry, tracer, and a trace-context resolver so batcher
+    # queue waits, prefill slices, pool pressure, host-tier traffic, and
+    # first-compile events surface as spans/histograms/flight events.
+    # Duck-typed (base-class attrs default None): every engine accepts the
+    # hooks, engines that never call them pay nothing.
+    for hook, value in (("metrics", self.metrics), ("flight", self.flight),
+                        ("tracer", self.tracer),
+                        ("trace_ctx", self._request_trace_ctx.get)):
+      try:
+        setattr(inference_engine, hook, value)
+      except Exception as e:
+        if DEBUG >= 2:
+          print(f"engine observability hook {hook} not attached: {e!r}")
     # Per-request completion caps (OpenAI max_tokens); rides the
     # inference_state side-channel to whichever peer owns the last layer.
     self._request_max_tokens: Dict[str, int] = {}
@@ -290,15 +316,19 @@ class Node:
 
   # ------------------------------------------------------- survivability
 
-  def start_watchdog(self) -> None:
+  def start_watchdog(self, request_id: Optional[str] = None) -> None:
     """Arm the deadline/stall watchdog (no-op when nothing needs it).
     Also called lazily from _note_progress / deadline adoption so Nodes
     driven without start() — the test harness pattern — still get
     coverage, and a peer whose OWN knobs are off still enforces a deadline
-    that arrived via hop metadata (the origin may be the node that died)."""
+    that arrived via hop metadata (the origin may be the node that died).
+    `request_id` is the request whose progress/deadline triggered the lazy
+    arming — recorded so a flight snapshot shows the arming→firing pair."""
     if self._watchdog_task is None and (
         self.stall_timeout_s > 0 or self.request_deadline_s > 0 or self._request_deadline):
       self._watchdog_task = self._spawn(self._watchdog_loop())
+      self.flight.record("watchdog.armed", request_id,
+                         stall_s=self.stall_timeout_s, deadline_s=self.request_deadline_s)
 
   def start_health_monitor(self) -> None:
     if self._health_task is None and self.health_interval_s > 0:
@@ -306,7 +336,7 @@ class Node:
 
   def _note_progress(self, request_id: str) -> None:
     self._last_progress[request_id] = time.monotonic()
-    self.start_watchdog()
+    self.start_watchdog(request_id)
 
   def note_hop_delivery(self, request_id: Optional[str], hop_seq: Optional[str]) -> bool:
     """Receiver-side dedup for retried hops: True admits the delivery, False
@@ -327,6 +357,7 @@ class Node:
     self._hop_seen.move_to_end(key)
     if hop_seq in seen:
       self.metrics.dedup_drops_total.inc()
+      self.flight.record("hop.dedup_drop", request_id, seq=hop_seq)
       if DEBUG >= 2:
         print(f"[{request_id}] duplicate hop delivery {hop_seq} dropped")
       return False
@@ -352,6 +383,8 @@ class Node:
             continue
           if rid in self.outstanding_requests or rid in self.buffered_token_output:
             self.metrics.watchdog_aborts_total.inc()
+            self.flight.record("deadline.expired", rid, overdue_s=round(now - dl, 3))
+            self.flight.record("watchdog.fired", rid, kind="deadline")
             await self._abort_request(rid, f"deadline_exceeded: request blew its deadline on {self.id}")
           else:
             self._request_deadline.pop(rid, None)  # finished elsewhere; GC the row
@@ -368,6 +401,8 @@ class Node:
               self._last_progress[rid] = now
             elif now - last > self.stall_timeout_s:
               self.metrics.watchdog_aborts_total.inc()
+              self.flight.record("watchdog.fired", rid, kind="stall",
+                                 idle_s=round(now - last, 3))
               await self._abort_request(
                 rid, f"stalled: no progress for {now - last:.2f}s on {self.id} "
                      f"(stall timeout {self.stall_timeout_s:g}s)")
@@ -400,6 +435,7 @@ class Node:
       faults.bump("health_check_failures")
       fails = self._health_fails.get(peer.id(), 0) + 1
       self._health_fails[peer.id()] = fails
+      self.flight.record("health.check_failed", None, peer=peer.id(), fails=fails)
       if fails >= evict_after:
         await self._evict_peer(peer)
 
@@ -411,6 +447,12 @@ class Node:
     self._health_fails.pop(peer.id(), None)
     self.metrics.peer_evictions_total.inc()
     self.metrics.peers.set(len(self.peers))
+    self.flight.record("peer.evicted", None, peer=peer.id(),
+                       cooldown_s=self.evict_cooldown_s)
+    # An eviction is a terminal anomaly for whatever was riding that peer:
+    # freeze a node-scope snapshot now (in-flight requests usually follow
+    # with their own watchdog/hop-error freeze via _abort_request).
+    self.flight.freeze(None, reason=f"peer_evicted:{peer.id()}")
     try:
       await peer.disconnect()
     except Exception as e:
@@ -458,6 +500,16 @@ class Node:
         self.topology_inference_engines_pool.append(status.get("engines", []))
       elif status_type == "download_progress":
         self.node_download_progress[status.get("node_id")] = status.get("progress")
+      elif status_type == "trace_spans":
+        # Cluster trace rollup (receiver side): adopt a peer's finished
+        # spans so a single /v1/traces call on ANY node returns the whole
+        # ring's trace for a request. Own broadcasts echo locally — skip.
+        if status.get("node_id") != self.id:
+          self.tracer.ingest(status.get("spans") or [])
+      elif status_type == "node_metrics":
+        nid = status.get("node_id")
+        if nid and nid != self.id:
+          self.ingest_peer_metrics(nid, status.get("metrics") or {})
       elif status_type == "resume_checkpoint":
         # Cluster-wide resume: each peer loads ITS layer range from the
         # shared checkpoint directory, so a multi-partition training ring
@@ -511,6 +563,9 @@ class Node:
         self._request_deadline[request_id] = time.monotonic() + max(0.0, float(deadline))
       elif self.request_deadline_s > 0:
         self._request_deadline[request_id] = time.monotonic() + self.request_deadline_s
+    self._request_started.setdefault(request_id, time.monotonic())
+    self.flight.record("request.admitted", request_id, model=base_shard.model_id,
+                       origin=traceparent is None)
     self._note_progress(request_id)
     if ring_map:
       # Forwarded prompt: route by the SENDER's pinned map, not our own
@@ -643,6 +698,9 @@ class Node:
     self.outstanding_requests[request_id] = "processing tensor"
     self.metrics.active_requests.set(len(self.outstanding_requests))
     self.metrics.tensor_hops_total.inc()
+    self._request_started.setdefault(request_id, time.monotonic())
+    self.flight.record("hop.recv", request_id,
+                       layers=f"{shard.start_layer}-{shard.end_layer}")
     self._note_progress(request_id)
     if inference_state and request_id not in self._request_deadline:
       d = inference_state.get(DEADLINE_KEY)
@@ -733,6 +791,11 @@ class Node:
     string rides the broadcast so API nodes surface a real error instead of
     an empty successful completion."""
     self.record_request_error(request_id, error)
+    # Freeze the request's flight timeline BEFORE cleanup churns the ring:
+    # watchdog aborts, blown deadlines, and hop errors each become a
+    # replayable /v1/debug/flight snapshot instead of one log line.
+    self.flight.record("request.aborted", request_id, error=error[:200])
+    self.flight.freeze(request_id, reason=error[:200])
     # Watchdog/deadline aborts can fire while the request's driving task is
     # still alive (a hung engine call, a loop awaiting a dead peer): the
     # cancel flag makes any late-completing local work stop at its next
@@ -1111,6 +1174,12 @@ class Node:
       if int(t) in eos or len(buffered) >= limit:
         finished = True
         break
+    if last is None and appended:
+      # First sampled token on this node: the TTFT SLO observation, measured
+      # from this node's first touch of the request (prompt/hop arrival).
+      started = self._request_started.get(request_id)
+      if started is not None:
+        self.metrics.ttft.observe(now - started)
     if last is not None and appended:
       self.metrics.token_latency.observe((now - last) / appended)
     self._last_token_time[request_id] = now
@@ -1594,6 +1663,10 @@ class Node:
     # this read-modify-write completing with its stale snapshot.
     self.peers = [p for p in peers_kept + [p for p, ok in zip(peers_added, connected) if ok]
                   if not self._is_evicted(p.id())]
+    for p in self.peers:
+      # Hand each peer handle this node's flight recorder so hop.send events
+      # (with their dedup seq ids) land in the SENDER's timeline.
+      p.flight = self.flight
     self.metrics.peers.set(len(self.peers))
     return bool(peers_added or peers_removed)
 
@@ -1605,6 +1678,14 @@ class Node:
         if changed:
           await self.collect_topology(set())
           await self.select_best_inference_engine()
+        if self.peers:
+          # Piggyback the cluster metrics rollup on the topology cadence:
+          # a compact summary per tick keeps every peer's
+          # /v1/cluster/metrics view fresh without a new RPC surface.
+          await self.broadcast_opaque_status("", json.dumps({
+            "type": "node_metrics", "node_id": self.id,
+            "metrics": self.metrics_summary(),
+          }))
       except Exception as e:
         if DEBUG >= 1:
           print(f"Topology collection error: {e!r}")
@@ -1670,7 +1751,22 @@ class Node:
     self.outstanding_requests.pop(request_id, None)
     self.metrics.active_requests.set(len(self.outstanding_requests))
     self.tracer.finish_request(request_id)
-    self._request_trace_ctx.pop(request_id, None)
+    started = self._request_started.pop(request_id, None)
+    if started is not None:
+      elapsed = time.monotonic() - started
+      self.metrics.request_latency.observe(elapsed)
+      self.flight.record("request.finished", request_id, secs=round(elapsed, 4))
+    ctx = self._request_trace_ctx.pop(request_id, None)
+    if ctx is not None and ctx.sampled and self.tracer.enabled and self.peers:
+      # Cluster trace rollup: flush THIS node's shard of the request's
+      # spans over the status bus, so any node's /v1/traces returns the
+      # whole ring's trace. The ctx pop above makes this once-per-request
+      # (finish_request_state is idempotent). Spawn guarded: harness code
+      # calls this without a running loop — rollup is best-effort there.
+      try:
+        self._spawn(self._flush_trace_spans(request_id, ctx.trace_id))
+      except RuntimeError:
+        pass  # no running event loop (sync harness/test call): skip rollup
     self._last_token_time.pop(request_id, None)
     self._request_max_tokens.pop(request_id, None)
     self._request_temp.pop(request_id, None)
@@ -1788,6 +1884,36 @@ class Node:
         self._finished_results.popitem(last=False)
       await self._finish_generation(request_id)
     return True, len(merged)
+
+  async def _flush_trace_spans(self, request_id: str, trace_id: str) -> None:
+    """Cluster trace rollup (sender side): ship this node's finished spans
+    for one trace over the opaque-status bus. Export filters by node.id, so
+    spans previously ingested FROM peers are never re-broadcast (no echo
+    amplification); receivers dedup by span id anyway. The short sleep lets
+    the spans enclosing the finish (hop span, prompt root) close first."""
+    await asyncio.sleep(0.05)
+    spans = self.tracer.export(trace_id=trace_id, node_id=self.id)
+    if not spans:
+      return
+    await self.broadcast_opaque_status(request_id, json.dumps({
+      "type": "trace_spans", "node_id": self.id, "request_id": request_id,
+      "trace_id": trace_id, "spans": spans,
+    }))
+
+  def metrics_summary(self) -> dict:
+    """This node's compact metric summary (counters + histogram sum/count)
+    for the cluster rollup — what rides the status bus and what
+    /v1/cluster/metrics serves per node."""
+    summary = self.metrics.summary()
+    summary["node_id"] = self.id
+    summary["ts"] = time.time()
+    return summary
+
+  def ingest_peer_metrics(self, node_id: str, summary: dict) -> None:
+    self.peer_metrics[node_id] = summary
+    self.peer_metrics.move_to_end(node_id)
+    while len(self.peer_metrics) > 64:
+      self.peer_metrics.popitem(last=False)
 
   async def broadcast_opaque_status(self, request_id: str, status: str) -> None:
     async def send(peer):

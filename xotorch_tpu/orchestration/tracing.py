@@ -67,6 +67,10 @@ class Span:
   end_ns: Optional[int] = None
   attributes: Dict[str, Any] = field(default_factory=dict)
   status: str = "OK"
+  # W3C `sampled` flag, inherited from the parent context: an unsampled
+  # trace's spans still flow through call sites unconditionally but are
+  # never appended to the export buffer.
+  sampled: bool = True
 
   def end(self, status: str = "OK") -> None:
     if self.end_ns is None:
@@ -77,7 +81,9 @@ class Span:
     self.attributes[key] = value
 
   def context(self) -> TraceContext:
-    return TraceContext(trace_id=self.trace_id, span_id=self.span_id)
+    # Children inherit the sampling decision (W3C trace-context semantics):
+    # a span created under an unsampled parent must itself be unsampled.
+    return TraceContext(trace_id=self.trace_id, span_id=self.span_id, sampled=self.sampled)
 
   def to_dict(self) -> dict:
     return {
@@ -90,6 +96,22 @@ class Span:
       "attributes": [{"key": k, "value": v} for k, v in self.attributes.items()],
       "status": self.status,
     }
+
+  @classmethod
+  def from_dict(cls, d: dict) -> "Span":
+    """Inverse of to_dict, for spans that arrive from ANOTHER node over the
+    opaque-status bus (cluster trace rollup)."""
+    return cls(
+      name=str(d.get("name", "")),
+      trace_id=str(d.get("traceId", "")),
+      span_id=str(d.get("spanId", "")),
+      parent_span_id=str(d.get("parentSpanId") or "") or None,
+      start_ns=int(d.get("startTimeUnixNano") or 0),
+      end_ns=int(d.get("endTimeUnixNano") or 0) or None,
+      attributes={a["key"]: a.get("value") for a in d.get("attributes", ())
+                  if isinstance(a, dict) and "key" in a},
+      status=str(d.get("status", "OK")),
+    )
 
 
 class _SpanHandle:
@@ -119,6 +141,10 @@ class Tracer:
     self._lock = threading.Lock()
     self._token_groups: Dict[str, Span] = {}
     self._token_counts: Dict[str, int] = {}
+    # span ids already adopted via ingest() (bounded): the status bus fans
+    # out to every peer, so the same remote span can arrive more than once.
+    self._ingested: "deque" = deque(maxlen=8192)
+    self._ingested_set: set = set()
 
   # ----------------------------------------------------------------- spans
 
@@ -136,12 +162,16 @@ class Tracer:
       parent_span_id=parent_span_id,
       start_ns=time.time_ns(),
       attributes={"node.id": self.node_id, **(attributes or {})},
+      sampled=parent.sampled,
     )
     return _SpanHandle(self, span)
 
   def end_span(self, span: Span, status: str = "OK") -> None:
     span.end(status)
-    if self.enabled:
+    # W3C `sampled` flag honored for real: an unsampled trace's spans are
+    # never buffered (the caller still gets a live span object, so call
+    # sites stay unconditional).
+    if self.enabled and span.sampled:
       with self._lock:
         self._finished.append(span)
 
@@ -150,7 +180,7 @@ class Tracer:
   def record_token(self, request_id: str, ctx: Optional[TraceContext]) -> None:
     """Group every 10 sampled tokens into one span under the request trace
     (parity: reference tracing.py:72-103 — span-per-token is too chatty)."""
-    if not self.enabled:
+    if not self.enabled or (ctx is not None and not ctx.sampled):
       return
     with self._lock:
       count = self._token_counts.get(request_id, 0)
@@ -187,9 +217,40 @@ class Tracer:
 
   # ---------------------------------------------------------------- export
 
-  def export(self, trace_id: Optional[str] = None, clear: bool = False) -> List[dict]:
+  def ingest(self, span_dicts: List[dict]) -> int:
+    """Adopt finished spans exported by ANOTHER node (cluster trace rollup:
+    peers flush a request's spans over the opaque-status bus at finish, so
+    one /v1/traces call returns the whole ring's trace). Deduped by span id
+    — the bus fans out, so redeliveries are expected. Returns spans added."""
+    if not self.enabled:
+      return 0
+    added = 0
     with self._lock:
-      spans = [s.to_dict() for s in self._finished if trace_id is None or s.trace_id == trace_id]
+      for d in span_dicts:
+        try:
+          span = Span.from_dict(d)
+        except Exception:
+          continue  # malformed remote span: skip, never poison the buffer
+        if not span.span_id or span.span_id in self._ingested_set:
+          continue
+        if len(self._ingested) == self._ingested.maxlen:
+          self._ingested_set.discard(self._ingested[0])
+        self._ingested.append(span.span_id)
+        self._ingested_set.add(span.span_id)
+        self._finished.append(span)
+        added += 1
+    return added
+
+  def export(self, trace_id: Optional[str] = None, clear: bool = False,
+             node_id: Optional[str] = None) -> List[dict]:
+    """Finished spans as OTLP-style dicts. `trace_id` filters one trace;
+    `node_id` filters by the span's `node.id` attribute (used by the rollup
+    flush to send only THIS node's shard of a trace, never re-broadcasting
+    spans it ingested from peers)."""
+    with self._lock:
+      spans = [s.to_dict() for s in self._finished
+               if (trace_id is None or s.trace_id == trace_id)
+               and (node_id is None or s.attributes.get("node.id") == node_id)]
       if clear:
         if trace_id is None:
           self._finished.clear()
@@ -206,25 +267,34 @@ class Tracer:
 # ------------------------------------------------------- jax device traces
 
 _profiling = False
+# Two concurrent API calls racing the unguarded flag used to both see
+# _profiling=False and double-start jax.profiler (which raises — or worse,
+# interleaves two trace sessions). The lock is held ACROSS the profiler
+# call, not just the flag flip, so the loser of the race observes the
+# winner's completed start and returns False cleanly.
+_profiling_lock = threading.Lock()
 
 
 def start_device_trace(logdir: str = "/tmp/xot_jax_trace") -> bool:
   """Start a jax.profiler trace (TensorBoard-compatible) alongside the span
-  trace. Returns False if a trace is already running."""
+  trace. Returns False if a trace is already running. Thread-safe: the API
+  serves concurrent POSTs and jax.profiler tolerates exactly one session."""
   global _profiling
-  if _profiling:
-    return False
-  import jax
-  jax.profiler.start_trace(logdir)
-  _profiling = True
-  return True
+  with _profiling_lock:
+    if _profiling:
+      return False
+    import jax
+    jax.profiler.start_trace(logdir)
+    _profiling = True
+    return True
 
 
 def stop_device_trace() -> bool:
   global _profiling
-  if not _profiling:
-    return False
-  import jax
-  jax.profiler.stop_trace()
-  _profiling = False
-  return True
+  with _profiling_lock:
+    if not _profiling:
+      return False
+    import jax
+    jax.profiler.stop_trace()
+    _profiling = False
+    return True

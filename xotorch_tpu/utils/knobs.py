@@ -124,6 +124,9 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_SIDECAR_QUANT", "str", None, "Native sidecar weight quantization (`int8`); read by the C++ engine.", "Sidecar"),
   # ------------------------------------------------------------ observability
   Knob("XOT_TRACING", "bool", "1", "Record request/hop spans in the in-process tracer (served at /v1/traces).", "Observability"),
+  Knob("XOT_FLIGHT", "bool", "1", "Record runtime events in the per-node flight recorder (served at /v1/debug/flight).", "Observability"),
+  Knob("XOT_FLIGHT_EVENTS", "int", "4096", "Flight-recorder ring capacity (events).", "Observability"),
+  Knob("XOT_FLIGHT_SNAPSHOTS", "int", "16", "Frozen flight-recorder snapshots kept per node (LRU).", "Observability"),
 )
 
 REGISTRY: Dict[str, Knob] = {k.name: k for k in _DEFS}
