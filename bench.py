@@ -57,10 +57,8 @@ def _tpu_peaks(devices):
   d0 = devices[0]
   if d0.platform != "tpu":
     return None, None
-  from xotorch_tpu.topology.device_capabilities import TPU_CHIP_SPECS, _tpu_kind_to_key
-  key = _tpu_kind_to_key(str(getattr(d0, "device_kind", ""))) or "v5e"
-  spec = TPU_CHIP_SPECS.get(key, TPU_CHIP_SPECS["v5e"])
-  return spec["bf16"], spec["hbm_gbps"]
+  from xotorch_tpu.topology.device_capabilities import tpu_chip_peaks
+  return tpu_chip_peaks(getattr(d0, "device_kind", ""))
 
 
 def _calibrate_sync(progress_path: str) -> dict:
@@ -148,8 +146,26 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     params = quantize_params(params, quantize)
   params = jax.block_until_ready(params)
   param_bytes = quantized_bytes(params)
+  # Analytic cost model (costmodel.CostModel): the same math the serving
+  # attribution layer uses, recorded NEXT TO the measured timings so every
+  # harvest carries its own predicted bytes/FLOPs — and cross-checked here
+  # against the real pytree (a layout drift shows up as a mismatch flag in
+  # the JSON, and as a ground-truth test failure in CI).
+  from xotorch_tpu.inference.jax_engine.costmodel import CostModel
+  cm = CostModel(cfg=cfg, n_layers=n, is_first=True, is_last=True,
+                 quantize=quantize or None, dtype_bytes=2)
+  predicted_weight_bytes = cm.weight_bytes()
+  # Fused decode streams the weights once per token and reads the whole
+  # ALLOCATED contiguous cache per step (the XLA path's real traffic).
+  predicted_decode_bytes_per_tok = (predicted_weight_bytes
+                                    + cm.kv_read_bytes_per_token(prefill_len, alloc_tokens=cache_len)
+                                    + cm.kv_write_bytes_per_token())
+  predicted_flops_per_tok = cm.decode_flops_per_token(prefill_len)
   _record(progress_path, f"{stage_prefix}:params", model=model_id,
-          n_params=n_params, gb=round(param_bytes / 1e9, 2), secs=round(time.time() - t0, 1))
+          n_params=n_params, gb=round(param_bytes / 1e9, 2),
+          predicted_gb=round(predicted_weight_bytes / 1e9, 2),
+          predicted_match=predicted_weight_bytes == param_bytes,
+          secs=round(time.time() - t0, 1))
 
   fwd = jax.jit(partial(forward_shard, cfg=cfg, is_first=True, is_last=True), donate_argnums=(2,))
   cache = init_kv_cache(cfg, n, 1, cache_len, jnp.bfloat16)
@@ -471,11 +487,18 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
   # Roofline context: decode does ~2·P MACs/token and must stream the full
   # resident param bytes from HBM each token (2/param at bf16, ~1 at int8) —
   # MFU for the compute view, BW% for the (binding, at batch 1) memory view.
+  # hbm_bw_pct/mfu_pct keep their historical weights-only definitions (every
+  # committed harvest is comparable through benchdiff); the predicted_* pair
+  # below additionally counts the KV traffic the cost model attributes.
   devices = jax.devices()
   peak_tflops, peak_gbps = _tpu_peaks(devices)
   mfu_pct = round(100 * 2 * n_params * toks_per_sec / (peak_tflops * 1e12), 2) if peak_tflops else None
   hbm_pct = round(100 * param_bytes * toks_per_sec / (peak_gbps * 1e9), 2) if peak_gbps else None
   ceiling = round(peak_gbps * 1e9 / param_bytes, 1) if peak_gbps else None
+  predicted_hbm_util_pct = (round(100 * predicted_decode_bytes_per_tok * toks_per_sec
+                                  / (peak_gbps * 1e9), 2) if peak_gbps else None)
+  predicted_mfu_pct = (round(100 * predicted_flops_per_tok * toks_per_sec
+                             / (peak_tflops * 1e12), 2) if peak_tflops else None)
 
   result = {
     "model_id": model_id,
@@ -502,24 +525,37 @@ def _run_config(model_id: str, prefill_len: int, decode_tokens: int, chunk: int,
     "mfu_pct": mfu_pct,
     "hbm_bw_pct": hbm_pct,
     "roofline_tok_s": ceiling,
+    "predicted_weight_bytes": predicted_weight_bytes,
+    "predicted_weight_match": predicted_weight_bytes == param_bytes,
+    "predicted_decode_bytes_per_tok": predicted_decode_bytes_per_tok,
+    "predicted_flops_per_tok": predicted_flops_per_tok,
+    "predicted_hbm_util_pct": predicted_hbm_util_pct,
+    "predicted_mfu_pct": predicted_mfu_pct,
     "prefill_len": prefill_len,
     "decode_tokens": decode_tokens,
     **long_result,
   }
   prefill_mfu_val = result.get("prefill_mfu_pct")
+  # Implausibility gate: measured throughput against the COST MODEL's
+  # predicted bytes/FLOPs per token (which include the KV traffic), not the
+  # inline weights-only constants — a backend reporting more bytes/s or
+  # FLOP/s than the chip can physically move is lying about its timings.
+  # The 10% margin absorbs spec slop, exactly as before.
+  gate_hbm = predicted_hbm_util_pct if predicted_hbm_util_pct is not None else hbm_pct
+  gate_mfu = predicted_mfu_pct if predicted_mfu_pct is not None else mfu_pct
   result["implausible"] = bool(
-    (hbm_pct is not None and hbm_pct > 110)
-    or (mfu_pct is not None and mfu_pct > 100)
+    (gate_hbm is not None and gate_hbm > 110)
+    or (gate_mfu is not None and gate_mfu > 100)
     or (prefill_mfu_val is not None and prefill_mfu_val > 100)
     or not tokens_verified
     or not overlap_tokens_match
   )
   if result["implausible"]:
     reasons = []
-    if hbm_pct is not None and hbm_pct > 110:
-      reasons.append(f"hbm_bw_pct={hbm_pct} exceeds physical ceiling")
-    if mfu_pct is not None and mfu_pct > 100:
-      reasons.append(f"mfu_pct={mfu_pct} exceeds 100")
+    if gate_hbm is not None and gate_hbm > 110:
+      reasons.append(f"predicted HBM utilization {gate_hbm} exceeds physical ceiling")
+    if gate_mfu is not None and gate_mfu > 100:
+      reasons.append(f"predicted MFU {gate_mfu} exceeds 100")
     if prefill_mfu_val is not None and prefill_mfu_val > 100:
       reasons.append(f"prefill_mfu_pct={prefill_mfu_val} exceeds 100")
     if not tokens_verified:
@@ -1469,7 +1505,10 @@ def _emit(result: dict) -> None:
             "concurrent_n", "concurrent_tok_s", "single_stream_tok_s",
             "concurrency_speedup", "concurrent_max_batch_width", "concurrent_error",
             "mfu_pct", "hbm_bw_pct", "platform", "n_devices", "device_kind",
-            "n_params", "param_bytes", "stage", "tpu_error", "error"):
+            "n_params", "param_bytes", "stage", "tpu_error", "error",
+            "predicted_weight_bytes", "predicted_weight_match",
+            "predicted_decode_bytes_per_tok", "predicted_flops_per_tok",
+            "predicted_hbm_util_pct", "predicted_mfu_pct"):
     if result.get(k) is not None:
       out[k] = result[k]
   # Quantized-flagship fields (int8_tok_s, int8_speedup, int8_error, ...)

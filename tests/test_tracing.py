@@ -376,3 +376,99 @@ async def test_api_traces_and_metrics_endpoints():
     assert 'xot_queue_wait_seconds_count{lane="prefill",node_id="solo"}' in text
   finally:
     await client.close()
+
+
+def _fake_profiler(monkeypatch, start_sleep=0.0):
+  """Install a counting jax.profiler stub and reset the module trace state."""
+  import jax
+
+  from xotorch_tpu.orchestration import tracing
+
+  calls = {"start": 0, "stop": 0}
+
+  class FakeProfiler:
+    @staticmethod
+    def start_trace(logdir):
+      calls["start"] += 1
+      if start_sleep:
+        time.sleep(start_sleep)
+
+    @staticmethod
+    def stop_trace():
+      calls["stop"] += 1
+
+  monkeypatch.setattr(jax, "profiler", FakeProfiler)
+  monkeypatch.setattr(tracing, "_profiling", False)
+  monkeypatch.setattr(tracing, "_trace_timer", None)
+  return calls
+
+
+def test_device_trace_auto_stops_after_max_s(monkeypatch):
+  """A forgotten /v1/trace/device/start cannot profile forever: the session
+  stops itself after XOT_DEVICE_TRACE_MAX_S."""
+  from xotorch_tpu.orchestration import tracing
+
+  calls = _fake_profiler(monkeypatch)
+  monkeypatch.setenv("XOT_DEVICE_TRACE_MAX_S", "0.05")
+  assert tracing.start_device_trace("/tmp/xot_trace_auto") is True
+  deadline = time.time() + 2.0
+  while tracing._profiling and time.time() < deadline:
+    time.sleep(0.01)
+  assert not tracing._profiling, "auto-stop never fired"
+  assert calls["stop"] == 1
+  # The session is really over: a manual stop now is a no-op...
+  assert tracing.stop_device_trace() is False
+  assert calls["stop"] == 1
+  # ...and a fresh start works.
+  assert tracing.start_device_trace("/tmp/xot_trace_auto") is True
+  assert tracing.stop_device_trace() is True
+
+
+def test_device_trace_auto_stop_races_manual_stop(monkeypatch):
+  """Auto-stop racing a manual stop must stop the profiler EXACTLY once,
+  whichever side wins, and a subsequent session must be startable."""
+  from xotorch_tpu.orchestration import tracing
+
+  for _ in range(5):  # several rounds to actually exercise both orders
+    calls = _fake_profiler(monkeypatch)
+    monkeypatch.setenv("XOT_DEVICE_TRACE_MAX_S", "0.01")
+    assert tracing.start_device_trace("/tmp/xot_trace_race2") is True
+    results = []
+    t = threading.Thread(target=lambda: results.append(tracing.stop_device_trace()))
+    time.sleep(0.01)  # land the manual stop right around the timer's firing
+    t.start()
+    t.join()
+    deadline = time.time() + 1.0
+    while tracing._profiling and time.time() < deadline:
+      time.sleep(0.005)
+    time.sleep(0.03)  # let a losing timer run if it is going to
+    assert calls["stop"] == 1, f"profiler stopped {calls['stop']} times"
+    assert not tracing._profiling
+
+
+def test_device_trace_stale_timer_cannot_kill_new_session(monkeypatch):
+  """A stop-then-restart must not be killed by the PREVIOUS session's timer:
+  the auto-stop checks its generation before touching the profiler."""
+  from xotorch_tpu.orchestration import tracing
+
+  calls = _fake_profiler(monkeypatch)
+  monkeypatch.setenv("XOT_DEVICE_TRACE_MAX_S", "60")
+  assert tracing.start_device_trace("/tmp/xot_trace_gen") is True
+  stale_gen = tracing._trace_gen
+  assert tracing.stop_device_trace() is True
+  assert tracing.start_device_trace("/tmp/xot_trace_gen") is True
+  # Simulate the first session's timer firing late (cancel lost the race).
+  tracing._auto_stop_device_trace(stale_gen)
+  assert tracing._profiling, "stale timer killed the new session"
+  assert calls["stop"] == 1
+  assert tracing.stop_device_trace() is True
+
+
+def test_device_trace_max_s_zero_disables_cap(monkeypatch):
+  from xotorch_tpu.orchestration import tracing
+
+  _fake_profiler(monkeypatch)
+  monkeypatch.setenv("XOT_DEVICE_TRACE_MAX_S", "0")
+  assert tracing.start_device_trace("/tmp/xot_trace_nocap") is True
+  assert tracing._trace_timer is None  # no watchdog scheduled
+  assert tracing.stop_device_trace() is True

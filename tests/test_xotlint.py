@@ -329,6 +329,39 @@ def test_metrics_init_assignment_is_not_an_increment(tmp_path):
   assert findings_by(repo, "metrics-consistency", "dead-exported-counter") == []
 
 
+def test_metrics_flags_dead_exported_gauge(tmp_path):
+  """An exposition row keyed on a STATS-DICT key (pool/host/perf gauge
+  tables) must resolve to a key some engine code actually produces."""
+  api = (
+    "class API:\n"
+    "  async def handle_get_metrics(self, request):\n"
+    "    eng = self.engine\n"
+    "    extra = []\n"
+    "    stats = eng.perf_stats()\n"
+    "    for key, name, help_text in (\n"
+    "      ('decode_tok_s', 'xot_decode_tok_s', 'EWMA decode tok/s'),\n"
+    "      ('ghost_rate', 'xot_ghost_rate', 'Never produced anywhere'),\n"
+    "    ):\n"
+    "      extra.append(f\"# HELP {name} {help_text}\\n# TYPE {name} gauge\\n{name} {stats[key]}\\n\")\n"
+    "    return extra\n"
+  )
+  engine = (
+    "class Engine:\n"
+    "  def __init__(self):\n"
+    "    self._prefix_hits = 0\n"
+    "  def hit(self):\n"
+    "    self._prefix_hits += 1\n"
+    "  def perf_stats(self):\n"
+    "    return {'decode_tok_s': 1.0}\n"
+  )
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/api/chatgpt_api.py": FIXTURE_API.rstrip() + "\n" + api,
+    "xotorch_tpu/inference/engine.py": engine,
+  })
+  found = findings_by(repo, "metrics-consistency", "dead-exported-gauge")
+  assert [f.key for f in found] == ["xot_ghost_rate"]
+
+
 # ----------------------------------------------- flight-event consistency
 
 FIXTURE_FLIGHT = '''

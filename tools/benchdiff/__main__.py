@@ -1,0 +1,118 @@
+"""CLI: `python -m tools.benchdiff` — diff bench harvests, gate CI.
+
+Modes:
+  python -m tools.benchdiff CURRENT.json --baseline BENCH_BASELINE.json
+      per-metric markdown delta table (CURRENT may also be a directory:
+      every BENCH_TPU_*/BENCH_r* file inside is diffed against the baseline)
+  python -m tools.benchdiff A.json B.json
+      diff two flat bench records directly (B is the baseline side)
+  python -m tools.benchdiff --check
+      CI gate: schema/implausibility over every committed BENCH_*.json plus
+      the PERF.md generated-section drift check; exit 1 on findings
+  python -m tools.benchdiff --write-perf-md
+      regenerate PERF.md's measured-results section from the committed JSONs
+
+Exit codes: 0 = ok, 1 = gate findings or a diffed metric REGRESSED beyond
+its noise floor (suppress with --no-gate), 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.benchdiff import (
+  baseline_metrics_for, bench_files, check_repo, diff_records, is_baseline_file,
+  load_bench, metrics_of, perf_md_section, render_markdown, write_perf_md,
+)
+
+
+def _diff_one(current_path: Path, baseline_path: Path, out: list) -> int:
+  current = load_bench(current_path)
+  if current is None or is_baseline_file(current):
+    print(f"benchdiff: {current_path} holds no flat bench record", file=sys.stderr)
+    return 2
+  baseline = load_bench(baseline_path)
+  if baseline is None:
+    print(f"benchdiff: {baseline_path} holds no bench record", file=sys.stderr)
+    return 2
+  if is_baseline_file(baseline):
+    key, base_metrics = baseline_metrics_for(baseline, current)
+    title = f"{current_path.name} vs {baseline_path.name} [{key or 'no matching bar'}]"
+  else:
+    base_metrics = metrics_of(baseline)
+    title = f"{current_path.name} vs {baseline_path.name}"
+  rows = diff_records(metrics_of(current), base_metrics)
+  out.append(render_markdown(rows, title=title))
+  return 1 if any(r["verdict"] == "REGRESSED" for r in rows) else 0
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+    prog="python -m tools.benchdiff",
+    description="Diff bench harvests with noise thresholds; gate committed "
+                "bench files and PERF.md's generated section in CI.",
+  )
+  parser.add_argument("current", nargs="?", help="bench record (or directory of them) to diff")
+  parser.add_argument("old", nargs="?", help="second record to diff against (baseline side)")
+  parser.add_argument("--baseline", default=None,
+                      help="baseline file (default: BENCH_BASELINE.json under --root)")
+  parser.add_argument("--root", default=".", help="repo root (default: cwd)")
+  parser.add_argument("--check", action="store_true",
+                      help="schema/implausibility gate over committed bench files + PERF.md drift")
+  parser.add_argument("--perf-md", action="store_true",
+                      help="print the generated PERF.md measured-results section and exit")
+  parser.add_argument("--write-perf-md", action="store_true",
+                      help="regenerate PERF.md's measured-results section in place")
+  parser.add_argument("--out", default=None, help="also write the markdown report to this file")
+  parser.add_argument("--no-gate", action="store_true",
+                      help="always exit 0 from a diff, even on regressions beyond noise")
+  args = parser.parse_args(argv)
+  root = Path(args.root)
+
+  if args.perf_md:
+    print(perf_md_section(root))
+    return 0
+  if args.write_perf_md:
+    changed = write_perf_md(root)
+    print("PERF.md updated" if changed else "PERF.md already current")
+    return 0
+  if args.check:
+    findings = check_repo(root)
+    for f in findings:
+      print(f)
+    if findings:
+      print(f"\nbenchdiff: {len(findings)} finding(s)", file=sys.stderr)
+      return 1
+    print(f"benchdiff: {len(bench_files(root))} bench file(s) clean, PERF.md section current")
+    return 0
+
+  if not args.current:
+    parser.print_usage(sys.stderr)
+    return 2
+  current = Path(args.current)
+  if not current.exists() and (root / current).exists():
+    current = root / current
+  baseline = Path(args.old) if args.old else Path(args.baseline or (root / "BENCH_BASELINE.json"))
+  if not baseline.exists() and (root / baseline).exists():
+    baseline = root / baseline
+
+  out: list = []
+  if current.is_dir():
+    rcs = [_diff_one(p, baseline, out)
+           for p in sorted(current.glob("BENCH_*.json"))
+           if (rec := load_bench(p)) is not None and not is_baseline_file(rec)]
+    rc = max(rcs, default=0)
+  else:
+    rc = _diff_one(current, baseline, out)
+  report = "\n".join(out)
+  print(report)
+  if args.out:
+    Path(args.out).write_text(report)
+  if rc == 1 and args.no_gate:
+    return 0
+  return rc
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -26,7 +26,13 @@ Checks:
   in orchestration/flight.py's `EVENTS` tuple (a typo'd string raises at
   runtime — fail it in CI instead), and every declared event must be
   recorded somewhere (a dead name means the instrumentation it documents
-  was removed or never landed).
+  was removed or never landed);
+- `dead-exported-gauge`: an API exposition row keyed on a STATS-DICT key
+  (pool occupancy, host tier, perf-attribution gauges — rows whose first
+  element is not an engine `_attr`) must resolve to a key some engine-side
+  code actually produces (a dict-literal key or `d["key"] = ...` store) —
+  otherwise the exported series silently KeyErrors or reads a value that
+  exists nowhere.
 """
 from __future__ import annotations
 
@@ -211,6 +217,30 @@ def _metrics_attr_calls(repo: Repo) -> List[Tuple[str, str, str, int]]:
   return calls
 
 
+def _produced_dict_keys(repo: Repo) -> Set[str]:
+  """String keys any code in the tree produces into a dict: literal
+  `{"key": ...}` entries and `d["key"] = ...` subscript stores. The
+  resolution set for exposition rows that read engine stats dicts
+  (page_pool_stats / host_kv_stats / perf_stats)."""
+  keys: Set[str] = set()
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    for node in ast.walk(sf.tree):
+      if isinstance(node, ast.Dict):
+        for k in node.keys:
+          if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+      elif isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for target in targets:
+          if isinstance(target, ast.Subscript) \
+              and isinstance(target.slice, ast.Constant) \
+              and isinstance(target.slice.value, str):
+            keys.add(target.slice.value)
+  return keys
+
+
 def _engine_aug_attrs(repo: Repo) -> Set[str]:
   """self.<attr> names actually INCREMENTED anywhere in the tree: `+=`, or
   an assignment whose RHS reads the same attr (`x.a = x.a + n`). A plain
@@ -303,20 +333,30 @@ def check(repo: Repo) -> List[Finding]:
                   "remove it or restore the instrumentation",
         ))
 
-  # Engine counters the API exports must be incremented somewhere.
+  # Engine counters the API exports must be incremented somewhere, and
+  # stats-dict rows (pool/host/perf gauges) must read a key some engine
+  # code actually produces.
   api_sf = repo.file(repo.api_metrics_path)
   if api_sf is not None and api_sf.tree is not None:
     incremented = _engine_aug_attrs(repo)
+    produced = _produced_dict_keys(repo)
     for loop, rows in _tuple_table(api_sf.tree):
-      if (_loop_metric_type(loop) or "counter") != "counter":
-        continue
+      is_counter = (_loop_metric_type(loop) or "counter") == "counter"
       for attr, name, line in rows:
         if api_sf.suppressed(line, CHECKER):
           continue
-        if attr.startswith("_") and attr not in incremented:
+        if attr.startswith("_"):
+          if is_counter and attr not in incremented:
+            findings.append(Finding(
+              CHECKER, "dead-exported-counter", repo.api_metrics_path, line, key=name,
+              message=f"API exports `{name}` from engine attr `{attr}` but nothing "
+                      "in the tree increments that attr — stale exposition row",
+            ))
+        elif attr not in produced:
           findings.append(Finding(
-            CHECKER, "dead-exported-counter", repo.api_metrics_path, line, key=name,
-            message=f"API exports `{name}` from engine attr `{attr}` but nothing "
-                    "in the tree increments that attr — stale exposition row",
+            CHECKER, "dead-exported-gauge", repo.api_metrics_path, line, key=name,
+            message=f"API exports `{name}` from stats key `{attr!s}` but no engine "
+                    "code produces that dict key — the exported series can never "
+                    "carry a real value",
           ))
   return findings

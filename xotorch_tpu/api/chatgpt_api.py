@@ -133,6 +133,9 @@ class ChatGPTAPI:
     # peer eviction / OOM recovery) + the cluster-wide metric rollup.
     r.add_get("/v1/debug/flight", self.handle_get_flight)
     r.add_get("/v1/cluster/metrics", self.handle_get_cluster_metrics)
+    # Live roofline attribution: analytic ceilings + achieved throughput +
+    # per-executable time/bytes, with the ring's peers via the status bus.
+    r.add_get("/v1/perf", self.handle_get_perf)
     r.add_post("/v1/trace/device/start", self.handle_device_trace_start)
     r.add_post("/v1/trace/device/stop", self.handle_device_trace_stop)
     r.add_get("/", self.handle_root)
@@ -237,6 +240,30 @@ class ChatGPTAPI:
       nodes.setdefault(node_id, summary)
     return web.json_response({"nodes": nodes, "count": len(nodes)})
 
+  async def handle_get_perf(self, request):
+    """Live performance-attribution report (engine.perf_report): the loaded
+    model's analytic bf16/int8/int4 roofline ceilings, predicted vs actual
+    resident weight bytes, achieved EWMA throughput/utilization, per-lane
+    dispatch totals, the heaviest executables, and pool + host-tier byte
+    flows. `cluster` carries each ring peer's compact perf summary (the
+    status-bus rollup PR 6 introduced), so one call shows the whole ring."""
+    eng = self.node.inference_engine
+    report_fn = getattr(eng, "perf_report", None)
+    report = report_fn() if report_fn is not None else None
+    if report is None:
+      return web.json_response(
+        {"detail": "engine exposes no perf attribution "
+                   "(XOT_PERF_ATTR=0 or a non-JAX engine)"}, status=404)
+    cluster = {}
+    for nid, summary in self.node.peer_metrics.items():
+      perf = summary.get("perf") if isinstance(summary, dict) else None
+      if perf:
+        cluster[nid] = perf
+    local = getattr(eng, "perf_compact", lambda: None)()
+    if local is not None:
+      cluster[self.node.id] = local
+    return web.json_response({"node_id": self.node.id, **report, "cluster": cluster})
+
   async def handle_get_metrics(self, request):
     body, content_type = self.node.metrics.exposition_with_content_type()
     # Engine-level serving counters (prefix cache, speculative decoding):
@@ -295,6 +322,23 @@ class ChatGPTAPI:
         ("entries", "xot_kv_host_entries", "Prefix entries resident in the host KV tier"),
       ):
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {host[key]}\n")
+    # Roofline-attribution EWMA gauges (XOT_PERF_ATTR; utilization reads 0
+    # off-TPU where no chip peak is known). Fed purely from wall timestamps
+    # the batcher already takes — scraping these costs no device syncs.
+    perf_fn = getattr(eng, "perf_stats", None)
+    perf = perf_fn() if perf_fn is not None else None
+    if perf is not None:
+      for key, name, help_text in (
+        ("decode_tok_s", "xot_decode_tok_s",
+         "EWMA decode throughput observed at the engine batcher (tokens/s)"),
+        ("prefill_tok_s", "xot_prefill_tok_s",
+         "EWMA prefill throughput observed at the engine (tokens/s)"),
+        ("hbm_util_pct", "xot_hbm_util_pct",
+         "EWMA predicted HBM bandwidth utilization vs the chip peak (0 off-TPU)"),
+        ("mfu_pct", "xot_mfu_pct",
+         "EWMA model FLOP utilization vs the chip peak (0 off-TPU)"),
+      ):
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {perf[key]}\n")
     if extra:
       body = body + "".join(extra).encode()
     # aiohttp's content_type kwarg rejects parameters; set the full
@@ -311,7 +355,8 @@ class ChatGPTAPI:
       )
     logdir = body.get("logdir", "/tmp/xot_jax_trace")
     started = start_device_trace(logdir)
-    return web.json_response({"started": started, "logdir": logdir})
+    return web.json_response({"started": started, "logdir": logdir,
+                              "max_s": knobs.get_float("XOT_DEVICE_TRACE_MAX_S")})
 
   async def handle_device_trace_stop(self, request):
     from xotorch_tpu.orchestration.tracing import stop_device_trace

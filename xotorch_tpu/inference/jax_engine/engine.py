@@ -131,6 +131,10 @@ class _ShardContext:
   # shared K/V arena + free-list/refcount metadata for every resident
   # request of this context.
   page_pool: Any = None
+  # Analytic roofline model (costmodel.CostModel) bound at load time from
+  # the shard's config + quantization — predicts the HBM bytes/FLOPs each
+  # dispatch must move for the live attribution pipeline (/v1/perf).
+  costmodel: Any = None
 
 
 class _DecodeBatcher:
@@ -173,14 +177,20 @@ class _DecodeBatcher:
     self._draining = False
     self._drain_task = None  # strong ref: the loop only weakly holds tasks
 
-  async def submit_prefill(self, fn) -> Any:
+  async def submit_prefill(self, fn, tokens: int = 0, key: Optional[tuple] = None,
+                           start: int = 0) -> Any:
     """Admit one bounded prefill slice into the drain-cycle rotation. FIFO
     across requests; a single request's slices stay ordered because its
     driver awaits each before submitting the next. With an idle decode side
     the loop degenerates to back-to-back slices (one event-loop tick of
-    overhead per slice — noise next to segment compute)."""
+    overhead per slice — noise next to segment compute). `tokens`/`key`/
+    `start` carry the slice's perf-attribution facts (position count,
+    executable identity, already-resident offset — later slices attend over
+    the KV earlier ones wrote) to the drain loop's _observe_dispatch;
+    key=None (the prologue: prefix reuse / state alloc, not a prefill
+    executable) stays unobserved."""
     fut = asyncio.get_running_loop().create_future()
-    self.pending_prefill.append((fn, fut, time.monotonic()))
+    self.pending_prefill.append((fn, fut, time.monotonic(), tokens, key, start))
     if not self._draining:
       self._draining = True
       self._drain_task = spawn_detached(self._drain())
@@ -272,7 +282,8 @@ class _DecodeBatcher:
                 "decode", ("decode", self.dispatch is not None,
                            _bucket(len(chunk_items), 1),
                            num_tokens, int(top_k), float(top_p)),
-                secs, batch=len(chunk_items), tokens=num_tokens)
+                secs, batch=len(chunk_items), tokens=num_tokens,
+                ctx=self.ctx, items=chunk_items)
               for (*_, fut), toks in zip(chunk_items, results):
                 if not fut.done():
                   fut.set_result(toks)
@@ -286,16 +297,20 @@ class _DecodeBatcher:
         # errors (pool exhaustion, capacity) land on the slice's own future
         # and fail only its request; the drain loop keeps serving.
         if self.pending_prefill:
-          fn, fut, enq_t = self.pending_prefill.pop(0)
+          fn, fut, enq_t, p_tokens, p_key, p_start = self.pending_prefill.pop(0)
           if m is not None:
             m.queue_wait_prefill.observe(time.monotonic() - enq_t)
           try:
             t0 = time.monotonic()
             res = await self.engine._run(fn)
+            secs = time.monotonic() - t0
             fl = self.engine.flight
             if fl is not None:
-              fl.record("batcher.prefill_slice", None,
-                        secs=round(time.monotonic() - t0, 6))
+              fl.record("batcher.prefill_slice", None, secs=round(secs, 6))
+            if p_key is not None:
+              self.engine._observe_dispatch("prefill", p_key, secs,
+                                            tokens=p_tokens, ctx=self.ctx,
+                                            start=p_start)
             if not fut.done():
               fut.set_result(res)
           except Exception as e:
@@ -316,7 +331,7 @@ class _DecodeBatcher:
       for *_, fut in batch + failed:
         if not fut.done():
           fut.set_exception(e)
-      for _, fut, _enq in failed_prefill:
+      for _, fut, *_meta in failed_prefill:
         if not fut.done():
           fut.set_exception(e)
     finally:
@@ -430,6 +445,16 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._exec_seen: set = set()
     self._jit_first_dispatches = 0
     self._jit_cached_dispatches = 0
+    # Live roofline attribution (XOT_PERF_ATTR, default on): cumulative
+    # per-executable time/bytes plus EWMA throughput/utilization gauges,
+    # fed ONLY from the _observe_dispatch boundaries below — the wall
+    # timestamps the batcher already takes, so the decode hot path gains
+    # zero device syncs. Served at /v1/perf and as /metrics gauges.
+    self.perf = None
+    if knobs.get_bool("XOT_PERF_ATTR"):
+      from xotorch_tpu.inference.jax_engine.costmodel import PerfAttribution
+      self.perf = PerfAttribution(knobs.get_float("XOT_PERF_EWMA_S"))
+    self._chip_peaks: Optional[Tuple[Optional[float], Optional[float]]] = None
 
   # ------------------------------------- active-context delegation (compat)
 
@@ -613,12 +638,20 @@ class JAXShardInferenceEngine(InferenceEngine):
                          attributes={"request.id": request_id, **(attributes or {})})
 
   def _observe_dispatch(self, kind: str, key: tuple, seconds: float,
-                        batch: int = 1, tokens: int = 0) -> None:
+                        batch: int = 1, tokens: int = 0,
+                        ctx: "Optional[_ShardContext]" = None,
+                        items: Optional[list] = None,
+                        start: int = 0) -> None:
     """Classify one device dispatch as jit-cache miss (first sighting of
     this executable identity key) or hit, and record the miss — with its
     wall time, which includes the compile — as a flight event. The key is a
     static-shape proxy for the executable (batch width, chunk/bucket size,
-    sampling constants): exactly the tuple a recompile keys off."""
+    sampling constants): exactly the tuple a recompile keys off.
+
+    The same boundary feeds the roofline attribution: `seconds` is a wall
+    interval the caller already measured, and the cost model turns the
+    dispatch's static facts (batch rows' depths/layouts, token count) into
+    predicted HBM bytes and FLOPs — all host metadata, zero device syncs."""
     if key not in self._exec_seen:
       self._exec_seen.add(key)
       self._jit_first_dispatches += 1
@@ -627,6 +660,138 @@ class JAXShardInferenceEngine(InferenceEngine):
                            tokens=tokens, secs=round(seconds, 4))
     else:
       self._jit_cached_dispatches += 1
+    perf = self.perf
+    if perf is None:
+      return
+    cm = ctx.costmodel if ctx is not None else None
+    hbm_bytes = flops = 0
+    total_tokens = tokens
+    if cm is not None:
+      if kind == "decode":
+        rows = self._perf_rows(items) if items else [(0, False, None)] * max(batch, 1)
+        hbm_bytes, flops = cm.decode_dispatch_cost(
+          tokens, rows, page=knobs.get_int("XOT_KV_PAGE"))
+        total_tokens = tokens * max(batch, 1)
+      else:
+        hbm_bytes, flops = cm.prefill_dispatch_cost(tokens, self._prefill_chunk(),
+                                                    start=start)
+    perf.observe(key, kind, seconds, tokens=total_tokens, batch=batch,
+                 hbm_bytes=hbm_bytes, flops=flops)
+
+  @staticmethod
+  def _perf_rows(items: list) -> list:
+    """(depth, paged, alloc_tokens) per batcher item, for the cost model's
+    KV-read prediction. Reads only host metadata (`state.pos` ints, cache
+    SHAPES); items whose state slot is not a _RequestState (the fused-ring
+    batcher carries seg lists there) contribute a depth-0 row."""
+    rows = []
+    for it in items:
+      st = it[1]
+      pos = getattr(st, "pos", None)
+      if pos is None:
+        rows.append((0, False, None))
+        continue
+      cache = getattr(st, "cache", None)
+      paged = cache is None and getattr(st, "pages", None) is not None
+      alloc = None
+      if cache is not None:
+        try:
+          alloc = int(cache["k"].shape[2])
+        except (KeyError, TypeError, IndexError):
+          alloc = None
+      rows.append((int(pos), bool(paged), alloc))
+    return rows
+
+  def _chip_peak_specs(self) -> Tuple[Optional[float], Optional[float]]:
+    """(peak bf16 TFLOP/s, peak HBM GB/s) of the local chip, or (None, None)
+    off-TPU — the denominators of the utilization gauges. Cached: reading
+    device kind strings is cheap but this runs on every /metrics scrape."""
+    if self._chip_peaks is None:
+      if not self._contexts:
+        # No shard loaded yet: jax.devices() here would initialize the
+        # backend (seconds on real TPU) on the EVENT-LOOP thread just to
+        # serve a scrape, stalling every handler. Report unknown, uncached,
+        # so the first post-load scrape picks the real peaks up.
+        return (None, None)
+      peak_tflops = peak_gbps = None
+      try:
+        jax = self._jax()
+        d0 = jax.devices()[0]
+        if d0.platform == "tpu":
+          from xotorch_tpu.topology.device_capabilities import tpu_chip_peaks
+          peak_tflops, peak_gbps = tpu_chip_peaks(getattr(d0, "device_kind", ""))
+      except Exception:  # no backend at all: gauges report 0, never crash /metrics
+        pass
+      self._chip_peaks = (peak_tflops, peak_gbps)
+    return self._chip_peaks
+
+  def perf_stats(self) -> Optional[Dict[str, float]]:
+    """EWMA gauge values for /metrics (xot_decode_tok_s and friends), or
+    None when attribution is off (XOT_PERF_ATTR=0)."""
+    if self.perf is None:
+      return None
+    peak_tflops, peak_gbps = self._chip_peak_specs()
+    return self.perf.gauges(peak_gbps, peak_tflops)
+
+  def perf_compact(self) -> Optional[Dict[str, Any]]:
+    """Small perf summary for the status-bus rollup (rides node_metrics on
+    the topology cadence, so /v1/perf on any node shows the whole ring)."""
+    if self.perf is None:
+      return None
+    out = self.perf.compact()
+    gauges = self.perf_stats() or {}
+    out["hbm_util_pct"] = gauges.get("hbm_util_pct", 0.0)
+    out["mfu_pct"] = gauges.get("mfu_pct", 0.0)
+    return out
+
+  def perf_report(self) -> Optional[Dict[str, Any]]:
+    """The full /v1/perf attribution report: the loaded model's analytic
+    roofline (bf16/int8/int4 ceilings), predicted vs actual resident weight
+    bytes, achieved EWMA throughput/utilization, per-lane cumulative totals,
+    the heaviest executables, jit dispatch classification, and pool +
+    host-tier byte flows. Host metadata only — safe on the serving path."""
+    if self.perf is None:
+      return None
+    peak_tflops, peak_gbps = self._chip_peak_specs()
+    report: Dict[str, Any] = {
+      "gauges": self.perf.gauges(peak_gbps, peak_tflops),
+      "lanes": self.perf.lanes(),
+      "executables": self.perf.executables(),
+      "dispatch": {
+        "jit_first_dispatches": self._jit_first_dispatches,
+        "jit_cached_dispatches": self._jit_cached_dispatches,
+      },
+      "byte_flows": {
+        "host_spill_bytes": self._host_spill_bytes,
+        "host_fetch_bytes": self._host_fetch_bytes,
+        "commit_copy_bytes": self._commit_copy_bytes,
+        "pool": self.page_pool_stats(),
+        "host_tier": self.host_kv_stats(),
+      },
+      "model": None,
+      "ceilings": None,
+    }
+    ctx = self._active
+    if ctx is not None and ctx.costmodel is not None:
+      from xotorch_tpu.models.quantize import quantized_bytes
+      cm = ctx.costmodel
+      report["model"] = {
+        "model_id": ctx.shard.model_id,
+        "layers": [ctx.shard.start_layer, ctx.shard.end_layer],
+        "dtype": self._dtype_name,
+        "quantize": self._quantize,
+        "kv_quant": self._kv_quant,
+        "n_params": cm.n_params(),
+        "weight_bytes_predicted": cm.weight_bytes(),
+        # Metadata-only walk over the resident pytree (size × itemsize) —
+        # the live cross-check that the analytic layout math is honest.
+        "weight_bytes_actual": quantized_bytes(ctx.params),
+        "kv_write_bytes_per_token": cm.kv_write_bytes_per_token(),
+        "kv_read_bytes_per_token_at_cache_len": cm.kv_read_bytes_per_token(
+          ctx.cache_len, alloc_tokens=ctx.cache_len),
+      }
+      report["ceilings"] = cm.ceilings(peak_gbps)
+    return report
 
   async def _run(self, fn, *args, oom_as_cache_exhausted: bool = True):
     """Every device computation funnels through the single-worker executor.
@@ -1035,18 +1200,26 @@ class JAXShardInferenceEngine(InferenceEngine):
       # T==1 is a per-token decode step riding this entry point, not a
       # prefill — a span per token would swamp the trace buffer.
       if tokens_in > 1:
-        t0 = time.monotonic()
         with self._engine_span("engine.prefill", request_id,
                                {"tokens": tokens_in, "cosched": False}):
-          tok = await self._run(self._infer_sample_sync, ctx, request_id, input_data,
-                                temp, top_k, top_p, sampling)
-        self._observe_dispatch("prefill",
-                               ("prefill", _bucket(tokens_in), int(top_k),
-                                float(top_p)),
-                               time.monotonic() - t0, tokens=tokens_in)
+          tok, consumed, fill_secs = await self._run(
+            self._infer_sample_sync, ctx, request_id, input_data,
+            temp, top_k, top_p, sampling)
+        # Attribute only the suffix that actually ran: a warm request whose
+        # prompt mostly hit the prefix cache must not book the full prompt's
+        # bytes/FLOPs over a millisecond window (utilization would read far
+        # above 100% — the exact lying-backend signal the gauges catch).
+        suffix_t = tokens_in - consumed
+        if suffix_t > 0:
+          self._observe_dispatch("prefill",
+                                 ("prefill", _bucket(suffix_t), int(top_k),
+                                  float(top_p)),
+                                 fill_secs, tokens=suffix_t, ctx=ctx,
+                                 start=consumed)
         return tok
-      return await self._run(self._infer_sample_sync, ctx, request_id, input_data,
-                             temp, top_k, top_p, sampling)
+      tok, _consumed, _secs = await self._run(self._infer_sample_sync, ctx, request_id,
+                                              input_data, temp, top_k, top_p, sampling)
+      return tok
     if ctx.batcher is None:
       ctx.batcher = _DecodeBatcher(self, ctx)
     batcher = ctx.batcher
@@ -1075,13 +1248,22 @@ class JAXShardInferenceEngine(InferenceEngine):
           # slice reserves capacity for the WHOLE remaining prompt so the
           # contiguous path allocates once instead of grow-copying per slice.
           expected = consumed + off if (consumed or off) else None
+          fill_t = int(sl.shape[1])
           await batcher.submit_prefill(
             partial(self._prefill_fill_sync, ctx, request_id, sl, paged_native,
-                    expected, true_t if off == 0 else None))
+                    expected, true_t if off == 0 else None),
+            tokens=fill_t,
+            key=("prefill", _bucket(fill_t), bool(paged_native), "fill"),
+            start=consumed + off)
+        tail_t = int(true_t - split)
         return await batcher.submit_prefill(
           partial(self._prefill_sample_sync, ctx, request_id, input_data[:, split:],
                   temp, top_k, top_p, sampling, paged_native, full_prompt,
-                  consumed + split if (consumed or split) else None))
+                  consumed + split if (consumed or split) else None),
+          tokens=tail_t,
+          key=("prefill", _bucket(tail_t), bool(paged_native),
+               int(top_k), float(top_p)),
+          start=consumed + split)
       except CacheExhausted:
         # Pool/capacity exhaustion mid-prefill kills only THIS request: its
         # partial pages return to the pool at once, so the co-scheduled
@@ -1256,13 +1438,19 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _infer_sample_sync(self, ctx: _ShardContext, request_id: str, input_data: np.ndarray,
                          temp: float, top_k: int, top_p: float = 0.0,
-                         sampling: Optional[dict] = None) -> int:
+                         sampling: Optional[dict] = None) -> Tuple[int, int, float]:
+    """Returns (token, consumed, fill_secs): `consumed` is the prefix-cache
+    hit the prologue took off the prompt and `fill_secs` the wall time of
+    the actual prefill executables AFTER the prologue — so the caller's
+    perf attribution covers the suffix that really ran, not the full prompt
+    over a window that also includes prefix reuse / host-tier restores."""
     paged_native = self._paged_prefill_ok(ctx, request_id, input_data, sampling)
     is_fresh = request_id not in ctx.states
     full_prompt, consumed = self._prefill_begin_sync(ctx, request_id, input_data, paged_native)
     if consumed:
       input_data = input_data[:, consumed:]
 
+    t0 = time.monotonic()
     try:
       true_t = input_data.shape[1]
       chunk = self._prefill_chunk()
@@ -1270,8 +1458,9 @@ class JAXShardInferenceEngine(InferenceEngine):
         split = ((true_t - 1) // chunk) * chunk
         self._prefill_fill_sync(ctx, request_id, input_data[:, :split], paged_native)
         input_data = input_data[:, split:]
-      return self._prefill_sample_sync(ctx, request_id, input_data, temp, top_k, top_p,
-                                       sampling, paged_native, full_prompt)
+      tok = self._prefill_sample_sync(ctx, request_id, input_data, temp, top_k, top_p,
+                                      sampling, paged_native, full_prompt)
+      return tok, consumed, time.monotonic() - t0
     except CacheExhausted:
       if paged_native and is_fresh:
         self._abort_paged_prefill(ctx, request_id)
@@ -3403,6 +3592,13 @@ class JAXShardInferenceEngine(InferenceEngine):
       forward_hidden_jit=forward_hidden_jit, forward_hidden_flash_jit=forward_hidden_flash_jit,
       vision=vision, model_dir=model_dir, synthetic=synthetic_cfg is not None,
       cache_len=cache_len, max_cache_len=max_cache_len,
+    )
+    from xotorch_tpu.inference.jax_engine.costmodel import CostModel, dtype_width
+    ctx.costmodel = CostModel(
+      cfg=cfg, n_layers=shard.get_layer_count(),
+      is_first=shard.is_first_layer, is_last=shard.is_last_layer,
+      quantize=self._quantize, dtype_bytes=dtype_width(self._dtype_name),
+      kv_quant=self._kv_quant,
     )
     if DEBUG >= 1:
       print(f"JAX engine ready for {shard} (dtype={self._dtype_name}, cache_len={cache_len})")

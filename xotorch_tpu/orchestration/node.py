@@ -1907,6 +1907,12 @@ class Node:
     summary = self.metrics.summary()
     summary["node_id"] = self.id
     summary["ts"] = time.time()
+    # Roofline-attribution compact (engines that expose one): rides the
+    # same status-bus broadcast, so /v1/perf on any node rolls up the ring.
+    perf_fn = getattr(self.inference_engine, "perf_compact", None)
+    perf = perf_fn() if callable(perf_fn) else None
+    if perf is not None:
+      summary["perf"] = perf
     return summary
 
   def ingest_peer_metrics(self, node_id: str, summary: dict) -> None:

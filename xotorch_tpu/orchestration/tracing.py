@@ -273,25 +273,53 @@ _profiling = False
 # call, not just the flag flip, so the loser of the race observes the
 # winner's completed start and returns False cleanly.
 _profiling_lock = threading.Lock()
+# Generation counter + pending auto-stop timer: each started session gets a
+# watchdog (XOT_DEVICE_TRACE_MAX_S) so a forgotten /v1/trace/device/start
+# cannot profile forever — jax.profiler buffers grow without bound and a
+# days-long session can OOM the host. The generation check makes the timer
+# stop only ITS OWN session: a manual stop followed by a fresh start must
+# not be killed by the previous session's stale timer.
+_trace_gen = 0
+_trace_timer: Optional[threading.Timer] = None
+
+
+def _auto_stop_device_trace(gen: int) -> None:
+  global _profiling
+  with _profiling_lock:
+    if not _profiling or gen != _trace_gen:
+      return  # manually stopped (and possibly restarted) before the cap hit
+    import jax
+    jax.profiler.stop_trace()
+    _profiling = False
 
 
 def start_device_trace(logdir: str = "/tmp/xot_jax_trace") -> bool:
   """Start a jax.profiler trace (TensorBoard-compatible) alongside the span
   trace. Returns False if a trace is already running. Thread-safe: the API
-  serves concurrent POSTs and jax.profiler tolerates exactly one session."""
-  global _profiling
+  serves concurrent POSTs and jax.profiler tolerates exactly one session.
+  Auto-stops after XOT_DEVICE_TRACE_MAX_S seconds (0 disables the cap)."""
+  global _profiling, _trace_gen, _trace_timer
   with _profiling_lock:
     if _profiling:
       return False
     import jax
     jax.profiler.start_trace(logdir)
     _profiling = True
+    _trace_gen += 1
+    max_s = knobs.get_float("XOT_DEVICE_TRACE_MAX_S")
+    if max_s and max_s > 0:
+      _trace_timer = threading.Timer(max_s, _auto_stop_device_trace, args=(_trace_gen,))
+      _trace_timer.daemon = True
+      _trace_timer.start()
     return True
 
 
 def stop_device_trace() -> bool:
-  global _profiling
+  global _profiling, _trace_timer
   with _profiling_lock:
+    if _trace_timer is not None:
+      _trace_timer.cancel()
+      _trace_timer = None
     if not _profiling:
       return False
     import jax

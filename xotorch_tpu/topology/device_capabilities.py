@@ -196,6 +196,15 @@ def _tpu_kind_to_key(kind: str) -> Optional[str]:
   return None
 
 
+def tpu_chip_peaks(device_kind: str) -> "tuple[float, float]":
+  """(peak bf16 TFLOP/s, peak HBM GB/s) for a TPU `device_kind` string —
+  the roofline denominators. One lookup for bench.py and the engine's perf
+  attribution; unknown kinds fall back to v5e (the fleet's chip)."""
+  key = _tpu_kind_to_key(str(device_kind)) or "v5e"
+  spec = TPU_CHIP_SPECS.get(key, TPU_CHIP_SPECS["v5e"])
+  return spec["bf16"], spec["hbm_gbps"]
+
+
 def _probe_jax_sync() -> Optional[DeviceCapabilities]:
   """Probe the local JAX runtime. Returns None when JAX has no accelerators."""
   try:

@@ -127,6 +127,9 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_FLIGHT", "bool", "1", "Record runtime events in the per-node flight recorder (served at /v1/debug/flight).", "Observability"),
   Knob("XOT_FLIGHT_EVENTS", "int", "4096", "Flight-recorder ring capacity (events).", "Observability"),
   Knob("XOT_FLIGHT_SNAPSHOTS", "int", "16", "Frozen flight-recorder snapshots kept per node (LRU).", "Observability"),
+  Knob("XOT_PERF_ATTR", "bool", "1", "Live roofline attribution: per-dispatch time/bytes/FLOPs accounting served at /v1/perf.", "Observability"),
+  Knob("XOT_PERF_EWMA_S", "float", "30", "Time constant (s) of the EWMA throughput/utilization gauges (xot_decode_tok_s and friends).", "Observability"),
+  Knob("XOT_DEVICE_TRACE_MAX_S", "float", "120", "Auto-stop a /v1/trace/device/start jax.profiler session after this many seconds; 0 disables the cap.", "Observability"),
 )
 
 REGISTRY: Dict[str, Knob] = {k.name: k for k in _DEFS}
