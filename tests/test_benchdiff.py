@@ -13,8 +13,8 @@ import pytest
 
 from tools.benchdiff import (
   BEGIN_MARK, END_MARK, baseline_metrics_for, check_perf_md, check_repo,
-  diff_records, is_baseline_file, load_bench, metrics_of, perf_md_section,
-  render_markdown, write_perf_md,
+  diff_records, is_baseline_file, is_soak_file, load_bench, metrics_of,
+  perf_md_section, render_markdown, soak_metrics_of, write_perf_md,
 )
 from tools.benchdiff.__main__ import main as benchdiff_main
 
@@ -175,3 +175,95 @@ def test_cli_report_out_file(tmp_path, capsys):
   capsys.readouterr()
   assert rc == 0
   assert "| tok_s | 165.9 | 203.74 |" in out_file.read_text()
+
+
+# ------------------------------------------------------- soak verdict shape
+
+
+def _soak_record(**metrics):
+  """A minimal SOAK_*.json-shaped record (schema + verdict + flat metrics —
+  the committed fixture SOAK_smoke.json is the full real one)."""
+  base = {
+    "client_ttft_p95_s": 0.5, "client_e2e_p95_s": 1.2, "server_ttft_p95_s": 0.4,
+    "achieved_rps": 0.25, "requests_submitted": 15.0, "requests_ok": 15.0,
+    "request_errors": 0.0, "false_aborts": 0.0, "leaked_requests": 0.0,
+    "pool_page_leaks": 0.0, "watchdog_aborts_total": 0.0,
+    "request_restarts_total": 1.0,
+  }
+  base.update(metrics)
+  return {"schema": "xot-soak-v1", "verdict": "green", "reasons": [],
+          "metrics": base}
+
+
+def test_committed_soak_fixture_is_real_and_green():
+  """SOAK_smoke.json is the committed evidence behind the survivability
+  defaults flip: a real 2-process smoke run — green verdict, an actually
+  injected kill, and the flat metrics benchdiff diffs."""
+  rec = json.loads((REPO / "SOAK_smoke.json").read_text())
+  assert is_soak_file(rec) and rec["verdict"] == "green"
+  assert rec["config"]["faults"], "the smoke must have injected a fault"
+  m = soak_metrics_of(rec)
+  assert m["false_aborts"] == 0 and m["leaked_requests"] == 0
+  assert m["requests_submitted"] > 0 and "client_e2e_p95_s" in m
+
+
+def test_soak_diff_direction_awareness():
+  """Latency drift within the wide soak noise floor is quiet; a new abort
+  or leak on a zero baseline is REGRESSED at any magnitude; rate counters
+  are informational."""
+  rows = _rows_by_metric(diff_records(
+    soak_metrics_of(_soak_record(client_e2e_p95_s=1.4, false_aborts=1.0,
+                                 leaked_requests=2.0, requests_ok=14.0)),
+    soak_metrics_of(_soak_record())))
+  assert rows["client_e2e_p95_s"]["verdict"] == "within noise"  # +17% < 30% floor
+  assert rows["false_aborts"]["verdict"] == "REGRESSED"   # 0 -> 1, no pct defined
+  assert rows["leaked_requests"]["verdict"] == "REGRESSED"
+  assert rows["requests_ok"]["verdict"] == "info"
+  worse = _rows_by_metric(diff_records(
+    soak_metrics_of(_soak_record(client_e2e_p95_s=2.0)),
+    soak_metrics_of(_soak_record())))
+  assert worse["client_e2e_p95_s"]["verdict"] == "REGRESSED"  # +67% > 30% floor
+  better = _rows_by_metric(diff_records(
+    soak_metrics_of(_soak_record(achieved_rps=0.4)),
+    soak_metrics_of(_soak_record())))
+  assert better["achieved_rps"]["verdict"] == "improved"  # _rps is higher-better
+
+
+def test_soak_gate_rejects_red_and_inconsistent_reports(tmp_path):
+  (tmp_path / "PERF.md").write_text(perf_md_section(tmp_path) + "\n")
+  red = _soak_record()
+  red["verdict"] = "red"
+  red["reasons"] = ["false abort: n1"]
+  (tmp_path / "SOAK_red.json").write_text(json.dumps(red))
+  findings = check_repo(tmp_path)
+  assert any("SOAK_red.json" in f and "red" in f for f in findings)
+  # A green verdict contradicted by nonzero abort metrics is also flagged.
+  lying = _soak_record(false_aborts=3.0)
+  (tmp_path / "SOAK_lying.json").write_text(json.dumps(lying))
+  findings = check_repo(tmp_path)
+  assert any("SOAK_lying.json" in f and "false_aborts" in f for f in findings)
+  # And a clean green one passes.
+  (tmp_path / "SOAK_red.json").unlink()
+  (tmp_path / "SOAK_lying.json").unlink()
+  (tmp_path / "SOAK_ok.json").write_text(json.dumps(_soak_record()))
+  assert check_repo(tmp_path) == []
+
+
+def test_soak_cli_diff_and_mixed_shapes(tmp_path, capsys):
+  cur = tmp_path / "SOAK_now.json"
+  base = tmp_path / "SOAK_then.json"
+  cur.write_text(json.dumps(_soak_record(client_e2e_p95_s=1.3)))
+  base.write_text(json.dumps(_soak_record()))
+  rc = benchdiff_main([str(cur), "--baseline", str(base)])
+  out = capsys.readouterr().out
+  assert rc == 0 and "[soak]" in out and "client_e2e_p95_s" in out
+  # A regression gates the CLI exactly like bench files.
+  cur.write_text(json.dumps(_soak_record(false_aborts=1.0)))
+  assert benchdiff_main([str(cur), "--baseline", str(base)]) == 1
+  capsys.readouterr()
+  # Soak-vs-bench cross diffs are a usage error, both ways.
+  assert benchdiff_main([str(cur), "--baseline",
+                         str(REPO / "BENCH_BASELINE.json")]) == 2
+  assert benchdiff_main([str(REPO / "BENCH_TPU_r04_main.json"),
+                         "--baseline", str(cur)]) == 2
+  capsys.readouterr()

@@ -366,7 +366,7 @@ async def test_hop_carried_deadline_enforced_without_local_knobs(monkeypatch):
   that arrived via hop metadata — the origin that set the knob may be the
   node that died."""
   for var in ("XOT_REQUEST_DEADLINE_S", "XOT_STALL_TIMEOUT_S"):
-    monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(var, "0")  # explicitly off (both default ON since the flip)
   engine = DummyInferenceEngine()
 
   async def hang(*args, **kwargs):
@@ -471,15 +471,256 @@ async def test_restart_budget_is_one_shot(monkeypatch):
     await b.stop()
 
 
-# ------------------------------------------------- (d) defaults-off parity
+async def test_compile_heavy_first_request_defers_stall_watchdog(monkeypatch):
+  """The ROADMAP worry that blocked the defaults flip: a cold-jit first
+  request whose single prefill dispatch outlives the stall timeout must NOT
+  be aborted as stalled while the engine is actively computing. The engine
+  advertises `dispatch_inflight` (set around every executor computation in
+  the JAX engine); the watchdog defers the stall abort while it reads True
+  and records the deferral in the flight timeline."""
+  monkeypatch.setenv("XOT_STALL_TIMEOUT_S", "0.4")
 
-async def test_defaults_off_keeps_fail_fast_semantics(monkeypatch):
-  """With every knob unset, a hop fault aborts immediately: zero retries,
-  no watchdog/monitor tasks, and the abort path (error recorded, all state
-  cleaned) is exactly today's."""
-  for var in ("XOT_HOP_RETRIES", "XOT_HOP_BACKOFF_S", "XOT_REQUEST_DEADLINE_S",
-              "XOT_STALL_TIMEOUT_S", "XOT_HEALTH_INTERVAL_S", "XOT_REQUEST_RESTARTS"):
+  class _CompileHeavyEngine(DummyInferenceEngine):
+    """Prefill takes 3x the stall timeout while reporting an in-flight
+    dispatch — the shape of a first-request XLA compile."""
+
+    def __init__(self):
+      super().__init__()
+      self._busy = False
+
+    def dispatch_inflight(self) -> bool:
+      return self._busy
+
+    async def infer_prompt(self, request_id, shard, prompt, images=None, **kw):
+      self._busy = True
+      try:
+        await asyncio.sleep(1.2)  # > XOT_STALL_TIMEOUT_S by 3x
+      finally:
+        self._busy = False
+      tokens = await self.encode(shard, prompt)
+      return await self.infer_tensor(request_id, shard, tokens[None, :])
+
+  engine = _CompileHeavyEngine()
+  node = await _make_node("cj-solo", engine)
+  node.topology.update_node("cj-solo", _caps())
+  try:
+    tokens, errors = await _generate(node, (node,), "cj-req", timeout=15)
+    assert tokens, "compile-heavy request produced no tokens"
+    assert not any(errors.values()), errors
+    assert int(node.metrics.watchdog_aborts_total._value.get()) == 0, \
+      "stall watchdog false-fired during an in-flight dispatch"
+    events = [e["event"] for e in node.flight.tail(0)]
+    assert "watchdog.deferred" in events, events
+    assert "watchdog.fired" not in events, events
+  finally:
+    await node.stop()
+
+
+async def test_engine_idle_stall_still_fires_with_dispatch_inflight_attr(monkeypatch):
+  """The deferral must not weaken the watchdog: an engine that EXPOSES
+  dispatch_inflight but is idle (the silent distributed stall — sunk hop,
+  dead peer) still gets the abort."""
+  monkeypatch.setenv("XOT_STALL_TIMEOUT_S", "0.3")
+  engine = DummyInferenceEngine()
+  engine.dispatch_inflight = lambda: False
+  node = await _make_node("ci-solo", engine)
+  node.topology.update_node("ci-solo", _caps())
+  try:
+    node.outstanding_requests["ci-req"] = "waiting"
+    node._note_progress("ci-req")
+    deadline = time.monotonic() + 6
+    while (int(node.metrics.watchdog_aborts_total._value.get()) == 0
+           and time.monotonic() < deadline):
+      await asyncio.sleep(0.05)
+    assert int(node.metrics.watchdog_aborts_total._value.get()) >= 1
+    assert "stalled" in (node.request_errors.get("ci-req") or "")
+  finally:
+    await node.stop()
+
+
+async def test_stall_deferral_is_bounded_by_busy_engine(monkeypatch):
+  """An engine kept PERMANENTLY busy (by other requests' dispatches) must
+  not shield a dead-peer hang forever: past _STALL_DEFER_CAP stall
+  timeouts the abort fires even mid-dispatch — deferral is a grace for the
+  stalled request's own compile, not an exemption."""
+  monkeypatch.setenv("XOT_STALL_TIMEOUT_S", "0.3")
+  engine = DummyInferenceEngine()
+  engine.dispatch_inflight = lambda: True  # forever busy with other work
+  node = await _make_node("cb-solo", engine)
+  node.topology.update_node("cb-solo", _caps())
+  try:
+    node.outstanding_requests["cb-req"] = "waiting"
+    node._note_progress("cb-req")
+    deadline = time.monotonic() + 8  # cap = 4 x 0.3 s, plus sweep slack
+    while (int(node.metrics.watchdog_aborts_total._value.get()) == 0
+           and time.monotonic() < deadline):
+      await asyncio.sleep(0.05)
+    assert int(node.metrics.watchdog_aborts_total._value.get()) >= 1
+    events = [e["event"] for e in node.flight.tail(0)]
+    assert "watchdog.deferred" in events  # the grace was exercised first
+    assert "watchdog.fired" in events
+  finally:
+    await node.stop()
+
+
+async def test_production_defaults_are_on(monkeypatch):
+  """The flipped registry defaults reach a Node built with a clean env:
+  retries=2, stall 30 s, health 5 s (the ROADMAP production values),
+  deadline still opt-in — and hop seq ids ride by default so retried
+  deliveries stay idempotent."""
+  for var in ("XOT_HOP_RETRIES", "XOT_STALL_TIMEOUT_S", "XOT_HEALTH_INTERVAL_S",
+              "XOT_REQUEST_DEADLINE_S", "XOT_FAULT_SPEC"):
     monkeypatch.delenv(var, raising=False)
+  assert faults.hop_retries() == 2
+  assert faults.hop_seqs_enabled()
+  node = await _make_node("pd-solo", DummyInferenceEngine())
+  try:
+    assert node.stall_timeout_s == 30.0
+    assert node.health_interval_s == 5.0
+    assert node.request_deadline_s == 0.0
+  finally:
+    await node.stop()
+
+
+async def test_streaming_request_restarted_before_first_chunk(monkeypatch):
+  """The streaming half of XOT_REQUEST_RESTARTS: a mid-ring kill under an
+  SSE request that has not yet emitted content yields ONE transparent
+  restart and a clean 200 stream — and no chunk from the dead first
+  attempt leaks in (every data chunk carries the restarted request's id)."""
+  import json as _json
+
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+
+  monkeypatch.setenv("XOT_STALL_TIMEOUT_S", "0.6")
+  monkeypatch.setenv("XOT_HEALTH_INTERVAL_S", "0.1")
+  monkeypatch.setenv("XOT_REQUEST_RESTARTS", "1")
+  monkeypatch.setenv("XOT_HOP_RETRIES", "1")
+  monkeypatch.setenv("XOT_HOP_BACKOFF_S", "0.01")
+
+  engine_a, engine_b = _TrackingEngine(), _TrackingEngine()
+  a = await _make_node("sk-a", engine_a)
+  b = await _make_node("sk-b", engine_b)
+  for node in (a, b):
+    for other in (a, b):
+      node.topology.update_node(other.id, _caps())
+  a.peers = [InProcessPeerHandle(b)]
+  b.peers = [InProcessPeerHandle(a)]
+  a.discovery = StaticDiscovery(list(a.peers))
+  b.discovery = StaticDiscovery(list(b.peers))
+  a.start_health_monitor()
+
+  # sk-b (partition 0) dies before the sampler ever produces a token: the
+  # stream has emitted nothing, so the restart window is still open.
+  faults.install(faults.FaultInjector([
+    {"rpc": "SendPrompt", "peer": "sk-b", "nth": 1, "action": "kill"},
+  ]))
+
+  api = ChatGPTAPI(a, "DummyInferenceEngine", response_timeout=15, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "messages": [{"role": "user", "content": "hello"}],
+      "stream": True,
+    })
+    assert resp.status == 200, await resp.text()
+    ids, content, done_markers, errors = set(), "", 0, []
+    async for raw in resp.content:
+      line = raw.decode().strip()
+      if not line.startswith("data: "):
+        continue
+      payload = line[len("data: "):]
+      if payload == "[DONE]":
+        done_markers += 1
+        continue
+      event = _json.loads(payload)
+      if "error" in event:
+        errors.append(event["error"])
+        continue
+      ids.add(event["id"])
+      delta = event["choices"][0]["delta"]
+      content += delta.get("content") or ""
+    assert not errors, errors
+    assert done_markers == 1
+    assert content, "restarted stream carried no content"
+    assert len(ids) == 1, f"chunks from more than one attempt leaked: {ids}"
+    assert int(a.metrics.request_restarts_total._value.get()) == 1
+    assert a.peers == [], "dead peer still in the ring"
+    await asyncio.sleep(0.3)
+    _assert_no_leaks(a)
+  finally:
+    await client.close()
+    await a.stop()
+    await b.stop()
+
+
+async def test_streaming_restart_never_fires_after_first_chunk(monkeypatch):
+  """Once a content chunk reached the client, a mid-stream failure must
+  surface as the SSE error event (old semantics) — never a restart that
+  could contradict emitted bytes."""
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+
+  monkeypatch.setenv("XOT_REQUEST_RESTARTS", "1")
+
+  engine = _TrackingEngine()
+  calls = {"n": 0}
+  orig_sample = engine.sample
+
+  async def sample_then_die(x, **kw):
+    calls["n"] += 1
+    if calls["n"] == 4:  # a few tokens stream out, then the engine dies
+      raise RuntimeError("engine died mid-stream")
+    # The dummy's sample knows only temp/top_k/top_p; the node may also
+    # pass the extras kwargs (this wrapper's **kw advertises support).
+    return await orig_sample(x, **{k: v for k, v in kw.items()
+                                   if k in ("temp", "top_k", "top_p")})
+
+  engine.sample = sample_then_die
+  node = await _make_node("sm-solo", engine)
+  node.topology.update_node("sm-solo", _caps())
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=15, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.post("/v1/chat/completions", json={
+      "model": "dummy", "messages": [{"role": "user", "content": "hello"}],
+      "stream": True,
+    })
+    assert resp.status == 200
+    body = await resp.text()
+    assert "server_error" in body, body
+    assert int(node.metrics.request_restarts_total._value.get()) == 0, \
+      "restart fired after content was already on the wire"
+  finally:
+    await client.close()
+    await node.stop()
+
+
+# --------------------------------------------------- (d) knobs-off parity
+#
+# Survivability ships ON since the defaults flip (retries=2, stall 30 s,
+# health 5 s — see the soak evidence in SOAK_*.json); the fail-fast path
+# must still be reachable by explicitly zeroing the knobs, byte-identical
+# to the historical defaults-off behavior.
+
+_OFF_KNOBS = {
+  "XOT_HOP_RETRIES": "0", "XOT_REQUEST_DEADLINE_S": "0",
+  "XOT_STALL_TIMEOUT_S": "0", "XOT_HEALTH_INTERVAL_S": "0",
+  "XOT_REQUEST_RESTARTS": "0",
+}
+
+
+async def test_knobs_off_keeps_fail_fast_semantics(monkeypatch):
+  """With every knob explicitly zeroed, a hop fault aborts immediately:
+  zero retries, no watchdog/monitor tasks, and the abort path (error
+  recorded, all state cleaned) is exactly the historical fail-fast one."""
+  for var, off in _OFF_KNOBS.items():
+    monkeypatch.setenv(var, off)
+  monkeypatch.delenv("XOT_HOP_BACKOFF_S", raising=False)
 
   retries_before = faults.COUNTERS["hop_retries"]
   faults.install(faults.FaultInjector([
@@ -496,12 +737,12 @@ async def test_defaults_off_keeps_fail_fast_semantics(monkeypatch):
     await _stop_ring(a, b)
 
 
-async def test_defaults_off_completion_bytes_unchanged(monkeypatch):
-  """No injector, no knobs: the ring produces the same bytes as the
+async def test_knobs_off_completion_bytes_unchanged(monkeypatch):
+  """No injector, retries zeroed: the ring produces the same bytes as the
   baseline run — the survivability layer is invisible when off (and no
   hop seq ids ride the wire: dedup state stays empty)."""
-  for var in ("XOT_HOP_RETRIES", "XOT_FAULT_SPEC"):
-    monkeypatch.delenv(var, raising=False)
+  monkeypatch.setenv("XOT_HOP_RETRIES", "0")
+  monkeypatch.delenv("XOT_FAULT_SPEC", raising=False)
   baseline = await _grpc_baseline()
   a, b = await _two_node_ring(DummyInferenceEngine(), DummyInferenceEngine())
   try:

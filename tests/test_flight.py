@@ -120,6 +120,16 @@ async def test_flight_and_cluster_endpoints():
     assert me["ttft_seconds"]["count"] >= 1
     assert me["request_seconds"]["count"] >= 1
     assert "queue_wait_decode_seconds" in me
+    # Bucket counts ride the summary (cumulative, '+Inf' last) so the
+    # rollup can answer percentile questions ring-wide.
+    rows = me["ttft_seconds"]["buckets"]
+    assert rows and rows[-1][0] == "+Inf" and rows[-1][1] == me["ttft_seconds"]["count"]
+    assert all(rows[i][1] <= rows[i + 1][1] for i in range(len(rows) - 1))
+    agg = data["aggregate"]
+    assert agg["ttft_seconds"]["count"] >= 1
+    p95 = agg["ttft_seconds"]["p95"]
+    assert p95 is not None and 0 <= p95 <= 60.0
+    assert set(agg["ttft_seconds"]) >= {"p50", "p95", "p99", "count", "sum"}
   finally:
     await client.close()
     await node.stop()
@@ -141,5 +151,18 @@ async def test_peer_metrics_ingestion_feeds_cluster_view():
       {"type": "node_metrics", "node_id": "fr-ingest", "metrics": {"requests": 999}}))
     assert node.peer_metrics["peer-2"] == {"requests": 3}
     assert "fr-ingest" not in node.peer_metrics
+    # Ring-wide percentiles merge local + peer bucket rows: 10 fast obs
+    # here, 10 slow ones from the peer -> the merged p95 lands in the
+    # peer's slow bucket while the local-only p95 stays fast.
+    from xotorch_tpu.orchestration.metrics import aggregate_histograms
+    for _ in range(10):
+      node.metrics.ttft.observe(0.02)
+    local = aggregate_histograms([node.metrics_summary()])
+    assert local["ttft_seconds"]["p95"] <= 0.05
+    peer_summary = {"ttft_seconds": {"sum": 80.0, "count": 10,
+                                     "buckets": [[1.0, 0], [10.0, 10], ["+Inf", 10]]}}
+    merged = aggregate_histograms([node.metrics_summary(), peer_summary])
+    assert merged["ttft_seconds"]["count"] == 20
+    assert merged["ttft_seconds"]["p95"] > 1.0
   finally:
     await node.stop()

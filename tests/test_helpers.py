@@ -160,7 +160,7 @@ def test_knob_empty_value_semantics(monkeypatch):
   assert knobs.raw("XOT_FLASH_ATTENTION") is None  # unset: auto-select
 
   monkeypatch.setenv("XOT_HOP_RETRIES", "")
-  assert knobs.get_int("XOT_HOP_RETRIES") == 0  # empty -> registered default
+  assert knobs.get_int("XOT_HOP_RETRIES") == 2  # empty -> registered default (2 since the flip)
   monkeypatch.setenv("XOT_HEALTH_FAILS", "")
   assert knobs.get_int("XOT_HEALTH_FAILS") == 2
 

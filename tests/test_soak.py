@@ -1,0 +1,214 @@
+"""Soak harness unit tests: the PURE verdict/loadgen pieces (arrival
+processes, percentile math, reconciliation, false-abort classification,
+leak checks, report flattening) — no processes spawned, no network. The
+full multi-process arc runs as CI's dedicated smoke-soak step
+(`python -m tools.soak --smoke`) and its committed SOAK_*.json evidence is
+gated by tools/benchdiff (tests/test_benchdiff.py)."""
+import random
+
+import pytest
+
+from tools import soak
+from tools.soak.loadgen import LoadPlan, PromptFactory, arrival_offsets
+from tools.soak.orchestrator import parse_prom
+
+
+# ------------------------------------------------------------ arrivals
+
+def test_poisson_arrivals_deterministic_and_open_loop():
+  a = arrival_offsets("poisson", 2.0, 100.0, random.Random(7))
+  b = arrival_offsets("poisson", 2.0, 100.0, random.Random(7))
+  assert a == b  # seeded: the schedule is reproducible
+  assert all(0 <= t < 100.0 for t in a)
+  assert a == sorted(a)
+  # Mean rate within statistical slack (200 expected, sd ~14).
+  assert 140 <= len(a) <= 260
+
+
+def test_bursty_arrivals_same_offered_load_in_bursts():
+  rng = random.Random(3)
+  a = arrival_offsets("bursty", 2.0, 200.0, rng, burst_size=4)
+  assert len(a) % 4 == 0
+  # Bursts are back-to-back arrivals at one instant.
+  assert a[0] == a[1] == a[2] == a[3]
+  assert 200 <= len(a) <= 640  # mean 400 with bursty variance
+  with pytest.raises(ValueError):
+    arrival_offsets("uniform", 1.0, 1.0, rng)
+  assert arrival_offsets("poisson", 0.0, 10.0, rng) == []
+
+
+def test_prompt_factory_session_reuse_shares_prefix():
+  rng = random.Random(11)
+  pf = PromptFactory(rng, sessions=4, reuse_p=1.0)
+  p1 = pf.next_prompt(0)
+  assert p1["session"] is not None
+  prefix = pf._session_prefixes[p1["session"]]
+  assert p1["prompt"].startswith(prefix)
+  pf_cold = PromptFactory(random.Random(11), sessions=4, reuse_p=0.0)
+  assert pf_cold.next_prompt(0)["session"] is None
+
+
+# ---------------------------------------------------------- percentiles
+
+def test_percentile_and_latency_summary():
+  assert soak.percentile([], 0.5) is None
+  assert soak.percentile([3.0], 0.99) == 3.0
+  xs = [float(i) for i in range(1, 101)]
+  assert soak.percentile(xs, 0.5) == pytest.approx(50.5)
+  assert soak.percentile(xs, 0.95) == pytest.approx(95.05)
+  s = soak.latency_summary(xs)
+  assert s["count"] == 100 and s["mean"] == pytest.approx(50.5)
+  assert s["p99"] == pytest.approx(99.01)
+
+
+def test_delta_buckets_and_server_percentiles():
+  base = {"n0": {"ttft_seconds": {"sum": 5.0, "count": 2,
+                                  "buckets": [[0.1, 2], [1.0, 2], ["+Inf", 2]]}}}
+  final = {"n0": {"ttft_seconds": {"sum": 9.0, "count": 12,
+                                   "buckets": [[0.1, 12], [1.0, 12], ["+Inf", 12]]}},
+           "n1": {"ttft_seconds": {"sum": 50.0, "count": 10,
+                                   "buckets": [[0.1, 0], [1.0, 10], ["+Inf", 10]]}}}
+  # n0's 2 warmup observations drop out; n1 (joined mid-run) counts whole.
+  out = soak.server_percentiles(final, base, "ttft_seconds")
+  assert out["count"] == 20
+  assert out["p50"] is not None and out["p50"] <= 1.0
+  empty = soak.server_percentiles({}, {}, "ttft_seconds")
+  assert empty["count"] == 0 and empty["p95"] is None
+
+
+# -------------------------------------------------------- reconciliation
+
+def _client(ttft_p95=0.5, e2e_p95=1.0, count=10):
+  base = {"p50": ttft_p95 / 2, "p95": ttft_p95, "p99": ttft_p95, "count": count}
+  e2e = {"p50": e2e_p95 / 2, "p95": e2e_p95, "p99": e2e_p95, "count": count}
+  return {"ttft_s": base, "e2e_s": e2e}
+
+
+def _server(ttft_p95=0.4, e2e_p95=0.9, count=10):
+  return {
+    "ttft_seconds": {"p50": ttft_p95 / 2, "p95": ttft_p95, "p99": ttft_p95, "count": count},
+    "request_seconds": {"p50": e2e_p95 / 2, "p95": e2e_p95, "p99": e2e_p95, "count": count},
+  }
+
+
+def test_reconcile_within_tolerance_is_ok():
+  rows = soak.reconcile(_client(), _server(), tol_s=2.5)
+  assert all(r["ok"] for r in rows.values())
+
+
+def test_reconcile_flags_client_far_above_server_two_sided_only():
+  # Server e2e histograms miss 10 s of latency clients really saw: the
+  # two-sided family flags it.
+  rows = soak.reconcile(_client(e2e_p95=10.0), _server(e2e_p95=0.2), tol_s=2.5)
+  assert rows["e2e_p95"]["ok"] is False
+  # TTFT is one-sided: the sampler's view legitimately under-counts the
+  # client's (origin-side prefill/queueing invisible), any gap that way is OK.
+  rows = soak.reconcile(_client(ttft_p95=10.0), _server(ttft_p95=0.2), tol_s=2.5)
+  assert rows["ttft_p95"]["ok"] is True and rows["ttft_p95"]["mode"] == "one_sided"
+
+
+def test_reconcile_flags_server_above_client_both_modes():
+  # The server cannot observe MORE latency than the client end to end —
+  # the structural invariant holds for BOTH families.
+  rows = soak.reconcile(_client(e2e_p95=1.0), _server(e2e_p95=5.0), tol_s=2.5)
+  assert rows["e2e_p95"]["ok"] is False
+  rows = soak.reconcile(_client(ttft_p95=0.2), _server(ttft_p95=5.0), tol_s=2.5)
+  assert rows["ttft_p95"]["ok"] is False
+
+
+def test_reconcile_unknowable_sides_are_none():
+  rows = soak.reconcile({"ttft_s": {"count": 0}}, _server(), tol_s=1.0)
+  assert rows["ttft_p50"]["ok"] is None
+
+
+# ------------------------------------------------- aborts / leaks / verdict
+
+def test_classify_aborts_by_fault_window():
+  events = [{"node_id": "a", "ts": 100.0, "reason": "stalled"},
+            {"node_id": "b", "ts": 500.0, "reason": "stalled"}]
+  windows = [{"t0": 90.0, "t1": 150.0}]
+  out = soak.classify_aborts(events, windows)
+  assert [e["ts"] for e in out["injected"]] == [100.0]
+  assert [e["ts"] for e in out["false"]] == [500.0]
+
+
+def test_leak_check_clean_and_dirty():
+  clean_a = {"n0": {"xot_active_requests": 0.0, "xot_kv_pool_pages_in_use": 8.0}}
+  clean_b = {"n0": {"xot_active_requests": 0.0, "xot_kv_pool_pages_in_use": 8.0}}
+  assert soak.leak_check(clean_a, clean_b)["ok"]
+  leaked = soak.leak_check(clean_a, {"n0": {"xot_active_requests": 2.0}})
+  assert not leaked["ok"] and leaked["active_requests"]["n0"] == 2.0
+  grown = soak.leak_check(clean_a, {"n0": {"xot_active_requests": 0.0,
+                                           "xot_kv_pool_pages_in_use": 9.0}})
+  assert not grown["ok"] and grown["pool_pages_growth"]["n0"] == 1.0
+  host = soak.leak_check(clean_a, {"n0": {"xot_active_requests": 0.0,
+                                          "xot_kv_host_bytes": 999.0}},
+                         host_budget_bytes=100.0)
+  assert not host["ok"] and host["host_bytes_over_budget"]["n0"] == 999.0
+
+
+def _min_report(**over):
+  report = {
+    "client": {"submitted": 10, "ok": 10, "errors": 0,
+               "errors_outside_fault_windows": 0,
+               "ttft_s": {"p95": 0.5}, "tpot_s": {}, "e2e_s": {"p95": 1.0},
+               "rps_achieved": 1.5},
+    "server": {"ttft_seconds": {"p95": 0.4}, "request_seconds": {"p95": 0.9},
+               "watchdog_aborts": 0.0, "request_restarts": 0.0},
+    "reconciliation": soak.reconcile(_client(), _server(), tol_s=2.5),
+    "aborts": {"injected": [], "false": [], "unattributed": 0},
+    "leaks": {"active_requests": {}, "pool_pages_growth": {},
+              "host_bytes_over_budget": {}, "ok": True},
+  }
+  report.update(over)
+  return report
+
+
+def test_evaluate_green_and_flat_metrics():
+  report = soak.evaluate(_min_report())
+  assert report["verdict"] == "green" and report["reasons"] == []
+  m = report["metrics"]
+  assert m["false_aborts"] == 0 and m["leaked_requests"] == 0
+  assert m["client_ttft_p95_s"] == 0.5 and m["server_ttft_p95_s"] == 0.4
+  assert m["requests_ok"] == 10 and m["achieved_rps"] == 1.5
+
+
+def test_evaluate_red_on_false_abort_leak_or_outside_error():
+  red = soak.evaluate(_min_report(
+    aborts={"injected": [], "unattributed": 0,
+            "false": [{"node_id": "n1", "ts": 1.0, "reason": "stalled"}]}))
+  assert red["verdict"] == "red" and any("false abort" in r for r in red["reasons"])
+  leak = _min_report()
+  leak["leaks"] = {"active_requests": {"n0": 1.0}, "pool_pages_growth": {},
+                   "host_bytes_over_budget": {}, "ok": False}
+  assert soak.evaluate(leak)["verdict"] == "red"
+  errs = _min_report()
+  errs["client"]["errors_outside_fault_windows"] = 2
+  assert soak.evaluate(errs)["verdict"] == "red"
+  recon = _min_report()
+  recon["reconciliation"] = soak.reconcile(_client(e2e_p95=30.0), _server(), tol_s=2.5)
+  assert soak.evaluate(recon)["verdict"] == "red"
+
+
+# ----------------------------------------------------------- prom parsing
+
+def test_parse_prom_sums_and_skips():
+  text = "\n".join((
+    "# HELP xot_requests_total Prompts",
+    "# TYPE xot_requests_total counter",
+    'xot_requests_total{node_id="a"} 3',
+    "xot_hop_retries_total 2",
+    'xot_queue_wait_seconds_bucket{node_id="a",lane="decode",le="0.001"} 5',
+    'xot_queue_wait_seconds_bucket{node_id="a",lane="prefill",le="0.001"} 2',
+    "garbage line",
+  ))
+  out = parse_prom(text)
+  assert out["xot_requests_total"] == 3.0
+  assert out["xot_hop_retries_total"] == 2.0
+  assert out["xot_queue_wait_seconds_bucket"] == 7.0  # same-name series summed
+  assert "garbage" not in out
+
+
+def test_load_plan_defaults_round_trip():
+  plan = LoadPlan(seconds=5, rate_rps=2.0)
+  assert plan.arrival == "poisson" and plan.records == []

@@ -54,6 +54,33 @@ NOISE = {
   "concurrent_tok_s": 0.07,
 }
 DEFAULT_NOISE = 0.05
+# Soak latency percentiles ride a loaded CPU ring in CI: run-to-run jitter
+# is far above bench-grade noise, so soak-to-soak drift gates at a wider
+# floor. Zero-tolerance counters (false aborts, leaks) are NOT noise-floored
+# — their direction rule flags any increase from 0 as REGRESSED.
+SOAK_LATENCY_NOISE = 0.30
+
+SOAK_SCHEMA = "xot-soak-v1"
+
+
+def is_soak_file(record: Dict[str, Any]) -> bool:
+  """A `SOAK_*.json` verdict report written by `python -m tools.soak`."""
+  return isinstance(record, dict) and record.get("schema") == SOAK_SCHEMA
+
+
+def soak_metrics_of(record: Dict[str, Any]) -> Dict[str, float]:
+  """The flat metric dict tools/soak stamps into every report
+  (`flatten_metrics`): latency percentiles, rates, abort/leak counters."""
+  out = {}
+  for k, v in (record.get("metrics") or {}).items():
+    if _is_number(v):
+      out[k] = float(v)
+  return out
+
+
+def _is_soak_latency(name: str) -> bool:
+  return ((name.startswith("client_") or name.startswith("server_"))
+          and name.endswith("_s"))
 
 
 def _is_number(v: Any) -> bool:
@@ -134,11 +161,33 @@ def baseline_metrics_for(baseline: Dict[str, Any],
   return key, {k: float(v) for k, v in entry.items() if _is_number(v)}
 
 
+# Soak counters whose every increase is bad vs. informational counters whose
+# magnitude depends on the injected fault schedule. Zero-tolerance is
+# reserved for the counters a green VERDICT already guarantees are zero
+# (false aborts, leaks): a drift gate on them can never flag a green run.
+# Raw watchdog aborts and client errors are legitimately nonzero when a kill
+# lands awkwardly (in-window, excused by the verdict) — gating those would
+# make CI flake on fault-timing luck, so they report as info.
+_SOAK_DOWN = frozenset({
+  "false_aborts", "leaked_requests", "pool_page_leaks",
+})
+_SOAK_INFO = frozenset({
+  "requests_submitted", "requests_ok", "request_errors",
+  "request_restarts_total", "peer_evictions_total", "hop_retries_total",
+  "dedup_drops_total", "watchdog_aborts_total",
+})
+
+
 def _direction(name: str) -> str:
   """'up' = higher is better, 'down' = lower is better, 'info' = report the
   delta but render no verdict (utilization, counts, ratios whose sign has
   no universal meaning)."""
-  if name.endswith("tok_s") or name.endswith("speedup") or name == "vs_baseline":
+  if name in _SOAK_DOWN:
+    return "down"
+  if name in _SOAK_INFO:
+    return "info"
+  if (name.endswith("tok_s") or name.endswith("speedup") or name.endswith("_rps")
+      or name == "vs_baseline"):
     return "up"
   if name.endswith("_ms") or name.endswith("_s"):
     return "down"
@@ -166,10 +215,22 @@ def diff_records(current: Dict[str, float], baseline: Dict[str, float],
       pct = (delta / abs(base) * 100.0) if base else None
       row.update(delta=round(delta, 4), pct=round(pct, 2) if pct is not None else None)
       direction = _direction(name)
-      floor = noise.get(name, DEFAULT_NOISE) * 100.0
+      if name in noise:
+        floor = noise[name] * 100.0
+      elif name in _SOAK_DOWN:
+        floor = 0.0  # zero-tolerance: any new abort/leak/error is a regression
+      elif _is_soak_latency(name):
+        floor = SOAK_LATENCY_NOISE * 100.0
+      else:
+        floor = DEFAULT_NOISE * 100.0
       if direction == "info":
         row["verdict"] = "info"
-      elif pct is None or abs(pct) <= floor:
+      elif pct is None:
+        # Zero baseline: percent is undefined but the sign still is —
+        # a counter moving 0 -> N must not hide behind "within noise".
+        row["verdict"] = ("within noise" if delta == 0 else
+                          "improved" if (delta > 0) == (direction == "up") else "REGRESSED")
+      elif abs(pct) <= floor:
         row["verdict"] = "within noise"
       else:
         better = (pct > 0) == (direction == "up")
@@ -251,6 +312,31 @@ def bench_files(root: Path) -> List[Path]:
   return sorted(Path(root).glob("BENCH_*.json"))
 
 
+def soak_files(root: Path) -> List[Path]:
+  return sorted(Path(root).glob("SOAK_*.json"))
+
+
+def _soak_findings(name: str, rec: Dict[str, Any]) -> List[str]:
+  """Gate one committed soak report: a red (or schema-less, or internally
+  inconsistent) verdict must not sit in the tree as if it were the record."""
+  findings = []
+  if not is_soak_file(rec):
+    return [f"{name}: not a recognized soak report (schema != {SOAK_SCHEMA!r})"]
+  verdict = rec.get("verdict")
+  if verdict != "green":
+    findings.append(f"{name}: soak verdict is {verdict!r} — only green soaks may be committed "
+                    f"(reasons: {'; '.join(map(str, rec.get('reasons') or ())) or 'none recorded'})")
+  metrics = rec.get("metrics")
+  if not isinstance(metrics, dict) or not any(_is_number(v) for v in metrics.values()):
+    findings.append(f"{name}: soak report carries no flat `metrics` dict to diff")
+  else:
+    for zero_key in ("false_aborts", "leaked_requests", "pool_page_leaks"):
+      v = metrics.get(zero_key)
+      if _is_number(v) and v > 0 and verdict == "green":
+        findings.append(f"{name}: metrics[{zero_key}]={v} contradicts the green verdict")
+  return findings
+
+
 def check_repo(root: Path) -> List[str]:
   """Schema + implausibility gate over every committed bench file, plus the
   PERF.md generated-section drift check. Returns human-readable findings
@@ -278,6 +364,13 @@ def check_repo(root: Path) -> List[str]:
       findings.append(f"{path.name}: record carries no numeric tok_s/value")
       continue
     findings.extend(_plausibility_findings(path.name, rec))
+  for path in soak_files(root):
+    try:
+      rec = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+      findings.append(f"{path.name}: no parseable soak report")
+      continue
+    findings.extend(_soak_findings(path.name, rec))
   findings.extend(check_perf_md(root))
   return findings
 

@@ -23,7 +23,8 @@ from pathlib import Path
 
 from tools.benchdiff import (
   baseline_metrics_for, bench_files, check_repo, diff_records, is_baseline_file,
-  load_bench, metrics_of, perf_md_section, render_markdown, write_perf_md,
+  is_soak_file, load_bench, metrics_of, perf_md_section, render_markdown,
+  soak_files, soak_metrics_of, write_perf_md,
 )
 
 
@@ -36,6 +37,16 @@ def _diff_one(current_path: Path, baseline_path: Path, out: list) -> int:
   if baseline is None:
     print(f"benchdiff: {baseline_path} holds no bench record", file=sys.stderr)
     return 2
+  if is_soak_file(current) or is_soak_file(baseline):
+    # Soak-to-soak SLO drift: both sides must be soak verdict reports.
+    if not (is_soak_file(current) and is_soak_file(baseline)):
+      print("benchdiff: a soak report can only be diffed against another "
+            "soak report", file=sys.stderr)
+      return 2
+    rows = diff_records(soak_metrics_of(current), soak_metrics_of(baseline))
+    out.append(render_markdown(
+      rows, title=f"{current_path.name} vs {baseline_path.name} [soak]"))
+    return 1 if any(r["verdict"] == "REGRESSED" for r in rows) else 0
   if is_baseline_file(baseline):
     key, base_metrics = baseline_metrics_for(baseline, current)
     title = f"{current_path.name} vs {baseline_path.name} [{key or 'no matching bar'}]"
@@ -84,7 +95,8 @@ def main(argv=None) -> int:
     if findings:
       print(f"\nbenchdiff: {len(findings)} finding(s)", file=sys.stderr)
       return 1
-    print(f"benchdiff: {len(bench_files(root))} bench file(s) clean, PERF.md section current")
+    print(f"benchdiff: {len(bench_files(root))} bench file(s) + "
+          f"{len(soak_files(root))} soak report(s) clean, PERF.md section current")
     return 0
 
   if not args.current:

@@ -1,0 +1,292 @@
+"""SLO soak harness: verdict math for multi-process soak runs.
+
+`python -m tools.soak` spawns a REAL N-process ring (tests/xproc_harness —
+the same child-environment contract every cross-process test uses), drives
+an open-loop load generator against it (tools/soak/loadgen.py), optionally
+injects faults on a wall-clock schedule (tools/soak/orchestrator.py), and
+writes a `SOAK_*.json` verdict report. This module holds the PURE parts —
+percentile math, client/server reconciliation, false-abort classification,
+leak checks, report assembly — so the verdict logic is unit-testable
+without spawning a single process, and `tools/benchdiff` can gate
+soak-to-soak SLO drift from the same flat metric names.
+
+The three verdict questions (ROADMAP "survivability production defaults"):
+
+1. **Reconciliation** — do the server's `xot_ttft_seconds` /
+   `xot_request_seconds` histograms agree with what clients measured? The
+   server must never report a percentile ABOVE the client's view (it
+   observes a strict subset of each request's wall time), and the gap must
+   stay under a tolerance (API/tokenizer/HTTP overhead) — catching
+   attribution bugs neither side can see alone.
+2. **False aborts** — every watchdog/deadline abort must fall inside an
+   active fault window; an abort with no injected fault to blame is the
+   false positive that blocks the survivability default flip.
+3. **Leaks** — after the load drains, in-flight gauges must return to
+   zero, the page pool must stop growing, and the host tier must respect
+   its byte budget.
+"""
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+SCHEMA = "xot-soak-v1"
+
+# Histogram families reconciled client-vs-server: the client-side sample
+# key each maps to, and the check mode the comparison supports.
+#
+# - `ttft_seconds` is observed at the SAMPLING node from ITS first touch:
+#   it structurally under-counts the client view (origin-side prefill,
+#   queueing, HTTP are invisible to the sampler), so only the one-sided
+#   invariant holds ring-wide: the server must never report MORE TTFT than
+#   clients experienced.
+# - `request_seconds` is observed per node; every ring member observes the
+#   same request from its own first touch, so the ring-merged distribution
+#   is a mixture of views. The ORIGIN (API) node's histogram alone is the
+#   apples-to-apples twin of client e2e (first touch ≈ HTTP arrival) and
+#   supports the two-sided check — provided the client sample also counts
+#   errored requests, because the server family records "any outcome".
+RECONCILE_FAMILIES = (
+  ("ttft_seconds", "ttft_s", "one_sided"),
+  ("request_seconds", "e2e_s", "two_sided"),
+)
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def percentile(samples: Sequence[float], q: float) -> Optional[float]:
+  """Linear-interpolation sample percentile (numpy's default method),
+  None on empty input."""
+  xs = sorted(float(x) for x in samples)
+  if not xs:
+    return None
+  if len(xs) == 1:
+    return xs[0]
+  rank = max(0.0, min(1.0, q)) * (len(xs) - 1)
+  lo = int(math.floor(rank))
+  hi = min(lo + 1, len(xs) - 1)
+  frac = rank - lo
+  return xs[lo] + (xs[hi] - xs[lo]) * frac
+
+
+def latency_summary(samples: Sequence[float]) -> Dict[str, Optional[float]]:
+  xs = [float(x) for x in samples]
+  out: Dict[str, Optional[float]] = {
+    f"p{int(q * 100)}": percentile(xs, q) for q in QUANTILES
+  }
+  out["mean"] = (sum(xs) / len(xs)) if xs else None
+  out["count"] = float(len(xs))
+  return out
+
+
+def delta_buckets(final_rows: Iterable, base_rows: Iterable) -> List[list]:
+  """Cumulative bucket rows covering only the observations made BETWEEN two
+  scrapes (load-window delta: the warmup request and any earlier traffic
+  drop out of the reconciliation on both sides)."""
+  base = {str(le): float(c) for le, c in (base_rows or [])}
+  return [[le, max(0.0, float(c) - base.get(str(le), 0.0))]
+          for le, c in (final_rows or [])]
+
+
+def server_percentiles(nodes_final: Dict[str, dict], nodes_base: Dict[str, dict],
+                       family: str, only_node: Optional[str] = None) -> Dict[str, Optional[float]]:
+  """Load-window percentiles for one histogram family from per-node
+  cluster-metrics summaries (bucket counts shipped by NodeMetrics.summary),
+  ring-merged or restricted to `only_node` (the origin-view families).
+  Nodes missing from the baseline contribute their full final rows (they
+  joined mid-run)."""
+  from xotorch_tpu.orchestration.metrics import (
+    merge_bucket_rows, quantile_bucket_span, quantile_from_buckets)
+  rows_per_node = []
+  count = 0.0
+  for node_id, summary in nodes_final.items():
+    if only_node is not None and node_id != only_node:
+      continue
+    h = summary.get(family) if isinstance(summary, dict) else None
+    if not isinstance(h, dict) or not h.get("buckets"):
+      continue
+    base = ((nodes_base.get(node_id) or {}).get(family) or {}).get("buckets")
+    rows = delta_buckets(h["buckets"], base)
+    rows_per_node.append(rows)
+    if rows:
+      count += rows[-1][1]
+  if not rows_per_node:
+    return {"count": 0.0, **{f"p{int(q * 100)}": None for q in QUANTILES}}
+  merged = merge_bucket_rows(rows_per_node)
+  out: Dict[str, Optional[float]] = {}
+  for q in QUANTILES:
+    key = f"p{int(q * 100)}"
+    out[key] = quantile_from_buckets(merged, q)
+    # The containing bucket's width: the honest bound on how far the
+    # interpolated percentile can over-state the true one (reconcile adds
+    # it to the server-over tolerance).
+    out[f"{key}_bucket_s"] = quantile_bucket_span(merged, q)
+  out["count"] = count
+  return out
+
+
+def reconcile(client: Dict[str, dict], server: Dict[str, dict],
+              tol_s: float, server_over_tol_s: float = 0.5) -> Dict[str, dict]:
+  """Per-percentile client-vs-server agreement rows.
+
+  Every family enforces the structural invariant: the server may not exceed
+  the client view by more than `server_over_tol_s` plus the containing
+  bucket's width (`p*_bucket_s` rows from server_percentiles — histogram
+  interpolation can over-state the true percentile by up to one bucket;
+  the server observes a SUBSET of each request's wall clock, so anything
+  beyond that means latency is being attributed to requests that never saw
+  it). `two_sided` families additionally bound the client-over-
+  server gap by `tol_s` (everything the server cannot see: HTTP,
+  tokenization, queue-to-API overhead — a bigger gap means server
+  histograms are missing real latency). `one_sided` families (TTFT,
+  observed at the sampling node from ITS first touch) legitimately
+  under-count by origin-side prefill + queueing, so only the structural
+  bound applies.
+
+  A side with no observations (e.g. zero streaming requests -> no client
+  TTFT samples) yields ok=None rows: unknowable, not failing."""
+  out: Dict[str, dict] = {}
+  for family, client_key, mode in RECONCILE_FAMILIES:
+    c = client.get(client_key) or {}
+    s = server.get(family) or {}
+    for q in QUANTILES:
+      key = f"p{int(q * 100)}"
+      cv, sv = c.get(key), s.get(key)
+      row: Dict[str, Any] = {"client_s": cv, "server_s": sv, "mode": mode}
+      if cv is None or sv is None or not c.get("count") or not s.get("count"):
+        row["ok"] = None
+      else:
+        quant = s.get(f"{key}_bucket_s") or 0.0
+        row["delta_s"] = round(cv - sv, 4)
+        ok = sv - cv <= server_over_tol_s + quant
+        if mode == "two_sided":
+          ok = ok and (cv - sv <= tol_s)
+        row["ok"] = ok
+      out[f"{client_key[:-2]}_{key}"] = row  # e.g. ttft_p95, e2e_p99
+  return out
+
+
+def classify_aborts(abort_events: Iterable[dict],
+                    fault_windows: Iterable[dict]) -> Dict[str, list]:
+  """Split watchdog/deadline abort evidence into injected (inside an active
+  fault window) vs false (no fault to blame). Each event: {node_id, ts,
+  reason}; each window: {t0, t1} in the same clock (unix seconds)."""
+  windows = [(float(w["t0"]), float(w["t1"])) for w in fault_windows]
+  injected, false = [], []
+  for ev in abort_events:
+    ts = float(ev.get("ts") or 0.0)
+    if any(t0 <= ts <= t1 for t0, t1 in windows):
+      injected.append(dict(ev))
+    else:
+      false.append(dict(ev))
+  return {"injected": injected, "false": false}
+
+
+def leak_check(settle_a: Dict[str, dict], settle_b: Dict[str, dict],
+               host_budget_bytes: Optional[float] = None) -> Dict[str, Any]:
+  """Post-drain leak verdict from two settle scrapes (per-node flat
+  /metrics samples, taken a few seconds apart once the load is gone).
+
+  - `xot_active_requests` must be 0 on every reachable node in BOTH scrapes
+    (a request the drain never finished is leaked engine/bookkeeping state);
+  - `xot_kv_pool_pages_in_use` must not grow between the scrapes (prefix
+    cache legitimately retains pages; growth with zero load is a leak);
+  - `xot_kv_host_bytes` must respect the configured budget."""
+  active = {}
+  for node_id in set(settle_a) | set(settle_b):
+    a = (settle_a.get(node_id) or {}).get("xot_active_requests", 0.0)
+    b = (settle_b.get(node_id) or {}).get("xot_active_requests", 0.0)
+    active[node_id] = max(float(a or 0.0), float(b or 0.0))
+  pool_growth = {}
+  host_over = {}
+  for node_id, sb in settle_b.items():
+    sa = settle_a.get(node_id) or {}
+    pa, pb = sa.get("xot_kv_pool_pages_in_use"), sb.get("xot_kv_pool_pages_in_use")
+    if pa is not None and pb is not None and float(pb) > float(pa):
+      pool_growth[node_id] = float(pb) - float(pa)
+    hb = sb.get("xot_kv_host_bytes")
+    if hb is not None and host_budget_bytes and float(hb) > float(host_budget_bytes):
+      host_over[node_id] = float(hb)
+  leaked_active = {n: v for n, v in active.items() if v > 0}
+  return {
+    "active_requests": leaked_active,
+    "pool_pages_growth": pool_growth,
+    "host_bytes_over_budget": host_over,
+    "ok": not leaked_active and not pool_growth and not host_over,
+  }
+
+
+def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
+  """The flat, direction-suffixed metric names benchdiff diffs soak-to-soak
+  (`*_s` = lower-better latency, `*_rps` = higher-better rate, counters
+  spelled so drift reads correctly)."""
+  out: Dict[str, float] = {}
+  client = report.get("client", {})
+  for key in ("ttft_s", "tpot_s", "e2e_s"):
+    summary = client.get(key) or {}
+    for p in ("p50", "p95", "p99"):
+      v = summary.get(p)
+      if v is not None:
+        out[f"client_{key[:-2]}_{p}_s"] = round(float(v), 4)
+  for k_src, k_out in (("submitted", "requests_submitted"), ("ok", "requests_ok"),
+                       ("errors", "request_errors"), ("rps_achieved", "achieved_rps")):
+    v = client.get(k_src)
+    if v is not None:
+      out[k_out] = float(v)
+  server = report.get("server", {})
+  for family in ("ttft_seconds", "request_seconds"):
+    s = server.get(family) or {}
+    for p in ("p50", "p95", "p99"):
+      v = s.get(p)
+      if v is not None:
+        out[f"server_{family.replace('_seconds', '')}_{p}_s"] = round(float(v), 4)
+  for counter in ("watchdog_aborts", "request_restarts", "peer_evictions",
+                  "hop_retries", "dedup_drops"):
+    v = server.get(counter)
+    if v is not None:
+      out[f"{counter}_total"] = float(v)
+  aborts = report.get("aborts") or {}
+  out["false_aborts"] = float(len(aborts.get("false") or ()))
+  leaks = report.get("leaks") or {}
+  out["leaked_requests"] = float(sum((leaks.get("active_requests") or {}).values()))
+  out["pool_page_leaks"] = float(sum((leaks.get("pool_pages_growth") or {}).values()))
+  return out
+
+
+def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
+  """Stamp the verdict: `green` iff reconciliation holds, no false aborts,
+  no leaks, and no client errors landed OUTSIDE a fault window. Returns the
+  report with `verdict`, `reasons`, and flat `metrics` filled in."""
+  reasons: List[str] = []
+  for name, row in (report.get("reconciliation") or {}).items():
+    if row.get("ok") is False:
+      reasons.append(
+        f"reconciliation: {name} client={row.get('client_s')}s "
+        f"server={row.get('server_s')}s disagree beyond tolerance")
+  false_aborts = (report.get("aborts") or {}).get("false") or []
+  for ev in false_aborts:
+    reasons.append(f"false abort: {ev.get('node_id')} at ts={ev.get('ts')}: "
+                   f"{str(ev.get('reason'))[:120]}")
+  unattributed = (report.get("aborts") or {}).get("unattributed", 0)
+  if unattributed:
+    reasons.append(f"{unattributed} watchdog abort(s) with no flight snapshot to classify")
+  leaks = report.get("leaks") or {}
+  if leaks and not leaks.get("ok", True):
+    reasons.append(f"leaks: {json.dumps({k: v for k, v in leaks.items() if k != 'ok'})}")
+  client = report.get("client") or {}
+  outside = client.get("errors_outside_fault_windows", 0)
+  if outside:
+    reasons.append(f"{outside} client error(s) outside any fault window")
+  if not client.get("submitted"):
+    reasons.append("no requests were submitted")
+  report["reasons"] = reasons
+  report["verdict"] = "green" if not reasons else "red"
+  report["metrics"] = flatten_metrics(report)
+  return report
+
+
+def write_report(report: Dict[str, Any], path) -> Path:
+  path = Path(path)
+  path.write_text(json.dumps(report, indent=1, sort_keys=False) + "\n")
+  return path

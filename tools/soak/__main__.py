@@ -1,0 +1,147 @@
+"""CLI: `python -m tools.soak` — multi-process SLO soak with a verdict.
+
+  python -m tools.soak --smoke
+      CI shape: 2-process CPU ring, ~60 s of Poisson load (mixed streaming,
+      session reuse), ONE injected kill mid-run, green `SOAK_*.json`
+      verdict required (exit 0 = green, 1 = red).
+
+  python -m tools.soak --seconds 600 --rps 4 --procs 3 --arrival bursty \
+      --kill 1@120 --rules '1@300+30:[{"rpc":"SendTensor","action":"delay","nth":1,"times":1000,"delay_s":0.2}]'
+      Long-form soak: any ring size, arrival process, and wall-clock fault
+      schedule (kill = SIGKILL the node process; rules = install injector
+      rules in a child over /v1/debug/faults for a timed phase).
+
+Defaults come from the XOT_SOAK_* knobs (utils/knobs.py) so CI can retune
+without editing workflows. The verdict report is written to --out (default
+SOAK_<tag>.json) and is diffable/gateable with `python -m tools.benchdiff`.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+if str(REPO) not in sys.path:
+  sys.path.insert(0, str(REPO))
+
+from xotorch_tpu.utils import knobs
+
+
+def _parse_kill(spec: str):
+  """node_index@at_s[+grace_s], e.g. `1@25` or `1@25+45`."""
+  from tools.soak.orchestrator import FaultPhase
+  node, _, when = spec.partition("@")
+  at, _, grace = when.partition("+")
+  return FaultPhase(kind="kill", node=int(node), at_s=float(at),
+                    grace_s=float(grace) if grace else 45.0)
+
+
+def _parse_rules(spec: str):
+  """node_index@at_s+hold_s:<json rules>, e.g.
+  `1@30+20:[{"rpc":"SendTensor","action":"delay","nth":1,"times":999,"delay_s":0.1}]`."""
+  from tools.soak.orchestrator import FaultPhase
+  head, _, rules_json = spec.partition(":")
+  node, _, when = head.partition("@")
+  at, _, hold = when.partition("+")
+  at_f = float(at)
+  hold_f = float(hold) if hold else 15.0
+  return FaultPhase(kind="rules", node=int(node), at_s=at_f, until_s=at_f + hold_f,
+                    rules=json.loads(rules_json))
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+    prog="python -m tools.soak",
+    description="Open-loop load + multi-process ring soak with a green/red "
+                "SLO verdict (reconciliation, false aborts, leaks).")
+  parser.add_argument("--smoke", action="store_true",
+                      help="CI smoke shape: 2 procs, ~60 s Poisson, one injected kill")
+  parser.add_argument("--seconds", type=float, default=None)
+  parser.add_argument("--rps", type=float, default=None)
+  parser.add_argument("--procs", type=int, default=None)
+  parser.add_argument("--arrival", choices=("poisson", "bursty"), default="poisson")
+  parser.add_argument("--stream-fraction", type=float, default=None)
+  parser.add_argument("--session-reuse", type=float, default=None)
+  parser.add_argument("--max-tokens", type=int, default=None,
+                      help="completion length per request (default 16; 8 under --smoke)")
+  parser.add_argument("--model", default="synthetic-tiny")
+  parser.add_argument("--seed", type=int, default=None)
+  parser.add_argument("--kill", action="append", default=[],
+                      help="inject a SIGKILL: node_index@at_s[+grace_s] (repeatable)")
+  parser.add_argument("--rules", action="append", default=[],
+                      help="timed injector phase: node@at_s+hold_s:<json rules> (repeatable)")
+  parser.add_argument("--recon-tol-s", type=float, default=None,
+                      help="client-vs-server percentile slack (default XOT_SOAK_RECON_TOL_S)")
+  parser.add_argument("--tag", default=None, help="report tag (SOAK_<tag>.json)")
+  parser.add_argument("--out", default=None, help="report path (default SOAK_<tag>.json)")
+  parser.add_argument("--log-dir", default=None, help="keep child logs here (default: temp dir)")
+  parser.add_argument("--json", action="store_true", help="print the full report JSON")
+  args = parser.parse_args(argv)
+
+  from tools.soak.orchestrator import SoakConfig, run_soak
+  cfg = SoakConfig(
+    procs=args.procs if args.procs is not None else knobs.get_int("XOT_SOAK_PROCS"),
+    seconds=args.seconds if args.seconds is not None else knobs.get_float("XOT_SOAK_SECONDS"),
+    rate_rps=args.rps if args.rps is not None else knobs.get_float("XOT_SOAK_RPS"),
+    arrival=args.arrival,
+    stream_fraction=(args.stream_fraction if args.stream_fraction is not None
+                     else knobs.get_float("XOT_SOAK_STREAM_FRACTION")),
+    session_reuse=(args.session_reuse if args.session_reuse is not None
+                   else knobs.get_float("XOT_SOAK_SESSION_REUSE")),
+    max_tokens=args.max_tokens if args.max_tokens is not None else 16,
+    model=args.model,
+    seed=args.seed if args.seed is not None else knobs.get_int("XOT_SOAK_SEED"),
+    recon_tol_s=(args.recon_tol_s if args.recon_tol_s is not None
+                 else knobs.get_float("XOT_SOAK_RECON_TOL_S")),
+    log_dir=args.log_dir,
+  )
+  cfg.tag = args.tag or ("smoke" if args.smoke else "run")
+  if args.smoke:
+    # The acceptance shape: one mid-run kill of the non-API node, load
+    # sized so a laptop/CI runner finishes the whole arc in a few minutes.
+    # The rate MUST stay subcritical for a CPU ring (~12 tok/s aggregate
+    # service): an open-loop rate above capacity grows the queue without
+    # bound until the stall watchdog starts shedding load as "stalled"
+    # aborts — a real overload behavior, but not the false-abort question
+    # this smoke exists to answer. Explicit --rps/--max-tokens still win.
+    cfg.procs = max(2, cfg.procs)
+    if args.rps is None:
+      cfg.rate_rps = 0.25
+    if args.max_tokens is None:
+      cfg.max_tokens = 8
+    kill_at = max(10.0, cfg.seconds * 0.4)
+    cfg.faults.append(_parse_kill(f"{cfg.procs - 1}@{kill_at:g}"))
+  cfg.faults.extend(_parse_kill(s) for s in args.kill)
+  cfg.faults.extend(_parse_rules(s) for s in args.rules)
+  for phase in cfg.faults:
+    if not 0 <= phase.node < cfg.procs:
+      print(f"soak: fault names node {phase.node} but the ring has {cfg.procs}",
+            file=sys.stderr)
+      return 2
+  cfg.out = args.out or f"SOAK_{cfg.tag}.json"
+
+  report = asyncio.run(run_soak(cfg))
+  if args.json:
+    print(json.dumps(report, indent=1))
+  client = report.get("client", {})
+  print(f"soak[{cfg.tag}]: verdict={report['verdict']} "
+        f"requests={client.get('ok')}/{client.get('submitted')} ok "
+        f"(errors in/out of fault windows: {client.get('errors_in_fault_windows')}/"
+        f"{client.get('errors_outside_fault_windows')})")
+  for name, row in sorted((report.get("reconciliation") or {}).items()):
+    print(f"  recon {name}: client={row.get('client_s')} server={row.get('server_s')} "
+          f"ok={row.get('ok')}")
+  ab = report.get("aborts") or {}
+  print(f"  aborts: injected={len(ab.get('injected') or ())} "
+        f"false={len(ab.get('false') or ())} unattributed={ab.get('unattributed', 0)}; "
+        f"leaks ok={report.get('leaks', {}).get('ok')}; report={cfg.out}")
+  for reason in report.get("reasons", []):
+    print(f"  RED: {reason}")
+  return 0 if report.get("verdict") == "green" else 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -1,0 +1,219 @@
+"""Open-loop load generator for the soak harness.
+
+Open-loop means arrivals are scheduled from the arrival PROCESS, never from
+completions: a slow server faces the same offered load a fast one does, so
+queueing delay shows up in the measurements instead of silently throttling
+the experiment (the classic closed-loop coordinated-omission trap).
+
+Pieces:
+- `arrival_offsets`: Poisson (exponential inter-arrival) or bursty
+  (Poisson bursts of B back-to-back arrivals) schedules, precomputed and
+  deterministic under a seed;
+- `PromptFactory`: prompt-length distribution (word count lognormal-ish via
+  choice buckets) and a session pool — with probability `reuse_p` a request
+  re-sends a session's long shared prefix plus a fresh tail, exercising the
+  prefix cache exactly like a returning chat user;
+- `run_load`: fires one HTTP task per arrival against the ring's OpenAI
+  API (mixed streaming/non-streaming per `stream_fraction`), capturing
+  per-request client-side TTFT (first content chunk), TPOT (mean
+  inter-chunk gap), and e2e wall time.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_WORDS = (
+  "ring", "shard", "layer", "token", "page", "prefix", "decode", "prefill",
+  "tensor", "batch", "cache", "stream", "sample", "weight", "device", "host",
+)
+
+
+def arrival_offsets(kind: str, rate_rps: float, seconds: float, rng: random.Random,
+                    burst_size: int = 4, burst_every_s: Optional[float] = None) -> List[float]:
+  """Arrival times (seconds from load start, ascending) for the whole run.
+
+  poisson: exponential inter-arrivals at `rate_rps`.
+  bursty:  bursts of `burst_size` back-to-back arrivals, burst STARTS
+           Poisson at rate_rps/burst_size (same mean offered load), or on a
+           fixed cadence when `burst_every_s` is given."""
+  if rate_rps <= 0 or seconds <= 0:
+    return []
+  out: List[float] = []
+  t = 0.0
+  if kind == "poisson":
+    while True:
+      t += rng.expovariate(rate_rps)
+      if t >= seconds:
+        return out
+      out.append(t)
+  if kind == "bursty":
+    burst_rate = rate_rps / max(1, burst_size)
+    while True:
+      t += (burst_every_s if burst_every_s else rng.expovariate(burst_rate))
+      if t >= seconds:
+        return out
+      out.extend([t] * burst_size)
+  raise ValueError(f"unknown arrival kind {kind!r} (poisson|bursty)")
+
+
+class PromptFactory:
+  """Deterministic prompts with a session/prefix-reuse mix.
+
+  `length_buckets` is a (word_count, weight) distribution; a session's
+  prefix is a fixed ~3/4-bucket head re-sent verbatim on reuse, so the
+  serving side sees the page-granular warm path a returning user drives."""
+
+  def __init__(self, rng: random.Random, length_buckets=((8, 4), (24, 3), (64, 2), (160, 1)),
+               sessions: int = 8, reuse_p: float = 0.3):
+    self.rng = rng
+    self.lengths = [w for w, _ in length_buckets]
+    self.weights = [wt for _, wt in length_buckets]
+    self.reuse_p = reuse_p
+    self._session_prefixes = [self._words(96, tag=f"session-{i}") for i in range(max(0, sessions))]
+
+  def _words(self, n: int, tag: str = "") -> str:
+    toks = [tag] if tag else []
+    toks += [self.rng.choice(_WORDS) for _ in range(n)]
+    return " ".join(toks)
+
+  def next_prompt(self, i: int) -> Dict[str, object]:
+    n = self.rng.choices(self.lengths, weights=self.weights)[0]
+    if self._session_prefixes and self.rng.random() < self.reuse_p:
+      sid = self.rng.randrange(len(self._session_prefixes))
+      text = f"{self._session_prefixes[sid]} {self._words(max(4, n // 4), tag=f'turn-{i}')}"
+      return {"prompt": text, "session": sid, "words": n}
+    return {"prompt": self._words(n, tag=f"req-{i}"), "session": None, "words": n}
+
+
+@dataclass
+class ClientRecord:
+  index: int
+  offset_s: float
+  streamed: bool
+  session: Optional[int]
+  t_submit: float = 0.0  # unix seconds
+  status: Optional[int] = None
+  ok: bool = False
+  error: Optional[str] = None
+  ttft_s: Optional[float] = None
+  tpot_s: Optional[float] = None
+  e2e_s: Optional[float] = None
+  content_len: int = 0
+  chunks: int = 0
+
+
+@dataclass
+class LoadPlan:
+  seconds: float
+  rate_rps: float
+  arrival: str = "poisson"
+  stream_fraction: float = 0.5
+  session_reuse: float = 0.3
+  max_tokens: int = 16
+  model: str = "synthetic-tiny"
+  seed: int = 1234
+  burst_size: int = 4
+  request_timeout_s: float = 120.0
+  records: List[ClientRecord] = field(default_factory=list)
+
+
+async def _do_request(session, port: int, plan: LoadPlan, rec: ClientRecord,
+                      prompt: str) -> None:
+  body = {
+    "model": plan.model,
+    "messages": [{"role": "user", "content": prompt}],
+    "max_tokens": plan.max_tokens, "temperature": 0,
+  }
+  if rec.streamed:
+    body["stream"] = True
+  url = f"http://127.0.0.1:{port}/v1/chat/completions"
+  t0 = time.monotonic()
+  rec.t_submit = time.time()
+  try:
+    async with session.post(url, json=body) as resp:
+      rec.status = resp.status
+      if not rec.streamed:
+        data = await resp.json()
+        rec.e2e_s = time.monotonic() - t0
+        if resp.status == 200:
+          content = (data.get("choices") or [{}])[0].get("message", {}).get("content", "")
+          rec.content_len = len(content or "")
+          rec.ok = bool(content)
+          if not rec.ok:
+            rec.error = "empty completion"
+        else:
+          rec.error = json.dumps(data)[:200]
+        return
+      # SSE: one line per event; first non-empty delta content = TTFT.
+      chunk_times: List[float] = []
+      done = False
+      async for raw in resp.content:
+        line = raw.decode("utf-8", "replace").strip()
+        if not line.startswith("data: "):
+          continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+          done = True
+          break
+        try:
+          event = json.loads(payload)
+        except json.JSONDecodeError:
+          continue
+        if "error" in event:
+          rec.error = json.dumps(event["error"])[:200]
+          break
+        delta = (event.get("choices") or [{}])[0].get("delta", {})
+        content = delta.get("content") or ""
+        if content:
+          now = time.monotonic()
+          if rec.ttft_s is None:
+            rec.ttft_s = now - t0
+          chunk_times.append(now)
+          rec.content_len += len(content)
+          rec.chunks += 1
+      rec.e2e_s = time.monotonic() - t0
+      if len(chunk_times) >= 2:
+        rec.tpot_s = (chunk_times[-1] - chunk_times[0]) / (len(chunk_times) - 1)
+      rec.ok = done and rec.error is None and rec.status == 200 and rec.content_len > 0
+      if not rec.ok and rec.error is None:
+        rec.error = f"stream ended early (done={done}, content={rec.content_len})"
+  except Exception as e:
+    rec.e2e_s = time.monotonic() - t0
+    rec.error = f"{type(e).__name__}: {e}"[:200]
+
+
+async def run_load(port: int, plan: LoadPlan) -> List[ClientRecord]:
+  """Fire the whole open-loop schedule; returns per-request records (also
+  left on plan.records). Arrivals that the event loop delivers late still
+  count from their ACTUAL send time — client latencies never include
+  scheduler lag."""
+  import aiohttp
+  rng = random.Random(plan.seed)
+  offsets = arrival_offsets(plan.arrival, plan.rate_rps, plan.seconds, rng,
+                            burst_size=plan.burst_size)
+  prompts = PromptFactory(rng, reuse_p=plan.session_reuse)
+  plan.records = []
+  tasks: List[asyncio.Task] = []
+  timeout = aiohttp.ClientTimeout(total=plan.request_timeout_s)
+  connector = aiohttp.TCPConnector(limit=256)
+  t_start = time.monotonic()
+  async with aiohttp.ClientSession(timeout=timeout, connector=connector) as session:
+    for i, off in enumerate(offsets):
+      delay = t_start + off - time.monotonic()
+      if delay > 0:
+        await asyncio.sleep(delay)
+      spec = prompts.next_prompt(i)
+      rec = ClientRecord(index=i, offset_s=off,
+                         streamed=rng.random() < plan.stream_fraction,
+                         session=spec["session"])
+      plan.records.append(rec)
+      tasks.append(asyncio.ensure_future(
+        _do_request(session, port, plan, rec, spec["prompt"])))
+    if tasks:
+      await asyncio.gather(*tasks, return_exceptions=True)
+  return plan.records

@@ -1,0 +1,460 @@
+"""Soak orchestrator: a real N-process ring + load + faults + scrapes.
+
+Spawns `xotorch_tpu.main` node processes over localhost gRPC/UDP exactly
+like the cross-process test suite (tests/xproc_harness owns the child
+environment contract), drives tools/soak/loadgen against node 0's OpenAI
+API, executes a wall-clock fault schedule (SIGKILL a node process, or
+install drop/delay injector rules in a child via its /v1/debug/faults
+endpoint), and continuously scrapes every node's /metrics and
+/v1/debug/flight plus node 0's /v1/cluster/metrics and /v1/perf. The
+verdict math lives in tools/soak/__init__ — this module only collects.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import signal
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent.parent
+if str(REPO) not in sys.path:
+  sys.path.insert(0, str(REPO))
+
+from tools import soak as verdicts
+from tools.soak.loadgen import LoadPlan, run_load
+from xotorch_tpu.utils import knobs
+
+_PROM_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+([0-9eE+.\-]+|NaN|Inf)\s*$")
+
+
+def parse_prom(text: str) -> Dict[str, float]:
+  """Flat {metric_name: value} view of a /metrics exposition (labels
+  dropped, same-name series summed — one node per process here)."""
+  out: Dict[str, float] = {}
+  for line in text.splitlines():
+    if line.startswith("#"):
+      continue
+    m = _PROM_LINE.match(line.strip())
+    if not m:
+      continue
+    try:
+      v = float(m.group(2))
+    except ValueError:
+      continue
+    out[m.group(1)] = out.get(m.group(1), 0.0) + v
+  return out
+
+
+@dataclass
+class FaultPhase:
+  kind: str                      # "kill" | "rules"
+  node: int                      # ring index (0 = API node)
+  at_s: float                    # seconds from load start
+  grace_s: float = 45.0          # how long after the fault aborts are excused
+  until_s: Optional[float] = None  # rules: uninstall time (default at_s+grace)
+  rules: Optional[list] = None   # rules: /v1/debug/faults payload
+
+
+@dataclass
+class SoakConfig:
+  # Knob-backed fields read the XOT_SOAK_* registry at construction so a
+  # programmatic SoakConfig() and the CLI agree on (and honor) the same
+  # defaults — utils/knobs.py is the single source of truth.
+  procs: int = field(default_factory=lambda: knobs.get_int("XOT_SOAK_PROCS"))
+  seconds: float = field(default_factory=lambda: knobs.get_float("XOT_SOAK_SECONDS"))
+  rate_rps: float = field(default_factory=lambda: knobs.get_float("XOT_SOAK_RPS"))
+  arrival: str = "poisson"
+  stream_fraction: float = field(
+    default_factory=lambda: knobs.get_float("XOT_SOAK_STREAM_FRACTION"))
+  session_reuse: float = field(
+    default_factory=lambda: knobs.get_float("XOT_SOAK_SESSION_REUSE"))
+  max_tokens: int = 16
+  model: str = "synthetic-tiny"
+  seed: int = field(default_factory=lambda: knobs.get_int("XOT_SOAK_SEED"))
+  recon_tol_s: float = field(
+    default_factory=lambda: knobs.get_float("XOT_SOAK_RECON_TOL_S"))
+  faults: List[FaultPhase] = field(default_factory=list)
+  out: Optional[str] = None
+  tag: str = "run"
+  api_base: int = 53510
+  udp_port: int = 53530
+  grpc_base: int = 53550
+  log_dir: Optional[str] = None
+  scrape_interval_s: float = 2.0
+  drain_timeout_s: float = 120.0
+  restarts: int = 1              # XOT_REQUEST_RESTARTS for the children
+
+
+class SoakRing:
+  """Child processes + the last-good scrape of each (a killed node's final
+  truth is its last successful scrape)."""
+
+  def __init__(self, cfg: SoakConfig):
+    self.cfg = cfg
+    self.procs: Dict[str, object] = {}
+    self.logs: Dict[str, object] = {}
+    self.ports: Dict[str, int] = {}
+    self.names: List[str] = [f"soak-{i}" for i in range(cfg.procs)]
+    self.last_metrics: Dict[str, Dict[str, float]] = {}
+    self.last_flight: Dict[str, dict] = {}
+    self.last_cluster: Optional[dict] = None
+    self.last_perf: Optional[dict] = None
+    self.killed: set = set()
+
+  def spawn(self, log_dir: Path) -> None:
+    from tests.xproc_harness import spawn_node
+    for i, name in enumerate(self.names):
+      self.ports[name] = self.cfg.api_base + i
+      self.logs[name] = open(log_dir / f"{name}.log", "w")
+      self.procs[name] = spawn_node(
+        name, self.cfg.api_base + i, self.cfg.udp_port, self.cfg.udp_port,
+        self.cfg.grpc_base + i, self.logs[name], model=self.cfg.model,
+        response_timeout=180,
+        extra_env={"XOT_REQUEST_RESTARTS": str(self.cfg.restarts)},
+      )
+
+  def wait_ready(self) -> None:
+    from tests.xproc_harness import http_get, wait_for
+    for name in self.names:
+      port = self.ports[name]
+      wait_for(lambda p=port: http_get(p, "/healthcheck").get("status") == "ok",
+               180, f"{name} API health", proc=self.procs[name],
+               log_path=self._log_path(name))
+    n = len(self.names)
+    for name in self.names:
+      port = self.ports[name]
+      wait_for(lambda p=port: len(http_get(p, "/v1/topology").get("nodes", {})) == n,
+               120, f"{name} sees {n}-node ring", proc=self.procs[name],
+               log_path=self._log_path(name))
+
+  def _log_path(self, name: str):
+    f = self.logs.get(name)
+    return getattr(f, "name", None)
+
+  def alive(self, name: str) -> bool:
+    proc = self.procs.get(name)
+    return proc is not None and proc.poll() is None and name not in self.killed
+
+  def get_json(self, name: str, path: str, timeout: float = 5.0) -> Optional[dict]:
+    try:
+      with urllib.request.urlopen(
+          f"http://127.0.0.1:{self.ports[name]}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+    except Exception:
+      return None
+
+  def get_text(self, name: str, path: str, timeout: float = 5.0) -> Optional[str]:
+    try:
+      with urllib.request.urlopen(
+          f"http://127.0.0.1:{self.ports[name]}{path}", timeout=timeout) as r:
+        return r.read().decode("utf-8", "replace")
+    except Exception:
+      return None
+
+  def scrape_once(self) -> None:
+    for name in self.names:
+      if not self.alive(name):
+        continue
+      text = self.get_text(name, "/metrics")
+      if text is not None:
+        self.last_metrics[name] = parse_prom(text)
+      flight = self.get_json(name, "/v1/debug/flight")
+      if flight is not None:
+        self.last_flight[name] = flight
+    api = self.names[0]
+    if self.alive(api):
+      cluster = self.get_json(api, "/v1/cluster/metrics")
+      if cluster is not None:
+        self.last_cluster = cluster
+      perf = self.get_json(api, "/v1/perf")
+      if perf is not None:
+        self.last_perf = perf
+
+  def kill(self, index: int) -> None:
+    name = self.names[index]
+    proc = self.procs.get(name)
+    if proc is not None and proc.poll() is None:
+      proc.send_signal(signal.SIGKILL)
+    self.killed.add(name)
+
+  def teardown(self) -> None:
+    from tests.xproc_harness import teardown_nodes
+    teardown_nodes(self.procs, self.logs)
+
+
+def _sum_counter(metrics_by_node: Dict[str, Dict[str, float]], name: str) -> float:
+  return sum(float(m.get(name, 0.0)) for m in metrics_by_node.values())
+
+
+def _abort_events(flight_by_node: Dict[str, dict]) -> List[dict]:
+  """Watchdog/deadline abort evidence from each node's frozen snapshots:
+  one event per snapshot whose timeline contains a watchdog.fired or
+  deadline.expired transition, stamped with the snapshot freeze time."""
+  events = []
+  for node_id, flight in flight_by_node.items():
+    for snap in flight.get("snapshots") or []:
+      names = {e.get("event") for e in snap.get("events") or []}
+      if "watchdog.fired" in names or "deadline.expired" in names:
+        events.append({"node_id": node_id, "ts": snap.get("frozen_at"),
+                       "request_id": snap.get("request_id"),
+                       "reason": snap.get("reason")})
+  return events
+
+
+async def _chat_once(port: int, model: str, timeout_s: float = 300.0) -> None:
+  """One sequential warmup completion (pays the cold-jit compiles before
+  the measured window opens)."""
+  import aiohttp
+  body = {"model": model, "messages": [{"role": "user", "content": "soak warmup"}],
+          "max_tokens": 8, "temperature": 0}
+  async with aiohttp.ClientSession(
+      timeout=aiohttp.ClientTimeout(total=timeout_s)) as session:
+    async with session.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                            json=body) as resp:
+      text = await resp.text()
+      if resp.status != 200:
+        raise RuntimeError(f"warmup failed ({resp.status}): {text[:300]}")
+
+
+async def _scraper(ring: SoakRing, stop: asyncio.Event) -> None:
+  loop = asyncio.get_running_loop()
+  while not stop.is_set():
+    await loop.run_in_executor(None, ring.scrape_once)
+    try:
+      await asyncio.wait_for(stop.wait(), timeout=ring.cfg.scrape_interval_s)
+    except asyncio.TimeoutError:
+      pass
+
+
+async def _fault_driver(ring: SoakRing, t_load_start: float,
+                        windows: List[dict]) -> None:
+  """Execute the wall-clock fault schedule; records each phase's excuse
+  window (unix seconds) for the verdict's abort classification."""
+  phases = sorted(ring.cfg.faults, key=lambda p: p.at_s)
+  loop = asyncio.get_running_loop()
+  for phase in phases:
+    delay = t_load_start + phase.at_s - time.monotonic()
+    if delay > 0:
+      await asyncio.sleep(delay)
+    now = time.time()
+    try:
+      if phase.kind == "kill":
+        ring.kill(phase.node)
+        windows.append({"kind": "kill", "node": ring.names[phase.node],
+                        "t0": now - 1.0, "t1": now + phase.grace_s})
+      elif phase.kind == "rules":
+        name = ring.names[phase.node]
+        until = phase.until_s if phase.until_s is not None else phase.at_s + phase.grace_s
+        body = json.dumps({"rules": phase.rules or []}).encode()
+
+        def post(payload=body, port=ring.ports[name]):
+          req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/debug/faults", data=payload,
+            headers={"Content-Type": "application/json"})
+          with urllib.request.urlopen(req, timeout=5.0):
+            pass
+
+        def delete(port=ring.ports[name]):
+          req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/debug/faults", method="DELETE")
+          with urllib.request.urlopen(req, timeout=5.0):
+            pass
+
+        try:
+          await loop.run_in_executor(None, post)
+          windows.append({"kind": "rules", "node": name,
+                          "t0": now - 1.0, "t1": time.time() + (until - phase.at_s) + phase.grace_s})
+          hold = t_load_start + until - time.monotonic()
+          if hold > 0:
+            await asyncio.sleep(hold)
+        finally:
+          # Synchronous on purpose: this must also run when the driver is
+          # CANCELLED mid-hold (teardown after an early load failure), and
+          # a cancelled coroutine cannot await the executor. Localhost with
+          # a 5 s timeout; a killed/unreachable node has no injector left
+          # to remove.
+          try:
+            delete()
+          except Exception:
+            pass
+    except asyncio.CancelledError:
+      raise
+    except Exception as e:
+      # One unreachable/late node must not lose the whole soak (the run's
+      # collected data and verdict): record the failed phase, keep going.
+      print(f"soak: fault phase {phase.kind}@{phase.at_s:g} (node {phase.node}) "
+            f"failed: {e!r}", file=sys.stderr)
+
+
+async def _drain(ring: SoakRing, timeout_s: float) -> bool:
+  """Wait until every reachable node reports zero in-flight requests."""
+  deadline = time.monotonic() + timeout_s
+  loop = asyncio.get_running_loop()
+  while time.monotonic() < deadline:
+    await loop.run_in_executor(None, ring.scrape_once)
+    busy = [n for n in ring.names if ring.alive(n)
+            and float(ring.last_metrics.get(n, {}).get("xot_active_requests", 0.0)) > 0]
+    if not busy:
+      return True
+    await asyncio.sleep(1.0)
+  return False
+
+
+async def run_soak(cfg: SoakConfig) -> dict:
+  """The whole arc: spawn -> warm -> baseline -> load + faults + scrapes ->
+  drain -> settle scrapes -> verdict report (returned AND written to
+  cfg.out when set)."""
+  import tempfile
+  log_dir = Path(cfg.log_dir) if cfg.log_dir else Path(tempfile.mkdtemp(prefix="xot_soak_"))
+  log_dir.mkdir(parents=True, exist_ok=True)
+  ring = SoakRing(cfg)
+  t_wall_start = time.time()
+  loop = asyncio.get_running_loop()
+  try:
+    await loop.run_in_executor(None, ring.spawn, log_dir)
+    await loop.run_in_executor(None, ring.wait_ready)
+    api_port = ring.ports[ring.names[0]]
+    await _chat_once(api_port, cfg.model)
+    # Let the warmup's metric summaries ride one topology tick so the
+    # baseline cluster scrape includes every node's post-warmup counters.
+    await asyncio.sleep(5.0)
+    await loop.run_in_executor(None, ring.scrape_once)
+    base_cluster = (ring.last_cluster or {}).get("nodes", {})
+    base_metrics = {n: dict(m) for n, m in ring.last_metrics.items()}
+
+    plan = LoadPlan(seconds=cfg.seconds, rate_rps=cfg.rate_rps, arrival=cfg.arrival,
+                    stream_fraction=cfg.stream_fraction, session_reuse=cfg.session_reuse,
+                    max_tokens=cfg.max_tokens, model=cfg.model, seed=cfg.seed)
+    stop_scraper = asyncio.Event()
+    scraper = asyncio.ensure_future(_scraper(ring, stop_scraper))
+    windows: List[dict] = []
+    t_load_start = time.monotonic()
+    fault_task = asyncio.ensure_future(_fault_driver(ring, t_load_start, windows))
+    try:
+      records = await run_load(api_port, plan)
+    finally:
+      # Cancel rather than await: in the normal arc every phase fires
+      # within the load window so this is a no-op, but a load that died
+      # early must not block teardown for the rest of a long wall-clock
+      # fault schedule. The driver's own cleanup (rules uninstall) is
+      # cancel-safe.
+      if not fault_task.done():
+        fault_task.cancel()
+      await asyncio.gather(fault_task, return_exceptions=True)
+      drained = await _drain(ring, cfg.drain_timeout_s)
+      # Two topology ticks so surviving peers' final summaries reach node 0.
+      await asyncio.sleep(5.0)
+      stop_scraper.set()
+      await scraper
+    await loop.run_in_executor(None, ring.scrape_once)
+    settle_a = {n: dict(m) for n, m in ring.last_metrics.items() if ring.alive(n)}
+    await asyncio.sleep(3.0)
+    await loop.run_in_executor(None, ring.scrape_once)
+    settle_b = {n: dict(m) for n, m in ring.last_metrics.items() if ring.alive(n)}
+
+    report = _build_report(cfg, ring, records, windows, base_cluster, base_metrics,
+                           settle_a, settle_b, drained, t_wall_start)
+    verdicts.evaluate(report)
+    if cfg.out:
+      verdicts.write_report(report, cfg.out)
+    return report
+  finally:
+    await loop.run_in_executor(None, ring.teardown)
+
+
+def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
+                  base_cluster, base_metrics, settle_a, settle_b,
+                  drained: bool, t_wall_start: float) -> dict:
+  ok_recs = [r for r in records if r.ok]
+  err_recs = [r for r in records if not r.ok]
+  # The server's request_seconds family records "any outcome" (finish OR
+  # abort), so the client e2e sample it reconciles against must count
+  # errored requests too — excluding them would compare a survivors-only
+  # distribution against an everyone distribution.
+  e2e_all = [r.e2e_s for r in records if r.e2e_s is not None]
+
+  def in_window(rec) -> bool:
+    t_fail = rec.t_submit + (rec.e2e_s or 0.0)
+    return any(w["t0"] <= t_fail <= w["t1"] for w in windows)
+
+  errors_outside = [r for r in err_recs if not in_window(r)]
+  elapsed = max(1e-9, time.time() - t_wall_start)
+  client = {
+    "submitted": len(records),
+    "ok": len(ok_recs),
+    "errors": len(err_recs),
+    "errors_in_fault_windows": len(err_recs) - len(errors_outside),
+    "errors_outside_fault_windows": len(errors_outside),
+    "streamed": sum(1 for r in records if r.streamed),
+    "session_reuse": sum(1 for r in records if r.session is not None),
+    "rps_target": cfg.rate_rps,
+    "rps_achieved": round(len(records) / cfg.seconds, 4) if cfg.seconds else None,
+    "ttft_s": verdicts.latency_summary([r.ttft_s for r in ok_recs if r.ttft_s is not None]),
+    "tpot_s": verdicts.latency_summary([r.tpot_s for r in ok_recs if r.tpot_s is not None]),
+    "e2e_s": verdicts.latency_summary(e2e_all),
+    "e2e_ok_s": verdicts.latency_summary([r.e2e_s for r in ok_recs if r.e2e_s is not None]),
+    "error_samples": [r.error for r in err_recs[:5]],
+  }
+
+  nodes_final = (ring.last_cluster or {}).get("nodes", {})
+  origin = ring.names[0]  # node ids == spawn names; names[0] runs the API
+  server = {}
+  for family, _client_key, mode in verdicts.RECONCILE_FAMILIES:
+    # Two-sided families compare like with like: only the ORIGIN node's
+    # histogram (its first touch ≈ HTTP arrival) — the ring-merged family
+    # is a mixture of per-node views of the same request. One-sided
+    # families merge ring-wide (the invariant holds for every view).
+    only = origin if mode == "two_sided" else None
+    server[family] = verdicts.server_percentiles(
+      nodes_final, base_cluster, family, only_node=only)
+  for counter, prom in (
+      ("watchdog_aborts", "xot_watchdog_aborts_total"),
+      ("request_restarts", "xot_request_restarts_total"),
+      ("peer_evictions", "xot_peer_evictions_total"),
+      ("dedup_drops", "xot_dedup_drops_total"),
+      ("hop_retries", "xot_hop_retries_total"),
+      ("requests", "xot_requests_total"),
+      ("tokens", "xot_tokens_total"),
+  ):
+    server[counter] = (_sum_counter(ring.last_metrics, prom)
+                       - _sum_counter(base_metrics, prom))
+  if ring.last_perf is not None:
+    server["perf"] = {k: ring.last_perf.get(k) for k in ("gauges", "dispatch") if k in ring.last_perf}
+
+  events = _abort_events(ring.last_flight)
+  aborts = verdicts.classify_aborts(events, windows)
+  aborts["unattributed"] = max(0, int(server["watchdog_aborts"]) - len(events))
+
+  report = {
+    "schema": verdicts.SCHEMA,
+    "tag": cfg.tag,
+    "recorded": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t_wall_start)),
+    "elapsed_s": round(time.time() - t_wall_start, 1),
+    "config": {
+      "procs": cfg.procs, "seconds": cfg.seconds, "rate_rps": cfg.rate_rps,
+      "arrival": cfg.arrival, "stream_fraction": cfg.stream_fraction,
+      "session_reuse": cfg.session_reuse, "max_tokens": cfg.max_tokens,
+      "model": cfg.model, "seed": cfg.seed, "recon_tol_s": cfg.recon_tol_s,
+      "restarts": cfg.restarts,
+      "faults": [{"kind": p.kind, "node": p.node, "at_s": p.at_s,
+                  "grace_s": p.grace_s} for p in cfg.faults],
+    },
+    "fault_windows": windows,
+    "client": client,
+    "server": server,
+    "reconciliation": verdicts.reconcile(client, server, cfg.recon_tol_s),
+    "aborts": aborts,
+    "leaks": verdicts.leak_check(settle_a, settle_b),
+    "drained": drained,
+  }
+  if not drained:
+    leaked = report["leaks"]
+    leaked["ok"] = False
+    leaked.setdefault("active_requests", {})["<drain-timeout>"] = 1.0
+  return report

@@ -28,6 +28,16 @@ from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
 WEB_DIR = Path(__file__).parent.parent / "tinychat"
 
 
+class _StreamRestart(Exception):
+  """Internal control flow: a streaming request died to a restartable ring
+  failure BEFORE any byte reached the client — the restart loop in
+  handle_post_chat_completions resubmits it under a fresh request id."""
+
+  def __init__(self, error: str):
+    super().__init__(error)
+    self.error = error
+
+
 class PromptSession:
   def __init__(self, request_id: str, timestamp: int, prompt: str):
     self.request_id = request_id
@@ -133,6 +143,12 @@ class ChatGPTAPI:
     # peer eviction / OOM recovery) + the cluster-wide metric rollup.
     r.add_get("/v1/debug/flight", self.handle_get_flight)
     r.add_get("/v1/cluster/metrics", self.handle_get_cluster_metrics)
+    # Runtime fault-injector control (test/soak only, like /quit): lets the
+    # soak orchestrator drive wall-clock drop/delay/kill phases in a child
+    # process AFTER spawn — XOT_FAULT_SPEC can only be set at startup.
+    r.add_get("/v1/debug/faults", self.handle_get_faults)
+    r.add_post("/v1/debug/faults", self.handle_post_faults)
+    r.add_delete("/v1/debug/faults", self.handle_delete_faults)
     # Live roofline attribution: analytic ceilings + achieved throughput +
     # per-executable time/bytes, with the ring's peers via the status bus.
     r.add_get("/v1/perf", self.handle_get_perf)
@@ -230,6 +246,49 @@ class ChatGPTAPI:
       body["events"] = fl.tail(n)
     return web.json_response(body)
 
+  async def handle_get_faults(self, request):
+    """Current process-wide fault-injector state (test/soak surface)."""
+    from xotorch_tpu.networking import faults
+    inj = faults.active()
+    if inj is None:
+      return web.json_response({"installed": False, "rules": 0, "dead_peers": []})
+    return web.json_response({
+      "installed": True, "rules": len(inj.rules),
+      "dead_peers": sorted(inj.dead_peers),
+    })
+
+  async def handle_post_faults(self, request):
+    """Install a process-wide fault injector at runtime (replaces any
+    previous one). Body: {"rules": [{rpc, peer, nth, action, times,
+    delay_s}, ...]} — the XOT_FAULT_SPEC rule shape. The soak orchestrator
+    uses this for wall-clock fault phases; production deployments should
+    firewall /v1/debug/* exactly like /quit."""
+    from xotorch_tpu.networking import faults
+    try:
+      body = await request.json() if request.can_read_body else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": "body must be JSON"}}, status=400)
+    rules = body.get("rules")
+    if (not isinstance(rules, list) or not rules
+        or not all(isinstance(r, dict) and r.get("action") for r in rules)):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "rules must be a non-empty list of objects with an `action`"}},
+        status=400)
+    try:
+      faults.install(faults.FaultInjector(rules))
+    except (KeyError, TypeError, ValueError) as e:
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": f"bad rule: {e!r}"}}, status=400)
+    return web.json_response({"installed": True, "rules": len(rules)})
+
+  async def handle_delete_faults(self, request):
+    """Remove the installed injector (ends a fault phase)."""
+    from xotorch_tpu.networking import faults
+    faults.install(None)
+    return web.json_response({"installed": False})
+
   async def handle_get_cluster_metrics(self, request):
     """Cluster metric rollup: this node's summary plus the latest summary
     each peer broadcast over the status bus — one scrape sees every peer.
@@ -238,7 +297,14 @@ class ChatGPTAPI:
     nodes = {self.node.id: self.node.metrics_summary()}
     for node_id, summary in self.node.peer_metrics.items():
       nodes.setdefault(node_id, summary)
-    return web.json_response({"nodes": nodes, "count": len(nodes)})
+    # Ring-wide percentiles: bucket counts ride each summary (cumulative,
+    # Prometheus semantics), merged here so one scrape answers "what is the
+    # cluster's TTFT p95" — the question the soak verdict and the
+    # replicated-rings router both route on.
+    from xotorch_tpu.orchestration.metrics import aggregate_histograms
+    aggregate = aggregate_histograms(nodes.values())
+    return web.json_response({"nodes": nodes, "count": len(nodes),
+                              "aggregate": aggregate})
 
   async def handle_get_perf(self, request):
     """Live performance-attribution report (engine.perf_report): the loaded
@@ -751,10 +817,12 @@ class ChatGPTAPI:
     # a request killed by a transient ring failure (hop error, stall
     # abort, evicted peer) is resubmitted ONCE under a fresh request id
     # (cold prefill) on the healed ring instead of surfacing a 500.
-    # Non-streaming only: an SSE stream may have already emitted content
-    # chunks the restart would contradict. Deadline-respecting: no restart
-    # once XOT_REQUEST_DEADLINE_S of wall time is spent.
-    restart_budget = 0 if stream else max(0, knobs.get_int("XOT_REQUEST_RESTARTS"))
+    # Streaming requests qualify only until their first byte reaches the
+    # client (_stream_response prepares lazily and raises _StreamRestart
+    # pre-first-write): once content is on the wire a restart could
+    # contradict it. Deadline-respecting: no restart once
+    # XOT_REQUEST_DEADLINE_S of wall time is spent.
+    restart_budget = max(0, knobs.get_int("XOT_REQUEST_RESTARTS"))
     deadline_s = knobs.get_float("XOT_REQUEST_DEADLINE_S")
     t0 = time.monotonic()
     base_request_id = request_id
@@ -771,8 +839,16 @@ class ChatGPTAPI:
                                          temperature=temperature, top_p=top_p,
                                          sampling=sampling or None)
         if stream:
-          return await self._stream_response(request, request_ids, model, tokenizer, stop=stop,
-                                             logprobs=bool(want_logprobs))
+          can_restart = (attempt < restart_budget
+                         and (deadline_s <= 0 or time.monotonic() - t0 < deadline_s))
+          try:
+            return await self._stream_response(request, request_ids, model, tokenizer, stop=stop,
+                                               logprobs=bool(want_logprobs),
+                                               restartable=can_restart)
+          except _StreamRestart as e:
+            attempt += 1
+            base_request_id = await self._restart_request(base_request_id, e.error)
+            continue
         eos_ids = self._eos_ids(tokenizer)
         try:
           results = await asyncio.gather(*(
@@ -784,15 +860,7 @@ class ChatGPTAPI:
         if (error is not None and attempt < restart_budget and self._restartable(error)
             and (deadline_s <= 0 or time.monotonic() - t0 < deadline_s)):
           attempt += 1
-          self.node.metrics.request_restarts_total.inc()
-          if DEBUG >= 1:
-            print(f"restarting request {base_request_id} after: {error}")
-          base_request_id = str(uuid.uuid4())
-          try:
-            await self.node.heal_ring()
-          except Exception as e:
-            if DEBUG >= 1:
-              print(f"ring heal before restart failed: {e!r}")
+          base_request_id = await self._restart_request(base_request_id, error)
           continue
         return self._build_full_response(request_ids, results, error, model, tokenizer, prompt,
                                          eos_ids, stop=stop, logprobs=bool(want_logprobs))
@@ -815,6 +883,21 @@ class ChatGPTAPI:
     # Client errors and blown deadlines are final; infra failures (hop
     # errors, stalls, evicted peers) qualify for the one-shot restart.
     return not error.startswith(("context_length_exceeded", "deadline_exceeded"))
+
+  async def _restart_request(self, base_request_id: str, error: str) -> str:
+    """Shared restart bookkeeping for the streaming and non-streaming
+    branches: count it, heal the ring (one failed health check is enough to
+    evict after a request just died there), return the fresh request id the
+    resubmission runs under (cold prefill — no partial state survives)."""
+    self.node.metrics.request_restarts_total.inc()
+    if DEBUG >= 1:
+      print(f"restarting request {base_request_id} after: {error}")
+    try:
+      await self.node.heal_ring()
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"ring heal before restart failed: {e!r}")
+    return str(uuid.uuid4())
 
   async def _tokenizer_for(self, model: str, shard):
     if model.startswith("synthetic") or model == "dummy":
@@ -886,9 +969,16 @@ class ChatGPTAPI:
     return ids
 
   async def _stream_response(self, request, request_ids: List[str], model: str, tokenizer,
-                             stop: Optional[List[str]] = None, logprobs: bool = False):
+                             stop: Optional[List[str]] = None, logprobs: bool = False,
+                             restartable: bool = False):
     """SSE stream over one or more completions (OpenAI n): sub-requests'
     queues are merged and each chunk carries its choice index.
+
+    The response is prepared LAZILY (first write sends the headers): until
+    then nothing has reached the client, so a restartable ring failure can
+    raise _StreamRestart and the caller's restart loop resubmits the whole
+    request transparently — the streaming half of XOT_REQUEST_RESTARTS.
+    After the first write the old semantics hold (error event, terminate).
 
     Stop-sequence scanning works on the TRUE decoded text: each iteration
     decodes a choice's full non-EOS token list and diffs against the
@@ -901,7 +991,15 @@ class ChatGPTAPI:
     stop split across chunks is caught before any of it reaches the
     client; `sent[i]` tracks what choice i emitted."""
     response = web.StreamResponse(status=200, headers=self._sse_headers())
-    await response.prepare(request)
+    prepared = False
+
+    async def write(data: bytes) -> None:
+      nonlocal prepared
+      if not prepared:
+        prepared = True
+        await response.prepare(request)
+      await response.write(data)
+
     eos_ids = self._eos_ids(tokenizer)
     acc = ["" for _ in request_ids]
     sent = [0 for _ in request_ids]
@@ -929,13 +1027,17 @@ class ChatGPTAPI:
           continue  # straggler after a stop-sequence cut
         error = self.node.request_errors.pop(rid, None) if finished else None
         if error is not None:
+          if restartable and not prepared and self._restartable(error):
+            # No byte has reached the client yet: hand the failure to the
+            # restart loop instead of committing an error stream.
+            raise _StreamRestart(error)
           # Mid-stream failure: OpenAI-style error event, then terminate. A
           # prompt that overflowed the KV budget is the client's error
           # (context_length_exceeded), not a server fault.
           etype = ("invalid_request_error" if error.startswith("context_length_exceeded")
                    else "server_error")
           payload = {"error": {"type": etype, "message": error}}
-          await response.write(f"data: {json.dumps(payload)}\n\n".encode())
+          await write(f"data: {json.dumps(payload)}\n\n".encode())
           done = [True] * len(done)
           break
         delta = self._delta_tokens(rid, tokens)
@@ -971,17 +1073,17 @@ class ChatGPTAPI:
               tokenizer, [p[0] for p in pairs], [p[1] for p in pairs])}
         done[idx] = done[idx] or finished
         chunk = self._chunk(rid, model, content, finish_reason, index=idx, logprobs=lp_obj)
-        await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        await write(f"data: {json.dumps(chunk)}\n\n".encode())
         deadline = time.monotonic() + self.response_timeout
-      await response.write(b"data: [DONE]\n\n")
+      await write(b"data: [DONE]\n\n")
       await response.write_eof()
       return response
     except asyncio.TimeoutError:
       for idx, rid in enumerate(request_ids):
         if not done[idx]:
           chunk = self._chunk(rid, model, "", "length", index=idx)
-          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
-      await response.write(b"data: [DONE]\n\n")
+          await write(f"data: {json.dumps(chunk)}\n\n".encode())
+      await write(b"data: [DONE]\n\n")
       await response.write_eof()
       return response
     finally:

@@ -445,6 +445,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._exec_seen: set = set()
     self._jit_first_dispatches = 0
     self._jit_cached_dispatches = 0
+    # Device computations currently on the executor (event-loop-thread
+    # increments around _run): the stall watchdog's "actively computing,
+    # not stalled" signal — a cold-jit compile shows up here for its whole
+    # wall time.
+    self._dispatches_inflight = 0
     # Live roofline attribution (XOT_PERF_ATTR, default on): cumulative
     # per-executable time/bytes plus EWMA throughput/utilization gauges,
     # fed ONLY from the _observe_dispatch boundaries below — the wall
@@ -802,7 +807,14 @@ class JAXShardInferenceEngine(InferenceEngine):
     oom_as_cache_exhausted=False and get a RuntimeError instead — a model
     that does not FIT is a capacity problem, not the client's prompt
     length. TPU-native analogue of the reference's CUDA-OOM clear_model
-    recovery (sharded_inference_engine.py:85-106, 330-334)."""
+    recovery (sharded_inference_engine.py:85-106, 330-334).
+
+    The in-flight counter brackets the executor call so the stall watchdog
+    (Node._watchdog_loop via `dispatch_inflight`) can tell "the engine is
+    actively computing — a cold-jit compile included" apart from a silent
+    distributed stall: a compile-heavy first request must never be aborted
+    as stalled while its own prefill is still on the worker thread."""
+    self._dispatches_inflight += 1
     try:
       return await asyncio.get_running_loop().run_in_executor(self.executor, fn, *args)
     except Exception as e:
@@ -819,6 +831,14 @@ class JAXShardInferenceEngine(InferenceEngine):
           raise CacheExhausted(msg) from e
         raise RuntimeError(msg) from e
       raise
+    finally:
+      self._dispatches_inflight -= 1
+
+  def dispatch_inflight(self) -> bool:
+    """True while the executor worker is running a device computation
+    (forward, prefill slice, compile). Consumed by the Node stall watchdog:
+    time spent here is active local work, not a distributed stall."""
+    return self._dispatches_inflight > 0
 
   def _free_device_memory(self) -> str:
     """Aggressive, reference-style recovery: drop every prefix-cache

@@ -64,6 +64,7 @@ EVENTS = (
   "peer.evicted",
   "watchdog.armed",
   "watchdog.fired",
+  "watchdog.deferred",
   "deadline.expired",
 )
 

@@ -8,7 +8,102 @@ text exposition format.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Iterable, List, Optional
+
+# Histogram keys a NodeMetrics.summary() may carry (counters ride alongside);
+# the cluster rollup aggregates exactly these families across peers.
+HISTOGRAM_KEYS = (
+  "ttft_seconds", "request_seconds", "queue_wait_decode_seconds",
+  "queue_wait_prefill_seconds", "token_seconds", "hop_seconds",
+)
+
+
+def _le_value(le) -> float:
+  return float("inf") if le in ("+Inf", "inf") else float(le)
+
+
+def quantile_from_buckets(buckets: Iterable, q: float) -> Optional[float]:
+  """PromQL-style histogram_quantile over CUMULATIVE bucket rows
+  [[le, cumulative_count], ...] (le ascending, '+Inf' JSON-safe as the last
+  bound). Linear interpolation inside the containing bucket; a quantile
+  landing in the +Inf bucket reports the highest finite bound (the honest
+  answer bucketed data can give). None for an empty histogram."""
+  rows = [(_le_value(le), float(c)) for le, c in buckets]
+  if not rows or rows[-1][1] <= 0:
+    return None
+  total = rows[-1][1]
+  rank = max(0.0, min(1.0, q)) * total
+  prev_le, prev_c = 0.0, 0.0
+  for le, c in rows:
+    if c >= rank:
+      if le == float("inf"):
+        return prev_le  # beyond the last finite bound: report that bound
+      if c == prev_c:
+        return le
+      frac = (rank - prev_c) / (c - prev_c)
+      return prev_le + (le - prev_le) * frac
+    prev_le, prev_c = le, c
+  return rows[-1][0] if rows[-1][0] != float("inf") else prev_le
+
+
+def quantile_bucket_span(buckets: Iterable, q: float) -> Optional[float]:
+  """Width of the bucket quantile `q` lands in — the bound on how far the
+  interpolated `quantile_from_buckets` value can sit from the true sample
+  quantile. 0.0 when the quantile lands in the +Inf bucket: the reported
+  value is already truncated DOWN to the last finite bound, so it cannot
+  over-state. None for an empty histogram."""
+  rows = [(_le_value(le), float(c)) for le, c in buckets]
+  if not rows or rows[-1][1] <= 0:
+    return None
+  rank = max(0.0, min(1.0, q)) * rows[-1][1]
+  prev_le, prev_c = 0.0, 0.0
+  for le, c in rows:
+    if c >= rank:
+      return 0.0 if le == float("inf") else le - prev_le
+    prev_le, prev_c = le, c
+  return 0.0
+
+
+def merge_bucket_rows(rows_per_node: Iterable[Iterable]) -> List[list]:
+  """Sum cumulative bucket rows across nodes (all NodeMetrics share one
+  bucket layout per family; a node reporting a different layout is summed
+  by bound, missing bounds contribute nothing)."""
+  acc: Dict[float, float] = {}
+  labels: Dict[float, object] = {}
+  for rows in rows_per_node:
+    for le, c in rows:
+      v = _le_value(le)
+      acc[v] = acc.get(v, 0.0) + float(c)
+      labels.setdefault(v, le)
+  return [[labels[v], acc[v]] for v in sorted(acc)]
+
+
+def aggregate_histograms(summaries: Iterable[dict],
+                         quantiles=(0.5, 0.95, 0.99)) -> Dict[str, dict]:
+  """Ring-wide percentile view over per-node metric summaries (the
+  /v1/cluster/metrics rollup): bucket counts merged per histogram family,
+  then p50/p95/p99 computed from the merged distribution. Families absent
+  from every summary (old peers that predate bucket export) are omitted —
+  their sum/count rows still appear per node."""
+  out: Dict[str, dict] = {}
+  for key in HISTOGRAM_KEYS:
+    rows_per_node, total_sum, total_count = [], 0.0, 0.0
+    for s in summaries:
+      h = s.get(key) if isinstance(s, dict) else None
+      if not isinstance(h, dict):
+        continue
+      total_sum += float(h.get("sum", 0.0))
+      total_count += float(h.get("count", 0.0))
+      if h.get("buckets"):
+        rows_per_node.append(h["buckets"])
+    if not rows_per_node:
+      continue
+    merged = merge_bucket_rows(rows_per_node)
+    entry = {"count": total_count, "sum": total_sum}
+    for q in quantiles:
+      entry[f"p{int(q * 100)}"] = quantile_from_buckets(merged, q)
+    out[key] = entry
+  return out
 
 
 class NodeMetrics:
@@ -120,10 +215,22 @@ class NodeMetrics:
         return None
 
     def hist(metric):
+      # Bucket counts ship CUMULATIVE (Prometheus exposition semantics,
+      # '+Inf' spelled JSON-safe) so the cluster rollup can merge peers'
+      # rows and answer percentile questions (aggregate_histograms) — the
+      # sum/count pair alone cannot.
       try:
-        return {"sum": metric._sum.get(), "count": sum(b.get() for b in metric._buckets)}
+        bounds = metric._upper_bounds
+        counts = [b.get() for b in metric._buckets]
+        s = metric._sum.get()
       except AttributeError:
         return None
+      acc = 0.0
+      rows = []
+      for le, c in zip(bounds, counts):
+        acc += c
+        rows.append(["+Inf" if le == float("inf") else le, acc])
+      return {"sum": s, "count": acc, "buckets": rows}
 
     out = {}
     for key, metric in (

@@ -86,6 +86,12 @@ DEADLINE_KEY = "xot_deadline_s"
 
 _DRAFT_SCAN_WINDOW = knobs.get_int("XOT_SPECULATE_WINDOW")
 
+# A busy local engine defers a stall-watchdog abort (an in-flight cold-jit
+# compile is active work, not a distributed stall) for at most this many
+# stall-timeout multiples: one compile fits comfortably, while an engine kept
+# permanently busy by OTHER requests cannot shield a dead-peer hang forever.
+_STALL_DEFER_CAP = 4
+
 
 def _lookup_draft(context: List[int], k: int) -> List[int]:
   """Prompt-lookup drafting (model-free speculative decoding): propose the
@@ -269,6 +275,10 @@ class Node:
     self.evict_cooldown_s = knobs.get_float("XOT_EVICT_COOLDOWN_S")
     self._request_deadline: Dict[str, float] = {}
     self._last_progress: Dict[str, float] = {}
+    # Requests whose stall abort was deferred because the local engine was
+    # mid-dispatch (compile included): tracked so the flight recorder logs
+    # ONE `watchdog.deferred` per stall episode, not one per sweep tick.
+    self._stall_deferred: set = set()
     # Receiver-side hop dedup: per-request bounded seen-sets of hop seq ids
     # (note_hop_delivery) — what makes retried deliveries idempotent.
     self._hop_seen: "OrderedDict[str, OrderedDict]" = OrderedDict()
@@ -336,6 +346,7 @@ class Node:
 
   def _note_progress(self, request_id: str) -> None:
     self._last_progress[request_id] = time.monotonic()
+    self._stall_deferred.discard(request_id)
     self.start_watchdog(request_id)
 
   def note_hop_delivery(self, request_id: Optional[str], hop_seq: Optional[str]) -> bool:
@@ -395,11 +406,30 @@ class Node:
           # silently lost prompt chain must still end at its deadline
           # instead of riding the API timeout. Rows die at finish, so a
           # completed request can't false-abort.
+          busy_fn = getattr(self.inference_engine, "dispatch_inflight", None)
           for rid in set(self.outstanding_requests) | set(self._last_progress):
             last = self._last_progress.get(rid)
             if last is None:
               self._last_progress[rid] = now
             elif now - last > self.stall_timeout_s:
+              if (busy_fn is not None and busy_fn()
+                  and now - last <= self.stall_timeout_s * _STALL_DEFER_CAP):
+                # The local engine is mid-dispatch (a cold-jit compile of a
+                # first request can exceed any sane stall bound): this is
+                # active work, not the silent distributed stall the watchdog
+                # exists for. Defer — the stall clock keeps running, so the
+                # abort fires at the first sweep that finds the engine idle.
+                # BOUNDED: on a busy ring the engine is mid-dispatch at
+                # almost every sweep serving OTHER requests, which must not
+                # shield a dead-peer hang forever — past the cap the abort
+                # fires regardless. A hung DEVICE call is the request
+                # deadline's job.
+                if rid not in self._stall_deferred:
+                  self._stall_deferred.add(rid)
+                  self.flight.record("watchdog.deferred", rid,
+                                     idle_s=round(now - last, 3))
+                continue
+              self._stall_deferred.discard(rid)
               self.metrics.watchdog_aborts_total.inc()
               self.flight.record("watchdog.fired", rid, kind="stall",
                                  idle_s=round(now - last, 3))
@@ -1777,6 +1807,7 @@ class Node:
     self._request_ring_map.pop(request_id, None)
     self._request_deadline.pop(request_id, None)
     self._last_progress.pop(request_id, None)
+    self._stall_deferred.discard(request_id)
     # _hop_seen rows deliberately OUTLIVE the request (they age out of the
     # bounded LRU instead): a slow retry can land after the request
     # finished, and admitting it as fresh would resurrect per-request state

@@ -98,14 +98,14 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_LR", "float", "1e-5", "Training learning rate.", "Training"),
   Knob("XOT_SAVE_OPT_STATE", "bool", "1", "Persist/restore optimizer state across training checkpoints.", "Training"),
   # ------------------------------------------------- ring / survivability
-  Knob("XOT_HOP_RETRIES", "int", "0", "Retries per ring hop on transient transport failures; 0 = fail-fast.", "Survivability"),
+  Knob("XOT_HOP_RETRIES", "int", "2", "Retries per ring hop on transient transport failures; 0 = fail-fast.", "Survivability"),
   Knob("XOT_HOP_BACKOFF_S", "float", "0.05", "Base backoff (s) for hop retries (exponential + jitter).", "Survivability"),
   Knob("XOT_REQUEST_DEADLINE_S", "float", "0", "End-to-end request deadline (s); remaining budget rides the hops. 0 disables.", "Survivability"),
-  Knob("XOT_STALL_TIMEOUT_S", "float", "0", "Per-node stall watchdog: abort a request with no progress for this long. 0 disables.", "Survivability"),
-  Knob("XOT_HEALTH_INTERVAL_S", "float", "0", "Peer health-check cadence (s); 0 disables the health monitor.", "Survivability"),
+  Knob("XOT_STALL_TIMEOUT_S", "float", "30", "Per-node stall watchdog: abort a request with no progress for this long. A mid-dispatch local engine (compiles included) defers the abort, bounded at 4x. 0 disables.", "Survivability"),
+  Knob("XOT_HEALTH_INTERVAL_S", "float", "5", "Peer health-check cadence (s); 0 disables the health monitor.", "Survivability"),
   Knob("XOT_HEALTH_FAILS", "int", "2", "Consecutive failed health checks before a peer is evicted.", "Survivability"),
   Knob("XOT_EVICT_COOLDOWN_S", "float", "30", "Seconds an evicted peer stays barred from re-admission by discovery.", "Survivability"),
-  Knob("XOT_REQUEST_RESTARTS", "int", "0", "One-shot transparent API restarts after a ring failure (non-streaming).", "Survivability"),
+  Knob("XOT_REQUEST_RESTARTS", "int", "0", "One-shot transparent API restarts after a ring failure (streaming qualifies until its first content chunk).", "Survivability"),
   Knob("XOT_FAULT_SPEC", "json", None, "Test-only: JSON fault-injection rules applied at the peer-handle boundary.", "Survivability"),
   # ------------------------------------------------------------- topology
   Knob("XOT_COORDINATOR", "str", None, "JAX multi-host coordinator address (`host:port`); setting it implies multi-host.", "Topology"),
@@ -130,6 +130,14 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_PERF_ATTR", "bool", "1", "Live roofline attribution: per-dispatch time/bytes/FLOPs accounting served at /v1/perf.", "Observability"),
   Knob("XOT_PERF_EWMA_S", "float", "30", "Time constant (s) of the EWMA throughput/utilization gauges (xot_decode_tok_s and friends).", "Observability"),
   Knob("XOT_DEVICE_TRACE_MAX_S", "float", "120", "Auto-stop a /v1/trace/device/start jax.profiler session after this many seconds; 0 disables the cap.", "Observability"),
+  # ------------------------------------------------------- soak / load gen
+  Knob("XOT_SOAK_SECONDS", "float", "60", "Soak load duration (s) for `python -m tools.soak` when --seconds is not given.", "Soak"),
+  Knob("XOT_SOAK_RPS", "float", "1.5", "Mean open-loop arrival rate (requests/s) for the soak load generator.", "Soak"),
+  Knob("XOT_SOAK_PROCS", "int", "2", "Ring size (node processes) the soak orchestrator spawns.", "Soak"),
+  Knob("XOT_SOAK_STREAM_FRACTION", "float", "0.5", "Fraction of soak requests issued as SSE streaming completions.", "Soak"),
+  Knob("XOT_SOAK_SESSION_REUSE", "float", "0.3", "Probability a soak request reuses a session prefix (prefix-cache exercise).", "Soak"),
+  Knob("XOT_SOAK_RECON_TOL_S", "float", "2.5", "Absolute slack (s) allowed between client- and server-observed latency percentiles in the soak verdict.", "Soak"),
+  Knob("XOT_SOAK_SEED", "int", "1234", "PRNG seed for the soak load generator (arrivals, lengths, mixes).", "Soak"),
 )
 
 REGISTRY: Dict[str, Knob] = {k.name: k for k in _DEFS}
