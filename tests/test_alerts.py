@@ -1,0 +1,386 @@
+"""SLO burn-rate alerts: burn math, counter-reset windows, the state
+machine, gray-failure localization scoring, the /v1/alerts + /metrics
+surface, and the no-new-syncs / knobs-off-byte-identical contracts.
+
+The injector-driven end-to-end (mid-ring delay -> firing alert naming the
+slow peer over a real two-node ring) lives in tests/test_fault_injection.py
+with the rest of the fault matrix.
+"""
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.inference.dummy import DummyInferenceEngine
+from xotorch_tpu.networking.peer_handle import HopRttEwma
+from xotorch_tpu.orchestration.alerts import (
+  AlertEngine, RULES, count_at_or_below, delta_hist, monotonic_violation,
+)
+
+from tests.test_orchestration import _caps, _make_node
+
+
+def _hist(obs, bounds=(0.1, 0.5, 1.0, 5.0)):
+  rows = [[b, float(sum(1 for o in obs if o <= b))] for b in bounds]
+  rows.append(["+Inf", float(len(obs))])
+  return {"sum": float(sum(obs)), "count": float(len(obs)), "buckets": rows}
+
+
+def _summary(requests=0, failed=0, ttft=(), e2e=()):
+  """A NodeMetrics.summary()-shaped snapshot with CUMULATIVE series."""
+  return {"requests": float(requests), "requests_failed": float(failed),
+          "ttft_seconds": _hist(ttft), "request_seconds": _hist(e2e)}
+
+
+def _alert_env(monkeypatch, **over):
+  env = {"XOT_ALERT_FAST_S": "10", "XOT_ALERT_SLOW_S": "20",
+         "XOT_ALERT_BURN_FAST": "1", "XOT_ALERT_BURN_SLOW": "1",
+         "XOT_ALERT_PENDING_S": "5", "XOT_ALERT_RESOLVE_S": "5",
+         "XOT_SLO_ERROR_RATE": "0.1", "XOT_SLO_TTFT_S": "0.5",
+         "XOT_SLO_TARGET": "0.9"}
+  env.update(over)
+  for k, v in env.items():
+    monkeypatch.setenv(k, str(v))
+
+
+# ------------------------------------------------------------------- math
+
+def test_count_at_or_below_interpolates():
+  rows = [[0.1, 4.0], [1.0, 8.0], ["+Inf", 10.0]]
+  assert count_at_or_below(rows, 0.1) == 4.0
+  assert count_at_or_below(rows, 1.0) == 8.0
+  # Midpoint of the (0.1, 1.0] bucket: 4 + 4 * (0.55-0.1)/0.9 = 6.
+  assert count_at_or_below(rows, 0.55) == pytest.approx(6.0)
+  # Above the last finite bound: +Inf observations stay ABOVE any target.
+  assert count_at_or_below(rows, 100.0) == 8.0
+  assert count_at_or_below([], 1.0) == 0.0
+
+
+def test_delta_hist_windows_out_old_observations():
+  base = _hist([0.05, 0.05])["buckets"]
+  cur = _hist([0.05, 0.05, 2.0, 2.0])
+  d = delta_hist(cur, {"buckets": base, "count": 2.0})
+  assert d["count"] == 2.0
+  # Both windowed observations sit above 1.0: all bad at a 0.5 target.
+  assert d["count"] - count_at_or_below(d["buckets"], 0.5) == pytest.approx(2.0)
+
+
+def test_monotonic_violation_detects_resets():
+  a = _summary(requests=10, failed=1, e2e=[0.1] * 5)
+  b = _summary(requests=12, failed=1, e2e=[0.1] * 6)
+  assert monotonic_violation(a, b) is None
+  assert "requests" in monotonic_violation(b, _summary(requests=2))
+  shrunk = _summary(requests=12, failed=1, e2e=[0.1])
+  assert "request_seconds" in monotonic_violation(b, shrunk)
+
+
+# ---------------------------------------------------------- engine windows
+
+async def test_counter_reset_clamps_and_restarts_window(monkeypatch):
+  _alert_env(monkeypatch)
+  node = await _make_node("ar-reset", DummyInferenceEngine())
+  eng = AlertEngine(node)
+  eng.evaluate(now=0.0, summary=_summary(requests=10, failed=0))
+  eng.evaluate(now=10.0, summary=_summary(requests=20, failed=0))
+  assert len(eng._snapshots) == 2 and eng.window_resets == 0
+  # A transparent restart re-exports from zero: the delta would be -15
+  # requests. The window must restart, not report a nonsense burn.
+  transitions = eng.evaluate(now=20.0, summary=_summary(requests=5, failed=3))
+  assert eng.window_resets == 1
+  assert len(eng._snapshots) == 1  # post-reset snapshot only
+  st = eng._states["slo_error_rate"]
+  assert st["state"] == "inactive" and st["burn_fast"] == 0.0
+  assert transitions == []
+  # Post-reset deltas work from the new epoch: 3 new failures now burn.
+  eng.evaluate(now=30.0, summary=_summary(requests=8, failed=6))
+  assert eng._states["slo_error_rate"]["burn_fast"] > 1.0
+
+
+async def test_state_machine_pending_firing_resolved(monkeypatch):
+  _alert_env(monkeypatch)
+  node = await _make_node("ar-sm", DummyInferenceEngine())
+  eng = AlertEngine(node)
+  eng.evaluate(now=0.0, summary=_summary(requests=10))
+  # Burst of failures: error-rate burn exceeds both windows -> pending.
+  tr = eng.evaluate(now=10.0, summary=_summary(requests=12, failed=2))
+  assert [t["to"] for t in tr] == ["pending"]
+  st = eng._states["slo_error_rate"]
+  assert st["state"] == "pending" and st["burn_fast"] > 1.0
+  # Held past XOT_ALERT_PENDING_S -> firing, with a frozen flight snapshot
+  # and a localization payload attached.
+  tr = eng.evaluate(now=16.0, summary=_summary(requests=13, failed=2))
+  assert [t["to"] for t in tr] == ["firing"]
+  assert st["state"] == "firing" and st["fired_at"] == 16.0
+  assert "localization" in st and "peers" in st["localization"]
+  assert any(s["reason"] == "alert_firing:slo_error_rate"
+             for s in node.flight.snapshots())
+  events = [e["event"] for e in node.flight.tail()]
+  assert "alert.pending" in events and "alert.firing" in events
+  assert [a["rule"] for a in eng.active()] == ["slo_error_rate"]
+  # Failures age out of both windows; after the hysteresis -> resolved.
+  tr = eng.evaluate(now=40.0, summary=_summary(requests=20, failed=2))
+  assert [t["to"] for t in tr] == ["resolved"]
+  assert st["state"] == "inactive" and eng.active() == []
+  recent = eng.recent()
+  assert recent and recent[0]["rule"] == "slo_error_rate"
+  assert recent[0]["fired_at"] == 16.0 and recent[0]["resolved_at"] == 40.0
+  assert "alert.resolved" in [e["event"] for e in node.flight.tail()]
+
+
+async def test_latency_rule_burns_on_slow_tail(monkeypatch):
+  _alert_env(monkeypatch, XOT_ALERT_PENDING_S="0")
+  node = await _make_node("ar-lat", DummyInferenceEngine())
+  eng = AlertEngine(node)
+  fast = [0.05] * 9
+  eng.evaluate(now=0.0, summary=_summary(requests=9, ttft=fast))
+  # 4 of 6 windowed TTFTs above the 0.5 s target: frac 0.67 / budget 0.1.
+  slow_now = fast + [0.05, 0.05] + [2.0] * 4
+  tr = eng.evaluate(now=10.0, summary=_summary(requests=15, ttft=slow_now))
+  st = eng._states["slo_ttft"]
+  assert st["burn_fast"] == pytest.approx((4 / 6) / 0.1, rel=1e-3)
+  assert st["state"] == "firing"
+  assert {t["to"] for t in tr} == {"pending", "firing"}
+  # A pending alert whose burn clears before XOT_ALERT_PENDING_S elapses
+  # goes back to inactive without ever firing (no flapping pages).
+  st2 = eng._states["slo_error_rate"]
+  assert st2["state"] == "inactive"
+
+
+async def test_pending_clears_without_firing(monkeypatch):
+  _alert_env(monkeypatch, XOT_ALERT_PENDING_S="100")
+  node = await _make_node("ar-pend", DummyInferenceEngine())
+  eng = AlertEngine(node)
+  eng.evaluate(now=0.0, summary=_summary(requests=10))
+  eng.evaluate(now=10.0, summary=_summary(requests=12, failed=2))
+  assert eng._states["slo_error_rate"]["state"] == "pending"
+  tr = eng.evaluate(now=40.0, summary=_summary(requests=30, failed=2))
+  assert eng._states["slo_error_rate"]["state"] == "inactive"
+  assert [t["to"] for t in tr] == ["cancelled"]
+  assert eng.recent() == []  # never fired, nothing resolved
+  events = [e["event"] for e in node.flight.tail()]
+  assert "alert.cancelled" in events and "alert.firing" not in events
+
+
+async def test_shipped_defaults_can_fire_latency_rules(monkeypatch):
+  """Regression: the maximum latency burn is 1/budget, so the shipped
+  XOT_SLO_TARGET must leave 1/(1-target) ABOVE both default burn
+  thresholds or slo_ttft/slo_e2e can never fire at all (a 90% target caps
+  burn at 10, below the 14.4x SRE pair — the bug this test pins). Proven
+  end to end: an all-bad TTFT window at PURE defaults walks the rule to
+  firing."""
+  import xotorch_tpu.utils.knobs as knobs_mod
+  for name in knobs_mod.REGISTRY:
+    if name.startswith(("XOT_ALERT", "XOT_SLO")):
+      monkeypatch.delenv(name, raising=False)
+  node = await _make_node("ar-defaults", DummyInferenceEngine())
+  eng = AlertEngine(node)
+  assert 1.0 / eng.latency_budget > eng.burn_fast_thr
+  assert 1.0 / eng.latency_budget > eng.burn_slow_thr
+  eng.evaluate(now=0.0, summary=_summary(requests=5, ttft=[0.1] * 5))
+  bad = [0.1] * 5 + [60.0] * 20  # every windowed TTFT blows the 10 s target
+  eng.evaluate(now=130.0, summary=_summary(requests=25, ttft=bad))
+  st = eng._states["slo_ttft"]
+  assert st["state"] == "pending" and st["burn_fast"] >= eng.burn_fast_thr
+  eng.evaluate(now=145.0, summary=_summary(requests=25, ttft=bad))
+  assert st["state"] == "firing"
+
+
+# ------------------------------------------------------------ localization
+
+def test_hop_rtt_ewma_converges():
+  ewma = HopRttEwma(tau_s=1.0)
+  assert ewma.value() is None
+  ewma.observe(0.1, now=0.0)
+  assert ewma.value() == pytest.approx(0.1)
+  for i in range(1, 20):
+    ewma.observe(0.5, now=i * 1.0)
+  assert 0.4 < ewma.value() <= 0.5
+  assert ewma.count == 20
+
+
+class _FakePeer:
+  def __init__(self, pid, rtt=None):
+    self._pid = pid
+    self.hop_rtt = None
+    if rtt is not None:
+      self.hop_rtt = HopRttEwma(tau_s=30.0)
+      self.hop_rtt.observe(rtt)
+
+  def id(self):
+    return self._pid
+
+
+async def test_localization_scores_degraded_peer(monkeypatch):
+  _alert_env(monkeypatch, XOT_ALERT_HOP_DEGRADED_S="0.05",
+             XOT_ALERT_DEGRADED_FACTOR="3")
+  node = await _make_node("ar-loc", DummyInferenceEngine())
+  node.peers = [_FakePeer("p-fast1", 0.01), _FakePeer("p-fast2", 0.012),
+                _FakePeer("p-slow", 0.5), _FakePeer("p-mute")]
+  eng = AlertEngine(node)
+  loc = eng.localization()
+  assert loc["suspect"] == "p-slow" and loc["stage"] == "hop"
+  assert loc["peers"]["p-slow"]["degraded"] is True
+  assert loc["peers"]["p-fast1"]["degraded"] is False
+  assert "p-mute" not in loc["peers"]  # no sends yet: no RTT, no verdict
+  assert loc["peers"]["p-slow"]["score"] > 10
+  # Compute decomposition: a peer whose per-dispatch time is an outlier is
+  # scored via the status-bus perf compacts.
+  node.peers = [_FakePeer("p-a", 0.01)]
+  node.ingest_peer_metrics("p-slow-compute",
+                           {"perf": {"secs": 50.0, "dispatches": 100}})
+  node.ingest_peer_metrics("p-ok", {"perf": {"secs": 0.4, "dispatches": 100}})
+  loc = eng.localization()
+  assert loc["compute"]["p-slow-compute"]["degraded"] is True
+  assert loc["suspect"] == "p-slow-compute" and loc["stage"] == "compute"
+
+
+# ------------------------------------------------------------- API surface
+
+async def test_alerts_endpoint_and_metrics_gauges(monkeypatch):
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  _alert_env(monkeypatch, XOT_ALERT_PENDING_S="0")
+  node = await _make_node("ar-api", DummyInferenceEngine())
+  node.topology.update_node("ar-api", _caps())
+  node.peers = [_FakePeer("ar-peer", 0.07)]
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy")
+  node.alerts.evaluate(now=0.0, summary=_summary(requests=10))
+  node.alerts.evaluate(now=10.0, summary=_summary(requests=12, failed=2))
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.get("/v1/alerts")
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["node_id"] == "ar-api" and data["enabled"]
+    assert set(data["rules"]) == {r.name for r in RULES}
+    assert [a["rule"] for a in data["active"]] == ["slo_error_rate"]
+    assert data["cluster"]["firing"] == 1
+    assert data["cluster"]["active"][0]["node_id"] == "ar-api"
+    assert "ar-peer" in data["degraded"]["peers"]
+    resp = await client.get("/metrics")
+    text = (await resp.read()).decode()
+    assert "xot_alerts_firing 1.0" in text
+    assert 'xot_slo_burn_rate{family="requests_failed/requests"}' in text
+    assert 'xot_peer_hop_seconds{peer="ar-peer"} 0.07' in text
+    assert "xot_requests_failed_total" in text
+  finally:
+    await client.close()
+    await node.stop()
+
+
+async def test_cluster_rollup_carries_remote_alerts(monkeypatch):
+  """Satellite: a REMOTE node's firing alert (with its localization
+  suspect) is visible from one /v1/alerts call on the origin, via the
+  status-bus compact riding node_metrics; stale peers are marked."""
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  _alert_env(monkeypatch)
+  node = await _make_node("ar-origin", DummyInferenceEngine())
+  node.topology.update_node("ar-origin", _caps())
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy")
+  remote = {"requests": 5.0, "ts": time.time(),
+            "alerts": {"active": [{"rule": "slo_e2e", "state": "firing",
+                                   "fired_at": 123.0, "suspect": "ar-slow",
+                                   "stage": "hop"}],
+                       "recent": [], "firing": 1, "degraded_peers": ["ar-slow"]}}
+  node.on_node_status("", json.dumps(
+    {"type": "node_metrics", "node_id": "ar-remote", "metrics": remote}))
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    data = await (await client.get("/v1/alerts")).json()
+    assert "ar-remote" in data["nodes"]
+    row = [r for r in data["cluster"]["active"] if r["node_id"] == "ar-remote"][0]
+    assert row["rule"] == "slo_e2e" and row["suspect"] == "ar-slow"
+    assert data["cluster"]["degraded_peers"] == ["ar-slow"]
+    assert data["cluster"]["firing"] == 1
+    # Age the row past 3x the topology cadence: marked stale, still shown.
+    node._peer_metrics_at["ar-remote"] -= 1000.0
+    data = await (await client.get("/v1/alerts")).json()
+    assert data["nodes"]["ar-remote"]["stale"] is True
+  finally:
+    await client.close()
+    await node.stop()
+
+
+# --------------------------------------------- hot-path + knobs-off contracts
+
+async def test_alerts_add_no_device_syncs_and_knobs_off_bytes(monkeypatch):
+  """Alert evaluation interleaved with decode adds ZERO block_until_ready /
+  host-fetch syncs, and the greedy stream is byte-identical alerts-on vs
+  alerts-off (XOT_ALERT=0) — evaluation reads metric cells and wall
+  clocks, never the device."""
+  import jax
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+
+  shard = Shard("synthetic-tiny", 0, 3, 4)
+  real_bur, real_asarray = jax.block_until_ready, np.asarray
+  counts = {}
+
+  async def run(alert_on: bool):
+    mp = pytest.MonkeyPatch()
+    try:
+      mp.setenv("XOT_ALERT", "1" if alert_on else "0")
+      node = await _make_node(f"ar-sync-{alert_on}", JAXShardInferenceEngine())
+      node.topology.update_node(node.id, _caps())
+      n = {"bur": 0, "asarray": 0}
+
+      def counting_bur(x):
+        n["bur"] += 1
+        return real_bur(x)
+
+      def counting_asarray(*a, **k):
+        n["asarray"] += 1
+        return real_asarray(*a, **k)
+
+      engine = node.inference_engine
+      prompt = np.arange(1, 17, dtype=np.int64).reshape(1, -1)
+
+      async def drive(rid):
+        tok, _ = await engine.infer_sample_tensor(rid, shard, prompt,
+                                                 temp=0.0, top_k=0)
+        stream = [int(tok)]
+        for _ in range(3):
+          node.alerts.evaluate()
+          chunk = await engine.generate_chunk(rid, shard, stream[-1], 4,
+                                              temp=0.0, top_k=0)
+          stream.extend(int(t) for t in real_asarray(chunk).reshape(-1))
+          node.alerts.evaluate()
+        return stream
+
+      # Warm pass (uncounted): pays every compile with identical shapes so
+      # the counted pass is compile-noise-free in BOTH runs.
+      await drive("ar-sync-warm")
+      mp.setattr(jax, "block_until_ready", counting_bur)
+      mp.setattr(np, "asarray", counting_asarray)
+      try:
+        stream = await drive("ar-sync-req")
+      finally:
+        mp.setattr(jax, "block_until_ready", real_bur)
+        mp.setattr(np, "asarray", real_asarray)
+      counts[alert_on] = dict(n)
+      await node.stop()
+      return stream
+    finally:
+      mp.undo()
+
+  on_stream = await run(True)
+  off_stream = await run(False)
+  assert on_stream == off_stream, "alerts-off run must be byte-identical"
+  assert counts[True] == counts[False], (
+    f"alert evaluation added device syncs: {counts}")
+
+
+async def test_alert_disabled_is_inert(monkeypatch):
+  monkeypatch.setenv("XOT_ALERT", "0")
+  node = await _make_node("ar-off", DummyInferenceEngine())
+  assert node.alerts.enabled is False
+  assert node.alerts.evaluate() == []
+  assert node.alerts.status()["enabled"] is False
+  assert "alerts" not in node.metrics_summary()
+  node.start_alerts()
+  assert node._alert_task is None

@@ -249,6 +249,26 @@ def test_soak_gate_rejects_red_and_inconsistent_reports(tmp_path):
   assert check_repo(tmp_path) == []
 
 
+def test_soak_alert_keys_gate_and_direction(tmp_path):
+  """Out-of-fault-window alert firings are zero-tolerance: REGRESSED even
+  from a zero baseline, and a committed green report carrying one is
+  flagged by --check; raw firing counts stay informational (a kill is
+  SUPPOSED to fire the error-rate rule)."""
+  rows = _rows_by_metric(diff_records(
+    soak_metrics_of(_soak_record(alert_firings_outside_fault_windows=1.0,
+                                 alert_firings_total=3.0)),
+    soak_metrics_of(_soak_record(alert_firings_outside_fault_windows=0.0,
+                                 alert_firings_total=1.0))))
+  assert rows["alert_firings_outside_fault_windows"]["verdict"] == "REGRESSED"
+  assert rows["alert_firings_total"]["verdict"] == "info"
+  (tmp_path / "PERF.md").write_text(perf_md_section(tmp_path) + "\n")
+  lying = _soak_record(alert_firings_outside_fault_windows=2.0)
+  (tmp_path / "SOAK_alerts.json").write_text(json.dumps(lying))
+  findings = check_repo(tmp_path)
+  assert any("SOAK_alerts.json" in f and "alert_firings_outside_fault_windows" in f
+             for f in findings)
+
+
 def test_soak_cli_diff_and_mixed_shapes(tmp_path, capsys):
   cur = tmp_path / "SOAK_now.json"
   base = tmp_path / "SOAK_then.json"

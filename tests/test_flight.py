@@ -166,3 +166,42 @@ async def test_peer_metrics_ingestion_feeds_cluster_view():
     assert merged["ttft_seconds"]["p95"] > 1.0
   finally:
     await node.stop()
+
+
+async def test_stale_peer_metrics_marked_and_excluded():
+  """Satellite (ISSUE 9): peer_metrics rows are stamped at ingest, marked
+  `stale` past 3x the topology cadence, excluded from the cluster
+  aggregate, and pruned outright when the peer is evicted — a dead node's
+  last-good summary must not shape /v1/cluster/metrics forever."""
+  node = await _make_node("fr-stale", DummyInferenceEngine())
+  try:
+    peer_summary = {"requests": 5,
+                    "ttft_seconds": {"sum": 80.0, "count": 10,
+                                     "buckets": [[1.0, 0], [10.0, 10], ["+Inf", 10]]}}
+    node.ingest_peer_metrics("peer-live", peer_summary)
+    assert node.peer_metrics_stale("peer-live") is False
+    nodes, aggregate = node.cluster_metrics_view()
+    assert "stale" not in nodes["peer-live"]
+    assert aggregate["ttft_seconds"]["count"] == 10  # fresh row aggregates
+    # Age the row past 3x the cadence: marked, excluded, still listed.
+    node._peer_metrics_at["peer-live"] -= 3.0 * node.topology_interval + 1.0
+    assert node.peer_metrics_stale("peer-live") is True
+    nodes, aggregate = node.cluster_metrics_view()
+    assert nodes["peer-live"]["stale"] is True
+    # The stale peer's 10 observations no longer shape the aggregate; only
+    # the local node's (empty) histograms remain.
+    assert aggregate["ttft_seconds"]["count"] == 0
+    # A never-stamped row (old peer, direct write) is stale by definition.
+    node.peer_metrics["peer-legacy"] = {"requests": 1}
+    assert node.peer_metrics_stale("peer-legacy") is True
+
+    # Eviction prunes the row outright.
+    class _DeadPeer:
+      def id(self): return "peer-live"
+      def addr(self): return "nowhere"
+      async def disconnect(self, grace=None): pass
+    await node._evict_peer(_DeadPeer())
+    assert "peer-live" not in node.peer_metrics
+    assert "peer-live" not in node._peer_metrics_at
+  finally:
+    await node.stop()

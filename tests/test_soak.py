@@ -190,6 +190,75 @@ def test_evaluate_red_on_false_abort_leak_or_outside_error():
   assert soak.evaluate(recon)["verdict"] == "red"
 
 
+def test_summarize_alerts_classifies_by_fault_window():
+  windows = [{"t0": 90.0, "t1": 150.0}]
+  alerts = {"nodes": {
+    "n0": {"active": [{"rule": "slo_e2e", "state": "firing", "fired_at": 100.0,
+                       "suspect": "n1", "stage": "hop"},
+                      {"rule": "slo_ttft", "state": "pending"}],  # never fired
+           "recent": [{"rule": "slo_error_rate", "fired_at": 110.0,
+                       "resolved_at": 140.0}]},
+    "n1": {"active": [], "recent": [{"rule": "slo_e2e", "fired_at": 500.0,
+                                     "resolved_at": 520.0}]},
+  }}
+  out = soak.summarize_alerts(alerts, windows)
+  assert len(out["firings"]) == 3  # pending-only rows don't count
+  assert out["outside_fault_windows"] == 1  # n1's firing at ts=500
+  assert out["fired_and_resolved_in_window"] == 1  # n0's error-rate alert
+  by_rule = {r["rule"]: r for r in out["firings"] if r["node_id"] == "n0"}
+  assert by_rule["slo_e2e"]["suspect"] == "n1"
+  # An alert visible in BOTH active and recent scrapes dedups by
+  # (node, rule, fired_at); empty/missing scrapes are harmless.
+  dup = {"nodes": {"n0": {
+    "active": [{"rule": "r", "fired_at": 100.0}],
+    "recent": [{"rule": "r", "fired_at": 100.0, "resolved_at": 120.0}]}}}
+  assert len(soak.summarize_alerts(dup, windows)["firings"]) == 1
+  assert soak.summarize_alerts(None, windows) == {
+    "firings": [], "outside_fault_windows": 0, "fired_and_resolved_in_window": 0}
+
+
+def test_classify_alert_firings_merges_resolution_across_scrapes():
+  """The orchestrator accumulates rows from every scrape: a firing seen
+  active mid-run merges with its resolved view from a later scrape (one
+  firing, resolved), so an eviction pruning the peer's compact before the
+  settle scrape cannot lose the firing OR its resolution."""
+  windows = [{"t0": 90.0, "t1": 150.0}]
+  rows = soak.alert_rows_of({"nodes": {"n1": {
+    "active": [{"rule": "r", "fired_at": 100.0}], "recent": []}}})
+  rows += soak.alert_rows_of({"nodes": {"n1": {
+    "active": [], "recent": [{"rule": "r", "fired_at": 100.0,
+                              "resolved_at": 120.0}]}}})
+  out = soak.classify_alert_firings(rows, windows)
+  assert len(out["firings"]) == 1
+  assert out["firings"][0]["resolved_at"] == 120.0
+  assert out["fired_and_resolved_in_window"] == 1
+
+
+def test_evaluate_consumes_alerts():
+  ok = _min_report(alerts={"firings": [
+    {"node_id": "n0", "rule": "slo_error_rate", "fired_at": 100.0,
+     "resolved_at": 140.0, "in_fault_window": True}],
+    "outside_fault_windows": 0, "fired_and_resolved_in_window": 1})
+  green = soak.evaluate(ok)
+  assert green["verdict"] == "green"
+  m = green["metrics"]
+  assert m["alert_firings_total"] == 1.0
+  assert m["alert_firings_outside_fault_windows"] == 0.0
+  assert m["alerts_fired_and_resolved"] == 1.0
+  # A firing with no fault to blame is red — the alerting twin of a
+  # false abort.
+  red = soak.evaluate(_min_report(alerts={"firings": [
+    {"node_id": "n0", "rule": "slo_ttft", "fired_at": 7.0,
+     "in_fault_window": False, "suspect": "n1"}],
+    "outside_fault_windows": 1, "fired_and_resolved_in_window": 0}))
+  assert red["verdict"] == "red"
+  assert any("outside any fault window" in r for r in red["reasons"])
+  # Pre-alert reports (no `alerts` section) still evaluate cleanly.
+  legacy = soak.evaluate(_min_report())
+  assert legacy["verdict"] == "green"
+  assert "alert_firings_total" not in legacy["metrics"]
+
+
 # ----------------------------------------------------------- prom parsing
 
 def test_parse_prom_sums_and_skips():

@@ -425,6 +425,66 @@ def test_flight_events_absent_module_skips_checks(tmp_path):
   assert findings_by(repo, "metrics-consistency") == []
 
 
+def _metrics_with_ttft_hist():
+  return FIXTURE_METRICS.replace(
+    "from prometheus_client import CollectorRegistry, Counter, Gauge",
+    "from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram",
+  ).replace(
+    "  def exposition(self):",
+    '    self.ttft = Histogram(\n'
+    '      "xot_ttft_seconds", "TTFT", ["node_id"], registry=self.registry\n'
+    '    ).labels(**labels)\n\n'
+    "  def exposition(self):",
+  )
+
+
+def test_alert_rule_refs_clean_fixture(tmp_path):
+  """AlertRule references that resolve against the extracted surface —
+  family to an exported histogram, bad/total to exported counters — are
+  clean (the FP guard for unknown-alert-metric)."""
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/metrics.py": _metrics_with_ttft_hist(),
+    "xotorch_tpu/orchestration/alerts.py": (
+      "class AlertRule:\n"
+      "  def __init__(self, **kw): pass\n"
+      "RULES = (\n"
+      "  AlertRule(name='lat', kind='latency', family='ttft_seconds'),\n"
+      "  AlertRule(name='err', kind='errors', bad='requests', total='requests'),\n"
+      ")\n"
+    ),
+  })
+  assert findings_by(repo, "metrics-consistency", "unknown-alert-metric") == []
+
+
+def test_alert_rule_refs_flag_unresolvable_metrics(tmp_path):
+  """A typo'd rule reference means the alert silently evaluates to 'no
+  data' forever — the TP case: an unknown family, an unexported counter,
+  and a family resolving to the WRONG type (a gauge is not a latency
+  distribution) all fail."""
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/metrics.py": _metrics_with_ttft_hist(),
+    "xotorch_tpu/orchestration/alerts.py": (
+      "class AlertRule:\n"
+      "  def __init__(self, **kw): pass\n"
+      "RULES = (\n"
+      "  AlertRule(name='a', kind='latency', family='nope_seconds'),\n"
+      "  AlertRule(name='b', kind='errors', bad='ghost', total='requests'),\n"
+      "  AlertRule(name='c', kind='latency', family='peers'),\n"  # gauge, not hist
+      ")\n"
+    ),
+  })
+  keys = {f.key for f in findings_by(repo, "metrics-consistency",
+                                     "unknown-alert-metric")}
+  assert keys == {"family:nope_seconds", "bad:ghost", "family:peers"}
+
+
+def test_alert_rule_refs_absent_module_skips(tmp_path):
+  """Fixture trees without orchestration/alerts.py simply have no rules to
+  check (every pre-existing fixture in this file)."""
+  repo = make_tree(tmp_path, {})
+  assert findings_by(repo, "metrics-consistency", "unknown-alert-metric") == []
+
+
 def test_metrics_registry_resolves_labeled_histogram_family(tmp_path):
   """The shared-parent registry shape — one Histogram local, several
   `self.attr = var.labels(...)` — must register every attr, or the

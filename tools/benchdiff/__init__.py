@@ -170,11 +170,18 @@ def baseline_metrics_for(baseline: Dict[str, Any],
 # make CI flake on fault-timing luck, so they report as info.
 _SOAK_DOWN = frozenset({
   "false_aborts", "leaked_requests", "pool_page_leaks",
+  # An SLO alert firing with no injected fault to blame is the alerting
+  # twin of a false abort: the rules paged on healthy traffic. A green
+  # verdict guarantees zero, so the drift gate can never flag a green run.
+  "alert_firings_outside_fault_windows",
 })
 _SOAK_INFO = frozenset({
   "requests_submitted", "requests_ok", "request_errors",
   "request_restarts_total", "peer_evictions_total", "hop_retries_total",
   "dedup_drops_total", "watchdog_aborts_total",
+  # Raw firing counts depend on the fault schedule (a kill is SUPPOSED to
+  # fire the error-rate rule), so magnitude drift is informational.
+  "alert_firings_total", "alerts_fired_and_resolved",
 })
 
 
@@ -330,7 +337,9 @@ def _soak_findings(name: str, rec: Dict[str, Any]) -> List[str]:
   if not isinstance(metrics, dict) or not any(_is_number(v) for v in metrics.values()):
     findings.append(f"{name}: soak report carries no flat `metrics` dict to diff")
   else:
-    for zero_key in ("false_aborts", "leaked_requests", "pool_page_leaks"):
+    # Driven by _SOAK_DOWN so the drift gate and the green-contradiction
+    # gate can never disagree about what zero-tolerance means.
+    for zero_key in sorted(_SOAK_DOWN):
       v = metrics.get(zero_key)
       if _is_number(v) and v > 0 and verdict == "green":
         findings.append(f"{name}: metrics[{zero_key}]={v} contradicts the green verdict")

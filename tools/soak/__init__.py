@@ -24,6 +24,11 @@ The three verdict questions (ROADMAP "survivability production defaults"):
 3. **Leaks** — after the load drains, in-flight gauges must return to
    zero, the page pool must stop growing, and the host tier must respect
    its byte budget.
+4. **Alerts** — every SLO burn-rate alert FIRING must fall inside an
+   active fault window (the alert engine must not page on healthy
+   traffic), and the smoke's kill must drive at least one alert through
+   fired-then-resolved — the end-to-end proof of the pending -> firing ->
+   resolved machine (`summarize_alerts`; asserted by `--smoke`).
 """
 from __future__ import annotations
 
@@ -167,6 +172,76 @@ def reconcile(client: Dict[str, dict], server: Dict[str, dict],
   return out
 
 
+def alert_rows_of(alerts: Optional[dict]) -> List[dict]:
+  """Node-tagged FIRING rows from one /v1/alerts cluster scrape (active +
+  recent compacts; pending-only rows never fired and carry nothing to
+  classify)."""
+  rows: List[dict] = []
+  for node_id, node_alerts in ((alerts or {}).get("nodes") or {}).items():
+    if not isinstance(node_alerts, dict):
+      continue
+    for row in (node_alerts.get("active") or []) + (node_alerts.get("recent") or []):
+      if row.get("fired_at") is None:
+        continue
+      rows.append({"node_id": node_id, **row})
+  return rows
+
+
+def alert_row_key(row: dict) -> tuple:
+  """One firing's identity across scrapes: the same alert seen active in
+  one scrape and resolved in a later one is one firing, not two."""
+  return (row.get("node_id"), row.get("rule"), round(float(row["fired_at"]), 1))
+
+
+def classify_alert_firings(rows: Iterable[dict],
+                           fault_windows: Iterable[dict]) -> Dict[str, Any]:
+  """Classify the ring's SLO alert firings against the fault schedule. The
+  green bar mirrors the abort rule: every FIRING must fall inside an
+  active fault window (an alert with no injected fault to blame means the
+  rules page on healthy traffic), and the smoke's kill phase must produce
+  at least one fired-then-resolved alert — proof the whole pending ->
+  firing -> resolved machine works under a real fault. Duplicate rows
+  (the same firing seen across scrapes / in both active and recent) merge
+  by identity, preferring the resolved view."""
+  windows = [(float(w["t0"]), float(w["t1"])) for w in fault_windows]
+  out_rows: List[dict] = []
+  seen: Dict[tuple, dict] = {}
+  for row in rows:
+    fired = float(row["fired_at"])
+    key = alert_row_key(row)
+    prev = seen.get(key)
+    if prev is not None:
+      if row.get("resolved_at") is not None and prev.get("resolved_at") is None:
+        prev["resolved_at"] = row.get("resolved_at")
+      continue
+    entry = {
+      "node_id": row.get("node_id"), "rule": row.get("rule"),
+      "family": row.get("family"), "fired_at": fired,
+      "resolved_at": row.get("resolved_at"),
+      "suspect": row.get("suspect"), "stage": row.get("stage"),
+      "in_fault_window": any(t0 <= fired <= t1 for t0, t1 in windows),
+    }
+    seen[key] = entry
+    out_rows.append(entry)
+  outside = [r for r in out_rows if not r["in_fault_window"]]
+  fired_resolved = [r for r in out_rows
+                    if r["in_fault_window"] and r.get("resolved_at") is not None]
+  return {
+    "firings": out_rows,
+    "outside_fault_windows": len(outside),
+    "fired_and_resolved_in_window": len(fired_resolved),
+  }
+
+
+def summarize_alerts(alerts: Optional[dict],
+                     fault_windows: Iterable[dict]) -> Dict[str, Any]:
+  """classify_alert_firings over a single /v1/alerts scrape. The soak
+  orchestrator accumulates rows across its CONTINUOUS scrapes instead —
+  an eviction prunes a dead peer's compact from later scrapes, so the
+  settle scrape alone could lose a firing that happened on it."""
+  return classify_alert_firings(alert_rows_of(alerts), fault_windows)
+
+
 def classify_aborts(abort_events: Iterable[dict],
                     fault_windows: Iterable[dict]) -> Dict[str, list]:
   """Split watchdog/deadline abort evidence into injected (inside an active
@@ -251,13 +326,21 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
   leaks = report.get("leaks") or {}
   out["leaked_requests"] = float(sum((leaks.get("active_requests") or {}).values()))
   out["pool_page_leaks"] = float(sum((leaks.get("pool_pages_growth") or {}).values()))
+  alerts = report.get("alerts")
+  if alerts is not None:
+    out["alert_firings_total"] = float(len(alerts.get("firings") or ()))
+    out["alert_firings_outside_fault_windows"] = float(
+      alerts.get("outside_fault_windows", 0))
+    out["alerts_fired_and_resolved"] = float(
+      alerts.get("fired_and_resolved_in_window", 0))
   return out
 
 
 def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
   """Stamp the verdict: `green` iff reconciliation holds, no false aborts,
-  no leaks, and no client errors landed OUTSIDE a fault window. Returns the
-  report with `verdict`, `reasons`, and flat `metrics` filled in."""
+  no leaks, no alert firing outside a fault window, and no client errors
+  landed OUTSIDE a fault window. Returns the report with `verdict`,
+  `reasons`, and flat `metrics` filled in."""
   reasons: List[str] = []
   for name, row in (report.get("reconciliation") or {}).items():
     if row.get("ok") is False:
@@ -274,6 +357,12 @@ def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
   leaks = report.get("leaks") or {}
   if leaks and not leaks.get("ok", True):
     reasons.append(f"leaks: {json.dumps({k: v for k, v in leaks.items() if k != 'ok'})}")
+  for fired in ((report.get("alerts") or {}).get("firings") or ()):
+    if not fired.get("in_fault_window"):
+      reasons.append(
+        f"alert fired outside any fault window: {fired.get('rule')} on "
+        f"{fired.get('node_id')} at ts={fired.get('fired_at')}"
+        + (f" (suspect {fired.get('suspect')})" if fired.get("suspect") else ""))
   client = report.get("client") or {}
   outside = client.get("errors_outside_fault_windows", 0)
   if outside:

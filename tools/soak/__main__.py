@@ -138,9 +138,22 @@ def main(argv=None) -> int:
   print(f"  aborts: injected={len(ab.get('injected') or ())} "
         f"false={len(ab.get('false') or ())} unattributed={ab.get('unattributed', 0)}; "
         f"leaks ok={report.get('leaks', {}).get('ok')}; report={cfg.out}")
+  al = report.get("alerts") or {}
+  print(f"  alerts: firings={len(al.get('firings') or ())} "
+        f"outside_fault_windows={al.get('outside_fault_windows', 0)} "
+        f"fired_and_resolved={al.get('fired_and_resolved_in_window', 0)}")
   for reason in report.get("reasons", []):
     print(f"  RED: {reason}")
-  return 0 if report.get("verdict") == "green" else 1
+  rc = 0 if report.get("verdict") == "green" else 1
+  if rc == 0 and any(p.kind == "kill" for p in cfg.faults):
+    # A kill phase must PROVE the alert machine end to end: at least one
+    # alert fired inside the kill window and resolved after the fault
+    # cleared. A green run with a silent alert engine is not green.
+    if al.get("fired_and_resolved_in_window", 0) < 1:
+      print("  RED: kill phase produced no fired-then-resolved alert "
+            "(the burn-rate rules slept through an injected fault)")
+      rc = 1
+  return rc
 
 
 if __name__ == "__main__":

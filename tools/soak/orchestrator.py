@@ -51,6 +51,29 @@ def parse_prom(text: str) -> Dict[str, float]:
   return out
 
 
+# Alert knobs for soak children: CI-timescale windows so a smoke's single
+# mid-run kill provably drives pending -> firing -> resolved INSIDE the run
+# (the production defaults' 2/10-minute windows and 14.4x/6x thresholds are
+# sized for real traffic and would outlive the whole smoke). The error
+# budget is loose enough that only an actual failure burst burns it, and
+# the latency targets stay at their (CPU-safe) defaults.
+SOAK_ALERT_ENV = {
+  "XOT_ALERT_EVAL_S": "1",
+  "XOT_ALERT_FAST_S": "15",
+  "XOT_ALERT_SLOW_S": "45",
+  "XOT_ALERT_BURN_FAST": "2",
+  "XOT_ALERT_BURN_SLOW": "1",
+  "XOT_ALERT_PENDING_S": "1",
+  "XOT_ALERT_RESOLVE_S": "5",
+  "XOT_SLO_ERROR_RATE": "0.05",
+  # With burn thresholds this low, keep the latency budget WIDE (10% of
+  # requests may miss the CPU-safe latency targets) so a loaded CI runner
+  # can't fire a latency rule outside the fault window — the kill detector
+  # here is the error-rate rule.
+  "XOT_SLO_TARGET": "0.9",
+}
+
+
 @dataclass
 class FaultPhase:
   kind: str                      # "kill" | "rules"
@@ -89,6 +112,7 @@ class SoakConfig:
   scrape_interval_s: float = 2.0
   drain_timeout_s: float = 120.0
   restarts: int = 1              # XOT_REQUEST_RESTARTS for the children
+  alert_env: Dict[str, str] = field(default_factory=lambda: dict(SOAK_ALERT_ENV))
 
 
 class SoakRing:
@@ -105,6 +129,12 @@ class SoakRing:
     self.last_flight: Dict[str, dict] = {}
     self.last_cluster: Optional[dict] = None
     self.last_perf: Optional[dict] = None
+    self.last_alerts: Optional[dict] = None
+    # Firing rows accumulated across every /v1/alerts scrape, keyed by
+    # alert identity: peer eviction PRUNES a dead node's compact from
+    # later scrapes, so the settle scrape alone could lose a firing that
+    # happened on it — the verdict classifies this superset instead.
+    self.alert_rows: Dict[tuple, dict] = {}
     self.killed: set = set()
 
   def spawn(self, log_dir: Path) -> None:
@@ -116,7 +146,8 @@ class SoakRing:
         name, self.cfg.api_base + i, self.cfg.udp_port, self.cfg.udp_port,
         self.cfg.grpc_base + i, self.logs[name], model=self.cfg.model,
         response_timeout=180,
-        extra_env={"XOT_REQUEST_RESTARTS": str(self.cfg.restarts)},
+        extra_env={"XOT_REQUEST_RESTARTS": str(self.cfg.restarts),
+                   **self.cfg.alert_env},
       )
 
   def wait_ready(self) -> None:
@@ -175,6 +206,17 @@ class SoakRing:
       perf = self.get_json(api, "/v1/perf")
       if perf is not None:
         self.last_perf = perf
+      # The cluster-rolled alert view: node 0 sees every peer's active +
+      # recent alerts via the status bus, so one scrape covers the ring.
+      alerts = self.get_json(api, "/v1/alerts")
+      if alerts is not None:
+        self.last_alerts = alerts
+        for row in verdicts.alert_rows_of(alerts):
+          key = verdicts.alert_row_key(row)
+          prev = self.alert_rows.get(key)
+          if prev is None or (row.get("resolved_at") is not None
+                              and prev.get("resolved_at") is None):
+            self.alert_rows[key] = row
 
   def kill(self, index: int) -> None:
     name = self.names[index]
@@ -357,6 +399,13 @@ async def run_soak(cfg: SoakConfig) -> dict:
     await asyncio.sleep(3.0)
     await loop.run_in_executor(None, ring.scrape_once)
     settle_b = {n: dict(m) for n, m in ring.last_metrics.items() if ring.alive(n)}
+    # Settle-time /v1/alerts scrape: the firing->resolved evidence the CI
+    # step uploads as an artifact (and the report's alert section reads).
+    try:
+      (log_dir / "alerts_settle.json").write_text(
+        json.dumps(ring.last_alerts or {}, indent=1) + "\n")
+    except OSError as e:
+      print(f"soak: writing alerts_settle.json failed: {e!r}", file=sys.stderr)
 
     report = _build_report(cfg, ring, records, windows, base_cluster, base_metrics,
                            settle_a, settle_b, drained, t_wall_start)
@@ -430,6 +479,10 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   events = _abort_events(ring.last_flight)
   aborts = verdicts.classify_aborts(events, windows)
   aborts["unattributed"] = max(0, int(server["watchdog_aborts"]) - len(events))
+  # Classify the accumulated superset, not just the settle scrape: a
+  # firing on a since-evicted peer survives here even though its compact
+  # no longer rides the final /v1/alerts response.
+  alerts = verdicts.classify_alert_firings(list(ring.alert_rows.values()), windows)
 
   report = {
     "schema": verdicts.SCHEMA,
@@ -450,6 +503,7 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
     "server": server,
     "reconciliation": verdicts.reconcile(client, server, cfg.recon_tol_s),
     "aborts": aborts,
+    "alerts": alerts,
     "leaks": verdicts.leak_check(settle_a, settle_b),
     "drained": drained,
   }

@@ -88,6 +88,7 @@ class Repo:
     readme_path: str = "README.md",
     helpers_path: str = "xotorch_tpu/utils/helpers.py",
     flight_path: str = "xotorch_tpu/orchestration/flight.py",
+    alerts_path: str = "xotorch_tpu/orchestration/alerts.py",
   ):
     self.root = os.path.abspath(root)
     self.py_roots = tuple(py_roots)
@@ -97,6 +98,7 @@ class Repo:
     self.readme_path = readme_path
     self.helpers_path = helpers_path
     self.flight_path = flight_path
+    self.alerts_path = alerts_path
     self._files: Optional[List[SourceFile]] = None
     self._by_path: Dict[str, SourceFile] = {}
     self._knobs_module = None

@@ -32,7 +32,13 @@ Checks:
   element is not an engine `_attr`) must resolve to a key some engine-side
   code actually produces (a dict-literal key or `d["key"] = ...` store) —
   otherwise the exported series silently KeyErrors or reads a value that
-  exists nowhere.
+  exists nowhere;
+- `unknown-alert-metric`: every `AlertRule(...)` metric reference in
+  orchestration/alerts.py must resolve against the statically extracted
+  surface — `family="x"` to an exported histogram `xot_x`, `bad=`/`total=`
+  to an exported counter `xot_x_total`. A typo'd reference evaluates to
+  "no data" forever: the rule silently never fires, which is the worst
+  possible failure mode for an alert.
 """
 from __future__ import annotations
 
@@ -185,6 +191,23 @@ def _flight_record_sites(repo: Repo) -> List[Tuple[str, str, int]]:
   return sites
 
 
+def alert_rule_refs(repo: Repo) -> List[Tuple[str, str, int]]:
+  """(kwarg, referenced-name, line) for every string `family=`/`bad=`/
+  `total=` keyword of an `AlertRule(...)` call in the alerts module."""
+  sf = repo.file(repo.alerts_path)
+  rows: List[Tuple[str, str, int]] = []
+  if sf is None or sf.tree is None:
+    return rows
+  for node in ast.walk(sf.tree):
+    if isinstance(node, ast.Call) \
+        and dotted_name(node.func).rsplit(".", 1)[-1] == "AlertRule":
+      for kw in node.keywords:
+        if kw.arg in ("family", "bad", "total") and isinstance(kw.value, ast.Constant) \
+            and isinstance(kw.value.value, str) and kw.value.value:
+          rows.append((kw.arg, kw.value.value, node.lineno))
+  return rows
+
+
 def _bump_sites(repo: Repo) -> List[Tuple[str, str, int]]:
   """(key, path, line) for every faults.bump("key") call."""
   sites = []
@@ -332,6 +355,25 @@ def check(repo: Repo) -> List[Finding]:
           message=f"flight event `{event}` is declared but nothing records it — "
                   "remove it or restore the instrumentation",
         ))
+
+  # Alert-rule metric references resolve against the extracted surface:
+  # a latency rule's family must be an exported histogram, an error rule's
+  # bad/total counters must export as xot_<name>_total.
+  alerts_sf = repo.file(repo.alerts_path)
+  for kwarg, ref, line in alert_rule_refs(repo):
+    if alerts_sf is not None and alerts_sf.suppressed(line, CHECKER):
+      continue
+    if kwarg == "family":
+      want, want_type = f"xot_{ref}", "histogram"
+    else:
+      want, want_type = f"xot_{ref}_total", "counter"
+    if exported.get(want) != want_type:
+      findings.append(Finding(
+        CHECKER, "unknown-alert-metric", repo.alerts_path, line, key=f"{kwarg}:{ref}",
+        message=f"AlertRule {kwarg}={ref!r} needs exported {want_type} `{want}` "
+                "but the extracted metrics surface has no such series — "
+                "the rule would evaluate to 'no data' forever",
+      ))
 
   # Engine counters the API exports must be incremented somewhere, and
   # stats-dict rows (pool/host/perf gauges) must read a key some engine

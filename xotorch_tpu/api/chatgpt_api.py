@@ -143,6 +143,10 @@ class ChatGPTAPI:
     # peer eviction / OOM recovery) + the cluster-wide metric rollup.
     r.add_get("/v1/debug/flight", self.handle_get_flight)
     r.add_get("/v1/cluster/metrics", self.handle_get_cluster_metrics)
+    # SLO burn-rate alerts + gray-failure localization: active/recent alerts
+    # with burn rates and degraded-peer scores, cluster-rolled like
+    # peer_metrics so one scrape sees every node's firing alerts.
+    r.add_get("/v1/alerts", self.handle_get_alerts)
     # Runtime fault-injector control (test/soak only, like /quit): lets the
     # soak orchestrator drive wall-clock drop/delay/kill phases in a child
     # process AFTER spawn — XOT_FAULT_SPEC can only be set at startup.
@@ -292,19 +296,46 @@ class ChatGPTAPI:
   async def handle_get_cluster_metrics(self, request):
     """Cluster metric rollup: this node's summary plus the latest summary
     each peer broadcast over the status bus — one scrape sees every peer.
-    Peers' rows carry their own `ts`; a stale row means a quiet (or dead)
-    peer, which is itself signal."""
-    nodes = {self.node.id: self.node.metrics_summary()}
-    for node_id, summary in self.node.peer_metrics.items():
-      nodes.setdefault(node_id, summary)
-    # Ring-wide percentiles: bucket counts ride each summary (cumulative,
-    # Prometheus semantics), merged here so one scrape answers "what is the
-    # cluster's TTFT p95" — the question the soak verdict and the
-    # replicated-rings router both route on.
-    from xotorch_tpu.orchestration.metrics import aggregate_histograms
-    aggregate = aggregate_histograms(nodes.values())
+    A peer whose last summary is older than 3x the topology cadence is
+    marked `stale: true` and EXCLUDED from the ring-wide percentile
+    aggregate (a dead node's last-good histogram is history, not signal);
+    the per-node row is still served so operators see who went quiet."""
+    nodes, aggregate = self.node.cluster_metrics_view()
     return web.json_response({"nodes": nodes, "count": len(nodes),
                               "aggregate": aggregate})
+
+  async def handle_get_alerts(self, request):
+    """SLO alert surface: this node's full rule status (burn rates, active
+    + recent alerts, the live ring decomposition with degraded-peer
+    scores) plus each peer's alert compact off the status bus — ONE call
+    answers "is anything firing anywhere, and which peer is to blame".
+    Stale peers (3x topology cadence, same rule as /v1/cluster/metrics)
+    are marked, and `cluster` merges every node's alerts tagged by node."""
+    al = self.node.alerts
+    loc = al.localization()  # score the ring once for both views below
+    body = {"node_id": self.node.id, **al.status(localization=loc)}
+    nodes = {self.node.id: al.compact(localization=loc)}
+    for nid, summary in self.node.peer_metrics.items():
+      alerts = summary.get("alerts") if isinstance(summary, dict) else None
+      if alerts is None:
+        continue
+      if self.node.peer_metrics_stale(nid):
+        alerts = {**alerts, "stale": True}
+      nodes[nid] = alerts
+    cluster_active, cluster_recent = [], []
+    for nid, alerts in nodes.items():
+      for row in alerts.get("active") or []:
+        cluster_active.append({"node_id": nid, **row})
+      for row in alerts.get("recent") or []:
+        cluster_recent.append({"node_id": nid, **row})
+    body["nodes"] = nodes
+    body["cluster"] = {
+      "active": cluster_active, "recent": cluster_recent,
+      "firing": sum(int(a.get("firing") or 0) for a in nodes.values()),
+      "degraded_peers": sorted({p for a in nodes.values()
+                                for p in (a.get("degraded_peers") or [])}),
+    }
+    return web.json_response(body)
 
   async def handle_get_perf(self, request):
     """Live performance-attribution report (engine.perf_report): the loaded
@@ -405,6 +436,30 @@ class ChatGPTAPI:
          "EWMA model FLOP utilization vs the chip peak (0 off-TPU)"),
       ):
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {perf[key]}\n")
+    # SLO alert gauges (XOT_ALERT, default on): firing count, per-family
+    # fast-window burn rates, and per-peer hop send RTT EWMAs — the
+    # localization signal, scrapeable without touching /v1/alerts.
+    alerts = self.node.alerts if self.node.alerts.enabled else None
+    if alerts is not None:
+      astats = alerts.gauge_stats()
+      for key, name, help_text in (
+        ("firing", "xot_alerts_firing", "SLO alert rules currently firing on this node"),
+      ):
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {astats[key]}\n")
+      burn = alerts.burn_gauges()
+      if burn:
+        extra.append("# HELP xot_slo_burn_rate Fast-window SLO burn rate "
+                     "(error-budget multiples) per rule family\n"
+                     "# TYPE xot_slo_burn_rate gauge\n")
+        for family, value in sorted(burn.items()):
+          extra.append(f'xot_slo_burn_rate{{family="{family}"}} {value}\n')
+      hops = alerts.peer_hop_gauges()
+      if hops:
+        extra.append("# HELP xot_peer_hop_seconds EWMA hop send RTT to each "
+                     "ring peer (gray-failure localization signal)\n"
+                     "# TYPE xot_peer_hop_seconds gauge\n")
+        for pid, value in sorted(hops.items()):
+          extra.append(f'xot_peer_hop_seconds{{peer="{pid}"}} {value}\n')
     if extra:
       body = body + "".join(extra).encode()
     # aiohttp's content_type kwarg rejects parameters; set the full

@@ -7,6 +7,7 @@ with tensors framed by the XOT1 codec (bf16 stays bf16 on the wire).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional, Tuple
 
 import grpc
@@ -172,23 +173,27 @@ class GRPCPeerHandle(PeerHandle):
     seq = faults.hop_seq()
     if self.flight is not None:
       self.flight.record("hop.send", request_id, rpc="SendPrompt", peer=self._id, seq=seq)
+    t0 = time.monotonic()
     await self._call("SendPrompt", {
       "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
       "max_tokens": max_tokens, "n_images": len(tensors) or None, "temperature": temperature,
       "top_p": top_p, "ring_map": ring_map, "deadline": deadline, "hop_seq": seq,
     }, tensors or None)
+    self.note_hop_rtt(time.monotonic() - t0)
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
                         inference_state: Optional[dict] = None) -> None:
     seq = faults.hop_seq()
     if self.flight is not None:
       self.flight.record("hop.send", request_id, rpc="SendTensor", peer=self._id, seq=seq)
+    t0 = time.monotonic()
     await self._call(
       "SendTensor",
       {"shard": shard.to_dict(), "request_id": request_id, "inference_state": inference_state,
        "hop_seq": seq},
       {"tensor": tensor},
     )
+    self.note_hop_rtt(time.monotonic() - t0)
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
                          train: bool, request_id: Optional[str] = None,

@@ -16,6 +16,7 @@ and the engine's keep_on_device plumbing key off.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -88,7 +89,9 @@ class InProcessPeerHandle(PeerHandle):
       if flags["lost_ack"]:
         raise faults.TransientHopError(f"injected lost ack on SendPrompt to {self.node.id}")
 
+    t0 = time.monotonic()
     await faults.with_hop_retries(attempt)
+    self.note_hop_rtt(time.monotonic() - t0)
 
   async def send_tensor(self, shard: Shard, tensor, request_id: Optional[str] = None,
                         inference_state: Optional[dict] = None) -> None:
@@ -105,7 +108,9 @@ class InProcessPeerHandle(PeerHandle):
       if flags["lost_ack"]:
         raise faults.TransientHopError(f"injected lost ack on SendTensor to {self.node.id}")
 
+    t0 = time.monotonic()
     await faults.with_hop_retries(attempt)
+    self.note_hop_rtt(time.monotonic() - t0)
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,
                          train: bool, request_id: Optional[str] = None,

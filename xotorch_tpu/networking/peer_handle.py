@@ -6,6 +6,8 @@ orchestration layer never sees transport details.
 """
 from __future__ import annotations
 
+import math
+import time
 from abc import ABC, abstractmethod
 from typing import Optional, Tuple
 
@@ -14,6 +16,36 @@ import numpy as np
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.topology.device_capabilities import DeviceCapabilities
 from xotorch_tpu.topology.topology import Topology
+from xotorch_tpu.utils import knobs
+
+
+class HopRttEwma:
+  """Irregular-interval EWMA of hop send round-trip seconds for ONE peer.
+
+  The gray-failure signal: a peer that answers health checks but silently
+  adds latency to every hop moves this number and nothing else. Fed from
+  wall timestamps the handles already have around their send awaits (host
+  clock only — no device work, no extra RPCs); read by the alert engine's
+  ring decomposition and exported as `xot_peer_hop_seconds{peer=...}`."""
+
+  def __init__(self, tau_s: float = 30.0):
+    self.tau_s = max(1e-3, float(tau_s))
+    self._value: Optional[float] = None
+    self._at: Optional[float] = None
+    self.count = 0
+
+  def observe(self, secs: float, now: Optional[float] = None) -> None:
+    now = time.monotonic() if now is None else now
+    if self._value is None:
+      self._value = float(secs)
+    else:
+      alpha = 1.0 - math.exp(-max(1e-6, now - self._at) / self.tau_s)
+      self._value += alpha * (float(secs) - self._value)
+    self._at = now
+    self.count += 1
+
+  def value(self) -> Optional[float]:
+    return self._value
 
 
 class PeerHandle(ABC):
@@ -22,6 +54,16 @@ class PeerHandle(ABC):
   # with their dedup seq ids — into the SENDER's timeline. None until a
   # node adopts the handle; handles used standalone record nothing.
   flight = None
+  # Per-peer hop send RTT EWMA (lazily created on the first timed send):
+  # the sender-side latency decomposition of a ring hop. Includes retries
+  # and backoff — the honest "how long did handing this peer a tensor
+  # take" number the localization scorer needs.
+  hop_rtt: Optional[HopRttEwma] = None
+
+  def note_hop_rtt(self, secs: float) -> None:
+    if self.hop_rtt is None:
+      self.hop_rtt = HopRttEwma(knobs.get_float("XOT_ALERT_RTT_TAU_S"))
+    self.hop_rtt.observe(secs)
 
   @abstractmethod
   def id(self) -> str:

@@ -66,6 +66,14 @@ EVENTS = (
   "watchdog.fired",
   "watchdog.deferred",
   "deadline.expired",
+  # SLO burn-rate alert state machine (orchestration/alerts.py): one event
+  # per transition, so a frozen snapshot shows pending -> firing -> resolved
+  # (or pending -> cancelled when the burn clears before the pending hold
+  # elapses) with the burn rates that drove each edge.
+  "alert.pending",
+  "alert.firing",
+  "alert.resolved",
+  "alert.cancelled",
 )
 
 _EVENT_SET = frozenset(EVENTS)

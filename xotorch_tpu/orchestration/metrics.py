@@ -177,6 +177,13 @@ class NodeMetrics:
       "xot_dedup_drops_total", "Retried hop deliveries dropped by receiver-side dedup",
       ["node_id"], registry=self.registry,
     ).labels(**labels)
+    # Terminal request failures (any _abort_request: hop error, watchdog,
+    # deadline, engine fault). The numerator of the error-rate SLO rule —
+    # `requests` alone can't answer "what fraction of traffic is dying".
+    self.requests_failed_total = Counter(
+      "xot_requests_failed_total", "Requests that ended in an abort on this node (any cause)",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
 
   def exposition(self) -> bytes:
     from prometheus_client import generate_latest
@@ -240,6 +247,7 @@ class NodeMetrics:
       ("peer_evictions", self.peer_evictions_total),
       ("request_restarts", self.request_restarts_total),
       ("dedup_drops", self.dedup_drops_total),
+      ("requests_failed", self.requests_failed_total),
     ):
       v = counter(metric)
       if v is not None:

@@ -40,7 +40,8 @@ from xotorch_tpu.networking.server import Server
 from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES, device_capabilities
 from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitions_to_shards
 from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
-from xotorch_tpu.orchestration.metrics import NodeMetrics
+from xotorch_tpu.orchestration.alerts import AlertEngine
+from xotorch_tpu.orchestration.metrics import NodeMetrics, aggregate_histograms
 from xotorch_tpu.orchestration.flight import FlightRecorder
 from xotorch_tpu.topology.topology import Topology
 from xotorch_tpu.utils import knobs
@@ -195,8 +196,15 @@ class Node:
     # Latest metric summaries received from peers over the status bus
     # (type "node_metrics"); served by /v1/cluster/metrics so one scrape
     # sees the whole ring. Bounded by cluster size in practice; the LRU
-    # guard protects against id churn.
+    # guard protects against id churn. Each ingest is stamped (monotonic)
+    # so a dead node's last-good summary reads STALE past 3x the topology
+    # cadence instead of polluting the cluster aggregate forever, and
+    # eviction prunes the row outright.
     self.peer_metrics: "OrderedDict[str, dict]" = OrderedDict()
+    self._peer_metrics_at: Dict[str, float] = {}
+    # Topology-reconcile cadence (start() overwrites with the real value):
+    # the staleness horizon for peer_metrics rows is 3x this.
+    self.topology_interval = 2.0
     # Engine-depth observability: hand the engine this node's recorder,
     # metrics registry, tracer, and a trace-context resolver so batcher
     # queue waits, prefill slices, pool pressure, host-tier traffic, and
@@ -286,6 +294,12 @@ class Node:
     self._evicted_until: Dict[str, float] = {}
     self._watchdog_task: Optional[asyncio.Task] = None
     self._health_task: Optional[asyncio.Task] = None
+    # SLO burn-rate alerts + gray-failure localization (XOT_ALERT, default
+    # on): evaluated on a background cadence over windowed deltas of this
+    # node's own metric summaries; served at /v1/alerts and rolled over the
+    # status bus via metrics_summary().
+    self.alerts = AlertEngine(self)
+    self._alert_task: Optional[asyncio.Task] = None
 
   def _spawn(self, coro) -> "asyncio.Task":
     return spawn_detached(coro, self._detached_tasks)
@@ -294,6 +308,7 @@ class Node:
 
   async def start(self, wait_for_peers: int = 0, topology_interval: float = 2.0) -> None:
     self.device_capabilities = await device_capabilities()
+    self.topology_interval = topology_interval
     await self.server.start()
     await self.discovery.start()
     await self.update_peers(wait_for_peers)
@@ -301,11 +316,12 @@ class Node:
     self._topology_task = self._spawn(self.periodic_topology_collection(topology_interval))
     self.start_watchdog()
     self.start_health_monitor()
+    self.start_alerts()
     if DEBUG >= 1:
       print(f"Node {self.id} started; topology: {self.topology}")
 
   async def stop(self) -> None:
-    for attr in ("_topology_task", "_watchdog_task", "_health_task"):
+    for attr in ("_topology_task", "_watchdog_task", "_health_task", "_alert_task"):
       task = getattr(self, attr)
       if task is not None:
         task.cancel()
@@ -343,6 +359,22 @@ class Node:
   def start_health_monitor(self) -> None:
     if self._health_task is None and self.health_interval_s > 0:
       self._health_task = self._spawn(self._health_monitor_loop())
+
+  def start_alerts(self) -> None:
+    if self._alert_task is None and self.alerts.enabled:
+      self._alert_task = self._spawn(self._alert_loop())
+
+  async def _alert_loop(self) -> None:
+    """SLO rule evaluation cadence: snapshot the node's own metric summary,
+    difference it at the burn windows, step each rule's state machine.
+    Host-side reads only — this loop can never add a device sync."""
+    while True:
+      await asyncio.sleep(self.alerts.eval_interval_s)
+      try:
+        self.alerts.evaluate()
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"alert evaluation error: {e!r}")
 
   def _note_progress(self, request_id: str) -> None:
     self._last_progress[request_id] = time.monotonic()
@@ -475,6 +507,11 @@ class Node:
     self.peers = [p for p in self.peers if p.id() != peer.id()]
     self._evicted_until[peer.id()] = time.monotonic() + self.evict_cooldown_s
     self._health_fails.pop(peer.id(), None)
+    # A dead peer's last-good metric summary must not keep feeding the
+    # cluster aggregate (it would freeze the ring's percentiles at the
+    # moment of death).
+    self.peer_metrics.pop(peer.id(), None)
+    self._peer_metrics_at.pop(peer.id(), None)
     self.metrics.peer_evictions_total.inc()
     self.metrics.peers.set(len(self.peers))
     self.flight.record("peer.evicted", None, peer=peer.id(),
@@ -821,6 +858,7 @@ class Node:
     string rides the broadcast so API nodes surface a real error instead of
     an empty successful completion."""
     self.record_request_error(request_id, error)
+    self.metrics.requests_failed_total.inc()
     # Freeze the request's flight timeline BEFORE cleanup churns the ring:
     # watchdog aborts, blown deadlines, and hop errors each become a
     # replayable /v1/debug/flight snapshot instead of one log line.
@@ -1944,13 +1982,45 @@ class Node:
     perf = perf_fn() if callable(perf_fn) else None
     if perf is not None:
       summary["perf"] = perf
+    # Alert compact (active + recent + degraded peers): rides the same
+    # broadcast so ONE /v1/alerts scrape on any node sees the whole ring's
+    # firing alerts with their localization verdicts.
+    if self.alerts.enabled:
+      summary["alerts"] = self.alerts.compact()
     return summary
 
   def ingest_peer_metrics(self, node_id: str, summary: dict) -> None:
     self.peer_metrics[node_id] = summary
     self.peer_metrics.move_to_end(node_id)
+    self._peer_metrics_at[node_id] = time.monotonic()
     while len(self.peer_metrics) > 64:
-      self.peer_metrics.popitem(last=False)
+      evicted_id, _ = self.peer_metrics.popitem(last=False)
+      self._peer_metrics_at.pop(evicted_id, None)
+
+  def peer_metrics_stale(self, node_id: str) -> bool:
+    """True when a peer's last summary is older than 3x the topology cadence
+    (summaries ride every topology tick, so three missed ticks means a dead
+    or wedged peer — its row is history, not signal)."""
+    at = self._peer_metrics_at.get(node_id)
+    if at is None:
+      return True  # pre-stamp row (old peer, direct dict write): treat as stale
+    return time.monotonic() - at > 3.0 * max(0.1, self.topology_interval)
+
+  def cluster_metrics_view(self) -> Tuple[Dict[str, dict], Dict[str, dict]]:
+    """(nodes, aggregate) for /v1/cluster/metrics: this node's summary plus
+    each peer's latest, with stale rows MARKED (`stale: true`) and excluded
+    from the ring-wide percentile aggregate — a node that died mid-soak must
+    not freeze the cluster's p95 at its last-good histogram forever."""
+    nodes: Dict[str, dict] = {self.id: self.metrics_summary()}
+    for node_id, summary in self.peer_metrics.items():
+      if node_id in nodes:
+        continue
+      if self.peer_metrics_stale(node_id):
+        summary = {**summary, "stale": True}
+      nodes[node_id] = summary
+    aggregate = aggregate_histograms(
+      [s for s in nodes.values() if not s.get("stale")])
+    return nodes, aggregate
 
   async def broadcast_opaque_status(self, request_id: str, status: str) -> None:
     async def send(peer):

@@ -130,6 +130,25 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_PERF_ATTR", "bool", "1", "Live roofline attribution: per-dispatch time/bytes/FLOPs accounting served at /v1/perf.", "Observability"),
   Knob("XOT_PERF_EWMA_S", "float", "30", "Time constant (s) of the EWMA throughput/utilization gauges (xot_decode_tok_s and friends).", "Observability"),
   Knob("XOT_DEVICE_TRACE_MAX_S", "float", "120", "Auto-stop a /v1/trace/device/start jax.profiler session after this many seconds; 0 disables the cap.", "Observability"),
+  # ------------------------------------------------------ alerting / SLOs
+  Knob("XOT_ALERT", "bool", "1", "Evaluate SLO burn-rate alert rules on a background cadence (served at /v1/alerts).", "Alerting"),
+  Knob("XOT_ALERT_EVAL_S", "float", "5", "Alert-rule evaluation cadence (seconds).", "Alerting"),
+  Knob("XOT_ALERT_FAST_S", "float", "120", "Fast burn-rate window (seconds) of the multi-window SLO rules.", "Alerting"),
+  Knob("XOT_ALERT_SLOW_S", "float", "600", "Slow burn-rate window (seconds) of the multi-window SLO rules.", "Alerting"),
+  Knob("XOT_ALERT_BURN_FAST", "float", "14.4", "Fast-window burn-rate threshold (error-budget multiples) a rule must exceed to fire.", "Alerting"),
+  Knob("XOT_ALERT_BURN_SLOW", "float", "6", "Slow-window burn-rate threshold (error-budget multiples) a rule must exceed to fire.", "Alerting"),
+  Knob("XOT_ALERT_PENDING_S", "float", "10", "Seconds the burn condition must hold before a pending alert transitions to firing.", "Alerting"),
+  Knob("XOT_ALERT_RESOLVE_S", "float", "60", "Hysteresis: seconds the burn condition must stay clear before a firing alert resolves.", "Alerting"),
+  Knob("XOT_ALERT_SNAPSHOTS", "int", "256", "Bounded ring of timestamped metric snapshots the burn windows are computed over.", "Alerting"),
+  Knob("XOT_ALERT_HISTORY", "int", "64", "Recent resolved alerts kept for /v1/alerts (bounded).", "Alerting"),
+  Knob("XOT_ALERT_DEVICE_TRACE", "bool", "0", "Capture-on-anomaly: a firing alert starts the bounded device trace (auto-stops after XOT_DEVICE_TRACE_MAX_S).", "Alerting"),
+  Knob("XOT_ALERT_RTT_TAU_S", "float", "30", "Time constant (s) of the per-peer hop send RTT EWMAs (xot_peer_hop_seconds).", "Alerting"),
+  Knob("XOT_ALERT_HOP_DEGRADED_S", "float", "0.2", "Absolute hop-RTT floor (s) below which a peer is never scored degraded.", "Alerting"),
+  Knob("XOT_ALERT_DEGRADED_FACTOR", "float", "3", "A peer whose hop RTT or per-dispatch compute exceeds this multiple of the ring median is scored degraded.", "Alerting"),
+  Knob("XOT_SLO_TTFT_S", "float", "10", "TTFT SLO target (s) the XOT_SLO_TARGET fraction of requests must beat.", "Alerting"),
+  Knob("XOT_SLO_E2E_S", "float", "60", "End-to-end request latency SLO target (s).", "Alerting"),
+  Knob("XOT_SLO_TARGET", "float", "0.99", "Fraction of requests that must meet each latency SLO target (error budget = 1 - target; must leave budget * XOT_ALERT_BURN_FAST below 1 or the rule can never fire).", "Alerting"),
+  Knob("XOT_SLO_ERROR_RATE", "float", "0.01", "Failed-request budget: the fraction of requests that may abort before the error-rate rule burns.", "Alerting"),
   # ------------------------------------------------------- soak / load gen
   Knob("XOT_SOAK_SECONDS", "float", "60", "Soak load duration (s) for `python -m tools.soak` when --seconds is not given.", "Soak"),
   Knob("XOT_SOAK_RPS", "float", "1.5", "Mean open-loop arrival rate (requests/s) for the soak load generator.", "Soak"),
