@@ -18,7 +18,7 @@ if str(ROOT) not in sys.path:
 
 from tools.xotlint import CHECKERS, run_checkers
 from tools.xotlint import __main__ as xotlint_main
-from tools.xotlint import doc_drift, metrics_consistency
+from tools.xotlint import callgraph, doc_drift, metrics_consistency
 from tools.xotlint.core import Repo, load_baseline
 
 # A minimal but faithful knob registry for fixture trees: same REGISTRY /
@@ -615,7 +615,14 @@ def test_real_tree_every_checker_ran():
   assert set(CHECKERS) == {
     "async-safety", "knob-registry", "doc-drift",
     "metrics-consistency", "exception-hygiene",
+    "hotpath-sync", "retrace-hazard", "donation-safety", "lock-discipline",
   }
+
+
+def test_real_tree_baseline_ships_empty():
+  """Policy (PR 5, reaffirmed here): findings get FIXED or suppressed with
+  a reason in the same PR — the committed baseline is always empty."""
+  assert load_baseline(str(ROOT / "tools/xotlint/baseline.json")) == []
 
 
 def test_real_registry_covers_every_xot_read():
@@ -638,6 +645,25 @@ def test_synthetic_violation_per_checker(tmp_path):
                             "def f(self):\n  self.metrics.bogus_total.inc()\n"},
     "exception-hygiene": {"xotorch_tpu/orchestration/bad_except.py":
                           "def f():\n  try:\n    x()\n  except Exception:\n    pass\n"},
+    "hotpath-sync": {"xotorch_tpu/inference/jax_engine/engine.py": FIXTURE_HOT_ENGINE},
+    "retrace-hazard": {"xotorch_tpu/ops/bad_jit.py": (
+      "import functools, jax\n"
+      "@functools.partial(jax.jit, static_argnames=('start_pos',))\n"
+      "def f(x, start_pos):\n  return x\n")},
+    "donation-safety": {"xotorch_tpu/ops/bad_donor.py": (
+      FIXTURE_DONOR_JIT +
+      "def use_after(state):\n"
+      "  out = write(state.buf, 1)\n"
+      "  return state.buf\n")},
+    "lock-discipline": {"xotorch_tpu/orchestration/bad_lock.py": (
+      "import threading\n"
+      "class S:\n"
+      "  def __init__(self):\n"
+      "    self._lock = threading.Lock()\n"
+      "    self.observer = None\n"
+      "  def f(self):\n"
+      "    with self._lock:\n"
+      "      self.observer(1)\n")},
   }
   for checker, files in violations.items():
     root = tmp_path / checker.replace("-", "_")
@@ -645,3 +671,472 @@ def test_synthetic_violation_per_checker(tmp_path):
     make_tree(root, files)
     rc = xotlint_main.main(["--root", str(root), "--no-baseline"])
     assert rc == 1, f"synthetic {checker} violation did not fail the CLI"
+    found = findings_by(Repo(str(root)), checker)
+    assert found, f"synthetic {checker} violation not caught by its own checker"
+
+
+# ------------------------------------------------------------ callgraph core
+
+def test_callgraph_method_and_attr_type_resolution(tmp_path):
+  """The drain-loop seam: `self.engine` typed by the __init__ annotation,
+  self-method edges, and function REFERENCES passed as call arguments
+  (executor indirection) all resolve."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/inference/jax_engine/engine.py": (
+    "class JAXShardInferenceEngine:\n"
+    "  def _run(self, fn):\n    return fn()\n"
+    "  def _decode_batch_sync(self):\n    self._helper()\n"
+    "  def _helper(self):\n    pass\n"
+    "  def _unreached(self):\n    pass\n"
+    "class _DecodeBatcher:\n"
+    "  def __init__(self, engine: \"JAXShardInferenceEngine\"):\n"
+    "    self.engine = engine\n"
+    "  async def _drain(self):\n"
+    "    await self.engine._run(self.engine._decode_batch_sync)\n"
+  )})
+  prog = callgraph.program(repo)
+  reach = prog.reachable(("engine.py::_DecodeBatcher._drain",))
+  names = {q.rsplit("::", 1)[1] for q in reach}
+  assert "JAXShardInferenceEngine._run" in names            # typed-attr method call
+  assert "JAXShardInferenceEngine._decode_batch_sync" in names  # reference edge
+  assert "JAXShardInferenceEngine._helper" in names         # self-method edge
+  assert "JAXShardInferenceEngine._unreached" not in names
+
+
+def test_callgraph_cycle_tolerance_and_imports(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/inference/a.py": (
+      "from xotorch_tpu.inference.b import pong\n"
+      "def ping():\n  pong()\n"),
+    "xotorch_tpu/inference/b.py": (
+      "from xotorch_tpu.inference import a\n"
+      "def pong():\n  a.ping()\n"),
+  })
+  prog = callgraph.program(repo)
+  reach = prog.reachable(("a.py::ping",))  # must terminate
+  names = {q.rsplit("::", 1)[1] for q in reach}
+  assert names >= {"ping", "pong"}
+
+
+def test_callgraph_unknown_callee_conservatism(tmp_path):
+  """Unresolvable callees (stdlib, dynamic attributes, called parameters)
+  are recorded but never expand the frontier — no phantom reachability."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/inference/c.py": (
+    "import os\n"
+    "def lonely(cb):\n"
+    "  os.getpid()\n"
+    "  cb()\n"
+    "  mystery.attr()\n"
+    "def other():\n  pass\n"
+  )})
+  prog = callgraph.program(repo)
+  reach = prog.reachable(("c.py::lonely",))
+  assert {q.rsplit("::", 1)[1] for q in reach} == {"lonely"}
+  info = prog.funcs[[q for q in prog.funcs if q.endswith("c.py::lonely")][0]]
+  assert "os.getpid" in info.unresolved and "mystery.attr" in info.unresolved
+
+
+# -------------------------------------------------------------- hotpath-sync
+
+FIXTURE_HOT_ENGINE = '''
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+class JAXShardInferenceEngine:
+  def _decode_batch_sync(self, items):
+    toks = jnp.zeros((1, 4))
+    self._helper(toks)
+    return np.asarray(toks[0])   # sanctioned seam: sampling readback
+
+  def _helper(self, x):
+    out = jnp.zeros((1,))
+    host = np.asarray(out)       # TP: device fetch off the sanctioned seam
+    n = int(out[0])              # TP: hidden transfer
+    meta = np.asarray([1, 2])    # FP guard: host metadata, no device taint
+    rows = float(out.ndim)       # FP guard: .ndim is a free metadata read
+    width = int(out.shape[0])    # FP guard: .shape too
+    count = int(len(out))        # FP guard: len() too
+    return host, n, meta, rows, width, count
+
+  def _cold_path(self):
+    out = jnp.zeros((1,))
+    return np.asarray(out)       # FP guard: not reachable from entry points
+'''
+
+
+def test_hotpath_sync_flags_reachable_syncs_not_sanctioned_or_cold(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/inference/jax_engine/engine.py":
+                              FIXTURE_HOT_ENGINE})
+  keys = {f.key for f in findings_by(repo, "hotpath-sync")}
+  assert keys == {"_helper:np.asarray", "_helper:int"}
+
+
+def test_hotpath_sync_block_until_ready_and_suppression(tmp_path):
+  body = FIXTURE_HOT_ENGINE.replace(
+    "host = np.asarray(out)       # TP: device fetch off the sanctioned seam",
+    "host = np.asarray(out)  # xotlint: disable=hotpath-sync (fixture reason)\n"
+    "    out.block_until_ready()")
+  repo = make_tree(tmp_path, {"xotorch_tpu/inference/jax_engine/engine.py": body})
+  keys = {f.key for f in findings_by(repo, "hotpath-sync")}
+  assert keys == {"_helper:block_until_ready", "_helper:int"}
+
+
+def test_hotpath_sync_sanctioned_list_matches_real_tree_exactly():
+  """No dead sanctioning: clearing SANCTIONED makes the checker fire on the
+  real tree EXACTLY the identities the list names — every entry is
+  load-bearing, and nothing outside it relies on sanctioning."""
+  from tools.xotlint import hotpath_sync
+  repo = Repo(str(ROOT))
+  orig = dict(hotpath_sync.SANCTIONED)
+  try:
+    hotpath_sync.SANCTIONED.clear()
+    found = hotpath_sync.check(repo)
+  finally:
+    hotpath_sync.SANCTIONED.update(orig)
+  fired = {tuple(f.key.split(":", 1)) for f in found}
+  sanctioned = {(suffix.rsplit(".", 1)[-1], op)
+                for suffix, op in hotpath_sync.SANCTIONED}
+  assert fired == sanctioned, (fired, sanctioned)
+
+
+async def test_dynamic_sync_callers_agree_with_sanctioned_list(monkeypatch):
+  """THE dynamic-static cross-check: drive a real engine decode with the
+  same monkeypatch instrumentation the PR 7-9 sync tests use, capture the
+  CALLER of every host fetch, and assert every caller that sits on the
+  statically-declared hot path is in the checker's SANCTIONED list. One
+  source of truth, checked from both sides."""
+  import sys
+  import jax
+  import numpy as np
+  from tests.test_perf_attr import _drive_engine
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from tools.xotlint import hotpath_sync
+
+  callers = set()
+  real_asarray, real_bur = np.asarray, jax.block_until_ready
+
+  def _record(kind):
+    f = sys._getframe(2)
+    if f.f_code.co_filename.endswith("jax_engine/engine.py"):
+      callers.add((getattr(f.f_code, "co_qualname", f.f_code.co_name), kind))
+
+  def counting_asarray(*a, **kw):
+    _record("np.asarray")
+    return real_asarray(*a, **kw)
+
+  def counting_bur(x):
+    _record("block_until_ready")
+    return real_bur(x)
+
+  monkeypatch.setenv("XOT_SEED", "7")
+  engine = JAXShardInferenceEngine()
+  monkeypatch.setattr(np, "asarray", counting_asarray)
+  monkeypatch.setattr(jax, "block_until_ready", counting_bur)
+  try:
+    await _drive_engine(engine, "xlint-xcheck")
+  finally:
+    monkeypatch.setattr(np, "asarray", real_asarray)
+    monkeypatch.setattr(jax, "block_until_ready", real_bur)
+
+  # co_name is the bare function name (co_qualname needs 3.11+), so the
+  # static sets are compared by their final component too.
+  prog = callgraph.program(Repo(str(ROOT)))
+  hot_scopes = {q.rsplit("::", 1)[1].rsplit(".", 1)[-1]
+                for q in prog.reachable(hotpath_sync.ENTRY_POINTS)}
+  sanctioned_scopes = {suffix.rsplit(".", 1)[-1]
+                       for suffix, _op in hotpath_sync.SANCTIONED}
+  on_path = {(qn, kind) for qn, kind in callers if qn in hot_scopes}
+  assert on_path, "the drive never touched the static hot path — dead cross-check"
+  off_list = {(qn, kind) for qn, kind in on_path if qn not in sanctioned_scopes}
+  assert off_list == set(), (
+    f"dynamically observed sync callers on the static hot path that the "
+    f"sanctioned-boundary list does not name: {off_list}")
+
+
+# ------------------------------------------------------------ retrace-hazard
+
+def test_retrace_hazard_unbounded_static_and_allowlist(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/ops/bad_jit.py": (
+    "import functools, jax\n"
+    "@functools.partial(jax.jit, static_argnames=('start_pos', 'num_tokens', 'top_k'))\n"
+    "def f(x, start_pos, num_tokens, top_k):\n"
+    "  return x\n"
+  )})
+  keys = {f.key for f in findings_by(repo, "retrace-hazard", "unbounded-static")}
+  assert keys == {"f:start_pos"}  # num_tokens/top_k: bounded by design
+
+
+def test_retrace_hazard_traced_branch_and_static_idioms(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/ops/branchy.py": (
+    "import functools, jax\n"
+    "@functools.partial(jax.jit, static_argnames=('flag',))\n"
+    "def f(x, y, flag):\n"
+    "  if x > 0:\n"                                      # TP
+    "    return x\n"
+    "  if y is None:\n"                                  # FP: None presence
+    "    return x\n"
+    "  if isinstance(y, (int, float)) and y == 0.0:\n"   # FP: guarded idiom
+    "    return x\n"
+    "  if flag:\n"                                       # FP: static param
+    "    return x\n"
+    "  if x.shape[0] > 1:\n"                             # FP: shape metadata
+    "    return x\n"
+    "  return x\n"
+  )})
+  found = findings_by(repo, "retrace-hazard", "traced-branch")
+  assert [f.line for f in found] == [4]
+
+
+def test_retrace_hazard_mutable_capture(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/ops/capt.py": (
+    "import jax\n"
+    "_TABLE = {'a': 1}\n"
+    "_FROZEN = ('a',)\n"
+    "@jax.jit\n"
+    "def f(y):\n"
+    "  return y + _TABLE['a'] + len(_FROZEN)\n"
+  )})
+  keys = {f.key for f in findings_by(repo, "retrace-hazard", "mutable-capture")}
+  assert keys == {"f:_TABLE"}  # tuple capture is immutable: clean
+
+
+# ----------------------------------------------------------- donation-safety
+
+FIXTURE_DONOR_JIT = (
+  "import functools, jax\n"
+  "@functools.partial(jax.jit, donate_argnames=('buf',))\n"
+  "def write(buf, x):\n"
+  "  return buf.at[0].set(x)\n"
+)
+
+
+def test_donation_safety_use_after_and_rebind(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/ops/donor.py": (
+    FIXTURE_DONOR_JIT +
+    "def use_after(state):\n"
+    "  out = write(state.buf, 1)\n"
+    "  return state.buf\n"          # TP: donated buffer read
+    "def rebind(state):\n"
+    "  state.buf = write(state.buf, 1)\n"
+    "  return state.buf\n"          # FP guard: rebound from the result
+    "def rebind_later(state):\n"
+    "  out = write(state.buf, 1)\n"
+    "  state.buf = out\n"
+    "  return state.buf\n"          # FP guard: rebound before the read
+  )})
+  found = findings_by(repo, "donation-safety", "use-after-donate")
+  assert [f.key for f in found] == ["use_after:state.buf"]
+
+
+def test_donation_safety_discard_and_branches(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/ops/donor2.py": (
+    FIXTURE_DONOR_JIT +
+    "def discard(state):\n"
+    "  write(state.buf, 1)\n"       # TP: result dropped, buffer gone
+    "def branches(state, flag):\n"
+    "  if flag:\n"
+    "    state.buf = write(state.buf, 1)\n"
+    "  else:\n"
+    "    y = state.buf\n"           # FP guard: sibling branch never runs after
+    "  return None\n"
+  )})
+  found = findings_by(repo, "donation-safety")
+  assert [(f.code, f.key) for f in found] == [("donated-result-discarded",
+                                               "discard:state.buf")]
+
+
+def test_donation_safety_factory_and_wrapper_transitivity(tmp_path):
+  """The lazy-jit factory idiom (`_commit_jit()(arena, ...)`) and the
+  wrapper that donates its own parameter both propagate to callers."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/inference/pool.py": (
+    "import jax\n"
+    "_JITS = {}\n"
+    "def _commit_jit():\n"
+    "  fn = _JITS.get('commit')\n"
+    "  if fn is None:\n"
+    "    def commit(arena, seg):\n"
+    "      return arena\n"
+    "    fn = _JITS['commit'] = jax.jit(commit, donate_argnames=('arena',))\n"
+    "  return fn\n"
+    "def commit_pages(arena, seg):\n"
+    "  return _commit_jit()(arena, seg)\n"   # clean: returned
+    "def caller(pool):\n"
+    "  commit_pages(pool.arena, 1)\n"        # TP via wrapper transitivity
+  )})
+  found = findings_by(repo, "donation-safety")
+  assert [(f.code, f.key) for f in found] == [("donated-result-discarded",
+                                               "caller:pool.arena")]
+
+
+# ----------------------------------------------------------- lock-discipline
+
+FIXTURE_LOCKS = '''
+import threading
+import time
+import jax.numpy as jnp
+
+class Store:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._aux_lock = threading.Lock()
+    self.observer = None
+
+  def bad_put(self):
+    with self._lock:
+      if self.observer is not None:
+        self.observer(1, 2)
+      time.sleep(0.1)
+      x = jnp.zeros((1,))
+
+  def good_put(self):
+    with self._lock:
+      snap = 1
+    if self.observer is not None:
+      self.observer(snap, 2)
+'''
+
+
+def test_lock_discipline_events_and_fp_guard(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/store.py": FIXTURE_LOCKS})
+  found = findings_by(repo, "lock-discipline")
+  codes = {(f.code, f.key) for f in found}
+  assert codes == {
+    ("callback-under-lock", "Store.bad_put:Store._lock:observer"),
+    ("blocking-under-lock", "Store.bad_put:Store._lock:time.sleep"),
+    ("device-op-under-lock", "Store.bad_put:Store._lock:jnp.zeros"),
+  }
+
+
+def test_lock_discipline_asyncio_lock_is_not_a_threading_lock(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/alock.py": (
+    "import asyncio\n"
+    "class T:\n"
+    "  def __init__(self):\n"
+    "    self._lock = asyncio.Lock()\n"
+    "  async def fine(self):\n"
+    "    async with self._lock:\n"
+    "      await asyncio.sleep(0)\n"
+  )})
+  assert findings_by(repo, "lock-discipline") == []
+
+
+def test_lock_discipline_interprocedural_lock_order(tmp_path):
+  """A->B by direct nesting in one function, B->A through a CALL made while
+  holding B (callgraph closure) — the inconsistent pair is one finding."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/order.py": (
+    "import threading\n"
+    "class S:\n"
+    "  def __init__(self):\n"
+    "    self._lock = threading.Lock()\n"
+    "    self._aux_lock = threading.Lock()\n"
+    "  def ab(self):\n"
+    "    with self._lock:\n"
+    "      with self._aux_lock:\n"
+    "        pass\n"
+    "  def ba(self):\n"
+    "    with self._aux_lock:\n"
+    "      self._take_main()\n"
+    "  def _take_main(self):\n"
+    "    with self._lock:\n"
+    "      pass\n"
+  )})
+  found = findings_by(repo, "lock-discipline", "lock-order")
+  assert [f.key for f in found] == ["S._aux_lock<->S._lock"]
+
+
+def test_lock_discipline_consistent_order_is_clean(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/order2.py": (
+    "import threading\n"
+    "class S:\n"
+    "  def __init__(self):\n"
+    "    self._lock = threading.Lock()\n"
+    "    self._aux_lock = threading.Lock()\n"
+    "  def ab(self):\n"
+    "    with self._lock:\n"
+    "      with self._aux_lock:\n"
+    "        pass\n"
+    "  def ab2(self):\n"
+    "    with self._lock:\n"
+    "      with self._aux_lock:\n"
+    "        pass\n"
+  )})
+  assert findings_by(repo, "lock-discipline", "lock-order") == []
+
+
+# --------------------------------------------------------- suppression audit
+
+def test_suppression_audit_stale_missing_reason_unknown(tmp_path):
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/supp.py": (
+    "import time\n"
+    "async def hop():\n"
+    "  time.sleep(1)  # xotlint: disable=async-safety (fixture reason)\n"
+    "def quiet():\n"
+    "  x = 1  # xotlint: disable=async-safety\n"
+    "  y = 2  # xotlint: disable=async-safty (typo'd checker)\n"
+  )})
+  found = [(f.code, f.line) for f in run_checkers(repo)
+           if f.checker == "suppression-audit"]
+  assert ("stale-suppression", 5) in found
+  assert ("missing-reason", 5) in found
+  assert ("unknown-checker", 6) in found
+  # The EARNED suppression on line 3 is not stale.
+  assert not any(line == 3 for _, line in found)
+
+
+def test_suppression_audit_catches_stale_on_checker_queried_lines(tmp_path):
+  """Regression: checkers must consult suppressed() only once a violation
+  is ESTABLISHED — a stale disable comment on a CLEAN line a checker
+  inspects (a resolvable metrics attr, a registered knob accessor read)
+  must still surface as stale, not be marked 'earned' by the query."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/clean.py": (
+    "from xotorch_tpu.utils import knobs\n"
+    "class Node:\n"
+    "  def hop(self):\n"
+    "    self.metrics.requests_total.inc()  # xotlint: disable=metrics-consistency (stale)\n"
+    "    k = knobs.get_int('XOT_GOOD')  # xotlint: disable=knob-registry (stale)\n"
+  )})
+  stale = {(f.line, f.code) for f in run_checkers(repo)
+           if f.checker == "suppression-audit"}
+  assert (4, "stale-suppression") in stale
+  assert (5, "stale-suppression") in stale
+
+
+def test_suppression_audit_skipped_on_partial_runs(tmp_path):
+  """A --checker subset run has incomplete hit data: no audit findings."""
+  repo = make_tree(tmp_path, {"xotorch_tpu/orchestration/supp.py": (
+    "def quiet():\n"
+    "  x = 1  # xotlint: disable=async-safety\n"
+  )})
+  assert [f for f in run_checkers(repo, only=["async-safety"])
+          if f.checker == "suppression-audit"] == []
+  assert [f for f in run_checkers(repo)
+          if f.checker == "suppression-audit"] != []
+
+
+# ------------------------------------------------------------- stats / perf
+
+def test_stats_cover_all_checkers_and_cli_writes_file(tmp_path, capsys):
+  make_tree(tmp_path, {})
+  stats = {}
+  run_checkers(Repo(str(tmp_path)), stats=stats)
+  assert set(stats) == set(CHECKERS) | {"suppression-audit"}
+  assert all("secs" in row and "findings" in row for row in stats.values())
+  out = tmp_path / "stats.json"
+  assert xotlint_main.main(["--root", str(tmp_path), "--no-baseline",
+                            "--stats", "--stats-file", str(out)]) == 0
+  payload = json.loads(out.read_text())
+  assert set(payload["checkers"]) == set(CHECKERS) | {"suppression-audit"}
+  assert payload["total_secs"] >= 0
+  capsys.readouterr()
+
+
+def test_real_tree_lint_completes_under_60s():
+  """Tier-1 guard for the shared-AST-cache performance: the full
+  nine-checker run over the real tree stays an order of magnitude inside
+  the CI budget. A regression to per-checker re-parsing/re-walking would
+  blow well past this."""
+  import time as _time
+  t0 = _time.monotonic()
+  repo = Repo(str(ROOT))
+  run_checkers(repo)
+  assert _time.monotonic() - t0 < 60.0

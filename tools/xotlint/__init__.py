@@ -1,6 +1,7 @@
 """xotlint: repo-native static analysis for the xotorch_tpu runtime.
 
-Five checkers, each a module exposing `check(repo) -> list[Finding]`:
+Nine checkers, each a module exposing `check(repo) -> list[Finding]`. Five
+are per-function (PR 5):
 
 - async-safety        blocking calls / sync locks / raw create_task in async code
 - knob-registry       every XOT_* env read routes through utils/knobs.py
@@ -8,20 +9,36 @@ Five checkers, each a module exposing `check(repo) -> list[Finding]`:
 - metrics-consistency incremented counters are exported, `_total` convention
 - exception-hygiene   no silent `except Exception: pass` on serving paths
 
-Run as `python -m tools.xotlint`; see `--help` for baseline management and
-`--knob-docs` for README generation.
+Four are whole-program, built on the shared callgraph core (callgraph.py):
+
+- hotpath-sync        no host sync reachable from the dispatch entry points
+- retrace-hazard      jit sites keep a bounded executable count
+- donation-safety     donated buffers are dead after the call
+- lock-discipline     nothing slow/foreign under a lock; consistent order
+
+The runner itself audits suppressions (`suppression-audit` findings): an
+`# xotlint: disable=<checker>` comment whose checker no longer fires on
+that line is stale and must be deleted; one without a parenthesized reason
+is incomplete. Run as `python -m tools.xotlint`; see `--help` for baseline
+management, `--stats` for per-checker timing, `--knob-docs` for README
+generation.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence
 
 from tools.xotlint.core import Finding, Repo
 from tools.xotlint import (  # noqa: E402  (registry of checker modules)
   async_safety,
   doc_drift,
+  donation_safety,
   exception_hygiene,
+  hotpath_sync,
   knob_registry,
+  lock_discipline,
   metrics_consistency,
+  retrace_hazard,
 )
 
 CHECKERS = {
@@ -30,14 +47,70 @@ CHECKERS = {
   doc_drift.CHECKER: doc_drift,
   metrics_consistency.CHECKER: metrics_consistency,
   exception_hygiene.CHECKER: exception_hygiene,
+  hotpath_sync.CHECKER: hotpath_sync,
+  retrace_hazard.CHECKER: retrace_hazard,
+  donation_safety.CHECKER: donation_safety,
+  lock_discipline.CHECKER: lock_discipline,
 }
 
+AUDIT = "suppression-audit"
 
-def run_checkers(repo: Repo, only: Optional[Sequence[str]] = None) -> List[Finding]:
+
+def _audit_suppressions(repo: Repo) -> List[Finding]:
+  """Runner-level pass (not a registered checker): every inline suppression
+  must still be EARNED — its named checker queried that line and would
+  have fired. Requires a full run (all checkers), so run_checkers only
+  calls this when none were filtered out."""
+  findings: List[Finding] = []
+  for sf in repo.files():
+    hits = sf.suppression_hits
+    for line, names, has_reason in sf.suppression_sites():
+      for name in names:
+        if name == "all":
+          continue  # blanket disables can't be attributed; reviewed by hand
+        if name not in CHECKERS and name != AUDIT:
+          findings.append(Finding(
+            checker=AUDIT, code="unknown-checker", path=sf.relpath, line=line,
+            key=f"{line}:{name}",
+            message=f"suppression names unknown checker `{name}` — it disables "
+                    "nothing (typo, or the checker was renamed)",
+          ))
+        elif (line, name) not in hits:
+          findings.append(Finding(
+            checker=AUDIT, code="stale-suppression", path=sf.relpath, line=line,
+            key=f"{sf.func_scope_at_line(line)}:{name}",
+            message=f"`xotlint: disable={name}` no longer suppresses anything "
+                    "on this line (the finding was fixed or moved) — delete "
+                    "the comment so suppressions can't rot",
+          ))
+      if not has_reason:
+        findings.append(Finding(
+          checker=AUDIT, code="missing-reason", path=sf.relpath, line=line,
+          key=f"{sf.func_scope_at_line(line)}:{','.join(names)}",
+          message="suppression without a parenthesized reason — write WHY "
+                  "this is safe: `# xotlint: disable=<checker> (reason)`",
+        ))
+  return findings
+
+
+def run_checkers(repo: Repo, only: Optional[Sequence[str]] = None,
+                 stats: Optional[Dict[str, dict]] = None) -> List[Finding]:
   findings: List[Finding] = []
   for name, module in CHECKERS.items():
     if only and name not in only:
       continue
-    findings.extend(module.check(repo))
+    t0 = time.monotonic()
+    found = module.check(repo)
+    if stats is not None:
+      stats[name] = {"secs": round(time.monotonic() - t0, 4),
+                     "findings": len(found)}
+    findings.extend(found)
+  if not only:  # the audit needs every checker's suppression hits
+    t0 = time.monotonic()
+    found = _audit_suppressions(repo)
+    if stats is not None:
+      stats[AUDIT] = {"secs": round(time.monotonic() - t0, 4),
+                      "findings": len(found)}
+    findings.extend(found)
   findings.sort(key=lambda f: (f.path, f.line, f.checker, f.code, f.key))
   return findings

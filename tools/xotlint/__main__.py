@@ -2,12 +2,16 @@
 
 Exit codes: 0 = no non-baselined findings, 1 = findings, 2 = usage/config
 error. `--knob-docs` prints the generated README knob section and exits.
+`--stats` prints per-checker wall time + finding counts; `--stats-file`
+writes them as JSON (the CI artifact guarding the shared-AST-cache perf).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import time
 
 from tools.xotlint import CHECKERS, run_checkers
 from tools.xotlint import doc_drift
@@ -19,8 +23,10 @@ DEFAULT_BASELINE = os.path.join("tools", "xotlint", "baseline.json")
 def main(argv=None) -> int:
   parser = argparse.ArgumentParser(
     prog="python -m tools.xotlint",
-    description="Repo-native static analysis: async-safety, knob registry, "
-                "doc drift, metrics consistency, exception hygiene.",
+    description="Repo-native static analysis, nine checkers: async-safety, "
+                "knob registry, doc drift, metrics consistency, exception "
+                "hygiene, plus the callgraph-driven hotpath-sync, "
+                "retrace-hazard, donation-safety and lock-discipline.",
   )
   parser.add_argument("--root", default=".", help="repo root (default: cwd)")
   parser.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -33,6 +39,10 @@ def main(argv=None) -> int:
                       help="print the generated README knob-reference section and exit")
   parser.add_argument("--checker", action="append", default=None,
                       help="run only this checker (repeatable)")
+  parser.add_argument("--stats", action="store_true",
+                      help="print per-checker wall time and finding counts")
+  parser.add_argument("--stats-file", default=None,
+                      help="write per-checker stats as JSON (CI artifact)")
   args = parser.parse_args(argv)
 
   repo = Repo(args.root)
@@ -47,7 +57,22 @@ def main(argv=None) -> int:
           f"(available: {', '.join(CHECKERS)})", file=sys.stderr)
     return 2
 
-  findings = run_checkers(repo, only=args.checker)
+  stats: dict = {}
+  t_total = time.monotonic()
+  findings = run_checkers(repo, only=args.checker, stats=stats)
+  total_secs = round(time.monotonic() - t_total, 4)
+  if args.stats or args.stats_file:
+    payload = {"total_secs": total_secs, "checkers": stats}
+    if args.stats:
+      width = max(len(n) for n in stats) if stats else 10
+      for name, row in stats.items():
+        print(f"{name:<{width}}  {row['secs']:8.4f}s  {row['findings']:3d} finding(s)",
+              file=sys.stderr)
+      print(f"{'total':<{width}}  {total_secs:8.4f}s", file=sys.stderr)
+    if args.stats_file:
+      with open(args.stats_file, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
 
   baseline_path = os.path.join(args.root, args.baseline)
   if args.write_baseline:

@@ -55,94 +55,69 @@ def _is_lock_expr(node: ast.AST) -> bool:
   return any(tok in tail for tok in _LOCKY)
 
 
-class _AsyncVisitor(ast.NodeVisitor):
-  def __init__(self, sf, findings: List[Finding]):
-    self.sf = sf
-    self.findings = findings
-    self.async_depth = 0
-    self.func_stack: List[str] = []
+def _in_async_scope(sf, node: ast.AST) -> bool:
+  """The node's INNERMOST enclosing function is `async def` (a nested sync
+  def or lambda inside an async body does not imply the event loop)."""
+  fn = sf.enclosing_func(node)
+  return isinstance(fn, ast.AsyncFunctionDef)
 
-  # --- scope tracking ---------------------------------------------------
 
-  def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-    self.func_stack.append(node.name)
-    prev, self.async_depth = self.async_depth, 0  # sync body: loop not implied
-    self.generic_visit(node)
-    self.async_depth = prev
-    self.func_stack.pop()
-
-  def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-    self.func_stack.append(node.name)
-    self.async_depth += 1
-    self.generic_visit(node)
-    self.async_depth -= 1
-    self.func_stack.pop()
-
-  def visit_Lambda(self, node: ast.Lambda) -> None:
-    prev, self.async_depth = self.async_depth, 0
-    self.generic_visit(node)
-    self.async_depth = prev
-
-  # --- findings ---------------------------------------------------------
-
-  def _emit(self, code: str, node: ast.AST, message: str, key: str) -> None:
-    if self.sf.suppressed(node.lineno, CHECKER):
-      return
-    self.findings.append(Finding(
-      checker=CHECKER, code=code, path=self.sf.relpath, line=node.lineno,
-      message=message, key=key,
-    ))
-
-  def _scope(self) -> str:
-    return ".".join(self.func_stack) or "<module>"
-
-  def visit_Call(self, node: ast.Call) -> None:
-    name = dotted_name(node.func)
-    in_wrapper = self.sf.relpath.endswith("utils/helpers.py")
-    if name.endswith(("create_task", "ensure_future")) and not in_wrapper \
-        and (name.startswith("asyncio.") or ".loop." in f".{name}" or name.startswith("loop.")):
-      self._emit(
-        "raw-create-task", node,
-        f"raw `{name}` — route through utils.helpers.spawn_detached so the task "
-        "holds a strong ref and its exception is logged, never silently dropped",
-        key=f"{self._scope()}:{name.rsplit('.', 1)[-1]}",
-      )
-    if self.async_depth > 0:
-      blocking = name in _BLOCKING_CALLS
-      attr = name.rsplit(".", 1)[-1] if name else (
-        node.func.attr if isinstance(node.func, ast.Attribute) else "")
-      if not blocking and attr in _BLOCKING_ATTRS:
-        blocking, name = True, attr
-      if not blocking and name == "open":
-        blocking = True
-        name = "open"
-      if blocking:
-        self._emit(
-          "blocking-call", node,
-          f"blocking `{name}(...)` inside `async def {self._scope()}` — the event "
-          "loop (and every watchdog on it) stalls; use the async equivalent or "
-          "run it in an executor",
-          key=f"{self._scope()}:{name}",
-        )
-    self.generic_visit(node)
-
-  def visit_With(self, node: ast.With) -> None:
-    if self.async_depth > 0 and any(_is_lock_expr(item.context_expr) for item in node.items):
-      if any(isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
-             for child in node.body for n in ast.walk(child)):
-        self._emit(
-          "lock-across-await", node,
-          f"synchronous lock held across `await` in `async def {self._scope()}` — "
-          "the loop parks holding the lock; use asyncio.Lock or release before awaiting",
-          key=self._scope(),
-        )
-    self.generic_visit(node)
+def _emit(sf, findings, code: str, node: ast.AST, message: str, key: str) -> None:
+  if sf.suppressed(node.lineno, CHECKER):
+    return
+  findings.append(Finding(
+    checker=CHECKER, code=code, path=sf.relpath, line=node.lineno,
+    message=message, key=key,
+  ))
 
 
 def check(repo: Repo) -> List[Finding]:
+  """Single pass over the shared AST cache: scope questions (innermost
+  enclosing function, dotted function-name scope) come pre-answered from
+  the per-file index instead of a stateful visitor."""
   findings: List[Finding] = []
   for sf in repo.files():
     if sf.tree is None:
       continue
-    _AsyncVisitor(sf, findings).visit(sf.tree)
+    in_wrapper = sf.relpath.endswith("utils/helpers.py")
+    for node in sf.nodes():
+      if isinstance(node, ast.Call):
+        scope = sf.func_scope(node)
+        name = dotted_name(node.func)
+        if name.endswith(("create_task", "ensure_future")) and not in_wrapper \
+            and (name.startswith("asyncio.") or ".loop." in f".{name}" or name.startswith("loop.")):
+          _emit(
+            sf, findings, "raw-create-task", node,
+            f"raw `{name}` — route through utils.helpers.spawn_detached so the task "
+            "holds a strong ref and its exception is logged, never silently dropped",
+            key=f"{scope}:{name.rsplit('.', 1)[-1]}",
+          )
+        if _in_async_scope(sf, node):
+          blocking = name in _BLOCKING_CALLS
+          attr = name.rsplit(".", 1)[-1] if name else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else "")
+          if not blocking and attr in _BLOCKING_ATTRS:
+            blocking, name = True, attr
+          if not blocking and name == "open":
+            blocking = True
+            name = "open"
+          if blocking:
+            _emit(
+              sf, findings, "blocking-call", node,
+              f"blocking `{name}(...)` inside `async def {scope}` — the event "
+              "loop (and every watchdog on it) stalls; use the async equivalent or "
+              "run it in an executor",
+              key=f"{scope}:{name}",
+            )
+      elif isinstance(node, ast.With) and _in_async_scope(sf, node) \
+          and any(_is_lock_expr(item.context_expr) for item in node.items):
+        if any(isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+               for child in node.body for n in ast.walk(child)):
+          scope = sf.func_scope(node)
+          _emit(
+            sf, findings, "lock-across-await", node,
+            f"synchronous lock held across `await` in `async def {scope}` — "
+            "the loop parks holding the lock; use asyncio.Lock or release before awaiting",
+            key=scope,
+          )
   return findings

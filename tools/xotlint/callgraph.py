@@ -1,0 +1,499 @@
+"""Whole-program callgraph / dataflow core for xotlint.
+
+The per-function checkers (PR 5) stop at the def boundary, but the PR 6-9
+hot-path invariants are properties of PATHS: "no host sync reachable from
+the decode dispatch entry points", "no callback invoked while a lock
+acquired three calls up is still held". This module builds the shared
+module-level callgraph those checkers escalate onto:
+
+- **module map**: every top-level `def`, every `class` with its methods
+  (and nested defs), keyed `path::Class.method` / `path::func`;
+- **import resolution** for absolute package imports, both
+  `from pkg.mod import name [as alias]` and `import pkg.mod [as alias]`;
+- **method resolution through `self`**: own methods first, then base
+  classes resolvable through imports (cycle-safe);
+- **attribute typing**: `self.attr = param` in `__init__` where the param
+  is annotated with a resolvable class name (string annotations included)
+  types later `self.attr.method()` calls — the `_DecodeBatcher.engine ->
+  JAXShardInferenceEngine` seam that makes the drain loop analyzable;
+- **reference edges**: a known function passed as a Call ARGUMENT is an
+  edge (`self._run(self._decode_batch_sync, ...)` — executor indirection
+  is how the engine dispatches everything);
+- **reachability**: cycle-tolerant BFS. Unresolved callees (stdlib, jax,
+  dynamic attributes, parameters called as functions) are recorded on the
+  FuncInfo but never expand the frontier — conservative for a lint whose
+  baseline policy is "empty": a silent miss is caught by the dynamic
+  monkeypatch tests, a false positive would train people to suppress.
+
+Also home to the **jit-site table** (`jit_sites`): every `jax.jit` call or
+`@partial(jax.jit, ...)` decoration with its wrapped def, static names and
+donate positions — shared by retrace-hazard and donation-safety.
+
+Everything is memoized on the Repo (`program(repo)` / `jit_sites(repo)`),
+so the four whole-program checkers pay for one build.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.xotlint.core import Repo, SourceFile, dotted_name
+
+_PKG = "xotorch_tpu"
+
+
+@dataclass
+class FuncInfo:
+  """One function/method in the program, with its resolved out-edges."""
+  qual: str                      # "relpath::Class.method" / "relpath::func"
+  node: ast.AST                  # the FunctionDef / AsyncFunctionDef
+  sf: SourceFile
+  cls: Optional[str]             # innermost enclosing class name, if a method
+  calls: List[str] = field(default_factory=list)       # resolved callee quals
+  refs: List[str] = field(default_factory=list)        # taken-as-value quals
+  unresolved: List[str] = field(default_factory=list)  # dotted names we punted on
+
+  @property
+  def edges(self) -> List[str]:
+    return self.calls + self.refs
+
+
+class _Module:
+  """Per-file symbol tables feeding resolution."""
+
+  def __init__(self, sf: SourceFile):
+    self.sf = sf
+    self.funcs: Dict[str, str] = {}          # top-level def name -> qual
+    self.classes: Dict[str, "_Class"] = {}
+    # import alias -> ("mod", relpath) | ("sym", relpath, name)
+    self.imports: Dict[str, tuple] = {}
+
+
+class _Class:
+  def __init__(self, name: str, relpath: str):
+    self.name = name
+    self.relpath = relpath
+    self.methods: Dict[str, str] = {}        # method name -> qual
+    self.bases: List[str] = []               # base names as written
+    self.attr_types: Dict[str, str] = {}     # self.attr -> class dotted name
+
+
+def _mod_relpath(dotted: str) -> Optional[str]:
+  """`xotorch_tpu.models.generate` -> `xotorch_tpu/models/generate.py`."""
+  if dotted != _PKG and not dotted.startswith(_PKG + "."):
+    return None
+  return dotted.replace(".", "/") + ".py"
+
+
+class Program:
+  """The whole-program view: symbol tables + resolved call/ref edges."""
+
+  def __init__(self, repo: Repo):
+    self.repo = repo
+    self.modules: Dict[str, _Module] = {}
+    self.funcs: Dict[str, FuncInfo] = {}
+    self._build()
+
+  # ------------------------------------------------------------------ build
+
+  def _build(self) -> None:
+    for sf in self.repo.files():
+      if sf.tree is not None:
+        self._collect_module(sf)
+    for sf in self.repo.files():
+      if sf.tree is not None:
+        self._collect_attr_types(sf)
+    for info in list(self.funcs.values()):
+      self._resolve_edges(info)
+
+  def _collect_module(self, sf: SourceFile) -> None:
+    mod = self.modules[sf.relpath] = _Module(sf)
+    for node in sf.nodes():
+      if isinstance(node, (ast.Import, ast.ImportFrom)):
+        self._collect_import(mod, node)
+      elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        qual = f"{sf.relpath}::{sf.qual(node)}"
+        cls = sf.class_scope(node)
+        self.funcs[qual] = FuncInfo(qual=qual, node=node, sf=sf, cls=cls)
+        if sf.enclosing_func(node) is None:
+          if cls is None:
+            mod.funcs[node.name] = qual
+          else:
+            c = mod.classes.get(cls)
+            if c is not None:
+              c.methods[node.name] = qual
+      elif isinstance(node, ast.ClassDef) and sf.enclosing_func(node) is None \
+          and sf.class_scope(node) is None:
+        c = mod.classes[node.name] = _Class(node.name, sf.relpath)
+        c.bases = [dotted_name(b) for b in node.bases if dotted_name(b)]
+
+  def _collect_import(self, mod: _Module, node: ast.AST) -> None:
+    if isinstance(node, ast.Import):
+      for alias in node.names:
+        rel = _mod_relpath(alias.name)
+        if rel is not None:
+          # `import xotorch_tpu.models.generate as g` binds g to the module;
+          # un-aliased imports bind the package root name (attribute chains
+          # resolve through the full dotted call name instead).
+          mod.imports[alias.asname or alias.name] = ("mod", rel)
+    elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+      rel = _mod_relpath(node.module)
+      if rel is None:
+        return
+      for alias in node.names:
+        # `from pkg.a import b` where pkg/a/b.py exists imports the MODULE.
+        sub = _mod_relpath(f"{node.module}.{alias.name}")
+        local = alias.asname or alias.name
+        if sub is not None and self._exists(sub):
+          mod.imports[local] = ("mod", sub)
+        else:
+          mod.imports[local] = ("sym", rel, alias.name)
+
+  def _exists(self, relpath: str) -> bool:
+    return any(sf.relpath == relpath for sf in self.repo.files())
+
+  def _collect_attr_types(self, sf: SourceFile) -> None:
+    """`self.attr = param` in __init__ with an annotated param whose type
+    resolves to a known class -> attr_types entry for method resolution
+    through `self.attr.method()`."""
+    mod = self.modules[sf.relpath]
+    for cls in mod.classes.values():
+      init_qual = cls.methods.get("__init__")
+      if init_qual is None:
+        continue
+      init = self.funcs[init_qual].node
+      ann: Dict[str, str] = {}
+      for a in init.args.args + init.args.kwonlyargs:
+        t = a.annotation
+        if isinstance(t, ast.Constant) and isinstance(t.value, str):
+          ann[a.arg] = t.value.strip("'\" ")
+        elif t is not None and dotted_name(t):
+          ann[a.arg] = dotted_name(t)
+      for stmt in ast.walk(init):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+          continue
+        tgt = stmt.targets[0]
+        if (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self" and isinstance(stmt.value, ast.Name)):
+          ty = ann.get(stmt.value.id)
+          if ty and self._resolve_class(mod, ty.split("[")[0]) is not None:
+            cls.attr_types[tgt.attr] = ty.split("[")[0]
+
+  # -------------------------------------------------------------- resolution
+
+  def _resolve_class(self, mod: _Module, name: str) -> Optional[_Class]:
+    """A class name as written in `mod` (local or imported symbol)."""
+    head, _, rest = name.partition(".")
+    if rest:
+      imp = mod.imports.get(head)
+      if imp is not None and imp[0] == "mod":
+        target = self.modules.get(imp[1])
+        return target.classes.get(rest) if target and "." not in rest else None
+      return None
+    c = mod.classes.get(name)
+    if c is not None:
+      return c
+    imp = mod.imports.get(name)
+    if imp is not None and imp[0] == "sym":
+      target = self.modules.get(imp[1])
+      if target is not None:
+        return target.classes.get(imp[2])
+    return None
+
+  def _method_on(self, mod: _Module, cls: _Class, method: str,
+                 _seen: Optional[Set[str]] = None) -> Optional[str]:
+    """Method lookup on a class, walking resolvable bases (cycle-safe)."""
+    seen = _seen or set()
+    key = f"{cls.relpath}::{cls.name}"
+    if key in seen:
+      return None
+    seen.add(key)
+    q = cls.methods.get(method)
+    if q is not None:
+      return q
+    base_mod = self.modules.get(cls.relpath)
+    for base in cls.bases:
+      bc = self._resolve_class(base_mod or mod, base)
+      if bc is not None:
+        q = self._method_on(self.modules.get(bc.relpath, mod), bc, method, seen)
+        if q is not None:
+          return q
+    return None
+
+  def _resolve_name(self, info: FuncInfo, name: str) -> Optional[str]:
+    """A dotted name in `info`'s body -> callee qual, or None (unresolved).
+
+    Classes resolve to their __init__ (instantiation executes it)."""
+    if not name:
+      return None
+    mod = self.modules[info.sf.relpath]
+    parts = name.split(".")
+
+    if parts[0] == "self" and info.cls is not None:
+      cls = mod.classes.get(info.cls)
+      if cls is None:
+        return None
+      if len(parts) == 2:
+        return self._method_on(mod, cls, parts[1])
+      if len(parts) == 3:
+        ty = cls.attr_types.get(parts[1])
+        if ty is not None:
+          tc = self._resolve_class(mod, ty)
+          if tc is not None:
+            return self._method_on(self.modules.get(tc.relpath, mod), tc, parts[2])
+      return None
+
+    # Nested defs visible from the enclosing function scope chain.
+    if len(parts) == 1:
+      scope = info.qual.split("::", 1)[1]
+      chain = scope.split(".")
+      for i in range(len(chain), 0, -1):
+        q = f"{info.sf.relpath}::{'.'.join(chain[:i])}.{name}"
+        if q in self.funcs:
+          return q
+
+    head_imp = mod.imports.get(parts[0])
+    if head_imp is not None:
+      if head_imp[0] == "sym":
+        target = self.modules.get(head_imp[1])
+        if target is None:
+          return None
+        if len(parts) == 1:
+          q = target.funcs.get(head_imp[2])
+          if q is not None:
+            return q
+          c = target.classes.get(head_imp[2])
+          return c.methods.get("__init__") if c is not None else None
+        c = target.classes.get(head_imp[2])
+        if c is not None and len(parts) == 2:
+          return self._method_on(target, c, parts[1])
+        return None
+      # module alias
+      target = self.modules.get(head_imp[1])
+      if target is None or len(parts) == 1:
+        return None
+      if len(parts) == 2:
+        q = target.funcs.get(parts[1])
+        if q is not None:
+          return q
+        c = target.classes.get(parts[1])
+        return c.methods.get("__init__") if c is not None else None
+      if len(parts) == 3:
+        c = target.classes.get(parts[1])
+        if c is not None:
+          return self._method_on(target, c, parts[2])
+      return None
+
+    if len(parts) == 1:
+      q = mod.funcs.get(name)
+      if q is not None:
+        return q
+      c = mod.classes.get(name)
+      if c is not None:
+        return c.methods.get("__init__")
+      return None
+    if len(parts) == 2:
+      c = mod.classes.get(parts[0])
+      if c is not None:
+        return self._method_on(mod, c, parts[1])
+    # Fully-dotted absolute call (import xotorch_tpu; xotorch_tpu.x.f()).
+    rel = _mod_relpath(".".join(parts[:-1]))
+    if rel is not None and rel in self.modules:
+      return self.modules[rel].funcs.get(parts[-1])
+    return None
+
+  def _resolve_edges(self, info: FuncInfo) -> None:
+    sf = info.sf
+    for node in ast.walk(info.node):
+      if node is not info.node and sf.enclosing_func(node) is None:
+        continue  # defensive; walk stays inside the def
+      if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        q = self._resolve_name(info, name)
+        if q is not None:
+          info.calls.append(q)
+        elif name:
+          info.unresolved.append(name)
+        # Function references in argument position: executor indirection
+        # (`self._run(self._decode_batch_sync, ...)`), thunk registration.
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+          rname = dotted_name(arg)
+          if rname:
+            rq = self._resolve_name(info, rname)
+            if rq is not None and rq != q:
+              info.refs.append(rq)
+
+  # ------------------------------------------------------------ reachability
+
+  def find(self, suffix: str) -> List[str]:
+    """Quals whose `path::qual` ends with `suffix` (declaration ergonomics:
+    entry points name `engine.py::Class.method` without the full path)."""
+    return [q for q in self.funcs if q == suffix or q.endswith(suffix)]
+
+  def reachable(self, entry_suffixes: Sequence[str]) -> Dict[str, List[str]]:
+    """BFS closure over call+ref edges from the entry points. Returns
+    {qual: path-of-quals from its entry} — the witness chain findings
+    print. Cycle-tolerant: first visit wins."""
+    chains: Dict[str, List[str]] = {}
+    frontier: List[str] = []
+    for s in entry_suffixes:
+      for q in self.find(s):
+        if q not in chains:
+          chains[q] = [q]
+          frontier.append(q)
+    while frontier:
+      q = frontier.pop()
+      info = self.funcs.get(q)
+      if info is None:
+        continue
+      for callee in info.edges:
+        if callee not in chains:
+          chains[callee] = chains[q] + [callee]
+          frontier.append(callee)
+    return chains
+
+
+def program(repo: Repo) -> Program:
+  """The memoized whole-program view (one build shared by all checkers)."""
+  prog = getattr(repo, "_xotlint_program", None)
+  if prog is None:
+    prog = Program(repo)
+    repo._xotlint_program = prog
+  return prog
+
+
+# ------------------------------------------------------------------ jit sites
+
+@dataclass
+class JitSite:
+  """One `jax.jit` application: decorator or call."""
+  sf: SourceFile
+  line: int
+  name: str                      # wrapped func name, assignment target, or key
+  func_node: Optional[ast.AST]   # the wrapped def, when visible in-file
+  static_names: Tuple[str, ...] = ()
+  donate_names: Tuple[str, ...] = ()
+  params: Tuple[str, ...] = ()   # wrapped def's positional params, if known
+  donate_positions: Tuple[int, ...] = ()
+  factory: Optional[str] = None  # enclosing function qual that RETURNS this jit
+
+
+def _const_tuple(node: ast.AST) -> Tuple:
+  if isinstance(node, ast.Constant):
+    return (node.value,)
+  if isinstance(node, (ast.Tuple, ast.List)):
+    return tuple(e.value for e in node.elts if isinstance(e, ast.Constant))
+  return ()
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+  return dotted_name(node.func) in ("jax.jit", "jit")
+
+
+def _partial_of_jit(node: ast.Call) -> Optional[ast.Call]:
+  """`partial(jax.jit, ...)` / `functools.partial(jax.jit, ...)` -> node."""
+  if dotted_name(node.func) in ("partial", "functools.partial") and node.args:
+    head = node.args[0]
+    if isinstance(head, ast.Attribute) or isinstance(head, ast.Name):
+      if dotted_name(head) in ("jax.jit", "jit"):
+        return node
+  return None
+
+
+def _unwrap_partial(node: ast.AST) -> Tuple[Optional[str], Dict[str, ast.AST]]:
+  """`partial(fwd, use_flash=True)` -> ("fwd", {use_flash: ...});
+  a bare Name -> (name, {}). Anything else -> (None, {})."""
+  if isinstance(node, ast.Name):
+    return node.id, {}
+  if isinstance(node, ast.Call) and dotted_name(node.func) in ("partial", "functools.partial"):
+    if node.args and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+      return dotted_name(node.args[0]) or None, {kw.arg: kw.value for kw in node.keywords if kw.arg}
+  return None, {}
+
+
+def _def_params(fn: ast.AST) -> Tuple[str, ...]:
+  a = fn.args
+  return tuple(p.arg for p in a.posonlyargs + a.args)
+
+
+def _site_from_kw(sf: SourceFile, line: int, name: str, func_node, keywords,
+                  factory=None) -> JitSite:
+  static: Tuple[str, ...] = ()
+  donate_names: Tuple[str, ...] = ()
+  donate_pos: Tuple[int, ...] = ()
+  params = _def_params(func_node) if func_node is not None else ()
+  for kw in keywords:
+    if kw.arg == "static_argnames":
+      static = tuple(str(v) for v in _const_tuple(kw.value))
+    elif kw.arg == "static_argnums":
+      nums = tuple(int(v) for v in _const_tuple(kw.value) if isinstance(v, int))
+      static = static + tuple(params[i] for i in nums if i < len(params))
+    elif kw.arg == "donate_argnames":
+      donate_names = tuple(str(v) for v in _const_tuple(kw.value))
+    elif kw.arg == "donate_argnums":
+      donate_pos = tuple(int(v) for v in _const_tuple(kw.value) if isinstance(v, int))
+  if donate_names and params:
+    donate_pos = donate_pos + tuple(params.index(n) for n in donate_names if n in params)
+  return JitSite(sf=sf, line=line, name=name, func_node=func_node,
+                 static_names=static, donate_names=donate_names,
+                 params=params, donate_positions=donate_pos, factory=factory)
+
+
+def jit_sites(repo: Repo) -> List[JitSite]:
+  """Every jax.jit application in the tree (memoized on the repo)."""
+  sites = getattr(repo, "_xotlint_jit_sites", None)
+  if sites is not None:
+    return sites
+  sites = []
+  for sf in repo.files():
+    if sf.tree is None:
+      continue
+    local_defs: Dict[str, ast.AST] = {}
+    for node in sf.nodes():
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        local_defs.setdefault(node.name, node)
+    for node in sf.nodes():
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for dec in node.decorator_list:
+          if isinstance(dec, ast.Call) and (_partial_of_jit(dec) or _is_jit_call(dec)):
+            sites.append(_site_from_kw(sf, node.lineno, node.name, node, dec.keywords))
+          elif dotted_name(dec) in ("jax.jit", "jit"):
+            sites.append(JitSite(sf=sf, line=node.lineno, name=node.name,
+                                 func_node=node, params=_def_params(node)))
+      elif isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+        base_name, _bound = _unwrap_partial(node.args[0])
+        func_node = local_defs.get(base_name) if base_name else None
+        # Site name: the assignment target when there is one (that is the
+        # callable's name at call sites), else the wrapped function's name.
+        name = base_name or "<dynamic>"
+        stmt = node
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+          stmt = sf.parent(stmt)
+        if isinstance(stmt, ast.Assign) and stmt.targets:
+          tgt = stmt.targets[0]
+          tn = dotted_name(tgt)
+          if tn:
+            name = tn.rsplit(".", 1)[-1]
+          elif isinstance(tgt, ast.Subscript) and isinstance(tgt.slice, ast.Constant):
+            name = str(tgt.slice.value)
+        factory = None
+        fn = sf.enclosing_func(node)
+        if fn is not None:
+          # A factory returns the jitted callable (the lazy-jit idiom:
+          # `_commit_jit()(args...)`): the jit call's value flows to a
+          # `return` of the function, directly or through one local name.
+          names = {name}
+          if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+              if isinstance(t, ast.Name):
+                names.add(t.id)
+          for n2 in ast.walk(fn):
+            if isinstance(n2, ast.Return) and n2.value is not None:
+              rv = n2.value
+              if rv is node or (isinstance(rv, ast.Name) and rv.id in names):
+                factory = f"{sf.relpath}::{sf.qual(fn)}"
+        sites.append(_site_from_kw(sf, node.lineno, name, func_node,
+                                   node.keywords, factory=factory))
+  repo._xotlint_jit_sites = sites
+  return sites

@@ -25,7 +25,7 @@ import sys
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
 
-_DISABLE_RE = re.compile(r"#\s*xotlint:\s*disable=([a-z0-9_,-]+)")
+_DISABLE_RE = re.compile(r"#\s*xotlint:\s*disable=([a-z0-9_,-]+)\s*(\([^)]*\))?")
 
 
 @dataclass(frozen=True)
@@ -58,6 +58,16 @@ class SourceFile:
       self.tree = ast.parse(self.text, filename=self.relpath)
     except SyntaxError as e:
       self.parse_error = e
+    # Shared AST cache (built lazily, ONCE per file, by _index): every
+    # checker iterates these instead of re-walking the tree.
+    self._nodes: Optional[List[ast.AST]] = None
+    self._parent: Dict[int, ast.AST] = {}
+    self._func: Dict[int, Optional[ast.AST]] = {}
+    self._func_names: Dict[int, tuple] = {}
+    self._classes: Dict[int, tuple] = {}
+    # Suppression bookkeeping: which (line, checker) suppressions actually
+    # fired this run — the stale-suppression audit's evidence.
+    self.suppression_hits: set = set()
 
   def line_text(self, line: int) -> str:
     if 1 <= line <= len(self.lines):
@@ -69,7 +79,102 @@ class SourceFile:
     if m is None:
       return False
     names = {n.strip() for n in m.group(1).split(",")}
-    return checker in names or "all" in names
+    hit = checker in names or "all" in names
+    if hit:
+      self.suppression_hits.add((line, checker if checker in names else "all"))
+    return hit
+
+  def suppression_sites(self) -> List[tuple]:
+    """Every inline suppression in the file: (line, checker names, has a
+    parenthesized reason). The audit's work-list."""
+    sites = []
+    for i, text in enumerate(self.lines, start=1):
+      m = _DISABLE_RE.search(text)
+      if m is not None:
+        names = tuple(n.strip() for n in m.group(1).split(","))
+        sites.append((i, names, bool(m.group(2) and m.group(2).strip("() \t"))))
+    return sites
+
+  # ------------------------------------------------------- shared AST cache
+
+  def _index(self) -> None:
+    """One walk per file: document-ordered node list plus parent, innermost
+    enclosing function (sync/async/lambda), enclosing function-NAME stack
+    (functions only — the identity convention checkers key on), and
+    enclosing class-name stack. All checkers consume this instead of
+    running their own ast.walk per concern."""
+    nodes: List[ast.AST] = []
+    stack = [(self.tree, None, None, (), ())]
+    while stack:
+      node, parent, func, fnames, classes = stack.pop()
+      nodes.append(node)
+      nid = id(node)
+      self._parent[nid] = parent
+      self._func[nid] = func
+      self._func_names[nid] = fnames
+      self._classes[nid] = classes
+      c_func, c_fnames, c_classes = func, fnames, classes
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        c_func, c_fnames = node, fnames + (node.name,)
+      elif isinstance(node, ast.Lambda):
+        c_func = node  # sync scope boundary; contributes no name
+      elif isinstance(node, ast.ClassDef):
+        c_classes = classes + (node.name,)
+      for child in reversed(list(ast.iter_child_nodes(node))):
+        stack.append((child, node, c_func, c_fnames, c_classes))
+    self._nodes = nodes
+
+  def nodes(self) -> List[ast.AST]:
+    if self._nodes is None:
+      if self.tree is None:
+        self._nodes = []
+      else:
+        self._index()
+    return self._nodes
+
+  def parent(self, node: ast.AST) -> Optional[ast.AST]:
+    self.nodes()
+    return self._parent.get(id(node))
+
+  def enclosing_func(self, node: ast.AST) -> Optional[ast.AST]:
+    """Innermost enclosing FunctionDef/AsyncFunctionDef/Lambda — for the
+    node ITSELF this is the scope it sits in (a def's enclosing_func is its
+    outer function, not itself)."""
+    self.nodes()
+    return self._func.get(id(node))
+
+  def func_scope(self, node: ast.AST) -> str:
+    """Dotted enclosing function names (classes excluded) — the existing
+    checkers' identity convention, e.g. `hop` or `outer.inner`."""
+    self.nodes()
+    return ".".join(self._func_names.get(id(node), ())) or "<module>"
+
+  def class_scope(self, node: ast.AST) -> Optional[str]:
+    """Innermost enclosing class name, or None at module level."""
+    self.nodes()
+    classes = self._classes.get(id(node), ())
+    return classes[-1] if classes else None
+
+  def func_scope_at_line(self, line: int) -> str:
+    """Dotted function scope covering a LINE (for suppression-audit
+    identities, which have no AST node to anchor on)."""
+    best: Optional[ast.AST] = None
+    for node in self.nodes():
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+          and node.lineno <= line <= (node.end_lineno or node.lineno):
+        if best is None or node.lineno >= best.lineno:
+          best = node
+    return self.qual(best) if best is not None else "<module>"
+
+  def qual(self, node: ast.AST) -> str:
+    """Class-qualified dotted path of the scope the node sits in (for a
+    def node, include the def itself): `Class.method.inner` / `func`."""
+    self.nodes()
+    nid = id(node)
+    parts = list(self._classes.get(nid, ())) + list(self._func_names.get(nid, ()))
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+      parts.append(node.name)
+    return ".".join(parts) or "<module>"
 
 
 class Repo:

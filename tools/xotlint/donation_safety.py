@@ -1,0 +1,228 @@
+"""donation-safety checker: a donated buffer is DEAD after the call.
+
+`donate_argnums`/`donate_argnames` tells XLA it may alias the input's
+memory for the output — the Python-side array is invalidated the moment
+the dispatch runs. Reading it afterwards raises on TPU ("donated buffer
+was deleted") but often WORKS on the CPU backend tests run on, so the bug
+class ships silently. Three findings:
+
+- `use-after-donate`: the caller reads the donated expression after the
+  call, before rebinding it (`out = decode_chunk(p, tok, state.cache, ...)`
+  then touching `state.cache` before `state.cache = out[...]`).
+- `donated-result-discarded`: the call's result is dropped — the donated
+  buffer is gone and nothing replaced it (the arena vanishes).
+
+Donated callables are found three ways (callgraph jit-site table):
+decorated defs (`@partial(jax.jit, donate_argnames=...)`), jit results
+assigned to a name (`forward_jit = jax.jit(fwd, donate_argnums=(2,))` —
+call sites matched by attribute tail, the `ctx.forward_jit(...)` idiom),
+and factory functions returning a donated jit (`_commit_jit()(arena, ...)`
+— the lazy-jit idiom). Wrapper functions that pass their own parameter in
+a donated position (paged_cache.commit_pages) donate TRANSITIVELY: their
+callers are checked against the wrapper's signature too.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.xotlint.core import Finding, Repo, dotted_name
+from tools.xotlint.callgraph import jit_sites, program
+
+CHECKER = "donation-safety"
+
+
+class _Donated:
+  """name -> donated positional indices (and argnames for kw matching)."""
+
+  def __init__(self):
+    self.by_name: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+    self.factories: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+
+  def add(self, name: str, pos: Tuple[int, ...], names: Tuple[str, ...]) -> None:
+    if pos or names:
+      old = self.by_name.get(name, ((), ()))
+      self.by_name[name] = (tuple(sorted(set(old[0] + pos))),
+                            tuple(sorted(set(old[1] + names))))
+
+
+def _donation_table(repo: Repo) -> _Donated:
+  table = _Donated()
+  for site in jit_sites(repo):
+    if not (site.donate_positions or site.donate_names):
+      continue
+    table.add(site.name, site.donate_positions, site.donate_names)
+    if site.factory is not None:
+      scope = site.factory.split("::", 1)[1]
+      table.factories[scope.rsplit(".", 1)[-1]] = (
+        site.donate_positions, site.donate_names)
+  # Transitive wrappers: a function passing its OWN parameter in a donated
+  # position donates that parameter to its callers. One propagation round
+  # covers the repo's wrapper depth (commit_pages -> _commit_jit()).
+  prog = program(repo)
+  for info in prog.funcs.values():
+    params = [a.arg for a in info.node.args.posonlyargs + info.node.args.args]
+    for node in ast.walk(info.node):
+      if not isinstance(node, ast.Call):
+        continue
+      spec = _donated_spec_for_call(node, table)
+      if spec is None:
+        continue
+      pos, names = spec
+      donated_params = []
+      for i, arg in enumerate(node.args):
+        if i in pos and isinstance(arg, ast.Name) and arg.id in params:
+          donated_params.append(params.index(arg.id))
+      for kw in node.keywords:
+        if kw.arg in names and isinstance(kw.value, ast.Name) and kw.value.id in params:
+          donated_params.append(params.index(kw.value.id))
+      if donated_params:
+        table.add(info.node.name, tuple(donated_params), ())
+  return table
+
+
+def _donated_spec_for_call(call: ast.Call,
+                           table: _Donated) -> Optional[Tuple[Tuple[int, ...], Tuple[str, ...]]]:
+  """The (positions, argnames) donated by this call, if it targets a known
+  donated callable: by bare/tail name, or a factory-call-of-call."""
+  func = call.func
+  if isinstance(func, ast.Call):
+    inner = dotted_name(func.func)
+    if inner:
+      spec = table.factories.get(inner.rsplit(".", 1)[-1])
+      if spec is not None:
+        return spec
+    return None
+  d = dotted_name(func)
+  if not d:
+    return None
+  return table.by_name.get(d.rsplit(".", 1)[-1])
+
+
+def _stmt_of(sf, node: ast.AST) -> Optional[ast.stmt]:
+  while node is not None and not isinstance(node, ast.stmt):
+    node = sf.parent(node)
+  return node
+
+
+def _following_stmts(sf, stmt: ast.stmt, within: ast.AST) -> List[ast.stmt]:
+  """Statements that can execute AFTER `stmt` completes, in order: later
+  siblings in its block, then later siblings of each enclosing block up to
+  `within`. Sibling BRANCHES of the same if/try never run after the call
+  and are excluded (that is the point — a linear lineno scan would read
+  the `else:` arm as 'after')."""
+  out: List[ast.stmt] = []
+  node: ast.AST = stmt
+  while node is not None and node is not within:
+    parent = sf.parent(node)
+    if parent is None:
+      break
+    for field in ("body", "orelse", "finalbody", "handlers"):
+      block = getattr(parent, field, None)
+      if isinstance(block, list) and node in block:
+        out.extend(block[block.index(node) + 1:])
+        break
+    node = parent
+  return out
+
+
+def _reads_name(node: ast.AST, name: str) -> bool:
+  """Does the expression READ `name` (exact dotted match or a deeper
+  access through it)?"""
+  for n in ast.walk(node):
+    if isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+        getattr(n, "ctx", None), ast.Load):
+      d = dotted_name(n)
+      if d == name or (d and d.startswith(name + ".")):
+        return True
+  return False
+
+
+def _assigns_name(stmt: ast.stmt, name: str) -> bool:
+  """Any assignment to `name` within the statement — compound statements
+  (if/try) count when ANY arm rebinds (conservative toward no-finding: the
+  `if counts: a, d = out / else: d = out` rebind idiom must read as a
+  rebind, and a statement that both reads and rebinds is ambiguous in
+  order, so the rebind wins)."""
+  for node in ast.walk(stmt):
+    targets = []
+    if isinstance(node, ast.Assign):
+      targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+      targets = [node.target]
+    for t in targets:
+      for leaf in ast.walk(t):
+        if isinstance(leaf, (ast.Name, ast.Attribute)) and dotted_name(leaf) == name:
+          return True
+  return False
+
+
+def check(repo: Repo) -> List[Finding]:
+  table = _donation_table(repo)
+  prog = program(repo)
+  findings: List[Finding] = []
+  for info in prog.funcs.values():
+    sf = info.sf
+    for node in ast.walk(info.node):
+      if not isinstance(node, ast.Call):
+        continue
+      spec = _donated_spec_for_call(node, table)
+      if spec is None:
+        continue
+      pos, kwnames = spec
+      donated_exprs = [node.args[i] for i in pos if i < len(node.args)]
+      donated_exprs += [kw.value for kw in node.keywords if kw.arg in kwnames]
+      donated = [dotted_name(e) for e in donated_exprs]
+      donated = [d for d in donated if d]
+      if not donated:
+        continue
+      stmt = _stmt_of(sf, node)
+      if stmt is None:
+        continue
+      if isinstance(stmt, ast.Return):
+        continue  # result escapes; locals die with the frame
+      rebound_now = set()
+      if isinstance(stmt, ast.Assign):
+        for d in donated:
+          if any(dotted_name(leaf) == d
+                 for t in stmt.targets for leaf in ast.walk(t)
+                 if isinstance(leaf, (ast.Name, ast.Attribute))):
+            rebound_now.add(d)
+      elif isinstance(stmt, ast.Expr) and stmt.value is node:
+        if not sf.suppressed(node.lineno, CHECKER):
+          findings.append(Finding(
+            checker=CHECKER, code="donated-result-discarded", path=sf.relpath,
+            line=node.lineno, key=f"{sf.func_scope(node)}:{donated[0]}",
+            message=f"result of donating call discarded — `{donated[0]}` was "
+                    "donated (its device buffer is invalidated) and nothing "
+                    "rebinds it; assign the result back",
+          ))
+        continue
+      for d in donated:
+        if d in rebound_now or d == "self" or "." not in d and d in ("_",):
+          continue
+        # Post-call scan over statements that can actually run after the
+        # call (later siblings up the block chain — other branches of the
+        # same if/try are excluded): a Load of the donated name before any
+        # rebind is a use-after-donate. Loop back-edges are ignored — a
+        # donate-then-reuse ACROSS iterations must rebind inside the loop
+        # body anyway, which this still checks linearly.
+        use_line = rebind_line = None
+        for s in _following_stmts(sf, stmt, info.node):
+          if rebind_line is None and _assigns_name(s, d):
+            rebind_line = s.lineno
+          if use_line is None and not _assigns_name(s, d) and _reads_name(s, d):
+            use_line = s.lineno
+          if rebind_line is not None or use_line is not None:
+            break
+        if use_line is not None and (rebind_line is None or use_line < rebind_line):
+          if sf.suppressed(use_line, CHECKER) or sf.suppressed(node.lineno, CHECKER):
+            continue
+          findings.append(Finding(
+            checker=CHECKER, code="use-after-donate", path=sf.relpath,
+            line=use_line, key=f"{sf.func_scope(node)}:{d}",
+            message=f"`{d}` is read after being donated at line {node.lineno} "
+                    "— the buffer is invalidated by the dispatch (works on "
+                    "CPU, raises on TPU); rebind it from the result first",
+          ))
+  return findings

@@ -45,24 +45,14 @@ def _body_is_silent(handler: ast.ExceptHandler) -> bool:
   return True
 
 
-def _walk_scoped(node: ast.AST, scope: str):
-  """(handler, enclosing_scope) pairs, scope = dotted def/class path. The
-  scope anchors baseline identity: an unrelated handler added elsewhere in
-  the file must not renumber (and so un-grandfather) existing findings.
-  Known residual churn: adding/removing a SILENT handler earlier in the
-  same scope still shifts later ordinals — acceptable because identical
-  `except Exception: pass` bodies offer nothing else to key on, and policy
-  keeps the baseline empty anyway."""
-  for child in ast.iter_child_nodes(node):
-    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-      yield from _walk_scoped(child, f"{scope}.{child.name}" if scope else child.name)
-      continue
-    if isinstance(child, ast.ExceptHandler):
-      yield child, scope
-    yield from _walk_scoped(child, scope)
-
-
 def check(repo: Repo) -> List[Finding]:
+  """Handlers come from the shared AST cache (document order), scoped by
+  the dotted class/def path. The scope anchors baseline identity: an
+  unrelated handler added elsewhere in the file must not renumber (and so
+  un-grandfather) existing findings. Known residual churn: adding/removing
+  a SILENT handler earlier in the same scope still shifts later ordinals —
+  acceptable because identical `except Exception: pass` bodies offer
+  nothing else to key on, and policy keeps the baseline empty anyway."""
   findings: List[Finding] = []
   for sf in repo.files():
     if sf.tree is None:
@@ -70,10 +60,12 @@ def check(repo: Repo) -> List[Finding]:
     if not any(f"/{scope}" in f"/{sf.relpath}" for scope in _SCOPES):
       continue
     per_scope: dict = {}
-    for node, scope in _walk_scoped(sf.tree, ""):
+    for node in sf.nodes():
+      if not isinstance(node, ast.ExceptHandler):
+        continue
       if not (_catches_broad(node) and _body_is_silent(node)):
         continue
-      scope = scope or "<module>"
+      scope = sf.qual(node)
       per_scope[scope] = per_scope.get(scope, 0) + 1
       if sf.suppressed(node.lineno, CHECKER):
         continue

@@ -38,7 +38,7 @@ def check(repo: Repo) -> List[Finding]:
   for sf in repo.files():
     if sf.tree is None or sf.relpath == repo.knobs_path:
       continue
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes():
       name = None
       direct = False
       if isinstance(node, ast.Call):
@@ -55,9 +55,12 @@ def check(repo: Repo) -> List[Finding]:
             name, direct = sub.value, True
       if name is None or not _KNOB_RE.match(name):
         continue
-      if sf.suppressed(node.lineno, CHECKER):
-        continue
+      # suppressed() is consulted only once a violation is ESTABLISHED:
+      # its hit-recording side effect feeds the stale-suppression audit,
+      # so querying it for clean lines would mark dead comments as earned.
       if name not in registered:
+        if sf.suppressed(node.lineno, CHECKER):
+          continue
         findings.append(Finding(
           checker=CHECKER, code="unregistered-knob", path=sf.relpath,
           line=node.lineno, key=name,
@@ -65,6 +68,8 @@ def check(repo: Repo) -> List[Finding]:
                   "— register it (typo'd knobs silently serve defaults forever)",
         ))
       elif direct:
+        if sf.suppressed(node.lineno, CHECKER):
+          continue
         findings.append(Finding(
           checker=CHECKER, code="direct-env-read", path=sf.relpath,
           line=node.lineno, key=name,

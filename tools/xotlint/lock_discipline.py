@@ -1,0 +1,220 @@
+"""lock-discipline checker: what happens while a lock is held, and in what
+order locks nest.
+
+The runtime's locks guard host-side metadata (logprob store, host KV tier,
+flight ring, cost-model rows) that BOTH the engine executor thread and the
+event loop touch — so the rules are strict:
+
+- `callback-under-lock`: invoking a user/observer callback while holding a
+  lock hands YOUR lock to arbitrary code (PR 6's HostKVStore rule: fire
+  `observer` outside the lock). Re-entry or a slow observer deadlocks or
+  stalls every other thread on the lock.
+- `blocking-under-lock`: sleeps, subprocess, sync HTTP under a lock turn
+  every contender into a convoy.
+- `device-op-under-lock`: a jax dispatch / host-device transfer under a
+  host lock serializes device work behind metadata bookkeeping (the
+  /metrics reader should never wait on an HBM copy).
+- `await-under-lock`: `await` while holding a THREADING lock parks the
+  loop with the lock taken (async-safety flags the lexical case; this one
+  rides the same walk for sync defs called from executors).
+- `lock-order`: two locks acquired in both orders on some pair of paths —
+  the textbook deadlock. Acquisition pairs are collected per function
+  (nested `with`) AND through the callgraph (holding L while calling a
+  function whose closure acquires M), cycle-tolerantly.
+
+Lock identity is `Class.attr` / `module-var` via the same name heuristic
+async-safety uses (`lock`/`mutex`/`cond`/`sema` in the attribute tail).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.xotlint.core import Finding, Repo, dotted_name
+from tools.xotlint.callgraph import program
+from tools.xotlint.async_safety import _BLOCKING_CALLS, _is_lock_expr
+
+CHECKER = "lock-discipline"
+
+_CALLBACK_TAILS = {"observer", "callback", "cb", "hook", "on_evict", "listener"}
+_DEVICE_HEADS = {"jnp", "jax"}
+_DEVICE_ATTRS = {"block_until_ready", "device_get", "device_put"}
+# jnp.asarray of host metadata is not a dispatch; jax.profiler.* is session
+# control whose lock exists precisely to serialize it.
+_DEVICE_EXEMPT = {"asarray"}
+
+
+def _lock_id(sf, node: ast.AST) -> Optional[str]:
+  """Stable identity for a lock expression: `self._lock` inside class C ->
+  `C._lock`; module-level `_profiling_lock` -> `mod._profiling_lock`."""
+  name = dotted_name(node)
+  if not name and isinstance(node, ast.Call):
+    name = dotted_name(node.func)
+  if not name:
+    return None
+  parts = name.split(".")
+  if parts[0] == "self":
+    cls = sf.class_scope(node) or "?"
+    return f"{cls}.{'.'.join(parts[1:])}"
+  return f"{sf.relpath.rsplit('/', 1)[-1]}:{name}"
+
+
+class _FuncLocks:
+  """Per-function lock facts: direct acquisitions, ordered nesting pairs,
+  and (lock-held -> calls made) for interprocedural closure."""
+
+  def __init__(self):
+    self.acquires: Set[str] = set()
+    self.pairs: List[Tuple[str, str, int]] = []       # (outer, inner, line)
+    self.calls_under: List[Tuple[str, str, int]] = [] # (lock, callee qual, line)
+    self.events: List[Tuple[str, str, str, int]] = [] # (code, lock, what, line)
+
+
+def _scan_function(prog, info) -> _FuncLocks:
+  out = _FuncLocks()
+  sf = info.sf
+
+  def visit(node: ast.AST, held_sync: Tuple[str, ...],
+            held_all: Tuple[str, ...]) -> None:
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+      # `async with` means an ASYNCIO lock: awaiting under it is its whole
+      # point, and blocking under it is async-safety's beat — so it never
+      # extends the SYNC held set the under-lock event checks use. It DOES
+      # participate in order analysis (two asyncio locks taken in both
+      # orders deadlock just the same).
+      new_locks = []
+      for item in node.items:
+        if _is_lock_expr(item.context_expr):
+          lid = _lock_id(sf, item.context_expr)
+          if lid is not None:
+            new_locks.append(lid)
+      for lid in new_locks:
+        out.acquires.add(lid)
+        for outer in held_all:
+          out.pairs.append((outer, lid, node.lineno))
+      held_all = held_all + tuple(new_locks)
+      if isinstance(node, ast.With):
+        held_sync = held_sync + tuple(new_locks)
+      for child in node.body:
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+          continue  # nested defs run when called, not here
+        visit(child, held_sync, held_all)
+      return
+    if held_sync and isinstance(node, ast.Await):
+      out.events.append(("await-under-lock", held_sync[-1], "await", node.lineno))
+    if isinstance(node, ast.Call):
+      d = dotted_name(node.func)
+      tail = d.rsplit(".", 1)[-1] if d else (
+        node.func.attr if isinstance(node.func, ast.Attribute) else "")
+      if held_sync and tail in _CALLBACK_TAILS:
+        out.events.append(("callback-under-lock", held_sync[-1], tail, node.lineno))
+      elif held_sync and d in _BLOCKING_CALLS:
+        out.events.append(("blocking-under-lock", held_sync[-1], d, node.lineno))
+      elif held_sync and (
+          (d.split(".", 1)[0] in _DEVICE_HEADS and tail not in _DEVICE_EXEMPT)
+          or tail in _DEVICE_ATTRS) and not d.startswith("jax.profiler."):
+        out.events.append(("device-op-under-lock", held_sync[-1], d or tail, node.lineno))
+      elif held_all:
+        q = prog._resolve_name(info, d)
+        if q is not None:
+          out.calls_under.append((held_all[-1], q, node.lineno))
+    for child in ast.iter_child_nodes(node):
+      # Nested defs are separate functions (own facts entry): their bodies
+      # run when CALLED, not here — the call is what we record.
+      if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        continue
+      visit(child, held_sync, held_all)
+
+  for child in ast.iter_child_nodes(info.node):
+    if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+      visit(child, (), ())
+  return out
+
+
+def _transitive_acquires(facts: Dict[str, _FuncLocks],
+                         prog) -> Dict[str, Set[str]]:
+  """lock set each function may acquire, including through callees
+  (cycle-tolerant fixpoint)."""
+  acq = {q: set(f.acquires) for q, f in facts.items()}
+  changed = True
+  while changed:
+    changed = False
+    for q, f in facts.items():
+      info = prog.funcs.get(q)
+      if info is None:
+        continue
+      for callee in info.edges:
+        extra = acq.get(callee)
+        if extra and not extra <= acq[q]:
+          acq[q] |= extra
+          changed = True
+  return acq
+
+
+def check(repo: Repo) -> List[Finding]:
+  prog = program(repo)
+  facts: Dict[str, _FuncLocks] = {}
+  for q, info in prog.funcs.items():
+    if info.sf.tree is not None:
+      facts[q] = _scan_function(prog, info)
+
+  findings: List[Finding] = []
+  for q, f in facts.items():
+    info = prog.funcs[q]
+    sf = info.sf
+    for code, lock, what, line in f.events:
+      if sf.suppressed(line, CHECKER):
+        continue
+      scope = q.split("::", 1)[1]
+      messages = {
+        "callback-under-lock": f"`{what}(...)` invoked while holding `{lock}` "
+                               "— arbitrary observer code runs under YOUR lock "
+                               "(re-entry deadlocks); snapshot under the lock, "
+                               "fire outside it",
+        "blocking-under-lock": f"blocking `{what}` while holding `{lock}` — "
+                               "every contender convoys behind it",
+        "device-op-under-lock": f"device op `{what}` while holding `{lock}` — "
+                                "metadata readers wait on a device "
+                                "dispatch/transfer; move it outside the lock",
+        "await-under-lock": f"`await` while holding threading lock `{lock}` — "
+                            "the loop parks with the lock taken",
+      }
+      findings.append(Finding(
+        checker=CHECKER, code=code, path=sf.relpath, line=line,
+        key=f"{scope}:{lock}:{what}", message=messages[code],
+      ))
+
+  # Interprocedural order pairs: direct nesting + (held lock, transitive
+  # acquisitions of the callee).
+  acq = _transitive_acquires(facts, prog)
+  pair_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+  for q, f in facts.items():
+    relpath = prog.funcs[q].sf.relpath
+    for outer, inner, line in f.pairs:
+      if outer != inner:
+        pair_sites.setdefault((outer, inner), (relpath, line))
+    for held, callee, line in f.calls_under:
+      for inner in acq.get(callee, ()):
+        if inner != held:
+          pair_sites.setdefault((held, inner), (relpath, line))
+
+  reported: Set[frozenset] = set()
+  for (a, b), (relpath, line) in sorted(pair_sites.items()):
+    if (b, a) not in pair_sites:
+      continue
+    key = frozenset((a, b))
+    if key in reported:
+      continue
+    reported.add(key)
+    sf = prog.repo.file(relpath)
+    if sf is not None and sf.suppressed(line, CHECKER):
+      continue
+    other_rel, other_line = pair_sites[(b, a)]
+    findings.append(Finding(
+      checker=CHECKER, code="lock-order", path=relpath, line=line,
+      key="<->".join(sorted((a, b))),
+      message=f"inconsistent lock order: `{a}` then `{b}` here, but "
+              f"`{b}` then `{a}` at {other_rel}:{other_line} — a deadlock "
+              "under concurrency; pick one order",
+    ))
+  return findings

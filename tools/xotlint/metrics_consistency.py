@@ -84,7 +84,7 @@ def registry_metrics(repo: Repo) -> Dict[str, Tuple[str, str]]:
   if sf is None or sf.tree is None:
     return out
   var_ctors: Dict[str, Tuple[str, str]] = {}
-  for node in ast.walk(sf.tree):
+  for node in sf.nodes():
     if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
       continue
     target = node.targets[0]
@@ -104,11 +104,11 @@ def registry_metrics(repo: Repo) -> Dict[str, Tuple[str, str]]:
   return out
 
 
-def _tuple_table(tree: ast.AST) -> List[Tuple[ast.For, List[Tuple[str, str, int]]]]:
+def _tuple_table(sf) -> List[Tuple[ast.For, List[Tuple[str, str, int]]]]:
   """For-loops iterating literal ((key, "xot_name", help), ...) tables:
   [(loop, [(key, metric_name, line), ...]), ...]."""
   out = []
-  for node in ast.walk(tree):
+  for node in sf.nodes():
     if not isinstance(node, ast.For):
       continue
     rows: List[Tuple[str, str, int]] = []
@@ -153,7 +153,7 @@ def exported_metrics(repo: Repo) -> Dict[str, str]:
     sf = repo.file(path)
     if sf is None or sf.tree is None:
       continue
-    for loop, rows in _tuple_table(sf.tree):
+    for loop, rows in _tuple_table(sf):
       mtype = _loop_metric_type(loop) or "counter"
       for _, name, _ in rows:
         exported[name] = mtype
@@ -167,7 +167,7 @@ def flight_events(repo: Repo) -> Dict[str, int]:
   out: Dict[str, int] = {}
   if sf is None or sf.tree is None:
     return out
-  for node in ast.walk(sf.tree):
+  for node in sf.nodes():
     if isinstance(node, ast.Assign) and len(node.targets) == 1 \
         and isinstance(node.targets[0], ast.Name) and node.targets[0].id == "EVENTS":
       for elt in ast.walk(node.value):
@@ -182,7 +182,7 @@ def _flight_record_sites(repo: Repo) -> List[Tuple[str, str, int]]:
   for sf in repo.files():
     if sf.tree is None:
       continue
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes():
       if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
           and node.func.attr == "record":
         event = str_arg(node)
@@ -198,7 +198,7 @@ def alert_rule_refs(repo: Repo) -> List[Tuple[str, str, int]]:
   rows: List[Tuple[str, str, int]] = []
   if sf is None or sf.tree is None:
     return rows
-  for node in ast.walk(sf.tree):
+  for node in sf.nodes():
     if isinstance(node, ast.Call) \
         and dotted_name(node.func).rsplit(".", 1)[-1] == "AlertRule":
       for kw in node.keywords:
@@ -214,7 +214,7 @@ def _bump_sites(repo: Repo) -> List[Tuple[str, str, int]]:
   for sf in repo.files():
     if sf.tree is None:
       continue
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes():
       if isinstance(node, ast.Call):
         fn = dotted_name(node.func)
         if fn == "bump" or fn.endswith(".bump"):
@@ -230,7 +230,7 @@ def _metrics_attr_calls(repo: Repo) -> List[Tuple[str, str, str, int]]:
   for sf in repo.files():
     if sf.tree is None:
       continue
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes():
       if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
           and node.func.attr in ("inc", "observe", "set", "dec"):
         chain = dotted_name(node.func)
@@ -249,7 +249,7 @@ def _produced_dict_keys(repo: Repo) -> Set[str]:
   for sf in repo.files():
     if sf.tree is None:
       continue
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes():
       if isinstance(node, ast.Dict):
         for k in node.keys:
           if isinstance(k, ast.Constant) and isinstance(k.value, str):
@@ -274,7 +274,7 @@ def _engine_aug_attrs(repo: Repo) -> Set[str]:
   for sf in repo.files():
     if sf.tree is None:
       continue
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes():
       if isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Attribute):
         attrs.add(node.target.attr)
       elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -307,11 +307,14 @@ def check(repo: Repo) -> List[Finding]:
       ))
 
   # Every metrics.<attr> touch resolves to a NodeMetrics attribute.
+  # Throughout: suppressed() is consulted only once a violation is
+  # ESTABLISHED — its hit-recording side effect feeds the stale-suppression
+  # audit, so querying it for clean lines would mark dead comments as earned.
   for attr, method, path, line in _metrics_attr_calls(repo):
-    sf = repo.file(path)
-    if sf is not None and sf.suppressed(line, CHECKER):
-      continue
     if attr not in reg:
+      sf = repo.file(path)
+      if sf is not None and sf.suppressed(line, CHECKER):
+        continue
       findings.append(Finding(
         CHECKER, "unknown-metric-attr", path, line, key=f"{attr}.{method}",
         message=f"`metrics.{attr}.{method}()` but NodeMetrics defines no `{attr}` "
@@ -321,11 +324,11 @@ def check(repo: Repo) -> List[Finding]:
   # Every bump("key") is exported as xot_<key>_total by the exposition.
   exposition_names = set(exported)
   for key, path, line in _bump_sites(repo):
-    sf = repo.file(path)
-    if sf is not None and sf.suppressed(line, CHECKER):
-      continue
     want = f"xot_{key}_total"
     if want not in exposition_names:
+      sf = repo.file(path)
+      if sf is not None and sf.suppressed(line, CHECKER):
+        continue
       findings.append(Finding(
         CHECKER, "unexported-counter", path, line, key=key,
         message=f"`bump(\"{key}\")` increments a process counter but "
@@ -338,11 +341,11 @@ def check(repo: Repo) -> List[Finding]:
   if declared:
     recorded: Set[str] = set()
     for event, path, line in _flight_record_sites(repo):
-      sf = repo.file(path)
-      if sf is not None and sf.suppressed(line, CHECKER):
-        continue
       recorded.add(event)
       if event not in declared:
+        sf = repo.file(path)
+        if sf is not None and sf.suppressed(line, CHECKER):
+          continue
         findings.append(Finding(
           CHECKER, "unknown-flight-event", path, line, key=event,
           message=f"`.record(\"{event}\")` but orchestration/flight.py EVENTS does "
@@ -361,13 +364,13 @@ def check(repo: Repo) -> List[Finding]:
   # bad/total counters must export as xot_<name>_total.
   alerts_sf = repo.file(repo.alerts_path)
   for kwarg, ref, line in alert_rule_refs(repo):
-    if alerts_sf is not None and alerts_sf.suppressed(line, CHECKER):
-      continue
     if kwarg == "family":
       want, want_type = f"xot_{ref}", "histogram"
     else:
       want, want_type = f"xot_{ref}_total", "counter"
     if exported.get(want) != want_type:
+      if alerts_sf is not None and alerts_sf.suppressed(line, CHECKER):
+        continue
       findings.append(Finding(
         CHECKER, "unknown-alert-metric", repo.alerts_path, line, key=f"{kwarg}:{ref}",
         message=f"AlertRule {kwarg}={ref!r} needs exported {want_type} `{want}` "
@@ -382,19 +385,18 @@ def check(repo: Repo) -> List[Finding]:
   if api_sf is not None and api_sf.tree is not None:
     incremented = _engine_aug_attrs(repo)
     produced = _produced_dict_keys(repo)
-    for loop, rows in _tuple_table(api_sf.tree):
+    for loop, rows in _tuple_table(api_sf):
       is_counter = (_loop_metric_type(loop) or "counter") == "counter"
       for attr, name, line in rows:
-        if api_sf.suppressed(line, CHECKER):
-          continue
         if attr.startswith("_"):
-          if is_counter and attr not in incremented:
+          if is_counter and attr not in incremented \
+              and not api_sf.suppressed(line, CHECKER):
             findings.append(Finding(
               CHECKER, "dead-exported-counter", repo.api_metrics_path, line, key=name,
               message=f"API exports `{name}` from engine attr `{attr}` but nothing "
                       "in the tree increments that attr — stale exposition row",
             ))
-        elif attr not in produced:
+        elif attr not in produced and not api_sf.suppressed(line, CHECKER):
           findings.append(Finding(
             CHECKER, "dead-exported-gauge", repo.api_metrics_path, line, key=name,
             message=f"API exports `{name}` from stats key `{attr!s}` but no engine "

@@ -1,0 +1,180 @@
+"""retrace-hazard checker: every `jax.jit` site keeps its executable count
+bounded.
+
+A jit executable is keyed on (static arg VALUES, traced arg SHAPES,
+closure constants). Three ways the key-space silently explodes — each one
+a compile storm mid-serving that the flight recorder only reports after
+the fact (`xot_jit_first_dispatch_total`):
+
+- `unbounded-static`: a static argname that carries a raw position /
+  offset / count. One compile per distinct value; positions are unbounded.
+  Chunk sizes riding the power-of-two ladder (`num_tokens`), sampling
+  constants (`top_k`/`top_p`), block sizes and flags are BOUNDED by
+  design and allowlisted below.
+- `traced-branch`: a Python `if`/`while` on a TRACED parameter inside a
+  jitted function — a TracerBoolConversionError at best, a silent
+  concretization (one compile per value) under `static_argnums` drift at
+  worst. Branching on `.shape`/`.ndim`/`.dtype` or on `is None` is static
+  structure and fine.
+- `mutable-capture`: a jitted function closing over a module-level
+  list/dict/set. Mutation invalidates nothing (jit hashes by identity or
+  not at all) — stale constants or unhashable errors at dispatch.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from tools.xotlint.core import Finding, Repo, dotted_name
+from tools.xotlint.callgraph import jit_sites
+
+CHECKER = "retrace-hazard"
+
+# Static argnames that smell like per-request positions/offsets (one
+# executable per VALUE).
+_UNBOUNDED_RE = re.compile(
+  r"(^|_)(pos|position|start|offset|index|idx|seq_len|cache_len|length)(_|$)")
+
+# Bounded-by-design statics the real tree justifies: chunk sizes ride the
+# power-of-two ladder, sampling constants come from a bounded request
+# vocabulary, block/layer constants are config.
+BOUNDED_STATIC_OK = {
+  "num_tokens", "top_k", "top_p", "top_lp", "n", "page", "n_segs",
+  "pad_rows", "block_q", "block_k", "block_out", "interpret", "variant",
+  "softcap", "cfg", "is_first", "is_last", "use_flash", "use_flash_decode",
+  "use_kernel", "moe_routed", "paged_kernel", "start_layer", "start_layers",
+}
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+  return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _test_is_static(test: ast.AST, traced: Set[str]) -> bool:
+  """True when the branch condition only consults static structure of
+  traced values: `.shape`/`.ndim`/`.dtype` access, `is (not) None`,
+  `isinstance(x, ...)` Python-type tests, or no traced name at all."""
+  if not (_names_in(test) & traced):
+    return True
+  if isinstance(test, ast.Compare) and all(
+      isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+    return True
+  if isinstance(test, ast.BoolOp):
+    remaining = set(traced)
+    for v in test.values:
+      if not _test_is_static(v, remaining):
+        return False
+      if isinstance(test.op, ast.And):
+        # `isinstance(x, (int, float)) and x == 0.0` is the static-shortcut
+        # idiom: the guard short-circuits for tracers, so later operands
+        # only ever see a host scalar.
+        for n in ast.walk(v):
+          if isinstance(n, ast.Call) and dotted_name(n.func) == "isinstance" \
+              and n.args and isinstance(n.args[0], ast.Name):
+            remaining.discard(n.args[0].id)
+    return True
+  if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+    return _test_is_static(test.operand, traced)
+  # Every traced-name occurrence must be behind a metadata attribute or an
+  # isinstance() type probe.
+  parents = {}
+  for n in ast.walk(test):
+    for c in ast.iter_child_nodes(n):
+      parents[id(c)] = n
+  for n in ast.walk(test):
+    if isinstance(n, ast.Name) and n.id in traced:
+      p = parents.get(id(n))
+      if isinstance(p, ast.Attribute) and p.attr in _SHAPE_ATTRS:
+        continue
+      if isinstance(p, ast.Call) and dotted_name(p.func) == "isinstance":
+        continue
+      return False
+  return True
+
+
+def _module_mutables(sf) -> Set[str]:
+  """Module-level names bound to list/dict/set displays (or their
+  constructors) — the mutable-capture candidates."""
+  out: Set[str] = set()
+  if sf.tree is None:
+    return out
+  for stmt in sf.tree.body:
+    if isinstance(stmt, ast.Assign):
+      v = stmt.value
+      mutable = isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                               ast.DictComp, ast.SetComp)) or (
+        isinstance(v, ast.Call) and dotted_name(v.func) in ("list", "dict", "set"))
+      if mutable:
+        for t in stmt.targets:
+          if isinstance(t, ast.Name):
+            out.add(t.id)
+  return out
+
+
+def check(repo: Repo) -> List[Finding]:
+  findings: List[Finding] = []
+  for site in jit_sites(repo):
+    sf = site.sf
+
+    for name in site.static_names:
+      if name in BOUNDED_STATIC_OK or not _UNBOUNDED_RE.search(name):
+        continue
+      if sf.suppressed(site.line, CHECKER):
+        continue
+      findings.append(Finding(
+        checker=CHECKER, code="unbounded-static", path=sf.relpath,
+        line=site.line, key=f"{site.name}:{name}",
+        message=f"static argname `{name}` on jit of `{site.name}` looks like "
+                "a raw position/offset — one executable per distinct value "
+                "(compile storm); trace it (dynamic_slice) or bound it to the "
+                "power-of-two ladder and allowlist it in retrace_hazard.py",
+      ))
+
+    fn = site.func_node
+    if fn is None:
+      continue
+    params = set(site.params)
+    traced = params - set(site.static_names)
+    # Locals assigned inside shadow params for branching purposes only when
+    # reassigned from host values — keep it simple: params only.
+    for node in ast.walk(fn):
+      if isinstance(node, (ast.If, ast.While)) and not _test_is_static(node.test, traced):
+        if sf.suppressed(node.lineno, CHECKER):
+          continue
+        findings.append(Finding(
+          checker=CHECKER, code="traced-branch", path=sf.relpath,
+          line=node.lineno, key=f"{site.name}:{sf.func_scope(node)}",
+          message=f"Python branch on traced value inside jitted `{site.name}` "
+                  "— TracerBoolConversionError at trace time (or a silent "
+                  "per-value recompile); use jnp.where/lax.cond or make the "
+                  "operand static",
+        ))
+
+    mutables = _module_mutables(sf)
+    if mutables:
+      local: Set[str] = set(params)
+      for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+          for t in node.targets:
+            local |= _names_in(t)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+          local.add(node.name)
+      free = {n for n in _names_in(fn) if n in mutables and n not in local}
+      for name in sorted(free):
+        # Anchor the finding (and its suppression comment) on the first USE
+        # of the captured name, not the def line.
+        line = min((n.lineno for n in ast.walk(fn)
+                    if isinstance(n, ast.Name) and n.id == name), default=fn.lineno)
+        if sf.suppressed(line, CHECKER):
+          continue
+        findings.append(Finding(
+          checker=CHECKER, code="mutable-capture", path=sf.relpath,
+          line=line, key=f"{site.name}:{name}",
+          message=f"jitted `{site.name}` closes over module-level mutable "
+                  f"`{name}` — jit sees a stale snapshot (or an unhashable "
+                  "error); pass it as an argument or freeze it",
+        ))
+  return findings

@@ -148,21 +148,26 @@ def _commit_jit():
     import jax
     import jax.numpy as jnp
 
-    def commit(arena, cache, page_ids, start_page: int, n: int, page: int):
+    def commit(arena, cache, page_ids, start_page, n: int, page: int):
+      # `start_page` is TRACED (xotlint retrace-hazard: a static offset
+      # means one compiled executable per distinct commit offset). The
+      # source is padded by a full window so the dynamic slice never
+      # clamps for in-range offsets; out-of-range tail positions copy
+      # zeros/garbage that per-row length masking never reads — exactly
+      # the old static-slice semantics.
       out = {}
       for name, buf in arena.items():
         src = cache[name][:, 0]  # [L, S, Hkv, D]
-        lo, hi = start_page * page, (start_page + n) * page
-        if src.shape[1] < hi:
-          pad = [(0, 0)] * src.ndim
-          pad[1] = (0, hi - src.shape[1])
-          src = jnp.pad(src, pad)
-        seg = src[:, lo:hi].reshape(src.shape[0], n, page, *src.shape[2:])
+        pad = [(0, 0)] * src.ndim
+        pad[1] = (0, n * page)
+        src = jnp.pad(src, pad)
+        seg = jax.lax.dynamic_slice_in_dim(src, start_page * page, n * page, axis=1)
+        seg = seg.reshape(src.shape[0], n, page, *src.shape[2:])
         out[name] = buf.at[:, page_ids].set(seg.astype(buf.dtype))
       return out
 
     fn = _JITS["commit"] = jax.jit(
-      commit, donate_argnames=("arena",), static_argnames=("start_page", "n", "page"))
+      commit, donate_argnames=("arena",), static_argnames=("n", "page"))
   return fn
 
 
@@ -179,7 +184,7 @@ def commit_pages(arena: Dict[str, Any], cache: Dict[str, Any], page_ids,
     return arena
   page = arena["k"].shape[2]
   return _commit_jit()(arena, cache, jnp.asarray(page_ids, jnp.int32),
-                       int(start_page), n, page)
+                       jnp.int32(start_page), n, page)
 
 
 def _gather_jit():

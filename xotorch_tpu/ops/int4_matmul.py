@@ -236,8 +236,11 @@ def _int4_grouped_matmul_impl(
     )(he8, ho8, he_s, ho_s, w_packed, gs3)
     return out
 
+  # The kernel table is read at TRACE time, keyed by the static `variant`;
+  # retraces rebuild the same choice deterministically.
+  kernel = _KERNELS.get(variant, _int4_matvec_kernel)  # xotlint: disable=retrace-hazard (trace-time table)
   out = pl.pallas_call(
-    _KERNELS.get(variant, _int4_matvec_kernel),
+    kernel,
     grid=(d_out // block_out,),
     in_specs=[act_block, act_block] + w_blocks,
     out_specs=pl.BlockSpec((rows, block_out), lambda j: (0, j)),

@@ -192,6 +192,9 @@ def cache_spec(rank: int = 5):
 def shard_cache(cache, mesh):
   import jax
   from jax.sharding import NamedSharding
-  return jax.tree.map(
-    lambda x: jax.device_put(x, NamedSharding(mesh, _restrict_spec(cache_spec(x.ndim), mesh))), cache
-  )
+  def _place(x):
+    # One-time arena placement at pool creation, not steady-state decode work.
+    spec = _restrict_spec(cache_spec(x.ndim), mesh)
+    return jax.device_put(x, NamedSharding(mesh, spec))  # xotlint: disable=hotpath-sync (pool creation)
+
+  return jax.tree.map(_place, cache)

@@ -108,7 +108,8 @@ def make_train_step(
   def train_step(params, opt_state, batch):
     from xotorch_tpu.models.quantize import is_quantized
     from xotorch_tpu.train.lora import has_lora
-    if is_quantized(params) and not has_lora(params):
+    # Pytree STRUCTURE predicates: static under trace, no value branch.
+    if is_quantized(params) and not has_lora(params):  # xotlint: disable=retrace-hazard (structure test)
       # Without a frozen-base mask the float scales/norms would train against
       # immutable int8 weights — neither a full fine-tune nor a clean freeze.
       raise ValueError("Training a quantized base requires LoRA adapters "
