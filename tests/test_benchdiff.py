@@ -269,6 +269,30 @@ def test_soak_alert_keys_gate_and_direction(tmp_path):
              for f in findings)
 
 
+def test_soak_anatomy_gate_and_direction(tmp_path):
+  """The stage-breakdown honesty gate: a committed green soak whose
+  anatomy leaves more than the declared fraction unattributed is flagged
+  by --check (absolute bound); reservoir depth and the share itself stay
+  informational in soak-to-soak diffs."""
+  rows = _rows_by_metric(diff_records(
+    soak_metrics_of(_soak_record(anatomy_breakdowns=12.0,
+                                 anatomy_unattributed_share=0.2)),
+    soak_metrics_of(_soak_record(anatomy_breakdowns=8.0,
+                                 anatomy_unattributed_share=0.1))))
+  assert rows["anatomy_breakdowns"]["verdict"] == "info"
+  assert rows["anatomy_unattributed_share"]["verdict"] == "info"
+  (tmp_path / "PERF.md").write_text(perf_md_section(tmp_path) + "\n")
+  lying = _soak_record(anatomy_unattributed_share=0.8)
+  (tmp_path / "SOAK_anatomy.json").write_text(json.dumps(lying))
+  findings = check_repo(tmp_path)
+  assert any("SOAK_anatomy.json" in f and "anatomy_unattributed_share" in f
+             for f in findings)
+  # Under the bound: passes.
+  (tmp_path / "SOAK_anatomy.json").write_text(json.dumps(
+    _soak_record(anatomy_unattributed_share=0.3)))
+  assert check_repo(tmp_path) == []
+
+
 def test_soak_cli_diff_and_mixed_shapes(tmp_path, capsys):
   cur = tmp_path / "SOAK_now.json"
   base = tmp_path / "SOAK_then.json"

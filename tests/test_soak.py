@@ -78,16 +78,18 @@ def test_delta_buckets_and_server_percentiles():
 
 # -------------------------------------------------------- reconciliation
 
-def _client(ttft_p95=0.5, e2e_p95=1.0, count=10):
+def _client(ttft_p95=0.5, e2e_p95=1.0, tpot_p95=0.1, count=10):
   base = {"p50": ttft_p95 / 2, "p95": ttft_p95, "p99": ttft_p95, "count": count}
   e2e = {"p50": e2e_p95 / 2, "p95": e2e_p95, "p99": e2e_p95, "count": count}
-  return {"ttft_s": base, "e2e_s": e2e}
+  tpot = {"p50": tpot_p95 / 2, "p95": tpot_p95, "p99": tpot_p95, "count": count}
+  return {"ttft_s": base, "e2e_s": e2e, "tpot_s": tpot}
 
 
-def _server(ttft_p95=0.4, e2e_p95=0.9, count=10):
+def _server(ttft_p95=0.4, e2e_p95=0.9, tpot_p95=0.05, count=10):
   return {
     "ttft_seconds": {"p50": ttft_p95 / 2, "p95": ttft_p95, "p99": ttft_p95, "count": count},
     "request_seconds": {"p50": e2e_p95 / 2, "p95": e2e_p95, "p99": e2e_p95, "count": count},
+    "token_seconds": {"p50": tpot_p95 / 2, "p95": tpot_p95, "p99": tpot_p95, "count": count},
   }
 
 
@@ -119,6 +121,45 @@ def test_reconcile_flags_server_above_client_both_modes():
 def test_reconcile_unknowable_sides_are_none():
   rows = soak.reconcile({"ttft_s": {"count": 0}}, _server(), tol_s=1.0)
   assert rows["ttft_p50"]["ok"] is None
+
+
+def test_reconcile_tpot_one_sided_median_only():
+  """TPOT: the client's inter-chunk gap contains broadcast/HTTP/SSE framing
+  the sampler never sees, so only the structural server<=client invariant
+  holds — and only at p50: the server histogram also counts tokens of
+  requests the client recorded as errors (kill-window retry storms), so
+  the tails are structurally incomparable and emit no rows."""
+  rows = soak.reconcile(_client(), _server(), tol_s=2.5)
+  assert rows["tpot_p50"]["ok"] is True and rows["tpot_p50"]["mode"] == "one_sided"
+  assert "tpot_p95" not in rows and "tpot_p99" not in rows
+  # Client far above server: fine (one-sided).
+  rows = soak.reconcile(_client(tpot_p95=5.0), _server(tpot_p95=0.01), tol_s=2.5)
+  assert rows["tpot_p50"]["ok"] is True
+  # Server above client beyond tolerance + bucket width: contradiction.
+  rows = soak.reconcile(_client(tpot_p95=0.01), _server(tpot_p95=5.0), tol_s=2.5)
+  assert rows["tpot_p50"]["ok"] is False
+  # A no-streaming run has no client TPOT samples: unknowable, not red.
+  client = _client()
+  client["tpot_s"] = {"count": 0}
+  rows = soak.reconcile(client, _server(), tol_s=2.5)
+  assert rows["tpot_p50"]["ok"] is None
+
+
+def test_anatomy_summary_and_flat_metrics():
+  payload = {"breakdowns": 7, "stages": {
+    "decode": {"share_mean": 0.6, "secs_mean": 0.3},
+    "hop:b": {"share_mean": 0.25, "secs_mean": 0.12},
+    "unattributed": {"share_mean": 0.15, "secs_mean": 0.07},
+  }}
+  summary = soak.summarize_anatomy(payload)
+  assert summary["breakdowns"] == 7
+  assert summary["unattributed_share_mean"] == pytest.approx(0.15)
+  assert soak.summarize_anatomy(None) is None
+  assert soak.summarize_anatomy({"stages": {}}) is None
+  report = {"client": {"submitted": 1}, "anatomy": summary}
+  flat = soak.flatten_metrics(report)
+  assert flat["anatomy_breakdowns"] == 7.0
+  assert flat["anatomy_unattributed_share"] == pytest.approx(0.15)
 
 
 # ------------------------------------------------- aborts / leaks / verdict

@@ -1,0 +1,91 @@
+"""Latency-anatomy CLI helpers: render `/v1/anatomy` payloads as terminal
+tables.
+
+`python -m tools.anatomy http://host:port` prints the ring-wide per-stage
+percentile table; `--request-id` renders one request's waterfall-style
+breakdown; `--diff SECONDS` renders the two-window "which stage grew"
+comparison; `--chrome OUT.json` saves the skew-corrected Chrome trace
+export for Perfetto. Pure rendering lives here so it is unit-testable
+without a server.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _fmt_s(v: Optional[float]) -> str:
+  if v is None:
+    return "-"
+  if v >= 1.0:
+    return f"{v:.3f}s"
+  return f"{v * 1e3:.1f}ms"
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+  return "-" if v is None else f"{v * 100:.1f}%"
+
+
+def render_breakdown(breakdown: Dict[str, Any]) -> str:
+  """One request's stage table, largest contributor first, with the
+  explicit unattributed residual and per-stage skew-uncertainty bound."""
+  lines = [
+    f"request {breakdown.get('request_id')}  "
+    f"e2e {_fmt_s(breakdown.get('e2e_s'))}  "
+    f"(trace {breakdown.get('trace_id')})",
+    f"{'stage':<24} {'secs':>10} {'share':>8} {'± skew':>10}",
+  ]
+  stages = breakdown.get("stages") or {}
+  for name, entry in sorted(stages.items(), key=lambda kv: -kv[1].get("secs", 0.0)):
+    lines.append(f"{name:<24} {_fmt_s(entry.get('secs')):>10} "
+                 f"{_fmt_pct(entry.get('share')):>8} "
+                 f"{_fmt_s(entry.get('uncertainty_s')):>10}")
+  offsets = breakdown.get("offsets") or {}
+  for node, off in sorted(offsets.items()):
+    lines.append(f"  clock[{node}]: offset {float(off.get('offset_ns', 0.0)) / 1e6:+.3f}ms "
+                 f"± {float(off.get('uncertainty_ns', 0.0)) / 1e6:.3f}ms ({off.get('via')})")
+  return "\n".join(lines)
+
+
+def render_percentiles(payload: Dict[str, Any]) -> str:
+  """The ring-wide per-stage contribution table (/v1/anatomy default)."""
+  lines = [
+    f"node {payload.get('node_id')}  breakdowns {payload.get('breakdowns')} "
+    f"(lifetime {payload.get('total')})",
+    f"{'stage':<24} {'secs p50':>10} {'secs p95':>10} {'share p50':>10} {'share p95':>10}",
+  ]
+  stages = payload.get("stages") or {}
+  for name, entry in sorted(stages.items(), key=lambda kv: -kv[1].get("secs_p50", 0.0)):
+    lines.append(f"{name:<24} {_fmt_s(entry.get('secs_p50')):>10} "
+                 f"{_fmt_s(entry.get('secs_p95')):>10} "
+                 f"{_fmt_pct(entry.get('share_p50')):>10} "
+                 f"{_fmt_pct(entry.get('share_p95')):>10}")
+  return "\n".join(lines)
+
+
+def render_diff(payload: Dict[str, Any]) -> str:
+  """The two-window "which stage grew" table (/v1/anatomy?diff=W)."""
+  recent = payload.get("recent") or {}
+  prev = payload.get("previous") or {}
+  lines = [
+    f"diff over {payload.get('window_s')}s windows: "
+    f"recent n={recent.get('n')} vs previous n={prev.get('n')}",
+    f"{'stage':<24} {'previous':>10} {'recent':>10} {'delta':>10}",
+  ]
+  deltas = payload.get("delta") or {}
+  for name, d in sorted(deltas.items(), key=lambda kv: -kv[1]):
+    lines.append(f"{name:<24} {_fmt_s((prev.get('stages') or {}).get(name)):>10} "
+                 f"{_fmt_s((recent.get('stages') or {}).get(name)):>10} "
+                 f"{'+' if d >= 0 else ''}{_fmt_s(abs(d)) if d >= 0 else '-' + _fmt_s(abs(d))}")
+  grown = payload.get("grown")
+  lines.append(f"grown: {grown if grown else '(no stage grew / empty window)'}")
+  return "\n".join(lines)
+
+
+def render(payload: Dict[str, Any]) -> str:
+  """Dispatch on payload shape: one breakdown, a diff, or the percentile
+  rollup."""
+  if "grown" in payload or "delta" in payload:
+    return render_diff(payload)
+  if "e2e_s" in payload:
+    return render_breakdown(payload)
+  return render_percentiles(payload)

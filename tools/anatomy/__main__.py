@@ -1,0 +1,77 @@
+"""CLI: fetch and render a node's latency anatomy.
+
+  python -m tools.anatomy http://127.0.0.1:52415
+  python -m tools.anatomy http://127.0.0.1:52415 --request-id <rid>
+  python -m tools.anatomy http://127.0.0.1:52415 --diff 300
+  python -m tools.anatomy http://127.0.0.1:52415 --chrome trace.json [--trace-id ID]
+  python -m tools.anatomy saved_anatomy.json      # render a saved payload
+
+The `--chrome` export plus Perfetto is the two-command postmortem workflow
+documented in README "Latency anatomy".
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+if str(REPO) not in sys.path:
+  sys.path.insert(0, str(REPO))
+
+from tools.anatomy import render
+
+
+def _fetch(url: str, timeout: float = 10.0) -> dict:
+  with urllib.request.urlopen(url, timeout=timeout) as r:
+    return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+    prog="python -m tools.anatomy",
+    description="Render a node's /v1/anatomy latency breakdown")
+  parser.add_argument("source", help="node base URL (http://host:port) or a saved JSON payload")
+  parser.add_argument("--request-id", help="render ONE request's breakdown")
+  parser.add_argument("--diff", type=float, metavar="SECONDS",
+                      help="two-window 'which stage grew' diff")
+  parser.add_argument("--chrome", metavar="OUT",
+                      help="save the skew-corrected Chrome trace export (Perfetto-loadable)")
+  parser.add_argument("--trace-id", help="restrict --chrome to one trace")
+  parser.add_argument("--json", action="store_true", help="print raw JSON instead of a table")
+  args = parser.parse_args(argv)
+
+  if args.source.startswith(("http://", "https://")):
+    base = args.source.rstrip("/")
+    if args.chrome:
+      query = {"format": "chrome"}
+      if args.trace_id:
+        query["trace_id"] = args.trace_id
+      payload = _fetch(f"{base}/v1/traces?{urllib.parse.urlencode(query)}")
+      Path(args.chrome).write_text(json.dumps(payload) + "\n")
+      print(f"wrote {len(payload.get('traceEvents') or [])} trace events to {args.chrome} "
+            "(load in https://ui.perfetto.dev or chrome://tracing)")
+      return 0
+    if args.request_id:
+      url = f"{base}/v1/anatomy?request_id={urllib.parse.quote(args.request_id)}"
+    elif args.diff is not None:
+      url = f"{base}/v1/anatomy?diff={args.diff:g}"
+    else:
+      url = f"{base}/v1/anatomy"
+    try:
+      payload = _fetch(url)
+    except Exception as e:
+      print(f"fetch {url} failed: {e}", file=sys.stderr)
+      return 2
+  else:
+    payload = json.loads(Path(args.source).read_text())
+
+  print(json.dumps(payload, indent=1) if args.json else render(payload))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

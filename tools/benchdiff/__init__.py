@@ -182,7 +182,16 @@ _SOAK_INFO = frozenset({
   # Raw firing counts depend on the fault schedule (a kill is SUPPOSED to
   # fire the error-rate rule), so magnitude drift is informational.
   "alert_firings_total", "alerts_fired_and_resolved",
+  # Latency-anatomy shape: reservoir depth varies with load; the
+  # unattributed share is gated ABSOLUTELY below (_ANATOMY_MAX_UNATTRIBUTED)
+  # rather than by drift, so both report as info in diffs.
+  "anatomy_breakdowns", "anatomy_unattributed_share",
 })
+
+# A committed green soak whose stage breakdowns leave more than this
+# fraction of e2e unattributed is not evidence — the anatomy can't say
+# where the time went, so it must not sit in the tree as the record.
+_ANATOMY_MAX_UNATTRIBUTED = 0.5
 
 
 def _direction(name: str) -> str:
@@ -343,6 +352,14 @@ def _soak_findings(name: str, rec: Dict[str, Any]) -> List[str]:
       v = metrics.get(zero_key)
       if _is_number(v) and v > 0 and verdict == "green":
         findings.append(f"{name}: metrics[{zero_key}]={v} contradicts the green verdict")
+    # Stage-breakdown honesty: a green file carrying an anatomy section
+    # must ATTRIBUTE the time it reports (absolute bound, not drift).
+    share = metrics.get("anatomy_unattributed_share")
+    if _is_number(share) and share > _ANATOMY_MAX_UNATTRIBUTED and verdict == "green":
+      findings.append(
+        f"{name}: metrics[anatomy_unattributed_share]={share} exceeds the "
+        f"{_ANATOMY_MAX_UNATTRIBUTED:g} bound — the stage breakdown cannot say "
+        "where the time went")
   return findings
 
 

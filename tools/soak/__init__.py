@@ -53,11 +53,25 @@ SCHEMA = "xot-soak-v1"
 #   apples-to-apples twin of client e2e (first touch ≈ HTTP arrival) and
 #   supports the two-sided check — provided the client sample also counts
 #   errored requests, because the server family records "any outcome".
+# - `token_seconds` is observed at the sampler per appended token; the
+#   client sample is the raw inter-chunk gap list of ok STREAMED requests
+#   (same per-token shape — a per-request mean would be a different
+#   distribution), and the gap additionally contains broadcast, HTTP, and
+#   SSE framing, so only the one-sided invariant holds: the server may not
+#   report MORE per-token time than clients measured (plus bucket
+#   quantization). MEDIAN ONLY: the server histogram also counts tokens of
+#   requests the client recorded as ERRORS (a kill window's retry storms),
+#   so the tails are structurally incomparable — p50 is robust to that
+#   contamination, the upper percentiles are not.
 RECONCILE_FAMILIES = (
   ("ttft_seconds", "ttft_s", "one_sided"),
   ("request_seconds", "e2e_s", "two_sided"),
+  ("token_seconds", "tpot_s", "one_sided"),
 )
 QUANTILES = (0.5, 0.95, 0.99)
+# Per-family quantile restriction for the reconciliation rows (default:
+# all of QUANTILES). See the token_seconds note above.
+RECONCILE_QUANTILES = {"token_seconds": (0.5,)}
 
 
 def percentile(samples: Sequence[float], q: float) -> Optional[float]:
@@ -155,7 +169,7 @@ def reconcile(client: Dict[str, dict], server: Dict[str, dict],
   for family, client_key, mode in RECONCILE_FAMILIES:
     c = client.get(client_key) or {}
     s = server.get(family) or {}
-    for q in QUANTILES:
+    for q in RECONCILE_QUANTILES.get(family, QUANTILES):
       key = f"p{int(q * 100)}"
       cv, sv = c.get(key), s.get(key)
       row: Dict[str, Any] = {"client_s": cv, "server_s": sv, "mode": mode}
@@ -240,6 +254,23 @@ def summarize_alerts(alerts: Optional[dict],
   an eviction prunes a dead peer's compact from later scrapes, so the
   settle scrape alone could lose a firing that happened on it."""
   return classify_alert_firings(alert_rows_of(alerts), fault_windows)
+
+
+def summarize_anatomy(anatomy: Optional[dict]) -> Optional[Dict[str, Any]]:
+  """The report's stage-breakdown section from one /v1/anatomy scrape on
+  the API node: per-stage mean/percentile contributions plus the
+  unattributed share benchdiff zero-tolerance-gates on committed green
+  files (a green soak whose breakdowns can't attribute most of the time is
+  lying about where it went). None when the node served no anatomy."""
+  if not isinstance(anatomy, dict) or not anatomy.get("stages"):
+    return None
+  stages = anatomy["stages"]
+  unattr = stages.get("unattributed") or {}
+  return {
+    "breakdowns": anatomy.get("breakdowns", 0),
+    "stages": stages,
+    "unattributed_share_mean": float(unattr.get("share_mean") or 0.0),
+  }
 
 
 def classify_aborts(abort_events: Iterable[dict],
@@ -333,6 +364,11 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
       alerts.get("outside_fault_windows", 0))
     out["alerts_fired_and_resolved"] = float(
       alerts.get("fired_and_resolved_in_window", 0))
+  anatomy = report.get("anatomy")
+  if anatomy is not None:
+    out["anatomy_breakdowns"] = float(anatomy.get("breakdowns") or 0)
+    out["anatomy_unattributed_share"] = float(
+      anatomy.get("unattributed_share_mean") or 0.0)
   return out
 
 

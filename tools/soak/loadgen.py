@@ -105,6 +105,10 @@ class ClientRecord:
   e2e_s: Optional[float] = None
   content_len: int = 0
   chunks: int = 0
+  # Raw inter-chunk gaps (seconds): the per-token-shaped client sample the
+  # TPOT reconciliation compares against the server's `xot_token_seconds`
+  # histogram — a per-request MEAN (tpot_s) is a different distribution.
+  tpot_gaps: List[float] = field(default_factory=list)
 
 
 @dataclass
@@ -179,6 +183,7 @@ async def _do_request(session, port: int, plan: LoadPlan, rec: ClientRecord,
       rec.e2e_s = time.monotonic() - t0
       if len(chunk_times) >= 2:
         rec.tpot_s = (chunk_times[-1] - chunk_times[0]) / (len(chunk_times) - 1)
+        rec.tpot_gaps = [b - a for a, b in zip(chunk_times, chunk_times[1:])]
       rec.ok = done and rec.error is None and rec.status == 200 and rec.content_len > 0
       if not rec.ok and rec.error is None:
         rec.error = f"stream ended early (done={done}, content={rec.content_len})"

@@ -130,6 +130,11 @@ class SoakRing:
     self.last_cluster: Optional[dict] = None
     self.last_perf: Optional[dict] = None
     self.last_alerts: Optional[dict] = None
+    self.last_anatomy: Optional[dict] = None
+    # Where children spool their flight ring on SIGTERM (teardown): a
+    # terminated node's evidence survives the process instead of relying
+    # only on its last-good scrape. Set by spawn().
+    self.dump_dir: Optional[Path] = None
     # Firing rows accumulated across every /v1/alerts scrape, keyed by
     # alert identity: peer eviction PRUNES a dead node's compact from
     # later scrapes, so the settle scrape alone could lose a firing that
@@ -139,6 +144,8 @@ class SoakRing:
 
   def spawn(self, log_dir: Path) -> None:
     from tests.xproc_harness import spawn_node
+    self.dump_dir = log_dir / "flight_dumps"
+    self.dump_dir.mkdir(parents=True, exist_ok=True)
     for i, name in enumerate(self.names):
       self.ports[name] = self.cfg.api_base + i
       self.logs[name] = open(log_dir / f"{name}.log", "w")
@@ -147,6 +154,7 @@ class SoakRing:
         self.cfg.grpc_base + i, self.logs[name], model=self.cfg.model,
         response_timeout=180,
         extra_env={"XOT_REQUEST_RESTARTS": str(self.cfg.restarts),
+                   "XOT_FLIGHT_DUMP_DIR": str(self.dump_dir),
                    **self.cfg.alert_env},
       )
 
@@ -206,6 +214,11 @@ class SoakRing:
       perf = self.get_json(api, "/v1/perf")
       if perf is not None:
         self.last_perf = perf
+      # The origin's latency-anatomy rollup: stage-contribution
+      # percentiles over its reservoir of skew-corrected breakdowns.
+      anatomy = self.get_json(api, "/v1/anatomy")
+      if anatomy is not None:
+        self.last_anatomy = anatomy
       # The cluster-rolled alert view: node 0 sees every peer's active +
       # recent alerts via the status bus, so one scrape covers the ring.
       alerts = self.get_json(api, "/v1/alerts")
@@ -228,6 +241,28 @@ class SoakRing:
   def teardown(self) -> None:
     from tests.xproc_harness import teardown_nodes
     teardown_nodes(self.procs, self.logs)
+
+  def collect_flight_dumps(self) -> Dict[str, dict]:
+    """Parse the post-mortem spool: {node_id: dump} from every
+    `flight_*.json` a SIGTERM'd child wrote to the dump dir. Children dump
+    at teardown (and on any external SIGTERM); a SIGKILLed node can write
+    nothing — its last-good scrape stays its only record."""
+    return collect_flight_dumps(self.dump_dir)
+
+
+def collect_flight_dumps(dump_dir: Optional[Path]) -> Dict[str, dict]:
+  out: Dict[str, dict] = {}
+  if not dump_dir:
+    return out
+  for path in sorted(Path(dump_dir).glob("flight_*.json")):
+    try:
+      dump = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+      continue
+    node_id = dump.get("node_id")
+    if node_id:
+      out[str(node_id)] = dump
+  return out
 
 
 def _sum_counter(metrics_by_node: Dict[str, Dict[str, float]], name: str) -> float:
@@ -407,8 +442,15 @@ async def run_soak(cfg: SoakConfig) -> dict:
     except OSError as e:
       print(f"soak: writing alerts_settle.json failed: {e!r}", file=sys.stderr)
 
+    # Tear the ring down BEFORE assembling the report: children spool
+    # their flight rings on SIGTERM (XOT_FLIGHT_DUMP_DIR), and the dumps
+    # are post-mortem evidence the report merges with the last-good
+    # scrapes. The finally-teardown below is then an idempotent no-op.
+    await loop.run_in_executor(None, ring.teardown)
+    dumps = ring.collect_flight_dumps()
+
     report = _build_report(cfg, ring, records, windows, base_cluster, base_metrics,
-                           settle_a, settle_b, drained, t_wall_start)
+                           settle_a, settle_b, drained, t_wall_start, dumps=dumps)
     verdicts.evaluate(report)
     if cfg.out:
       verdicts.write_report(report, cfg.out)
@@ -419,7 +461,8 @@ async def run_soak(cfg: SoakConfig) -> dict:
 
 def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
                   base_cluster, base_metrics, settle_a, settle_b,
-                  drained: bool, t_wall_start: float) -> dict:
+                  drained: bool, t_wall_start: float,
+                  dumps: Optional[Dict[str, dict]] = None) -> dict:
   ok_recs = [r for r in records if r.ok]
   err_recs = [r for r in records if not r.ok]
   # The server's request_seconds family records "any outcome" (finish OR
@@ -445,7 +488,12 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
     "rps_target": cfg.rate_rps,
     "rps_achieved": round(len(records) / cfg.seconds, 4) if cfg.seconds else None,
     "ttft_s": verdicts.latency_summary([r.ttft_s for r in ok_recs if r.ttft_s is not None]),
-    "tpot_s": verdicts.latency_summary([r.tpot_s for r in ok_recs if r.tpot_s is not None]),
+    # Raw per-gap samples, not per-request means: the server's
+    # token_seconds family is per-token, so the client sample must be too.
+    "tpot_s": verdicts.latency_summary(
+      [g for r in ok_recs for g in (getattr(r, "tpot_gaps", None) or [])]),
+    "tpot_request_mean_s": verdicts.latency_summary(
+      [r.tpot_s for r in ok_recs if r.tpot_s is not None]),
     "e2e_s": verdicts.latency_summary(e2e_all),
     "e2e_ok_s": verdicts.latency_summary([r.e2e_s for r in ok_recs if r.e2e_s is not None]),
     "error_samples": [r.error for r in err_recs[:5]],
@@ -476,7 +524,21 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   if ring.last_perf is not None:
     server["perf"] = {k: ring.last_perf.get(k) for k in ("gauges", "dispatch") if k in ring.last_perf}
 
-  events = _abort_events(ring.last_flight)
+  # Abort evidence: last-good scrapes MERGED with the post-mortem dumps —
+  # a terminated node's frozen snapshots survive teardown even when its
+  # final scrape was missed (killed nodes still rely on last-good).
+  flight_evidence = {n: dict(f) for n, f in ring.last_flight.items()}
+  for node_id, dump in (dumps or {}).items():
+    row = flight_evidence.setdefault(node_id, {})
+    have = {(s.get("request_id"), s.get("reason"), s.get("frozen_at"))
+            for s in row.get("snapshots") or []}
+    merged = list(row.get("snapshots") or [])
+    for snap in dump.get("snapshots") or []:
+      key = (snap.get("request_id"), snap.get("reason"), snap.get("frozen_at"))
+      if key not in have:
+        merged.append(snap)
+    row["snapshots"] = merged
+  events = _abort_events(flight_evidence)
   aborts = verdicts.classify_aborts(events, windows)
   aborts["unattributed"] = max(0, int(server["watchdog_aborts"]) - len(events))
   # Classify the accumulated superset, not just the settle scrape: a
@@ -504,6 +566,12 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
     "reconciliation": verdicts.reconcile(client, server, cfg.recon_tol_s),
     "aborts": aborts,
     "alerts": alerts,
+    "anatomy": verdicts.summarize_anatomy(ring.last_anatomy),
+    "flight_dumps": {
+      node_id: {"reason": d.get("reason"), "events": len(d.get("events") or ()),
+                "snapshots": len(d.get("snapshots") or ())}
+      for node_id, d in (dumps or {}).items()
+    },
     "leaks": verdicts.leak_check(settle_a, settle_b),
     "drained": drained,
   }

@@ -147,6 +147,10 @@ class ChatGPTAPI:
     # with burn rates and degraded-peer scores, cluster-rolled like
     # peer_metrics so one scrape sees every node's firing alerts.
     r.add_get("/v1/alerts", self.handle_get_alerts)
+    # Critical-path latency anatomy: skew-corrected per-request stage
+    # breakdowns, ring-wide per-stage percentiles, and the "which stage
+    # grew" two-window diff (orchestration/anatomy.py).
+    r.add_get("/v1/anatomy", self.handle_get_anatomy)
     # Runtime fault-injector control (test/soak only, like /quit): lets the
     # soak orchestrator drive wall-clock drop/delay/kill phases in a child
     # process AFTER spawn — XOT_FAULT_SPEC can only be set at startup.
@@ -219,11 +223,57 @@ class ChatGPTAPI:
 
   async def handle_get_traces(self, request):
     """Finished spans, OTLP-style JSON. ?trace_id= filters one trace;
-    ?clear=1 drains the buffer after reading."""
+    ?clear=1 drains the buffer after reading; ?format=chrome re-bases the
+    assembled spans onto THIS node's clock (estimated ring offsets) and
+    returns Chrome trace-event JSON loadable in Perfetto/chrome://tracing."""
     trace_id = request.query.get("trace_id")
     clear = request.query.get("clear") == "1"
     spans = self.node.tracer.export(trace_id=trace_id, clear=clear)
+    if request.query.get("format") == "chrome":
+      from xotorch_tpu.orchestration.anatomy import chrome_trace
+      offsets = self.node.ring_offsets_view()
+      return web.json_response({
+        "traceEvents": chrome_trace(spans, offsets),
+        "displayTimeUnit": "ms",
+        "otherData": {"node_id": self.node.id,
+                      # Corrected only when some PEER's offset was solved —
+                      # the origin's own zero entry is always present.
+                      "skew_corrected": any(nid != self.node.id for nid in offsets)},
+      })
     return web.json_response({"spans": spans, "count": len(spans)})
+
+  async def handle_get_anatomy(self, request):
+    """Latency anatomy. No params: per-stage contribution percentiles over
+    the origin's reservoir of skew-corrected breakdowns, plus the current
+    ring clock offsets. `?request_id=` serves one request's full breakdown
+    (404 when none was assembled). `?diff=<seconds>` answers "which stage
+    grew" between the last window and the one before it."""
+    store = self.node.anatomy
+    rid = request.query.get("request_id")
+    if rid:
+      b = store.get(rid)
+      if b is None:
+        return web.json_response(
+          {"detail": f"no anatomy breakdown assembled for request {rid}"}, status=404)
+      return web.json_response(b)
+    diff = request.query.get("diff")
+    if diff is not None:
+      try:
+        window_s = float(diff)
+      except ValueError:
+        return web.json_response(
+          {"detail": f"diff must be a window in seconds, got {diff!r}"}, status=400)
+      return web.json_response({"node_id": self.node.id, **store.diff(window_s)})
+    offsets = self.node.ring_offsets_view()
+    return web.json_response({
+      "node_id": self.node.id,
+      "enabled": store.enabled,
+      "breakdowns": len(store.recent()),
+      "total": store.total,
+      "stages": store.percentiles(),
+      "offsets": offsets,
+      "recent_requests": [b.get("request_id") for b in store.recent(16)],
+    })
 
   async def handle_get_flight(self, request):
     """Flight-recorder postmortems. No params: every frozen snapshot plus
@@ -460,6 +510,30 @@ class ChatGPTAPI:
                      "# TYPE xot_peer_hop_seconds gauge\n")
         for pid, value in sorted(hops.items()):
           extra.append(f'xot_peer_hop_seconds{{peer="{pid}"}} {value}\n')
+    # Latency-anatomy gauges (XOT_ANATOMY, default on): reservoir depth,
+    # the mean unattributed share of recent breakdowns (the honesty gauge
+    # benchdiff gates on committed soak files), and each peer's estimated
+    # clock offset relative to this node.
+    anat = getattr(self.node, "anatomy", None)
+    if anat is not None and anat.enabled:
+      astats = anat.gauge_stats()
+      for key, name, help_text in (
+        ("breakdowns", "xot_anatomy_breakdowns",
+         "Skew-corrected stage breakdowns currently held in the anatomy reservoir"),
+        ("unattributed_share", "xot_anatomy_unattributed_share",
+         "Mean unattributed fraction of recent latency breakdowns (0 = fully attributed)"),
+      ):
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {astats[key]}\n")
+      offsets = self.node.ring_offsets_view()
+      rows = {nid: o for nid, o in offsets.items() if nid != self.node.id}
+      if rows:
+        extra.append("# HELP xot_clock_offset_seconds Estimated clock offset of "
+                     "each ring peer relative to this node (latency anatomy)\n"
+                     "# TYPE xot_clock_offset_seconds gauge\n")
+        for pid, off in sorted(rows.items()):
+          extra.append(
+            f'xot_clock_offset_seconds{{peer="{pid}"}} '
+            f'{round(float(off.get("offset_ns") or 0.0) / 1e9, 6)}\n')
     if extra:
       body = body + "".join(extra).encode()
     # aiohttp's content_type kwarg rejects parameters; set the full

@@ -337,9 +337,21 @@ async def async_main(args) -> None:
     await seed_models(args.models_seed_dir)
   node, engine, engine_classname, api, topology_viz = build_node(args)
   loop = asyncio.get_running_loop()
+  def _on_exit_signal(s):
+    # Post-mortem spool BEFORE teardown churns state: with
+    # XOT_FLIGHT_DUMP_DIR set, the flight ring + frozen snapshots land on
+    # disk so a terminated node's evidence survives the process (the soak
+    # orchestrator collects these instead of relying on last-good scrapes).
+    try:
+      node.spool_flight(reason=f"signal:{getattr(s, 'name', s)}")
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"flight spool on {s} failed: {e!r}")
+    spawn_detached(shutdown(s, loop, node.server))
+
   for sig in (signal.SIGINT, signal.SIGTERM):
     try:
-      loop.add_signal_handler(sig, lambda s=sig: spawn_detached(shutdown(s, loop, node.server)))
+      loop.add_signal_handler(sig, lambda s=sig: _on_exit_signal(s))
     except NotImplementedError:
       pass
 

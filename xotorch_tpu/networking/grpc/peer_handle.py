@@ -173,12 +173,16 @@ class GRPCPeerHandle(PeerHandle):
     seq = faults.hop_seq()
     if self.flight is not None:
       self.flight.record("hop.send", request_id, rpc="SendPrompt", peer=self._id, seq=seq)
-    t0 = time.monotonic()
-    await self._call("SendPrompt", {
+    fields = {
       "shard": shard.to_dict(), "prompt": prompt, "request_id": request_id, "traceparent": traceparent,
       "max_tokens": max_tokens, "n_images": len(tensors) or None, "temperature": temperature,
       "top_p": top_p, "ring_map": ring_map, "deadline": deadline, "hop_seq": seq,
-    }, tensors or None)
+    }
+    clk = self.hop_clock_stamp()
+    if clk is not None:
+      fields["clock"] = clk
+    t0 = time.monotonic()
+    await self._call("SendPrompt", fields, tensors or None)
     self.note_hop_rtt(time.monotonic() - t0)
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: Optional[str] = None,
@@ -186,13 +190,13 @@ class GRPCPeerHandle(PeerHandle):
     seq = faults.hop_seq()
     if self.flight is not None:
       self.flight.record("hop.send", request_id, rpc="SendTensor", peer=self._id, seq=seq)
+    fields = {"shard": shard.to_dict(), "request_id": request_id,
+              "inference_state": inference_state, "hop_seq": seq}
+    clk = self.hop_clock_stamp()
+    if clk is not None:
+      fields["clock"] = clk
     t0 = time.monotonic()
-    await self._call(
-      "SendTensor",
-      {"shard": shard.to_dict(), "request_id": request_id, "inference_state": inference_state,
-       "hop_seq": seq},
-      {"tensor": tensor},
-    )
+    await self._call("SendTensor", fields, {"tensor": tensor})
     self.note_hop_rtt(time.monotonic() - t0)
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray,

@@ -65,11 +65,22 @@ class GRPCServer(Server):
     seq = fields.get("hop_seq")
     return seq is not None and not self.node.note_hop_delivery(fields.get("request_id"), seq)
 
+  def _note_hop_clock(self, fields: dict) -> None:
+    """Feed the sender's wall-clock stamp to the receiving node's skew
+    estimator. BEFORE dedup on purpose: a retried delivery's stamp is a
+    valid (if backoff-inflated) sample the min filter handles."""
+    clk = fields.get("clock")
+    if clk is not None:
+      clock = getattr(self.node, "clock", None)
+      if clock is not None:
+        clock.note(clk)
+
   async def _rpc_send_prompt(self, request: bytes, context) -> bytes:
     # Ack immediately and process in the background: a ring hop's RPC must
     # not stay open for the remainder of the generation (the chain would
     # otherwise exceed any sane deadline and couple peer lifetimes).
     fields, tensors = decode_message(request)
+    self._note_hop_clock(fields)
     if self._is_duplicate_hop(fields):
       return encode_message({"ok": True, "dup": True})
     shard = Shard.from_dict(fields["shard"])
@@ -84,6 +95,7 @@ class GRPCServer(Server):
 
   async def _rpc_send_tensor(self, request: bytes, context) -> bytes:
     fields, tensors = decode_message(request)
+    self._note_hop_clock(fields)
     if self._is_duplicate_hop(fields):
       return encode_message({"ok": True, "dup": True})
     shard = Shard.from_dict(fields["shard"])

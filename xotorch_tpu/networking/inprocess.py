@@ -41,6 +41,14 @@ class InProcessPeerHandle(PeerHandle):
   def _spawn(self, coro) -> None:
     spawn_detached(coro, self._tasks)
 
+  def _note_clock(self, stamp) -> None:
+    """Deliver the sender's clock stamp to the TARGET node's skew estimator
+    (the in-process analogue of the gRPC server's receive-side note)."""
+    if stamp is not None:
+      clock = getattr(self.node, "clock", None)
+      if clock is not None:
+        clock.note(stamp)
+
   def id(self) -> str:
     return self.node.id
 
@@ -78,9 +86,17 @@ class InProcessPeerHandle(PeerHandle):
     seq = faults.hop_seq()
     if self.flight is not None:
       self.flight.record("hop.send", request_id, rpc="SendPrompt", peer=self.node.id, seq=seq)
+    # Stamp once, like the gRPC frame: a retried delivery must carry the
+    # identical (possibly stale) stamp — the receiver's min filter copes.
+    clk = self.hop_clock_stamp()
 
     async def attempt():
       flags = await faults.apply("SendPrompt", self.node.id)
+      if not flags["sink"]:
+        # After the sink check, like the gRPC path never sends a sunk
+        # frame: a "silently lost" delivery must not feed the receiver's
+        # skew estimator either.
+        self._note_clock(clk)
       if not flags["sink"] and self.node.note_hop_delivery(request_id, seq):
         self._spawn(self.node.process_prompt(
           shard, prompt, request_id, traceparent=traceparent, max_tokens=max_tokens, images=images,
@@ -100,9 +116,12 @@ class InProcessPeerHandle(PeerHandle):
     seq = faults.hop_seq()
     if self.flight is not None:
       self.flight.record("hop.send", request_id, rpc="SendTensor", peer=self.node.id, seq=seq)
+    clk = self.hop_clock_stamp()
 
     async def attempt():
       flags = await faults.apply("SendTensor", self.node.id)
+      if not flags["sink"]:
+        self._note_clock(clk)
       if not flags["sink"] and self.node.note_hop_delivery(request_id, seq):
         self._spawn(self.node.process_tensor(shard, tensor, request_id, inference_state))
       if flags["lost_ack"]:

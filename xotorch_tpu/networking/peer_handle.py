@@ -59,11 +59,22 @@ class PeerHandle(ABC):
   # and backoff — the honest "how long did handing this peer a tensor
   # take" number the localization scorer needs.
   hop_rtt: Optional[HopRttEwma] = None
+  # Owning node's ClockSkew collector (attached at peer-set assignment like
+  # `flight`): hop sends stamp the SENDER's wall-clock ns into the optional
+  # `clock` field so receivers can estimate per-peer clock offsets
+  # (orchestration/anatomy.py). None until a node adopts the handle;
+  # standalone handles send no stamps.
+  clock = None
 
   def note_hop_rtt(self, secs: float) -> None:
     if self.hop_rtt is None:
       self.hop_rtt = HopRttEwma(knobs.get_float("XOT_ALERT_RTT_TAU_S"))
     self.hop_rtt.observe(secs)
+
+  def hop_clock_stamp(self) -> Optional[dict]:
+    """The sender's wall-clock stamp for this hop, or None (the field stays
+    off the wire entirely — XOT_ANATOMY=0 must add zero bytes)."""
+    return self.clock.stamp() if self.clock is not None else None
 
   @abstractmethod
   def id(self) -> str:

@@ -279,6 +279,13 @@ class AlertEngine:
         if st["state"] == "pending" and now - st["since"] >= self.pending_s:
           st["state"], st["fired_at"] = "firing", wall
           st["localization"] = self.localization()
+          if rule.kind == "latency":
+            # Per-stage evidence next to the EWMA-level `suspect`: the
+            # current skew-corrected stage breakdown (where recent
+            # requests' time actually went — orchestration/anatomy.py).
+            anat = getattr(self.node, "anatomy", None)
+            if anat is not None and anat.enabled:
+              st["anatomy"] = anat.stage_summary()
           if flight is not None:
             flight.record("alert.firing", None, rule=st["rule"], family=st["family"],
                           burn_fast=st["burn_fast"], burn_slow=st["burn_slow"],
@@ -301,9 +308,11 @@ class AlertEngine:
             "rule": rule.name, "family": st["family"],
             "fired_at": st["fired_at"], "resolved_at": wall,
             "localization": st.get("localization"),
+            "anatomy": st.get("anatomy"),
           })
           st.update(state="inactive", since=None, fired_at=None, last_true=None)
           st.pop("localization", None)
+          st.pop("anatomy", None)
           transitions.append({"rule": rule.name, "to": "resolved", "at": now})
     return transitions
 
@@ -385,6 +394,8 @@ class AlertEngine:
                               "burn_fast", "burn_slow", "target")}
     if st.get("localization") is not None:
       row["localization"] = st["localization"]
+    if st.get("anatomy") is not None:
+      row["anatomy"] = st["anatomy"]
     return row
 
   def active(self) -> List[dict]:

@@ -74,6 +74,11 @@ EVENTS = (
   "alert.firing",
   "alert.resolved",
   "alert.cancelled",
+  # critical-path latency anatomy (orchestration/anatomy.py via node.py):
+  # one event per assembled skew-corrected breakdown, so a frozen snapshot
+  # shows which requests had their anatomy extracted and how much of each
+  # went unattributed.
+  "anatomy.breakdown",
 )
 
 _EVENT_SET = frozenset(EVENTS)
@@ -178,3 +183,35 @@ class FlightRecorder:
         "snapshot_count": len(self._snapshots),
         "capacity": self._ring.maxlen,
       }
+
+  # -------------------------------------------------------------- post-mortem
+
+  def dump_to(self, dir_path, reason: str = "") -> "Optional[str]":
+    """Spool the live ring + every frozen snapshot to
+    `<dir>/flight_<node_id>_<pid>.json` (post-mortem: a SIGTERM'd node's
+    evidence survives the process instead of dying with the last-good
+    scrape). Data is copied under the lock; file I/O happens outside it.
+    Returns the written path, or None when recording is disabled or the
+    write failed (best-effort — a dump must never turn shutdown into a
+    crash)."""
+    if not self.enabled:
+      return None
+    import json
+    import os
+    from pathlib import Path
+    with self._lock:
+      payload = {
+        "node_id": self.node_id,
+        "reason": reason,
+        "dumped_at": time.time(),
+        "events": [self._to_dict(e) for e in self._ring],
+        "snapshots": list(self._snapshots.values()),
+      }
+    try:
+      out_dir = Path(dir_path)
+      out_dir.mkdir(parents=True, exist_ok=True)
+      path = out_dir / f"flight_{self.node_id or 'node'}_{os.getpid()}.json"
+      path.write_text(json.dumps(payload) + "\n")
+      return str(path)
+    except OSError:
+      return None

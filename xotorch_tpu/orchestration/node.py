@@ -41,6 +41,9 @@ from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES
 from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitions_to_shards
 from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
 from xotorch_tpu.orchestration.alerts import AlertEngine
+from xotorch_tpu.orchestration.anatomy import (
+  AnatomyStore, ClockSkew, extract_breakdown, ring_offsets,
+)
 from xotorch_tpu.orchestration.metrics import NodeMetrics, aggregate_histograms
 from xotorch_tpu.orchestration.flight import FlightRecorder
 from xotorch_tpu.topology.topology import Topology
@@ -300,6 +303,22 @@ class Node:
     # status bus via metrics_summary().
     self.alerts = AlertEngine(self)
     self._alert_task: Optional[asyncio.Task] = None
+    # Critical-path latency anatomy (XOT_ANATOMY, default on): per-peer
+    # clock-skew estimation fed by hop clock stamps (receive side:
+    # note via `self.clock`; send side: peer handles adopt `self.clock` at
+    # peer-set assignment, like `flight`), plus a bounded reservoir of
+    # skew-corrected per-request stage breakdowns assembled at the ORIGIN
+    # once the ring's trace shards arrive. Served at /v1/anatomy.
+    self.clock = ClockSkew(self.id)
+    # Spans stamp through the same (possibly skew-injected) wall clock as
+    # the hop stamps, so XOT_ANATOMY_SKEW_NS simulates a skewed host end
+    # to end — spans drift exactly as far as the stamps that correct them.
+    self.tracer.now_ns = self.clock.wall_ns
+    self.anatomy = AnatomyStore()
+    self._anatomy_delay_s = max(0.0, knobs.get_float("XOT_ANATOMY_DELAY_S"))
+    # Requests THIS node originated (bounded LRU): only the origin holds
+    # the rolled-up trace, so only it assembles the breakdown.
+    self._anatomy_origin: "OrderedDict[str, None]" = OrderedDict()
 
   def _spawn(self, coro) -> "asyncio.Task":
     return spawn_detached(coro, self._detached_tasks)
@@ -660,6 +679,12 @@ class Node:
       # Count only origin requests: a forwarded prompt re-enters process_prompt
       # on the partition-0 owner and would double the cluster-wide sum.
       self.metrics.requests_total.inc()
+      if self.anatomy.enabled:
+        # Only the origin assembles anatomy: it holds the rolled-up trace.
+        self._anatomy_origin[request_id] = None
+        self._anatomy_origin.move_to_end(request_id)
+        while len(self._anatomy_origin) > 512:
+          self._anatomy_origin.popitem(last=False)
     # A forwarded prompt carries the origin node's trace context; joining it
     # keeps one trace per request across the ring (reference tracing.py:36-70).
     parent_ctx = TraceContext.from_traceparent(traceparent)
@@ -1733,8 +1758,10 @@ class Node:
                   if not self._is_evicted(p.id())]
     for p in self.peers:
       # Hand each peer handle this node's flight recorder so hop.send events
-      # (with their dedup seq ids) land in the SENDER's timeline.
+      # (with their dedup seq ids) land in the SENDER's timeline, and the
+      # clock collector so hop sends carry this node's wall stamp.
       p.flight = self.flight
+      p.clock = self.clock
     self.metrics.peers.set(len(self.peers))
     return bool(peers_added or peers_removed)
 
@@ -1835,6 +1862,15 @@ class Node:
         self._spawn(self._flush_trace_spans(request_id, ctx.trace_id))
       except RuntimeError:
         pass  # no running event loop (sync harness/test call): skip rollup
+    was_origin = request_id in self._anatomy_origin
+    self._anatomy_origin.pop(request_id, None)
+    if ctx is not None and was_origin and self.anatomy.enabled and self.tracer.enabled:
+      # Origin-only, once per request (the ctx pop above + the origin-set
+      # pop here gate it). Delayed so remote span shards land first.
+      try:
+        self._spawn(self._assemble_anatomy(request_id, ctx.trace_id))
+      except RuntimeError:
+        pass  # no running event loop: anatomy is best-effort in harnesses
     self._last_token_time.pop(request_id, None)
     self._request_max_tokens.pop(request_id, None)
     self._request_temp.pop(request_id, None)
@@ -1969,6 +2005,71 @@ class Node:
       "trace_id": trace_id, "spans": spans,
     }))
 
+  def _peer_hop_rtts(self) -> Dict[str, float]:
+    """This node's hop-RTT EWMA seconds per peer (sender-side view) — the
+    transit bound the skew estimator's one-way edges need."""
+    out: Dict[str, float] = {}
+    for p in self.peers:
+      ewma = getattr(p, "hop_rtt", None)
+      v = ewma.value() if ewma is not None else None
+      if v is not None:
+        out[p.id()] = round(v, 6)
+    return out
+
+  def ring_offsets_view(self) -> Dict[str, dict]:
+    """Every reachable node's clock offset relative to THIS node, from the
+    local skew estimator plus each peer's `clock` summary off the status
+    bus (orchestration/anatomy.ring_offsets)."""
+    clocks: Dict[str, dict] = {self.id: self.clock.deltas()}
+    rtts: Dict[str, Dict[str, float]] = {self.id: self._peer_hop_rtts()}
+    for nid, summary in self.peer_metrics.items():
+      if self.peer_metrics_stale(nid):
+        # Same rule as the cluster metrics aggregate: a dead/wedged peer's
+        # last clock window is history, not signal — solving offsets from
+        # it would silently freeze the correction at the moment it died.
+        continue
+      clk = summary.get("clock") if isinstance(summary, dict) else None
+      if isinstance(clk, dict):
+        clocks[nid] = clk.get("deltas") or {}
+        if isinstance(clk.get("hop_rtt_s"), dict):
+          rtts[nid] = clk["hop_rtt_s"]
+    return ring_offsets(self.id, clocks, rtts)
+
+  async def _assemble_anatomy(self, request_id: str, trace_id: str) -> None:
+    """Origin-side breakdown assembly for one finished request: wait a beat
+    for remote span shards to arrive over the status bus, re-base the
+    assembled trace onto this clock, and reservoir the stage breakdown."""
+    await asyncio.sleep(self._anatomy_delay_s)
+    try:
+      spans = self.tracer.export(trace_id=trace_id)
+      if not spans:
+        return
+      offsets = self.ring_offsets_view()
+      # Off the event loop: a long generation's trace holds thousands of
+      # spans and the sweep is quadratic-ish in them — blocking decode for
+      # every in-flight request at each finish is not acceptable.
+      breakdown = await asyncio.get_running_loop().run_in_executor(
+        None, extract_breakdown, spans, offsets, request_id, trace_id)
+      if breakdown is None:
+        return
+      self.anatomy.add(breakdown)
+      self.flight.record(
+        "anatomy.breakdown", request_id, e2e_s=breakdown["e2e_s"],
+        stages=len(breakdown["stages"]),
+        unattributed_s=breakdown["stages"]["unattributed"]["secs"])
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"[{request_id}] anatomy assembly failed: {e!r}")
+
+  def spool_flight(self, reason: str = "") -> Optional[str]:
+    """Post-mortem spool: dump the flight ring + frozen snapshots to
+    XOT_FLIGHT_DUMP_DIR (no-op when unset) so a SIGTERM'd node's evidence
+    survives the process. Called from the main-loop signal handler."""
+    dump_dir = knobs.get_str("XOT_FLIGHT_DUMP_DIR")
+    if not dump_dir:
+      return None
+    return self.flight.dump_to(dump_dir, reason=reason)
+
   def metrics_summary(self) -> dict:
     """This node's compact metric summary (counters + histogram sum/count)
     for the cluster rollup — what rides the status bus and what
@@ -1976,6 +2077,12 @@ class Node:
     summary = self.metrics.summary()
     summary["node_id"] = self.id
     summary["ts"] = time.time()
+    if self.clock.enabled:
+      # Clock-skew compact: this node's received one-way deltas per sender
+      # plus its sender-side hop RTTs — what lets the ORIGIN solve the
+      # whole ring's offsets (anatomy.ring_offsets) from one rollup.
+      summary["clock"] = {"deltas": self.clock.deltas(),
+                          "hop_rtt_s": self._peer_hop_rtts()}
     # Roofline-attribution compact (engines that expose one): rides the
     # same status-bus broadcast, so /v1/perf on any node rolls up the ring.
     perf_fn = getattr(self.inference_engine, "perf_compact", None)

@@ -72,9 +72,9 @@ class Span:
   # never appended to the export buffer.
   sampled: bool = True
 
-  def end(self, status: str = "OK") -> None:
+  def end(self, status: str = "OK", end_ns: Optional[int] = None) -> None:
     if self.end_ns is None:
-      self.end_ns = time.time_ns()
+      self.end_ns = end_ns if end_ns is not None else time.time_ns()
       self.status = status
 
   def set_attribute(self, key: str, value: Any) -> None:
@@ -137,6 +137,12 @@ class Tracer:
   def __init__(self, node_id: str = "", max_spans: int = 4096):
     self.node_id = node_id
     self.enabled = knobs.get_bool("XOT_TRACING")
+    # The wall clock spans are stamped with. The owning Node rebinds this
+    # to its ClockSkew collector's wall_ns, so an injected artificial skew
+    # (XOT_ANATOMY_SKEW_NS — the offset-recovery test harness) shifts THIS
+    # node's spans and hop stamps together, exactly like a genuinely
+    # skewed host clock would.
+    self.now_ns = time.time_ns
     self._finished: deque = deque(maxlen=max_spans)
     self._lock = threading.Lock()
     self._token_groups: Dict[str, Span] = {}
@@ -160,14 +166,14 @@ class Tracer:
       trace_id=parent.trace_id,
       span_id=secrets.token_hex(8),
       parent_span_id=parent_span_id,
-      start_ns=time.time_ns(),
+      start_ns=self.now_ns(),
       attributes={"node.id": self.node_id, **(attributes or {})},
       sampled=parent.sampled,
     )
     return _SpanHandle(self, span)
 
   def end_span(self, span: Span, status: str = "OK") -> None:
-    span.end(status)
+    span.end(status, end_ns=self.now_ns())
     # W3C `sampled` flag honored for real: an unsampled trace's spans are
     # never buffered (the caller still gets a live span object, so call
     # sites stay unconditional).
@@ -192,7 +198,7 @@ class Tracer:
           trace_id=parent.trace_id,
           span_id=secrets.token_hex(8),
           parent_span_id=ctx.span_id if ctx else None,
-          start_ns=time.time_ns(),
+          start_ns=self.now_ns(),
           attributes={"node.id": self.node_id, "request.id": request_id},
         )
         entry = (group, count)
@@ -201,7 +207,7 @@ class Tracer:
       self._token_counts[request_id] = count + 1
       group.set_attribute("token.count", self._token_counts[request_id] - group_start)
       if self._token_counts[request_id] % _TOKEN_GROUP_SIZE == 0:
-        group.end()
+        group.end(end_ns=self.now_ns())
         self._finished.append(group)
         del self._token_groups[request_id]
 
@@ -212,7 +218,7 @@ class Tracer:
       self._token_counts.pop(request_id, None)
       if entry is not None and self.enabled:
         group, _ = entry
-        group.end()
+        group.end(end_ns=self.now_ns())
         self._finished.append(group)
 
   # ---------------------------------------------------------------- export

@@ -127,6 +127,12 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_FLIGHT", "bool", "1", "Record runtime events in the per-node flight recorder (served at /v1/debug/flight).", "Observability"),
   Knob("XOT_FLIGHT_EVENTS", "int", "4096", "Flight-recorder ring capacity (events).", "Observability"),
   Knob("XOT_FLIGHT_SNAPSHOTS", "int", "16", "Frozen flight-recorder snapshots kept per node (LRU).", "Observability"),
+  Knob("XOT_FLIGHT_DUMP_DIR", "path", None, "Post-mortem spool: on SIGTERM/SIGINT the node dumps its flight ring + frozen snapshots here as JSON; unset disables.", "Observability"),
+  Knob("XOT_ANATOMY", "bool", "1", "Critical-path latency anatomy: hop clock stamps, skew-corrected per-request stage breakdowns (served at /v1/anatomy). 0 removes the clock field from the wire entirely.", "Observability"),
+  Knob("XOT_ANATOMY_RESERVOIR", "int", "256", "Recent stage breakdowns kept per node for /v1/anatomy percentiles and diffs.", "Observability"),
+  Knob("XOT_ANATOMY_CLOCK_WINDOW", "int", "64", "Per-peer window of one-way clock-delta samples the skew estimator min-filters.", "Observability"),
+  Knob("XOT_ANATOMY_DELAY_S", "float", "0.35", "Seconds after a request finishes before the origin assembles its breakdown (lets remote span shards arrive over the status bus).", "Observability"),
+  Knob("XOT_ANATOMY_SKEW_NS", "int", "0", "Test-only: artificial offset (ns) added to this node's anatomy wall clock — the skew-injection point for offset-recovery proofs.", "Observability"),
   Knob("XOT_PERF_ATTR", "bool", "1", "Live roofline attribution: per-dispatch time/bytes/FLOPs accounting served at /v1/perf.", "Observability"),
   Knob("XOT_PERF_EWMA_S", "float", "30", "Time constant (s) of the EWMA throughput/utilization gauges (xot_decode_tok_s and friends).", "Observability"),
   Knob("XOT_DEVICE_TRACE_MAX_S", "float", "120", "Auto-stop a /v1/trace/device/start jax.profiler session after this many seconds; 0 disables the cap.", "Observability"),
