@@ -713,7 +713,14 @@ def _run_spec(model_id: str, prefill_len: int, decode_tokens: int, progress_path
   the token callback — plus the engine's draft accounting. The two greedy
   streams must be IDENTICAL (spec_tokens_verified): speculation may never
   change output, only its rate. Acceptance is data-dependent; whatever the
-  synthetic model's greedy text yields is reported honestly."""
+  synthetic model's greedy text yields is reported honestly.
+
+  BENCH_SPEC_PAGED=1 adds the PAGED A/B (the `specpaged` retry stage): the
+  same on/off pair under XOT_PAGED_KV=1, where verification runs as a T>1
+  ragged query over the request's page table (engine XOT_PAGED_SPEC). All
+  four greedy streams must be byte-identical, and the paged spec-on run
+  must finish with ZERO unpage gathers and ZERO commit-copy bytes — the
+  native-verify acceptance bar, asserted here exactly as in the tests."""
   import asyncio
 
   from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
@@ -727,9 +734,12 @@ def _run_spec(model_id: str, prefill_len: int, decode_tokens: int, progress_path
   words = ("alpha", "beta", "gamma", "delta")
   prompt = " ".join(words[i % len(words)] for i in range(prefill_len))
 
-  async def run_mode(spec: int, tag: str) -> dict:
-    prior = os.environ.get("XOT_SPECULATE")  # restore a user-set depth after
+  async def run_mode(spec: int, tag: str, paged: bool = False) -> dict:
+    # Restore user-set values after: the paged A/B flips XOT_PAGED_KV per
+    # mode, so the contiguous pair is honest even when the stage env sets it.
+    prior = {k: os.environ.get(k) for k in ("XOT_SPECULATE", "XOT_PAGED_KV")}
     os.environ["XOT_SPECULATE"] = str(spec)
+    os.environ["XOT_PAGED_KV"] = "1" if paged else "0"
     try:
       eng = JAXShardInferenceEngine()
       node = Node(f"spec-{tag}", _NullServer(), eng, _NoDiscovery(), None,
@@ -748,19 +758,24 @@ def _run_spec(model_id: str, prefill_len: int, decode_tokens: int, progress_path
       timed = await _timed_generate([node], shard, prompt, f"bench-spec-{tag}-timed")
       timed["proposed"] = getattr(eng, "_spec_proposed", 0) - p0
       timed["accepted"] = getattr(eng, "_spec_accepted", 0) - a0
+      # Native-verify acceptance counters (cumulative over warmup + timed —
+      # the bar is ZERO, so the window doesn't matter).
+      timed["unpage_calls"] = getattr(eng, "_unpage_calls", 0)
+      timed["commit_copy_bytes"] = getattr(eng, "_commit_copy_bytes", 0)
       _record(progress_path, f"spec:{tag}", tok_s=round(timed["tok_s"], 2),
               proposed=timed["proposed"], accepted=timed["accepted"])
       return timed
     finally:
-      if prior is None:
-        os.environ.pop("XOT_SPECULATE", None)
-      else:
-        os.environ["XOT_SPECULATE"] = prior
+      for k, v in prior.items():
+        if v is None:
+          os.environ.pop(k, None)
+        else:
+          os.environ[k] = v
 
   async def run() -> dict:
     on = await run_mode(8, "on")
     off = await run_mode(0, "off")
-    return {
+    out = {
       "spec_tok_s": round(on["tok_s"], 2),
       "spec_off_tok_s": round(off["tok_s"], 2),
       "spec_speedup": round(on["tok_s"] / off["tok_s"], 2) if off["tok_s"] else None,
@@ -771,6 +786,30 @@ def _run_spec(model_id: str, prefill_len: int, decode_tokens: int, progress_path
       # IDENTITY, not common-prefix: speculation may never change output.
       "spec_tokens_verified": bool(on["tokens"] and on["tokens"] == off["tokens"]),
     }
+    if os.getenv("BENCH_SPEC_PAGED", "0") == "1":
+      pon = await run_mode(8, "paged-on", paged=True)
+      poff = await run_mode(0, "paged-off", paged=True)
+      out.update({
+        # spec_tok_s counts only ACCEPTED tokens (rejected drafts never
+        # reach the stream), so specpaged_tok_s IS the acceptance-adjusted
+        # headline the roofline comparison uses.
+        "specpaged_tok_s": round(pon["tok_s"], 2),
+        "specpaged_off_tok_s": round(poff["tok_s"], 2),
+        "specpaged_speedup": (round(pon["tok_s"] / poff["tok_s"], 2)
+                              if poff["tok_s"] else None),
+        "specpaged_proposed": pon["proposed"],
+        "specpaged_accepted": pon["accepted"],
+        "specpaged_accept_rate": (round(pon["accepted"] / pon["proposed"], 3)
+                                  if pon["proposed"] else None),
+        # The native-verify bar: zero gather-backs, zero commit copies.
+        "specpaged_unpage_calls": pon["unpage_calls"],
+        "specpaged_commit_copy_bytes": pon["commit_copy_bytes"],
+        # All four streams identical: paged spec == paged plain == contiguous.
+        "specpaged_tokens_verified": bool(
+          pon["tokens"] and pon["tokens"] == poff["tokens"]
+          and pon["tokens"] == on["tokens"]),
+      })
+    return out
 
   return asyncio.run(run())
 
@@ -1514,9 +1553,10 @@ def _emit(result: dict) -> None:
   # Quantized-flagship fields (int8_tok_s, int8_speedup, int8_error, ...)
   # pass through as a family keyed off the ATTEMPTED format, so even an
   # unsupported-format failure surfaces its <fmt>_error diagnostic. The
-  # pagedfill_* (prefill-interference A/B) and kv_* (page-pool
-  # observability) families ride the same mechanism.
-  prefixes = set(QUANT_PREFIXES) | {"pagedfill", "kv"}
+  # pagedfill_* (prefill-interference A/B), kv_* (page-pool observability)
+  # and specpaged_* (paged speculative-decode A/B) families ride the same
+  # mechanism.
+  prefixes = set(QUANT_PREFIXES) | {"pagedfill", "kv", "specpaged"}
   if result.get("quant_fmt"):
     out["quant_fmt"] = result["quant_fmt"]
     prefixes.add(result["quant_fmt"])

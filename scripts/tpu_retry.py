@@ -106,6 +106,15 @@ STEPS: list[tuple[str, dict, str]] = [
   ("kvq16k", {**LONG, "BENCH_KV_QUANT": "int8"}, "long_tok_s"),
   # Prompt-lookup speculation through the Node loop, streams cross-checked.
   ("spec", {**SHORT, "BENCH_QUANT": "", "BENCH_SPEC": "1"}, "spec_tok_s"),
+  # Paged speculative decoding (ISSUE 13): the same on/off pair under
+  # XOT_PAGED_KV=1 — verification runs as a T>1 ragged query over the
+  # request's page table (XOT_PAGED_SPEC), so the verify forward never
+  # gathers the cache back. All four greedy streams byte-identical;
+  # specpaged_tok_s is acceptance-adjusted accepted tok/s, the number
+  # judged against the 331 tok/s single-stream bf16 ceiling.
+  ("specpaged", {**SHORT, "BENCH_QUANT": "", "BENCH_SPEC": "1",
+                 "BENCH_SPEC_PAGED": "1", "XOT_PAGED_KV": "1"},
+   "specpaged_tok_s"),
   # 32k depth: twice the r3-comparable context, scan prefill + decode.
   ("long32k", {**LONG, "BENCH_LONG": "32768"}, "long_tok_s"),
 ]

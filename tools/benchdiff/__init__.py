@@ -52,6 +52,13 @@ NOISE = {
   "long_tok_s": 0.07,
   "long_prefill_s": 0.10,
   "concurrent_tok_s": 0.07,
+  # Speculation throughput is acceptance-dependent (data-dependent draft
+  # hits), so both spec stages — and their off-arms, measured in the same
+  # noisy window — get the wider concurrent-style floor.
+  "spec_tok_s": 0.07,
+  "spec_off_tok_s": 0.07,
+  "specpaged_tok_s": 0.07,
+  "specpaged_off_tok_s": 0.07,
 }
 DEFAULT_NOISE = 0.05
 # Soak latency percentiles ride a loaded CPU ring in CI: run-to-run jitter
@@ -203,8 +210,12 @@ def _direction(name: str) -> str:
   if name in _SOAK_INFO:
     return "info"
   if (name.endswith("tok_s") or name.endswith("speedup") or name.endswith("_rps")
-      or name == "vs_baseline"):
+      or name.endswith("_accept_rate") or name == "vs_baseline"):
     return "up"
+  # Paged-speculation zero-bars: any unpage gather or commit copy on the
+  # native verify path is a structural regression, not noise.
+  if name.endswith("_unpage_calls") or name.endswith("_commit_copy_bytes"):
+    return "down"
   if name.endswith("_ms") or name.endswith("_s"):
     return "down"
   return "info"

@@ -428,6 +428,9 @@ class ChatGPTAPI:
       ("_commit_copy_bytes", "xot_kv_commit_copy_bytes_total",
        "Device bytes copied committing contiguous prefill KV into pool pages "
        "(zero under paged-native prefill, XOT_PAGED_PREFILL)"),
+      ("_unpage_calls", "xot_kv_unpage_total",
+       "Paged-to-contiguous cache gathers (zero when paged speculation keeps "
+       "draft verification native, XOT_PAGED_SPEC)"),
       ("_oom_count", "xot_oom_recoveries_total",
        "HBM-exhaustion recoveries (engine._free_device_memory invocations)"),
       ("_prefix_evictions", "xot_prefix_evictions_total",
@@ -486,6 +489,17 @@ class ChatGPTAPI:
          "EWMA model FLOP utilization vs the chip peak (0 off-TPU)"),
       ):
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {perf[key]}\n")
+    # Speculation-efficiency gauge (absent until a draft has been verified):
+    # EWMA accepted/proposed over the engine's verify rounds — what benchdiff
+    # gates acceptance-adjusted tok/s against.
+    spec_fn = getattr(eng, "spec_stats", None)
+    spec = spec_fn() if spec_fn is not None else None
+    if spec is not None:
+      for key, name, help_text in (
+        ("accept_rate", "xot_spec_accept_rate",
+         "EWMA fraction of drafted tokens accepted by verification"),
+      ):
+        extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {spec[key]}\n")
     # SLO alert gauges (XOT_ALERT, default on): firing count, per-family
     # fast-window burn rates, and per-peer hop send RTT EWMAs — the
     # localization signal, scrapeable without touching /v1/alerts.

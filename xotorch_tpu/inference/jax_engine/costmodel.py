@@ -272,6 +272,24 @@ class CostModel:
                    + tokens * self.kv_write_bytes_per_token())
     return bytes_total, self.prefill_flops(tokens, start)
 
+  def verify_dispatch_cost(self, tokens: int, depth: int, paged: bool = False,
+                           alloc_tokens: Optional[int] = None,
+                           page: int = 128) -> Tuple[int, int]:
+    """(hbm_bytes, flops) one K-token draft-VERIFY forward must move: ONE
+    weight stream regardless of K (the entire speculation win — K accepted
+    tokens ride a single pass of the resident weights), the KV read at the
+    layout the request is actually served from (a paged verify streams only
+    the request's occupied pages; contiguous reads its whole allocation),
+    the K fresh positions' KV writes, and prefill-shaped causal attention +
+    per-position unembed FLOPs (the verify argmaxes every position, not
+    just the last). This is what keeps /v1/perf's MFU honest when
+    speculation multiplies accepted tok/s past the plain-decode roofline."""
+    kv_read = self.kv_read_bytes_per_token(
+      depth + tokens, alloc_tokens=alloc_tokens, paged=paged, page=page)
+    bytes_total = (self.weight_bytes() + kv_read
+                   + tokens * self.kv_write_bytes_per_token())
+    return bytes_total, self.prefill_flops(tokens, depth)
+
   # ---------------------------------------------------------------- ceilings
 
   def ceilings(self, hbm_gbps: Optional[float]) -> Dict[str, Any]:

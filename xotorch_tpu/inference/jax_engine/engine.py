@@ -400,9 +400,19 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._host_kv_hits = 0
     self._host_spill_bytes = 0
     self._host_fetch_bytes = 0
-    # Speculative-decode observability: drafted vs model-confirmed tokens.
+    # Speculative-decode observability: drafted vs model-confirmed tokens,
+    # plus a live efficiency gauge — paired EWMAs of the proposed/accepted
+    # token rates whose ratio is xot_spec_accept_rate (both decay with the
+    # same time constant, so the ratio stays meaningful across idle gaps).
+    # Lazy: engines that never verify a draft never allocate the pair.
     self._spec_proposed = 0
     self._spec_accepted = 0
+    self._spec_ewma: Optional[Tuple[Any, Any]] = None
+    # Paged→contiguous gathers (_unpage_state invocations). Paged-native
+    # speculation keeps draft verification on the page table, so a plain
+    # paged request — speculating or not — finishes with this at ZERO
+    # (counter-asserted in tests, exported as xot_kv_unpage_total).
+    self._unpage_calls = 0
     # Requests whose device state was dropped by OOM recovery (bounded LRU):
     # their next touch raises RequestStateLost instead of silently starting
     # over from an empty cache.
@@ -646,7 +656,7 @@ class JAXShardInferenceEngine(InferenceEngine):
                         batch: int = 1, tokens: int = 0,
                         ctx: "Optional[_ShardContext]" = None,
                         items: Optional[list] = None,
-                        start: int = 0) -> None:
+                        start: int = 0, emitted: Optional[int] = None) -> None:
     """Classify one device dispatch as jit-cache miss (first sighting of
     this executable identity key) or hit, and record the miss — with its
     wall time, which includes the compile — as a flight event. The key is a
@@ -677,9 +687,21 @@ class JAXShardInferenceEngine(InferenceEngine):
         hbm_bytes, flops = cm.decode_dispatch_cost(
           tokens, rows, page=knobs.get_int("XOT_KV_PAGE"))
         total_tokens = tokens * max(batch, 1)
+      elif kind == "verify":
+        # One K-token draft-verify forward: a single weight stream (the
+        # whole speculation win) + KV read at the layout the request is
+        # actually served from — items carries its (depth, paged, alloc)
+        # row. The lane's token count is the ACCEPTED output (`emitted`),
+        # so /v1/perf's verify lane reads as accepted tok/s directly.
+        depth, paged, alloc = (items[0] if items else (0, False, None))
+        hbm_bytes, flops = cm.verify_dispatch_cost(
+          tokens, depth, paged=paged, alloc_tokens=alloc,
+          page=knobs.get_int("XOT_KV_PAGE"))
       else:
         hbm_bytes, flops = cm.prefill_dispatch_cost(tokens, self._prefill_chunk(),
                                                     start=start)
+    if emitted is not None:
+      total_tokens = emitted
     perf.observe(key, kind, seconds, tokens=total_tokens, batch=batch,
                  hbm_bytes=hbm_bytes, flops=flops)
 
@@ -747,7 +769,35 @@ class JAXShardInferenceEngine(InferenceEngine):
     gauges = self.perf_stats() or {}
     out["hbm_util_pct"] = gauges.get("hbm_util_pct", 0.0)
     out["mfu_pct"] = gauges.get("mfu_pct", 0.0)
+    spec = self.spec_stats()
+    if spec is not None:
+      out["spec_accept_rate"] = spec["accept_rate"]
+      out["spec_proposed"] = self._spec_proposed
+      out["spec_accepted"] = self._spec_accepted
     return out
+
+  def _observe_spec(self, proposed: int, accepted: int) -> None:
+    """Feed one verify round into the paired accept-rate EWMAs (every
+    verify path calls this right after bumping the cumulative counters)."""
+    from xotorch_tpu.inference.jax_engine.costmodel import _Ewma
+    if self._spec_ewma is None:
+      tau = knobs.get_float("XOT_SPEC_EWMA_S")
+      self._spec_ewma = (_Ewma(tau), _Ewma(tau))
+    now = time.monotonic()
+    self._spec_ewma[0].observe(float(proposed), 1e-3, now)
+    self._spec_ewma[1].observe(float(accepted), 1e-3, now)
+
+  def spec_stats(self) -> Optional[Dict[str, float]]:
+    """Live speculation-efficiency gauge (xot_spec_accept_rate): EWMA
+    accepted-token rate over EWMA proposed-token rate. None until a draft
+    has been verified — the gauge only exists once speculation ran, the
+    same presence rule as the other engine-feature gauges."""
+    if self._spec_ewma is None:
+      return None
+    now = time.monotonic()
+    prop = self._spec_ewma[0].peek(now)
+    acc = self._spec_ewma[1].peek(now)
+    return {"accept_rate": round(acc / prop, 4) if prop > 1e-12 else 0.0}
 
   def perf_report(self) -> Optional[Dict[str, Any]]:
     """The full /v1/perf attribution report: the loaded model's analytic
@@ -770,8 +820,16 @@ class JAXShardInferenceEngine(InferenceEngine):
         "host_spill_bytes": self._host_spill_bytes,
         "host_fetch_bytes": self._host_fetch_bytes,
         "commit_copy_bytes": self._commit_copy_bytes,
+        "unpage_gathers": self._unpage_calls,
         "pool": self.page_pool_stats(),
         "host_tier": self.host_kv_stats(),
+      },
+      # Drafted-vs-accepted next to the verify lane's accepted tok/s, so
+      # acceptance-adjusted throughput can be gated from one endpoint.
+      "speculation": {
+        "proposed": self._spec_proposed,
+        "accepted": self._spec_accepted,
+        "accept_rate_ewma": (self.spec_stats() or {}).get("accept_rate"),
       },
       "model": None,
       "ceilings": None,
@@ -1548,6 +1606,12 @@ class JAXShardInferenceEngine(InferenceEngine):
     the rolled-back `pos`, invisible to the validity mask
     (transformer.forward_shard kv_valid_len) and overwritten by the next
     write at the same offsets.
+
+    A page-backed request (XOT_PAGED_KV + XOT_PAGED_SPEC) verifies NATIVE
+    to the arena — a T>1 ragged query over its existing page table
+    (_verify_draft_paged_sync), with the same free rollback plus a
+    page-granular decref of the rejected tail; everything else takes the
+    contiguous forward below.
     """
     if not (shard.is_first_layer and shard.is_last_layer) or not draft:
       return None
@@ -1577,9 +1641,14 @@ class JAXShardInferenceEngine(InferenceEngine):
                            [int(t) for t in draft])
 
   def _verify_draft_sync(self, ctx: _ShardContext, request_id: str, prev_token: int,
-                         draft: list) -> list:
+                         draft: list):
     import jax.numpy as jnp
     state = ctx.states[request_id]
+    if self._paged_spec_ok(ctx, state):
+      # Paged-native verification: the forward runs as a T>1 ragged query
+      # over the request's EXISTING page table — no gather-back, no
+      # re-commit, no contiguous buffer at any point.
+      return self._verify_draft_paged_sync(ctx, request_id, prev_token, draft)
     # Discard in-flight speculation BEFORE capturing pos: _prep_state (via
     # _forward_segment) would roll state.pos back underneath us, and a
     # pos_before read from the inflated value would land the post-verify
@@ -1589,9 +1658,11 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._discard_batch_spec_for(ctx, request_id)
     pos_before = state.pos
     x = np.asarray([[prev_token] + draft], dtype=np.int64)
+    t0 = time.monotonic()
     out, true_t = self._forward_segment(ctx, request_id, x)
     # preds[i] = model's greedy choice AFTER consuming x[:, : i + 1].
     preds = np.asarray(jnp.argmax(out[0, :true_t], axis=-1)).astype(np.int64)
+    secs = time.monotonic() - t0
     n_acc = 0
     while n_acc < len(draft) and int(preds[n_acc]) == draft[n_acc]:
       n_acc += 1
@@ -1603,6 +1674,93 @@ class JAXShardInferenceEngine(InferenceEngine):
     state.pos = pos_before + 1 + n_acc
     self._spec_proposed += len(draft)
     self._spec_accepted += n_acc
+    self._observe_spec(len(draft), n_acc)
+    alloc = state.cache["k"].shape[2] if state.cache is not None else None
+    self._observe_dispatch(
+      "verify", ("verify", _bucket(true_t), False), secs,
+      tokens=_bucket(true_t), ctx=ctx, items=[(pos_before, False, alloc)],
+      emitted=len(accepted))
+    if self.flight is not None:
+      self.flight.record("spec.verify", request_id, drafted=len(draft),
+                         accepted=n_acc, paged=False)
+    return accepted
+
+  def _paged_spec_ok(self, ctx: _ShardContext, state: "_RequestState") -> bool:
+    """Qualification rule for paged-native draft verification: the request
+    must already live on the page table (cache committed/native, no sampling
+    extras) under a paged-family config, with XOT_PAGED_SPEC on. Everything
+    else takes the contiguous verify (which un-pages a page-backed state
+    via _prep_state — the pre-ragged behavior, kept behind the knob)."""
+    return (self._paged_on() and self._paged_ok(ctx) and self._paged_spec_on()
+            and state.cache is None and state.pages is not None
+            and state.extras is None)
+
+  def _verify_draft_paged_sync(self, ctx: _ShardContext, request_id: str,
+                               prev_token: int, draft: list):
+    """Greedy draft verification NATIVE to the page arena: one
+    forward_argmax_paged dispatch runs [prev_token] + draft as a T>1 ragged
+    query through the request's existing page table, scattering the draft's
+    K/V into the request's own pages (partial tail page + fresh
+    allocations covering the padded bucket). Rollback is page-granular and
+    free: pos rewinds to the accepted prefix and the tail pages past
+    pages_for(pos) — bucket overshoot AND rejected-draft pages, all
+    fresh-allocated this round — decref straight back to the pool. The
+    request never leaves the arena, so _unpage_state and
+    _commit_state_to_pages stay untouched (the counters tests assert)."""
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import forward_argmax_paged
+    state = ctx.states[request_id]
+    self._discard_spec(request_id, state)
+    self._discard_batch_spec_for(ctx, request_id)
+    pos_before = state.pos
+    T = 1 + len(draft)
+    bucket = _bucket(T)
+    try:
+      # Extends the table to cover the padded bucket (pages for the draft
+      # positions — a draft straddling a page boundary allocates its fresh
+      # pages HERE, before any device work).
+      self._prep_state_paged(ctx, request_id, bucket)
+    except CacheExhausted:
+      # Pool pressure: fall back to plain decode (one page per chunk beats
+      # a bucket-wide verify claim) — same "fast path does not apply"
+      # contract as the room check in verify_draft.
+      return None
+    pool = ctx.page_pool
+    x = np.zeros((1, bucket), dtype=np.int64)
+    x[0, :T] = [prev_token] + draft
+    table = self._paged_table_for(state)
+    t0 = time.monotonic()
+    preds_dev, pool.arena = forward_argmax_paged(
+      ctx.params, jnp.asarray(x, jnp.int32), pool.arena, table,
+      jnp.int32(pos_before), ctx.cfg, use_kernel=self._paged_kernel_on(),
+      moe_routed=self._moe_routed_for(ctx), ragged=self._ragged_prefill_on(),
+      start_layer=ctx.shard.start_layer)
+    preds = np.asarray(preds_dev[0, :T]).astype(np.int64)
+    secs = time.monotonic() - t0
+    n_acc = 0
+    while n_acc < len(draft) and int(preds[n_acc]) == draft[n_acc]:
+      n_acc += 1
+    accepted = draft[:n_acc] + [int(preds[n_acc])]
+    state.pos = pos_before + 1 + n_acc
+    # Page-granular rollback: everything past pages_for(pos) was allocated
+    # for this verify (the pre-verify invariant is len(pages) ==
+    # pages_for(pos), restored here) — shared prefix pages are full pages
+    # below pos_before and can never sit in the trimmed tail.
+    keep = pool.pages_for(state.pos)
+    if len(state.pages) > keep:
+      pool.decref(state.pages[keep:])
+      del state.pages[keep:]
+    state.last_used = time.monotonic()
+    self._spec_proposed += len(draft)
+    self._spec_accepted += n_acc
+    self._observe_spec(len(draft), n_acc)
+    self._observe_dispatch(
+      "verify", ("verify", bucket, True, self._paged_kernel_on()), secs,
+      tokens=bucket, ctx=ctx, items=[(pos_before, True, None)],
+      emitted=len(accepted))
+    if self.flight is not None:
+      self.flight.record("spec.verify", request_id, drafted=len(draft),
+                         accepted=n_acc, paged=True)
     return accepted
 
   # ----------------------------------------------- draft-model speculation
@@ -2424,6 +2582,10 @@ class JAXShardInferenceEngine(InferenceEngine):
       st.last_used = now
     self._spec_proposed += len(draft)
     self._spec_accepted += n_acc
+    self._observe_spec(len(draft), n_acc)
+    if self.flight is not None:
+      self.flight.record("spec.verify", request_id, drafted=len(draft),
+                         accepted=n_acc, paged=False)
     return accepted
 
   def _ring_batch_sync(self, items: list, num_tokens: int, top_k: int,
@@ -2949,6 +3111,20 @@ class JAXShardInferenceEngine(InferenceEngine):
       return env == "1"
     return self._jax().default_backend() == "tpu"
 
+  def _ragged_prefill_on(self) -> bool:
+    """XOT_RAGGED_PREFILL: under the kernel path, T>1 segments read pages
+    NATIVELY through the ragged paged-attention kernel (page-table-
+    indirected kv BlockSpecs — no gathered-view materialisation on the
+    prefill/verify hot path). 0 restores the legacy gather + cached-kernel
+    read for on-chip A/B."""
+    return knobs.get_bool("XOT_RAGGED_PREFILL")
+
+  def _paged_spec_on(self) -> bool:
+    """XOT_PAGED_SPEC: draft verification runs native to the page arena
+    (T>1 ragged query over the request's page table). 0 restores the
+    unpage-then-verify-contiguous fallback."""
+    return knobs.get_bool("XOT_PAGED_SPEC")
+
   def _ensure_page_pool(self, ctx: _ShardContext):
     if ctx.page_pool is None:
       from xotorch_tpu.inference.jax_engine.paged_cache import PagePool
@@ -3019,12 +3195,15 @@ class JAXShardInferenceEngine(InferenceEngine):
   def _unpage_state(self, ctx: _ShardContext, state: _RequestState,
                     min_len: int = 0) -> None:
     """Gather a paged request back into a contiguous buffer (the reverse of
-    commit): segment forwards, draft verification, and per-token decode all
-    assume `state.cache`. The request's pages are released; the next paged
-    chunk re-commits. Cold-path by design — steady-state decode never calls
-    this."""
+    commit): segment forwards, extras decode, and (under XOT_PAGED_SPEC=0)
+    draft verification assume `state.cache`. The request's pages are
+    released; the next paged chunk re-commits. Cold-path by design —
+    steady-state decode never calls this, and paged-native speculation
+    keeps the verify path off it too (xot_kv_unpage_total counts every
+    invocation; the paged tests assert it stays 0)."""
     import jax
     from xotorch_tpu.inference.jax_engine.paged_cache import gather_pages
+    self._unpage_calls += 1
     pool = ctx.page_pool
     need = min(max(min_len, state.pos, 1), ctx.max_cache_len)
     length = ctx.cache_len
@@ -3149,7 +3328,8 @@ class JAXShardInferenceEngine(InferenceEngine):
         ctx.params, x[:, off * chunk:(off + g) * chunk], pool.arena, jnp.int32(state.pos),
         ctx.cfg, g, is_first=True, start_layer=ctx.shard.start_layer,
         moe_routed=self._moe_routed_for(ctx),
-        page_table=table, paged_kernel=use_kernel)
+        page_table=table, paged_kernel=use_kernel,
+        ragged_prefill=self._ragged_prefill_on())
       state.pos += g * chunk
     state.last_used = time.monotonic()
 
@@ -3176,7 +3356,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       ctx.params, x, pool.arena, jnp.int32(state.pos), jnp.int32(true_t - 1), key,
       ctx.cfg, True, temp, top_k, top_p,
       start_layer=ctx.shard.start_layer, moe_routed=self._moe_routed_for(ctx),
-      page_table=table, paged_kernel=self._paged_kernel_on())
+      page_table=table, paged_kernel=self._paged_kernel_on(),
+      ragged_prefill=self._ragged_prefill_on())
     state.pos += true_t
     # Trim the padded bucket's overshoot: pages past pages_for(pos) hold
     # only padding garbage and are exclusively ours (fresh-allocated; the

@@ -137,7 +137,11 @@ class PagePool:
 # (transformer._attention_block's page branch), so commit_pages runs only
 # for requests that still prefill contiguous (sampling extras, hidden
 # input, the env off), and gather_pages only when a contiguous-only code
-# path (draft verify, extras decode) un-pages a resident request.
+# path (extras decode, XOT_PAGED_SPEC=0 draft verify) un-pages a resident
+# request. Since paged-native speculation (engine XOT_PAGED_SPEC, default
+# on) draft verification runs as a ragged query over the request's own
+# page table — it allocates/decrefs pages through the normal alloc path
+# and never touches these copy programs.
 
 _JITS: Dict[str, Any] = {}
 

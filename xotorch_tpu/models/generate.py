@@ -27,7 +27,7 @@ from xotorch_tpu.ops.sampling import sample_logits, sample_logits_logprobs
 @partial(
   jax.jit,
   static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode",
-                   "start_layer", "top_lp", "moe_routed", "paged_kernel"),
+                   "start_layer", "top_lp", "moe_routed", "paged_kernel", "ragged_prefill"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -54,6 +54,7 @@ def forward_sample(
   min_p=None,  # min-p cutoff (traced; None = off) — ops/sampling
   page_table: jnp.ndarray = None,  # [1, max_pages]: paged-NATIVE prefill — `cache` is the arena
   paged_kernel: bool = False,
+  ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
   ([B] int32 sampled token, updated cache) — with `top_lp >= 0`, instead
@@ -73,7 +74,8 @@ def forward_sample(
   h, cache = forward_shard(params, x, cache, start_pos, cfg=cfg, is_first=is_first,
                            is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode,
                            start_layer=start_layer, moe_routed=moe_routed,
-                           page_table=page_table, paged_kernel=paged_kernel)
+                           page_table=page_table, paged_kernel=paged_kernel,
+                           ragged_prefill=ragged_prefill)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
   if top_lp >= 0:
@@ -187,7 +189,8 @@ def scan_groups(n_segs: int):
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "n_segs", "is_first", "start_layer", "moe_routed", "paged_kernel"),
+  static_argnames=("cfg", "n_segs", "is_first", "start_layer", "moe_routed", "paged_kernel",
+                   "ragged_prefill"),
   donate_argnames=("cache",),
 )
 def prefill_scan(
@@ -202,6 +205,7 @@ def prefill_scan(
   moe_routed: bool = True,
   page_table: jnp.ndarray = None,  # [1, max_pages]: paged-NATIVE prefill — `cache` is the arena
   paged_kernel: bool = False,
+  ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
 ):
   """Chunked long-prompt prefill as ONE device program: `lax.scan` over the
   prompt's fixed-size segments, each step = forward_shard over the
@@ -241,7 +245,8 @@ def prefill_scan(
     h, cache = forward_shard(params, x_seg, cache, pos, cfg=cfg, is_first=is_first,
                              is_last=False, use_flash_decode=True,
                              start_layer=start_layer, moe_routed=moe_routed,
-                             page_table=page_table, paged_kernel=paged_kernel)
+                             page_table=page_table, paged_kernel=paged_kernel,
+                             ragged_prefill=ragged_prefill)
     return (cache, pos + seg), h
 
   (cache, _), hs = jax.lax.scan(step, (cache, start_pos.astype(jnp.int32)), xs)
@@ -338,6 +343,43 @@ def forward_argmax_ring(
     new_caches.append(c)
   logits = unembed(params_segs[-1], h, cfg)
   return jnp.argmax(logits, axis=-1).astype(jnp.int32), tuple(new_caches)
+
+
+@partial(
+  jax.jit,
+  static_argnames=("cfg", "use_kernel", "moe_routed", "ragged", "start_layer"),
+  donate_argnames=("arena",),
+)
+def forward_argmax_paged(
+  params,
+  x: jnp.ndarray,  # [1, T_pad] int32 — [prev_token] + draft, zero-padded to a po2 bucket
+  arena: Dict[str, jnp.ndarray],  # shared page arena: [L, P, page, Hkv, D] leaves
+  page_table: jnp.ndarray,  # [1, max_pages] int32 physical page ids (0-padded)
+  start_pos: jnp.ndarray,  # scalar int32 — the request's committed position
+  cfg: ModelConfig,
+  use_kernel: bool = False,  # static: Pallas ragged kernel vs XLA gather
+  moe_routed: bool = True,
+  ragged: bool = True,  # static: kernel path reads pages natively (no gather)
+  start_layer: int = 0,
+):
+  """Draft verification over the PAGED arena: one forward of
+  [prev_token] + draft as a T>1 ragged query through the request's existing
+  page table + per-position greedy argmax — the paged twin of the
+  contiguous verify forward (engine._verify_draft_sync) and of
+  forward_argmax_ring. Draft K/V scatter straight into the request's pages
+  (the engine pre-extends the table to cover the padded bucket); rejected
+  positions' slots sit past the rolled-back pos, invisible to the validity
+  mask, and the rejected tail's FRESH pages decref back to the pool host-
+  side. T_pad is the caller's po2 bucket, so the executable count is
+  logarithmic in the draft depth, never one per K. Returns
+  ([1, T_pad] int32 argmax, updated arena)."""
+  h, arena = forward_shard(params, x, arena, start_pos, cfg=cfg, is_first=True,
+                           is_last=False, moe_routed=moe_routed,
+                           start_layer=start_layer,
+                           page_table=page_table, paged_kernel=use_kernel,
+                           ragged_prefill=ragged)
+  logits = unembed(params, h, cfg)
+  return jnp.argmax(logits, axis=-1).astype(jnp.int32), arena
 
 
 @partial(

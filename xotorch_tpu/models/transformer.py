@@ -201,6 +201,7 @@ def _attention_block(
   window: Optional[jnp.ndarray] = None,  # per-layer scalar, 0 = global
   page_table: Optional[jnp.ndarray] = None,  # [B, max_pages]: paged-KV decode
   paged_kernel: bool = False,
+  ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -252,11 +253,12 @@ def _attention_block(
         softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
         use_kernel=paged_kernel)
     else:
-      # Paged-native prefill segment: every position scatters into its own
-      # (page, slot). B == 1 by contract (per-request prefill); the engine
-      # allocates the table to cover the PADDED segment, so bucket-padding
-      # garbage lands in pages this request owns (masked by kv_valid_len,
-      # overwritten by later writes at the same positions).
+      # Paged-native T>1 segment (prefill slice or draft-verify forward):
+      # every position scatters into its own (page, slot). B == 1 by
+      # contract (per-request prefill); the engine allocates the table to
+      # cover the PADDED segment, so bucket-padding garbage lands in pages
+      # this request owns (masked by kv_valid_len, overwritten by later
+      # writes at the same positions).
       if B != 1:
         raise ValueError(f"paged prefill serves per-request segments (B == 1), got B={B}")
       pos_vec = positions[0].astype(jnp.int32)  # [T] absolute positions
@@ -269,7 +271,7 @@ def _attention_block(
       attn = paged_prefill_attention(
         q, layer_cache["k"], layer_cache["v"], page_table, positions, kv_valid_len,
         softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
-        use_kernel=paged_kernel)
+        use_kernel=paged_kernel, ragged=ragged_prefill)
     attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
     out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
     if cfg.sandwich_norms:
@@ -425,6 +427,7 @@ def forward_shard(
   moe_routed: bool = True,
   page_table: Optional[jnp.ndarray] = None,  # [B, max_pages]: paged-KV decode
   paged_kernel: bool = False,
+  ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
 
@@ -503,7 +506,7 @@ def forward_shard(
     attn_out, layer_cache = _attention_block(
       layer, h, layer_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
       ring_mesh, use_flash_decode, window=window,
-      page_table=page_table, paged_kernel=paged_kernel,
+      page_table=page_table, paged_kernel=paged_kernel, ragged_prefill=ragged_prefill,
     )
     h = h + attn_out
     mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)

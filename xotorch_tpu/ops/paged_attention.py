@@ -23,15 +23,26 @@ Two implementations, one contract:
   max_pages. Unallocated/padded table slots are never touched.
 
 `paged_decode_attention` is T == 1 only (the decode step).
-`paged_prefill_attention` serves chunked-prefill SEGMENTS (T > 1) whose K/V
-were scattered straight into pool pages (transformer._attention_block's
-paged write-through): it gathers the row's pages into a contiguous view and
-runs either the shared masked-softmax math (XLA reference, CPU fallback) or
-the occupancy-aware cached-attention kernel (ops/flash_decode.py) over the
-gathered view — the sanctioned "cached kernel gathers from pages" shape; a
-true ragged-prefill Pallas kernel (no gather materialisation) is future
-work (ROADMAP). On CPU the kernels run in interpret mode so tests exercise
-the same code paths.
+`paged_prefill_attention` serves T > 1 RAGGED segments — chunked-prefill
+slices and the draft-verify forward ([prev_token] + draft) — whose K/V were
+scattered straight into pool pages (transformer._attention_block's paged
+write-through). Three read paths, one contract:
+
+- XLA reference (use_kernel=False): `jnp.take` gather of each row's pages +
+  the shared gqa_attention mask math. Runs anywhere, correctness reference.
+- Ragged Pallas kernel (use_kernel=True, ragged=True — the default kernel
+  path): the T>1 generalisation of the decode kernel below. The kv
+  BlockSpec indirects through the page table directly (`_kv_map`), per-row
+  page saturation elides DMAs past each row's occupied pages, and the
+  causal mask offsets every query row by its resident position
+  (q_start = kv_valid_len - T) — NO gathered-view materialisation
+  anywhere, the Ragged Paged Attention design (arXiv 2604.15464).
+- Legacy gathered view (use_kernel=True, ragged=False): gather + the
+  occupancy-aware cached kernel (ops/flash_decode.py) — the pre-ragged
+  shape, kept for on-chip A/B (XOT_RAGGED_PREFILL=0).
+
+On CPU the kernels run in interpret mode so tests exercise the same code
+paths.
 """
 from __future__ import annotations
 
@@ -153,6 +164,117 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
   return out.reshape(B, 1, Hq, D)
 
 
+def _paged_ragged_kernel(pt_ref, qstart_ref, len_ref, q_ref, k_ref, v_ref,
+                         o_ref, acc_ref, m_ref, l_ref, *, page: int,
+                         groups: int, T: int, scale: float, softcap: float):
+  """T > 1 generalisation of `_paged_kernel`: grid = (B, Hkv, n_pages), the
+  page axis innermost so VMEM scratch carries the online-softmax state of
+  ALL of one (batch, kv-head)'s query rows across its pages. A tile packs
+  the `groups` query heads sharing this kv head times the T segment
+  positions as rows (row r = g*T + t), so one MXU dot scores a whole page
+  against every query at once. Causality is per ROW: query t sits at
+  absolute position q_start[b] + t and sees exactly the occupied positions
+  at or before it — the ragged mask that lets one kernel serve chunked
+  prefill slices and draft-verify forwards over a resident cache."""
+  b = pl.program_id(0)
+  j = pl.program_id(2)
+  n_j = pl.num_programs(2)
+  length = len_ref[b]
+  q_start = qstart_ref[b]
+
+  @pl.when(j == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  @pl.when(j * page < length)
+  def _compute():
+    q = _mxu_operand(q_ref[0, 0])  # [groups*T, D]
+    k = _mxu_operand(k_ref[0, 0])  # [page, D]
+    v = _mxu_operand(v_ref[0, 0])
+    s = jax.lax.dot_general(
+      q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [groups*T, page]
+    s = _softcap(s, softcap)
+    # Row r is query offset t = r % T at absolute position q_start + t; it
+    # attends key positions <= its own. Position 0 is visible to every row,
+    # so m/l leave NEG_INF on the very first page — later fully-masked
+    # pages then renormalise against a finite running max (exp(-inf - m)
+    # underflows to 0, never NaN).
+    k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % T
+    s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[:] = jnp.broadcast_to(
+      alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+      p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+  @pl.when(j == n_j - 1)
+  def _finalize():
+    l = l_ref[:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _ragged_attention_kernel(q, k_pages, v_pages, page_table, kv_valid_len,
+                             scale: float, softcap: float,
+                             interpret: bool | None) -> jnp.ndarray:
+  """Pallas dispatch for the T>1 ragged kernel: queries [B, T, Hq, D] over
+  page-table-indirected K/V. Query row t of batch b sits at absolute
+  position kv_valid_len[b] - T + t (the engine's prefill/verify contract:
+  contiguous positions ending at the last occupied one)."""
+  B, T, Hq, D = q.shape
+  _, page, Hkv, _ = k_pages.shape
+  groups = Hq // Hkv
+  maxp = page_table.shape[1]
+  if interpret is None:
+    interpret = jax.default_backend() != "tpu"
+
+  lens = kv_valid_len.astype(jnp.int32)
+  q_start = lens - T
+  # Head h_q = kv * groups + g packs to tile row r = g*T + t.
+  qt = q.transpose(0, 2, 1, 3).reshape(B, Hkv, groups * T, D)
+  kt = k_pages.transpose(2, 0, 1, 3)  # [Hkv, P, page, D]
+  vt = v_pages.transpose(2, 0, 1, 3)
+  pt = page_table.astype(jnp.int32)
+
+  def _kv_map(b, h, j, pt_ref, qstart_ref, len_ref):
+    jj = _logical_page_index(j, len_ref[b], page)
+    return (h, pt_ref[b, jj], 0, 0)
+
+  q_block = pl.BlockSpec((1, 1, groups * T, D), lambda b, h, j, *_: (b, h, 0, 0))
+  kv_block = pl.BlockSpec((1, 1, page, D), _kv_map)
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=3,
+    grid=(B, Hkv, maxp),
+    in_specs=[q_block, kv_block, kv_block],
+    out_specs=q_block,
+    scratch_shapes=[
+      pltpu.VMEM((groups * T, D), jnp.float32),
+      pltpu.VMEM((groups * T, 128), jnp.float32),
+      pltpu.VMEM((groups * T, 128), jnp.float32),
+    ],
+  )
+  out = pl.pallas_call(
+    functools.partial(_paged_ragged_kernel, page=page, groups=groups, T=T,
+                      scale=scale, softcap=float(softcap)),
+    grid_spec=grid_spec,
+    out_shape=jax.ShapeDtypeStruct((B, Hkv, groups * T, D), q.dtype),
+    interpret=interpret,
+  )(pt, q_start, lens, qt, kt, vt)
+  return (out.reshape(B, Hkv, groups, T, D)
+          .transpose(0, 3, 1, 2, 4).reshape(B, T, Hq, D))
+
+
 def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
                          scale: float, softcap: float) -> jnp.ndarray:
   """`jnp.take`-based fallback: gather each row's pages into a per-row
@@ -181,23 +303,33 @@ def paged_prefill_attention(
   softcap: float = 0.0,  # static tanh score cap (gemma2); 0 = off
   scale: float | None = None,  # static score scale; None = D**-0.5
   use_kernel: bool = False,
+  ragged: bool = True,  # static: kernel path reads pages NATIVELY (no gather)
   interpret: bool | None = None,
 ) -> jnp.ndarray:
-  """Causal GQA attention of a prefill segment over its row's occupied pages.
+  """Causal GQA attention of a T>1 ragged segment over its row's occupied
+  pages: chunked-prefill slices and draft-verify forwards share this op.
 
-  Query t (absolute position q_positions[:, t]) attends every occupied
-  position <= it, reached through `page_table`. Both paths first gather the
-  table's pages into a contiguous [B, max_pages*page] view — the copy the
-  issue blesses ("the cached-attention kernel gathers from pages"); padded
-  table slots gather the scratch page, whose positions sit at or past
-  kv_valid_len and mask out. `use_kernel` (static) runs the occupancy-aware
-  flash_cached kernel over the gathered view (its DMA stops at the occupied
-  prefix, and in-kernel scores never materialise [T, S]); the default XLA
-  path is the correctness reference and the off-TPU fallback.
-  Returns [B, T, Hq, D].
+  Query t (absolute position q_positions[:, t] == kv_valid_len - T + t)
+  attends every occupied position <= it, reached through `page_table`.
+  `use_kernel` (static) selects the Pallas path; with `ragged` (the
+  default) that is the TRUE ragged kernel — the kv BlockSpec indirects
+  through the page table, each row's DMA stops at its own occupied pages,
+  and no gathered view is ever materialised on the hot path. ragged=False
+  keeps the legacy shape (gather the pages contiguous, run the
+  occupancy-aware flash_cached kernel over the view) for on-chip A/B.
+  The default XLA gather path is the correctness reference and the off-TPU
+  fallback. Padded table slots hold the scratch page; their positions sit
+  at or past kv_valid_len and mask out. Returns [B, T, Hq, D].
   """
+  T = q.shape[1]
+  if use_kernel and ragged:
+    D = q.shape[-1]
+    k_scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    return _ragged_attention_kernel(q, k_pages, v_pages, page_table,
+                                    kv_valid_len, k_scale, float(softcap),
+                                    interpret)
   from xotorch_tpu.ops.attention import gqa_attention
-  B, T = q.shape[0], q.shape[1]
+  B = q.shape[0]
   maxp, page = page_table.shape[1], k_pages.shape[1]
   k = jnp.take(k_pages, page_table, axis=0)  # [B, maxp, page, Hkv, D]
   v = jnp.take(v_pages, page_table, axis=0)

@@ -59,6 +59,11 @@ EVENTS = (
   # engine-level events
   "engine.compile",
   "engine.oom_recovery",
+  # speculative decoding: one event per draft verification (drafted vs
+  # accepted counts + whether the verify ran native to the page arena), so
+  # a frozen snapshot shows how well speculation was paying off for the
+  # request that anomalied.
+  "spec.verify",
   # survivability layer
   "health.check_failed",
   "peer.evicted",

@@ -64,6 +64,8 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_KV_POOL_TOKENS", "int", "0", "Total paged-pool capacity in tokens; 0 sizes it automatically.", "Paged KV"),
   Knob("XOT_PAGED_KERNEL", "bool", None, "Force the Pallas ragged paged-attention kernel on/off; unset auto-selects by backend.", "Paged KV"),
   Knob("XOT_PAGED_PREFILL", "bool", "1", "Prefill straight into pool pages under XOT_PAGED_KV (no contiguous commit copy).", "Paged KV"),
+  Knob("XOT_RAGGED_PREFILL", "bool", "1", "Kernel-path T>1 segments read pages natively via the ragged kernel (no gathered view); 0 restores the legacy gather+cached-kernel read.", "Paged KV"),
+  Knob("XOT_PAGED_SPEC", "bool", "1", "Draft verification runs native to the page arena (ragged query over the request's page table); 0 restores unpage-then-verify.", "Paged KV"),
   Knob("XOT_PREFILL_COSCHED", "bool", "1", "Co-schedule chunked prefill slices through the decode batcher's drain cycle.", "Paged KV"),
   Knob("XOT_PREFILL_CHUNK_BUDGET", "int", "1", "Prefill segments admitted per decode drain cycle under co-scheduling.", "Paged KV"),
   Knob("XOT_KV_HOST_BYTES", "int", "268435456", "Host-RAM budget (bytes) for the spilled warm-prefix KV tier; 0 disables.", "Paged KV"),
@@ -86,6 +88,7 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_SPECULATE_WINDOW", "int", "2048", "Backward scan window (tokens) for prompt-lookup draft matching.", "Speculative"),
   Knob("XOT_DRAFT_MODEL", "str", None, "Resident draft model id for model-based speculative decoding.", "Speculative"),
   Knob("XOT_DRAFT_RETRY_S", "float", "300", "Cooldown (s) before retrying a draft model that failed to load.", "Speculative"),
+  Knob("XOT_SPEC_EWMA_S", "float", "60", "Time constant (s) of the xot_spec_accept_rate EWMA gauge.", "Speculative"),
   # ------------------------------------------------------------- sharding
   Knob("XOT_SERVE_TP", "int", None, "Tensor-parallel degree for serving; unset auto-selects from local devices.", "Sharding"),
   Knob("XOT_SERVE_SP", "int", "0", "Sequence-parallel degree for long-prompt serving prefill.", "Sharding"),
