@@ -879,3 +879,176 @@ async def test_fault_spec_env_parsing(monkeypatch):
   with pytest.raises(faults.TransientHopError):
     await fresh.apply("SendTensor", "anyone")
   monkeypatch.delenv("XOT_FAULT_SPEC")
+
+
+# ------------------------------------ (e) admission control at the front door
+
+async def _admission_api(monkeypatch, max_inflight, queue_depth,
+                         stall_timeout="5"):
+  """A single-node dummy ring behind the real aiohttp app with the
+  admission knobs scoped to the test."""
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+
+  if max_inflight is None:
+    monkeypatch.delenv("XOT_MAX_INFLIGHT", raising=False)
+  else:
+    monkeypatch.setenv("XOT_MAX_INFLIGHT", str(max_inflight))
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", str(queue_depth))
+  # Watchdog armed on purpose: the point is that overload produces ZERO
+  # watchdog aborts, so the watchdog must actually be running to prove it.
+  monkeypatch.setenv("XOT_STALL_TIMEOUT_S", stall_timeout)
+  engine = _TrackingEngine()
+  node = await _make_node("adm-node", engine)
+  node.topology.update_node("adm-node", _caps())
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30,
+                   default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return client, node, engine
+
+
+async def test_overload_sheds_as_429s_never_watchdog_aborts(monkeypatch):
+  """The PR 8 gap, closed at the node: above-capacity concurrent load on a
+  gate with max_inflight=1 / queue_depth=1 yields exactly two admitted
+  completions and 429s for the rest — every rejection a well-formed 429
+  with Retry-After + queue position, ZERO watchdog aborts, and every
+  ADMITTED stream byte-identical to an unloaded run."""
+  client, node, engine = await _admission_api(monkeypatch, 1, 1)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    baseline = await client.post("/v1/chat/completions", json=body)
+    assert baseline.status == 200
+    expected = (await baseline.json())["choices"][0]["message"]["content"]
+
+    resps = await asyncio.gather(
+      *[client.post("/v1/chat/completions", json=body) for _ in range(8)])
+    statuses = sorted(r.status for r in resps)
+    # One slot + one queue seat: exactly two admissions, six rejections.
+    assert statuses == [200, 200] + [429] * 6, statuses
+    for r in resps:
+      if r.status == 429:
+        assert r.headers.get("Retry-After"), "429 without Retry-After"
+        err = (await r.json())["error"]
+        assert err["code"] == "overloaded"
+        assert err["queue_depth"] == 1 and err["queue_position"] == 2
+        assert err["est_wait_s"] >= 0
+      else:
+        data = await r.json()
+        # The admission gate serializes the dummy engine, so every admitted
+        # completion must be byte-identical to the unloaded baseline.
+        assert data["choices"][0]["message"]["content"] == expected
+    assert int(node.metrics.watchdog_aborts_total._value.get()) == 0
+    assert int(node.metrics.admission_rejections_total._value.get()) == 6
+    gate = node.admission
+    assert gate.admitted_total == 3 and gate.rejected_total == 6
+    assert gate.inflight == 0 and len(gate._queue) == 0
+    # Rejected requests never touched the ring: no engine state to clear,
+    # no bookkeeping to leak.
+    _assert_no_leaks(node)
+  finally:
+    await client.close()
+
+
+async def test_admission_knobs_off_parity(monkeypatch):
+  """XOT_MAX_INFLIGHT=0 (the shipped default) is byte-and-behavior
+  identical to a tree without the gate: same completion bytes, a disabled
+  gate with zero state, no admission key in the status-bus summary (no new
+  bytes on the wire), and /v1/queue honestly reports disabled."""
+  client, node, engine = await _admission_api(monkeypatch, None, 32)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    baseline = await client.post("/v1/chat/completions", json=body)
+    expected = (await baseline.json())["choices"][0]["message"]["content"]
+    resp = await client.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    assert (await resp.json())["choices"][0]["message"]["content"] == expected
+    gate = node.admission
+    assert not gate.enabled
+    assert gate.admitted_total == 0 and gate.rejected_total == 0
+    assert gate.inflight == 0 and len(gate._queue) == 0
+    assert int(node.metrics.admission_rejections_total._value.get()) == 0
+    summary = node.metrics_summary()
+    assert "admission" not in summary, "defaults-off must add no wire keys"
+    q = await (await client.get("/v1/queue")).json()
+    assert q["enabled"] is False and q["cluster"] == {}
+    _assert_no_leaks(node)
+  finally:
+    await client.close()
+
+
+async def test_process_prompt_delay_tap_is_origin_only_and_observed():
+  """The gray-failure tap: a ProcessPrompt delay rule slows ORIGIN
+  requests (observed by the node's own TTFT histogram — what lets a
+  single-node replica's burn-rate rules fire on it) while the completion
+  itself stays byte-identical; an error rule aborts cleanly."""
+  import numpy as np
+  engine = DummyInferenceEngine()
+  node = await _make_node("tap-node", engine)
+  node.topology.update_node("tap-node", _caps())
+  from xotorch_tpu.inference.shard import Shard as _Shard
+
+  async def run(rid):
+    done = asyncio.Event()
+    out = {}
+
+    def on_token(request_id, tokens, fin):
+      if request_id == rid:
+        out["tokens"] = list(tokens)
+        if fin:
+          done.set()
+
+    node.on_token.register(f"tap-{rid}").on_next(on_token)
+    t0 = time.monotonic()
+    await node.process_prompt(_Shard("dummy", 0, 0, 8), "hello", rid)
+    await asyncio.wait_for(done.wait(), timeout=15)
+    node.on_token.deregister(f"tap-{rid}")
+    return out["tokens"], time.monotonic() - t0
+
+  base_tokens, base_secs = await run("tap-base")
+  faults.install(faults.FaultInjector([
+    {"rpc": "ProcessPrompt", "action": "delay", "nth": 1, "times": 1, "delay_s": 0.6},
+  ]))
+  slow_tokens, slow_secs = await run("tap-slow")
+  assert slow_tokens == base_tokens  # delayed, never altered
+  assert slow_secs >= base_secs + 0.5
+  faults.install(faults.FaultInjector([
+    {"rpc": "ProcessPrompt", "action": "error", "nth": 1, "times": 1},
+  ]))
+  done = asyncio.Event()
+  node.on_token.register("tap-err").on_next(
+    lambda rid, tokens, fin: done.set() if fin and rid == "tap-err" else None)
+  await node.process_prompt(_Shard("dummy", 0, 0, 8), "hello", "tap-err")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  node.on_token.deregister("tap-err")
+  assert "injected fault" in (node.request_errors.get("tap-err") or "")
+  _assert_no_leaks(node)
+
+
+async def test_process_prompt_tap_ignores_wildcard_rules():
+  """Rules with no `rpc` filter keep their historical peer-handle-boundary
+  semantics: the origin tap neither fires them nor consumes their
+  nth/times call budget."""
+  engine = DummyInferenceEngine()
+  node = await _make_node("wild-node", engine)
+  node.topology.update_node("wild-node", _caps())
+  inj = faults.FaultInjector([{"action": "error", "nth": 1, "times": 1}])
+  faults.install(inj)
+  from xotorch_tpu.inference.shard import Shard as _Shard
+  done = asyncio.Event()
+  out = {}
+
+  def on_token(rid, tokens, fin):
+    if rid == "wild-req":
+      out["tokens"] = list(tokens)
+      if fin:
+        done.set()
+
+  node.on_token.register("wild").on_next(on_token)
+  await node.process_prompt(_Shard("dummy", 0, 0, 8), "hello", "wild-req")
+  await asyncio.wait_for(done.wait(), timeout=15)
+  node.on_token.deregister("wild")
+  # The wildcard rule neither fired at the origin nor had calls consumed.
+  assert node.request_errors.get("wild-req") is None
+  assert inj.rules[0].calls == 0
+  assert len(out["tokens"]) == engine.num_generate_dummy_tokens

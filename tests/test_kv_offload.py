@@ -327,3 +327,53 @@ async def test_zero_budget_disables_tier(tiny_model_dir, monkeypatch):
   got = await _generate(eng, "rb", PROMPT_B)
   assert eng._host_kv_hits == 0 and eng._prefix_hits == 0
   assert got == await _cold_b(tiny_model_dir)
+
+
+async def test_prefetch_host_prefix_restores_before_request(tiny_model_dir, monkeypatch):
+  """The PRESERVE hook (arXiv 2501.08192): `prefetch_host_prefix` on a
+  QUEUED prompt promotes the spilled prefix host->HBM before any request
+  runs, so the request itself takes the native warm path and pays ZERO
+  further host fetch; misses and non-resident shards are side-effect-free
+  (a prefetch must never trigger a model load)."""
+  _env(monkeypatch, paged=False)
+  want_b = await _cold_b(tiny_model_dir)
+
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  ctx = eng._contexts[_full_shard()]
+  eng._free_device_memory()
+  assert eng._host_kv is not None and len(eng._host_kv) == 1
+
+  class _Tok:
+    eos_token_id = 0
+
+    def encode(self, prompt):
+      assert prompt == "queued prompt b"
+      return PROMPT_B.reshape(-1)
+
+  ctx.tokenizer = _Tok()
+  restored = await eng.prefetch_host_prefix(_full_shard(), "queued prompt b")
+  assert restored is True
+  assert eng._host_kv_hits == 1 and eng._host_fetch_bytes > 0
+  assert len(ctx.prefix_cache) == 1  # HBM entry re-created pre-admission
+  fetched_at_prefetch = eng._host_fetch_bytes
+
+  got_b = await _generate(eng, "rb", PROMPT_B)
+  assert got_b == want_b, f"prefetched-warm {got_b} != cold {want_b}"
+  # The real request paid no further host fetch: the prefetch already put
+  # the prefix back in HBM and the request took the native warm path.
+  assert eng._host_fetch_bytes == fetched_at_prefetch
+  assert eng._prefix_hits == 1 and eng._prefix_tokens_saved == 32
+
+  class _TokMiss:
+    eos_token_id = 0
+
+    def encode(self, prompt):
+      return np.array([7, 7, 7, 7, 7], dtype=np.int64)
+
+  ctx.tokenizer = _TokMiss()
+  assert await eng.prefetch_host_prefix(_full_shard(), "unrelated") is False
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  assert await eng.prefetch_host_prefix(Shard("m", 0, 0, n), "x") is False
+  assert Shard("m", 0, 0, n) not in eng._contexts  # no load was triggered

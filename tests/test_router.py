@@ -1,0 +1,512 @@
+"""Front-door tests: the replica lifecycle state machine as PURE logic
+(injected clocks, no processes), affinity/spill placement, the bounded
+admission gate, the /v1/queue surface, and an in-process router-over-two-
+replicas integration pass (aiohttp test servers, dummy engines). The full
+multi-process arc — overload shed as 429s, gray-failure drain + readmit —
+runs as `python -m tools.soak --router-smoke` (CI step) and its committed
+SOAK_router.json is gated by tools/benchdiff."""
+import asyncio
+import json
+
+import pytest
+
+from xotorch_tpu.router import (
+  ReplicaLifecycle, prefix_key, rendezvous, replica_names, route,
+)
+
+
+# ---------------------------------------------------- lifecycle state machine
+
+def _lc(**kw):
+  kw.setdefault("probes_required", 2)
+  kw.setdefault("min_out_s", 10.0)
+  kw.setdefault("flap_window_s", 60.0)
+  return ReplicaLifecycle("r0", **kw)
+
+
+def test_healthy_drains_on_firing_alert_and_on_suspect():
+  lc = _lc()
+  ev = lc.note_status(100.0, firing=1)
+  assert ev["transition"] == "draining" and "alerts_firing" in ev["reason"]
+  assert not lc.routable and lc.drains_total == 1
+
+  lc2 = _lc()
+  ev = lc2.note_status(100.0, firing=0, suspect="node-b")
+  assert ev["transition"] == "draining" and ev["reason"] == "suspect:node-b"
+
+  lc3 = _lc()
+  lc3.note_status(99.0, reachable=True)  # joined: unreachability now drains
+  ev = lc3.note_status(100.0, reachable=False)
+  assert ev["transition"] == "draining" and ev["reason"] == "unreachable"
+
+  # Healthy traffic never transitions.
+  assert _lc().note_status(100.0, firing=0, inflight=5) is None
+
+
+def test_draining_waits_for_inflight_and_alert_clear():
+  lc = _lc()
+  lc.note_status(0.0, firing=1)
+  # Inflight streams still running: stays draining (they must finish).
+  assert lc.note_status(1.0, firing=1, inflight=3) is None
+  assert lc.state == "draining"
+  # Drained but the alert still burns: probing a known-burning replica is
+  # pointless — stay out.
+  assert lc.note_status(2.0, firing=1, inflight=0) is None
+  assert lc.state == "draining"
+  ev = lc.note_status(3.0, firing=0, inflight=0)
+  assert ev["transition"] == "probing"
+
+
+def test_probe_failure_keeps_the_replica_out():
+  lc = _lc()
+  lc.note_status(0.0, firing=1)
+  lc.note_status(1.0, firing=0, inflight=0)
+  assert lc.state == "probing"
+  assert lc.note_probe(True, 20.0) is None      # 1/2 successes
+  assert lc.note_probe(False, 21.0) is None     # failure resets the streak
+  assert lc.probe_successes == 0 and lc.probe_failures_total == 1
+  assert lc.note_probe(True, 22.0) is None
+  ev = lc.note_probe(True, 23.0)
+  assert ev is not None and ev["transition"] == "healthy"
+  assert lc.routable and lc.readmits_total == 1
+
+
+def test_probing_returns_to_draining_when_burn_refires():
+  lc = _lc(min_out_s=10.0)
+  lc.note_status(0.0, firing=1)
+  lc.note_status(1.0, firing=0, inflight=0)
+  assert lc.state == "probing"
+  ev = lc.note_status(8.0, firing=1)
+  assert ev["transition"] == "draining" and ev["reason"] == "alert re-fired"
+  # A re-fire is a full re-drain: the out-clock restarts and the drain is
+  # counted — the replica can't readmit off the ORIGINAL drain's clock
+  # seconds after its alert dips.
+  assert lc.drained_at == 8.0 and lc.drains_total == 2
+  # Probe results while not probing are ignored.
+  assert lc.note_probe(True, 9.0) is None and lc.state == "draining"
+  lc.note_status(10.0, firing=0, inflight=0)
+  lc.note_probe(True, 11.0)
+  assert lc.note_probe(True, 12.0) is None  # only 4 s since the RE-drain
+  ev = lc.note_probe(True, 18.5)            # 10.5 s out: readmitted
+  assert ev["transition"] == "healthy"
+
+
+def test_readmit_hysteresis_escalates_on_flap():
+  lc = _lc(min_out_s=10.0, flap_window_s=60.0)
+  lc.note_status(0.0, firing=1)
+  lc.note_status(1.0, firing=0, inflight=0)
+  lc.note_probe(True, 5.0)
+  # Enough successes but the 10 s minimum out-time hasn't elapsed.
+  assert lc.note_probe(True, 6.0) is None and lc.state == "probing"
+  ev = lc.note_probe(True, 11.0)
+  assert ev["transition"] == "healthy"
+  # Flap: re-drained 5 s after readmission (inside the 60 s window) — the
+  # out-time doubles, so the next readmit needs >= 20 s out.
+  lc.note_status(16.0, firing=1)
+  assert lc.out_multiplier == 2 and lc.required_out_s() == 20.0
+  lc.note_status(17.0, firing=0, inflight=0)
+  lc.note_probe(True, 20.0)
+  assert lc.note_probe(True, 30.0) is None     # only 14 s out: still held
+  ev = lc.note_probe(True, 37.0)               # 21 s out: readmitted
+  assert ev["transition"] == "healthy"
+  # A drain OUTSIDE the flap window resets the escalation.
+  lc.note_status(300.0, firing=1)
+  assert lc.out_multiplier == 1
+
+
+# ------------------------------------------------------------------ placement
+
+def test_prefix_key_prefers_user_field_then_first_user_message():
+  assert prefix_key({"user": "alice", "messages": [
+    {"role": "user", "content": "hi"}]}) == "user:alice"
+  assert prefix_key({"messages": [
+    {"role": "system", "content": "sys"},
+    {"role": "user", "content": "session-3 turn words"}]}).startswith("session-3")
+  # Multi-part content concatenates the text parts.
+  key = prefix_key({"messages": [{"role": "user", "content": [
+    {"type": "text", "text": "look at"}, {"type": "image_url", "image_url": {}},
+    {"type": "text", "text": "this"}]}]})
+  assert key == "look at this"
+  assert prefix_key({}) == ""
+
+
+def test_rendezvous_is_stable_and_minimally_disruptive():
+  names = ["r0", "r1", "r2"]
+  assert rendezvous("k1", names) == rendezvous("k1", list(reversed(names)))
+  # Removing a replica only remaps keys that lived on it.
+  keys = [f"session-{i}" for i in range(64)]
+  before = {k: rendezvous(k, names) for k in keys}
+  after = {k: rendezvous(k, ["r0", "r1"]) for k in keys}
+  for k in keys:
+    if before[k] != "r2":
+      assert after[k] == before[k]
+
+
+def test_route_affinity_and_queue_depth_spill():
+  views = [{"name": "r0", "queued": 0, "est_wait_s": 0.0},
+           {"name": "r1", "queued": 0, "est_wait_s": 0.0}]
+  pick, spilled = route("session-1", views, spill_depth=2)
+  assert pick in ("r0", "r1") and not spilled
+  # Same key always lands on the same replica while both are level.
+  assert route("session-1", views, 2) == (pick, False)
+  # Affinity target's queue is deep and the other is strictly less loaded:
+  # spill to the least-loaded.
+  deep = [{"name": pick, "queued": 3, "est_wait_s": 4.0},
+          {"name": ("r1" if pick == "r0" else "r0"), "queued": 0, "est_wait_s": 0.0}]
+  alt, spilled = route("session-1", deep, spill_depth=2)
+  assert alt != pick and spilled
+  # Everyone equally deep: no spill (affinity keeps the warm prefix).
+  level = [{"name": "r0", "queued": 3, "est_wait_s": 4.0},
+           {"name": "r1", "queued": 3, "est_wait_s": 4.0}]
+  assert route("session-1", level, 2) == (pick, False)
+  # spill_depth=0 disables spilling entirely.
+  assert route("session-1", deep, 0) == (pick, False)
+  assert route("k", [], 2) is None
+
+
+def test_replica_names_are_ordered_and_stable():
+  assert replica_names(["http://a:1/", "http://b:2"]) == {
+    "r0": "http://a:1", "r1": "http://b:2"}
+
+
+# ------------------------------------------------------------- admission gate
+
+async def _api_client(env=None):
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_tpu.inference.dummy import DummyInferenceEngine
+  from tests.test_orchestration import _caps, _make_node
+
+  engine = DummyInferenceEngine()
+  node = await _make_node("api-node", engine)
+  node.topology.update_node("api-node", _caps())
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30,
+                   default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return client, node, engine
+
+
+async def test_admission_gate_fifo_and_release(monkeypatch):
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "1")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "2")
+  from xotorch_tpu.orchestration.admission import AdmissionGate, AdmissionRejected
+  from xotorch_tpu.inference.dummy import DummyInferenceEngine
+  from tests.test_orchestration import _make_node
+  node = await _make_node("gate-node", DummyInferenceEngine())
+  gate = AdmissionGate(node)
+  assert gate.enabled
+  state, fut = gate.admit("a")
+  assert state == "admitted" and fut is None and gate.inflight == 1
+  s1, f1 = gate.admit("b")
+  s2, f2 = gate.admit("c")
+  assert (s1, s2) == ("queued", "queued") and not f1.done() and not f2.done()
+  with pytest.raises(AdmissionRejected) as exc:
+    gate.admit("d")
+  assert exc.value.queued == 2 and exc.value.retry_after_s > 0
+  assert gate.rejected_total == 1
+  gate.release()
+  assert f1.done() and not f2.done()  # FIFO: b admitted before c
+  gate.release()
+  assert f2.done()
+  gate.release()
+  assert gate.inflight == 0 and gate.admitted_total == 3
+
+
+async def test_admission_cancelled_waiter_leaves_queue(monkeypatch):
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "1")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "4")
+  from xotorch_tpu.orchestration.admission import AdmissionGate
+  from xotorch_tpu.inference.dummy import DummyInferenceEngine
+  from tests.test_orchestration import _make_node
+  node = await _make_node("gate-node", DummyInferenceEngine())
+  gate = AdmissionGate(node)
+  gate.admit("a")
+  queued_hook = []
+  waiter = asyncio.ensure_future(
+    gate.acquire("b", on_queued=lambda: queued_hook.append(True)))
+  await asyncio.sleep(0)
+  assert queued_hook == [True]  # the prefetch lookahead fired on queueing
+  waiter.cancel()
+  with pytest.raises(asyncio.CancelledError):
+    await waiter
+  # The dead waiter left the queue; a release must not grant it a slot.
+  gate.release()
+  assert gate.inflight == 0 and len(gate._queue) == 0
+
+
+async def test_queue_endpoint_defaults_off_shape():
+  client, node, _ = await _api_client()
+  try:
+    q = await (await client.get("/v1/queue")).json()
+    assert q["enabled"] is False and q["cluster"] == {}
+    assert q["admission"]["max_inflight"] == 0
+    # Defaults-off wire parity: the status-bus summary carries no
+    # admission key (no new bytes on the wire at defaults).
+    assert "admission" not in node.metrics_summary()
+  finally:
+    await client.close()
+
+
+async def test_queue_endpoint_reports_gate_and_cluster(monkeypatch):
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "2")
+  client, node, _ = await _api_client()
+  try:
+    q = await (await client.get("/v1/queue")).json()
+    assert q["enabled"] is True
+    assert q["admission"]["max_inflight"] == 2
+    assert q["cluster"]["api-node"]["max_inflight"] == 2
+    assert "admission" in node.metrics_summary()
+  finally:
+    await client.close()
+
+
+async def test_prefetch_endpoint_validates_and_accepts():
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.post("/v1/prefetch", json={"model": "dummy"})
+    assert resp.status == 400
+    resp = await client.post("/v1/prefetch",
+                             json={"model": "not-a-model", "prompt": "x"})
+    assert resp.status == 400
+    resp = await client.post("/v1/prefetch",
+                             json={"model": "dummy", "prompt": "hello world"})
+    assert resp.status == 202 and (await resp.json())["accepted"] is True
+    resp = await client.post("/v1/prefetch", json={
+      "model": "dummy", "messages": [{"role": "user", "content": "hi"}]})
+    assert resp.status == 202
+  finally:
+    await client.close()
+
+
+# ------------------------------------------------- router over two replicas
+
+async def _router_over_two_replicas(monkeypatch):
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.router.app import RouterApp
+
+  monkeypatch.setenv("XOT_ROUTER_POLL_S", "0.25")
+  monkeypatch.setenv("XOT_ROUTER_MIN_OUT_S", "0")
+  clients, nodes = [], []
+  urls = []
+  for _ in range(2):
+    client, node, _ = await _api_client()
+    clients.append(client)
+    nodes.append(node)
+    urls.append(f"http://127.0.0.1:{client.server.port}")
+  router = RouterApp(urls)
+  rclient = TestClient(TestServer(router.app))
+  await rclient.start_server()
+  await router.start()
+  for _ in range(40):  # first poll tick marks the replicas reachable
+    if len(router.routable()) == 2:
+      break
+    await asyncio.sleep(0.1)
+  assert len(router.routable()) == 2
+  return router, rclient, clients, nodes
+
+
+async def _teardown_router(router, rclient, clients):
+  await router.stop()
+  await rclient.close()
+  for c in clients:
+    await c.close()
+
+
+async def test_router_proxies_and_reports(monkeypatch):
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["object"] == "chat.completion"
+    assert "dummy" in data["choices"][0]["message"]["content"]
+    # Streaming relays chunk-for-chunk through the router.
+    resp = await rclient.post("/v1/chat/completions", json={**body, "stream": True})
+    assert resp.status == 200
+    raw = await resp.text()
+    events = [l[6:] for l in raw.split("\n") if l.startswith("data: ")]
+    assert events[-1] == "[DONE]" and len(events) > 1
+    status = await (await rclient.get("/v1/router")).json()
+    assert status["proxied_total"] == 2
+    assert sum(r["routed_total"] for r in status["replicas"].values()) == 2
+    # Same session key -> same replica both times (affinity).
+    routed = [r["routed_total"] for r in status["replicas"].values()]
+    assert sorted(routed) == [0, 2]
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+async def test_router_skips_drained_replica_and_503s_when_none(monkeypatch):
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    # Drain r0: new traffic must land on r1 only.
+    router.replicas["r0"].lifecycle.note_status(0.0, firing=1)
+    for _ in range(3):
+      resp = await rclient.post("/v1/chat/completions", json=body)
+      assert resp.status == 200
+    assert router.replicas["r0"].routed_total == 0
+    assert router.replicas["r1"].routed_total == 3
+    # Both out: a clean 503 with Retry-After, never a hang.
+    router.replicas["r1"].lifecycle.note_status(0.0, firing=1)
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 503
+    assert resp.headers.get("Retry-After")
+    assert (await resp.json())["error"]["code"] == "no_replica"
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+async def test_router_spills_on_replica_429(monkeypatch):
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "1")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "0")
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    # Occupy the affinity replica's only slot directly so the router's
+    # forward gets a 429 and must retry the other replica.
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "session-9 hi"}]}
+    views = [r.view() for r in router.routable()]
+    from xotorch_tpu.router import prefix_key as pk, route as rt
+    target, _ = rt(pk(body), views, 0)
+    target_node = nodes[int(target[1:])]
+    target_node.admission.admit("occupier")
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200  # spilled to the free replica, not 429
+    other = "r1" if target == "r0" else "r0"
+    assert router.replicas[other].spilled_to_total >= 1
+    target_node.admission.release()
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+def test_least_loaded_shared_helper():
+  from xotorch_tpu.router import least_loaded
+  assert least_loaded([]) is None
+  views = [{"name": "r0", "queued": 2, "est_wait_s": 1.0},
+           {"name": "r1", "queued": 0, "est_wait_s": 5.0},
+           {"name": "r2", "queued": 0, "est_wait_s": 0.5}]
+  assert least_loaded(views)["name"] == "r2"  # depth first, then wait
+
+
+async def test_prefetch_rejects_malformed_bodies():
+  client, node, _ = await _api_client()
+  try:
+    # Non-dict messages entries and non-object bodies are 400s, never 500s.
+    resp = await client.post("/v1/prefetch", json={"model": "dummy",
+                                                   "messages": ["hi"]})
+    assert resp.status == 400
+    resp = await client.post("/v1/prefetch", json=[])
+    assert resp.status == 400
+  finally:
+    await client.close()
+
+
+async def test_router_final_429_keeps_well_formed_rejection(monkeypatch):
+  """Every routable replica full with an empty queue: the client gets the
+  replica's own well-formed 429 (Retry-After intact), counted as relayed."""
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "1")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "0")
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    for node in nodes:
+      node.admission.admit(f"occupier-{node.id}")
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    for stream in (False, True):
+      resp = await rclient.post("/v1/chat/completions", json={**body, "stream": stream})
+      assert resp.status == 429, (stream, resp.status)
+      assert resp.headers.get("Retry-After")
+    assert sum(r.relayed_429_total for r in router.replicas.values()) == 2
+    for node in nodes:
+      node.admission.release()
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+async def test_router_unknown_load_never_attracts_spill(monkeypatch):
+  """A replica whose /v1/queue has never answered ranks as maximally
+  loaded: spill and 429 retries avoid it, affinity still works."""
+  from xotorch_tpu.router.app import _Replica
+  rep = _Replica("r9", "http://unused")
+  v = rep.view()
+  assert v["queued"] >= 1 << 30  # unknown load == heavy, never idle
+  rep.queue = {"queued": 1, "est_wait_s": 0.5}
+  assert rep.view() == {"name": "r9", "queued": 1, "est_wait_s": 0.5}
+
+
+async def test_router_rejects_non_object_bodies(monkeypatch):
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    resp = await rclient.post("/v1/chat/completions", json=[1, 2])
+    assert resp.status == 400
+    resp = await rclient.post("/v1/chat/completions", data=b"not json",
+                              headers={"Content-Type": "application/json"})
+    assert resp.status == 400
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+def test_never_reachable_replica_is_joining_not_drained():
+  """A replica that has never answered a poll (still booting) takes no
+  lifecycle transition — every boot would otherwise burn a
+  drain/probe/readmit cycle and pollute the counters the soak verdict
+  reads. Unreachability only drains once the replica was seen alive."""
+  lc = _lc()
+  assert lc.note_status(0.0, reachable=False) is None
+  assert lc.note_status(1.0, reachable=False) is None
+  assert lc.state == "healthy" and lc.drains_total == 0
+  assert lc.note_status(2.0, reachable=True) is None  # joined
+  ev = lc.note_status(3.0, reachable=False)           # NOW it's a failure
+  assert ev["transition"] == "draining" and ev["reason"] == "unreachable"
+
+
+async def test_router_fails_over_on_replica_connection_failure(monkeypatch):
+  """A replica that dies between poll ticks: requests affinity-hashed to it
+  fail over to the healthy replica instead of surfacing a 502, and the
+  dead replica is marked unreachable immediately."""
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "session-7 hi"}]}
+    views = [r.view() for r in router.routable()]
+    from xotorch_tpu.router import prefix_key as pk, route as rt
+    target, _ = rt(pk(body), views, 0)
+    # Kill the affinity replica's HTTP server out from under the router
+    # (the poll loop hasn't noticed yet: lifecycle still routable).
+    idx = int(target[1:])
+    await clients[idx].close()
+    assert router.replicas[target].lifecycle.routable
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200  # served by the survivor, not a 502
+    assert router.replicas[target].reachable is False
+    other = "r1" if target == "r0" else "r0"
+    assert router.replicas[other].routed_total >= 1
+  finally:
+    await _teardown_router(router, rclient, [c for i, c in enumerate(clients)
+                                             if i != idx])
+
+
+async def test_prefetch_prompt_dedupes_router_and_gate_hooks():
+  """The router pre-announce and the gate's on_queued hook name the SAME
+  prompt: only the first within the window reaches the engine."""
+  from xotorch_tpu.inference.dummy import DummyInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from tests.test_orchestration import _caps, _make_node
+
+  class _PrefetchEngine(DummyInferenceEngine):
+    def __init__(self):
+      super().__init__()
+      self.prefetches = []
+
+    async def prefetch_host_prefix(self, shard, prompt):
+      self.prefetches.append(prompt)
+      return True
+
+  engine = _PrefetchEngine()
+  node = await _make_node("pf-node", engine)
+  node.topology.update_node("pf-node", _caps())
+  shard = Shard("dummy", 0, 0, 8)
+  assert await node.prefetch_prompt(shard, "hello session") is True
+  assert await node.prefetch_prompt(shard, "hello session") is False  # deduped
+  assert await node.prefetch_prompt(shard, "другой prompt") is True   # distinct
+  assert engine.prefetches == ["hello session", "другой prompt"]

@@ -322,3 +322,202 @@ def test_parse_prom_sums_and_skips():
 def test_load_plan_defaults_round_trip():
   plan = LoadPlan(seconds=5, rate_rps=2.0)
   assert plan.arrival == "poisson" and plan.records == []
+
+
+# ------------------------------------------- overload / router verdict math
+
+def _rec(t_submit=100.0, ok=True, rejected=False):
+  from tools.soak.loadgen import ClientRecord
+  r = ClientRecord(index=0, offset_s=0.0, streamed=False, session=None)
+  r.t_submit, r.ok, r.rejected = t_submit, ok, rejected
+  return r
+
+
+def test_summarize_overload_rejected_not_aborted():
+  windows = [{"t0": 90.0, "t1": 140.0}]
+  records = [_rec(100.0), _rec(101.0, ok=False, rejected=True),
+             _rec(200.0, ok=False, rejected=True)]
+  events = [{"node_id": "rep0", "ts": 120.0, "reason": "stalled"},
+            {"node_id": "rep0", "ts": 300.0, "reason": "stalled"}]
+  ov = soak.summarize_overload(records, events, windows, server_rejections=2.0)
+  assert ov["client_rejected"] == 2
+  assert ov["client_rejected_in_window"] == 1
+  assert ov["watchdog_aborts_in_window"] == 1  # the ts=300 abort is outside
+  assert ov["server_admission_rejections"] == 2.0
+  assert soak.summarize_overload(records, events, [], 2.0) is None
+
+
+def test_summarize_router_tracks_out_of_rotation_routing():
+  status = {
+    "replicas": {"r0": {"state": "healthy"}, "r1": {"state": "probing"}},
+    "drains_total": 1, "readmits_total": 1, "proxied_total": 40,
+    "no_replica_503_total": 0, "prefetch_announced_total": 3,
+  }
+  # r1: one banked episode that leaked 1 request, plus a still-open episode
+  # that leaked 2 more; r0: healthy traffic between episodes never counts.
+  tracking = {"r1": {"accum": 1, "episode_start": 10, "episode_last": 12},
+              "r0": {"accum": 0, "episode_start": None, "episode_last": None}}
+  rt = soak.summarize_router(status, tracking, expect_drain=True)
+  assert rt["drains_total"] == 1 and rt["readmits_total"] == 1
+  assert rt["routed_while_out"] == {"r1": 3, "r0": 0}
+  assert rt["expect_drain"] is True
+  assert soak.summarize_router(None, tracking, True) is None
+
+
+def test_router_track_is_episode_scoped():
+  """Healthy traffic BETWEEN two drain episodes never counts as
+  routed-while-out (the scrape-side tracker banks per episode)."""
+  from tools.soak.orchestrator import SoakConfig, SoakRing
+  ring = SoakRing(SoakConfig(router=True, replicas=1))
+  ring.note_router_row("r0", "healthy", 5)
+  ring.note_router_row("r0", "draining", 10)  # episode 1 opens at 10
+  ring.note_router_row("r0", "probing", 10)   # no leak
+  ring.note_router_row("r0", "healthy", 15)   # closes clean; healthy traffic follows
+  ring.note_router_row("r0", "draining", 20)  # episode 2 opens at 20
+  ring.note_router_row("r0", "draining", 21)  # one request leaked while out
+  ring.note_router_row("r0", "healthy", 21)
+  track = ring.router_track["r0"]
+  assert track["accum"] == 1 and track["episode_start"] is None
+  # What the verdict consumes: only the in-episode leak, never the healthy
+  # traffic between episodes.
+  rt = soak.summarize_router({"replicas": {}}, ring.router_track, expect_drain=False)
+  assert rt["routed_while_out"] == {"r0": 1}
+
+
+def test_evaluate_red_on_overload_aborts_or_silent_gate():
+  shed_as_aborts = _min_report(overload={
+    "windows": [{"t0": 0, "t1": 10}], "client_rejected": 3,
+    "client_rejected_in_window": 3, "watchdog_aborts_in_window": 2,
+    "abort_events_in_window": [], "server_admission_rejections": 3.0})
+  red = soak.evaluate(shed_as_aborts)
+  assert red["verdict"] == "red"
+  assert any("shed as aborts" in r for r in red["reasons"])
+  assert red["metrics"]["overload_watchdog_aborts"] == 2.0
+
+  silent_gate = _min_report(overload={
+    "windows": [{"t0": 0, "t1": 10}], "client_rejected": 0,
+    "client_rejected_in_window": 0, "watchdog_aborts_in_window": 0,
+    "abort_events_in_window": [], "server_admission_rejections": 0.0})
+  red = soak.evaluate(silent_gate)
+  assert red["verdict"] == "red"
+  assert any("no admission rejection" in r for r in red["reasons"])
+
+  green = _min_report(overload={
+    "windows": [{"t0": 0, "t1": 10}], "client_rejected": 4,
+    "client_rejected_in_window": 4, "watchdog_aborts_in_window": 0,
+    "abort_events_in_window": [], "server_admission_rejections": 5.0})
+  ok = soak.evaluate(green)
+  assert ok["verdict"] == "green"
+  assert ok["metrics"]["overload_client_rejected"] == 4.0
+
+
+def test_evaluate_red_on_router_failover_violations():
+  leaky = _min_report(router={
+    "replicas": {}, "drains_total": 1, "readmits_total": 1,
+    "proxied_total": 10, "no_replica_503_total": 0,
+    "prefetch_announced_total": 0,
+    "routed_while_out": {"r1": 3}, "expect_drain": True})
+  red = soak.evaluate(leaky)
+  assert red["verdict"] == "red"
+  assert any("out of rotation" in r for r in red["reasons"])
+  assert red["metrics"]["router_routed_while_out"] == 3.0
+
+  slept = _min_report(router={
+    "replicas": {}, "drains_total": 0, "readmits_total": 0,
+    "proxied_total": 10, "no_replica_503_total": 0,
+    "prefetch_announced_total": 0,
+    "routed_while_out": {}, "expect_drain": True})
+  red = soak.evaluate(slept)
+  assert red["verdict"] == "red"
+  assert any("no replica to draining" in r for r in red["reasons"])
+  assert any("readmitted" in r for r in red["reasons"])
+
+  green = _min_report(router={
+    "replicas": {}, "drains_total": 1, "readmits_total": 1,
+    "proxied_total": 10, "no_replica_503_total": 0,
+    "prefetch_announced_total": 2,
+    "routed_while_out": {"r1": 0}, "expect_drain": True})
+  ok = soak.evaluate(green)
+  assert ok["verdict"] == "green"
+  assert ok["metrics"]["router_drains_total"] == 1.0
+  assert ok["metrics"]["router_readmits_total"] == 1.0
+  assert ok["metrics"]["router_prefetch_announced"] == 2.0
+
+
+def test_server_percentiles_accepts_origin_set():
+  rows = [["0.1", 1.0], ["1.0", 3.0], ["+Inf", 3.0]]
+  nodes = {"rep0": {"request_seconds": {"sum": 1.0, "count": 3.0, "buckets": rows}},
+           "rep1": {"request_seconds": {"sum": 1.0, "count": 3.0, "buckets": rows}},
+           "mid": {"request_seconds": {"sum": 9.0, "count": 3.0,
+                                       "buckets": [["0.1", 0.0], ["1.0", 0.0],
+                                                   ["+Inf", 3.0]]}}}
+  both = soak.server_percentiles(nodes, {}, "request_seconds",
+                                 only_node={"rep0", "rep1"})
+  assert both["count"] == 6.0
+  one = soak.server_percentiles(nodes, {}, "request_seconds", only_node="rep0")
+  assert one["count"] == 3.0
+  # The excluded mid node's +Inf-heavy histogram never pollutes the view.
+  assert both["p50"] == one["p50"]
+
+
+def test_loadgen_extra_phases_layer_arrivals():
+  import random as _random
+  plan = LoadPlan(seconds=30.0, rate_rps=1.0,
+                  extra_phases=[{"at_s": 10.0, "seconds": 5.0, "rate_rps": 8.0}])
+  rng = _random.Random(plan.seed)
+  base = arrival_offsets(plan.arrival, plan.rate_rps, plan.seconds, rng)
+  extra = arrival_offsets("poisson", 8.0, 5.0, rng)
+  merged = sorted(base + [10.0 + o for o in extra])
+  assert all(10.0 <= t < 15.0 for t in [10.0 + o for o in extra])
+  in_window = [t for t in merged if 10.0 <= t < 15.0]
+  outside_rate = (len(merged) - len(in_window)) / 25.0
+  assert len(in_window) / 5.0 > 3 * max(outside_rate, 0.1)
+
+
+def test_classify_alert_firings_since_excludes_warmup_history():
+  windows = [{"t0": 100.0, "t1": 150.0}]
+  rows = [
+    # Warmup cold-compile firing: fired (and resolved) before the load
+    # window opened — excluded from the verdict by `since`.
+    {"node_id": "n0", "rule": "slo_ttft", "fired_at": 40.0, "resolved_at": 55.0},
+    {"node_id": "n0", "rule": "slo_e2e", "fired_at": 120.0, "resolved_at": 140.0},
+  ]
+  out = soak.classify_alert_firings(rows, windows, since=90.0)
+  assert len(out["firings"]) == 1
+  assert out["firings"][0]["fired_at"] == 120.0
+  assert out["outside_fault_windows"] == 0
+  # Without `since`, the warmup row counts (and is outside every window).
+  assert soak.classify_alert_firings(rows, windows)["outside_fault_windows"] == 1
+
+
+def test_reconcile_quantile_overrides_restrict_family():
+  c = _client()
+  s = _server()
+  # Poison the server's ttft p99 the way an injected non-streamed delay
+  # does: without the override the structural bound fails, with the
+  # median-only override the row is simply not checked.
+  s["ttft_seconds"]["p99"] = 25.0
+  s["ttft_seconds"]["p99_bucket_s"] = 1.0
+  full = soak.reconcile(c, s, tol_s=2.5)
+  assert full["ttft_p99"]["ok"] is False
+  narrowed = soak.reconcile(c, s, tol_s=2.5,
+                            quantile_overrides={"ttft_seconds": (0.5,)})
+  assert "ttft_p99" not in narrowed and "ttft_p95" not in narrowed
+  assert narrowed["ttft_p50"]["ok"] is True
+
+
+def test_summarize_router_baseline_scopes_drains_to_load_window():
+  status = {"replicas": {}, "drains_total": 3, "readmits_total": 3,
+            "proxied_total": 40, "no_replica_503_total": 0,
+            "prefetch_announced_total": 1}
+  # Two of the three drain/readmit cycles happened before load start
+  # (warmup cold-jit alerts): only the in-window one counts.
+  baseline = {"drains_total": 2, "readmits_total": 2}
+  rt = soak.summarize_router(status, {}, expect_drain=True, baseline=baseline)
+  assert rt["drains_total"] == 1 and rt["readmits_total"] == 1
+  # All pre-window: the gray-failure expectation must then fail.
+  rt0 = soak.summarize_router(status, {}, expect_drain=True,
+                              baseline={"drains_total": 3, "readmits_total": 3})
+  red = soak.evaluate(_min_report(router=rt0))
+  assert red["verdict"] == "red"
+  assert any("no replica to draining" in r for r in red["reasons"])

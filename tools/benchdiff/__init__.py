@@ -181,11 +181,25 @@ _SOAK_DOWN = frozenset({
   # twin of a false abort: the rules paged on healthy traffic. A green
   # verdict guarantees zero, so the drift gate can never flag a green run.
   "alert_firings_outside_fault_windows",
+  # A watchdog abort INSIDE the overload window means above-capacity load
+  # was shed as "stalled" aborts instead of admission-gate 429s — the exact
+  # PR 8 failure mode the front door exists to close. A green verdict
+  # guarantees zero, so the gate can never flag a green run.
+  "overload_watchdog_aborts",
+  # Traffic routed to a replica while it was out of rotation: the router
+  # kept placing load on a drained/probing replica — failover is broken.
+  "router_routed_while_out",
 })
 _SOAK_INFO = frozenset({
   "requests_submitted", "requests_ok", "request_errors",
   "request_restarts_total", "peer_evictions_total", "hop_retries_total",
   "dedup_drops_total", "watchdog_aborts_total",
+  # Admission/router magnitudes depend on the injected overload/gray
+  # schedule (an overload burst is SUPPOSED to shed, a gray failure is
+  # supposed to drain), so their drift is informational; the zero bars
+  # above are what a green verdict actually guarantees.
+  "requests_rejected", "admission_rejections_total", "overload_client_rejected",
+  "router_drains_total", "router_readmits_total", "router_prefetch_announced",
   # Raw firing counts depend on the fault schedule (a kill is SUPPOSED to
   # fire the error-rate rule), so magnitude drift is informational.
   "alert_firings_total", "alerts_fired_and_resolved",

@@ -109,18 +109,21 @@ def delta_buckets(final_rows: Iterable, base_rows: Iterable) -> List[list]:
 
 
 def server_percentiles(nodes_final: Dict[str, dict], nodes_base: Dict[str, dict],
-                       family: str, only_node: Optional[str] = None) -> Dict[str, Optional[float]]:
+                       family: str, only_node=None) -> Dict[str, Optional[float]]:
   """Load-window percentiles for one histogram family from per-node
   cluster-metrics summaries (bucket counts shipped by NodeMetrics.summary),
-  ring-merged or restricted to `only_node` (the origin-view families).
+  ring-merged or restricted to `only_node` (the origin-view families; a
+  str, or a SET of node ids — router runs have one origin per replica).
   Nodes missing from the baseline contribute their full final rows (they
   joined mid-run)."""
   from xotorch_tpu.orchestration.metrics import (
     merge_bucket_rows, quantile_bucket_span, quantile_from_buckets)
+  origins = ({only_node} if isinstance(only_node, str)
+             else set(only_node) if only_node is not None else None)
   rows_per_node = []
   count = 0.0
   for node_id, summary in nodes_final.items():
-    if only_node is not None and node_id != only_node:
+    if origins is not None and node_id not in origins:
       continue
     h = summary.get(family) if isinstance(summary, dict) else None
     if not isinstance(h, dict) or not h.get("buckets"):
@@ -146,7 +149,8 @@ def server_percentiles(nodes_final: Dict[str, dict], nodes_base: Dict[str, dict]
 
 
 def reconcile(client: Dict[str, dict], server: Dict[str, dict],
-              tol_s: float, server_over_tol_s: float = 0.5) -> Dict[str, dict]:
+              tol_s: float, server_over_tol_s: float = 0.5,
+              quantile_overrides: Optional[Dict[str, tuple]] = None) -> Dict[str, dict]:
   """Per-percentile client-vs-server agreement rows.
 
   Every family enforces the structural invariant: the server may not exceed
@@ -164,12 +168,21 @@ def reconcile(client: Dict[str, dict], server: Dict[str, dict],
   bound applies.
 
   A side with no observations (e.g. zero streaming requests -> no client
-  TTFT samples) yields ok=None rows: unknowable, not failing."""
+  TTFT samples) yields ok=None rows: unknowable, not failing.
+
+  `quantile_overrides` narrows a family's checked quantiles for runs whose
+  fault schedule makes the tails structurally incomparable — e.g. injected
+  ProcessPrompt delays land in the server's TTFT histogram for EVERY
+  request, while the client TTFT sample only covers streamed ones, so a
+  delay that happens to hit only non-streamed requests puts the 10 s+
+  observations on exactly one side (the token_seconds median-only
+  precedent, applied per run)."""
+  quantiles = {**RECONCILE_QUANTILES, **(quantile_overrides or {})}
   out: Dict[str, dict] = {}
   for family, client_key, mode in RECONCILE_FAMILIES:
     c = client.get(client_key) or {}
     s = server.get(family) or {}
-    for q in RECONCILE_QUANTILES.get(family, QUANTILES):
+    for q in quantiles.get(family, QUANTILES):
       key = f"p{int(q * 100)}"
       cv, sv = c.get(key), s.get(key)
       row: Dict[str, Any] = {"client_s": cv, "server_s": sv, "mode": mode}
@@ -208,7 +221,8 @@ def alert_row_key(row: dict) -> tuple:
 
 
 def classify_alert_firings(rows: Iterable[dict],
-                           fault_windows: Iterable[dict]) -> Dict[str, Any]:
+                           fault_windows: Iterable[dict],
+                           since: Optional[float] = None) -> Dict[str, Any]:
   """Classify the ring's SLO alert firings against the fault schedule. The
   green bar mirrors the abort rule: every FIRING must fall inside an
   active fault window (an alert with no injected fault to blame means the
@@ -216,12 +230,19 @@ def classify_alert_firings(rows: Iterable[dict],
   at least one fired-then-resolved alert — proof the whole pending ->
   firing -> resolved machine works under a real fault. Duplicate rows
   (the same firing seen across scrapes / in both active and recent) merge
-  by identity, preferring the resolved view."""
+  by identity, preferring the resolved view. `since` (unix seconds) bounds
+  the verdict to the MEASURED window: the warmup completion's cold-jit
+  compile legitimately blows any sane latency target, and its
+  fired-then-resolved rows survive in every node's `recent` list — alerts
+  that fired before the load window opened are pre-measurement history,
+  not evidence about steady-state traffic."""
   windows = [(float(w["t0"]), float(w["t1"])) for w in fault_windows]
   out_rows: List[dict] = []
   seen: Dict[tuple, dict] = {}
   for row in rows:
     fired = float(row["fired_at"])
+    if since is not None and fired < since:
+      continue
     key = alert_row_key(row)
     prev = seen.get(key)
     if prev is not None:
@@ -270,6 +291,81 @@ def summarize_anatomy(anatomy: Optional[dict]) -> Optional[Dict[str, Any]]:
     "breakdowns": anatomy.get("breakdowns", 0),
     "stages": stages,
     "unattributed_share_mean": float(unattr.get("share_mean") or 0.0),
+  }
+
+
+def summarize_overload(records: Iterable, abort_events: Iterable[dict],
+                       overload_windows: Iterable[dict],
+                       server_rejections: float) -> Optional[Dict[str, Any]]:
+  """The "rejected, not aborted" overload verdict section. Inside the
+  overload windows (offered load deliberately above capacity) the green bar
+  is: the admission gate shed load as 429s (>= 1 rejection recorded — an
+  overload phase that sheds nothing proves nothing), ZERO watchdog/deadline
+  aborts (the exact failure mode PR 8 documented: without admission
+  control, overload surfaces as "stalled" aborts), and every admitted
+  request completes (client errors are judged by the run-wide
+  errors-outside-fault-windows rule — overload is not an excuse window).
+  None when the run had no overload phase (pre-router reports)."""
+  windows = [(float(w["t0"]), float(w["t1"])) for w in overload_windows]
+  if not windows:
+    return None
+
+  def in_window(ts: float) -> bool:
+    return any(t0 <= ts <= t1 for t0, t1 in windows)
+
+  rejected = [r for r in records if getattr(r, "rejected", False)]
+  aborts_in = [dict(ev) for ev in abort_events
+               if in_window(float(ev.get("ts") or 0.0))]
+  return {
+    "windows": [{"t0": t0, "t1": t1} for t0, t1 in windows],
+    "client_rejected": len(rejected),
+    "client_rejected_in_window": sum(1 for r in rejected if in_window(r.t_submit)),
+    "watchdog_aborts_in_window": len(aborts_in),
+    "abort_events_in_window": aborts_in,
+    "server_admission_rejections": float(server_rejections),
+  }
+
+
+def summarize_router(router_status: Optional[dict], tracking: Optional[dict],
+                     expect_drain: bool,
+                     baseline: Optional[dict] = None) -> Optional[Dict[str, Any]]:
+  """The router/failover verdict section from the final /v1/router scrape
+  plus the orchestrator's out-of-rotation tracking. The green bar: when a
+  gray failure was injected (`expect_drain`), at least one replica went
+  through draining AND was readmitted after the fault cleared, and NO
+  request was routed to a replica while it was out of rotation (drained
+  replicas keep their inflight streams, new traffic lands elsewhere).
+  `baseline` (the /v1/router scrape taken at LOAD START) turns the
+  run-lifetime drain/readmit totals into load-window deltas — a boot-time
+  or warmup-alert drain that resolved before the measured window must not
+  satisfy the injected-fault expectation."""
+  if router_status is None:
+    return None
+  replicas = router_status.get("replicas") or {}
+
+  def delta(key: str) -> int:
+    return max(0, int(router_status.get(key) or 0)
+               - int((baseline or {}).get(key) or 0))
+
+  def out_count(row: dict) -> int:
+    # Banked episodes plus the still-open one (a replica that is STILL out
+    # at report time must not hide its in-episode routing).
+    n = int(row.get("accum") or 0)
+    if row.get("episode_start") is not None:
+      n += max(0, int(row.get("episode_last") or row["episode_start"])
+               - int(row["episode_start"]))
+    return n
+
+  routed_while_out = {name: out_count(row) for name, row in (tracking or {}).items()}
+  return {
+    "replicas": replicas,
+    "drains_total": delta("drains_total"),
+    "readmits_total": delta("readmits_total"),
+    "proxied_total": int(router_status.get("proxied_total") or 0),
+    "no_replica_503_total": int(router_status.get("no_replica_503_total") or 0),
+    "prefetch_announced_total": int(router_status.get("prefetch_announced_total") or 0),
+    "routed_while_out": routed_while_out,
+    "expect_drain": bool(expect_drain),
   }
 
 
@@ -336,7 +432,8 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
       if v is not None:
         out[f"client_{key[:-2]}_{p}_s"] = round(float(v), 4)
   for k_src, k_out in (("submitted", "requests_submitted"), ("ok", "requests_ok"),
-                       ("errors", "request_errors"), ("rps_achieved", "achieved_rps")):
+                       ("errors", "request_errors"), ("rejected", "requests_rejected"),
+                       ("rps_achieved", "achieved_rps")):
     v = client.get(k_src)
     if v is not None:
       out[k_out] = float(v)
@@ -348,10 +445,21 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
       if v is not None:
         out[f"server_{family.replace('_seconds', '')}_{p}_s"] = round(float(v), 4)
   for counter in ("watchdog_aborts", "request_restarts", "peer_evictions",
-                  "hop_retries", "dedup_drops"):
+                  "hop_retries", "dedup_drops", "admission_rejections"):
     v = server.get(counter)
     if v is not None:
       out[f"{counter}_total"] = float(v)
+  overload = report.get("overload")
+  if overload is not None:
+    out["overload_watchdog_aborts"] = float(overload.get("watchdog_aborts_in_window", 0))
+    out["overload_client_rejected"] = float(overload.get("client_rejected", 0))
+  router = report.get("router")
+  if router is not None:
+    out["router_drains_total"] = float(router.get("drains_total", 0))
+    out["router_readmits_total"] = float(router.get("readmits_total", 0))
+    out["router_routed_while_out"] = float(
+      sum((router.get("routed_while_out") or {}).values()))
+    out["router_prefetch_announced"] = float(router.get("prefetch_announced_total", 0))
   aborts = report.get("aborts") or {}
   out["false_aborts"] = float(len(aborts.get("false") or ()))
   leaks = report.get("leaks") or {}
@@ -405,6 +513,31 @@ def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
     reasons.append(f"{outside} client error(s) outside any fault window")
   if not client.get("submitted"):
     reasons.append("no requests were submitted")
+  overload = report.get("overload")
+  if overload is not None:
+    # Overload must be SURVIVED, not shed as aborts: the PR 8 failure mode
+    # (watchdog "stalled" aborts under above-capacity load) is a red in its
+    # own right, and an overload phase that recorded no rejection at all
+    # never actually exercised the gate.
+    aborts_in = overload.get("watchdog_aborts_in_window", 0)
+    if aborts_in:
+      reasons.append(
+        f"overload: {aborts_in} watchdog abort(s) inside the overload window "
+        "— load was shed as aborts, not 429s")
+    if overload.get("server_admission_rejections", 0) < 1:
+      reasons.append("overload: no admission rejection recorded — the phase "
+                     "never drove the gate past its bound")
+  router = report.get("router")
+  if router is not None:
+    for name, n in sorted((router.get("routed_while_out") or {}).items()):
+      if n > 0:
+        reasons.append(f"router: {n} request(s) routed to {name} while it was "
+                       "out of rotation (draining/probing)")
+    if router.get("expect_drain"):
+      if router.get("drains_total", 0) < 1:
+        reasons.append("router: injected gray failure drove no replica to draining")
+      if router.get("readmits_total", 0) < 1:
+        reasons.append("router: no drained replica was readmitted after the fault cleared")
   report["reasons"] = reasons
   report["verdict"] = "green" if not reasons else "red"
   report["metrics"] = flatten_metrics(report)

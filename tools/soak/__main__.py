@@ -59,6 +59,11 @@ def main(argv=None) -> int:
                 "SLO verdict (reconciliation, false aborts, leaks).")
   parser.add_argument("--smoke", action="store_true",
                       help="CI smoke shape: 2 procs, ~60 s Poisson, one injected kill")
+  parser.add_argument("--router-smoke", action="store_true",
+                      help="front-door smoke: router + 2 single-node replicas, an "
+                           "above-capacity overload burst (shed as 429s, never "
+                           "watchdog aborts) and an injected gray failure one "
+                           "replica is drained for and readmitted after")
   parser.add_argument("--seconds", type=float, default=None)
   parser.add_argument("--rps", type=float, default=None)
   parser.add_argument("--procs", type=int, default=None)
@@ -98,7 +103,38 @@ def main(argv=None) -> int:
                  else knobs.get_float("XOT_SOAK_RECON_TOL_S")),
     log_dir=args.log_dir,
   )
-  cfg.tag = args.tag or ("smoke" if args.smoke else "run")
+  cfg.tag = args.tag or ("smoke" if args.smoke
+                         else "router" if args.router_smoke else "run")
+  if args.smoke and args.router_smoke:
+    print("soak: --smoke and --router-smoke are mutually exclusive", file=sys.stderr)
+    return 2
+  if args.router_smoke:
+    # The front-door acceptance shape: two independent single-node replicas
+    # behind the router, admission gates ON (ROUTER_REPLICA_ENV), base load
+    # comfortably subcritical. Phase 1 (overload): an above-capacity burst
+    # that must be shed as 429s with zero watchdog aborts. Phase 2 (gray
+    # failure): a ProcessPrompt delay on replica 1 — 10 s against a 6 s SLO
+    # target, health checks green — that must fire its burn-rate alert,
+    # drain the replica (inflight completes, new traffic fails over), and
+    # end in readmission once the fault clears. recon_tol_s is wide because
+    # queue waits and the injected delay are client-visible by design; the
+    # structural server-never-over-client bound stays tight.
+    cfg.router = True
+    cfg.replicas = 2
+    if args.seconds is None:
+      cfg.seconds = 110.0
+    if args.rps is None:
+      cfg.rate_rps = 0.4
+    if args.max_tokens is None:
+      cfg.max_tokens = 6
+    if args.recon_tol_s is None:
+      cfg.recon_tol_s = 30.0
+    # A SIMULTANEOUS 24-request burst: with max_inflight=1 + queue_depth=2
+    # per replica, at most 6 can be admitted/queued across the fleet at one
+    # instant — the rest MUST be 429s no matter how fast the machine is (a
+    # rate-shaped burst gets absorbed by a fast CI runner).
+    cfg.overload = {"at_s": 8.0, "count": 24}
+    cfg.gray = {"node": 1, "at_s": 24.0, "hold_s": 24.0, "delay_s": 10.0}
   if args.smoke:
     # The acceptance shape: one mid-run kill of the non-API node, load
     # sized so a laptop/CI runner finishes the whole arc in a few minutes.
@@ -116,9 +152,10 @@ def main(argv=None) -> int:
     cfg.faults.append(_parse_kill(f"{cfg.procs - 1}@{kill_at:g}"))
   cfg.faults.extend(_parse_kill(s) for s in args.kill)
   cfg.faults.extend(_parse_rules(s) for s in args.rules)
+  node_count = cfg.replicas if cfg.router else cfg.procs
   for phase in cfg.faults:
-    if not 0 <= phase.node < cfg.procs:
-      print(f"soak: fault names node {phase.node} but the ring has {cfg.procs}",
+    if not 0 <= phase.node < node_count:
+      print(f"soak: fault names node {phase.node} but the run has {node_count} node(s)",
             file=sys.stderr)
       return 2
   cfg.out = args.out or f"SOAK_{cfg.tag}.json"
@@ -142,6 +179,16 @@ def main(argv=None) -> int:
   print(f"  alerts: firings={len(al.get('firings') or ())} "
         f"outside_fault_windows={al.get('outside_fault_windows', 0)} "
         f"fired_and_resolved={al.get('fired_and_resolved_in_window', 0)}")
+  ov = report.get("overload")
+  if ov is not None:
+    print(f"  overload: client_rejected={ov.get('client_rejected')} "
+          f"server_rejections={ov.get('server_admission_rejections')} "
+          f"aborts_in_window={ov.get('watchdog_aborts_in_window')}")
+  rt = report.get("router")
+  if rt is not None:
+    print(f"  router: drains={rt.get('drains_total')} readmits={rt.get('readmits_total')} "
+          f"routed_while_out={sum((rt.get('routed_while_out') or {}).values())} "
+          f"prefetch_announced={rt.get('prefetch_announced_total')}")
   for reason in report.get("reasons", []):
     print(f"  RED: {reason}")
   rc = 0 if report.get("verdict") == "green" else 1

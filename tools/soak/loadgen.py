@@ -99,6 +99,10 @@ class ClientRecord:
   t_submit: float = 0.0  # unix seconds
   status: Optional[int] = None
   ok: bool = False
+  # 429 at the admission gate (or relayed by the router): load the server
+  # SHED on purpose, counted separately from errors — "rejected, not
+  # aborted" is precisely the overload verdict the soak proves.
+  rejected: bool = False
   error: Optional[str] = None
   ttft_s: Optional[float] = None
   tpot_s: Optional[float] = None
@@ -123,6 +127,13 @@ class LoadPlan:
   seed: int = 1234
   burst_size: int = 4
   request_timeout_s: float = 120.0
+  # Extra open-loop arrival windows LAYERED on the base schedule — the
+  # overload phase's shapes: {"at_s", "seconds", "rate_rps"} (a Poisson
+  # window) or {"at_s", "count"} (`count` SIMULTANEOUS arrivals — the
+  # deterministic above-capacity burst: a rate window can be absorbed by a
+  # fast machine, a same-instant batch larger than every admission queue
+  # cannot). Offered load is base + extra, never completion-throttled.
+  extra_phases: List[dict] = field(default_factory=list)
   records: List[ClientRecord] = field(default_factory=list)
 
 
@@ -141,6 +152,13 @@ async def _do_request(session, port: int, plan: LoadPlan, rec: ClientRecord,
   try:
     async with session.post(url, json=body) as resp:
       rec.status = resp.status
+      if resp.status == 429:
+        # Admission-control shed: a deliberate, well-formed rejection (the
+        # body carries queue depth + Retry-After), not a failure.
+        rec.rejected = True
+        rec.e2e_s = time.monotonic() - t0
+        await resp.read()
+        return
       if not rec.streamed:
         data = await resp.json()
         rec.e2e_s = time.monotonic() - t0
@@ -201,6 +219,13 @@ async def run_load(port: int, plan: LoadPlan) -> List[ClientRecord]:
   rng = random.Random(plan.seed)
   offsets = arrival_offsets(plan.arrival, plan.rate_rps, plan.seconds, rng,
                             burst_size=plan.burst_size)
+  for phase in plan.extra_phases:
+    if phase.get("count"):
+      extra = [0.0] * int(phase["count"])
+    else:
+      extra = arrival_offsets("poisson", float(phase["rate_rps"]),
+                              float(phase["seconds"]), rng)
+    offsets = sorted(offsets + [float(phase["at_s"]) + o for o in extra])
   prompts = PromptFactory(rng, reuse_p=plan.session_reuse)
   plan.records = []
   tasks: List[asyncio.Task] = []

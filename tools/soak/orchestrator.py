@@ -84,6 +84,31 @@ class FaultPhase:
   rules: Optional[list] = None   # rules: /v1/debug/faults payload
 
 
+
+
+# Child env for ROUTER-mode replicas: bounded admission on (the gate the
+# overload phase exercises) and CPU-safe latency SLO targets tight enough
+# that the injected gray-failure delay (>= 2x the target) provably fires a
+# burn-rate rule while healthy CI traffic stays far under them.
+ROUTER_REPLICA_ENV = {
+  "XOT_MAX_INFLIGHT": "1",
+  "XOT_ADMIT_QUEUE_DEPTH": "2",
+  "XOT_SLO_TTFT_S": "6",
+  "XOT_SLO_E2E_S": "6",
+}
+
+# Router process env: CI-timescale cadences (1 s polls, 5 s minimum
+# out-time, 2 canaries) so drain -> probe -> readmit completes inside a
+# short smoke window.
+ROUTER_ENV = {
+  "XOT_ROUTER_POLL_S": "1",
+  "XOT_ROUTER_MIN_OUT_S": "5",
+  "XOT_ROUTER_PROBES": "2",
+  "XOT_ROUTER_SPILL_DEPTH": "1",
+  "XOT_ROUTER_PROBE_TOKENS": "2",
+}
+
+
 @dataclass
 class SoakConfig:
   # Knob-backed fields read the XOT_SOAK_* registry at construction so a
@@ -113,6 +138,21 @@ class SoakConfig:
   drain_timeout_s: float = 120.0
   restarts: int = 1              # XOT_REQUEST_RESTARTS for the children
   alert_env: Dict[str, str] = field(default_factory=lambda: dict(SOAK_ALERT_ENV))
+  # --- router mode (the replicated-rings front door) ---
+  # router=True spawns `replicas` INDEPENDENT single-node rings (disjoint
+  # discovery ports) plus a `python -m xotorch_tpu.router` process, and the
+  # load targets the router. `overload` layers an above-capacity arrival
+  # window on the base load ({"at_s", "seconds", "rate_rps"}); `gray`
+  # installs a ProcessPrompt delay on one replica for a timed phase
+  # ({"node", "at_s", "hold_s", "delay_s"}) — the delayed-but-health-green
+  # failure the router must drain and later readmit.
+  router: bool = False
+  replicas: int = 2
+  overload: Optional[dict] = None
+  gray: Optional[dict] = None
+  router_port: int = 53590
+  replica_env: Dict[str, str] = field(default_factory=lambda: dict(ROUTER_REPLICA_ENV))
+  router_env: Dict[str, str] = field(default_factory=lambda: dict(ROUTER_ENV))
 
 
 class SoakRing:
@@ -124,7 +164,21 @@ class SoakRing:
     self.procs: Dict[str, object] = {}
     self.logs: Dict[str, object] = {}
     self.ports: Dict[str, int] = {}
-    self.names: List[str] = [f"soak-{i}" for i in range(cfg.procs)]
+    # Router mode: N independent single-node rings, named rep<i>; the node
+    # id doubles as the replica id everywhere (metrics, cluster views).
+    self.names: List[str] = ([f"rep{i}" for i in range(cfg.replicas)] if cfg.router
+                             else [f"soak-{i}" for i in range(cfg.procs)])
+    self.router_proc = None
+    self.router_log = None
+    self.last_router: Optional[dict] = None
+    # Out-of-rotation routing tracker, per EPISODE: while the router
+    # reports a replica draining/probing, its routed_total is baselined at
+    # the episode's first scrape and any growth accumulates into `accum`
+    # when the episode closes (replica healthy again). Episode-scoped so
+    # requests legitimately routed BETWEEN two drains (replica healthy)
+    # never count as routed-while-out. accum + the live episode's delta
+    # > 0 means traffic landed on a drained replica — the failover red.
+    self.router_track: Dict[str, Dict[str, Optional[int]]] = {}
     self.last_metrics: Dict[str, Dict[str, float]] = {}
     self.last_flight: Dict[str, dict] = {}
     self.last_cluster: Optional[dict] = None
@@ -143,20 +197,37 @@ class SoakRing:
     self.killed: set = set()
 
   def spawn(self, log_dir: Path) -> None:
-    from tests.xproc_harness import spawn_node
+    import subprocess
+    import sys as _sys
+    from tests.xproc_harness import node_env, spawn_node
     self.dump_dir = log_dir / "flight_dumps"
     self.dump_dir.mkdir(parents=True, exist_ok=True)
     for i, name in enumerate(self.names):
       self.ports[name] = self.cfg.api_base + i
       self.logs[name] = open(log_dir / f"{name}.log", "w")
+      # Router mode gives every replica a DISJOINT discovery port pair so
+      # the "replicas" stay independent rings instead of gossiping into one.
+      udp = self.cfg.udp_port + (2 * i if self.cfg.router else 0)
+      extra = {"XOT_REQUEST_RESTARTS": str(self.cfg.restarts),
+               "XOT_FLIGHT_DUMP_DIR": str(self.dump_dir),
+               **self.cfg.alert_env}
+      if self.cfg.router:
+        extra.update(self.cfg.replica_env)
       self.procs[name] = spawn_node(
-        name, self.cfg.api_base + i, self.cfg.udp_port, self.cfg.udp_port,
+        name, self.cfg.api_base + i, udp, udp,
         self.cfg.grpc_base + i, self.logs[name], model=self.cfg.model,
-        response_timeout=180,
-        extra_env={"XOT_REQUEST_RESTARTS": str(self.cfg.restarts),
-                   "XOT_FLIGHT_DUMP_DIR": str(self.dump_dir),
-                   **self.cfg.alert_env},
+        response_timeout=180, extra_env=extra,
       )
+    if self.cfg.router:
+      self.router_log = open(log_dir / "router.log", "w")
+      replica_flags = []
+      for name in self.names:
+        replica_flags += ["--replica", f"http://127.0.0.1:{self.ports[name]}"]
+      self.router_proc = subprocess.Popen(
+        [_sys.executable, "-m", "xotorch_tpu.router",
+         "--port", str(self.cfg.router_port), *replica_flags],
+        env=node_env(**self.cfg.router_env), stdout=self.router_log,
+        stderr=subprocess.STDOUT)
 
   def wait_ready(self) -> None:
     from tests.xproc_harness import http_get, wait_for
@@ -165,12 +236,20 @@ class SoakRing:
       wait_for(lambda p=port: http_get(p, "/healthcheck").get("status") == "ok",
                180, f"{name} API health", proc=self.procs[name],
                log_path=self._log_path(name))
-    n = len(self.names)
+    # Router mode: each replica is its own 1-node ring; plain mode: every
+    # node must see the full ring.
+    n = 1 if self.cfg.router else len(self.names)
     for name in self.names:
       port = self.ports[name]
       wait_for(lambda p=port: len(http_get(p, "/v1/topology").get("nodes", {})) == n,
                120, f"{name} sees {n}-node ring", proc=self.procs[name],
                log_path=self._log_path(name))
+    if self.cfg.router:
+      wait_for(lambda: http_get(self.cfg.router_port, "/healthcheck")
+               .get("routable") == len(self.names),
+               60, f"router routes all {len(self.names)} replicas",
+               proc=self.router_proc,
+               log_path=getattr(self.router_log, "name", None))
 
   def _log_path(self, name: str):
     f = self.logs.get(name)
@@ -181,9 +260,12 @@ class SoakRing:
     return proc is not None and proc.poll() is None and name not in self.killed
 
   def get_json(self, name: str, path: str, timeout: float = 5.0) -> Optional[dict]:
+    return self.get_json_port(self.ports[name], path, timeout)
+
+  def get_json_port(self, port: int, path: str, timeout: float = 5.0) -> Optional[dict]:
     try:
       with urllib.request.urlopen(
-          f"http://127.0.0.1:{self.ports[name]}{path}", timeout=timeout) as r:
+          f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
         return json.loads(r.read())
     except Exception:
       return None
@@ -206,30 +288,66 @@ class SoakRing:
       flight = self.get_json(name, "/v1/debug/flight")
       if flight is not None:
         self.last_flight[name] = flight
-    api = self.names[0]
-    if self.alive(api):
-      cluster = self.get_json(api, "/v1/cluster/metrics")
+    # Cluster/alert rollups. A plain ring's node 0 sees every peer via the
+    # status bus; router-mode replicas are DISJOINT rings, so each head is
+    # scraped and the node rows merged into one cluster/alert view (node
+    # ids are unique across replicas by construction).
+    heads = [n for n in (self.names if self.cfg.router else self.names[:1])
+             if self.alive(n)]
+    merged_cluster: Dict[str, dict] = {}
+    merged_alert_nodes: Dict[str, dict] = {}
+    for head in heads:
+      cluster = self.get_json(head, "/v1/cluster/metrics")
       if cluster is not None:
-        self.last_cluster = cluster
-      perf = self.get_json(api, "/v1/perf")
+        merged_cluster.update(cluster.get("nodes") or {})
+      alerts = self.get_json(head, "/v1/alerts")
+      if alerts is not None:
+        merged_alert_nodes.update(alerts.get("nodes") or {})
+    if merged_cluster:
+      self.last_cluster = {"nodes": merged_cluster, "count": len(merged_cluster)}
+    if merged_alert_nodes:
+      self.last_alerts = {
+        "nodes": merged_alert_nodes,
+        "cluster": {"firing": sum(int(a.get("firing") or 0)
+                                  for a in merged_alert_nodes.values())},
+      }
+      for row in verdicts.alert_rows_of(self.last_alerts):
+        key = verdicts.alert_row_key(row)
+        prev = self.alert_rows.get(key)
+        if prev is None or (row.get("resolved_at") is not None
+                            and prev.get("resolved_at") is None):
+          self.alert_rows[key] = row
+    if heads:
+      perf = self.get_json(heads[0], "/v1/perf")
       if perf is not None:
         self.last_perf = perf
       # The origin's latency-anatomy rollup: stage-contribution
       # percentiles over its reservoir of skew-corrected breakdowns.
-      anatomy = self.get_json(api, "/v1/anatomy")
+      anatomy = self.get_json(heads[0], "/v1/anatomy")
       if anatomy is not None:
         self.last_anatomy = anatomy
-      # The cluster-rolled alert view: node 0 sees every peer's active +
-      # recent alerts via the status bus, so one scrape covers the ring.
-      alerts = self.get_json(api, "/v1/alerts")
-      if alerts is not None:
-        self.last_alerts = alerts
-        for row in verdicts.alert_rows_of(alerts):
-          key = verdicts.alert_row_key(row)
-          prev = self.alert_rows.get(key)
-          if prev is None or (row.get("resolved_at") is not None
-                              and prev.get("resolved_at") is None):
-            self.alert_rows[key] = row
+    if self.cfg.router and self.router_proc is not None and self.router_proc.poll() is None:
+      status = self.get_json_port(self.cfg.router_port, "/v1/router")
+      if status is not None:
+        self.last_router = status
+        for name, row in (status.get("replicas") or {}).items():
+          self.note_router_row(name, str(row.get("state") or ""),
+                               int(row.get("routed_total") or 0))
+
+  def note_router_row(self, name: str, state: str, routed: int) -> None:
+    """One router-scrape observation into the out-of-rotation tracker."""
+    track = self.router_track.setdefault(
+      name, {"accum": 0, "episode_start": None, "episode_last": None})
+    if state in ("draining", "probing"):
+      if track["episode_start"] is None:
+        track["episode_start"] = routed
+      track["episode_last"] = routed
+    elif track["episode_start"] is not None:
+      # Episode closed (readmitted): bank its delta, reset the baseline.
+      track["accum"] += max(
+        0, int(track["episode_last"] or track["episode_start"])
+        - int(track["episode_start"]))
+      track["episode_start"] = track["episode_last"] = None
 
   def kill(self, index: int) -> None:
     name = self.names[index]
@@ -240,7 +358,13 @@ class SoakRing:
 
   def teardown(self) -> None:
     from tests.xproc_harness import teardown_nodes
-    teardown_nodes(self.procs, self.logs)
+    procs = dict(self.procs)
+    logs = dict(self.logs)
+    if self.router_proc is not None:
+      procs["router"] = self.router_proc
+      if self.router_log is not None:
+        logs["router"] = self.router_log
+    teardown_nodes(procs, logs)
 
   def collect_flight_dumps(self) -> Dict[str, dict]:
     """Parse the post-mortem spool: {node_id: dump} from every
@@ -390,13 +514,32 @@ async def run_soak(cfg: SoakConfig) -> dict:
   import tempfile
   log_dir = Path(cfg.log_dir) if cfg.log_dir else Path(tempfile.mkdtemp(prefix="xot_soak_"))
   log_dir.mkdir(parents=True, exist_ok=True)
+  if cfg.gray is not None:
+    # The gray-failure drain phase: a timed ProcessPrompt delay on one
+    # replica — requests there get slower (visible to ITS burn-rate rules
+    # and to clients) while /healthcheck stays green. Rides the existing
+    # rules-phase machinery, so its window excuses the resulting alert
+    # firings exactly like any injected fault.
+    g = cfg.gray
+    cfg.faults.append(FaultPhase(
+      kind="rules", node=int(g.get("node", cfg.replicas - 1)),
+      at_s=float(g["at_s"]), until_s=float(g["at_s"]) + float(g.get("hold_s", 20.0)),
+      grace_s=float(g.get("grace_s", 60.0)),
+      rules=[{"rpc": "ProcessPrompt", "action": "delay", "nth": 1,
+              "times": 1000000, "delay_s": float(g.get("delay_s", 12.0))}]))
   ring = SoakRing(cfg)
   t_wall_start = time.time()
   loop = asyncio.get_running_loop()
   try:
     await loop.run_in_executor(None, ring.spawn, log_dir)
     await loop.run_in_executor(None, ring.wait_ready)
-    api_port = ring.ports[ring.names[0]]
+    if cfg.router:
+      # Pay every replica's cold jit directly, then prove the router path.
+      for name in ring.names:
+        await _chat_once(ring.ports[name], cfg.model)
+      api_port = cfg.router_port
+    else:
+      api_port = ring.ports[ring.names[0]]
     await _chat_once(api_port, cfg.model)
     # Let the warmup's metric summaries ride one topology tick so the
     # baseline cluster scrape includes every node's post-warmup counters.
@@ -404,14 +547,22 @@ async def run_soak(cfg: SoakConfig) -> dict:
     await loop.run_in_executor(None, ring.scrape_once)
     base_cluster = (ring.last_cluster or {}).get("nodes", {})
     base_metrics = {n: dict(m) for n, m in ring.last_metrics.items()}
+    # Router baseline at load start: boot-time/warmup drains (cold-jit
+    # alerts, a poll racing a replica's bind) resolved before the measured
+    # window must not satisfy the gray-failure drain/readmit expectation —
+    # and the routed-while-out tracker starts fresh for the same reason.
+    base_router = dict(ring.last_router) if ring.last_router else None
+    ring.router_track.clear()
 
     plan = LoadPlan(seconds=cfg.seconds, rate_rps=cfg.rate_rps, arrival=cfg.arrival,
                     stream_fraction=cfg.stream_fraction, session_reuse=cfg.session_reuse,
-                    max_tokens=cfg.max_tokens, model=cfg.model, seed=cfg.seed)
+                    max_tokens=cfg.max_tokens, model=cfg.model, seed=cfg.seed,
+                    extra_phases=[dict(cfg.overload)] if cfg.overload else [])
     stop_scraper = asyncio.Event()
     scraper = asyncio.ensure_future(_scraper(ring, stop_scraper))
     windows: List[dict] = []
     t_load_start = time.monotonic()
+    t_wall_load_start = time.time()
     fault_task = asyncio.ensure_future(_fault_driver(ring, t_load_start, windows))
     try:
       records = await run_load(api_port, plan)
@@ -450,7 +601,9 @@ async def run_soak(cfg: SoakConfig) -> dict:
     dumps = ring.collect_flight_dumps()
 
     report = _build_report(cfg, ring, records, windows, base_cluster, base_metrics,
-                           settle_a, settle_b, drained, t_wall_start, dumps=dumps)
+                           settle_a, settle_b, drained, t_wall_start, dumps=dumps,
+                           t_wall_load_start=t_wall_load_start,
+                           base_router=base_router)
     verdicts.evaluate(report)
     if cfg.out:
       verdicts.write_report(report, cfg.out)
@@ -462,14 +615,21 @@ async def run_soak(cfg: SoakConfig) -> dict:
 def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
                   base_cluster, base_metrics, settle_a, settle_b,
                   drained: bool, t_wall_start: float,
-                  dumps: Optional[Dict[str, dict]] = None) -> dict:
+                  dumps: Optional[Dict[str, dict]] = None,
+                  t_wall_load_start: Optional[float] = None,
+                  base_router: Optional[dict] = None) -> dict:
   ok_recs = [r for r in records if r.ok]
-  err_recs = [r for r in records if not r.ok]
+  rejected_recs = [r for r in records if getattr(r, "rejected", False)]
+  # 429s are deliberate admission sheds, not failures: they never reached
+  # the ring, so they belong to neither the error count nor the e2e
+  # reconciliation sample (the server only times requests it ADMITTED).
+  err_recs = [r for r in records if not r.ok and not getattr(r, "rejected", False)]
   # The server's request_seconds family records "any outcome" (finish OR
   # abort), so the client e2e sample it reconciles against must count
   # errored requests too — excluding them would compare a survivors-only
   # distribution against an everyone distribution.
-  e2e_all = [r.e2e_s for r in records if r.e2e_s is not None]
+  e2e_all = [r.e2e_s for r in records
+             if r.e2e_s is not None and not getattr(r, "rejected", False)]
 
   def in_window(rec) -> bool:
     t_fail = rec.t_submit + (rec.e2e_s or 0.0)
@@ -480,6 +640,7 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   client = {
     "submitted": len(records),
     "ok": len(ok_recs),
+    "rejected": len(rejected_recs),
     "errors": len(err_recs),
     "errors_in_fault_windows": len(err_recs) - len(errors_outside),
     "errors_outside_fault_windows": len(errors_outside),
@@ -500,7 +661,9 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   }
 
   nodes_final = (ring.last_cluster or {}).get("nodes", {})
-  origin = ring.names[0]  # node ids == spawn names; names[0] runs the API
+  # Node ids == spawn names; names[0] runs the API. Router runs have one
+  # origin PER replica (each head node's first touch ≈ HTTP arrival there).
+  origin = set(ring.names) if cfg.router else ring.names[0]
   server = {}
   for family, _client_key, mode in verdicts.RECONCILE_FAMILIES:
     # Two-sided families compare like with like: only the ORIGIN node's
@@ -516,6 +679,7 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
       ("peer_evictions", "xot_peer_evictions_total"),
       ("dedup_drops", "xot_dedup_drops_total"),
       ("hop_retries", "xot_hop_retries_total"),
+      ("admission_rejections", "xot_admission_rejections_total"),
       ("requests", "xot_requests_total"),
       ("tokens", "xot_tokens_total"),
   ):
@@ -544,7 +708,8 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   # Classify the accumulated superset, not just the settle scrape: a
   # firing on a since-evicted peer survives here even though its compact
   # no longer rides the final /v1/alerts response.
-  alerts = verdicts.classify_alert_firings(list(ring.alert_rows.values()), windows)
+  alerts = verdicts.classify_alert_firings(list(ring.alert_rows.values()), windows,
+                                           since=t_wall_load_start)
 
   report = {
     "schema": verdicts.SCHEMA,
@@ -557,13 +722,27 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
       "session_reuse": cfg.session_reuse, "max_tokens": cfg.max_tokens,
       "model": cfg.model, "seed": cfg.seed, "recon_tol_s": cfg.recon_tol_s,
       "restarts": cfg.restarts,
+      "router": cfg.router, "replicas": cfg.replicas if cfg.router else None,
+      "overload": cfg.overload, "gray": cfg.gray,
       "faults": [{"kind": p.kind, "node": p.node, "at_s": p.at_s,
                   "grace_s": p.grace_s} for p in cfg.faults],
     },
     "fault_windows": windows,
     "client": client,
     "server": server,
-    "reconciliation": verdicts.reconcile(client, server, cfg.recon_tol_s),
+    # Runs with injected DELAY rules restrict TTFT reconciliation to the
+    # median: the delay lands in the server's TTFT histogram for every
+    # request, but the client TTFT sample covers only streamed ones — a
+    # delay hitting non-streamed requests puts the slow observations on
+    # exactly one side, making the tails structurally incomparable (the
+    # token_seconds median-only precedent, applied per run). Keyed on the
+    # rules' ACTIONS: error/drop/kill rules phases keep the full check.
+    "reconciliation": verdicts.reconcile(
+      client, server, cfg.recon_tol_s,
+      quantile_overrides=({"ttft_seconds": (0.5,)} if any(
+        p.kind == "rules" and any(str(r.get("action")) == "delay"
+                                  for r in (p.rules or []))
+        for p in cfg.faults) else None)),
     "aborts": aborts,
     "alerts": alerts,
     "anatomy": verdicts.summarize_anatomy(ring.last_anatomy),
@@ -575,6 +754,20 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
     "leaks": verdicts.leak_check(settle_a, settle_b),
     "drained": drained,
   }
+  if cfg.overload and t_wall_load_start is not None:
+    # Abort evidence gets a 45 s tail past the burst: a queue built during
+    # the window would shed as "stalled" aborts up to a stall timeout later
+    # — exactly the failure the gate must have prevented.
+    t0 = t_wall_load_start + float(cfg.overload["at_s"]) - 1.0
+    t1 = (t_wall_load_start + float(cfg.overload["at_s"])
+          + float(cfg.overload.get("seconds", 0.0)) + 45.0)
+    report["overload"] = verdicts.summarize_overload(
+      records, events, [{"t0": t0, "t1": t1}],
+      server.get("admission_rejections", 0.0))
+  if cfg.router:
+    report["router"] = verdicts.summarize_router(
+      ring.last_router, ring.router_track, expect_drain=cfg.gray is not None,
+      baseline=base_router)
   if not drained:
     leaked = report["leaks"]
     leaked["ok"] = False

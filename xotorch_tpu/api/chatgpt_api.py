@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import os
 import time
 import uuid
@@ -22,6 +23,7 @@ from aiohttp import web
 from xotorch_tpu.inference.engine import inference_engine_classes
 from xotorch_tpu.inference.tokenizers import resolve_tokenizer
 from xotorch_tpu.models.registry import build_base_shard, get_model_card, get_repo, model_cards, pretty_name
+from xotorch_tpu.orchestration.admission import AdmissionRejected
 from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
 
@@ -160,6 +162,14 @@ class ChatGPTAPI:
     # Live roofline attribution: analytic ceilings + achieved throughput +
     # per-executable time/bytes, with the ring's peers via the status bus.
     r.add_get("/v1/perf", self.handle_get_perf)
+    # Bounded admission surface (XOT_MAX_INFLIGHT): live inflight/queue
+    # depth + estimated wait, with every peer's compact via the status bus
+    # — what the router places load by instead of guessing.
+    r.add_get("/v1/queue", self.handle_get_queue)
+    # Anticipatory KV prefetch pre-announce (PRESERVE, arXiv 2501.08192):
+    # the router names a queued request's prompt so the host-to-HBM warm
+    # prefix restore starts while the request is still in flight to us.
+    r.add_post("/v1/prefetch", self.handle_post_prefetch)
     r.add_post("/v1/trace/device/start", self.handle_device_trace_start)
     r.add_post("/v1/trace/device/stop", self.handle_device_trace_stop)
     r.add_get("/", self.handle_root)
@@ -410,6 +420,68 @@ class ChatGPTAPI:
     if local is not None:
       cluster[self.node.id] = local
     return web.json_response({"node_id": self.node.id, **report, "cluster": cluster})
+
+  async def handle_get_queue(self, request):
+    """Admission surface: this node's gate state (inflight, queued,
+    admitted/queued/rejected totals, estimated wait from the cost-model
+    tok/s view) plus each peer's admission compact off the status bus —
+    the load signal the router routes by. `enabled: false` with an empty
+    cluster when every node runs at the default (gate off)."""
+    gate = self.node.admission
+    local = gate.compact()
+    cluster = {self.node.id: local} if gate.enabled else {}
+    for nid, summary in self.node.peer_metrics.items():
+      adm = summary.get("admission") if isinstance(summary, dict) else None
+      if not adm:
+        continue
+      if self.node.peer_metrics_stale(nid):
+        adm = {**adm, "stale": True}
+      cluster[nid] = adm
+    return web.json_response({
+      "node_id": self.node.id, "enabled": gate.enabled,
+      # Ring-visible in-flight work on THIS node: the router's drain
+      # completion signal even when the gate itself is disabled.
+      "active_requests": len(self.node.outstanding_requests),
+      "admission": local, "cluster": cluster,
+    })
+
+  async def handle_post_prefetch(self, request):
+    """Pre-announce a queued request's prompt so the engine's host-to-HBM
+    warm-prefix restore (PR 3 tier, PRESERVE discipline) starts before the
+    request itself arrives. Body: {model, prompt} or {model, messages[,
+    tools]} — messages build the exact chat-template prompt a completion
+    would run, so the prefix keys match. Fire-and-forget: 202 means the
+    prefetch was scheduled, never that a warm prefix exists."""
+    try:
+      data = await request.json() if request.can_read_body else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": "body must be JSON"}}, status=400)
+    if not isinstance(data, dict):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "body must be a JSON object"}}, status=400)
+    model = self._resolve_model(data.get("model"))
+    shard = build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"detail": f"Invalid model: {model}"}, status=400)
+    prompt = data.get("prompt")
+    messages = data.get("messages")
+    if not prompt and messages:
+      if (not isinstance(messages, list)
+          or not all(isinstance(m, dict) for m in messages)):
+        return web.json_response(
+          {"error": {"type": "invalid_request_error",
+                     "message": "messages must be a list of objects"}}, status=400)
+      prompt, _ = await self._request_prompt(model, shard, messages,
+                                             data.get("tools"))
+    if not prompt or not isinstance(prompt, str):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "a non-empty `prompt` or `messages` list is required"}},
+        status=400)
+    spawn_detached(self.node.prefetch_prompt(shard, prompt))
+    return web.json_response({"accepted": True, "model": model}, status=202)
 
   async def handle_get_metrics(self, request):
     body, content_type = self.node.metrics.exposition_with_content_type()
@@ -749,6 +821,19 @@ class ChatGPTAPI:
       return self.default_model
     return model
 
+  async def _request_prompt(self, model: str, shard, messages: List[dict],
+                            tools: Optional[list]):
+    """THE prompt a completion for these messages would run: server system
+    prompt injected when absent, chat template applied. One copy shared by
+    completions, token-encode, and prefetch — the prefetch contract is
+    that its prefix keys match a real completion's, so the construction
+    must never be able to drift between the three. Returns
+    (prompt, tokenizer)."""
+    if self.system_prompt and not any(m.get("role") == "system" for m in messages):
+      messages = [{"role": "system", "content": self.system_prompt}] + messages
+    tokenizer = await self._tokenizer_for(model, shard)
+    return build_prompt(tokenizer, messages, tools), tokenizer
+
   async def handle_post_chat_token_encode(self, request):
     """Tokenize a chat request without running it (parity reference
     chatgpt_api.py:287-306 — same response shape: length, num_tokens,
@@ -758,13 +843,10 @@ class ChatGPTAPI:
     shard = build_base_shard(model, self.inference_engine_classname)
     if shard is None:
       return web.json_response({"detail": f"Invalid model: {model}"}, status=400)
-    messages = data.get("messages", [])
     # Mirror the completions path exactly (incl. the injected system prompt)
     # so the reported token count matches what a completion would really run.
-    if self.system_prompt and not any(m.get("role") == "system" for m in messages):
-      messages = [{"role": "system", "content": self.system_prompt}] + messages
-    tokenizer = await self._tokenizer_for(model, shard)
-    prompt = build_prompt(tokenizer, messages, data.get("tools"))
+    prompt, tokenizer = await self._request_prompt(
+      model, shard, data.get("messages", []), data.get("tools"))
     tokens = tokenizer.encode(prompt)
     tokens = tokens.tolist() if hasattr(tokens, "tolist") else list(tokens)
     return web.json_response({
@@ -790,11 +872,7 @@ class ChatGPTAPI:
         {"detail": f"Invalid model: {model}. Supported: {supported}"}, status=400
       )
 
-    if self.system_prompt and not any(m.get("role") == "system" for m in messages):
-      messages = [{"role": "system", "content": self.system_prompt}] + messages
-
-    tokenizer = await self._tokenizer_for(model, shard)
-    prompt = build_prompt(tokenizer, messages, tools)
+    prompt, tokenizer = await self._request_prompt(model, shard, messages, tools)
     request_id = str(uuid.uuid4())
     if self.on_chat_completion_request:
       try:
@@ -956,6 +1034,32 @@ class ChatGPTAPI:
                    "message": f"n must be an integer in [1, 8], got {n!r}"}},
         status=400,
       )
+    # Bounded admission (XOT_MAX_INFLIGHT, default 0 = off): acquire a slot
+    # before the request touches the ring. Over the inflight cap it WAITS in
+    # the bounded FIFO (firing the anticipatory host-tier prefix prefetch the
+    # moment it queues — the PRESERVE queue-lookahead); past the queue bound
+    # it is shed as HTTP 429 + Retry-After/queue position, which is how
+    # overload stops surfacing as watchdog "stalled" aborts (PR 8 finding).
+    # One slot covers the whole HTTP request: all n sub-completions and any
+    # transparent restart run under it.
+    gate = self.node.admission
+    held_slot = False
+    if gate.enabled:
+      try:
+        held_slot = await gate.acquire(
+          request_id,
+          on_queued=lambda: spawn_detached(self.node.prefetch_prompt(shard, prompt)))
+      except AdmissionRejected as e:
+        retry_after = max(1, int(math.ceil(e.retry_after_s)))
+        return web.json_response(
+          {"error": {
+            "type": "rate_limit_error", "code": "overloaded",
+            "message": f"admission queue is full ({e.queued}/{e.limit} waiting); "
+                       f"retry in ~{retry_after}s",
+            "queue_depth": e.queued, "queue_limit": e.limit,
+            "queue_position": e.queued + 1, "est_wait_s": e.retry_after_s,
+          }},
+          status=429, headers={"Retry-After": str(retry_after)})
     # One-shot transparent restart (XOT_REQUEST_RESTARTS, default 0 = off):
     # a request killed by a transient ring failure (hop error, stall
     # abort, evicted peer) is resubmitted ONCE under a fresh request id
@@ -1008,6 +1112,10 @@ class ChatGPTAPI:
         return self._build_full_response(request_ids, results, error, model, tokenizer, prompt,
                                          eos_ids, stop=stop, logprobs=bool(want_logprobs))
     finally:
+      if held_slot:
+        # The slot outlives every sub-request and restart attempt; release
+        # wakes the oldest queued waiter.
+        gate.release()
       for rid in all_rids:
         self.token_queues.pop(rid, None)
         self.prev_token_lens.pop(rid, None)

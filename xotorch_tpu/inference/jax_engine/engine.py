@@ -2248,6 +2248,36 @@ class JAXShardInferenceEngine(InferenceEngine):
       print(f"host KV tier hit: {entry.length}-token prefix restored "
             f"({entry.nbytes} bytes H2D)")
 
+  async def prefetch_host_prefix(self, shard: Shard, prompt: str) -> bool:
+    """PRESERVE-style anticipatory restore (arXiv 2501.08192): run the
+    host-to-HBM prefix promote for a prompt that is still QUEUED (admission
+    gate / router pre-announce), so by admission its warm prefix is already
+    resident and the request takes the native warm path immediately.
+    Strictly best-effort and load-shaped: resident contexts only (a
+    prefetch must never trigger a model load), and when a batcher is live
+    the promote rides the co-scheduled prefill lane so resident decode
+    never stalls on the H2D copy. Returns True when bytes were restored."""
+    store = self._host_kv
+    if store is None or len(store) == 0:
+      return False
+    ctx = self._contexts.get(shard)
+    if ctx is None or ctx.params is None:
+      return False
+    try:
+      tokenizer = await self._ensure_tokenizer(ctx)
+      toks = np.asarray(tokenizer.encode(prompt), dtype=np.int64).reshape(-1)
+    except Exception:
+      return False  # unresolvable tokenizer: the real request will report it
+    if toks.shape[0] < 2:
+      return False
+    fetched_before = self._host_fetch_bytes
+    promote = partial(self._host_promote, ctx, toks)
+    if ctx.batcher is not None:
+      await ctx.batcher.submit_prefill(promote)
+    else:
+      await self._run(promote)
+    return self._host_fetch_bytes > fetched_before
+
   async def infer_prompt(
     self, request_id: str, shard: Shard, prompt: str, inference_state: Optional[dict] = None,
     images: Optional[list] = None, keep_on_device: bool = False,

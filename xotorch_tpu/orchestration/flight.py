@@ -42,6 +42,17 @@ EVENTS = (
   "request.admitted",
   "request.finished",
   "request.aborted",
+  # bounded admission gate (orchestration/admission.py): a request that
+  # waited for a slot, and one shed as a 429 — the overload evidence that
+  # used to surface only as watchdog "stalled" aborts.
+  "admission.queued",
+  "admission.rejected",
+  # router replica lifecycle (router/app.py): one event per state-machine
+  # transition, so the front door's decisions (who was drained on which
+  # alert, when probes readmitted it) are replayable like any node anomaly.
+  "replica.draining",
+  "replica.probing",
+  "replica.readmitted",
   # ring hops (peer handles send; node receives/dedups)
   "hop.send",
   "hop.recv",

@@ -184,6 +184,18 @@ class NodeMetrics:
       "xot_requests_failed_total", "Requests that ended in an abort on this node (any cause)",
       ["node_id"], registry=self.registry,
     ).labels(**labels)
+    # Admission control (XOT_MAX_INFLIGHT / XOT_ADMIT_QUEUE_DEPTH): requests
+    # shed as 429s at the front door instead of watchdog aborts inside the
+    # ring, and the live bounded-queue depth the router places load by.
+    self.admission_rejections_total = Counter(
+      "xot_admission_rejections_total",
+      "Requests rejected 429 at the admission gate (bounded queue full)",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
+    self.admit_queue_depth = Gauge(
+      "xot_admit_queue_depth", "Requests currently waiting in the admission queue",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
 
   def exposition(self) -> bytes:
     from prometheus_client import generate_latest

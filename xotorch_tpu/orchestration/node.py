@@ -40,6 +40,7 @@ from xotorch_tpu.networking.server import Server
 from xotorch_tpu.topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES, device_capabilities
 from xotorch_tpu.topology.partitioning import PartitioningStrategy, map_partitions_to_shards
 from xotorch_tpu.orchestration.tracing import TRACEPARENT_KEY, TraceContext, Tracer
+from xotorch_tpu.orchestration.admission import AdmissionGate
 from xotorch_tpu.orchestration.alerts import AlertEngine
 from xotorch_tpu.orchestration.anatomy import (
   AnatomyStore, ClockSkew, extract_breakdown, ring_offsets,
@@ -303,6 +304,18 @@ class Node:
     # status bus via metrics_summary().
     self.alerts = AlertEngine(self)
     self._alert_task: Optional[asyncio.Task] = None
+    # Bounded admission gate (XOT_MAX_INFLIGHT, default 0 = off): the API
+    # acquires a slot before process_prompt, so overload is shed as 429s at
+    # the door instead of watchdog "stalled" aborts inside the ring.
+    # Exposed at /v1/queue; the compact rides metrics_summary() while
+    # enabled so the router (and peers) place by live load.
+    self.admission = AdmissionGate(self)
+    # Anticipatory-prefetch dedupe (bounded LRU of (shard, prompt-hash) ->
+    # monotonic ts): the router's /v1/prefetch pre-announce and the
+    # admission gate's on_queued hook fire for the SAME queued request, and
+    # the duplicate would re-run tokenizer encode + host-store match on a
+    # node that is by definition saturated.
+    self._prefetch_recent: "OrderedDict[tuple, float]" = OrderedDict()
     # Critical-path latency anatomy (XOT_ANATOMY, default on): per-peer
     # clock-skew estimation fed by hop clock stamps (receive side:
     # note via `self.clock`; send side: peer handles adopt `self.clock` at
@@ -653,6 +666,29 @@ class Node:
     self.flight.record("request.admitted", request_id, model=base_shard.model_id,
                        origin=traceparent is None)
     self._note_progress(request_id)
+    if traceparent is None:
+      # Test/soak-only latency tap: injector rules matching rpc
+      # "ProcessPrompt" apply at the ORIGIN, after the request's first-touch
+      # clock is stamped — the gray-failure shape for a SINGLE-node replica
+      # where no peer hop exists to delay. A delay here lands in this node's
+      # own TTFT/e2e SLO histograms (so its burn-rate alerts fire exactly
+      # like a real slowdown) while /healthcheck stays green — the PR 9
+      # delayed-but-health-green scenario the router must act on. With no
+      # injector installed this costs one function call per origin request.
+      # Gated on a rule that EXPLICITLY names this rpc: wildcard (rpc-less)
+      # rules keep their historical peer-handle-boundary semantics and
+      # never have their nth/times budget consumed at the origin. (A spec
+      # mixing an explicit ProcessPrompt rule with wildcard rules shares
+      # one injector, so the wildcard rules' counters do advance on origin
+      # taps — name the rpc on both when that matters.)
+      from xotorch_tpu.networking import faults
+      inj = faults.active()
+      if inj is not None and any(r.rpc == "ProcessPrompt" for r in inj.rules):
+        try:
+          await inj.apply("ProcessPrompt", None)
+        except faults.TransientHopError as e:
+          await self._abort_request(request_id, f"injected fault on {self.id}: {e}")
+          return
     if ring_map:
       # Forwarded prompt: route by the SENDER's pinned map, not our own
       # (possibly lagging) partition view — see RING_MAP_KEY.
@@ -2094,7 +2130,41 @@ class Node:
     # firing alerts with their localization verdicts.
     if self.alerts.enabled:
       summary["alerts"] = self.alerts.compact()
+    # Admission compact (inflight/queued/est-wait): only while the gate is
+    # enabled — defaults-off must add no keys to the wire.
+    if self.admission.enabled:
+      summary["admission"] = self.admission.compact()
     return summary
+
+  async def prefetch_prompt(self, base_shard: Shard, prompt: str) -> bool:
+    """PRESERVE-style anticipatory KV prefetch (arXiv 2501.08192): start the
+    engine's host-to-HBM prefix restore for a prompt that is QUEUED (at the
+    admission gate, or pre-announced by the router) so by the time it is
+    admitted its warm prefix is already resident and it prefills only the
+    suffix. Best-effort and side-effect-free on miss: engines without the
+    hook (or without a host tier) report False and nothing changes."""
+    hook = getattr(self.inference_engine, "prefetch_host_prefix", None)
+    if hook is None:
+      return False
+    try:
+      shard = self.get_current_shard(base_shard)
+      # Dedupe the router pre-announce against the gate's own on_queued
+      # hook: one restore per (shard, prompt) per window is all the host
+      # tier can use.
+      key = (shard, hash(prompt))
+      now = time.monotonic()
+      last = self._prefetch_recent.get(key)
+      if last is not None and now - last < 30.0:
+        return False
+      self._prefetch_recent[key] = now
+      self._prefetch_recent.move_to_end(key)
+      while len(self._prefetch_recent) > 128:
+        self._prefetch_recent.popitem(last=False)
+      return bool(await hook(shard, prompt))
+    except Exception as e:
+      if DEBUG >= 1:
+        print(f"anticipatory prefix prefetch failed (cold prefill instead): {e!r}")
+      return False
 
   def ingest_peer_metrics(self, node_id: str, summary: dict) -> None:
     self.peer_metrics[node_id] = summary

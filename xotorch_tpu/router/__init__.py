@@ -1,0 +1,244 @@
+"""SLO-driven front door: N independent ring replicas behind one endpoint.
+
+One ring is one failure domain and one throughput ceiling. This package
+runs several INDEPENDENT rings ("replicas", each its own discovery domain
+and OpenAI API) behind a single OpenAI-compatible endpoint, and is the
+component that finally ACTS on eight PRs of advisory observability:
+
+- **Placement** (`route`): requests hash by session/prefix key
+  (`prefix_key`, rendezvous hashing) to the replica whose HBM or host tier
+  already holds their prefix — the PR 3 warm path — with queue-depth-aware
+  spill to the least-loaded replica when the affinity target's admission
+  queue (the `/v1/queue` surface) is backed up. The router also
+  pre-announces a queued request's prompt to the target (`/v1/prefetch`)
+  so the host-to-HBM restore runs while the request is still in flight
+  (PRESERVE, arXiv 2501.08192).
+- **Lifecycle** (`ReplicaLifecycle`): a firing burn-rate alert or a named
+  gray-failure `suspect` (the PR 9 localization, advisory until now) moves
+  a replica healthy -> draining -> probing -> readmitted. Draining stops
+  new admissions but lets inflight streams finish; probing sends synthetic
+  canary completions; readmission takes `XOT_ROUTER_PROBES` consecutive
+  successes plus a minimum out-time that DOUBLES when the replica flaps
+  (re-drained soon after readmission), so an oscillating replica spends
+  exponentially longer out instead of thrashing the fleet.
+
+This module is the PURE half — state machine, hashing, placement — fully
+unit-testable with injected clocks and no processes; `router/app.py` is
+the asyncio process that drives it against real replicas. Cross-replica
+weight handling (shared host-RAM weight cache, staggered rollout) follows
+the replica-sharding analysis of arXiv 2004.13336: replicas share nothing
+at runtime, so one replica's failure domain never reaches another.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from xotorch_tpu.utils import knobs
+
+# Escalation cap for the flap hysteresis: a replica that keeps flapping
+# waits at most 8x the base out-time between readmissions.
+MAX_OUT_MULTIPLIER = 8
+
+
+def prefix_key(body: dict) -> str:
+  """Stable session/prefix affinity key for an OpenAI chat body: the first
+  user message's leading characters — exactly the shared session head a
+  returning chat user re-sends verbatim (and the shape tools/soak/loadgen
+  reuses), so session traffic rendezvous-hashes to the replica whose HBM
+  or host tier already holds the prefix. An explicit `user` field (the
+  OpenAI end-user id) wins when present: it is the stronger session
+  signal and survives prompt edits."""
+  user = body.get("user")
+  if isinstance(user, str) and user:
+    return f"user:{user}"
+  for m in body.get("messages") or []:
+    if not isinstance(m, dict) or m.get("role") != "user":
+      continue
+    content = m.get("content")
+    if isinstance(content, list):  # multi-part: concatenate the text parts
+      content = " ".join(p.get("text", "") for p in content
+                         if isinstance(p, dict) and p.get("type") == "text")
+    return str(content or "")[:160]
+  return ""
+
+
+def rendezvous(key: str, names: Sequence[str]) -> Optional[str]:
+  """Highest-random-weight (rendezvous) choice: every router instance maps
+  the same key to the same replica with no shared state, and removing a
+  replica only remaps the keys that lived on it."""
+  best, best_score = None, None
+  for name in names:
+    score = hashlib.sha1(f"{key}|{name}".encode()).digest()
+    if best_score is None or score > best_score:
+      best, best_score = name, score
+  return best
+
+
+def least_loaded(views: List[dict]) -> Optional[dict]:
+  """The lightest replica view by (admission queue depth, estimated wait,
+  name) — ONE definition of "least loaded", shared by route()'s spill and
+  the router's 429 retry so placement and retry can never disagree."""
+  if not views:
+    return None
+  return min(views, key=lambda v: (int(v.get("queued") or 0),
+                                   float(v.get("est_wait_s") or 0.0),
+                                   str(v["name"])))
+
+
+def route(key: str, views: List[dict], spill_depth: int) -> Optional[Tuple[str, bool]]:
+  """Pick a replica for one request. `views` are the ROUTABLE replicas'
+  load compacts: {name, queued, est_wait_s} (from each replica's
+  /v1/queue poll). Affinity first — rendezvous on the prefix key — then
+  queue-depth-aware spill: when the affinity target's admission queue is
+  at least `spill_depth` deep and another replica is strictly less
+  loaded, the request goes to the least-loaded one instead (warm prefix
+  lost, but a queue wait is lost time for certain). Returns
+  (replica_name, spilled) or None when nothing is routable."""
+  if not views:
+    return None
+  by_name = {str(v["name"]): v for v in views}
+  pick = rendezvous(key, sorted(by_name))
+  if spill_depth > 0:
+    target_q = int(by_name[pick].get("queued") or 0)
+    if target_q >= spill_depth:
+      least = least_loaded(views)
+      if str(least["name"]) != pick and int(least.get("queued") or 0) < target_q:
+        return str(least["name"]), True
+  return pick, False
+
+
+class ReplicaLifecycle:
+  """healthy -> draining -> probing -> readmitted (healthy), per replica.
+
+  Pure and clock-injected: `note_status` consumes one poll observation
+  (firing alert count, named suspect, inflight requests, reachability) and
+  `note_probe` one canary outcome; both return a transition dict (what the
+  router records as a flight event) or None. Only `healthy` replicas are
+  routable — draining/probing replicas accept no new traffic, which is
+  what lets their inflight streams finish undisturbed."""
+
+  def __init__(self, name: str, probes_required: Optional[int] = None,
+               min_out_s: Optional[float] = None,
+               flap_window_s: Optional[float] = None):
+    self.name = name
+    self.probes_required = (probes_required if probes_required is not None
+                            else max(1, knobs.get_int("XOT_ROUTER_PROBES")))
+    self.min_out_s = (min_out_s if min_out_s is not None
+                      else max(0.0, knobs.get_float("XOT_ROUTER_MIN_OUT_S")))
+    self.flap_window_s = (flap_window_s if flap_window_s is not None
+                          else max(0.0, knobs.get_float("XOT_ROUTER_FLAP_S")))
+    self.state = "healthy"
+    self.drained_at: Optional[float] = None
+    self.drain_reason: Optional[str] = None
+    self.readmitted_at: Optional[float] = None
+    self.out_multiplier = 1
+    self.probe_successes = 0
+    self.drains_total = 0
+    self.readmits_total = 0
+    self.probe_failures_total = 0
+    # A replica that has NEVER answered a poll is JOINING (booting, port
+    # not bound yet), not failing: unreachability only drains once the
+    # replica has been seen alive — otherwise every boot would burn a
+    # drain/probe/readmit cycle and pollute the lifecycle counters.
+    self.ever_reachable = False
+
+  @property
+  def routable(self) -> bool:
+    return self.state == "healthy"
+
+  def required_out_s(self) -> float:
+    """Current minimum out-time: the flap-escalated hysteresis floor."""
+    return self.min_out_s * self.out_multiplier
+
+  def _transition(self, to: str, now: float, reason: str = "") -> dict:
+    self.state = to
+    return {"replica": self.name, "transition": to, "at": now, "reason": reason}
+
+  def note_status(self, now: float, firing: int = 0, suspect: Optional[str] = None,
+                  inflight: int = 0, reachable: bool = True) -> Optional[dict]:
+    """One poll observation. Transitions:
+    - healthy -> draining on a firing alert, a named suspect, or an
+      unreachable replica (flap escalation applies when the drain lands
+      inside the flap window of the last readmission);
+    - draining -> probing once the replica is reachable, its inflight
+      count has drained to zero, and the alert has cleared;
+    - probing -> draining when the burn re-fires mid-probe.
+    A never-yet-reachable replica (still booting) takes no transition:
+    it is not routable anyway, and draining it would burn a
+    probe/readmit cycle on every boot."""
+    if reachable:
+      self.ever_reachable = True
+    elif not self.ever_reachable:
+      return None
+    bad = bool(firing) or bool(suspect) or not reachable
+    if self.state == "healthy":
+      if not bad:
+        return None
+      if (self.readmitted_at is not None and self.flap_window_s > 0
+          and now - self.readmitted_at < self.flap_window_s):
+        self.out_multiplier = min(MAX_OUT_MULTIPLIER, self.out_multiplier * 2)
+      else:
+        self.out_multiplier = 1
+      self.drained_at = now
+      self.probe_successes = 0
+      self.drains_total += 1
+      why = ("unreachable" if not reachable
+             else f"suspect:{suspect}" if suspect else f"alerts_firing:{firing}")
+      self.drain_reason = why
+      return self._transition("draining", now, why)
+    if self.state == "draining":
+      if reachable and inflight <= 0 and not firing:
+        return self._transition("probing", now, "drained")
+      return None
+    if self.state == "probing" and (bool(firing) or not reachable):
+      # The burn came back mid-probe: a full re-drain, not a pause — the
+      # minimum out-time restarts from NOW (otherwise the original drain's
+      # clock would let a replica whose alert merely dips readmit seconds
+      # after each dip, the oscillation the hysteresis exists to prevent).
+      self.probe_successes = 0
+      self.drained_at = now
+      self.drains_total += 1
+      why = "alert re-fired" if firing else "unreachable"
+      self.drain_reason = why
+      return self._transition("draining", now, why)
+    return None
+
+  def note_probe(self, ok: bool, now: float) -> Optional[dict]:
+    """One synthetic canary outcome (probing state only). Readmission takes
+    `probes_required` CONSECUTIVE successes and at least the (flap-
+    escalated) minimum out-time since the drain; any failure resets the
+    streak — a replica that can't serve a 2-token canary stays out."""
+    if self.state != "probing":
+      return None
+    if not ok:
+      self.probe_failures_total += 1
+      self.probe_successes = 0
+      return None
+    self.probe_successes += 1
+    out_for = now - (self.drained_at if self.drained_at is not None else now)
+    if self.probe_successes >= self.probes_required and out_for >= self.required_out_s():
+      self.readmitted_at = now
+      self.readmits_total += 1
+      self.drain_reason = None
+      return self._transition("healthy", now, "readmitted")
+    return None
+
+  def snapshot(self) -> dict:
+    """JSON row for /v1/router and the soak's router scrape."""
+    return {
+      "name": self.name, "state": self.state,
+      "drain_reason": self.drain_reason,
+      "drained_at": self.drained_at, "readmitted_at": self.readmitted_at,
+      "out_multiplier": self.out_multiplier,
+      "probe_successes": self.probe_successes,
+      "drains_total": self.drains_total, "readmits_total": self.readmits_total,
+      "probe_failures_total": self.probe_failures_total,
+    }
+
+
+def replica_names(urls: Iterable[str]) -> Dict[str, str]:
+  """Stable short names for replica base URLs: r0, r1, ... in the order
+  given (the CLI's --replica order), so logs, /v1/router rows, and soak
+  scrapes agree on identity without parsing URLs."""
+  return {f"r{i}": url.rstrip("/") for i, url in enumerate(urls)}

@@ -1,0 +1,54 @@
+"""CLI: `python -m xotorch_tpu.router` — the SLO-driven front door.
+
+  python -m xotorch_tpu.router --port 52400 \
+      --replica http://127.0.0.1:52415 --replica http://127.0.0.1:52416
+
+Each --replica is one independent ring's OpenAI API base URL (any node of
+that ring — every node serves the rolled-up /v1/alerts and /v1/queue).
+The router serves /v1/chat/completions with session/prefix-affinity
+placement, drains replicas on their own firing SLO alerts, probes them
+back to health with canary completions, and reports at /v1/router.
+Tunables are the XOT_ROUTER_* knobs (see the README knob reference).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+    prog="python -m xotorch_tpu.router",
+    description="OpenAI-compatible front door over N independent ring replicas: "
+                "affinity + load routing, admission-aware spill, alert-driven "
+                "replica drain/probe/readmit.")
+  parser.add_argument("--replica", action="append", required=True,
+                      help="replica API base URL (repeatable, one per ring)")
+  parser.add_argument("--host", default="0.0.0.0")
+  parser.add_argument("--port", type=int, default=52400)
+  args = parser.parse_args(argv)
+
+  from xotorch_tpu.router.app import RouterApp
+
+  async def run():
+    router = RouterApp(args.replica)
+    runner = await router.run(host=args.host, port=args.port)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+      try:
+        loop.add_signal_handler(sig, stop.set)
+      except NotImplementedError:
+        pass  # platforms without signal handler support (tests drive stop())
+    await stop.wait()
+    await router.stop()
+    await runner.cleanup()
+
+  asyncio.run(run())
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

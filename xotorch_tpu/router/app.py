@@ -1,0 +1,458 @@
+"""The router process: one OpenAI endpoint over N independent replicas.
+
+An asyncio aiohttp proxy that (1) places each chat completion on a replica
+by session/prefix affinity with queue-depth-aware spill (`router.route`),
+(2) pre-announces queued prompts to the target replica's `/v1/prefetch` so
+the host-tier warm-prefix restore overlaps the queue wait, and (3) runs
+the alert-driven replica lifecycle: a poll loop reads each replica's
+`/v1/alerts` and `/v1/queue` (the admission compact riding the metrics
+rollup) every `XOT_ROUTER_POLL_S`, feeds `ReplicaLifecycle`, sends
+synthetic canary completions to probing replicas, and records every
+transition in the router's own flight recorder (served at
+`/v1/debug/flight` exactly like a node's).
+
+The router holds no model state and shares nothing with the replicas but
+HTTP — a replica failure domain never reaches the router beyond a drained
+entry in its table (arXiv 2004.13336's replica-sharding argument).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, List, Optional
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+from xotorch_tpu.orchestration.flight import FlightRecorder
+from xotorch_tpu.router import (
+  ReplicaLifecycle, least_loaded, prefix_key, replica_names, route,
+)
+from xotorch_tpu.utils import knobs
+from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
+
+_POLL_TIMEOUT = ClientTimeout(total=5.0)
+_PROBE_TIMEOUT = ClientTimeout(total=60.0)
+
+
+class _Replica:
+  """One replica's live view: lifecycle + the latest poll observations."""
+
+  def __init__(self, name: str, url: str):
+    self.name = name
+    self.url = url
+    self.lifecycle = ReplicaLifecycle(name)
+    self.reachable = False
+    # Latest /v1/queue admission compact; None until the FIRST successful
+    # poll — an unknown load must rank as heavy, never as idle.
+    self.queue: Optional[dict] = None
+    self.active_requests = 0       # latest ring-visible inflight
+    self.firing = 0                # latest cluster-wide firing alert count
+    self.suspect: Optional[str] = None
+    self.routed_total = 0
+    self.spilled_to_total = 0
+    self.relayed_429_total = 0
+    self.probe_inflight = False
+
+  def view(self) -> dict:
+    """The placement view `router.route` consumes. A replica whose queue
+    endpoint has NEVER answered ranks as maximally loaded (fail closed):
+    it can still win by affinity, but spill and 429 retries never steer
+    extra traffic onto the one replica whose load is unknown."""
+    if self.queue is None:
+      return {"name": self.name, "queued": 1 << 30, "est_wait_s": 1e9}
+    return {"name": self.name, "queued": int(self.queue.get("queued") or 0),
+            "est_wait_s": float(self.queue.get("est_wait_s") or 0.0)}
+
+  def snapshot(self) -> dict:
+    return {
+      **self.lifecycle.snapshot(),
+      "url": self.url, "reachable": self.reachable,
+      "firing": self.firing, "suspect": self.suspect,
+      "active_requests": self.active_requests,
+      "queue": self.queue,
+      "routed_total": self.routed_total,
+      "spilled_to_total": self.spilled_to_total,
+      "relayed_429_total": self.relayed_429_total,
+    }
+
+
+class RouterApp:
+  def __init__(self, replica_urls: List[str]):
+    self.replicas: Dict[str, _Replica] = {
+      name: _Replica(name, url) for name, url in replica_names(replica_urls).items()
+    }
+    self.poll_s = max(0.2, knobs.get_float("XOT_ROUTER_POLL_S"))
+    self.spill_depth = max(0, knobs.get_int("XOT_ROUTER_SPILL_DEPTH"))
+    self.probe_tokens = max(1, knobs.get_int("XOT_ROUTER_PROBE_TOKENS"))
+    self.proxy_timeout = ClientTimeout(
+      total=max(5.0, knobs.get_float("XOT_ROUTER_TIMEOUT_S")))
+    self.flight = FlightRecorder(node_id="router")
+    self.proxied_total = 0
+    self.no_replica_503_total = 0
+    self.prefetch_announced_total = 0
+    self._session: Optional[ClientSession] = None
+    self._poll_task = None
+
+    self.app = web.Application(client_max_size=100 * 1024 * 1024)
+    r = self.app.router
+    r.add_post("/v1/chat/completions", self.handle_chat)
+    r.add_post("/chat/completions", self.handle_chat)
+    r.add_get("/healthcheck", self.handle_healthcheck)
+    r.add_get("/v1/router", self.handle_router_status)
+    r.add_get("/v1/debug/flight", self.handle_flight)
+    # Read-only conveniences: answered by any routable replica, so OpenAI
+    # clients pointed at the router keep working end to end.
+    for path in ("/v1/models", "/models", "/v1/topology", "/modelpool"):
+      r.add_get(path, self.handle_proxy_get)
+
+  # -------------------------------------------------------------- lifecycle
+
+  async def start(self) -> None:
+    self._session = ClientSession()
+    self._poll_task = spawn_detached(self._poll_loop())
+
+  async def stop(self) -> None:
+    if self._poll_task is not None:
+      self._poll_task.cancel()
+      try:
+        await self._poll_task
+      except asyncio.CancelledError:
+        pass
+      self._poll_task = None
+    if self._session is not None:
+      await self._session.close()
+      self._session = None
+
+  def routable(self) -> List[_Replica]:
+    return [r for r in self.replicas.values() if r.lifecycle.routable and r.reachable]
+
+  # ------------------------------------------------------------ poll + probe
+
+  async def _poll_one(self, rep: _Replica) -> None:
+    assert self._session is not None
+    try:
+      async with self._session.get(f"{rep.url}/healthcheck",
+                                   timeout=_POLL_TIMEOUT) as resp:
+        rep.reachable = resp.status == 200
+    except Exception:
+      rep.reachable = False
+    if not rep.reachable:
+      return
+    try:
+      async with self._session.get(f"{rep.url}/v1/queue",
+                                   timeout=_POLL_TIMEOUT) as resp:
+        q = await resp.json()
+      rep.queue = q.get("admission") or {}
+      rep.active_requests = int(q.get("active_requests") or 0)
+    except Exception as e:
+      # Fail CLOSED (same policy as the alerts poll below): keep the last
+      # observed load view — zeroing it would make the replica whose queue
+      # endpoint just timed out look like the LEAST loaded one and attract
+      # the spill traffic it can least afford.
+      if DEBUG >= 2:
+        print(f"router: /v1/queue poll of {rep.name} failed: {e!r}")
+    try:
+      async with self._session.get(f"{rep.url}/v1/alerts",
+                                   timeout=_POLL_TIMEOUT) as resp:
+        al = await resp.json()
+      cluster = al.get("cluster") or {}
+      rep.firing = int(cluster.get("firing") or 0)
+      suspect = None
+      for row in cluster.get("active") or []:
+        if row.get("suspect"):
+          suspect = str(row["suspect"])
+          break
+      rep.suspect = suspect
+    except Exception as e:
+      # Fail CLOSED: a replica whose alerts endpoint errors while its
+      # health check stays green keeps its LAST observed firing/suspect —
+      # zeroing it here would promote a still-burning replica out of
+      # draining (or never drain it) exactly when it is least trustworthy.
+      if DEBUG >= 2:
+        print(f"router: /v1/alerts poll of {rep.name} failed: {e!r}")
+
+  async def _probe_one(self, rep: _Replica) -> None:
+    """One synthetic canary completion against a probing replica. The model
+    field is omitted so the replica serves its own default — the router
+    needs no model registry of its own. The outcome is stamped at probe
+    COMPLETION (a cold canary can take tens of seconds), so readmitted_at
+    is never backdated and the flap window measures real elapsed time."""
+    assert self._session is not None
+    rep.probe_inflight = True
+    try:
+      body = {"messages": [{"role": "user", "content": "router canary probe"}],
+              "max_tokens": self.probe_tokens, "temperature": 0}
+      ok = False
+      try:
+        async with self._session.post(f"{rep.url}/v1/chat/completions", json=body,
+                                      timeout=_PROBE_TIMEOUT) as resp:
+          data = await resp.json()
+          content = (data.get("choices") or [{}])[0].get("message", {}).get("content")
+          ok = resp.status == 200 and bool(content)
+      except Exception:
+        ok = False
+      now = time.monotonic()
+      ev = rep.lifecycle.note_probe(ok, now)
+      if ev is not None:  # the only probe-driven transition is readmission
+        self.flight.record("replica.readmitted", None, replica=rep.name,
+                           probes=rep.lifecycle.probes_required,
+                           out_s=round(now - (rep.lifecycle.drained_at or now), 2))
+        if DEBUG >= 0:
+          print(f"router: replica {rep.name} readmitted after "
+                f"{rep.lifecycle.probes_required} canaries")
+    finally:
+      rep.probe_inflight = False
+
+  async def _poll_loop(self) -> None:
+    while True:
+      await asyncio.sleep(self.poll_s)
+      now = time.monotonic()
+      try:
+        await asyncio.gather(*(self._poll_one(r) for r in self.replicas.values()))
+        for rep in self.replicas.values():
+          inflight = rep.active_requests
+          q = rep.queue or {}
+          if q.get("max_inflight"):
+            inflight = max(inflight, int(q.get("inflight") or 0))
+          ev = rep.lifecycle.note_status(
+            now, firing=rep.firing, suspect=rep.suspect,
+            inflight=inflight, reachable=rep.reachable)
+          if ev is not None:
+            if ev["transition"] == "draining":
+              self.flight.record("replica.draining", None, replica=rep.name,
+                                 reason=ev["reason"])
+            elif ev["transition"] == "probing":
+              self.flight.record("replica.probing", None, replica=rep.name)
+            if DEBUG >= 0:
+              print(f"router: replica {rep.name} -> {ev['transition']}"
+                    f" ({ev.get('reason') or ''})")
+          if rep.lifecycle.state == "probing" and rep.reachable and not rep.probe_inflight:
+            spawn_detached(self._probe_one(rep))
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"router poll error: {e!r}")
+
+  # ----------------------------------------------------------------- routes
+
+  async def handle_healthcheck(self, request):
+    return web.json_response({"status": "ok", "replicas": len(self.replicas),
+                              "routable": len(self.routable())})
+
+  async def handle_router_status(self, request):
+    return web.json_response({
+      "replicas": {name: rep.snapshot() for name, rep in self.replicas.items()},
+      "routable": [r.name for r in self.routable()],
+      "proxied_total": self.proxied_total,
+      "no_replica_503_total": self.no_replica_503_total,
+      "prefetch_announced_total": self.prefetch_announced_total,
+      "drains_total": sum(r.lifecycle.drains_total for r in self.replicas.values()),
+      "readmits_total": sum(r.lifecycle.readmits_total for r in self.replicas.values()),
+      "poll_s": self.poll_s, "spill_depth": self.spill_depth,
+    })
+
+  async def handle_flight(self, request):
+    body = {"node_id": "router", **self.flight.stats(),
+            "snapshots": self.flight.snapshots(), "events": self.flight.tail(0)}
+    return web.json_response(body)
+
+  async def handle_proxy_get(self, request):
+    targets = self.routable() or [r for r in self.replicas.values() if r.reachable]
+    if not targets:
+      return web.json_response({"detail": "no reachable replica"}, status=503)
+    assert self._session is not None
+    rep = targets[0]
+    try:
+      async with self._session.get(f"{rep.url}{request.path_qs}",
+                                   timeout=_POLL_TIMEOUT) as resp:
+        return web.Response(body=await resp.read(), status=resp.status,
+                            content_type=resp.content_type)
+    except Exception as e:
+      return web.json_response({"detail": f"replica {rep.name} failed: {e!r}"},
+                               status=502)
+
+  def _announce_prefetch(self, rep: _Replica, body: dict) -> None:
+    """PRESERVE pre-announce: ship the request's messages to the target's
+    /v1/prefetch so its host tier can start the warm-prefix restore while
+    the request is queued (there, or still in flight here). Only fired
+    when the target actually has a wait (inflight at cap or queue
+    non-empty) — an immediately admitted request reuses its prefix through
+    the normal path at no extra cost."""
+    q = rep.queue or {}
+    waiting = (int(q.get("queued") or 0) > 0
+               or (int(q.get("max_inflight") or 0) > 0
+                   and int(q.get("inflight") or 0) >= int(q.get("max_inflight") or 0)))
+    if not waiting or self._session is None:
+      return
+
+    async def announce():
+      payload = {k: body[k] for k in ("model", "messages", "tools") if k in body}
+      try:
+        async with self._session.post(f"{rep.url}/v1/prefetch", json=payload,
+                                      timeout=_POLL_TIMEOUT) as resp:
+          if resp.status == 202:
+            self.prefetch_announced_total += 1
+      except Exception as e:
+        if DEBUG >= 2:
+          print(f"router prefetch announce to {rep.name} failed: {e!r}")
+
+    spawn_detached(announce())
+
+  def _no_replica_503(self):
+    self.no_replica_503_total += 1
+    return web.json_response(
+      {"error": {"type": "server_error", "code": "no_replica",
+                 "message": "no healthy replica is accepting traffic"}},
+      status=503, headers={"Retry-After": str(int(self.poll_s * 2) or 1)})
+
+  async def handle_chat(self, request):
+    try:
+      body = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": "body must be JSON"}},
+        status=400)
+    if not isinstance(body, dict):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "body must be a JSON object"}}, status=400)
+    views = [r.view() for r in self.routable()]
+    picked = route(prefix_key(body), views, self.spill_depth)
+    if picked is None:
+      return self._no_replica_503()
+    name, spilled = picked
+    rep = self.replicas[name]
+    rep.routed_total += 1
+    if spilled:
+      rep.spilled_to_total += 1
+    self.proxied_total += 1
+    self._announce_prefetch(rep, body)
+    resp = await self._forward(rep, body, request)
+    if resp is None:
+      # Replica shed it (429): one spill retry on the least-loaded OTHER
+      # routable replica before the 429 reaches the client — by queue
+      # depth, NOT affinity (the affinity target just proved it is full;
+      # re-hashing could land on another saturated replica while a free
+      # one sits idle).
+      # Re-filter against the LIVE routable set: the poll loop may have
+      # drained a replica while the first forward was in flight, and a
+      # retry must not hand new traffic to a replica that is now out.
+      routable_now = {r.name for r in self.routable()}
+      others = [v for v in views if v["name"] != name and v["name"] in routable_now]
+      least = least_loaded(others)
+      if least is not None:
+        alt_rep = self.replicas[str(least["name"])]
+        alt_rep.routed_total += 1
+        alt_rep.spilled_to_total += 1
+        self._announce_prefetch(alt_rep, body)
+        resp = await self._forward(alt_rep, body, request)
+      if resp is None:
+        # Final attempt, relaying the 429 if it still sheds — but a request
+        # ADMITTED here keeps full streaming semantics (a real forward, not
+        # a buffered re-read). Routability is re-read NOW (the alternate
+        # attempt may have outlived another poll tick) and the forward is
+        # accounted in routed_total like every other attempt, so a drained
+        # replica can neither serve this request nor serve it invisibly to
+        # the routed-while-out tracker.
+        final_now = {r.name for r in self.routable()}
+        final_rep = rep if rep.name in final_now else None
+        if final_rep is None:
+          fallback = least_loaded([r.view() for r in self.routable()])
+          final_rep = self.replicas[str(fallback["name"])] if fallback else None
+        if final_rep is None:
+          return self._no_replica_503()
+        final_rep.routed_total += 1
+        resp = await self._forward(final_rep, body, request, final=True)
+        if getattr(resp, "status", None) == 429:
+          final_rep.relayed_429_total += 1
+    return resp
+
+  async def _forward(self, rep: _Replica, body: dict, request, final: bool = False):
+    """Proxy one completion to a replica. Returns the prepared response, or
+    None when the replica answered 429 and `final` is False (the caller
+    may retry elsewhere); `final` relays the 429 to the client instead.
+    Streaming responses are relayed chunk-for-chunk as they arrive."""
+    if body.get("stream"):
+      return await self._relay_stream(rep, body, request, allow_429=final)
+    return await self._relay_json(rep, body, request, allow_429=final)
+
+  def _connect_failed(self, rep: _Replica, e: Exception, final: bool):
+    """A request that never reached the replica (connect refused/reset
+    before any byte) is safe to retry elsewhere: mark the replica
+    unreachable NOW (the next poll/lifecycle tick drains it; the final-
+    attempt routability re-check skips it) and return None so the caller's
+    retry machinery engages — a crash between poll ticks must fail over
+    like a 429, not surface as a 502 while a healthy replica sits idle.
+    On the FINAL attempt there is nowhere left to go: answer 502."""
+    rep.reachable = False
+    if DEBUG >= 1:
+      print(f"router: forward to {rep.name} failed: {e!r}")
+    if final:
+      return web.json_response(
+        {"error": {"type": "server_error",
+                   "message": f"replica {rep.name} failed: {e!r}"}}, status=502)
+    return None
+
+  async def _relay_json(self, rep: _Replica, body: dict, request, allow_429: bool):
+    assert self._session is not None
+    try:
+      async with self._session.post(f"{rep.url}/v1/chat/completions", json=body,
+                                    timeout=self.proxy_timeout) as resp:
+        if resp.status == 429 and not allow_429:
+          return None
+        headers = {}
+        if resp.headers.get("Retry-After"):
+          headers["Retry-After"] = resp.headers["Retry-After"]
+        return web.Response(body=await resp.read(), status=resp.status,
+                            content_type=resp.content_type, headers=headers)
+    except Exception as e:
+      # allow_429 is set exactly on the final attempt (see _forward).
+      return self._connect_failed(rep, e, final=allow_429)
+
+  async def _relay_stream(self, rep: _Replica, body: dict, request,
+                          allow_429: bool = False):
+    """SSE pass-through. The upstream connection is held for the stream's
+    life; the client response is prepared LAZILY on the first upstream
+    byte, so a pre-stream 429 can still return None for the spill retry
+    (or, with allow_429, relay the 429 JSON — a shed request never
+    streamed anything)."""
+    assert self._session is not None
+    try:
+      upstream = await self._session.post(f"{rep.url}/v1/chat/completions",
+                                          json=body, timeout=self.proxy_timeout)
+    except Exception as e:
+      # allow_429 is set exactly on the final attempt (see _forward).
+      return self._connect_failed(rep, e, final=allow_429)
+    try:
+      if upstream.status == 429 and not allow_429:
+        return None
+      if upstream.status != 200:
+        headers = {}
+        if upstream.headers.get("Retry-After"):
+          headers["Retry-After"] = upstream.headers["Retry-After"]
+        return web.Response(body=await upstream.read(), status=upstream.status,
+                            content_type=upstream.content_type, headers=headers)
+      response = web.StreamResponse(status=200, headers={
+        "Content-Type": upstream.headers.get("Content-Type", "text/event-stream"),
+        "Cache-Control": "no-cache",
+        "Access-Control-Allow-Origin": "*",
+      })
+      await response.prepare(request)
+      async for chunk in upstream.content.iter_any():
+        await response.write(chunk)
+      await response.write_eof()
+      return response
+    finally:
+      upstream.release()
+
+  async def run(self, host: str = "0.0.0.0", port: int = 52400):
+    await self.start()
+    runner = web.AppRunner(self.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    if DEBUG >= 0:
+      print(f"xot router on http://{host}:{port} over "
+            f"{len(self.replicas)} replica(s): "
+            + ", ".join(f"{n}={r.url}" for n, r in self.replicas.items()))
+    return runner

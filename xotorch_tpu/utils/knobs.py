@@ -110,6 +110,16 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_EVICT_COOLDOWN_S", "float", "30", "Seconds an evicted peer stays barred from re-admission by discovery.", "Survivability"),
   Knob("XOT_REQUEST_RESTARTS", "int", "0", "One-shot transparent API restarts after a ring failure (streaming qualifies until its first content chunk).", "Survivability"),
   Knob("XOT_FAULT_SPEC", "json", None, "Test-only: JSON fault-injection rules applied at the peer-handle boundary.", "Survivability"),
+  # --------------------------------------------- admission / front door
+  Knob("XOT_MAX_INFLIGHT", "int", "0", "Bounded admission: max requests admitted into the ring concurrently by the origin node's API; 0 disables the gate (today's behavior).", "Front door"),
+  Knob("XOT_ADMIT_QUEUE_DEPTH", "int", "32", "Bounded admission queue: over-limit requests wait here (FIFO); beyond it they are rejected with HTTP 429 + Retry-After.", "Front door"),
+  Knob("XOT_ROUTER_POLL_S", "float", "2", "Router: cadence (s) for polling each replica's /v1/alerts, /v1/queue, and /healthcheck.", "Front door"),
+  Knob("XOT_ROUTER_PROBE_TOKENS", "int", "2", "Router: max_tokens of the synthetic canary completion sent to a probing replica.", "Front door"),
+  Knob("XOT_ROUTER_PROBES", "int", "2", "Router: consecutive successful canaries required before a drained replica is readmitted.", "Front door"),
+  Knob("XOT_ROUTER_MIN_OUT_S", "float", "10", "Router: minimum seconds a drained replica stays out before readmission; doubles (bounded 8x) when the replica flaps.", "Front door"),
+  Knob("XOT_ROUTER_FLAP_S", "float", "60", "Router: a re-drain within this many seconds of a readmission counts as flapping (escalates the out-time hysteresis).", "Front door"),
+  Knob("XOT_ROUTER_SPILL_DEPTH", "int", "2", "Router: spill a request to the least-loaded healthy replica when its affinity replica's admission queue is at least this deep.", "Front door"),
+  Knob("XOT_ROUTER_TIMEOUT_S", "float", "300", "Router: total proxy timeout (s) for one forwarded request.", "Front door"),
   # ------------------------------------------------------------- topology
   Knob("XOT_COORDINATOR", "str", None, "JAX multi-host coordinator address (`host:port`); setting it implies multi-host.", "Topology"),
   Knob("XOT_MULTIHOST", "bool", "0", "Force JAX multi-host initialization.", "Topology"),
