@@ -1,0 +1,605 @@
+"""Metrics history + chronic-drift sentinel: tier downsampling, restart
+classification, the JSONL spool, gauge derivation, the /v1/history surface,
+the perf_drift state machine, the router's differential-drift loop (e2e:
+gradual slowdown named by peer-median comparison, drained, readmitted), the
+x-ratelimit headers, the uptime gauge, and the no-new-syncs /
+knobs-off-byte-identical contracts.
+"""
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.inference.dummy import DummyInferenceEngine
+from xotorch_tpu.orchestration.history import (
+  DRIFT_RULES_BY_METRIC, MetricsHistory, median, merge_rows, worse_by,
+)
+from xotorch_tpu.router import fleet_trailing_medians, name_drift
+
+from tests.test_alerts import _hist, _summary
+from tests.test_orchestration import _caps, _make_node
+
+
+def _hist_env(monkeypatch, **over):
+  env = {"XOT_HISTORY": "1", "XOT_HISTORY_SAMPLE_S": "1",
+         "XOT_HISTORY_SAMPLES": "8", "XOT_HISTORY_MERGE": "2",
+         "XOT_HISTORY_COARSE": "8",
+         "XOT_DRIFT_WINDOW_S": "10", "XOT_DRIFT_BASELINE_S": "30",
+         "XOT_DRIFT_RATIO": "0.25", "XOT_DRIFT_PEER_RATIO": "0.5",
+         "XOT_DRIFT_MIN_SAMPLES": "2", "XOT_DRIFT_PENDING_S": "5",
+         "XOT_DRIFT_RESOLVE_S": "5"}
+  env.update(over)
+  for k, v in env.items():
+    monkeypatch.setenv(k, str(v))
+
+
+# ---------------------------------------------------------------- pure math
+
+def test_worse_by_is_direction_aware():
+  # "up" = higher is worse (latency): 0.2 vs 0.1 baseline is 100% worse.
+  assert worse_by(0.2, 0.1, "up") == pytest.approx(1.0)
+  assert worse_by(0.05, 0.1, "up") == pytest.approx(-0.5)
+  # "down" = lower is worse (throughput): 50 vs 100 baseline is 50% worse.
+  assert worse_by(50.0, 100.0, "down") == pytest.approx(0.5)
+  assert worse_by(150.0, 100.0, "down") == pytest.approx(-0.5)
+
+
+def test_median_and_merge_rows():
+  assert median([]) is None
+  assert median([3.0, 1.0, 2.0]) == 2.0
+  assert median([1.0, 2.0]) == 1.5
+  rows = [
+    {"ts": 10.0, "mono": 1.0, "dur_s": 1.0, "gauges": {"a": 1.0, "b": 4.0}},
+    {"ts": 11.0, "mono": 2.0, "dur_s": 3.0, "gauges": {"a": 5.0}, "restart": True},
+  ]
+  m = merge_rows(rows)
+  assert m["ts"] == 10.0 and m["samples"] == 2 and m["restart"] is True
+  # Duration-weighted: (1*1 + 5*3) / 4 = 4.0.
+  assert m["gauges"]["a"] == pytest.approx(4.0)
+  # A gauge absent from a sample contributes nothing — no fake zeros.
+  assert m["gauges"]["b"] == pytest.approx(4.0)
+
+
+# -------------------------------------------------------- sampling + tiers
+
+async def test_tier_downsampling_is_bounded(monkeypatch):
+  _hist_env(monkeypatch)
+  node = await _make_node("h-tiers", DummyInferenceEngine())
+  h = node.history
+  assert h.enabled
+  for i in range(100):
+    h.observe(now=float(i), summary=_summary(requests=i, ttft=[0.01] * i))
+  assert h.samples_total == 100
+  assert len(h._fine) <= h.fine_cap + h.merge
+  assert len(h._mid) <= h.coarse_cap + h.merge
+  assert len(h._old) <= h.coarse_cap
+  # Bounded memory means the OLDEST buckets are eventually forgotten; at
+  # these caps the store retains exactly fine 8 + mid 8x2 + old 8x4 = 56
+  # of the 100 samples, newest at full resolution.
+  assert sum(int(r["samples"]) for r in h.rows()) == 56
+  assert [int(r["samples"]) for r in h.rows()[:3]] == [4, 4, 4]   # old tier
+  assert [int(r["samples"]) for r in h.rows()[-3:]] == [1, 1, 1]  # fine tier
+  # Windowed queries honor the monotonic clock.
+  recent = h.rows(window_s=5.0, now=99.0)
+  assert all(r["mono"] >= 94.0 for r in recent)
+  await node.stop()
+
+
+async def test_restart_classification_and_uptime(monkeypatch):
+  _hist_env(monkeypatch)
+  node = await _make_node("h-restart", DummyInferenceEngine())
+  h = node.history
+  h.observe(now=0.0, summary=_summary(requests=10, failed=1))
+  h.observe(now=1.0, summary=_summary(requests=20, failed=1))
+  assert h.restarts == 0
+  # Counters re-exported from zero: a restart boundary, not a regression.
+  sample = h.observe(now=2.0, summary=_summary(requests=3, failed=0))
+  assert sample["restart"] is True and "requests" in sample["restart_why"]
+  assert h.restarts == 1 and sample["gauges"] == {}
+  # Every sample carries the process uptime (the satellite gauge) so the
+  # record itself can distinguish restart-induced resets.
+  assert sample["uptime_s"] >= 0.0
+  # Post-reset deltas work from the new epoch.
+  s2 = h.observe(now=3.0, summary=_summary(requests=7, failed=2))
+  assert s2["restart"] is False
+  assert s2["gauges"]["error_rate"] == pytest.approx(0.5)
+  await node.stop()
+
+
+async def test_gauges_from_deltas_and_engine_hook(monkeypatch):
+  _hist_env(monkeypatch)
+
+  class _HookEngine(DummyInferenceEngine):
+    def __init__(self):
+      super().__init__()
+      self.hook = {"decode_tok_s": 100.0, "jit_first_dispatches": 0,
+                   "jit_cached_dispatches": 0, "host_fetch_bytes": 0}
+
+    def history_gauges(self):
+      return dict(self.hook)
+
+  engine = _HookEngine()
+  node = await _make_node("h-gauges", engine)
+  h = node.history
+  h.observe(now=0.0, summary=_summary(requests=10, ttft=[0.1] * 10))
+  engine.hook.update(jit_first_dispatches=3, jit_cached_dispatches=9,
+                     host_fetch_bytes=4 * 4096 * 10)
+  s = h.observe(now=1.0, summary=_summary(requests=20, failed=2,
+                                          ttft=[0.1] * 10 + [0.4] * 10))
+  g = s["gauges"]
+  assert g["error_rate"] == pytest.approx(0.2)
+  # Windowed TTFT median: the 10 NEW observations all sit in (0.25, 0.5].
+  assert 0.25 < g["ttft_p50_s"] <= 0.5
+  assert g["decode_tok_s"] == pytest.approx(100.0)
+  assert g["jit_miss_fraction"] == pytest.approx(3 / 12)
+  assert g["host_fetch_bytes_per_req"] == pytest.approx(4 * 4096)
+  await node.stop()
+
+
+async def test_spool_restores_across_restart(monkeypatch, tmp_path):
+  _hist_env(monkeypatch, XOT_HISTORY_DIR=str(tmp_path))
+  node = await _make_node("h-spool", DummyInferenceEngine())
+  for i in range(5):
+    node.history.observe(now=float(i), summary=_summary(requests=10 * (i + 1),
+                                                        ttft=[0.1] * (i + 1)))
+  spool = node.history._spool_file()
+  assert spool.exists() and len(spool.read_text().splitlines()) == 5
+  await node.stop()
+  # "Restart": a fresh store on the same node id restores the record.
+  node2 = await _make_node("h-spool", DummyInferenceEngine())
+  h2 = node2.history
+  assert h2.restarts == 1
+  restored = h2.rows()
+  assert sum(int(r["samples"]) for r in restored) == 5
+  assert any(r["restart"] for r in restored)  # the boundary is marked
+  # Restored rows carry no live monotonic clock: windowed queries skip
+  # them, the unwindowed record keeps them.
+  assert h2.rows(window_s=1e9) == []
+  await node2.stop()
+
+
+async def test_diff_names_the_moved_metric(monkeypatch):
+  _hist_env(monkeypatch)
+  node = await _make_node("h-diff", DummyInferenceEngine())
+  h = node.history
+  reqs, obs = 0, []
+  for i in range(10):  # old window: fast
+    reqs += 5
+    obs += [0.05] * 5
+    h.observe(now=float(i), summary=_summary(requests=reqs, ttft=obs))
+  for i in range(10, 20):  # recent window: slow
+    reqs += 5
+    obs += [1.0] * 5
+    h.observe(now=float(i), summary=_summary(requests=reqs, ttft=obs))
+  d = h.diff(10.0, now=19.0)
+  assert d["moved"] == "ttft_p50_s"
+  row = [r for r in d["rows"] if r["metric"] == "ttft_p50_s"][0]
+  assert row["after"] > row["before"] and row["worse_by"] > 1.0
+  await node.stop()
+
+
+# ----------------------------------------------------------- drift sentinel
+
+async def test_drift_fires_on_own_baseline_and_resolves(monkeypatch):
+  _hist_env(monkeypatch)
+  node = await _make_node("h-drift", DummyInferenceEngine())
+  h, eng = node.history, node.alerts
+  assert eng.drift.enabled
+  reqs, obs = 0, []
+
+  def tick(now, ttft_each):
+    nonlocal reqs, obs
+    reqs += 5
+    obs += [ttft_each] * 5
+    h.observe(now=now, summary=_summary(requests=reqs, ttft=obs))
+
+  for i in range(40):  # healthy baseline
+    tick(float(i), 0.05)
+  for i in range(40, 50):  # chronic rot: 4x TTFT, far below any burn rule
+    tick(float(i), 0.2)
+  tr = eng.drift.evaluate(now=50.0, wall=50.0)
+  assert {"rule": "perf_drift:ttft_p50_s", "to": "pending", "at": 50.0} in tr
+  st = eng.drift._states["ttft_p50_s"]
+  assert st["evidence"]["via"] == ["baseline"]
+  for i in range(50, 56):
+    tick(float(i), 0.2)
+  tr = eng.drift.evaluate(now=56.0, wall=56.0)
+  assert any(t["to"] == "firing" for t in tr)
+  assert eng.drift.firing_count() == 1
+  # The firing row rides the alert engine's active list and compact as
+  # class=perf_drift EVIDENCE — but never the hard `firing` drain signal
+  # (a drain shifts load onto survivors and moves their baselines; a
+  # self-reported drift cascading through `firing` could take the whole
+  # fleet out — the router's fleet-median comparison is the actuator).
+  assert any(r["rule"] == "perf_drift:ttft_p50_s" for r in eng.active())
+  compact = eng.compact()
+  assert compact["firing"] == 0
+  assert any(r.get("class") == "perf_drift" for r in compact["active"])
+  events = [e["event"] for e in node.flight.tail()]
+  assert "drift.pending" in events and "drift.firing" in events
+  assert any(s["reason"] == "drift_firing:ttft_p50_s"
+             for s in node.flight.snapshots())
+  # Recovery: TTFT returns to baseline; after the hysteresis it resolves.
+  for i in range(56, 90):
+    tick(float(i), 0.05)
+  tr = eng.drift.evaluate(now=90.0, wall=90.0)
+  assert any(t["to"] == "resolved" for t in tr)
+  recent = eng.drift.recent()
+  assert recent and recent[0]["rule"] == "perf_drift:ttft_p50_s"
+  assert "drift.resolved" in [e["event"] for e in node.flight.tail()]
+  await node.stop()
+
+
+async def test_drift_peer_median_comparison(monkeypatch):
+  """A node whose gauge tracks its OWN baseline but sits far above the
+  ring-peer median still fires — the differential detector."""
+  _hist_env(monkeypatch, XOT_DRIFT_PENDING_S="0", XOT_DRIFT_RATIO="1000")
+  node = await _make_node("h-peer", DummyInferenceEngine())
+  h, eng = node.history, node.alerts
+  reqs, obs = 0, []
+  for i in range(20):  # steady but SLOW from the start: no own-baseline delta
+    reqs += 5
+    obs += [0.4] * 5
+    h.observe(now=float(i), summary=_summary(requests=reqs, ttft=obs))
+  for nid, p50 in (("p-a", 0.04), ("p-b", 0.05), ("p-c", 0.06)):
+    node.ingest_peer_metrics(nid, {"history": {"trailing": {"ttft_p50_s": p50}}})
+  tr = eng.drift.evaluate(now=20.0, wall=20.0)
+  assert any(t["to"] == "firing" for t in tr)
+  ev = eng.drift._states["ttft_p50_s"]["evidence"]
+  assert ev["via"] == ["peer_median"]
+  assert ev["peer_median"] == pytest.approx(0.05)
+  await node.stop()
+
+
+async def test_history_disabled_is_inert(monkeypatch):
+  monkeypatch.setenv("XOT_HISTORY", "0")
+  node = await _make_node("h-off", DummyInferenceEngine())
+  assert node.history.enabled is False
+  assert node.history.observe() is None
+  assert node.alerts.drift.enabled is False
+  assert node.alerts.drift.evaluate(0.0, 0.0) == []
+  # No wire keys at XOT_HISTORY=0.
+  assert "history" not in node.metrics_summary()
+  node.start_history()
+  assert node._history_task is None
+  await node.stop()
+
+
+# ------------------------------------------------------------- API surface
+
+async def _api_node(node_id="h-api"):
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.api.chatgpt_api import ChatGPTAPI
+  node = await _make_node(node_id, DummyInferenceEngine())
+  node.topology.update_node(node_id, _caps())
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30,
+                   default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return client, node
+
+
+async def test_history_endpoint_and_cluster_rollup(monkeypatch):
+  _hist_env(monkeypatch)
+  client, node = await _api_node()
+  reqs, obs = 0, []
+  for i in range(12):
+    reqs += 4
+    obs += [0.1] * 4
+    node.history.observe(now=float(i), summary=_summary(requests=reqs, ttft=obs))
+  node.ingest_peer_metrics("h-remote", {"history": {
+    "window_s": 10, "samples": 7, "restarts": 2,
+    "trailing": {"ttft_p50_s": 0.4}, "ts": time.time()}})
+  try:
+    data = await (await client.get("/v1/history")).json()
+    assert data["node_id"] == "h-api" and data["enabled"] is True
+    assert data["samples_total"] == 12
+    assert "ttft_p50_s" in data["metrics"]
+    assert data["trailing"].get("ttft_p50_s") is None or True  # windowed by mono
+    assert data["cluster"]["h-remote"]["restarts"] == 2
+    # One-metric series view.
+    data = await (await client.get("/v1/history?metric=ttft_p50_s")).json()
+    assert all("value" in r for r in data["rows"])
+    # The compact the router polls.
+    data = await (await client.get("/v1/history?compact=1")).json()
+    assert data["enabled"] is True and "trailing" in data["compact"]
+    # Diff view + validation.
+    data = await (await client.get("/v1/history?diff=5")).json()
+    assert "rows" in data and "moved" in data
+    assert (await client.get("/v1/history?diff=nope")).status == 400
+    assert (await client.get("/v1/history?window=nope")).status == 400
+    # Stale peers are marked, like /v1/alerts.
+    node._peer_metrics_at["h-remote"] -= 1000.0
+    data = await (await client.get("/v1/history")).json()
+    assert data["cluster"]["h-remote"]["stale"] is True
+  finally:
+    await client.close()
+    await node.stop()
+
+
+async def test_uptime_gauge_exported(monkeypatch):
+  client, node = await _api_node("h-uptime")
+  try:
+    assert node.metrics.uptime_s() >= 0.0
+    text = (await (await client.get("/metrics")).read()).decode()
+    line = [l for l in text.splitlines()
+            if l.startswith("xot_uptime_seconds{")][0]
+    assert float(line.rsplit(" ", 1)[1]) >= 0.0
+    assert "xot_perf_drift_firing 0.0" in text
+  finally:
+    await client.close()
+    await node.stop()
+
+
+async def test_ratelimit_headers_follow_the_gate(monkeypatch):
+  # Gate off (the default): no x-ratelimit headers anywhere — byte parity.
+  client, node = await _api_node("h-rl-off")
+  body = {"model": "dummy", "messages": [{"role": "user", "content": "hi"}]}
+  try:
+    resp = await client.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    assert not any(k.lower().startswith("x-ratelimit") for k in resp.headers)
+  finally:
+    await client.close()
+    await node.stop()
+  # Gate on: limit/remaining/reset ride 200s (buffered AND streamed) and 429s.
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "2")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "1")
+  client, node = await _api_node("h-rl-on")
+  try:
+    resp = await client.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    assert resp.headers["x-ratelimit-limit-requests"] == "3"
+    # Snapshot at admission: this request held 1 of 3 budget slots.
+    assert resp.headers["x-ratelimit-remaining-requests"] == "2"
+    assert resp.headers["x-ratelimit-reset-requests"].endswith("s")
+    resp = await client.post("/v1/chat/completions", json={**body, "stream": True})
+    assert resp.status == 200
+    assert resp.headers["x-ratelimit-limit-requests"] == "3"
+    await resp.read()
+    # Fill the gate so the next request is shed as 429 with the headers.
+    gate = node.admission
+    gate.admit("a"), gate.admit("b"), gate.admit("c")
+    resp = await client.post("/v1/chat/completions", json=body)
+    assert resp.status == 429
+    assert resp.headers["x-ratelimit-remaining-requests"] == "0"
+    assert resp.headers["Retry-After"]
+  finally:
+    await client.close()
+    await node.stop()
+
+
+# ------------------------------------------------- router differential drift
+
+def test_fleet_median_and_name_drift_helpers():
+  compacts = [{"trailing": {"ttft_p50_s": 0.04, "decode_tok_s": 100.0}},
+              {"trailing": {"ttft_p50_s": 0.06, "decode_tok_s": 120.0}}]
+  med = fleet_trailing_medians(compacts)
+  assert med["ttft_p50_s"] == pytest.approx(0.05)
+  assert med["decode_tok_s"] == pytest.approx(110.0)
+  # Worse than the median beyond ratio + floor: named, worst metric first.
+  hit = name_drift({"trailing": {"ttft_p50_s": 0.5, "decode_tok_s": 115.0}},
+                   med, ratio=0.5)
+  assert hit["metric"] == "ttft_p50_s" and hit["peer_median"] == pytest.approx(0.05)
+  # Better-or-equal never fires; sub-floor absolute moves never fire.
+  assert name_drift({"trailing": {"ttft_p50_s": 0.05}}, med, 0.5) is None
+  assert name_drift({"trailing": {"ttft_p50_s": 0.08}}, med, 0.5) is None  # < 0.05 floor over median
+  assert name_drift(None, med, 0.5) is None
+
+
+async def test_router_names_gradual_drift_and_drains_e2e(monkeypatch):
+  """The differential-drift e2e: two replicas behind the router, a GRADUAL
+  engine slowdown injected on one — sized far below the burn-rate
+  thresholds — is named perf_drift by the router's peer-median comparison,
+  the replica is drained with zero routed-while-out, and once the slowdown
+  clears (and its trailing window forgets it) the canary probes readmit
+  it. The healthy replica never fires and never drains."""
+  from aiohttp.test_utils import TestClient, TestServer
+  from xotorch_tpu.router.app import RouterApp
+
+  _hist_env(monkeypatch, XOT_HISTORY_SAMPLE_S="0.1",
+            XOT_DRIFT_WINDOW_S="2.0", XOT_DRIFT_PENDING_S="600")
+  monkeypatch.setenv("XOT_ROUTER_POLL_S", "0.2")
+  monkeypatch.setenv("XOT_ROUTER_MIN_OUT_S", "0")
+  monkeypatch.setenv("XOT_ROUTER_PROBES", "1")
+  monkeypatch.setenv("XOT_ROUTER_DRIFT_POLLS", "2")
+
+  clients, nodes, urls = [], [], []
+  for i in range(2):
+    client, node = await _api_node(f"rep{i}")
+    node.start_history()
+    clients.append(client)
+    nodes.append(node)
+    urls.append(f"http://127.0.0.1:{client.server.port}")
+  router = RouterApp(urls)
+  rclient = TestClient(TestServer(router.app))
+  await rclient.start_server()
+  await router.start()
+  try:
+    for _ in range(40):
+      if len(router.routable()) == 2:
+        break
+      await asyncio.sleep(0.1)
+    assert len(router.routable()) == 2
+
+    # Gradual ProcessPrompt-path slowdown on rep1's engine: each inference
+    # a bit slower, capped at 0.35 s — far below the 10 s TTFT SLO target.
+    slow_node = nodes[1]
+    real_infer = slow_node.inference_engine.infer_tensor
+    ramp = {"n": 0, "on": True}
+
+    async def slow_infer(*a, **k):
+      if ramp["on"]:
+        ramp["n"] += 1
+        await asyncio.sleep(min(0.35, 0.02 * ramp["n"]))
+      return await real_infer(*a, **k)
+
+    slow_node.inference_engine.infer_tensor = slow_infer
+
+    stop_load = asyncio.Event()
+
+    async def one_request(i: int):
+      body = {"model": "dummy", "user": f"u{i % 8}",
+              "messages": [{"role": "user", "content": f"hello {i % 8}"}],
+              "max_tokens": 3}
+      try:
+        resp = await rclient.post("/v1/chat/completions", json=body)
+        await resp.read()
+      except Exception:
+        pass
+
+    async def load():
+      # Open-loop-ish: fire concurrently so a slow replica's latency can't
+      # throttle the offered load (the closed-loop trap) — both replicas
+      # must keep fresh trailing samples for the peer-median comparison.
+      i = 0
+      pending = set()
+      while not stop_load.is_set():
+        pending = {t for t in pending if not t.done()}
+        if len(pending) < 8:
+          pending.add(asyncio.ensure_future(one_request(i)))
+          i += 1
+        await asyncio.sleep(0.05)
+      if pending:
+        await asyncio.gather(*pending, return_exceptions=True)
+
+    load_task = asyncio.ensure_future(load())
+    rep_slow, rep_ok = router.replicas["r1"], router.replicas["r0"]
+
+    # Out-of-rotation routing monitor (the soak tracker's semantics): any
+    # routed_total growth while the replica is draining/probing on BOTH
+    # sides of a tick is a violation.
+    violations = []
+
+    async def watch():
+      last_state, last_routed = rep_slow.lifecycle.state, rep_slow.routed_total
+      while not stop_load.is_set():
+        state, routed = rep_slow.lifecycle.state, rep_slow.routed_total
+        if last_state != "healthy" and state != "healthy" and routed > last_routed:
+          violations.append((state, routed))
+        last_state, last_routed = state, routed
+        await asyncio.sleep(0.02)
+
+    watch_task = asyncio.ensure_future(watch())
+    try:
+      for _ in range(200):  # ~20 s budget for naming + drain
+        if rep_slow.lifecycle.state != "healthy":
+          break
+        await asyncio.sleep(0.1)
+      assert rep_slow.lifecycle.state in ("draining", "probing")
+      assert str(rep_slow.lifecycle.drain_reason).startswith("suspect:perf_drift:")
+      assert rep_slow.drift_named_total >= 1
+      assert any(e["event"] == "drift.replica" and e.get("replica") == "r1"
+                 for e in router.flight.tail())
+      # Named by the differential sentinel, NOT by an SLO burn: no alert
+      # ever fired on either node.
+      for node in nodes:
+        assert node.alerts.compact()["firing"] == 0
+      # The healthy replica keeps serving and was never drained.
+      assert rep_ok.lifecycle.state == "healthy"
+      assert rep_ok.lifecycle.drains_total == 0 and rep_ok.drift is None
+
+      # Traffic keeps flowing to the healthy replica meanwhile.
+      healthy_routed = rep_ok.routed_total
+      await asyncio.sleep(1.0)
+      assert rep_ok.routed_total > healthy_routed
+
+      # The fault clears; the trailing window forgets; probes readmit and
+      # the replica STAYS healthy (no residual drift name re-drains it).
+      ramp["on"] = False
+      stable = 0
+      for _ in range(400):
+        if rep_slow.lifecycle.state == "healthy" and rep_slow.drift is None:
+          stable += 1
+          if stable >= 15:
+            break
+        else:
+          stable = 0
+        await asyncio.sleep(0.1)
+      assert stable >= 15, (rep_slow.lifecycle.state, rep_slow.drift)
+      assert rep_slow.lifecycle.readmits_total >= 1
+      # Zero routed-while-out across the whole episode.
+      assert violations == []
+    finally:
+      stop_load.set()
+      await asyncio.gather(load_task, watch_task)
+  finally:
+    await router.stop()
+    await rclient.close()
+    for c in clients:
+      await c.close()
+    for n in nodes:
+      await n.stop()
+
+
+# --------------------------------------------- hot-path + knobs-off contracts
+
+async def test_history_adds_no_device_syncs_and_knobs_off_bytes(monkeypatch):
+  """History sampling interleaved with decode adds ZERO block_until_ready /
+  host-fetch syncs, and the greedy stream is byte-identical history-on vs
+  history-off (XOT_HISTORY=0) — sampling reads metric cells, engine
+  counters, and wall clocks, never the device."""
+  import jax
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+
+  shard = Shard("synthetic-tiny", 0, 3, 4)
+  real_bur, real_asarray = jax.block_until_ready, np.asarray
+  counts = {}
+
+  async def run(history_on: bool):
+    mp = pytest.MonkeyPatch()
+    try:
+      mp.setenv("XOT_HISTORY", "1" if history_on else "0")
+      mp.setenv("XOT_HISTORY_SAMPLE_S", "0.1")
+      node = await _make_node(f"h-sync-{history_on}", JAXShardInferenceEngine())
+      node.topology.update_node(node.id, _caps())
+      n = {"bur": 0, "asarray": 0}
+
+      def counting_bur(x):
+        n["bur"] += 1
+        return real_bur(x)
+
+      def counting_asarray(*a, **k):
+        n["asarray"] += 1
+        return real_asarray(*a, **k)
+
+      engine = node.inference_engine
+      prompt = np.arange(1, 17, dtype=np.int64).reshape(1, -1)
+
+      async def drive(rid):
+        tok, _ = await engine.infer_sample_tensor(rid, shard, prompt,
+                                                 temp=0.0, top_k=0)
+        stream = [int(tok)]
+        for _ in range(3):
+          node.history.observe()
+          node.alerts.evaluate()
+          chunk = await engine.generate_chunk(rid, shard, stream[-1], 4,
+                                              temp=0.0, top_k=0)
+          stream.extend(int(t) for t in real_asarray(chunk).reshape(-1))
+          node.history.observe()
+        return stream
+
+      # Warm pass (uncounted): pays every compile with identical shapes so
+      # the counted pass is compile-noise-free in BOTH runs.
+      await drive("h-sync-warm")
+      mp.setattr(jax, "block_until_ready", counting_bur)
+      mp.setattr(np, "asarray", counting_asarray)
+      try:
+        stream = await drive("h-sync-req")
+      finally:
+        mp.setattr(jax, "block_until_ready", real_bur)
+        mp.setattr(np, "asarray", real_asarray)
+      counts[history_on] = dict(n)
+      await node.stop()
+      return stream
+    finally:
+      mp.undo()
+
+  on_stream = await run(True)
+  off_stream = await run(False)
+  assert on_stream == off_stream, "history-off run must be byte-identical"
+  assert counts[True] == counts[False], (
+    f"history sampling added device syncs: {counts}")

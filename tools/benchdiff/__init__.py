@@ -189,6 +189,11 @@ _SOAK_DOWN = frozenset({
   # Traffic routed to a replica while it was out of rotation: the router
   # kept placing load on a drained/probing replica — failover is broken.
   "router_routed_while_out",
+  # A perf_drift firing with no injected fault to blame: the chronic
+  # sentinel named rot on healthy traffic — the drift twin of a false
+  # abort. A green verdict guarantees zero, so the gate can never flag a
+  # green run.
+  "drift_firings_outside_fault_windows",
 })
 _SOAK_INFO = frozenset({
   "requests_submitted", "requests_ok", "request_errors",
@@ -203,6 +208,11 @@ _SOAK_INFO = frozenset({
   # Raw firing counts depend on the fault schedule (a kill is SUPPOSED to
   # fire the error-rate rule), so magnitude drift is informational.
   "alert_firings_total", "alerts_fired_and_resolved",
+  # Drift magnitudes depend on the injected schedule too (a gray phase is
+  # SUPPOSED to deviate from the fleet median); the zero bar above is what
+  # a green verdict guarantees. History volumes scale with run length.
+  "drift_firings_total", "router_drift_named",
+  "history_samples_total", "history_restarts_total",
   # Latency-anatomy shape: reservoir depth varies with load; the
   # unattributed share is gated ABSOLUTELY below (_ANATOMY_MAX_UNATTRIBUTED)
   # rather than by drift, so both report as info in diffs.

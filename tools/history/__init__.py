@@ -1,0 +1,88 @@
+"""tools/history: render a node's /v1/history metrics record.
+
+The endpoint serves the downsampled gauge time-series (and the "which
+metric moved" diff) as JSON; this module turns either payload into the
+terminal tables the README's two-command workflow documents. Stdlib-only
+on purpose, like tools/anatomy: CI and operators call it without touching
+the serving stack's dependencies.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _fmt(v: Any) -> str:
+  if v is None:
+    return "—"
+  if isinstance(v, bool):
+    return "yes" if v else ""
+  if isinstance(v, float):
+    return f"{v:g}"
+  return str(v)
+
+
+def _table(headers: List[str], rows: List[List[Any]]) -> str:
+  cells = [headers] + [[_fmt(c) for c in row] for row in rows]
+  widths = [max(len(r[i]) for r in cells) for i in range(len(headers))]
+  lines = ["  ".join(h.ljust(w) for h, w in zip(cells[0], widths))]
+  lines.append("  ".join("-" * w for w in widths))
+  for row in cells[1:]:
+    lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+  return "\n".join(lines)
+
+
+def render_diff(payload: Dict[str, Any]) -> str:
+  """The ?diff= payload: per-metric before/after means, worst mover first."""
+  rows = payload.get("rows") or []
+  out = [f"history diff over {_fmt(payload.get('window_s'))}s windows "
+         f"(node {payload.get('node_id', '?')})"]
+  moved = payload.get("moved")
+  out.append(f"moved: {moved}" if moved else "moved: nothing worsened")
+  if rows:
+    out.append("")
+    out.append(_table(
+      ["metric", "before", "after", "delta", "worse_by", "bad-direction"],
+      [[r.get("metric"), r.get("before"), r.get("after"), r.get("delta"),
+        r.get("worse_by"), r.get("worse")] for r in rows]))
+  return "\n".join(out) + "\n"
+
+
+def render(payload: Dict[str, Any], metric: Optional[str] = None) -> str:
+  """The /v1/history payload: store stats, trailing means, cluster
+  compacts, and (for a single-metric query) the value series."""
+  if "rows" in payload and "moved" in payload:
+    return render_diff(payload)
+  tiers = payload.get("tiers") or {}
+  out = [
+    f"metrics history (node {payload.get('node_id', '?')}): "
+    f"enabled={payload.get('enabled')} sample_s={_fmt(payload.get('sample_s'))} "
+    f"samples_total={payload.get('samples_total')} "
+    f"restarts={payload.get('restarts')}",
+    f"tiers: fine={tiers.get('fine')} mid={tiers.get('mid')} old={tiers.get('old')}"
+    + (f"  spool: {payload['spool']}" if payload.get("spool") else ""),
+  ]
+  trailing = payload.get("trailing") or {}
+  if trailing:
+    out += ["", "trailing means (drift window):", _table(
+      ["metric", "mean"], [[k, v] for k, v in sorted(trailing.items())])]
+  rows = payload.get("rows") or []
+  if metric and rows:
+    out += ["", f"series: {metric}", _table(
+      ["ts", "dur_s", "samples", "value", "restart"],
+      [[r.get("ts"), r.get("dur_s"), r.get("samples"), r.get("value"),
+        r.get("restart")] for r in rows[-64:]])]
+  elif rows:
+    out.append(f"\nrows retained: {len(rows)} "
+               "(pass --metric to render one gauge's series)")
+  cluster = payload.get("cluster") or {}
+  peers = {nid: c for nid, c in cluster.items() if nid != payload.get("node_id")}
+  if peers:
+    out += ["", "cluster compacts (trailing means per node):"]
+    metrics = sorted({m for c in cluster.values()
+                      for m in (c.get("trailing") or {})})
+    out.append(_table(
+      ["node"] + metrics + ["restarts", "stale"],
+      [[nid] + [(c.get("trailing") or {}).get(m) for m in metrics]
+       + [c.get("restarts"), c.get("stale")]
+       for nid, c in sorted(cluster.items())]))
+  return "\n".join(out) + "\n"

@@ -1,0 +1,70 @@
+"""CLI: fetch and render a node's metrics history.
+
+  python -m tools.history http://127.0.0.1:52415
+  python -m tools.history http://127.0.0.1:52415 --diff 600
+  python -m tools.history http://127.0.0.1:52415 --metric decode_tok_s --window 3600
+  python -m tools.history saved_history.json     # render a saved payload
+
+The no-flag call plus `--diff` is the two-command workflow documented in
+README "Metrics history & drift": first "what does the record say", then
+"which metric moved".
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+if str(REPO) not in sys.path:
+  sys.path.insert(0, str(REPO))
+
+from tools.history import render
+
+
+def _fetch(url: str, timeout: float = 10.0) -> dict:
+  with urllib.request.urlopen(url, timeout=timeout) as r:
+    return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+    prog="python -m tools.history",
+    description="Render a node's /v1/history downsampled metrics record")
+  parser.add_argument("source", help="node base URL (http://host:port) or a saved JSON payload")
+  parser.add_argument("--window", type=float, metavar="SECONDS",
+                      help="bound the record to the trailing window")
+  parser.add_argument("--metric", help="render ONE gauge's value series")
+  parser.add_argument("--diff", type=float, metavar="SECONDS",
+                      help="two-window 'which metric moved' diff")
+  parser.add_argument("--json", action="store_true", help="print raw JSON instead of tables")
+  args = parser.parse_args(argv)
+
+  if args.source.startswith(("http://", "https://")):
+    base = args.source.rstrip("/")
+    if args.diff is not None:
+      url = f"{base}/v1/history?diff={args.diff:g}"
+    else:
+      query = {}
+      if args.window is not None:
+        query["window"] = f"{args.window:g}"
+      if args.metric:
+        query["metric"] = args.metric
+      url = f"{base}/v1/history" + (f"?{urllib.parse.urlencode(query)}" if query else "")
+    try:
+      payload = _fetch(url)
+    except Exception as e:
+      print(f"fetch {url} failed: {e}", file=sys.stderr)
+      return 2
+  else:
+    payload = json.loads(Path(args.source).read_text())
+
+  print(json.dumps(payload, indent=1) if args.json else render(payload, metric=args.metric))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -277,6 +277,59 @@ def summarize_alerts(alerts: Optional[dict],
   return classify_alert_firings(alert_rows_of(alerts), fault_windows)
 
 
+def is_drift_row(row: dict) -> bool:
+  """A perf_drift firing (the chronic sentinel) vs an SLO burn firing.
+  Classified separately: the two alert classes have different green bars
+  and different benchdiff zero-tolerance keys."""
+  return str(row.get("rule") or "").startswith("perf_drift")
+
+
+def summarize_history(history_by_node: Optional[Dict[str, dict]]) -> Optional[Dict[str, Any]]:
+  """The report's metrics-history section from the /v1/history scrapes:
+  per-node sample/restart counts and trailing gauge means — the record a
+  chronic-rot investigation starts from. None when no node served one."""
+  if not history_by_node:
+    return None
+  nodes = {}
+  for node_id, h in sorted(history_by_node.items()):
+    if not isinstance(h, dict) or not h.get("enabled"):
+      continue
+    nodes[node_id] = {
+      "samples_total": int(h.get("samples_total") or 0),
+      "restarts": int(h.get("restarts") or 0),
+      "tiers": h.get("tiers"),
+      "trailing": h.get("trailing") or {},
+    }
+  if not nodes:
+    return None
+  return {
+    "nodes": nodes,
+    "samples_total": sum(n["samples_total"] for n in nodes.values()),
+    "restarts_total": sum(n["restarts"] for n in nodes.values()),
+  }
+
+
+def summarize_drift(rows: Iterable[dict], fault_windows: Iterable[dict],
+                    since: Optional[float] = None,
+                    router_status: Optional[dict] = None) -> Dict[str, Any]:
+  """The report's chronic-drift section: perf_drift firings classified
+  against the fault schedule (same window discipline as the SLO rows —
+  a drift firing with no injected fault to blame means the sentinel pages
+  on healthy traffic) plus the router's differential-drift naming."""
+  out = classify_alert_firings(rows, fault_windows, since=since)
+  if router_status is not None:
+    out["router_named_total"] = int(router_status.get("drift_named_total") or 0)
+    # `drift_last` is stamped (name + evidence) at naming time and
+    # survives the clear, so the map's shape never depends on whether the
+    # live `drift` name had already been forgotten by scrape time.
+    out["router_named"] = {
+      name: rep["drift_last"]
+      for name, rep in (router_status.get("replicas") or {}).items()
+      if rep.get("drift_last")
+    }
+  return out
+
+
 def summarize_anatomy(anatomy: Optional[dict]) -> Optional[Dict[str, Any]]:
   """The report's stage-breakdown section from one /v1/anatomy scrape on
   the API node: per-stage mean/percentile contributions plus the
@@ -472,6 +525,17 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
       alerts.get("outside_fault_windows", 0))
     out["alerts_fired_and_resolved"] = float(
       alerts.get("fired_and_resolved_in_window", 0))
+  drift = report.get("drift")
+  if drift is not None:
+    out["drift_firings_total"] = float(len(drift.get("firings") or ()))
+    out["drift_firings_outside_fault_windows"] = float(
+      drift.get("outside_fault_windows", 0))
+    if "router_named_total" in drift:
+      out["router_drift_named"] = float(drift.get("router_named_total") or 0)
+  history = report.get("history")
+  if history is not None:
+    out["history_samples_total"] = float(history.get("samples_total") or 0)
+    out["history_restarts_total"] = float(history.get("restarts_total") or 0)
   anatomy = report.get("anatomy")
   if anatomy is not None:
     out["anatomy_breakdowns"] = float(anatomy.get("breakdowns") or 0)
@@ -507,6 +571,13 @@ def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
         f"alert fired outside any fault window: {fired.get('rule')} on "
         f"{fired.get('node_id')} at ts={fired.get('fired_at')}"
         + (f" (suspect {fired.get('suspect')})" if fired.get("suspect") else ""))
+  for fired in ((report.get("drift") or {}).get("firings") or ()):
+    # Same zero-tolerance as the SLO rows: a chronic sentinel that names
+    # rot on healthy traffic is paging noise, not a detector.
+    if not fired.get("in_fault_window"):
+      reasons.append(
+        f"perf_drift fired outside any fault window: {fired.get('rule')} on "
+        f"{fired.get('node_id')} at ts={fired.get('fired_at')}")
   client = report.get("client") or {}
   outside = client.get("errors_outside_fault_windows", 0)
   if outside:

@@ -179,6 +179,13 @@ def main(argv=None) -> int:
   print(f"  alerts: firings={len(al.get('firings') or ())} "
         f"outside_fault_windows={al.get('outside_fault_windows', 0)} "
         f"fired_and_resolved={al.get('fired_and_resolved_in_window', 0)}")
+  dr = report.get("drift") or {}
+  hi = report.get("history") or {}
+  print(f"  drift: firings={len(dr.get('firings') or ())} "
+        f"outside_fault_windows={dr.get('outside_fault_windows', 0)} "
+        f"router_named={dr.get('router_named_total', 0)}; "
+        f"history: samples={hi.get('samples_total', 0)} "
+        f"restarts={hi.get('restarts_total', 0)}")
   ov = report.get("overload")
   if ov is not None:
     print(f"  overload: client_rejected={ov.get('client_rejected')} "

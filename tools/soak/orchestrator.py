@@ -71,6 +71,16 @@ SOAK_ALERT_ENV = {
   # can't fire a latency rule outside the fault window — the kill detector
   # here is the error-rate rule.
   "XOT_SLO_TARGET": "0.9",
+  # CI-timescale history: 2 s samples so a one-minute smoke still records
+  # a meaningful downsampled series for the report's history section. The
+  # node-side drift sentinel is effectively OFF (pending hold longer than
+  # any smoke): its peer-median arm needs only XOT_DRIFT_MIN_SAMPLES in
+  # the current window — no chronic baseline — so a loaded CI runner's
+  # hop-RTT jitter between ring nodes could otherwise fire perf_drift
+  # outside any fault window, a zero-tolerance red. Chronic detection is
+  # proven by its own unit/e2e tests, not smuggled into the smoke.
+  "XOT_HISTORY_SAMPLE_S": "2",
+  "XOT_DRIFT_PENDING_S": "600",
 }
 
 
@@ -95,6 +105,19 @@ ROUTER_REPLICA_ENV = {
   "XOT_ADMIT_QUEUE_DEPTH": "2",
   "XOT_SLO_TTFT_S": "6",
   "XOT_SLO_E2E_S": "6",
+  # Short trailing window for the history compact: the injected gray
+  # delay pollutes the slow replica's trailing means, and a 120 s default
+  # window would keep the router's differential-drift comparison naming it
+  # long after the fault cleared — blocking the readmission the smoke
+  # asserts. 30 s lets the gauges forget the fault on the smoke's clock.
+  "XOT_DRIFT_WINDOW_S": "30",
+  # Node-side drift sentinel effectively off for the smoke: the gray
+  # phase is an ACUTE fault the burn rules own (and provably fire on);
+  # letting the chronic sentinel also fire during it adds nothing but a
+  # 60 s resolve hysteresis that outlives the run. The router-side
+  # differential naming (the actuator) still runs and is what the
+  # report's drift section records.
+  "XOT_DRIFT_PENDING_S": "600",
 }
 
 # Router process env: CI-timescale cadences (1 s polls, 5 s minimum
@@ -185,6 +208,9 @@ class SoakRing:
     self.last_perf: Optional[dict] = None
     self.last_alerts: Optional[dict] = None
     self.last_anatomy: Optional[dict] = None
+    # Latest /v1/history body per head node: the chronic-memory record the
+    # report's history section summarizes and CI uploads as an artifact.
+    self.last_history: Dict[str, dict] = {}
     # Where children spool their flight ring on SIGTERM (teardown): a
     # terminated node's evidence survives the process instead of relying
     # only on its last-good scrape. Set by spawn().
@@ -303,6 +329,14 @@ class SoakRing:
       alerts = self.get_json(head, "/v1/alerts")
       if alerts is not None:
         merged_alert_nodes.update(alerts.get("nodes") or {})
+      # ?window=0: the stats/trailing head of the record without its rows
+      # — the continuous scrape only feeds the report's summary, so
+      # shipping every retained row each tick would be discarded I/O. The
+      # full body is fetched ONCE at settle (scrape_history_full) for the
+      # history_settle.json artifact.
+      history = self.get_json(head, "/v1/history?window=0")
+      if history is not None:
+        self.last_history[head] = history
     if merged_cluster:
       self.last_cluster = {"nodes": merged_cluster, "count": len(merged_cluster)}
     if merged_alert_nodes:
@@ -333,6 +367,17 @@ class SoakRing:
         for name, row in (status.get("replicas") or {}).items():
           self.note_router_row(name, str(row.get("state") or ""),
                                int(row.get("routed_total") or 0))
+
+  def scrape_history_full(self) -> None:
+    """One full /v1/history fetch per reachable head (every retained row)
+    — the settle-time artifact the CI step uploads; the continuous scrape
+    deliberately fetches only the row-less summary."""
+    heads = [n for n in (self.names if self.cfg.router else self.names[:1])
+             if self.alive(n)]
+    for head in heads:
+      history = self.get_json(head, "/v1/history", timeout=10.0)
+      if history is not None:
+        self.last_history[head] = history
 
   def note_router_row(self, name: str, state: str, routed: int) -> None:
     """One router-scrape observation into the out-of-rotation tracker."""
@@ -592,6 +637,15 @@ async def run_soak(cfg: SoakConfig) -> dict:
         json.dumps(ring.last_alerts or {}, indent=1) + "\n")
     except OSError as e:
       print(f"soak: writing alerts_settle.json failed: {e!r}", file=sys.stderr)
+    # The history record next to the alerts scrape: the same CI step
+    # uploads both, so a chronic-rot investigation has the full
+    # downsampled time-series, not just the report's trailing means.
+    try:
+      await loop.run_in_executor(None, ring.scrape_history_full)
+      (log_dir / "history_settle.json").write_text(
+        json.dumps(ring.last_history or {}, indent=1) + "\n")
+    except OSError as e:
+      print(f"soak: writing history_settle.json failed: {e!r}", file=sys.stderr)
 
     # Tear the ring down BEFORE assembling the report: children spool
     # their flight rings on SIGTERM (XOT_FLIGHT_DUMP_DIR), and the dumps
@@ -707,9 +761,16 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   aborts["unattributed"] = max(0, int(server["watchdog_aborts"]) - len(events))
   # Classify the accumulated superset, not just the settle scrape: a
   # firing on a since-evicted peer survives here even though its compact
-  # no longer rides the final /v1/alerts response.
-  alerts = verdicts.classify_alert_firings(list(ring.alert_rows.values()), windows,
-                                           since=t_wall_load_start)
+  # no longer rides the final /v1/alerts response. SLO burns and
+  # perf_drift firings split into their own sections — different green
+  # bars, different benchdiff zero-tolerance keys.
+  all_rows = list(ring.alert_rows.values())
+  alerts = verdicts.classify_alert_firings(
+    [r for r in all_rows if not verdicts.is_drift_row(r)], windows,
+    since=t_wall_load_start)
+  drift = verdicts.summarize_drift(
+    [r for r in all_rows if verdicts.is_drift_row(r)], windows,
+    since=t_wall_load_start, router_status=ring.last_router)
 
   report = {
     "schema": verdicts.SCHEMA,
@@ -745,6 +806,8 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
         for p in cfg.faults) else None)),
     "aborts": aborts,
     "alerts": alerts,
+    "drift": drift,
+    "history": verdicts.summarize_history(ring.last_history),
     "anatomy": verdicts.summarize_anatomy(ring.last_anatomy),
     "flight_dumps": {
       node_id: {"reason": d.get("reason"), "events": len(d.get("events") or ()),

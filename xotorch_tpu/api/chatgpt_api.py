@@ -153,6 +153,11 @@ class ChatGPTAPI:
     # breakdowns, ring-wide per-stage percentiles, and the "which stage
     # grew" two-window diff (orchestration/anatomy.py).
     r.add_get("/v1/anatomy", self.handle_get_anatomy)
+    # Metrics history: the bounded downsampling gauge time-series
+    # (orchestration/history.py) — windowed record, "which metric moved"
+    # diffs, and the trailing compact the router's peer-median drift
+    # comparison polls; cluster-rolled like /v1/alerts.
+    r.add_get("/v1/history", self.handle_get_history)
     # Runtime fault-injector control (test/soak only, like /quit): lets the
     # soak orchestrator drive wall-clock drop/delay/kill phases in a child
     # process AFTER spawn — XOT_FAULT_SPEC can only be set at startup.
@@ -397,6 +402,49 @@ class ChatGPTAPI:
     }
     return web.json_response(body)
 
+  async def handle_get_history(self, request):
+    """Metrics history: the node's downsampled gauge time-series.
+    `?window=<s>` bounds the record; `?metric=<name>` restricts rows to
+    one gauge; `?diff=<s>` answers "which metric moved" between the last
+    window and the one before it; `?compact=1` serves just the trailing
+    rollup (what the router's drift comparison polls). `cluster` carries
+    each ring peer's history compact off the status bus, stale-marked
+    like /v1/alerts."""
+    hist = self.node.history
+    if request.query.get("compact") == "1":
+      return web.json_response({
+        "node_id": self.node.id, "enabled": hist.enabled,
+        "compact": hist.compact() if hist.enabled else None,
+      })
+    diff = request.query.get("diff")
+    if diff is not None:
+      try:
+        window_s = float(diff)
+      except ValueError:
+        return web.json_response(
+          {"detail": f"diff must be a window in seconds, got {diff!r}"}, status=400)
+      return web.json_response({"node_id": self.node.id, **hist.diff(window_s)})
+    window = request.query.get("window")
+    window_s = None
+    if window is not None:
+      try:
+        window_s = float(window)
+      except ValueError:
+        return web.json_response(
+          {"detail": f"window must be seconds, got {window!r}"}, status=400)
+    body = {"node_id": self.node.id,
+            **hist.status(window_s=window_s, metric=request.query.get("metric"))}
+    cluster = {self.node.id: hist.compact()} if hist.enabled else {}
+    for nid, summary in self.node.peer_metrics.items():
+      h = summary.get("history") if isinstance(summary, dict) else None
+      if not h:
+        continue
+      if self.node.peer_metrics_stale(nid):
+        h = {**h, "stale": True}
+      cluster[nid] = h
+    body["cluster"] = cluster
+    return web.json_response(body)
+
   async def handle_get_perf(self, request):
     """Live performance-attribution report (engine.perf_report): the loaded
     model's analytic bf16/int8/int4 roofline ceilings, predicted vs actual
@@ -580,6 +628,8 @@ class ChatGPTAPI:
       astats = alerts.gauge_stats()
       for key, name, help_text in (
         ("firing", "xot_alerts_firing", "SLO alert rules currently firing on this node"),
+        ("drift_firing", "xot_perf_drift_firing",
+         "Chronic perf_drift rules currently firing on this node"),
       ):
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {astats[key]}\n")
       burn = alerts.burn_gauges()
@@ -815,6 +865,33 @@ class ChatGPTAPI:
     return response
 
   # ----------------------------------------------------- chat completions
+
+  def _ratelimit_headers(self, remaining: Optional[int] = None,
+                         reset_s: Optional[float] = None) -> dict:
+    """OpenAI-style x-ratelimit-* response headers from the admission
+    gate's live queue estimate (the ROADMAP front-door follow-up): the
+    request budget is the concurrency cap plus the bounded queue,
+    remaining is what is left of it right now, and reset is the
+    cost-model-backed estimated wait for the present population.
+    `remaining`/`reset_s` override the live view (the 429 path reports
+    the rejection's own numbers); keeping ONE definition of the budget
+    here means the 200 and 429 headers can never disagree. Empty when
+    the gate is off — defaults-off adds no headers, so disabled serving
+    stays byte-identical on the wire."""
+    gate = self.node.admission
+    if not gate.enabled:
+      return {}
+    limit = gate.max_inflight + gate.queue_limit
+    if remaining is None:
+      c = gate.compact()
+      used = int(c["inflight"]) + int(c["queued"])
+      remaining = max(0, limit - used)
+      reset_s = float(c["est_wait_s"]) if used else 0.0
+    return {
+      "x-ratelimit-limit-requests": str(limit),
+      "x-ratelimit-remaining-requests": str(remaining),
+      "x-ratelimit-reset-requests": f"{reset_s:g}s",
+    }
 
   def _resolve_model(self, model: Optional[str]) -> str:
     if not model or model.startswith("gpt-"):  # alias gpt-* (parity :322-323)
@@ -1059,7 +1136,12 @@ class ChatGPTAPI:
             "queue_depth": e.queued, "queue_limit": e.limit,
             "queue_position": e.queued + 1, "est_wait_s": e.retry_after_s,
           }},
-          status=429, headers={"Retry-After": str(retry_after)})
+          status=429, headers={
+            "Retry-After": str(retry_after),
+            # A shed request consumed the whole budget by definition:
+            # remaining 0, reset = the wait the client was quoted.
+            **self._ratelimit_headers(remaining=0, reset_s=e.retry_after_s),
+          })
     # One-shot transparent restart (XOT_REQUEST_RESTARTS, default 0 = off):
     # a request killed by a transient ring failure (hop error, stall
     # abort, evicted peer) is resubmitted ONCE under a fresh request id
@@ -1071,6 +1153,9 @@ class ChatGPTAPI:
     # XOT_REQUEST_DEADLINE_S of wall time is spent.
     restart_budget = max(0, knobs.get_int("XOT_REQUEST_RESTARTS"))
     deadline_s = knobs.get_float("XOT_REQUEST_DEADLINE_S")
+    # Snapshotted AT ADMISSION (slot held, queue position known): the
+    # budget view every response from this request reports, streamed or not.
+    rl_headers = self._ratelimit_headers()
     t0 = time.monotonic()
     base_request_id = request_id
     all_rids: List[str] = []
@@ -1091,7 +1176,8 @@ class ChatGPTAPI:
           try:
             return await self._stream_response(request, request_ids, model, tokenizer, stop=stop,
                                                logprobs=bool(want_logprobs),
-                                               restartable=can_restart)
+                                               restartable=can_restart,
+                                               extra_headers=rl_headers)
           except _StreamRestart as e:
             attempt += 1
             base_request_id = await self._restart_request(base_request_id, e.error)
@@ -1109,8 +1195,10 @@ class ChatGPTAPI:
           attempt += 1
           base_request_id = await self._restart_request(base_request_id, error)
           continue
-        return self._build_full_response(request_ids, results, error, model, tokenizer, prompt,
+        resp = self._build_full_response(request_ids, results, error, model, tokenizer, prompt,
                                          eos_ids, stop=stop, logprobs=bool(want_logprobs))
+        resp.headers.update(rl_headers)
+        return resp
     finally:
       if held_slot:
         # The slot outlives every sub-request and restart attempt; release
@@ -1221,7 +1309,8 @@ class ChatGPTAPI:
 
   async def _stream_response(self, request, request_ids: List[str], model: str, tokenizer,
                              stop: Optional[List[str]] = None, logprobs: bool = False,
-                             restartable: bool = False):
+                             restartable: bool = False,
+                             extra_headers: Optional[dict] = None):
     """SSE stream over one or more completions (OpenAI n): sub-requests'
     queues are merged and each chunk carries its choice index.
 
@@ -1241,7 +1330,8 @@ class ChatGPTAPI:
     choice finishes, a tail of max(len(stop))-1 chars is held back so a
     stop split across chunks is caught before any of it reaches the
     client; `sent[i]` tracks what choice i emitted."""
-    response = web.StreamResponse(status=200, headers=self._sse_headers())
+    response = web.StreamResponse(
+      status=200, headers={**self._sse_headers(), **(extra_headers or {})})
     prepared = False
 
     async def write(data: bytes) -> None:

@@ -776,6 +776,25 @@ class JAXShardInferenceEngine(InferenceEngine):
       out["spec_accepted"] = self._spec_accepted
     return out
 
+  def history_gauges(self) -> Optional[Dict[str, Any]]:
+    """Host-side gauge snapshot for the metrics-history sampler
+    (orchestration/history.py): live EWMA throughput/utilization plus the
+    cumulative counters the sampler differences per tick (jit dispatch
+    classification, host-tier fetch bytes — CUMULATIVE_ENGINE_KEYS). Reads
+    attribute ints and EWMA cells only; never touches the device. None
+    when attribution is off (XOT_PERF_ATTR=0) — the sampler then records
+    the node-level gauges alone."""
+    if self.perf is None:
+      return None
+    out: Dict[str, Any] = dict(self.perf_stats() or {})
+    spec = self.spec_stats()
+    if spec is not None:
+      out["spec_accept_rate"] = spec["accept_rate"]
+    out["jit_first_dispatches"] = self._jit_first_dispatches
+    out["jit_cached_dispatches"] = self._jit_cached_dispatches
+    out["host_fetch_bytes"] = self._host_fetch_bytes
+    return out
+
   def _observe_spec(self, proposed: int, accepted: int) -> None:
     """Feed one verify round into the paired accept-rate EWMAs (every
     verify path calls this right after bumping the cumulative counters)."""

@@ -184,6 +184,13 @@ class AlertEngine:
       for rule in rules
     }
     self.window_resets = 0
+    # Chronic-drift sentinel (orchestration/history.py): the perf_drift
+    # alert class, stepped from this engine's evaluate() tick so drift
+    # rides the same flight recorder, compact rollup, and router drain
+    # loop as the burn rules. Lazy import: history imports this module's
+    # delta/violation helpers.
+    from xotorch_tpu.orchestration.history import DriftSentinel
+    self.drift = DriftSentinel(node)
 
   # ------------------------------------------------------------- snapshots
 
@@ -314,6 +321,7 @@ class AlertEngine:
           st.pop("localization", None)
           st.pop("anatomy", None)
           transitions.append({"rule": rule.name, "to": "resolved", "at": now})
+    transitions.extend(self.drift.evaluate(now, wall))
     return transitions
 
   def _on_firing(self, st: dict) -> None:
@@ -399,11 +407,11 @@ class AlertEngine:
     return row
 
   def active(self) -> List[dict]:
-    return [self._alert_row(st) for st in self._states.values()
-            if st["state"] != "inactive"]
+    return ([self._alert_row(st) for st in self._states.values()
+             if st["state"] != "inactive"] + self.drift.active())
 
   def recent(self) -> List[dict]:
-    return list(self._recent)
+    return list(self._recent) + self.drift.recent()
 
   def status(self, localization: Optional[dict] = None) -> dict:
     """The local half of /v1/alerts: every rule's live burn rates, active
@@ -420,6 +428,7 @@ class AlertEngine:
       "active": self.active(),
       "recent": self.recent(),
       "degraded": localization if localization is not None else self.localization(),
+      "drift": self.drift.status(),
       "snapshots": len(self._snapshots),
       "window_resets": self.window_resets,
     }
@@ -430,8 +439,9 @@ class AlertEngine:
     just enough to classify and localize from a remote node."""
     def mini(row: dict) -> dict:
       loc = row.get("localization") or {}
-      out = {k: row.get(k) for k in ("rule", "family", "state", "fired_at",
-                                     "resolved_at", "burn_fast", "burn_slow")}
+      out = {k: row.get(k) for k in ("rule", "family", "class", "state",
+                                     "fired_at", "resolved_at", "burn_fast",
+                                     "burn_slow")}
       out["suspect"] = loc.get("suspect")
       out["stage"] = loc.get("stage")
       return {k: v for k, v in out.items() if v is not None}
@@ -440,6 +450,14 @@ class AlertEngine:
       localization = self.localization()
     degraded = [pid for pid, row in localization["peers"].items()
                 if row["degraded"]]
+    # `firing` counts SLO burns ONLY. Drift rows ride `active`/`recent`
+    # (class: perf_drift) as evidence, but must not feed the router's
+    # hard drain signal: a drain shifts the fleet's load onto the
+    # survivors, moves THEIR gauges off baseline, and a drift-inflated
+    # firing count would then drain the survivors too — the detector
+    # taking the whole fleet out. Like PR 9's `degraded`, node-side drift
+    # is advisory; the router's own fleet-median comparison (which knows
+    # whether the fleet is steady) is the actuator.
     return {
       "active": [mini(r) for r in self.active()],
       "recent": [mini(r) for r in self.recent()],
@@ -450,7 +468,8 @@ class AlertEngine:
   def gauge_stats(self) -> Dict[str, float]:
     """/metrics gauge values (keys are the exposition table's row keys)."""
     return {"firing": float(sum(1 for st in self._states.values()
-                                if st["state"] == "firing"))}
+                                if st["state"] == "firing")),
+            "drift_firing": float(self.drift.firing_count())}
 
   def burn_gauges(self) -> Dict[str, float]:
     """family -> fast-window burn rate, for xot_slo_burn_rate{family=...}."""

@@ -90,6 +90,15 @@ EVENTS = (
   "alert.firing",
   "alert.resolved",
   "alert.cancelled",
+  # chronic-drift sentinel (orchestration/history.py, stepped inside the
+  # alert loop): the perf_drift state machine's transitions, plus the
+  # router-side peer-median naming (`drift.replica`, recorded in the
+  # router's own flight recorder when a fleet comparison names a drifter).
+  "drift.pending",
+  "drift.firing",
+  "drift.resolved",
+  "drift.cancelled",
+  "drift.replica",
   # critical-path latency anatomy (orchestration/anatomy.py via node.py):
   # one event per assembled skew-corrected breakdown, so a frozen snapshot
   # shows which requests had their anatomy extracted and how much of each

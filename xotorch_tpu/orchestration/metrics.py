@@ -108,10 +108,22 @@ def aggregate_histograms(summaries: Iterable[dict],
 
 class NodeMetrics:
   def __init__(self, node_id: str = ""):
+    import time as _time
+
     from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
     self.registry = CollectorRegistry()
     labels = {"node_id": node_id}
+    # Process birth stamps: wall for humans, monotonic for arithmetic. The
+    # uptime gauge lets history samplers and soak verdicts tell a
+    # restart-induced counter reset (uptime collapsed) from a genuine drop.
+    self.started_at = _time.time()
+    self._started_mono = _time.monotonic()
+    self.uptime = Gauge(
+      "xot_uptime_seconds", "Seconds since this node process started",
+      ["node_id"], registry=self.registry,
+    ).labels(**labels)
+    self.uptime.set_function(self.uptime_s)
     self.requests_total = Counter(
       "xot_requests_total", "Prompts accepted by this node", ["node_id"], registry=self.registry
     ).labels(**labels)
@@ -196,6 +208,10 @@ class NodeMetrics:
       "xot_admit_queue_depth", "Requests currently waiting in the admission queue",
       ["node_id"], registry=self.registry,
     ).labels(**labels)
+
+  def uptime_s(self) -> float:
+    import time as _time
+    return _time.monotonic() - self._started_mono
 
   def exposition(self) -> bytes:
     from prometheus_client import generate_latest

@@ -298,6 +298,16 @@ class Node:
     self._evicted_until: Dict[str, float] = {}
     self._watchdog_task: Optional[asyncio.Task] = None
     self._health_task: Optional[asyncio.Task] = None
+    # Metrics history (XOT_HISTORY, default on): a bounded downsampling
+    # time-series of this node's own windowed gauge deltas, optionally
+    # spooled to XOT_HISTORY_DIR so restarts keep the record. Served at
+    # /v1/history; its trailing compact rides metrics_summary() so ring
+    # peers (and the router) can run peer-median drift comparisons.
+    # Constructed BEFORE the alert engine: the engine's DriftSentinel
+    # reads it on every evaluate tick.
+    from xotorch_tpu.orchestration.history import MetricsHistory
+    self.history = MetricsHistory(self)
+    self._history_task: Optional[asyncio.Task] = None
     # SLO burn-rate alerts + gray-failure localization (XOT_ALERT, default
     # on): evaluated on a background cadence over windowed deltas of this
     # node's own metric summaries; served at /v1/alerts and rolled over the
@@ -349,11 +359,13 @@ class Node:
     self.start_watchdog()
     self.start_health_monitor()
     self.start_alerts()
+    self.start_history()
     if DEBUG >= 1:
       print(f"Node {self.id} started; topology: {self.topology}")
 
   async def stop(self) -> None:
-    for attr in ("_topology_task", "_watchdog_task", "_health_task", "_alert_task"):
+    for attr in ("_topology_task", "_watchdog_task", "_health_task", "_alert_task",
+                 "_history_task"):
       task = getattr(self, attr)
       if task is not None:
         task.cancel()
@@ -395,6 +407,22 @@ class Node:
   def start_alerts(self) -> None:
     if self._alert_task is None and self.alerts.enabled:
       self._alert_task = self._spawn(self._alert_loop())
+
+  def start_history(self) -> None:
+    if self._history_task is None and self.history.enabled:
+      self._history_task = self._spawn(self._history_loop())
+
+  async def _history_loop(self) -> None:
+    """Metrics-history sampling cadence: one windowed gauge sample per
+    tick. Host-side reads only (metric cells, engine counters, EWMAs) —
+    this loop can never add a device sync."""
+    while True:
+      await asyncio.sleep(self.history.sample_s)
+      try:
+        self.history.observe()
+      except Exception as e:
+        if DEBUG >= 1:
+          print(f"history sampling error: {e!r}")
 
   async def _alert_loop(self) -> None:
     """SLO rule evaluation cadence: snapshot the node's own metric summary,
@@ -2134,6 +2162,11 @@ class Node:
     # enabled — defaults-off must add no keys to the wire.
     if self.admission.enabled:
       summary["admission"] = self.admission.compact()
+    # History compact (trailing gauge means): what ring peers' drift
+    # sentinels median against. Only while enabled — XOT_HISTORY=0 must
+    # add no keys to the wire.
+    if self.history.enabled:
+      summary["history"] = self.history.compact()
     return summary
 
   async def prefetch_prompt(self, base_shard: Shard, prompt: str) -> bool:

@@ -34,11 +34,74 @@ from __future__ import annotations
 import hashlib
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from xotorch_tpu.orchestration.history import DRIFT_RULES, median, worse_by
 from xotorch_tpu.utils import knobs
 
 # Escalation cap for the flap hysteresis: a replica that keeps flapping
 # waits at most 8x the base out-time between readmissions.
 MAX_OUT_MULTIPLIER = 8
+
+
+def fleet_trailing_medians(compacts: Iterable[dict],
+                           min_n: int = 1) -> Dict[str, float]:
+  """Per-metric median of the fleet's trailing history gauges. `compacts`
+  are /v1/history compact dicts from the replicas a drifting one should be
+  judged against (healthy + reachable only — a drained replica's polluted
+  gauges must not drag the fleet's definition of normal). A peer's value
+  joins the median only when it rests on at least `min_n` samples: one
+  cold-start observation is not a reference."""
+  by_metric: Dict[str, List[float]] = {}
+  for c in compacts:
+    trailing = (c or {}).get("trailing")
+    if not isinstance(trailing, dict):
+      continue
+    counts = (c or {}).get("trailing_n")
+    for metric, v in trailing.items():
+      # A compact without counts (older peer) reports unknown depth = 1.
+      n = int(counts.get(metric) or 0) if isinstance(counts, dict) else 1
+      if n >= min_n:
+        by_metric.setdefault(metric, []).append(float(v))
+  out = {}
+  for metric, vals in by_metric.items():
+    m = median(vals)
+    if m is not None:
+      out[metric] = m
+  return out
+
+
+def name_drift(own: Optional[dict], peer_medians: Dict[str, float],
+               ratio: float, min_n: int = 1) -> Optional[dict]:
+  """The differential-drift verdict for one replica: its worst watched
+  DIFFERENTIAL gauge deviating (direction-aware, past the rule's absolute
+  floor) from the PEER median by at least `ratio`, or None when it tracks
+  the fleet. Volume-coupled gauges (tok/s, jit-miss, fetch bytes) are
+  excluded: they diverge whenever load is uneven — which the router's own
+  drains and spills cause — so comparing them across replicas is a
+  feedback loop, and a deviation resting on fewer than `min_n` samples
+  (a cold-start compile's lone TTFT) is not chronic evidence. Pure — the
+  router's poll loop feeds it compacts and debounces the result over
+  consecutive polls."""
+  trailing = (own or {}).get("trailing")
+  if not isinstance(trailing, dict):
+    return None
+  counts = (own or {}).get("trailing_n")
+  worst = None
+  for rule in DRIFT_RULES:
+    if not rule.differential:
+      continue
+    v = trailing.get(rule.metric)
+    ref = peer_medians.get(rule.metric)
+    # A compact without counts (older peer) reports unknown depth = 1.
+    n = int(counts.get(rule.metric) or 0) if isinstance(counts, dict) else 1
+    if v is None or ref is None or n < min_n:
+      continue
+    dev = worse_by(float(v), float(ref), rule.worse)
+    if dev < ratio or abs(float(v) - float(ref)) < rule.floor:
+      continue
+    if worst is None or dev > worst["worse_by"]:
+      worst = {"metric": rule.metric, "value": round(float(v), 6),
+               "peer_median": round(float(ref), 6), "worse_by": round(dev, 4)}
+  return worst
 
 
 def prefix_key(body: dict) -> str:
@@ -162,7 +225,12 @@ class ReplicaLifecycle:
       unreachable replica (flap escalation applies when the drain lands
       inside the flap window of the last readmission);
     - draining -> probing once the replica is reachable, its inflight
-      count has drained to zero, and the alert has cleared;
+      count has drained to zero, and the ACCUSATION has cleared — the
+      firing alert resolved AND no suspect (gray localization or
+      perf_drift) is still named. Probing while the cause persists sends
+      canaries INTO the fault: they pollute the replica's latency
+      histograms with traffic no client sees and can readmit a replica
+      whose rot merely paused;
     - probing -> draining when the burn re-fires mid-probe.
     A never-yet-reachable replica (still booting) takes no transition:
     it is not routable anyway, and draining it would burn a
@@ -188,18 +256,23 @@ class ReplicaLifecycle:
       self.drain_reason = why
       return self._transition("draining", now, why)
     if self.state == "draining":
-      if reachable and inflight <= 0 and not firing:
+      if reachable and inflight <= 0 and not firing and not suspect:
         return self._transition("probing", now, "drained")
       return None
-    if self.state == "probing" and (bool(firing) or not reachable):
-      # The burn came back mid-probe: a full re-drain, not a pause — the
-      # minimum out-time restarts from NOW (otherwise the original drain's
-      # clock would let a replica whose alert merely dips readmit seconds
-      # after each dip, the oscillation the hysteresis exists to prevent).
+    if self.state == "probing" and bad:
+      # The accusation came back mid-probe (burn re-fired, suspect
+      # re-named, or the replica vanished): a full re-drain, not a pause —
+      # the minimum out-time restarts from NOW (otherwise the original
+      # drain's clock would let a replica whose alert merely dips readmit
+      # seconds after each dip, the oscillation the hysteresis exists to
+      # prevent). Without the suspect arm, note_probe could readmit a
+      # still-accused replica and the next poll would instantly re-drain
+      # it with flap escalation.
       self.probe_successes = 0
       self.drained_at = now
       self.drains_total += 1
-      why = "alert re-fired" if firing else "unreachable"
+      why = ("alert re-fired" if firing
+             else f"suspect:{suspect}" if suspect else "unreachable")
       self.drain_reason = why
       return self._transition("draining", now, why)
     return None

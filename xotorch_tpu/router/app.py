@@ -26,13 +26,27 @@ from aiohttp import ClientSession, ClientTimeout, web
 
 from xotorch_tpu.orchestration.flight import FlightRecorder
 from xotorch_tpu.router import (
-  ReplicaLifecycle, least_loaded, prefix_key, replica_names, route,
+  ReplicaLifecycle, fleet_trailing_medians, least_loaded, name_drift,
+  prefix_key, replica_names, route,
 )
 from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
 
 _POLL_TIMEOUT = ClientTimeout(total=5.0)
 _PROBE_TIMEOUT = ClientTimeout(total=60.0)
+
+
+def _passthrough_headers(upstream_headers) -> dict:
+  """Response headers the router relays verbatim: Retry-After plus the
+  OpenAI-style x-ratelimit-* family the replica's admission gate stamps —
+  a client behind the router sees the same budget view it would see
+  talking to the replica directly."""
+  out = {}
+  for key, value in upstream_headers.items():
+    lower = key.lower()
+    if lower == "retry-after" or lower.startswith("x-ratelimit-"):
+      out[key] = value
+  return out
 
 
 class _Replica:
@@ -49,6 +63,20 @@ class _Replica:
     self.active_requests = 0       # latest ring-visible inflight
     self.firing = 0                # latest cluster-wide firing alert count
     self.suspect: Optional[str] = None
+    # Latest /v1/history trailing compact (None until the replica serves
+    # one) and the debounced differential-drift verdict: `drift_hit` is
+    # the live per-poll comparison, `drift` the metric it has held on for
+    # XOT_ROUTER_DRIFT_POLLS consecutive polls — the drain-eligible name.
+    self.history: Optional[dict] = None
+    self.history_at: Optional[float] = None  # router-clock receive time
+    self.drift_hit: Optional[dict] = None
+    self.drift_polls = 0
+    self.drift: Optional[str] = None
+    # Last name ever held (with its evidence), surviving the clear: the
+    # live `drift` field empties once the trailing window forgets, so a
+    # teardown-time scrape could otherwise never say WHO was named.
+    self.drift_last: Optional[dict] = None
+    self.drift_named_total = 0
     self.routed_total = 0
     self.spilled_to_total = 0
     self.relayed_429_total = 0
@@ -69,6 +97,9 @@ class _Replica:
       **self.lifecycle.snapshot(),
       "url": self.url, "reachable": self.reachable,
       "firing": self.firing, "suspect": self.suspect,
+      "drift": self.drift, "drift_hit": self.drift_hit,
+      "drift_last": self.drift_last,
+      "drift_named_total": self.drift_named_total,
       "active_requests": self.active_requests,
       "queue": self.queue,
       "routed_total": self.routed_total,
@@ -85,6 +116,10 @@ class RouterApp:
     self.poll_s = max(0.2, knobs.get_float("XOT_ROUTER_POLL_S"))
     self.spill_depth = max(0, knobs.get_int("XOT_ROUTER_SPILL_DEPTH"))
     self.probe_tokens = max(1, knobs.get_int("XOT_ROUTER_PROBE_TOKENS"))
+    self.drift_enabled = knobs.get_bool("XOT_ROUTER_DRIFT")
+    self.drift_polls_required = max(1, knobs.get_int("XOT_ROUTER_DRIFT_POLLS"))
+    self.drift_peer_ratio = max(0.01, knobs.get_float("XOT_DRIFT_PEER_RATIO"))
+    self.drift_min_samples = max(1, knobs.get_int("XOT_DRIFT_MIN_SAMPLES"))
     self.proxy_timeout = ClientTimeout(
       total=max(5.0, knobs.get_float("XOT_ROUTER_TIMEOUT_S")))
     self.flight = FlightRecorder(node_id="router")
@@ -171,6 +206,21 @@ class RouterApp:
       # draining (or never drain it) exactly when it is least trustworthy.
       if DEBUG >= 2:
         print(f"router: /v1/alerts poll of {rep.name} failed: {e!r}")
+    if not self.drift_enabled:
+      return
+    try:
+      async with self._session.get(f"{rep.url}/v1/history?compact=1",
+                                   timeout=_POLL_TIMEOUT) as resp:
+        h = await resp.json()
+      rep.history = h.get("compact") if h.get("enabled") else None
+      # Stamped on the ROUTER's monotonic clock: freshness must not trust
+      # the replica's wall clock (cross-host skew would silently disable
+      # — or never expire — this replica's drift evidence).
+      rep.history_at = time.monotonic()
+    except Exception as e:
+      # Fail CLOSED like the polls above: keep the last trailing view.
+      if DEBUG >= 2:
+        print(f"router: /v1/history poll of {rep.name} failed: {e!r}")
 
   async def _probe_one(self, rep: _Replica) -> None:
     """One synthetic canary completion against a probing replica. The model
@@ -204,19 +254,95 @@ class RouterApp:
     finally:
       rep.probe_inflight = False
 
+  def _note_drift(self, rep: _Replica) -> None:
+    """One poll tick of the differential-drift detector: compare this
+    replica's trailing history gauges against the median of its HEALTHY
+    reachable peers (replicas serving rendezvous-split traffic should
+    perform identically), debounced over consecutive polls so one noisy
+    tick never drains anyone. Evaluated for every reachable replica — a
+    drained one must be able to CLEAR its name, or it could never
+    readmit."""
+    now = time.monotonic()
+
+    def fresh(r: _Replica) -> Optional[dict]:
+      # A compact that has stopped refreshing (the /v1/history poll keeps
+      # failing while the lighter polls keep the replica reachable) is
+      # history, not evidence: judging by it would freeze a named
+      # drifter's polluted pre-drain view and block the name from EVER
+      # clearing. Staleness is measured on the router's receive stamps —
+      # never the replica's wall clock.
+      if r.history is None or r.history_at is None \
+          or now - r.history_at > max(10.0 * self.poll_s, 30.0):
+        return None
+      return r.history
+
+    peers = []
+    for r in self.replicas.values():
+      if r is rep or not r.reachable or not r.lifecycle.routable:
+        continue
+      h = fresh(r)
+      if h is not None:
+        peers.append(h)
+    if not peers:
+      # No fresh reference fleet: no verdict either way. Fail CLOSED like
+      # the poll-failure handlers — a confirmed name must not clear (and
+      # readmit a still-rotten replica) just because the peers' history
+      # polls went dark; only a real tracks-the-fleet verdict clears it.
+      rep.drift_hit = None
+      return
+    hit = name_drift(fresh(rep),
+                     fleet_trailing_medians(peers, min_n=self.drift_min_samples),
+                     self.drift_peer_ratio,
+                     min_n=self.drift_min_samples)
+    rep.drift_hit = hit
+    if hit is None:
+      rep.drift_polls = 0
+      rep.drift = None
+      return
+    # Single-suspect discipline: while any OTHER replica is out of
+    # rotation the fleet median is not a steady reference — naming a
+    # second chronic drifter then could take the whole fleet out, and the
+    # overflow load a drain shifts onto survivors legitimately moves
+    # their gauges. The debounce counter RESETS too: deviations observed
+    # during (or before) the unsteady phase are load-shift artifacts, and
+    # crediting them would let a survivor be named on the first steady
+    # poll after a peer readmits — naming requires the deviation to hold
+    # for XOT_ROUTER_DRIFT_POLLS consecutive STEADY polls.
+    fleet_steady = all(r.lifecycle.state == "healthy"
+                      for r in self.replicas.values() if r is not rep)
+    if not fleet_steady:
+      rep.drift_polls = 0
+      return
+    rep.drift_polls += 1
+    if rep.drift_polls >= self.drift_polls_required and rep.drift is None:
+      rep.drift = f"perf_drift:{hit['metric']}"
+      rep.drift_last = {"name": rep.drift, "at": time.time(), **hit}
+      rep.drift_named_total += 1
+      self.flight.record("drift.replica", None, replica=rep.name,
+                         metric=hit["metric"], value=hit["value"],
+                         peer_median=hit["peer_median"],
+                         worse_by=hit["worse_by"])
+      if DEBUG >= 0:
+        print(f"router: replica {rep.name} named {rep.drift} "
+              f"({hit['value']} vs fleet median {hit['peer_median']})")
+
   async def _poll_loop(self) -> None:
     while True:
       await asyncio.sleep(self.poll_s)
       now = time.monotonic()
       try:
         await asyncio.gather(*(self._poll_one(r) for r in self.replicas.values()))
+        if self.drift_enabled:
+          for rep in self.replicas.values():
+            if rep.reachable:
+              self._note_drift(rep)
         for rep in self.replicas.values():
           inflight = rep.active_requests
           q = rep.queue or {}
           if q.get("max_inflight"):
             inflight = max(inflight, int(q.get("inflight") or 0))
           ev = rep.lifecycle.note_status(
-            now, firing=rep.firing, suspect=rep.suspect,
+            now, firing=rep.firing, suspect=rep.suspect or rep.drift,
             inflight=inflight, reachable=rep.reachable)
           if ev is not None:
             if ev["transition"] == "draining":
@@ -248,6 +374,7 @@ class RouterApp:
       "prefetch_announced_total": self.prefetch_announced_total,
       "drains_total": sum(r.lifecycle.drains_total for r in self.replicas.values()),
       "readmits_total": sum(r.lifecycle.readmits_total for r in self.replicas.values()),
+      "drift_named_total": sum(r.drift_named_total for r in self.replicas.values()),
       "poll_s": self.poll_s, "spill_depth": self.spill_depth,
     })
 
@@ -400,11 +527,9 @@ class RouterApp:
                                     timeout=self.proxy_timeout) as resp:
         if resp.status == 429 and not allow_429:
           return None
-        headers = {}
-        if resp.headers.get("Retry-After"):
-          headers["Retry-After"] = resp.headers["Retry-After"]
         return web.Response(body=await resp.read(), status=resp.status,
-                            content_type=resp.content_type, headers=headers)
+                            content_type=resp.content_type,
+                            headers=_passthrough_headers(resp.headers))
     except Exception as e:
       # allow_429 is set exactly on the final attempt (see _forward).
       return self._connect_failed(rep, e, final=allow_429)
@@ -427,15 +552,14 @@ class RouterApp:
       if upstream.status == 429 and not allow_429:
         return None
       if upstream.status != 200:
-        headers = {}
-        if upstream.headers.get("Retry-After"):
-          headers["Retry-After"] = upstream.headers["Retry-After"]
         return web.Response(body=await upstream.read(), status=upstream.status,
-                            content_type=upstream.content_type, headers=headers)
+                            content_type=upstream.content_type,
+                            headers=_passthrough_headers(upstream.headers))
       response = web.StreamResponse(status=200, headers={
         "Content-Type": upstream.headers.get("Content-Type", "text/event-stream"),
         "Cache-Control": "no-cache",
         "Access-Control-Allow-Origin": "*",
+        **_passthrough_headers(upstream.headers),
       })
       await response.prepare(request)
       async for chunk in upstream.content.iter_any():

@@ -120,6 +120,8 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_ROUTER_FLAP_S", "float", "60", "Router: a re-drain within this many seconds of a readmission counts as flapping (escalates the out-time hysteresis).", "Front door"),
   Knob("XOT_ROUTER_SPILL_DEPTH", "int", "2", "Router: spill a request to the least-loaded healthy replica when its affinity replica's admission queue is at least this deep.", "Front door"),
   Knob("XOT_ROUTER_TIMEOUT_S", "float", "300", "Router: total proxy timeout (s) for one forwarded request.", "Front door"),
+  Knob("XOT_ROUTER_DRIFT", "bool", "1", "Router: compare each replica's /v1/history trailing gauges against the fleet median and treat a chronic drifter as a drain-eligible perf_drift suspect.", "Front door"),
+  Knob("XOT_ROUTER_DRIFT_POLLS", "int", "3", "Router: consecutive poll ticks a replica must deviate from the fleet median before it is named perf_drift.", "Front door"),
   # ------------------------------------------------------------- topology
   Knob("XOT_COORDINATOR", "str", None, "JAX multi-host coordinator address (`host:port`); setting it implies multi-host.", "Topology"),
   Knob("XOT_MULTIHOST", "bool", "0", "Force JAX multi-host initialization.", "Topology"),
@@ -168,6 +170,21 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_SLO_E2E_S", "float", "60", "End-to-end request latency SLO target (s).", "Alerting"),
   Knob("XOT_SLO_TARGET", "float", "0.99", "Fraction of requests that must meet each latency SLO target (error budget = 1 - target; must leave budget * XOT_ALERT_BURN_FAST below 1 or the rule can never fire).", "Alerting"),
   Knob("XOT_SLO_ERROR_RATE", "float", "0.01", "Failed-request budget: the fraction of requests that may abort before the error-rate rule burns.", "Alerting"),
+  # --------------------------------------------------- metrics history / drift
+  Knob("XOT_HISTORY", "bool", "1", "Metrics history: sample windowed deltas of the node's own gauges on a background cadence (served at /v1/history); 0 disables the sampler entirely — no task, no wire keys, byte-identical serving.", "History"),
+  Knob("XOT_HISTORY_SAMPLE_S", "float", "10", "History sampling cadence (seconds): one windowed-delta gauge sample per tick.", "History"),
+  Knob("XOT_HISTORY_SAMPLES", "int", "360", "Fine-tier samples kept before the oldest are merged into the next-coarser tier (at the default 10 s cadence: one hour at full resolution).", "History"),
+  Knob("XOT_HISTORY_MERGE", "int", "8", "Samples merged into one duration-weighted bucket when a history tier overflows into the next-coarser tier.", "History"),
+  Knob("XOT_HISTORY_COARSE", "int", "336", "Buckets kept in each of the two coarser history tiers (mid keeps merge-fold buckets, old keeps merge^2-fold).", "History"),
+  Knob("XOT_HISTORY_DIR", "path", None, "JSONL spool directory for history samples: restarts and soak teardowns keep the record (restored rows are marked as a restart boundary); unset keeps history in memory only.", "History"),
+  Knob("XOT_DRIFT", "bool", "1", "Evaluate chronic perf-drift rules over the metrics history inside the alert loop (requires XOT_HISTORY and XOT_ALERT); fires the perf_drift alert class.", "History"),
+  Knob("XOT_DRIFT_WINDOW_S", "float", "120", "Recent window (s) a drift rule averages over — also the trailing-mean window of the history compact the router and ring peers compare.", "History"),
+  Knob("XOT_DRIFT_BASELINE_S", "float", "600", "Trailing baseline window (s) a drift rule compares its recent window against; the baseline ends where the recent window begins.", "History"),
+  Knob("XOT_DRIFT_RATIO", "float", "0.25", "Relative worsening vs the gauge's own trailing baseline before a drift rule's condition holds (direction-aware: tok/s down, rtt up).", "History"),
+  Knob("XOT_DRIFT_PEER_RATIO", "float", "0.5", "Relative worsening vs the median of peer nodes' trailing gauges before a drift rule's condition holds.", "History"),
+  Knob("XOT_DRIFT_MIN_SAMPLES", "int", "3", "Minimum samples carrying the gauge in each compared window before a drift rule may evaluate (thin evidence never pages).", "History"),
+  Knob("XOT_DRIFT_PENDING_S", "float", "30", "Seconds a drift condition must hold before the pending perf_drift alert transitions to firing.", "History"),
+  Knob("XOT_DRIFT_RESOLVE_S", "float", "60", "Hysteresis: seconds a drift condition must stay clear before a firing perf_drift alert resolves.", "History"),
   # ------------------------------------------------------- soak / load gen
   Knob("XOT_SOAK_SECONDS", "float", "60", "Soak load duration (s) for `python -m tools.soak` when --seconds is not given.", "Soak"),
   Knob("XOT_SOAK_RPS", "float", "1.5", "Mean open-loop arrival rate (requests/s) for the soak load generator.", "Soak"),
