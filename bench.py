@@ -814,6 +814,83 @@ def _run_spec(model_id: str, prefill_len: int, decode_tokens: int, progress_path
   return asyncio.run(run())
 
 
+def _run_mesh(model_id: str, prefill_len: int, decode_tokens: int,
+              progress_path: str) -> dict:
+  """Tensor-parallel serving mesh throughput (the `mesh` retry stage): the
+  same greedy request through the Node loop with the ring stage tp-sharded
+  (XOT_TP=N — weights per spec_for_param, KV on Hkv, activations pinned,
+  paged kernels per-tp-shard) vs single-device (XOT_TP=0).
+
+  The two greedy streams must be IDENTICAL (mesh_tokens_verified): a mesh
+  may never change output, only who holds the bytes. The collective tax is
+  reported from the cost model (two row-parallel psums per layer) so the
+  speedup can be read against the per-device roofline honestly — on real
+  chips ICI carries it, on the forced host mesh it is memcpy. BENCH_MESH_TP
+  sets the requested width (default 2; the engine clamps to feasibility)."""
+  import asyncio
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+  tp_req = int(os.getenv("BENCH_MESH_TP", "2"))
+  words = ("alpha", "beta", "gamma", "delta")
+  prompt = " ".join(words[i % len(words)] for i in range(prefill_len))
+
+  async def run_mode(tp: int, tag: str) -> dict:
+    prior = os.environ.get("XOT_TP")
+    os.environ["XOT_TP"] = str(tp)
+    try:
+      eng = JAXShardInferenceEngine()
+      node = Node(f"mesh-{tag}", _NullServer(), eng, _NoDiscovery(), None,
+                  RingMemoryWeightedPartitioningStrategy(),
+                  max_generate_tokens=decode_tokens, default_sample_temp=0.0,
+                  decode_chunk_size=int(os.getenv("XOT_DECODE_CHUNK", "8")))
+      node.device_capabilities = _bench_caps()
+      node.topology.update_node(node.id, _bench_caps())
+      shard = Shard(model_id, 0, n_layers - 1, n_layers)
+
+      warm = await _timed_generate([node], shard, prompt, f"bench-mesh-{tag}-warmup")
+      _record(progress_path, f"mesh:{tag}:warmup", tok_s=round(warm["tok_s"], 2))
+      timed = await _timed_generate([node], shard, prompt, f"bench-mesh-{tag}-timed")
+      mesh = getattr(eng, "_mesh", None)
+      timed["tp"] = int(mesh.shape["tp"]) if mesh is not None and "tp" in mesh.shape else 1
+      model = (eng.perf_report() or {}).get("model") or {}
+      timed["collective_bytes"] = model.get("collective_bytes_per_token", 0)
+      timed["weight_bytes_per_device"] = model.get("weight_bytes_per_device_actual")
+      _record(progress_path, f"mesh:{tag}", tok_s=round(timed["tok_s"], 2),
+              tp=timed["tp"])
+      return timed
+    finally:
+      if prior is None:
+        os.environ.pop("XOT_TP", None)
+      else:
+        os.environ["XOT_TP"] = prior
+
+  async def run() -> dict:
+    on = await run_mode(tp_req, "on")
+    off = await run_mode(0, "off")
+    return {
+      "mesh_tok_s": round(on["tok_s"], 2),
+      "mesh_off_tok_s": round(off["tok_s"], 2),
+      "mesh_speedup": round(on["tok_s"] / off["tok_s"], 2) if off["tok_s"] else None,
+      "mesh_ttft_ms": round(on["ttft_s"] * 1000, 1),
+      "mesh_tp": on["tp"],
+      # Per-device byte story behind the headline: the cost-model ICI term
+      # and the ground-truth-checked per-device weight stream.
+      "mesh_collective_bytes": on["collective_bytes"],
+      "mesh_weight_bytes_per_device": on["weight_bytes_per_device"],
+      # IDENTITY, not allclose: sharding may never change the stream.
+      "mesh_tokens_verified": bool(on["tokens"] and on["tokens"] == off["tokens"]),
+    }
+
+  return asyncio.run(run())
+
+
 def _run_concurrent(model_id: str, prefill_len: int, decode_tokens: int, n_conc: int,
                     progress_path: str) -> dict:
   """Aggregate throughput of N concurrent requests through one Node with
@@ -1401,6 +1478,20 @@ def child_main() -> None:
       res.update(_run_spec(model_id, min(prefill_len, 128), decode_tokens, progress_path))
     except Exception as e:
       res["spec_error"] = repr(e)
+  # Mesh (tensor-parallel serving) stage (opt-in: BENCH_MESH=1 — the
+  # tpu_retry `mesh` step): XOT_TP on vs off through the Node loop, greedy
+  # streams cross-checked byte for byte.
+  if os.getenv("BENCH_MESH", "0") == "1":
+    try:
+      res.update(_run_mesh(model_id, min(prefill_len, 128), decode_tokens,
+                           progress_path))
+      if res.get("mesh_tokens_verified") is False:
+        res["implausible"] = True
+        res["diagnosis"] = "; ".join(filter(None, [
+          res.get("diagnosis"),
+          "tp-mesh vs single-device greedy token streams disagree"]))
+    except Exception as e:
+      res["mesh_error"] = repr(e)
   # Real-checkpoint stage: auto-runs whenever actual downloaded weights are
   # on disk (zero-egress containers without them skip silently).
   try:

@@ -115,6 +115,15 @@ STEPS: list[tuple[str, dict, str]] = [
   ("specpaged", {**SHORT, "BENCH_QUANT": "", "BENCH_SPEC": "1",
                  "BENCH_SPEC_PAGED": "1", "XOT_PAGED_KV": "1"},
    "specpaged_tok_s"),
+  # Mesh-sharded ring stage A/B (ISSUE 16 `mesh`): the same greedy request
+  # with the partition tp-sharded over the local chips (XOT_TP — weights
+  # per spec_for_param, KV on Hkv, paged kernels per-tp-shard) vs
+  # single-device. Streams byte-identical; mesh_speedup is judged against
+  # the per-device roofline minus the reported collective tax
+  # (mesh_collective_bytes), never naive bytes/tp.
+  ("mesh", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "0",
+            "XOT_PAGED_KV": "1", "BENCH_MESH": "1"},
+   "mesh_tok_s"),
   # 32k depth: twice the r3-comparable context, scan prefill + decode.
   ("long32k", {**LONG, "BENCH_LONG": "32768"}, "long_tok_s"),
 ]

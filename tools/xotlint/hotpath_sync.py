@@ -68,6 +68,13 @@ SANCTIONED = {
     "spec-next bookkeeping reads the already-fetched host array",
   ("JAXShardInferenceEngine._decode_batch_paged_sync", "np.asarray"):
     "sampling readback on the paged decode path",
+  # Page-table placement under a serving mesh: an ASYNC host→device copy
+  # of a few KB of metadata, explicitly replicated so paged executables
+  # see mesh-consistent input shardings. device_put returns immediately —
+  # it is the checker's conservative lumping with device_get that lands
+  # it here, not a real sync.
+  ("JAXShardInferenceEngine._device_table", "jax.device_put"):
+    "async replicated placement of the KB-scale page table on the mesh",
 }
 
 _DEVICE_CALL_HEADS = ("jnp", "jax")

@@ -55,6 +55,12 @@ class CostModel:
   weight format (None | "int8" | "int4"); `kv_quant` the KV-cache format
   (None | "int8"). Covers the text stack; vision towers and LoRA adapter
   leaves are O(rank·hidden) noise against the matmuls and are not counted.
+
+  `tp` is the serving mesh's tensor-parallel width (engine._serving_mesh):
+  the per-device byte methods divide exactly the axes
+  parallel/mesh.spec_for_param shards, so a mesh run's roofline is the
+  bytes ONE device must stream, not the fiction of the whole model on one
+  chip. tp=1 makes every per-device method equal its global twin.
   """
   cfg: ModelConfig
   n_layers: int
@@ -63,6 +69,7 @@ class CostModel:
   quantize: Optional[str] = None
   dtype_bytes: int = 2
   kv_quant: Optional[str] = None
+  tp: int = 1
 
   # ------------------------------------------------------------ weight bytes
 
@@ -161,6 +168,101 @@ class CostModel:
           total += n * self.dtype_bytes
     return total
 
+  # ------------------------------------------------------ mesh-aware (tp) math
+
+  # Megatron layout (parallel/mesh.spec_for_param): column-parallel slots
+  # shard their OUT axis over tp, row-parallel their contraction axis.
+  _TP_COL_SLOTS = ("wq", "wk", "wv", "w_gate", "w_up", "we_gate", "we_up")
+  _TP_ROW_SLOTS = ("wo", "w_down", "we_down")
+
+  def _tp_width(self) -> int:
+    return max(int(self.tp), 1)
+
+  def weight_bytes_per_device(self, fmt: Optional[str] = "__default__") -> int:
+    """Per-DEVICE resident weight bytes under the tp serving mesh — the
+    mesh-aware twin of weight_bytes, mirroring parallel/mesh.spec_for_param
+    placement for placement (ground-truth-tested against per-leaf
+    `sharding.shard_shape` sizes on a sharded pytree): column slots divide
+    their out axis, row slots their contraction axis, qkv biases follow
+    their out axis, int8 scales follow their base slot's OUT axis (so row
+    slots' scales replicate), the int4 grouped layout shards out on column
+    slots and the GROUP axis on row slots (replicating when groups don't
+    divide — the _int4_shape_guard fallback), and norms/router replicate.
+    The engine only builds a mesh whose tp divides every dense dimension
+    (engine._serving_mesh feasibility loop), so the dense divisions here
+    are exact, never floor."""
+    if fmt == "__default__":
+      fmt = self.quantize
+    tp = self._tp_width()
+    if tp == 1:
+      return self.weight_bytes(fmt)
+    cfg = self.cfg
+    L = self.n_layers
+    total = 0
+    for slot, shape in self._layer_slot_shapes().items():
+      d_in = shape[-2] if len(shape) >= 2 else 1
+      d_out = shape[-1]
+      if not (fmt in ("int8", "int4") and slot in LAYER_SLOTS):
+        n = L * math.prod(shape) * self.dtype_bytes
+        if slot in self._TP_COL_SLOTS + ("bq", "bk", "bv") or slot in self._TP_ROW_SLOTS:
+          n //= tp
+        total += n
+        continue
+      gs = _group_size(d_in)
+      if fmt == "int4" and slot in _INT4_LAYER_SLOTS and gs % 2 == 0:
+        groups = d_in // gs
+        payload = L * math.prod(shape) // 2
+        gscale = L * groups * d_out * self.dtype_bytes
+        if slot in self._TP_COL_SLOTS or groups % tp == 0:
+          payload //= tp
+          gscale //= tp
+        total += payload + gscale
+        continue
+      payload = L * math.prod(shape)
+      scale = L * math.prod(shape) // d_in * self.dtype_bytes
+      if slot in self._TP_COL_SLOTS:
+        payload //= tp
+        scale //= tp
+      elif slot in self._TP_ROW_SLOTS:
+        payload //= tp  # per-out scale stays replicated on row slots
+      total += payload + scale
+    if self.is_first or cfg.tie_word_embeddings:
+      # embedding [V, H] shards hidden; its per-row scale [V] replicates.
+      n = cfg.vocab_size * cfg.hidden_size // tp
+      if fmt in ("int8", "int4"):
+        total += n + cfg.vocab_size * self.dtype_bytes
+      else:
+        total += n * self.dtype_bytes
+    if self.is_last:
+      total += cfg.hidden_size * self.dtype_bytes  # final_norm replicated
+      if not cfg.tie_word_embeddings:
+        # lm_head [H, V] shards vocab; its scale [V] shards with it.
+        n = cfg.hidden_size * cfg.vocab_size // tp
+        if fmt in ("int8", "int4"):
+          total += n + cfg.vocab_size * self.dtype_bytes // tp
+        else:
+          total += n * self.dtype_bytes
+    return total
+
+  def _kv_tp(self) -> int:
+    """KV divisor: cache_spec shards Hkv over tp, so per-device KV bytes
+    divide by tp exactly when the head count does (always true on an
+    engine-built mesh — num_kv_heads is in the feasibility dims)."""
+    tp = self._tp_width()
+    return tp if self.cfg.num_kv_heads % tp == 0 else 1
+
+  def collective_bytes_per_token(self) -> int:
+    """Per-device ICI bytes ONE decoded token moves under tp: two
+    row-parallel psums per layer (the wo and w_down matmul outputs), each a
+    ring all-reduce shipping 2·(tp-1)/tp of the hidden activation per
+    device. 0 off-mesh — the term exists so mesh speedup claims subtract
+    the collective tax instead of pretending ICI is free."""
+    tp = self._tp_width()
+    if tp == 1:
+      return 0
+    per_psum = 2 * (tp - 1) * self.cfg.hidden_size * self.dtype_bytes // tp
+    return self.n_layers * 2 * per_psum
+
   # ---------------------------------------------------------------- KV bytes
 
   def _kv_token_bytes(self, per_position_scale: bool = True) -> int:
@@ -197,6 +299,15 @@ class CostModel:
 
   def kv_write_bytes_per_token(self) -> int:
     return self._kv_token_bytes()
+
+  def kv_read_bytes_per_token_per_device(self, depth: int,
+                                         alloc_tokens: Optional[int] = None,
+                                         paged: bool = False, page: int = 128) -> int:
+    """Per-device twin of kv_read_bytes_per_token: the cache/arena shards
+    its Hkv axis over tp (parallel/mesh.cache_spec), so one chip streams
+    1/tp of every position's K/V rows."""
+    return self.kv_read_bytes_per_token(
+      depth, alloc_tokens=alloc_tokens, paged=paged, page=page) // self._kv_tp()
 
   # ------------------------------------------------------------------- FLOPs
 
@@ -246,13 +357,17 @@ class CostModel:
     """(hbm_bytes, flops) one fused/batched decode dispatch must move: the
     weight stream repeats once per scan step (each of `tokens` steps reads
     every resident weight byte), each row adds its per-step KV read at its
-    own (depth, paged, alloc) and the per-step KV write."""
-    wb = self.weight_bytes()
+    own (depth, paged, alloc) and the per-step KV write. Under a tp mesh
+    both terms are PER-DEVICE (sharded weight/KV streams) and so are the
+    FLOPs — /v1/perf's HBM%/MFU gauges compare against ONE chip's peak."""
+    wb = self.weight_bytes_per_device()
     kv_read = sum(
       self.kv_read_bytes_per_token(depth, alloc_tokens=alloc, paged=paged, page=page)
-      for depth, paged, alloc in rows)
-    bytes_total = tokens * (wb + kv_read + len(rows) * self.kv_write_bytes_per_token())
-    flops = tokens * sum(self.decode_flops_per_token(depth) for depth, _, _ in rows)
+      for depth, paged, alloc in rows) // self._kv_tp()
+    kv_write = len(rows) * self.kv_write_bytes_per_token() // self._kv_tp()
+    bytes_total = tokens * (wb + kv_read + kv_write)
+    flops = tokens * sum(self.decode_flops_per_token(depth)
+                         for depth, _, _ in rows) // self._tp_width()
     return bytes_total, flops
 
   def prefill_dispatch_cost(self, tokens: int, chunk: int = 4096,
@@ -267,10 +382,10 @@ class CostModel:
     c = max(chunk, 1)
     n_seg = max(1, math.ceil(tokens / c))
     kv_read_tokens = sum(start + min(i * c, tokens) for i in range(n_seg))
-    bytes_total = (n_seg * self.weight_bytes()
-                   + kv_read_tokens * self._kv_token_bytes()
-                   + tokens * self.kv_write_bytes_per_token())
-    return bytes_total, self.prefill_flops(tokens, start)
+    bytes_total = (n_seg * self.weight_bytes_per_device()
+                   + (kv_read_tokens * self._kv_token_bytes()
+                      + tokens * self.kv_write_bytes_per_token()) // self._kv_tp())
+    return bytes_total, self.prefill_flops(tokens, start) // self._tp_width()
 
   def verify_dispatch_cost(self, tokens: int, depth: int, paged: bool = False,
                            alloc_tokens: Optional[int] = None,
@@ -286,22 +401,33 @@ class CostModel:
     speculation multiplies accepted tok/s past the plain-decode roofline."""
     kv_read = self.kv_read_bytes_per_token(
       depth + tokens, alloc_tokens=alloc_tokens, paged=paged, page=page)
-    bytes_total = (self.weight_bytes() + kv_read
-                   + tokens * self.kv_write_bytes_per_token())
-    return bytes_total, self.prefill_flops(tokens, depth)
+    bytes_total = (self.weight_bytes_per_device()
+                   + (kv_read + tokens * self.kv_write_bytes_per_token())
+                   // self._kv_tp())
+    return bytes_total, self.prefill_flops(tokens, depth) // self._tp_width()
 
   # ---------------------------------------------------------------- ceilings
 
   def ceilings(self, hbm_gbps: Optional[float]) -> Dict[str, Any]:
     """Batch-1 decode tok/s ceilings (peak HBM bandwidth ÷ resident weight
     bytes) for each weight format this model could serve in — the PERF.md
-    roofline table, computed instead of hand-derived."""
-    out: Dict[str, Any] = {"hbm_gbps": hbm_gbps}
+    roofline table, computed instead of hand-derived. On a tp mesh the
+    tok/s ceiling uses the PER-DEVICE weight stream (the bytes one chip
+    actually moves per step) and the per-device bytes appear alongside the
+    global ones; the collective term is reported so the ceiling can be
+    read as bandwidth-bound-minus-ICI-tax, not naive bytes/tp."""
+    tp = self._tp_width()
+    out: Dict[str, Any] = {"hbm_gbps": hbm_gbps, "tp": tp}
     for label, fmt in (("bf16", None), ("int8", "int8"), ("int4", "int4")):
       wb = self.weight_bytes(fmt)
+      wbd = self.weight_bytes_per_device(fmt)
       out[f"{label}_weight_bytes"] = wb
-      out[f"{label}_tok_s"] = (round(hbm_gbps * 1e9 / wb, 1)
-                               if hbm_gbps and wb else None)
+      if tp > 1:
+        out[f"{label}_weight_bytes_per_device"] = wbd
+      out[f"{label}_tok_s"] = (round(hbm_gbps * 1e9 / wbd, 1)
+                               if hbm_gbps and wbd else None)
+    if tp > 1:
+      out["collective_bytes_per_token"] = self.collective_bytes_per_token()
     return out
 
 

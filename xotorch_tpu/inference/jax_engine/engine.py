@@ -569,14 +569,16 @@ class JAXShardInferenceEngine(InferenceEngine):
     fast path): a peer that owns several local chips serves its layer-range
     shard SPMD over a local mesh instead of leaving all but one chip idle.
 
-    Axes: 'tp' (Megatron tensor parallel — XOT_SERVE_TP: 0 = off, N = force,
-    unset = all local devices on real TPU) and optionally 'sp'
-    (XOT_SERVE_SP=N): sequence-parallel PREFILL, where a long prompt's
-    positions shard over sp chips and attention runs as ring attention over
-    ICI (ops/ring_attention) — the serving-side twin of the training sp
-    axis. Requested sizes reduce to the largest feasible divisors so
-    placements stay even."""
-    env = knobs.raw("XOT_SERVE_TP")
+    Axes: 'tp' (Megatron tensor parallel — XOT_TP, falling back to
+    XOT_SERVE_TP: 0 = off, N = force, unset = all local devices on real
+    TPU) and optionally 'sp' (XOT_SERVE_SP=N): sequence-parallel PREFILL,
+    where a long prompt's positions shard over sp chips and attention runs
+    as ring attention over ICI (ops/ring_attention) — the serving-side twin
+    of the training sp axis. Requested sizes reduce to the largest feasible
+    divisors so placements stay even."""
+    env = knobs.raw("XOT_TP")
+    if env is None:
+      env = knobs.raw("XOT_SERVE_TP")
     sp_env = knobs.get_int("XOT_SERVE_SP")
     # 'ep' (XOT_SERVE_EP=N, MoE models only): expert tensors distribute over
     # N local chips' HBM (parallel/mesh.spec_for_param 'we_*' rules) — each
@@ -856,6 +858,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     ctx = self._active
     if ctx is not None and ctx.costmodel is not None:
       from xotorch_tpu.models.quantize import quantized_bytes
+      from xotorch_tpu.parallel.mesh import device_bytes
       cm = ctx.costmodel
       report["model"] = {
         "model_id": ctx.shard.model_id,
@@ -863,14 +866,23 @@ class JAXShardInferenceEngine(InferenceEngine):
         "dtype": self._dtype_name,
         "quantize": self._quantize,
         "kv_quant": self._kv_quant,
+        "tp": cm.tp,
         "n_params": cm.n_params(),
         "weight_bytes_predicted": cm.weight_bytes(),
         # Metadata-only walk over the resident pytree (size × itemsize) —
         # the live cross-check that the analytic layout math is honest.
         "weight_bytes_actual": quantized_bytes(ctx.params),
+        # Mesh twin of the same cross-check: per-device predicted vs the
+        # pytree's actual per-leaf shard sizes (sharding.shard_shape).
+        "weight_bytes_per_device_predicted": cm.weight_bytes_per_device(),
+        "weight_bytes_per_device_actual": device_bytes(ctx.params),
         "kv_write_bytes_per_token": cm.kv_write_bytes_per_token(),
         "kv_read_bytes_per_token_at_cache_len": cm.kv_read_bytes_per_token(
           ctx.cache_len, alloc_tokens=ctx.cache_len),
+        "kv_read_bytes_per_token_at_cache_len_per_device":
+          cm.kv_read_bytes_per_token_per_device(
+            ctx.cache_len, alloc_tokens=ctx.cache_len),
+        "collective_bytes_per_token": cm.collective_bytes_per_token(),
       }
       report["ceilings"] = cm.ceilings(peak_gbps)
     return report
@@ -1185,7 +1197,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       h, state.cache = prefill_scan(
         ctx.params, x[:, off * chunk:(off + g) * chunk], state.cache, jnp.int32(state.pos),
         ctx.cfg, g, is_first=(x.ndim == 2), start_layer=ctx.shard.start_layer,
-        moe_routed=self._moe_routed_for(ctx))
+        moe_routed=self._moe_routed_for(ctx), tp_mesh=self._tp_mesh(ctx))
       if want_hidden:
         outs.append(h)
       state.pos += g * chunk
@@ -1594,6 +1606,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
       min_p=e.get("min_p"),
       top_lp=-1 if want_lp is None else int(want_lp),
+      tp_mesh=self._tp_mesh(ctx),
     )
     if want_lp is not None:
       tok, lp, top_ids, top_lps = out
@@ -1747,13 +1760,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     pool = ctx.page_pool
     x = np.zeros((1, bucket), dtype=np.int64)
     x[0, :T] = [prev_token] + draft
-    table = self._paged_table_for(state)
+    table = self._paged_table_for(ctx, state)
     t0 = time.monotonic()
     preds_dev, pool.arena = forward_argmax_paged(
       ctx.params, jnp.asarray(x, jnp.int32), pool.arena, table,
       jnp.int32(pos_before), ctx.cfg, use_kernel=self._paged_kernel_on(),
       moe_routed=self._moe_routed_for(ctx), ragged=self._ragged_prefill_on(),
-      start_layer=ctx.shard.start_layer)
+      start_layer=ctx.shard.start_layer, tp_mesh=self._tp_mesh(ctx))
     preds = np.asarray(preds_dev[0, :T]).astype(np.int64)
     secs = time.monotonic() - t0
     n_acc = 0
@@ -1876,7 +1889,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       toks, state.cache = decode_chunk(
         ctx.params, jnp.asarray([[suffix[-1]]], jnp.int32), state.cache, jnp.int32(pos),
         jax.random.PRNGKey(0), ctx.cfg, k, 0.0, 0,
-        use_flash_decode=use_fd, moe_routed=self._moe_routed_for(ctx))
+        use_flash_decode=use_fd, moe_routed=self._moe_routed_for(ctx),
+        tp_mesh=self._tp_mesh(ctx))
     except CacheExhausted:
       return []
     draft = [int(t) for t in np.asarray(toks)[0]]
@@ -2977,6 +2991,7 @@ class JAXShardInferenceEngine(InferenceEngine):
           presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
           min_p=e.get("min_p"),
           top_lp=-1 if want_lp is None else int(want_lp),
+          tp_mesh=self._tp_mesh(ctx),
         )
         out = list(out)
         if want_lp is not None:
@@ -3017,7 +3032,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         ntoks, state.cache = decode_chunk(
           ctx.params, toks[:, -1:].astype(jnp.int32), state.cache, jnp.int32(pos_before),
           key2, ctx.cfg, int(next_size), temp, top_k, top_p, use_flash_decode=use_fd2,
-          moe_routed=self._moe_routed_for(ctx),
+          moe_routed=self._moe_routed_for(ctx), tp_mesh=self._tp_mesh(ctx),
         )
         state.pos += int(next_size)
         spec_rec = {"toks": ntoks, "n": int(next_size), "pos": pos_before,
@@ -3061,6 +3076,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         ctx.params, tuple(s.cache for s in states), row_tokens_dev, pos_vec, key,
         ctx.cfg, n_toks, temp_vec, top_k, top_p, use_flash_decode=use_fd,
         pad_rows=B_pad - B, moe_routed=self._moe_routed_for(ctx),
+        tp_mesh=self._tp_mesh(ctx),
       )
       for state, c in zip(states, new_caches):
         state.cache = c
@@ -3347,15 +3363,40 @@ class JAXShardInferenceEngine(InferenceEngine):
       state.pages.extend(self._pool_alloc(ctx, pool, need_pages - len(state.pages)))
     return state
 
-  def _paged_table_for(self, state: _RequestState):
+  @staticmethod
+  def _tp_mesh(ctx: _ShardContext):
+    """ctx's serving mesh when it carries a REAL tp axis, else None — the
+    static `tp_mesh` kwarg every fused executable takes (Mesh is hashable,
+    so jit treats it like the other static flags). One helper so each
+    dispatch path names the mesh the same way the _load partials did."""
+    mesh = ctx.mesh
+    if mesh is not None and "tp" in mesh.axis_names and int(mesh.shape["tp"]) > 1:
+      return mesh
+    return None
+
+  def _device_table(self, ctx: _ShardContext, table: np.ndarray):
+    """Place a host-built page table on the device(s). Under a serving
+    mesh the table is committed REPLICATED explicitly: every paged
+    executable then sees mesh-consistent input shardings (arena Hkv-
+    sharded per cache_spec, table/positions replicated) instead of leaving
+    GSPMD to re-infer a layout per executable — page ids index the arena's
+    unsharded page axis, so every tp shard needs the whole table. The put
+    is an async host→device copy of a few KB of metadata, not a sync."""
+    import jax.numpy as jnp
+    if ctx.mesh is None:
+      return jnp.asarray(table)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.device_put(table, NamedSharding(ctx.mesh, PartitionSpec()))
+
+  def _paged_table_for(self, ctx: _ShardContext, state: _RequestState):
     """The request's [1, maxp] device page table, width bucketed to a power
     of two (0-padded — the scratch page, masked) so the prefill executables
     stay logarithmic in context length."""
-    import jax.numpy as jnp
     maxp = _bucket(max(len(state.pages), 1), 1)
     table = np.zeros((1, maxp), np.int32)
     table[0, :len(state.pages)] = state.pages
-    return jnp.asarray(table)
+    return self._device_table(ctx, table)
 
   def _paged_fill_sync(self, ctx: _ShardContext, request_id: str, input_data) -> None:
     """Fill-only paged-native prefill of `input_data` (length a multiple of
@@ -3370,7 +3411,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     state = self._prep_state_paged(ctx, request_id, total)
     pool = ctx.page_pool
     x = self._to_device_input(input_data)
-    table = self._paged_table_for(state)
+    table = self._paged_table_for(ctx, state)
     use_kernel = self._paged_kernel_on()
     for off, g in scan_groups(total // chunk):
       _, pool.arena = prefill_scan(
@@ -3378,7 +3419,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         ctx.cfg, g, is_first=True, start_layer=ctx.shard.start_layer,
         moe_routed=self._moe_routed_for(ctx),
         page_table=table, paged_kernel=use_kernel,
-        ragged_prefill=self._ragged_prefill_on())
+        ragged_prefill=self._ragged_prefill_on(), tp_mesh=self._tp_mesh(ctx))
       state.pos += g * chunk
     state.last_used = time.monotonic()
 
@@ -3398,7 +3439,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     x = self._to_device_input(input_data)
     if bucket != true_t:
       x = jnp.pad(x, [(0, 0), (0, bucket - true_t)])
-    table = self._paged_table_for(state)
+    table = self._paged_table_for(ctx, state)
     key = self._extras_key(state, None, request_id=request_id,
                            sample_pos=state.pos + true_t - 1)
     tok, pool.arena = forward_sample(
@@ -3406,7 +3447,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       ctx.cfg, True, temp, top_k, top_p,
       start_layer=ctx.shard.start_layer, moe_routed=self._moe_routed_for(ctx),
       page_table=table, paged_kernel=self._paged_kernel_on(),
-      ragged_prefill=self._ragged_prefill_on())
+      ragged_prefill=self._ragged_prefill_on(), tp_mesh=self._tp_mesh(ctx))
     state.pos += true_t
     # Trim the padded bucket's overshoot: pages past pages_for(pos) hold
     # only padding garbage and are exclusively ours (fresh-allocated; the
@@ -3516,9 +3557,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._sample_calls += 1
     key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
     out, pool.arena = decode_chunk_paged(
-      ctx.params, pool.arena, jnp.asarray(table), toks, pos_vec, key, ctx.cfg,
+      ctx.params, pool.arena, self._device_table(ctx, table), toks, pos_vec, key, ctx.cfg,
       num_tokens, temps, top_k, top_p, use_kernel=self._paged_kernel_on(),
-      pad_rows=B_pad - B, moe_routed=self._moe_routed_for(ctx))
+      pad_rows=B_pad - B, moe_routed=self._moe_routed_for(ctx),
+      tp_mesh=self._tp_mesh(ctx))
     out_np = np.asarray(out)
     now = time.monotonic()
     for state in states:
@@ -3774,9 +3816,15 @@ class JAXShardInferenceEngine(InferenceEngine):
         if DEBUG >= 1:
           print(f"LoRA adapters attached: rank={lora_rank}, targets={targets}")
 
+      # The serving mesh rides into every executable as a STATIC kwarg (Mesh
+      # is hashable — same pattern as the ring_mesh closure below): the
+      # forward pins tp activation layouts (transformer._tp_constraint) and
+      # the paged kernels run per-tp-shard (ops/paged_attention).
+      tp_mesh = (mesh if mesh is not None and "tp" in mesh.axis_names
+                 and mesh.shape["tp"] > 1 else None)
       fwd = partial(
         forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=shard.is_last_layer,
-        start_layer=shard.start_layer,
+        start_layer=shard.start_layer, tp_mesh=tp_mesh,
       )
       forward_jit = jax.jit(fwd, donate_argnums=(2,))
       forward_flash_jit = jax.jit(partial(fwd, use_flash=True), donate_argnums=(2,))
@@ -3790,7 +3838,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       fill_jits = None
       if shard.is_last_layer:
         fill_fwd = partial(forward_shard, cfg=cfg, is_first=shard.is_first_layer, is_last=False,
-                           start_layer=shard.start_layer)
+                           start_layer=shard.start_layer, tp_mesh=tp_mesh)
         fill_jits = {
           "base": jax.jit(fill_fwd, donate_argnums=(2,)),
           "flash": jax.jit(partial(fill_fwd, use_flash=True), donate_argnums=(2,)),
@@ -3817,7 +3865,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       vision = None
       if cfg.is_multimodal and shard.is_first_layer:
         hidden_fwd = partial(forward_shard, cfg=cfg, is_first=False, is_last=shard.is_last_layer,
-                             start_layer=shard.start_layer)
+                             start_layer=shard.start_layer, tp_mesh=tp_mesh)
         forward_hidden_jit = jax.jit(hidden_fwd, donate_argnums=(2,))
         # Image prompts are the longest fresh-context prefills (576 patches
         # per image on llava-1.5) — they deserve the Pallas flash path too.
@@ -3849,6 +3897,10 @@ class JAXShardInferenceEngine(InferenceEngine):
       is_first=shard.is_first_layer, is_last=shard.is_last_layer,
       quantize=self._quantize, dtype_bytes=dtype_width(self._dtype_name),
       kv_quant=self._kv_quant,
+      # Mesh-aware roofline: per-device byte/FLOP math divides by the tp
+      # width the params/caches were actually placed with.
+      tp=(int(mesh.shape["tp"])
+          if mesh is not None and "tp" in mesh.axis_names else 1),
     )
     if DEBUG >= 1:
       print(f"JAX engine ready for {shard} (dtype={self._dtype_name}, cache_len={cache_len})")

@@ -27,7 +27,8 @@ from xotorch_tpu.ops.sampling import sample_logits, sample_logits_logprobs
 @partial(
   jax.jit,
   static_argnames=("cfg", "is_first", "top_k", "top_p", "use_flash", "use_flash_decode",
-                   "start_layer", "top_lp", "moe_routed", "paged_kernel", "ragged_prefill"),
+                   "start_layer", "top_lp", "moe_routed", "paged_kernel", "ragged_prefill",
+                   "tp_mesh"),
   donate_argnames=("cache",),
 )
 def forward_sample(
@@ -55,6 +56,7 @@ def forward_sample(
   page_table: jnp.ndarray = None,  # [1, max_pages]: paged-NATIVE prefill — `cache` is the arena
   paged_kernel: bool = False,
   ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Last-shard forward + ON-DEVICE sampling in one dispatch: returns
   ([B] int32 sampled token, updated cache) — with `top_lp >= 0`, instead
@@ -75,7 +77,7 @@ def forward_sample(
                            is_last=False, use_flash=use_flash, use_flash_decode=use_flash_decode,
                            start_layer=start_layer, moe_routed=moe_routed,
                            page_table=page_table, paged_kernel=paged_kernel,
-                           ragged_prefill=ragged_prefill)
+                           ragged_prefill=ragged_prefill, tp_mesh=tp_mesh)
   h_last = jax.lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, H]
   logits = unembed(params, h_last, cfg)
   if top_lp >= 0:
@@ -92,7 +94,7 @@ def forward_sample(
 @partial(
   jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "top_lp",
-                   "moe_routed"),
+                   "moe_routed", "tp_mesh"),
   donate_argnames=("cache",),
 )
 def decode_chunk(
@@ -114,6 +116,7 @@ def decode_chunk(
   top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
   moe_routed: bool = True,  # static: False when experts shard over 'ep'
   min_p=None,  # min-p cutoff (traced; None = off) — ops/sampling
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Generate `num_tokens` tokens in one device program.
 
@@ -135,7 +138,8 @@ def decode_chunk(
   def step(carry, _):
     tok, cache, pos, key, counts = carry
     logits, cache = forward_shard(params, tok, cache, pos, cfg=cfg, is_first=True, is_last=True,
-                                  use_flash_decode=use_flash_decode, moe_routed=moe_routed)
+                                  use_flash_decode=use_flash_decode, moe_routed=moe_routed,
+                                  tp_mesh=tp_mesh)
     key, sub = jax.random.split(key)
     # counts=None (not the 0-d carry placeholder) when penalties are off:
     # the None/array split is what keeps the [B, V] penalty subtractions out
@@ -190,7 +194,7 @@ def scan_groups(n_segs: int):
 @partial(
   jax.jit,
   static_argnames=("cfg", "n_segs", "is_first", "start_layer", "moe_routed", "paged_kernel",
-                   "ragged_prefill"),
+                   "ragged_prefill", "tp_mesh"),
   donate_argnames=("cache",),
 )
 def prefill_scan(
@@ -206,6 +210,7 @@ def prefill_scan(
   page_table: jnp.ndarray = None,  # [1, max_pages]: paged-NATIVE prefill — `cache` is the arena
   paged_kernel: bool = False,
   ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Chunked long-prompt prefill as ONE device program: `lax.scan` over the
   prompt's fixed-size segments, each step = forward_shard over the
@@ -246,7 +251,7 @@ def prefill_scan(
                              is_last=False, use_flash_decode=True,
                              start_layer=start_layer, moe_routed=moe_routed,
                              page_table=page_table, paged_kernel=paged_kernel,
-                             ragged_prefill=ragged_prefill)
+                             ragged_prefill=ragged_prefill, tp_mesh=tp_mesh)
     return (cache, pos + seg), h
 
   (cache, _), hs = jax.lax.scan(step, (cache, start_pos.astype(jnp.int32)), xs)
@@ -347,7 +352,7 @@ def forward_argmax_ring(
 
 @partial(
   jax.jit,
-  static_argnames=("cfg", "use_kernel", "moe_routed", "ragged", "start_layer"),
+  static_argnames=("cfg", "use_kernel", "moe_routed", "ragged", "start_layer", "tp_mesh"),
   donate_argnames=("arena",),
 )
 def forward_argmax_paged(
@@ -361,6 +366,7 @@ def forward_argmax_paged(
   moe_routed: bool = True,
   ragged: bool = True,  # static: kernel path reads pages natively (no gather)
   start_layer: int = 0,
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Draft verification over the PAGED arena: one forward of
   [prev_token] + draft as a T>1 ragged query through the request's existing
@@ -377,7 +383,7 @@ def forward_argmax_paged(
                            is_last=False, moe_routed=moe_routed,
                            start_layer=start_layer,
                            page_table=page_table, paged_kernel=use_kernel,
-                           ragged_prefill=ragged)
+                           ragged_prefill=ragged, tp_mesh=tp_mesh)
   logits = unembed(params, h, cfg)
   return jnp.argmax(logits, axis=-1).astype(jnp.int32), arena
 
@@ -451,7 +457,7 @@ def decode_chunk_ring_batched(
 @partial(
   jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_kernel", "pad_rows",
-                   "moe_routed"),
+                   "moe_routed", "tp_mesh"),
   donate_argnames=("arena",),
 )
 def decode_chunk_paged(
@@ -469,6 +475,7 @@ def decode_chunk_paged(
   use_kernel: bool = False,  # static: Pallas ragged kernel vs XLA gather
   pad_rows: int = 0,  # static: dummy rows padding B to a power of two
   moe_routed: bool = True,
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Batched fused decode over the PAGED KV pool, ONE executable end to end.
 
@@ -497,7 +504,8 @@ def decode_chunk_paged(
     tok, arena, pos, key = carry
     logits, arena = forward_shard(params, tok, arena, pos, cfg=cfg, is_first=True,
                                   is_last=True, moe_routed=moe_routed,
-                                  page_table=page_table, paged_kernel=use_kernel)
+                                  page_table=page_table, paged_kernel=use_kernel,
+                                  tp_mesh=tp_mesh)
     key, sub = jax.random.split(key)
     nxt = sample_logits(logits[:, -1, :], sub, temp=temps, top_k=top_k, top_p=top_p)
     return (nxt[:, None], arena, pos + 1, key), nxt
@@ -510,7 +518,7 @@ def decode_chunk_paged(
 @partial(
   jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_flash_decode", "pad_rows",
-                   "moe_routed"),
+                   "moe_routed", "tp_mesh"),
   donate_argnames=("caches",),
 )
 def decode_chunk_batched(
@@ -527,6 +535,7 @@ def decode_chunk_batched(
   use_flash_decode: bool = False,
   pad_rows: int = 0,  # static: dummy rows padding B to a power of two
   moe_routed: bool = True,  # static: False when experts shard over 'ep'
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Batched fused decode for continuous batching, ONE executable end to
   end: stack the requests' caches along the batch axis, run the decode
@@ -555,7 +564,7 @@ def decode_chunk_batched(
     temps = jnp.concatenate([temps, jnp.broadcast_to(temps[:1], (pad_rows,))])
   out, cache_b = decode_chunk(
     params, toks, cache_b, pos_vec, key, cfg, num_tokens, temps, top_k, top_p,
-    use_flash_decode=use_flash_decode, moe_routed=moe_routed,
+    use_flash_decode=use_flash_decode, moe_routed=moe_routed, tp_mesh=tp_mesh,
   )
   split = tuple({name: cache_b[name][:, i:i + 1] for name in cache_b} for i in range(B))
   return out[:B], split

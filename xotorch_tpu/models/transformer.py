@@ -105,6 +105,26 @@ def _linear(layer: Params, slot: str, h: jnp.ndarray) -> jnp.ndarray:
   return (h @ w.astype(h.dtype)) * scale.astype(h.dtype)
 
 
+def _tp_constraint(x: jnp.ndarray, tp_mesh, axis: int) -> jnp.ndarray:
+  """Pin a tensor-parallel layout on an activation: `axis` sharded over the
+  mesh's 'tp' axis, everything else replicated. Placed at the Megatron
+  column→row boundaries (q/k/v heads after the projections, ffn columns
+  after gate/up) so GSPMD's propagation keeps partial activations + ONE
+  psum per block instead of resolving an unconstrained fixpoint to
+  all-gather-the-columns-then-compute-replicated. Static no-op off-mesh or
+  when the axis doesn't divide (degenerate tiny-model heads)."""
+  if tp_mesh is None or "tp" not in tp_mesh.axis_names:
+    return x
+  tp = int(tp_mesh.shape["tp"])
+  if tp <= 1 or x.shape[axis] % tp != 0:
+    return x
+  from jax.sharding import NamedSharding, PartitionSpec
+  spec = [None] * x.ndim
+  spec[axis % x.ndim] = "tp"
+  return jax.lax.with_sharding_constraint(
+    x, NamedSharding(tp_mesh, PartitionSpec(*spec)))
+
+
 def _moe_einsum(layer: Params, slot: str, eq: str, h: jnp.ndarray) -> jnp.ndarray:
   """Expert einsum with the same static int8 dispatch; per-(expert, out)
   scales broadcast over the leading E axis of the 'e...' output."""
@@ -202,6 +222,7 @@ def _attention_block(
   page_table: Optional[jnp.ndarray] = None,  # [B, max_pages]: paged-KV decode
   paged_kernel: bool = False,
   ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
+  tp_mesh=None,  # static Mesh: activation constraints for tensor parallelism
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   B, T, H = x.shape
   h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -212,9 +233,9 @@ def _attention_block(
     q = q + layer["bq"]
     k = k + layer["bk"]
     v = v + layer["bv"]
-  q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
-  k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-  v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+  q = _tp_constraint(q.reshape(B, T, cfg.num_heads, cfg.head_dim), tp_mesh, 2)
+  k = _tp_constraint(k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim), tp_mesh, 2)
+  v = _tp_constraint(v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim), tp_mesh, 2)
   if cfg.qk_norm:
     q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -251,7 +272,7 @@ def _attention_block(
       attn = paged_decode_attention(
         q, layer_cache["k"], layer_cache["v"], page_table, kv_valid_len,
         softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
-        use_kernel=paged_kernel)
+        use_kernel=paged_kernel, tp_mesh=tp_mesh)
     else:
       # Paged-native T>1 segment (prefill slice or draft-verify forward):
       # every position scatters into its own (page, slot). B == 1 by
@@ -271,8 +292,9 @@ def _attention_block(
       attn = paged_prefill_attention(
         q, layer_cache["k"], layer_cache["v"], page_table, positions, kv_valid_len,
         softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
-        use_kernel=paged_kernel, ragged=ragged_prefill)
-    attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+        use_kernel=paged_kernel, ragged=ragged_prefill, tp_mesh=tp_mesh)
+    attn2d = _tp_constraint(
+      attn.reshape(B, T, cfg.num_heads * cfg.head_dim), tp_mesh, 2)
     out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
     if cfg.sandwich_norms:
       out = rms_norm(out, layer["post_attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -332,16 +354,20 @@ def _attention_block(
     k_all, v_all = _cache_read(layer_cache, q.dtype)
     attn = gqa_attention(q, k_all, v_all, positions, kv_valid_len,
                          scale=attn_scale, softcap=cfg.attn_logit_softcap, window=window)
-  attn2d = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+  attn2d = _tp_constraint(
+    attn.reshape(B, T, cfg.num_heads * cfg.head_dim), tp_mesh, 2)
   out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
   if cfg.sandwich_norms:
     out = rms_norm(out, layer["post_attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
   return out, layer_cache
 
 
-def _dense_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
-  gate = _mlp_act(cfg, _maybe_lora(layer, "w_gate", h, _linear(layer, "w_gate", h)))
-  up = gate * _maybe_lora(layer, "w_up", h, _linear(layer, "w_up", h))
+def _dense_mlp(layer: Params, h: jnp.ndarray, cfg: ModelConfig,
+               tp_mesh=None) -> jnp.ndarray:
+  gate = _mlp_act(cfg, _tp_constraint(
+    _maybe_lora(layer, "w_gate", h, _linear(layer, "w_gate", h)), tp_mesh, -1))
+  up = gate * _tp_constraint(
+    _maybe_lora(layer, "w_up", h, _linear(layer, "w_up", h)), tp_mesh, -1)
   return _maybe_lora(layer, "w_down", up, _linear(layer, "w_down", up))
 
 
@@ -428,6 +454,7 @@ def forward_shard(
   page_table: Optional[jnp.ndarray] = None,  # [B, max_pages]: paged-KV decode
   paged_kernel: bool = False,
   ragged_prefill: bool = True,  # static: kernel prefill reads pages natively
+  tp_mesh=None,  # static Mesh: activation constraints for tensor parallelism
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
   """Run one shard. Returns (hidden or fp32 logits, updated cache).
 
@@ -443,6 +470,14 @@ def forward_shard(
   the engine passes False when expert weights are sharded over an 'ep' mesh
   axis (see _moe_mlp).
 
+  tp_mesh (static, hashable — same pattern as ring_mesh): the serving mesh
+  when this executable runs SPMD over a 'tp' axis. Activations get explicit
+  with_sharding_constraint pins at the Megatron column→row boundaries
+  (_tp_constraint) so GSPMD keeps heads/ffn columns sharded instead of
+  all-gathering; the paged Pallas kernels run per-tp-shard via shard_map
+  over the head-sliced arena (ops/paged_attention). Ignored on ring
+  (sequence-parallel) executables, whose activations shard over 'sp'.
+
   cfg/is_first/is_last/use_flash/use_flash_decode must be static under jit;
   start_pos is traced so one executable serves every decode step. use_flash
   selects the Pallas prefill kernel (ops/flash_attention.py) and is only
@@ -456,6 +491,10 @@ def forward_shard(
   property of the absolute layer index (gemma2 alternates), so a mid-ring
   shard must know where it sits.
   """
+  if ring_mesh is not None:
+    # Ring (sequence-parallel) executables shard activations over 'sp' along
+    # T; pinning a tp-only layout on them would force an sp all-gather.
+    tp_mesh = None
   if is_first:
     emb = params["embed"]["embedding"]
     row_scale = params["embed"].get("embedding_scale")
@@ -507,11 +546,12 @@ def forward_shard(
       layer, h, layer_cache, positions, kv_valid_len, start_pos, cfg, inv_freq, use_flash,
       ring_mesh, use_flash_decode, window=window,
       page_table=page_table, paged_kernel=paged_kernel, ragged_prefill=ragged_prefill,
+      tp_mesh=tp_mesh,
     )
     h = h + attn_out
     mlp_in = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     mlp_out = (_moe_mlp(layer, mlp_in, cfg, moe_routed=moe_routed) if cfg.is_moe
-               else _dense_mlp(layer, mlp_in, cfg))
+               else _dense_mlp(layer, mlp_in, cfg, tp_mesh=tp_mesh))
     if cfg.sandwich_norms:
       mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     return h + mlp_out, layer_cache

@@ -59,6 +59,37 @@ from xotorch_tpu.ops.flash_attention import _mxu_operand, _softcap
 NEG_INF = -1e30
 
 
+def _tp_shards(tp_mesh, hq: int, hkv: int) -> int:
+  """tp width a paged kernel call can split over: >1 only when the mesh has
+  a 'tp' axis that divides BOTH head counts (GQA group size is then
+  preserved per shard). 1 means run the kernel unsharded."""
+  if tp_mesh is None or "tp" not in tp_mesh.axis_names:
+    return 1
+  tp = int(tp_mesh.shape["tp"])
+  return tp if tp > 1 and hq % tp == 0 and hkv % tp == 0 else 1
+
+
+def _tp_sharded_call(kernel, tp_mesh, q, k_pages, v_pages, page_table, rows):
+  """Invoke a paged Pallas kernel PER TP SHARD via shard_map: q and the page
+  arena are sliced on their head axes ([B,T,Hq,D] / [P,page,Hkv,D], heads at
+  index 2 — matching parallel.mesh.cache_spec), the table and row metadata
+  replicated. Each shard's kernel sees Hq/tp query heads over Hkv/tp arena
+  heads — same GQA group size, same grid shape, no cross-shard traffic (the
+  softmax is per head). This is how the kernels keep running under a tp
+  serving mesh: GSPMD has no partitioning rule for the custom call, so an
+  unwrapped kernel would make XLA all-gather the whole arena per step."""
+  from jax.sharding import PartitionSpec as P
+
+  from xotorch_tpu.parallel.mesh import shard_map
+  heads = P(None, None, "tp", None)
+  per_shard = shard_map(
+    kernel, mesh=tp_mesh,
+    in_specs=(heads, heads, heads, P(None, None), P(None)),
+    out_specs=heads, check_rep=False,
+  )
+  return per_shard(q, k_pages, v_pages, page_table, rows)
+
+
 def _logical_page_index(j, length, page_size: int):
   """Logical kv-page index a grid step `j` should read for a row holding
   `length` tokens: j itself while occupied, else saturating at the row's
@@ -305,6 +336,7 @@ def paged_prefill_attention(
   use_kernel: bool = False,
   ragged: bool = True,  # static: kernel path reads pages NATIVELY (no gather)
   interpret: bool | None = None,
+  tp_mesh=None,  # static Mesh: kernel runs per-tp-shard over sliced heads
 ) -> jnp.ndarray:
   """Causal GQA attention of a T>1 ragged segment over its row's occupied
   pages: chunked-prefill slices and draft-verify forwards share this op.
@@ -325,9 +357,12 @@ def paged_prefill_attention(
   if use_kernel and ragged:
     D = q.shape[-1]
     k_scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
-    return _ragged_attention_kernel(q, k_pages, v_pages, page_table,
-                                    kv_valid_len, k_scale, float(softcap),
-                                    interpret)
+    kernel = functools.partial(_ragged_attention_kernel, scale=k_scale,
+                               softcap=float(softcap), interpret=interpret)
+    if _tp_shards(tp_mesh, q.shape[2], k_pages.shape[2]) > 1:
+      return _tp_sharded_call(kernel, tp_mesh, q, k_pages, v_pages,
+                              page_table, kv_valid_len)
+    return kernel(q, k_pages, v_pages, page_table, kv_valid_len)
   from xotorch_tpu.ops.attention import gqa_attention
   B = q.shape[0]
   maxp, page = page_table.shape[1], k_pages.shape[1]
@@ -355,6 +390,7 @@ def paged_decode_attention(
   scale: float | None = None,  # static score scale; None = D**-0.5
   use_kernel: bool = False,
   interpret: bool | None = None,
+  tp_mesh=None,  # static Mesh: kernel runs per-tp-shard over sliced heads
 ) -> jnp.ndarray:
   """Causal GQA decode attention over each row's occupied pages.
 
@@ -366,7 +402,11 @@ def paged_decode_attention(
   D = q.shape[-1]
   scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
   if use_kernel:
-    return _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
-                                   scale, float(softcap), interpret)
+    kernel = functools.partial(_paged_attention_kernel, scale=scale,
+                               softcap=float(softcap), interpret=interpret)
+    if _tp_shards(tp_mesh, q.shape[2], k_pages.shape[2]) > 1:
+      return _tp_sharded_call(kernel, tp_mesh, q, k_pages, v_pages,
+                              page_table, lengths)
+    return kernel(q, k_pages, v_pages, page_table, lengths)
   return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
                               scale, float(softcap))

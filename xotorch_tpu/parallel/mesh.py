@@ -30,6 +30,30 @@ def _int4_dense_slots():
   return _INT4_LAYER_SLOTS
 
 
+def shard_map(f, mesh, in_specs, out_specs, **kwargs):
+  """Version-portable shard_map: `jax.shard_map` when the alias exists
+  (newer JAX), else `jax.experimental.shard_map.shard_map`. The
+  replication-check kwarg was renamed across versions (`check_rep` →
+  `check_vma`); either spelling is accepted here and forwarded under
+  whichever name the resolved implementation takes (dropped if neither)."""
+  import inspect
+
+  import jax
+
+  impl = getattr(jax, "shard_map", None)
+  if impl is None:
+    from jax.experimental.shard_map import shard_map as impl
+  accepted = inspect.signature(impl).parameters
+  check = kwargs.pop("check_vma", kwargs.pop("check_rep", None))
+  if check is not None:
+    for alias in ("check_vma", "check_rep"):
+      if alias in accepted:
+        kwargs[alias] = check
+        break
+  kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+  return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
 def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
   """Build a Mesh with named axes from {axis: size}. Axes of size 1 are kept
   (harmless, simplifies downstream specs)."""
@@ -161,6 +185,28 @@ def shard_params(params: Dict[str, Any], mesh) -> Dict[str, Any]:
     return jax.device_put(leaf, NamedSharding(mesh, placement))
 
   return jax.tree_util.tree_map_with_path(place, params)
+
+
+def device_bytes(tree) -> int:
+  """Per-device resident bytes of a (possibly sharded) pytree: each leaf
+  counts its LOCAL shard shape (`sharding.shard_shape`) × itemsize, so a
+  tp-sharded param tree reports what one chip actually holds. Metadata-only
+  (no device sync) — the ground truth the mesh-aware cost model's
+  weight_bytes_per_device is tested against."""
+  import math
+
+  import jax
+
+  total = 0
+  for leaf in jax.tree_util.tree_leaves(tree):
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+      continue
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+      shape = sharding.shard_shape(tuple(shape))
+    total += math.prod(shape) * leaf.dtype.itemsize
+  return int(total)
 
 
 def batch_spec(rank: int = 2):

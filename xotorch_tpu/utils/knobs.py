@@ -90,6 +90,7 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_DRAFT_RETRY_S", "float", "300", "Cooldown (s) before retrying a draft model that failed to load.", "Speculative"),
   Knob("XOT_SPEC_EWMA_S", "float", "60", "Time constant (s) of the xot_spec_accept_rate EWMA gauge.", "Speculative"),
   # ------------------------------------------------------------- sharding
+  Knob("XOT_TP", "int", None, "Tensor-parallel width of each ring partition's serving mesh (primary knob; overrides XOT_SERVE_TP). 0 forces single-device; unset defers to XOT_SERVE_TP.", "Sharding"),
   Knob("XOT_SERVE_TP", "int", None, "Tensor-parallel degree for serving; unset auto-selects from local devices.", "Sharding"),
   Knob("XOT_SERVE_SP", "int", "0", "Sequence-parallel degree for long-prompt serving prefill.", "Sharding"),
   Knob("XOT_SERVE_EP", "int", "0", "Expert-parallel degree for MoE serving.", "Sharding"),
