@@ -891,6 +891,100 @@ def _run_mesh(model_id: str, prefill_len: int, decode_tokens: int,
   return asyncio.run(run())
 
 
+def _run_vkv(model_id: str, prefill_len: int, decode_tokens: int,
+             progress_path: str) -> dict:
+  """Virtual-KV A/B (the `vkv` retry stage): the same greedy request through
+  the Node loop on three cache layouts — paged int8-KV (the headline: scale
+  pages halve paged KV read bytes, judged against the 662 tok/s int8
+  ceiling), contiguous int8-KV (the `rest` stage's layout — isolates what
+  the page indirection costs/buys at equal arithmetic), and paged bf16 (the
+  `paged` stage's layout — isolates what int8 KV buys at equal addressing).
+
+  Paged int8 vs contiguous int8 must be byte-IDENTICAL
+  (vkv_tokens_verified): virtual addressing may never change output, only
+  where the bytes live. The bf16 arm legitimately differs (different cache
+  numerics) and is only a throughput reference. Both paged arms must finish
+  with ZERO unpage gathers and ZERO commit-copy bytes — the gate-list
+  retirement bar, asserted here exactly as in tests/test_vkv.py — and the
+  paged pool's defrag/fragmentation counters ride along for the record."""
+  import asyncio
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+  from xotorch_tpu.orchestration.node import Node
+  from xotorch_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+  words = ("alpha", "beta", "gamma", "delta")
+  prompt = " ".join(words[i % len(words)] for i in range(prefill_len))
+
+  async def run_mode(tag: str, paged: bool, kv_quant: str) -> dict:
+    prior = {k: os.environ.get(k) for k in ("XOT_PAGED_KV", "XOT_KV_QUANT")}
+    os.environ["XOT_PAGED_KV"] = "1" if paged else "0"
+    os.environ["XOT_KV_QUANT"] = kv_quant
+    try:
+      eng = JAXShardInferenceEngine()
+      node = Node(f"vkv-{tag}", _NullServer(), eng, _NoDiscovery(), None,
+                  RingMemoryWeightedPartitioningStrategy(),
+                  max_generate_tokens=decode_tokens, default_sample_temp=0.0,
+                  decode_chunk_size=int(os.getenv("XOT_DECODE_CHUNK", "8")))
+      node.device_capabilities = _bench_caps()
+      node.topology.update_node(node.id, _bench_caps())
+      shard = Shard(model_id, 0, n_layers - 1, n_layers)
+
+      warm = await _timed_generate([node], shard, prompt, f"bench-vkv-{tag}-warmup")
+      _record(progress_path, f"vkv:{tag}:warmup", tok_s=round(warm["tok_s"], 2))
+      timed = await _timed_generate([node], shard, prompt, f"bench-vkv-{tag}-timed")
+      # Zero bars are cumulative over warmup + timed on purpose: one gather
+      # anywhere means the layout lied about being native.
+      timed["unpage_calls"] = int(getattr(eng, "_unpage_calls", 0))
+      timed["commit_copy_bytes"] = int(getattr(eng, "_commit_copy_bytes", 0))
+      stats = eng.page_pool_stats() if paged else None
+      timed["pool"] = stats or {}
+      _record(progress_path, f"vkv:{tag}", tok_s=round(timed["tok_s"], 2),
+              unpage_calls=timed["unpage_calls"])
+      return timed
+    finally:
+      for k, v in prior.items():
+        if v is None:
+          os.environ.pop(k, None)
+        else:
+          os.environ[k] = v
+
+  async def run() -> dict:
+    pon = await run_mode("int8-paged", paged=True, kv_quant="int8")
+    coff = await run_mode("int8-contig", paged=False, kv_quant="int8")
+    bf16 = await run_mode("bf16-paged", paged=True, kv_quant="")
+    return {
+      "vkv_int8_tok_s": round(pon["tok_s"], 2),
+      "vkv_int8_contig_tok_s": round(coff["tok_s"], 2),
+      "vkv_bf16_tok_s": round(bf16["tok_s"], 2),
+      # What the page indirection costs/buys at equal arithmetic, and what
+      # int8 KV buys at equal addressing.
+      "vkv_paged_speedup": (round(pon["tok_s"] / coff["tok_s"], 2)
+                            if coff["tok_s"] else None),
+      "vkv_int8_speedup": (round(pon["tok_s"] / bf16["tok_s"], 2)
+                           if bf16["tok_s"] else None),
+      "vkv_ttft_ms": round(pon["ttft_s"] * 1000, 1),
+      # The gate-list retirement bar, summed over BOTH paged arms.
+      "vkv_unpage_calls": pon["unpage_calls"] + bf16["unpage_calls"],
+      "vkv_commit_copy_bytes": pon["commit_copy_bytes"] + bf16["commit_copy_bytes"],
+      # Arena health for the record (headline arm): idle-slot defrag
+      # activity and the live-hole gauge it acts on.
+      "vkv_defrag_moves": int(pon["pool"].get("defrag_moves", 0)),
+      "vkv_fragmentation_pages": int(pon["pool"].get("fragmentation", 0)),
+      "vkv_peak_pages_in_use": int(pon["pool"].get("peak_pages_in_use", 0)),
+      # IDENTITY, not allclose: the int8 arms share numerics, so virtual
+      # addressing may not change a single token. bf16 is excluded — its
+      # cache numerics differ by construction.
+      "vkv_tokens_verified": bool(pon["tokens"] and pon["tokens"] == coff["tokens"]),
+    }
+
+  return asyncio.run(run())
+
+
 def _run_concurrent(model_id: str, prefill_len: int, decode_tokens: int, n_conc: int,
                     progress_path: str) -> dict:
   """Aggregate throughput of N concurrent requests through one Node with
@@ -1492,6 +1586,27 @@ def child_main() -> None:
           "tp-mesh vs single-device greedy token streams disagree"]))
     except Exception as e:
       res["mesh_error"] = repr(e)
+  # Virtual-KV A/B stage (opt-in: BENCH_VKV=1 — the tpu_retry `vkv` step):
+  # paged int8-KV vs contiguous int8-KV vs paged bf16, int8 streams
+  # byte-identical, both paged arms at zero unpage/commit-copy.
+  if os.getenv("BENCH_VKV", "0") == "1":
+    try:
+      res.update(_run_vkv(model_id, min(prefill_len, 128), decode_tokens,
+                          progress_path))
+      if res.get("vkv_tokens_verified") is False:
+        res["implausible"] = True
+        res["diagnosis"] = "; ".join(filter(None, [
+          res.get("diagnosis"),
+          "paged int8 vs contiguous int8 greedy token streams disagree"]))
+      # The zero bar is measurement integrity too: a "paged" number that
+      # secretly gathered the cache back measured the contiguous path.
+      if res.get("vkv_unpage_calls", 0) or res.get("vkv_commit_copy_bytes", 0):
+        res["implausible"] = True
+        res["diagnosis"] = "; ".join(filter(None, [
+          res.get("diagnosis"),
+          "paged vkv arms gathered pages back (nonzero unpage/commit-copy)"]))
+    except Exception as e:
+      res["vkv_error"] = repr(e)
   # Real-checkpoint stage: auto-runs whenever actual downloaded weights are
   # on disk (zero-egress containers without them skip silently).
   try:

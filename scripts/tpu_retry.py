@@ -124,6 +124,16 @@ STEPS: list[tuple[str, dict, str]] = [
   ("mesh", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "0",
             "XOT_PAGED_KV": "1", "BENCH_MESH": "1"},
    "mesh_tok_s"),
+  # Virtual-KV A/B (ISSUE 17 `vkv`): paged int8-KV (handles + scale pages
+  # from the same arena) vs contiguous int8-KV vs paged bf16 on one greedy
+  # request — vkv_int8_tok_s is the headline judged against the 662 tok/s
+  # int8 ceiling. The stage flips XOT_PAGED_KV/XOT_KV_QUANT per arm itself
+  # (no env here), int8 streams must be byte-identical, and both paged arms
+  # must land zero unpage gathers / zero commit-copy bytes — the gate-list
+  # retirement bar measured on chip, not just counter-asserted on CPU.
+  ("vkv", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "0",
+           "BENCH_VKV": "1"},
+   "vkv_int8_tok_s"),
   # 32k depth: twice the r3-comparable context, scan prefill + decode.
   ("long32k", {**LONG, "BENCH_LONG": "32768"}, "long_tok_s"),
 ]

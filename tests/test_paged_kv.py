@@ -342,10 +342,11 @@ async def test_pool_pressure_evicts_prefix_entries_not_requests(tiny_model_dir, 
   assert pool.pages_in_use == 0
 
 
-async def test_unpage_roundtrip_via_per_token_decode(tiny_model_dir, monkeypatch):
-  """A contiguous code path touching a committed request (per-token
-  fused-sample decode) gathers its pages back transparently — the stream
-  must continue exactly as the all-contiguous engine's."""
+async def test_per_token_decode_stays_paged(tiny_model_dir, monkeypatch):
+  """Per-token fused-sample steps on a committed request run NATIVE to the
+  page arena (virtual KV addressing — no gather back to a contiguous
+  buffer): the stream must continue exactly as the all-contiguous
+  engine's, with the unpage counter still at zero."""
   monkeypatch.setenv("XOT_SEED", "7")
   monkeypatch.setenv("XOT_CACHE_LEN", "16")
   shard = _full_shard()
@@ -358,7 +359,7 @@ async def test_unpage_roundtrip_via_per_token_decode(tiny_model_dir, monkeypatch
     toks = [tok]
     out = await eng.generate_chunk(rid, shard, toks[-1], 8, temp=0.0)
     toks.extend(int(t) for t in out)
-    # ... then per-token fused-sample steps (contiguous-only path)
+    # ... then per-token fused-sample steps (paged-native bucket fallback)
     for _ in range(3):
       tok, _ = await eng.infer_sample_tensor(
         rid, shard, np.asarray([[toks[-1]]], dtype=np.int64), temp=0.0)
@@ -373,3 +374,4 @@ async def test_unpage_roundtrip_via_per_token_decode(tiny_model_dir, monkeypatch
   eng = _engine(tiny_model_dir)
   got = await mixed(eng, "r")
   assert got == want
+  assert eng._unpage_calls == 0, "per-token steps must not gather pages back"

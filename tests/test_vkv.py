@@ -1,0 +1,546 @@
+"""Virtual KV addressing (inference/jax_engine/vkv.py + engine wiring).
+
+Requests hold VirtualKV handles — logical page slots naming physical ids,
+resolved to a dense table once per dispatch by a jit-free mapper — instead
+of raw page-id lists. Everything the gate list used to exclude now serves
+paged, and this file is the correctness bar for each unlocked family:
+
+- handle unit invariants: list-compat arithmetic (len == pages_for(pos)),
+  window release (release_below zeroes slots, advances base, frees ids),
+  trim/remap/prefix extraction, dense-table resolution;
+- sliding-window configs decode BYTE-EQUAL to the contiguous path —
+  gemma2-style alternation (windowed kernels, but one global layer means
+  nothing frees) AND mistral-style all-layers-windowed (out-of-window pages
+  decref back to the pool mid-decode, with EXACT free-page accounting
+  against vkv.dead_page_count);
+- int8-KV pages (K/V int8 pages + per-(position,head) scale pages from the
+  same arena) decode byte-equal to the contiguous int8 engine, through the
+  XLA fallback and the Pallas kernel, with zero commit copies;
+- sampling-extras requests and per-token steps stay on pages:
+  xot_kv_unpage_total is ZERO suite-wide unless XOT_PAGED_SPEC=0 explicitly
+  restores the legacy unpage fallback (tested too);
+- idle-slot defrag migrates live requests' pages and rewrites only the
+  virtual maps — streams keep decoding byte-equal across a compaction;
+- host-tier promotion scatters H2D straight into pool pages (zero-copy:
+  no contiguous intermediate, _commit_copy_bytes stays 0), bf16 and int8;
+- CostModel's windowed paged read-bytes are ground-truth-tested against the
+  kernel's own page-walk clamp and the arena's actual leaf layout;
+- TP=2 on the virtual 8-device mesh serves the windowed + int8 families
+  paged with the same byte-equality bar.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.inference.jax_engine import vkv
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.jax_engine.vkv import VirtualKV
+from xotorch_tpu.inference.shard import Shard
+from xotorch_tpu.models.config import config_from_hf_dict
+
+from tests.test_model_equivalence import (
+  TINY_GEMMA2_CFG, _tiny_cfg, make_hf_checkpoint,
+)
+
+# Mistral-style: sliding_window with no layer_types and no alternation rule
+# means EVERY layer slides (config.layer_window) — the one family where
+# window release actually returns pages mid-decode. window=8 == one page at
+# XOT_KV_PAGE=8, so a short CPU-sized decode crosses several release
+# boundaries.
+TINY_MISTRAL_WIN_CFG = _tiny_cfg("mistral", "MistralForCausalLM", head_dim=32,
+                                 sliding_window=8)
+
+
+@pytest.fixture(scope="module")
+def gemma2_model_dir(tmp_path_factory):
+  return make_hf_checkpoint(tmp_path_factory.mktemp("vkv_g2"), TINY_GEMMA2_CFG, seed=3)
+
+
+@pytest.fixture(scope="module")
+def mistral_win_model_dir(tmp_path_factory):
+  return make_hf_checkpoint(tmp_path_factory.mktemp("vkv_mw"), TINY_MISTRAL_WIN_CFG, seed=3)
+
+
+@pytest.fixture(scope="module")
+def llama_model_dir(tmp_path_factory):
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  return make_hf_checkpoint(tmp_path_factory.mktemp("vkv_ll"), TINY_LLAMA_CFG, seed=3)
+
+
+def _full_shard(cfg_dict):
+  n = cfg_dict["num_hidden_layers"]
+  return Shard("m", 0, n - 1, n)
+
+
+def _paged_env(monkeypatch, **extra):
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  monkeypatch.setenv("XOT_PAGED_KV", "1")
+  monkeypatch.setenv("XOT_KV_PAGE", "8")
+  monkeypatch.setenv("XOT_KV_POOL_TOKENS", "512")
+  for k, v in extra.items():
+    monkeypatch.setenv(k, v)
+
+
+def _engine(model_dir, **kw):
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}),
+                                 dtype="float32", **kw)
+
+
+async def _greedy(eng, rid, shard, prompt, chunks=2, chunk_size=8, sampling=None):
+  tok, _ = await eng.infer_sample_tensor(rid, shard, prompt, temp=0.0,
+                                         sampling=sampling)
+  toks = [int(tok)]
+  for _ in range(chunks):
+    out = await eng.generate_chunk(rid, shard, toks[-1], chunk_size, temp=0.0)
+    toks.extend(int(t) for t in out)
+  return toks
+
+
+def _assert_paged_native(eng):
+  """The virtual-addressing bar: requests never leave the arena."""
+  assert eng._unpage_calls == 0, "paged-native request gathered back to contiguous"
+  assert eng._commit_copy_bytes == 0, "paged-native prefill must not commit-copy"
+  assert eng._grow_copies == 0
+
+
+# -------------------------------------------------------------- handle unit
+
+
+def test_virtual_kv_handle_ops():
+  h = VirtualKV([3, 5, 9])
+  # list-compatible surface: the engine's len(pages) == pages_for(pos)
+  # arithmetic and slicing both keep working on the handle.
+  assert len(h) == 3 and list(h) == [3, 5, 9] and h[1] == 5 and h[:2] == [3, 5]
+  h.append(12)
+  h.extend([14])
+  assert h.live() == [3, 5, 9, 12, 14]
+
+  # Window release: slots zero, base advances, freed ids come back once.
+  assert h.release_below(2) == [3, 5]
+  assert h.base == 2 and len(h) == 5 and h.live() == [9, 12, 14]
+  assert h.release_below(2) == []  # idempotent at the same bound
+  assert h.release_below(3) == [9]
+  assert list(h)[:3] == [0, 0, 0]
+
+  # Tail trim (spec backstop shrink): drops live tail ids, len shrinks.
+  assert h.trim_to(4) == [14]
+  assert len(h) == 4 and h.live() == [12]
+
+  # Prefix extraction: a window-released handle has holes — not shareable.
+  assert h.prefix_ids(1) is None
+  assert VirtualKV([3, 5, 9]).prefix_ids(2) == [3, 5]
+
+  # Defrag remap renames physical ids without touching structure.
+  h2 = VirtualKV([7, 0, 11], base=1)
+  h2.remap({7: 1, 11: 2})
+  assert list(h2) == [1, 0, 2] and h2.base == 1
+
+
+def test_resolve_page_table_pads_and_preserves_holes():
+  t = vkv.resolve_page_table([VirtualKV([3, 5]), [9], VirtualKV([0, 0, 7], base=2)], 4)
+  assert t.dtype == np.int32 and t.shape == (3, 4)
+  # Released slots stay 0 (scratch) in the dense table; short rows zero-pad.
+  np.testing.assert_array_equal(t, [[3, 5, 0, 0], [9, 0, 0, 0], [0, 0, 7, 0]])
+
+
+def test_freeable_window_and_dead_page_math():
+  g2 = config_from_hf_dict(TINY_GEMMA2_CFG)
+  mw = config_from_hf_dict(TINY_MISTRAL_WIN_CFG)
+  # gemma2 alternation: any global layer in the shard pins history forever.
+  assert g2.uses_sliding_window and vkv.freeable_window(g2, 0, g2.num_layers) == 0
+  # ...but a shard holding ONLY even (sliding) layers may free.
+  assert vkv.freeable_window(g2, 0, 1) == g2.sliding_window
+  # mistral semantics: every layer slides -> the max window frees.
+  assert vkv.freeable_window(mw, 0, mw.num_layers) == 8
+  # layer_types wins over the family rule.
+  lt = config_from_hf_dict(_tiny_cfg(
+    "mistral", "MistralForCausalLM", head_dim=32, sliding_window=8,
+    layer_types=["sliding_attention", "full_attention", "sliding_attention"]))
+  assert vkv.freeable_window(lt, 0, lt.num_layers) == 0
+  assert vkv.freeable_window(lt, 0, 1) == 8  # first-layer-only shard
+
+  # A page dies when its last position drops below every future query's
+  # window ([q-w+1, q] visible); the current write page is never freed.
+  assert vkv.dead_page_count(7, 8, 8) == 0
+  assert vkv.dead_page_count(15, 8, 8) == 1   # pos 15 -> page 0 (0..7) dead
+  assert vkv.dead_page_count(52, 8, 8) == 5
+  assert vkv.dead_page_count(52, 0, 8) == 0   # global: nothing ever dies
+  for pos in range(1, 200):
+    assert vkv.dead_page_count(pos, 8, 8) < -(-pos // 8)  # write page live
+
+
+# ----------------------------------------------------- CostModel ground truth
+
+
+def test_costmodel_windowed_paged_reads_match_kernel_clamp():
+  """The paged read-byte prediction must count exactly the pages the ragged
+  kernel's kv index map DMAs: distinct _logical_page_index values over the
+  grid, window clamp included — the kernel is the ground truth, per layer."""
+  import jax.numpy as jnp
+  from xotorch_tpu.inference.jax_engine.costmodel import CostModel
+  from xotorch_tpu.ops.paged_attention import _logical_page_index
+
+  cfg = config_from_hf_dict(TINY_GEMMA2_CFG)  # alternating: per-layer math
+  page, maxp = 8, 32
+  cm = CostModel(cfg, cfg.num_layers, True, True, dtype_bytes=4)
+  for depth in (1, 7, 8, 9, 63, 64, 100):
+    for li in range(cfg.num_layers):
+      w = cfg.layer_window(li)
+      win = jnp.int32(w) if w > 0 else None
+      seen = {int(_logical_page_index(j, jnp.int32(depth), page, window=win))
+              for j in range(maxp)}
+      assert cm._paged_pages_read(depth, li, page) == len(seen), (depth, li, w)
+
+
+def test_costmodel_paged_bytes_match_arena_layout():
+  """Per-(token, layer) KV bytes must equal the ARENA's actual leaf bytes
+  per token slot — bf16-style fp32 arena and the int8 arena with its
+  per-(position, head) scale pages — and the windowed total must be the
+  per-layer page-walk sum at the cfg's own windows."""
+  import jax.numpy as jnp
+  from xotorch_tpu.inference.jax_engine.costmodel import CostModel
+  from xotorch_tpu.inference.jax_engine.paged_cache import PagePool
+
+  cfg = config_from_hf_dict(TINY_GEMMA2_CFG)
+  L, page = cfg.num_layers, 8
+
+  def arena_bytes_per_token_layer(kv_quant):
+    pool = PagePool(cfg, L, 4, page, jnp.float32, kv_quant=kv_quant)
+    total = sum(leaf.size * leaf.dtype.itemsize for leaf in pool.arena.values())
+    return total // (L * 4 * page)  # leaves are [L, P, page, ...]
+
+  for kv_quant, model_kv in ((False, None), (True, "int8")):
+    cm = CostModel(cfg, L, True, True, dtype_bytes=4, kv_quant=model_kv)
+    assert cm._kv_token_bytes_one_layer() == arena_bytes_per_token_layer(kv_quant)
+    # Windowed paged read = sum over layers of that layer's own page walk.
+    depth = 40
+    want = sum(cm._paged_pages_read(depth, i, page)
+               for i in range(L)) * page * cm._kv_token_bytes_one_layer()
+    assert cm.kv_read_bytes_per_token(depth, paged=True, page=page) == want
+    # Sliding layers read LESS than global ones at depth >> window.
+    assert (cm._paged_pages_read(depth, 0, page)
+            < cm._paged_pages_read(depth, 1, page))
+
+  # int8 halves the payload: scale overhead is 1/head_dim of the fp32 rows.
+  bf = CostModel(cfg, L, True, True, dtype_bytes=2)
+  q8 = CostModel(cfg, L, True, True, dtype_bytes=2, kv_quant="int8")
+  r_bf = bf.kv_read_bytes_per_token(100, paged=True, page=page)
+  r_q8 = q8.kv_read_bytes_per_token(100, paged=True, page=page)
+  assert r_q8 < 0.6 * r_bf
+
+
+# ------------------------------------------------- sliding window, engine e2e
+
+
+@pytest.mark.parametrize("kernel", ["0", "1"], ids=["xla", "pallas"])
+async def test_gemma2_sliding_window_paged_stream_equal(gemma2_model_dir,
+                                                        monkeypatch, kernel):
+  """gemma2-style alternation was the hardest gate-list exclusion: paged
+  greedy streams must be byte-equal to the contiguous engine through both
+  the XLA fallback and the windowed Pallas kernel, fully paged-native.
+  Alternation means one global layer pins history: nothing may free."""
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_GEMMA2_CFG)
+  prompt = np.array([np.arange(12) % 250 + 1], dtype=np.int64)
+  want = await _greedy(_engine(gemma2_model_dir), "r", shard, prompt)
+
+  _paged_env(monkeypatch, XOT_PAGED_KERNEL=kernel)
+  eng = _engine(gemma2_model_dir)
+  got = await _greedy(eng, "r", shard, prompt)
+  assert got == want, f"windowed paged stream {got} != contiguous {want}"
+  _assert_paged_native(eng)
+
+  ctx = eng._contexts[shard]
+  st = ctx.states["r"]
+  assert isinstance(st.pages, VirtualKV)
+  assert st.pages.base == 0 and len(st.pages.live()) == len(st.pages)
+  assert len(st.pages) == ctx.page_pool.pages_for(st.pos)
+
+
+async def test_mistral_window_release_frees_pages_exactly(mistral_win_model_dir,
+                                                          monkeypatch):
+  """All-layers-windowed (mistral semantics): out-of-window pages decref
+  back to the pool AS DECODE ADVANCES — the stream stays byte-equal to the
+  contiguous engine while the request's physical footprint is bounded by
+  the window, with free-page accounting exact against dead_page_count."""
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_MISTRAL_WIN_CFG)
+  prompt = np.array([np.arange(12) % 250 + 1], dtype=np.int64)
+  want = await _greedy(_engine(mistral_win_model_dir), "r", shard, prompt, chunks=4)
+
+  # No prefix entries / host tier: the pool must account to the request alone.
+  _paged_env(monkeypatch, XOT_PREFIX_CACHE_MIN="10000", XOT_KV_HOST_BYTES="0")
+  eng = _engine(mistral_win_model_dir)
+  got = await _greedy(eng, "r", shard, prompt, chunks=4)
+  assert got == want, f"window-freed paged stream {got} != contiguous {want}"
+  _assert_paged_native(eng)
+
+  ctx = eng._contexts[shard]
+  pool = ctx.page_pool
+  st = ctx.states["r"]
+  assert st.pos == 12 + len(got) - 1  # prompt + written tokens (last not yet)
+  # Logical length still covers the whole position range...
+  assert len(st.pages) == pool.pages_for(st.pos)
+  # ...but everything behind the window went back to the pool, exactly.
+  dead = vkv.dead_page_count(st.pos, 8, pool.page_size)
+  assert dead > 0 and st.pages.base == dead
+  live = st.pages.live()
+  assert len(live) == len(st.pages) - dead
+  assert pool.pages_in_use == len(live)
+
+  await eng.clear_request("r")
+  assert pool.pages_in_use == 0  # released slots must not double-free
+
+
+# --------------------------------------------------------------- int8 KV e2e
+
+
+@pytest.mark.parametrize("kernel", ["0", "1"], ids=["xla", "pallas"])
+async def test_int8_kv_paged_stream_equal(llama_model_dir, monkeypatch, kernel):
+  """int8-KV paged: K/V live as int8 pages paired with per-(position, head)
+  scale pages from the same arena. The paged engine's greedy stream must be
+  byte-equal to the CONTIGUOUS int8 engine — same quantize-at-write, same
+  dequant-at-read math, only the layout differs."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_LLAMA_CFG)
+  prompt = np.array([np.arange(12) % 250 + 1], dtype=np.int64)
+  want = await _greedy(_engine(llama_model_dir, kv_quant="int8"), "r", shard, prompt)
+
+  _paged_env(monkeypatch, XOT_PAGED_KERNEL=kernel)
+  eng = _engine(llama_model_dir, kv_quant="int8")
+  got = await _greedy(eng, "r", shard, prompt)
+  assert got == want, f"int8 paged stream {got} != int8 contiguous {want}"
+  _assert_paged_native(eng)
+
+  pool = eng._contexts[shard].page_pool
+  import jax.numpy as jnp
+  assert pool.arena["k"].dtype == jnp.int8
+  assert set(pool.arena) == {"k", "v", "k_scale", "v_scale"}
+  # Scale pages mirror the K/V pages' [L, P, page] geometry minus head_dim.
+  assert pool.arena["k_scale"].shape == pool.arena["k"].shape[:-1]
+
+
+# ------------------------------------------------- extras + per-token, paged
+
+
+async def test_extras_and_per_token_stay_paged(llama_model_dir, monkeypatch):
+  """Sampling-extras requests (seed/bias/penalties/logprobs lane) and
+  per-token bucket-fallback steps run as paged dispatches: streams match
+  the contiguous engine byte-for-byte — including a mixed batch where the
+  extras member splits into its own single-row dispatch — and the unpage
+  counter stays at zero."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_LLAMA_CFG)
+  p_extras = np.array([np.arange(12) % 250 + 1], dtype=np.int64)
+  p_plain = np.array([[7, 3, 11, 25]], dtype=np.int64)
+  bias_tok = 123
+  sampling = {"logit_bias": {str(bias_tok): 100.0}}  # forces tok under greedy
+
+  async def scenario(eng):
+    # plain + extras members decode CONCURRENTLY (mixed batch at the
+    # batcher), plus per-token steps on the extras request afterwards.
+    ex, pl = await asyncio.gather(
+      _greedy(eng, "ex", shard, p_extras, chunks=2, sampling=sampling),
+      _greedy(eng, "pl", shard, p_plain, chunks=2))
+    for _ in range(2):
+      tok, _ = await eng.infer_sample_tensor(
+        "ex", shard, np.asarray([[ex[-1]]], dtype=np.int64), temp=0.0,
+        sampling=sampling)
+      ex.append(int(tok))
+    return ex, pl
+
+  want_ex, want_pl = await scenario(_engine(llama_model_dir))
+  assert all(t == bias_tok for t in want_ex), "bias must dominate greedy sampling"
+
+  _paged_env(monkeypatch)
+  eng = _engine(llama_model_dir)
+  got_ex, got_pl = await scenario(eng)
+  assert got_ex == want_ex and got_pl == want_pl
+  _assert_paged_native(eng)
+
+
+async def test_paged_spec_zero_restores_legacy_unpage(llama_model_dir, monkeypatch):
+  """XOT_PAGED_SPEC=0 is the ONE remaining escape hatch to the old
+  unpage-then-contiguous fallback (segment forwards via _prep_state): the
+  stream must still be correct, and xot_kv_unpage_total must actually
+  count — the zero-assertions elsewhere are meaningful only if this path
+  can fire. (The fused per-token sampler stays paged even here; the raw
+  logits path below is what the legacy gate reroutes.)"""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_LLAMA_CFG)
+  prompt = np.array([np.arange(12) % 250 + 1], dtype=np.int64)
+
+  async def chunk_then_logits(eng):
+    logits, _ = await eng.infer_tensor("r", shard, prompt)
+    toks = [int((await eng.sample(logits, temp=0.0))[0])]
+    out = await eng.generate_chunk("r", shard, toks[-1], 8, temp=0.0)
+    toks.extend(int(t) for t in out)  # paged chunk commits the request
+    for _ in range(2):  # raw-logits per-token steps (_forward_segment)
+      logits, _ = await eng.infer_tensor(
+        "r", shard, np.asarray([[toks[-1]]], dtype=np.int64))
+      toks.append(int((await eng.sample(logits, temp=0.0))[0]))
+    return toks
+
+  want = await chunk_then_logits(_engine(llama_model_dir))
+  _paged_env(monkeypatch, XOT_PAGED_SPEC="0")
+  eng = _engine(llama_model_dir)
+  got = await chunk_then_logits(eng)
+  assert got == want
+  assert eng._unpage_calls > 0, "legacy gate must route through the unpage fallback"
+
+
+# -------------------------------------------------------------------- defrag
+
+
+async def test_defrag_migrates_pages_under_live_requests(llama_model_dir, monkeypatch):
+  """Request churn strands free holes below the high-water mark; a
+  compaction pass migrates the highest used pages down and rewrites only
+  the virtual maps — live requests keep decoding byte-equal, accounting
+  stays exact, and the counters/stats surface the work."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_LLAMA_CFG)
+  prompts = {
+    "r1": np.array([np.arange(20) % 250 + 1], dtype=np.int64),
+    "r2": np.array([[7, 3, 11, 25]], dtype=np.int64),
+    "r3": np.array([[42, 17, 5, 9, 2]], dtype=np.int64),
+  }
+
+  async def scenario(eng, defrag):
+    toks = {}
+    for rid, p in prompts.items():
+      toks[rid] = await _greedy(eng, rid, shard, p, chunks=1)
+    # r1 held the LOWEST page ids; clearing it opens holes under r2/r3.
+    await eng.clear_request("r1")
+    if defrag:
+      ctx = eng._contexts[shard]
+      assert ctx.page_pool.fragmentation() > 0
+      before = {rid: list(ctx.states[rid].pages) for rid in ("r2", "r3")}
+      moved = eng._defrag_sync(ctx, max_moves=64)
+      assert moved > 0 and eng._defrag_moves == moved
+      assert ctx.page_pool.fragmentation() == 0
+      # Physical ids were renamed for at least one holder...
+      assert any(list(ctx.states[rid].pages) != before[rid] for rid in before)
+      # ...with exact accounting preserved across the migration.
+      assert ctx.page_pool.pages_in_use >= sum(
+        len(ctx.states[rid].pages.live()) for rid in ("r2", "r3"))
+      stats = eng.page_pool_stats()
+      assert stats["defrag_moves"] == moved and stats["fragmentation"] == 0
+    # Decode must continue seamlessly over the migrated pages.
+    for rid in ("r2", "r3"):
+      out = await eng.generate_chunk(rid, shard, toks[rid][-1], 8, temp=0.0)
+      toks[rid].extend(int(t) for t in out)
+    return toks
+
+  monkeypatch.setenv("XOT_PAGED_KV", "0")
+  want = await scenario(_engine(llama_model_dir), defrag=False)
+  _paged_env(monkeypatch, XOT_PREFIX_CACHE_MIN="10000", XOT_KV_HOST_BYTES="0")
+  eng = _engine(llama_model_dir)
+  got = await scenario(eng, defrag=True)
+  for rid in ("r2", "r3"):
+    assert got[rid] == want[rid], f"{rid} diverged across defrag"
+  _assert_paged_native(eng)
+
+
+async def test_defrag_idle_hook_fires_from_batcher(llama_model_dir, monkeypatch):
+  """XOT_KV_DEFRAG (default on): the decode batcher runs a compaction pass
+  in its idle slot after draining — no caller ever schedules it."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  _paged_env(monkeypatch, XOT_PREFIX_CACHE_MIN="10000", XOT_KV_HOST_BYTES="0")
+  shard = _full_shard(TINY_LLAMA_CFG)
+  eng = _engine(llama_model_dir)
+  t1 = await _greedy(eng, "a", shard, np.array([np.arange(20) % 250 + 1]), chunks=1)
+  t2 = await _greedy(eng, "b", shard, np.array([[7, 3, 11, 25]]), chunks=1)
+  await eng.clear_request("a")
+  ctx = eng._contexts[shard]
+  assert ctx.page_pool.fragmentation() > 0
+  # The next chunk rides the batcher; its drain cycle's idle slot compacts.
+  await eng.generate_chunk("b", shard, t2[-1], 8, temp=0.0)
+  for _ in range(50):  # the idle pass runs after the chunk's result posts
+    if eng._defrag_moves > 0:
+      break
+    await asyncio.sleep(0.05)
+  assert eng._defrag_moves > 0
+  assert ctx.page_pool.fragmentation() == 0
+  assert t1  # decode output sanity (fixture reuse keeps this cheap)
+
+
+# ------------------------------------------------- zero-copy host promotion
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8"], ids=["bf16", "int8"])
+async def test_host_promotion_scatters_into_pages_zero_copy(llama_model_dir,
+                                                            monkeypatch, kv_quant):
+  """A prefix spilled to the host tier under pool pressure promotes back by
+  scattering H2D STRAIGHT into fresh pool pages — no contiguous
+  intermediate, no commit copy — and the warm stream is byte-equal to a
+  cold engine's. The int8 flavor round-trips the scale leaves too."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  shard = _full_shard(TINY_LLAMA_CFG)
+  prompt_a = np.array([np.arange(44) % 250 + 1], dtype=np.int64)
+  prompt_b = np.array([np.arange(44) % 250 + 101], dtype=np.int64)
+
+  async def generate(eng, rid, prompt):
+    tok, _ = await eng.infer_sample_tensor(rid, shard, prompt, temp=0.0)
+    out = await eng.generate_chunk(rid, shard, int(tok), 8, temp=0.0)
+    return [int(tok)] + [int(t) for t in out]
+
+  want = await generate(_engine(llama_model_dir, kv_quant=kv_quant), "cold", prompt_a)
+
+  # 10 usable pages of 8 tokens: A pins 5 pages of prefix entry + decode;
+  # B's 44-token prompt forces the pool-pressure spill of A's entry.
+  _paged_env(monkeypatch, XOT_KV_POOL_TOKENS="80", XOT_PREFIX_CACHE_MIN="16")
+  eng = _engine(llama_model_dir, kv_quant=kv_quant)
+  await generate(eng, "ra", prompt_a)
+  await eng.clear_request("ra")
+  await generate(eng, "rb", prompt_b)
+  assert eng._host_spill_bytes > 0, "pool pressure must have spilled A's prefix"
+  await eng.clear_request("rb")
+
+  got = await generate(eng, "rc", prompt_a)  # promotes A's prefix from host
+  assert eng._prefix_hits >= 1
+  assert got == want, f"promoted stream {got} != cold stream {want}"
+  _assert_paged_native(eng)  # in particular: promotion copied ZERO commit bytes
+
+
+# ------------------------------------------------------------------ TP=2 mesh
+
+
+@pytest.mark.parametrize("family", ["gemma2-window", "int8"])
+async def test_tp2_paged_families_stream_equal(gemma2_model_dir, llama_model_dir,
+                                               monkeypatch, family):
+  """XOT_TP=2 on the virtual 8-device mesh: the arena shards its kv-head
+  axis while tables stay replicated — the previously gated families must
+  hold the same byte-equality bar under the mesh."""
+  from tests.test_model_equivalence import TINY_LLAMA_CFG
+  if family == "gemma2-window":
+    model_dir, cfg_d, kv_quant = gemma2_model_dir, TINY_GEMMA2_CFG, None
+  else:
+    model_dir, cfg_d, kv_quant = llama_model_dir, TINY_LLAMA_CFG, "int8"
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  monkeypatch.setenv("XOT_TP", "2")
+  shard = _full_shard(cfg_d)
+  prompt = np.array([np.arange(12) % 250 + 1], dtype=np.int64)
+  want = await _greedy(_engine(model_dir, kv_quant=kv_quant), "r", shard, prompt,
+                       chunks=1)
+
+  _paged_env(monkeypatch, XOT_TP="2")
+  eng = _engine(model_dir, kv_quant=kv_quant)
+  got = await _greedy(eng, "r", shard, prompt, chunks=1)
+  assert got == want, f"TP=2 paged {family} stream {got} != contiguous {want}"
+  _assert_paged_native(eng)

@@ -549,8 +549,10 @@ class ChatGPTAPI:
        "Device bytes copied committing contiguous prefill KV into pool pages "
        "(zero under paged-native prefill, XOT_PAGED_PREFILL)"),
       ("_unpage_calls", "xot_kv_unpage_total",
-       "Paged-to-contiguous cache gathers (zero when paged speculation keeps "
-       "draft verification native, XOT_PAGED_SPEC)"),
+       "Paged-to-contiguous cache gathers (zero under virtual KV addressing "
+       "unless XOT_PAGED_SPEC=0 restores the legacy fallback)"),
+      ("_defrag_moves", "xot_kv_defrag_moves_total",
+       "Pages migrated by idle-slot pool compaction (XOT_KV_DEFRAG)"),
       ("_oom_count", "xot_oom_recoveries_total",
        "HBM-exhaustion recoveries (engine._free_device_memory invocations)"),
       ("_prefix_evictions", "xot_prefix_evictions_total",
@@ -579,6 +581,9 @@ class ChatGPTAPI:
         ("free_pages", "xot_kv_pool_free_pages", "KV pool pages on the free list"),
         ("peak_pages_in_use", "xot_kv_pool_peak_pages",
          "High-water mark of concurrently referenced KV pool pages"),
+        ("fragmentation", "xot_kv_fragmentation_pages",
+         "Free pages stranded below the pool's highest used page id "
+         "(the holes an idle defrag pass can close)"),
       ):
         if key in stats:
           extra.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n{name} {stats[key]}\n")

@@ -70,6 +70,10 @@ class CostModel:
   dtype_bytes: int = 2
   kv_quant: Optional[str] = None
   tp: int = 1
+  # Absolute index of this shard's first layer: the paged KV read math is
+  # per-LAYER when windows alternate (gemma2), and cfg.layer_window takes
+  # absolute indices. 0 keeps every single-shard construction unchanged.
+  start_layer: int = 0
 
   # ------------------------------------------------------------ weight bytes
 
@@ -265,17 +269,36 @@ class CostModel:
 
   # ---------------------------------------------------------------- KV bytes
 
-  def _kv_token_bytes(self, per_position_scale: bool = True) -> int:
-    """HBM bytes of ONE cached token position (K + V across this shard's
-    layers, scales included under int8 KV)."""
+  def _kv_token_bytes_one_layer(self, per_position_scale: bool = True) -> int:
+    """HBM bytes of ONE cached token position in ONE layer (K + V rows,
+    scale entries included under int8 KV — the arena pairs each int8 page
+    with a per-(position, head) scale page from the same allocator)."""
     cfg = self.cfg
-    per_pos = 2 * self.n_layers * cfg.num_kv_heads  # K and V rows
+    per_pos = 2 * cfg.num_kv_heads  # K and V rows
     if self.kv_quant == "int8":
       b = per_pos * cfg.head_dim  # int8 payload
       if per_position_scale:
         b += per_pos * self.dtype_bytes  # one scale per (position, head)
       return b
     return per_pos * cfg.head_dim * self.dtype_bytes
+
+  def _kv_token_bytes(self, per_position_scale: bool = True) -> int:
+    """HBM bytes of ONE cached token position (K + V across this shard's
+    layers, scales included under int8 KV)."""
+    return self.n_layers * self._kv_token_bytes_one_layer(per_position_scale)
+
+  def _paged_pages_read(self, depth: int, layer_idx: int, page: int) -> int:
+    """Pages one decode step DMAs for one layer at `depth` resident tokens:
+    the windowed kernels clamp their page walk to ceil over the layer's own
+    window ([lo, hi] inclusive, lo = max(depth - w, 0) // page), and the
+    engine's window release means the clamped-out pages aren't even
+    resident. Global layers walk every occupied page. Ground-truth-tested
+    against the arena's actual layout (tests/test_costmodel)."""
+    d = max(int(depth), 1)
+    hi = (d - 1) // page
+    w = self.cfg.layer_window(layer_idx) if self.cfg.uses_sliding_window else 0
+    lo = max(d - w, 0) // page if w > 0 else 0
+    return hi - lo + 1
 
   def kv_resident_bytes(self, alloc_tokens: int, batch: int = 1) -> int:
     """Resident bytes of a contiguous cache allocation
@@ -287,11 +310,15 @@ class CostModel:
     """KV bytes one decode step must stream for one request at `depth`
     resident tokens. Contiguous XLA attention reads the whole ALLOCATED
     buffer (`alloc_tokens`); the paged kernel DMAs only the request's
-    occupied pages (rounded up to page granularity); flash-decode/occupancy
+    occupied pages (rounded up to page granularity, bounded per LAYER by
+    that layer's sliding window — gemma2-style alternation reads full depth
+    on global layers and ~window on sliding ones); flash-decode/occupancy
     paths read ~`depth` (pass alloc_tokens=None, paged=False)."""
     if paged:
-      tokens_read = max(1, math.ceil(max(depth, 1) / page)) * page
-    elif alloc_tokens:
+      per_layer = self._kv_token_bytes_one_layer()
+      return sum(self._paged_pages_read(depth, self.start_layer + i, page)
+                 for i in range(self.n_layers)) * page * per_layer
+    if alloc_tokens:
       tokens_read = alloc_tokens
     else:
       tokens_read = max(depth, 1)

@@ -46,6 +46,8 @@ import numpy as np
 
 from xotorch_tpu.download.shard_download import NoopShardDownloader, ShardDownloader
 from xotorch_tpu.inference.engine import CacheExhausted, InferenceEngine, RequestStateLost
+from xotorch_tpu.inference.jax_engine import vkv
+from xotorch_tpu.inference.jax_engine.vkv import VirtualKV
 from xotorch_tpu.inference.shard import Shard
 from xotorch_tpu.inference.tokenizers import DummyTokenizer, resolve_tokenizer
 from xotorch_tpu.models.config import ModelConfig, config_from_hf_dict, load_model_config
@@ -77,10 +79,12 @@ class _RequestState:
   cache: Any  # device pytree {"k","v"}; None once committed to the page pool
   pos: int  # tokens already resident in this shard's cache
   last_used: float
-  # Paged KV (XOT_PAGED_KV): ordered page ids into the context's PagePool
-  # arena once the request's cache is committed (cache is then None), and
-  # prefix-shared pages held (incref'd) before commit. See _commit_state_to_pages.
-  pages: Optional[list] = None
+  # Paged KV (XOT_PAGED_KV): vkv.VirtualKV — the request's ordered LOGICAL
+  # page handle over the context's PagePool arena (cache is then None).
+  # Slots the sliding window has released are zeroed in place, so
+  # len(pages) stays == pages_for(pos); physical ids resolve per dispatch
+  # via vkv.resolve_page_table. See _commit_state_to_pages / vkv.py.
+  pages: Optional[Any] = None
   paged_seed: Optional[list] = None
   # OpenAI sampling extras (seed / logit_bias / presence+frequency penalties):
   # {"seed": int|None, "bias": [1,V] device array|None, "counts": [1,V] int32
@@ -319,6 +323,18 @@ class _DecodeBatcher:
         # Let the resolved requests' loops ingest tokens and re-submit before
         # the next take, so steady-state batches stay wide.
         await asyncio.sleep(0)
+      # Queues drained — the batcher is idle. Spend the slot on page-pool
+      # compaction: a bounded defrag pass (XOT_KV_DEFRAG) rewrites only the
+      # virtual maps on the executor thread, so it is invisible to requests
+      # and never delays a dispatch that has work queued.
+      if (self.ctx is not None and self.ctx.page_pool is not None
+          and self.engine._defrag_on()
+          and self.ctx.page_pool.fragmentation() > 0):
+        try:
+          await self.engine._run(self.engine._defrag_sync, self.ctx)
+        except Exception as e:
+          if DEBUG >= 1:
+            print(f"idle defrag pass failed (ignored): {e!r}")
     except Exception as e:
       # A failure OUTSIDE the per-group dispatch (whose errors already land
       # on their futures) must fail every affected submitter loudly — both
@@ -413,6 +429,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     # paged request — speculating or not — finishes with this at ZERO
     # (counter-asserted in tests, exported as xot_kv_unpage_total).
     self._unpage_calls = 0
+    # Background defrag (XOT_KV_DEFRAG): pages migrated by idle compaction
+    # passes. Each move is one page's device copy + a host-side rewrite of
+    # every virtual map naming it — exported as xot_kv_defrag_moves_total.
+    self._defrag_moves = 0
     # Requests whose device state was dropped by OOM recovery (bounded LRU):
     # their next touch raises RequestStateLost instead of silently starting
     # over from an empty cache.
@@ -1132,6 +1152,17 @@ class JAXShardInferenceEngine(InferenceEngine):
     the hidden-only executables on a last-layer shard (cache update without
     the unembedding)."""
     import jax.numpy as jnp
+    st = ctx.states.get(request_id)
+    if (self._paged_on() and self._paged_spec_on() and st is not None
+        and st.cache is None and st.pages is not None
+        and getattr(input_data, "ndim", 0) == 2 and input_data.shape[0] == 1
+        and ctx.shard.is_first_layer and ctx.shard.is_last_layer):
+      # Page-backed request on the per-token/segment path (extras decode,
+      # per-token bucket fallback, node-driven rings): forward NATIVE to
+      # the arena instead of gathering pages back to a contiguous buffer.
+      # XOT_PAGED_SPEC=0 restores the legacy unpage-then-contiguous route
+      # (_prep_state below).
+      return self._forward_segment_paged(ctx, request_id, input_data)
     x, true_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
     ring_ok = (ctx.fill_jits is not None and "ring" in ctx.fill_jits
                and state.pos == 0 and x.ndim == 2 and true_t > 1
@@ -1155,6 +1186,38 @@ class JAXShardInferenceEngine(InferenceEngine):
     out, new_cache = forward(ctx.params, x, state.cache, jnp.int32(state.pos))
     state.cache = new_cache
     state.pos += true_t
+    state.last_used = time.monotonic()
+    return out, true_t
+
+  def _forward_segment_paged(self, ctx: _ShardContext, request_id: str, input_data):
+    """Single-segment forward NATIVE to the page arena (models/
+    generate.forward_paged): the page-backed twin of _forward_segment for
+    the per-token and bucket-fallback paths, so requests that leave the
+    fused chunk ladder (sampling extras stepping per token, odd tails)
+    never gather back to a contiguous buffer — _unpage_calls stays 0.
+    Returns (device logits, true_t), same contract as _forward_segment."""
+    import jax.numpy as jnp
+    from xotorch_tpu.models.generate import forward_paged
+    x = self._to_device_input(input_data)
+    true_t = int(x.shape[1])
+    bucket = 1 if true_t == 1 else _bucket(true_t)
+    state = self._prep_state_paged(ctx, request_id, bucket)
+    pool = ctx.page_pool
+    if bucket != true_t:
+      x = jnp.pad(x, [(0, 0), (0, bucket - true_t)])
+    table = self._paged_table_for(ctx, state)
+    out, pool.arena = forward_paged(
+      ctx.params, x, pool.arena, table, jnp.int32(state.pos), ctx.cfg,
+      use_kernel=self._paged_kernel_on(), moe_routed=self._moe_routed_for(ctx),
+      ragged=self._ragged_prefill_on(), start_layer=ctx.shard.start_layer,
+      tp_mesh=self._tp_mesh(ctx))
+    state.pos += true_t
+    # Bucket-overshoot pages hold only padding garbage and are exclusively
+    # ours — back to the pool, then release what the window slid past.
+    freed = state.pages.trim_to(pool.pages_for(state.pos))
+    if freed:
+      pool.decref(freed)
+    self._vkv_window_release(ctx, state)
     state.last_used = time.monotonic()
     return out, true_t
 
@@ -1589,7 +1652,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._check_prefill_continuity(ctx, request_id, expected_pos)
     if paged_native:
       return self._paged_sample_sync(ctx, request_id, input_data, temp, top_k, top_p,
-                                     full_prompt)
+                                     full_prompt, sampling)
     x, seg_t, state, use_flash, use_fd = self._segment_setup(ctx, request_id, input_data)
     if sampling and state.extras is None:
       state.extras = self._build_extras(ctx, sampling)
@@ -1719,13 +1782,12 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _paged_spec_ok(self, ctx: _ShardContext, state: "_RequestState") -> bool:
     """Qualification rule for paged-native draft verification: the request
-    must already live on the page table (cache committed/native, no sampling
-    extras) under a paged-family config, with XOT_PAGED_SPEC on. Everything
-    else takes the contiguous verify (which un-pages a page-backed state
-    via _prep_state — the pre-ragged behavior, kept behind the knob)."""
-    return (self._paged_on() and self._paged_ok(ctx) and self._paged_spec_on()
-            and state.cache is None and state.pages is not None
-            and state.extras is None)
+    must already live on the page table (cache committed/native) with
+    XOT_PAGED_SPEC on. The only remaining fallback is the knob itself —
+    XOT_PAGED_SPEC=0 restores the contiguous verify (which un-pages a
+    page-backed state via _prep_state — the pre-ragged behavior)."""
+    return (self._paged_on() and self._paged_spec_on()
+            and state.cache is None and state.pages is not None)
 
   def _verify_draft_paged_sync(self, ctx: _ShardContext, request_id: str,
                                prev_token: int, draft: list):
@@ -1778,10 +1840,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     # for this verify (the pre-verify invariant is len(pages) ==
     # pages_for(pos), restored here) — shared prefix pages are full pages
     # below pos_before and can never sit in the trimmed tail.
-    keep = pool.pages_for(state.pos)
-    if len(state.pages) > keep:
-      pool.decref(state.pages[keep:])
-      del state.pages[keep:]
+    freed = state.pages.trim_to(pool.pages_for(state.pos))
+    if freed:
+      pool.decref(freed)
+    self._vkv_window_release(ctx, state)
     state.last_used = time.monotonic()
     self._spec_proposed += len(draft)
     self._spec_accepted += n_acc
@@ -1975,7 +2037,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         # touch a contiguous buffer at all.
         state = self._get_or_create_paged_state(ctx, request_id)
         pool.incref(ids)
-        state.pages = ids
+        state.pages = VirtualKV(ids)
         state.pos = consumed
         self._prefix_hits += 1
         self._prefix_tokens_saved += consumed
@@ -2033,7 +2095,7 @@ class JAXShardInferenceEngine(InferenceEngine):
     if key in ctx.prefix_cache:
       ctx.prefix_cache.move_to_end(key)
       return
-    if self._paged_on() and self._paged_ok(ctx) and state.extras is None:
+    if self._paged_on():
       # Paged mode: SHARE the prefill's full pages (incref) instead of
       # snapshotting a whole cache copy — the arena holds one copy of a hot
       # system prompt no matter how many requests and entries reference it.
@@ -2041,9 +2103,6 @@ class JAXShardInferenceEngine(InferenceEngine):
       # land at page index pos // page_size, past every full prefix page,
       # so divergence after the shared prefix is copy-on-write with the
       # "copy" limited to the partial tail page each request already owns.
-      # Extras-bearing requests decode contiguous (_use_paged) — committing
-      # them here would just be unpaged back on their first chunk, so they
-      # take the snapshot branch below instead.
       try:
         pool = self._ensure_page_pool(ctx)
         if state.pages is None:
@@ -2056,7 +2115,11 @@ class JAXShardInferenceEngine(InferenceEngine):
       n_full = T // pool.page_size
       if n_full <= 0:
         return
-      ids = list(state.pages[:n_full])
+      ids = vkv.as_handle(state.pages).prefix_ids(n_full)
+      if ids is None:
+        # A windowed request already released prefix pages back to the pool
+        # — the hole-y virtual map isn't a shareable physical prefix.
+        return
       pool.incref(ids)
       ctx.prefix_cache[key] = (toks, {"pages": ids, "len": n_full * pool.page_size})
     else:
@@ -2204,8 +2267,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       return
     t0 = time.monotonic()
     usable = min(common, entry.length)
-    want_paged = (self._paged_on() and self._paged_ok(ctx)
-                  and set(entry.data) == {"k", "v"})
+    want_paged = (self._paged_on()
+                  and set(entry.data) == self._cache_leaf_names())
     try:
       if set(entry.data) != self._cache_leaf_names() and not want_paged:
         # Spilled under an incompatible cache config (e.g. int8-KV scales
@@ -2224,6 +2287,12 @@ class JAXShardInferenceEngine(InferenceEngine):
             or leaf.shape[0] != pool.arena["k"].shape[0]
             or leaf.shape[3:] != pool.arena["k"].shape[3:]):
           store.drop(ctx.shard, entry.toks)  # torn or config-mismatched
+          return
+        sc = entry.data.get("k_scale")
+        if sc is not None and (sc.shape[0] != pool.arena["k_scale"].shape[0]
+                               or sc.shape[2] < n_full * page
+                               or sc.shape[3:] != pool.arena["k_scale"].shape[3:]):
+          store.drop(ctx.shard, entry.toks)  # scale leaves torn/mismatched
           return
         from xotorch_tpu.inference.jax_engine.paged_cache import scatter_pages
         ids = self._pool_alloc(ctx, pool, n_full)
@@ -3152,20 +3221,23 @@ class JAXShardInferenceEngine(InferenceEngine):
   # tables (models/generate.decode_chunk_paged): batch membership is
   # metadata, appends allocate pages instead of grow-copying, and attention
   # reads only each row's occupied pages. _commit_state_to_pages remains
-  # for requests that still prefill contiguous (sampling extras, hidden
-  # input, XOT_PAGED_PREFILL=0) and counts its copied bytes
-  # (_commit_copy_bytes — zero for the native path). Contiguous remains the
-  # default until on-chip A/B numbers land (scripts/tpu_retry.py `paged` /
-  # `pagedfill` stages).
+  # for requests that still prefill contiguous (hidden input,
+  # XOT_PAGED_PREFILL=0) and counts its copied bytes (_commit_copy_bytes —
+  # zero for the native path). Contiguous remains the default until on-chip
+  # A/B numbers land (scripts/tpu_retry.py `paged` / `vkv` stages).
+  #
+  # VIRTUAL ADDRESSING (vkv.py): requests hold VirtualKV handles — logical
+  # page slots naming physical ids, resolved once per dispatch by the
+  # jit-free vkv.resolve_page_table mapper. Every paged family rides it:
+  # sliding-window configs release out-of-window pages back to the pool as
+  # decode advances (_vkv_window_release; the kernels' windowed _kv_map
+  # clamp bounds the DMA to live pages), int8-KV pairs K/V pages with
+  # per-(position, head) scale pages from the same arena, and idle-slot
+  # defrag (_defrag_sync) migrates pages under live requests by rewriting
+  # only the virtual maps. There is no family gate list anymore.
 
   def _paged_on(self) -> bool:
     return knobs.get_bool("XOT_PAGED_KV")
-
-  def _paged_ok(self, ctx: _ShardContext) -> bool:
-    """Families the paged path serves: sliding-window configs keep the
-    contiguous kernels (the ragged kernel has no window re-map yet), and
-    int8 KV stays contiguous (per-(position, head) scale pages unplumbed)."""
-    return not ctx.cfg.uses_sliding_window and self._kv_quant is None
 
   def _paged_kernel_on(self) -> bool:
     """XOT_PAGED_KERNEL: 1 = force the Pallas ragged kernel (interpret mode
@@ -3201,7 +3273,8 @@ class JAXShardInferenceEngine(InferenceEngine):
         tokens = ctx.max_cache_len + MAX_RESIDENT_REQUESTS * ctx.cache_len
       num_pages = -(-tokens // page) + 1  # +1: reserved scratch page 0
       ctx.page_pool = PagePool(ctx.cfg, ctx.shard.get_layer_count(), num_pages,
-                               page, self._dtype(), mesh=ctx.mesh)
+                               page, self._dtype(), mesh=ctx.mesh,
+                               kv_quant=self._kv_quant is not None)
       if DEBUG >= 1:
         print(f"KV page pool ready: {num_pages - 1} pages x {page} tokens")
     return ctx.page_pool
@@ -3253,19 +3326,23 @@ class JAXShardInferenceEngine(InferenceEngine):
       leaf = pool.arena["k"]  # [L, P, page, Hkv, D]
       self._commit_copy_bytes += (2 * len(fresh) * leaf.shape[0] * leaf.shape[2]
                                   * leaf.shape[3] * leaf.shape[4] * leaf.dtype.itemsize)
-    state.pages = seed + fresh
+      sc = pool.arena.get("k_scale")  # int8 arena: scale pages ride the copy
+      if sc is not None:
+        self._commit_copy_bytes += (2 * len(fresh) * sc.shape[0] * sc.shape[2]
+                                    * sc.shape[3] * sc.dtype.itemsize)
+    state.pages = VirtualKV(seed + fresh)
     state.paged_seed = None
     state.cache = None
 
   def _unpage_state(self, ctx: _ShardContext, state: _RequestState,
                     min_len: int = 0) -> None:
     """Gather a paged request back into a contiguous buffer (the reverse of
-    commit): segment forwards, extras decode, and (under XOT_PAGED_SPEC=0)
-    draft verification assume `state.cache`. The request's pages are
-    released; the next paged chunk re-commits. Cold-path by design —
-    steady-state decode never calls this, and paged-native speculation
-    keeps the verify path off it too (xot_kv_unpage_total counts every
-    invocation; the paged tests assert it stays 0)."""
+    commit). Since virtual KV addressing this is a LEGACY path: segment
+    forwards, per-token decode, and extras all stay on pages
+    (_forward_segment_paged / decode_chunk_paged extras), so only
+    XOT_PAGED_SPEC=0 — the explicit restore-the-old-fallbacks knob — can
+    reach it (xot_kv_unpage_total counts every invocation; the paged tests
+    assert it stays 0 suite-wide)."""
     import jax
     from xotorch_tpu.inference.jax_engine.paged_cache import gather_pages
     self._unpage_calls += 1
@@ -3282,7 +3359,10 @@ class JAXShardInferenceEngine(InferenceEngine):
       state.cache = cache
       state.pages = None
       return
-    gathered = gather_pages(pool.arena, np.asarray(state.pages, np.int32))
+    # Released (windowed) slots resolve to the scratch page: its zeros
+    # gather into dead positions no query can see (the legacy path only
+    # serves non-windowed configs anyway).
+    gathered = gather_pages(pool.arena, np.asarray(list(state.pages), np.int32))
     cut = min(len(state.pages) * pool.page_size, length)
     state.cache = {
       name: jax.lax.dynamic_update_slice(
@@ -3290,7 +3370,7 @@ class JAXShardInferenceEngine(InferenceEngine):
         (0,) * cache[name].ndim)
       for name in cache
     }
-    pool.decref(state.pages)
+    pool.decref(vkv.as_handle(state.pages).live())
     state.pages = None
 
   # ------------------------------------------------- paged-NATIVE prefill
@@ -3303,21 +3383,20 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _paged_prefill_ok(self, ctx: _ShardContext, request_id: str, input_data,
                         sampling: Optional[dict]) -> bool:
-    """Qualification rule for paged-native prefill: the paged families only
-    (no sliding window / int8 KV — _paged_ok), token input on a full-model
-    shard (mid-ring shards see hidden states), batch 1, no sampling extras
-    (extras decode contiguous per _use_paged — native-paging them would
-    just be unpaged back on their first chunk), no sp ring prefill (which
-    shards positions over chips and outranks), and a state that is either
-    fresh or already page-backed (a contiguous state keeps its path)."""
-    if not (self._paged_on() and self._paged_ok(ctx) and self._paged_prefill_on()
-            and not sampling
+    """Qualification rule for paged-native prefill: token input on a
+    full-model shard (mid-ring shards see hidden states), batch 1, no sp
+    ring prefill (which shards positions over chips and outranks), and a
+    state that is either fresh or already page-backed (a contiguous state
+    keeps its path). Sampling extras qualify — forward_sample threads them
+    alongside the page table, and the request then decodes paged too
+    (decode_chunk_paged extras), so it never leaves the arena."""
+    if not (self._paged_on() and self._paged_prefill_on()
             and ctx.shard.is_first_layer and ctx.shard.is_last_layer
             and getattr(input_data, "ndim", 0) == 2 and input_data.shape[0] == 1
             and not (ctx.fill_jits is not None and "ring" in ctx.fill_jits)):
       return False
     st = ctx.states.get(request_id)
-    return st is None or (st.cache is None and st.pages is not None and st.extras is None)
+    return st is None or (st.cache is None and st.pages is not None)
 
   def _get_or_create_paged_state(self, ctx: _ShardContext, request_id: str) -> _RequestState:
     """Page-backed twin of _get_or_create_state: the state NEVER owns a
@@ -3328,7 +3407,8 @@ class JAXShardInferenceEngine(InferenceEngine):
       if request_id in self._states_lost_to_oom:
         raise RequestStateLost(
           f"request {request_id}: device state dropped by OOM recovery")
-      state = _RequestState(cache=None, pos=0, last_used=time.monotonic(), pages=[])
+      state = _RequestState(cache=None, pos=0, last_used=time.monotonic(),
+                            pages=VirtualKV())
       ctx.states[request_id] = state
       while len(ctx.states) > MAX_RESIDENT_REQUESTS:
         evicted, est = ctx.states.popitem(last=False)
@@ -3392,11 +3472,12 @@ class JAXShardInferenceEngine(InferenceEngine):
   def _paged_table_for(self, ctx: _ShardContext, state: _RequestState):
     """The request's [1, maxp] device page table, width bucketed to a power
     of two (0-padded — the scratch page, masked) so the prefill executables
-    stay logarithmic in context length."""
+    stay logarithmic in context length. Physical resolution of the virtual
+    handle happens HERE, once per dispatch (vkv.resolve_page_table):
+    window-released slots resolve to scratch and the kernels' windowed
+    clamp never reads them."""
     maxp = _bucket(max(len(state.pages), 1), 1)
-    table = np.zeros((1, maxp), np.int32)
-    table[0, :len(state.pages)] = state.pages
-    return self._device_table(ctx, table)
+    return self._device_table(ctx, vkv.resolve_page_table([state.pages], maxp))
 
   def _paged_fill_sync(self, ctx: _ShardContext, request_id: str, input_data) -> None:
     """Fill-only paged-native prefill of `input_data` (length a multiple of
@@ -3421,15 +3502,22 @@ class JAXShardInferenceEngine(InferenceEngine):
         page_table=table, paged_kernel=use_kernel,
         ragged_prefill=self._ragged_prefill_on(), tp_mesh=self._tp_mesh(ctx))
       state.pos += g * chunk
+    # Long windowed prompts free their dead head DURING prefill: later
+    # segments' queries sit at >= pos, so pages the window slid past are
+    # already invisible to every remaining read.
+    self._vkv_window_release(ctx, state)
     state.last_used = time.monotonic()
 
   def _paged_sample_sync(self, ctx: _ShardContext, request_id: str, input_data,
                          temp: float, top_k: int, top_p: float,
-                         full_prompt: Optional[np.ndarray]) -> int:
+                         full_prompt: Optional[np.ndarray],
+                         sampling: Optional[dict] = None) -> int:
     """Final paged-native prefill segment + ON-DEVICE first-token sampling:
     forward_sample over the page arena. After the prompt lands the request
     is ALREADY page-resident — its first decode chunk is pure metadata
-    (no _commit_state_to_pages copy, no freed buffer)."""
+    (no _commit_state_to_pages copy, no freed buffer). Sampling extras
+    (bias/penalties/min-p/logprobs) thread through the same executable the
+    contiguous epilogue uses — extras requests stay paged end to end."""
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import forward_sample
     true_t = int(input_data.shape[1])
@@ -3440,26 +3528,45 @@ class JAXShardInferenceEngine(InferenceEngine):
     if bucket != true_t:
       x = jnp.pad(x, [(0, 0), (0, bucket - true_t)])
     table = self._paged_table_for(ctx, state)
-    key = self._extras_key(state, None, request_id=request_id,
+    if sampling and state.extras is None:
+      state.extras = self._build_extras(ctx, sampling)
+    extras = state.extras
+    key = self._extras_key(state, extras, request_id=request_id,
                            sample_pos=state.pos + true_t - 1)
-    tok, pool.arena = forward_sample(
+    e = extras or {}
+    want_lp = e.get("logprobs")
+    out, pool.arena = forward_sample(
       ctx.params, x, pool.arena, jnp.int32(state.pos), jnp.int32(true_t - 1), key,
       ctx.cfg, True, temp, top_k, top_p,
       start_layer=ctx.shard.start_layer, moe_routed=self._moe_routed_for(ctx),
+      bias=e.get("bias"), counts=e.get("counts"),
+      presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+      min_p=e.get("min_p"),
+      top_lp=-1 if want_lp is None else int(want_lp),
       page_table=table, paged_kernel=self._paged_kernel_on(),
       ragged_prefill=self._ragged_prefill_on(), tp_mesh=self._tp_mesh(ctx))
+    if want_lp is not None:
+      tok, lp, top_ids, top_lps = out
+      self._record_logprobs(request_id, np.asarray(lp), np.asarray(top_ids),
+                            np.asarray(top_lps))
+    else:
+      tok = out
     state.pos += true_t
     # Trim the padded bucket's overshoot: pages past pages_for(pos) hold
     # only padding garbage and are exclusively ours (fresh-allocated; the
-    # shared prefix sits below pos) — return them to the pool.
-    keep = pool.pages_for(state.pos)
-    if len(state.pages) > keep:
-      pool.decref(state.pages[keep:])
-      del state.pages[keep:]
+    # shared prefix sits below pos) — return them to the pool. Then release
+    # whatever the window already slid past.
+    freed = state.pages.trim_to(pool.pages_for(state.pos))
+    if freed:
+      pool.decref(freed)
+    self._vkv_window_release(ctx, state)
     state.last_used = time.monotonic()
     if full_prompt is not None:
       self._prefix_store(ctx, request_id, full_prompt)
-    return int(np.asarray(tok).reshape(-1)[0])
+    tok_int = int(np.asarray(tok).reshape(-1)[0])
+    if extras and extras.get("counts") is not None:
+      extras["counts"] = extras["counts"].at[0, tok_int % ctx.cfg.vocab_size].add(1)
+    return tok_int
 
   def page_pool_stats(self) -> Optional[Dict[str, int]]:
     """Aggregate page-pool occupancy across resident contexts, or None when
@@ -3469,7 +3576,9 @@ class JAXShardInferenceEngine(InferenceEngine):
       return None
     return {"pages_in_use": sum(p.pages_in_use for p in pools),
             "free_pages": sum(p.free_pages for p in pools),
-            "peak_pages_in_use": sum(p.peak_pages_in_use for p in pools)}
+            "peak_pages_in_use": sum(p.peak_pages_in_use for p in pools),
+            "fragmentation": sum(p.fragmentation() for p in pools),
+            "defrag_moves": self._defrag_moves}
 
   def _release_state_pages(self, ctx: _ShardContext, state: _RequestState) -> None:
     """Drop a finished/evicted request's page references (committed table
@@ -3479,11 +3588,86 @@ class JAXShardInferenceEngine(InferenceEngine):
     if pool is None:
       return
     if state.pages is not None:
-      pool.decref(state.pages)
+      pool.decref(vkv.as_handle(state.pages).live())
       state.pages = None
     if state.paged_seed:
       pool.decref(state.paged_seed)
       state.paged_seed = None
+
+  def _vkv_window_release(self, ctx: _ShardContext, state: _RequestState) -> None:
+    """Sliding-window page reclamation: once EVERY layer this shard serves
+    is windowed, pages wholly behind the widest window can never be read
+    again (queries only advance) — zero their virtual slots and return the
+    physical pages to the pool while the request keeps decoding. The
+    virtual map keeps its length (the len(pages) == pages_for(pos)
+    arithmetic everywhere is untouched); released slots resolve to the
+    scratch page, which the kernels' windowed clamp never DMAs. One
+    global-attention layer in the shard (gemma2-style alternation) disables
+    freeing entirely — its reads reach back to position 0."""
+    pool = ctx.page_pool
+    if pool is None or not isinstance(state.pages, VirtualKV):
+      return
+    w = vkv.freeable_window(ctx.cfg, ctx.shard.start_layer,
+                            ctx.shard.get_layer_count())
+    if w <= 0:
+      return
+    freed = state.pages.release_below(
+      vkv.dead_page_count(state.pos, w, pool.page_size))
+    if freed:
+      pool.decref(freed)
+      if self.flight is not None:
+        self.flight.record("vkv.window_free", None, pages=len(freed),
+                           pos=state.pos, window=w)
+
+  def _defrag_on(self) -> bool:
+    """XOT_KV_DEFRAG: compact the page pool in batcher-idle slots (window
+    release and request churn strand free holes below the high-water mark;
+    compaction keeps long-lived arenas dense without touching requests)."""
+    return knobs.get_bool("XOT_KV_DEFRAG")
+
+  def _defrag_max_moves(self) -> int:
+    try:
+      return max(1, knobs.get_int("XOT_KV_DEFRAG_MAX_MOVES"))
+    except ValueError:
+      return 8
+
+  def _defrag_sync(self, ctx: _ShardContext, max_moves: Optional[int] = None) -> int:
+    """One bounded compaction pass (executor thread, batcher-idle slots):
+    migrate the highest used pages into the lowest free holes with ONE
+    donated gather-scatter, then rewrite only the VIRTUAL maps — every
+    holder of a physical id (request handles, uncommitted prefix seeds,
+    prefix-cache entries) renames src -> dst; no request state, position,
+    or cache byte changes meaning. Returns pages moved. Requests in flight
+    are safe by construction: tables are resolved fresh from the handles at
+    every dispatch, and the executor serializes this pass against them."""
+    pool = ctx.page_pool
+    if pool is None:
+      return 0
+    plan = pool.defrag_plan(max_moves if max_moves is not None
+                            else self._defrag_max_moves())
+    if not plan:
+      return 0
+    from xotorch_tpu.inference.jax_engine.paged_cache import migrate_pages
+    srcs = [s for s, _ in plan]
+    dsts = [d for _, d in plan]
+    pool.arena = migrate_pages(pool.arena, srcs, dsts)
+    mapping = {s: d for s, d in plan}
+    for st in ctx.states.values():
+      if isinstance(st.pages, VirtualKV):
+        st.pages.remap(mapping)
+      elif st.pages is not None:
+        st.pages = VirtualKV(vkv.remap_ids(st.pages, mapping))
+      if st.paged_seed:
+        st.paged_seed = vkv.remap_ids(st.paged_seed, mapping)
+    for _, entry in ctx.prefix_cache.values():
+      if isinstance(entry, dict) and "pages" in entry:
+        entry["pages"] = vkv.remap_ids(entry["pages"], mapping)
+    pool.apply_moves(plan)
+    self._defrag_moves += len(plan)
+    if self.flight is not None:
+      self.flight.record("vkv.defrag", None, moves=len(plan),
+                         fragmentation=pool.fragmentation())
+    return len(plan)
 
   def _clear_prefix_cache(self, ctx: _ShardContext) -> None:
     """Drop every prefix entry, returning paged entries' page references to
@@ -3502,12 +3686,12 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _use_paged(self, ctx: _ShardContext, items: list) -> bool:
     """One qualification rule for routing a decode dispatch to the paged
-    path. Requests with sampling extras decode contiguous (their in-chunk
-    counts/logprob plumbing isn't wired through the paged executable) —
-    they never commit, so the split is stable per request."""
-    if not (self._paged_on() and self._paged_ok(ctx)):
-      return False
-    return all(it[1].extras is None for it in items)
+    path: XOT_PAGED_KV decides, full stop — every family (sliding window,
+    int8 KV, sampling extras) is paged-servable under virtual addressing.
+    Extras members run as their own single-row dispatches inside
+    _decode_batch_paged_sync (their bias/counts plumbing is per-request),
+    but they never leave the arena."""
+    return self._paged_on()
 
   def _decode_batch_paged_sync(self, ctx: _ShardContext, items: list, num_tokens: int,
                                top_k: int, top_p: float = 0.0) -> list:
@@ -3516,12 +3700,26 @@ class JAXShardInferenceEngine(InferenceEngine):
     decode_chunk_paged dispatch indexing the shared arena — no cache
     stack/split, no common-length growth, no grow-copies. The page-table
     width is bucketed to a power of two so executables stay logarithmic in
-    the longest resident context."""
-    import jax
+    the longest resident context. Sampling extras thread through the same
+    executable when the dispatch is a single row (their bias/counts are
+    per-request [1, V] state) — a mixed batch splits extras members into
+    their own rows first, so NOBODY leaves the arena."""
     import jax.numpy as jnp
     from xotorch_tpu.models.generate import decode_chunk_paged
     pool = self._ensure_page_pool(ctx)
     states = [it[1] for it in items]
+    if len(items) > 1 and any(s.extras is not None for s in states):
+      by_rid: Dict[str, Any] = {}
+      plain = [it for it in items if it[1].extras is None]
+      if plain:
+        for it, r in zip(plain, self._decode_batch_paged_sync(
+            ctx, plain, num_tokens, top_k, top_p)):
+          by_rid[it[0]] = r
+      for it in items:
+        if it[1].extras is not None:
+          by_rid[it[0]] = self._decode_batch_paged_sync(
+            ctx, [it], num_tokens, top_k, top_p)[0]
+      return [by_rid[it[0]] for it in items]
     for it in items:
       # Any leftover speculation records belong to the contiguous path —
       # supersede them before touching positions.
@@ -3547,24 +3745,40 @@ class JAXShardInferenceEngine(InferenceEngine):
         state.pages.extend(self._pool_alloc(ctx, pool, need - len(state.pages)))
     B = len(states)
     maxp = _bucket(max(len(s.pages) for s in states), 1)
-    table = np.zeros((B, maxp), np.int32)  # 0-padded: the scratch page, masked
-    for i, s in enumerate(states):
-      table[i, :len(s.pages)] = s.pages
+    # The once-per-dispatch physical resolution of every member's virtual
+    # handle (0-padded: the scratch page, masked / window-clamped).
+    table = vkv.resolve_page_table([s.pages for s in states], maxp)
     B_pad = _bucket(B, 1)
     pos_vec = jnp.asarray([s.pos for s in states], jnp.int32)
     temps = jnp.asarray([float(it[4]) for it in items], jnp.float32)
     toks = jnp.asarray([[int(it[2])] for it in items], jnp.int32)
-    self._sample_calls += 1
-    key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._sample_calls)
-    out, pool.arena = decode_chunk_paged(
+    extras = states[0].extras if B == 1 else None
+    e = extras or {}
+    want_lp = e.get("logprobs")
+    key = self._extras_key(states[0], extras, request_id=items[0][0])
+    res = list(decode_chunk_paged(
       ctx.params, pool.arena, self._device_table(ctx, table), toks, pos_vec, key, ctx.cfg,
       num_tokens, temps, top_k, top_p, use_kernel=self._paged_kernel_on(),
       pad_rows=B_pad - B, moe_routed=self._moe_routed_for(ctx),
-      tp_mesh=self._tp_mesh(ctx))
+      bias=e.get("bias"), counts=e.get("counts"),
+      presence=e.get("presence", 0.0), frequency=e.get("frequency", 0.0),
+      top_lp=-1 if want_lp is None else int(want_lp),
+      min_p=e.get("min_p"),
+      tp_mesh=self._tp_mesh(ctx)))
+    out, pool.arena = res[0], res[1]
+    idx = 2
+    if e.get("counts") is not None:
+      extras["counts"] = res[idx]
+      idx += 1
+    if want_lp is not None:
+      lp, top_ids, top_lps = res[idx]
+      self._record_logprobs(items[0][0], np.asarray(lp[0]), np.asarray(top_ids[0]),
+                            np.asarray(top_lps[0]))
     out_np = np.asarray(out)
     now = time.monotonic()
     for state in states:
       state.pos += num_tokens
+      self._vkv_window_release(ctx, state)
       state.last_used = now
     return [out_np[i].astype(np.int64) for i in range(B)]
 
@@ -3896,7 +4110,7 @@ class JAXShardInferenceEngine(InferenceEngine):
       cfg=cfg, n_layers=shard.get_layer_count(),
       is_first=shard.is_first_layer, is_last=shard.is_last_layer,
       quantize=self._quantize, dtype_bytes=dtype_width(self._dtype_name),
-      kv_quant=self._kv_quant,
+      kv_quant=self._kv_quant, start_layer=shard.start_layer,
       # Mesh-aware roofline: per-device byte/FLOP math divides by the tp
       # width the params/caches were actually placed with.
       tp=(int(mesh.shape["tp"])

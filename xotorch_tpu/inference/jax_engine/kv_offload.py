@@ -22,7 +22,12 @@ force at spill time. The store itself never touches the device: the engine
 does the D2H gather on spill and the H2D scatter on restore
 (engine._spill_prefix_entry / engine._host_promote), and the restore rides
 the _DecodeBatcher prefill lane so co-resident decode never stalls on the
-copy.
+copy. The paged restore is ZERO-COPY on device: host rows scatter straight
+into freshly allocated pool pages (scatter_pages), never through a
+contiguous device intermediate — engine._commit_copy_bytes stays 0 across
+a promotion, counter-asserted in tests/test_vkv.py. int8-KV entries carry
+their per-(position, head) scale leaves (k_scale/v_scale) through the same
+canonical layout, so a quantized prefix promotes byte-exactly too.
 
 Integrity over availability: entries are inserted atomically under the
 lock (a reader can never observe a torn entry), `match` only reports the

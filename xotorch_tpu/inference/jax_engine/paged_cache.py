@@ -50,12 +50,23 @@ class PagePool:
   thread, so no locking is needed (same discipline as _RequestState)."""
 
   def __init__(self, cfg, num_layers: int, num_pages: int, page_size: int, dtype,
-               mesh=None):
+               mesh=None, kv_quant: bool = False):
     import jax.numpy as jnp
     if num_pages < 2:
       raise ValueError(f"page pool needs >= 2 pages (1 scratch + 1 usable), got {num_pages}")
     shape = (num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
-    self.arena: Dict[str, Any] = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if kv_quant:
+      # int8 arena: K/V pages pair with per-(position, head) SCALE pages
+      # from the same allocator — a page id indexes payload and scales
+      # alike, so the virtual map stays one list. Scale leaves are rank 4
+      # ([L, P, page, Hkv], Hkv at index 3) — the same cache_spec rule that
+      # shards contiguous int8 scale buffers over 'tp' applies unchanged.
+      self.arena: Dict[str, Any] = {
+        "k": jnp.zeros(shape, jnp.int8), "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(shape[:-1], dtype), "v_scale": jnp.zeros(shape[:-1], dtype),
+      }
+    else:
+      self.arena = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
     if mesh is not None:
       from xotorch_tpu.parallel.mesh import shard_cache
       self.arena = shard_cache(self.arena, mesh)
@@ -124,6 +135,53 @@ class PagePool:
       self._ref[p] -= 1
       if self._ref[p] == 0:
         self._free.append(int(p))
+
+  # ------------------------------------------------------------ defrag plan
+
+  def fragmentation(self) -> int:
+    """Free pages stranded BELOW the highest used page id — the holes a
+    compaction pass could close. 0 means the used set is a dense prefix
+    (nothing to do). Exported as xot_kv_fragmentation_pages."""
+    used = np.nonzero(self._ref[1:] > 0)[0]
+    if used.size == 0:
+      return 0
+    hi = int(used[-1]) + 1  # highest used id (offset for the scratch slice)
+    return sum(1 for p in self._free if p < hi)
+
+  def defrag_plan(self, max_moves: int) -> List[tuple]:
+    """(src, dst) migration pairs that compact the used set downward:
+    highest used pages move into the lowest free holes, stopping when the
+    sets cross (or max_moves). Pure bookkeeping — the device copy and the
+    virtual-map rewrite are the engine's job (engine._defrag_sync)."""
+    if max_moves <= 0 or not self._free:
+      return []
+    used = sorted((int(p) for p in np.nonzero(self._ref[1:] > 0)[0] + 1),
+                  reverse=True)
+    holes = sorted(self._free)
+    moves = []
+    for src, dst in zip(used, holes):
+      if src <= dst or len(moves) >= max_moves:
+        break
+      moves.append((src, dst))
+    return moves
+
+  def apply_moves(self, moves) -> None:
+    """Commit a defrag migration's allocator state: refcounts transfer
+    src -> dst, sources return to the free list. Call only AFTER the device
+    copy (migrate_pages) and the virtual-map rewrite have both landed."""
+    if not moves:
+      return
+    srcs = {int(s) for s, _ in moves}
+    dsts = {int(d) for _, d in moves}
+    for src, dst in moves:
+      if self._ref[src] <= 0:
+        raise AssertionError(f"defrag move from free page {src}")
+      if self._ref[dst] != 0:
+        raise AssertionError(f"defrag move into used page {dst}")
+      self._ref[dst] = self._ref[src]
+      self._ref[src] = 0
+    # Pop-from-the-end ascending order, same as __init__.
+    self._free = sorted((set(self._free) - dsts) | srcs, reverse=True)
 
 
 # --------------------------------------------------------------- device ops
@@ -229,6 +287,34 @@ def _scatter_jit():
 
     fn = _JITS["scatter"] = jax.jit(scatter, donate_argnames=("arena",))
   return fn
+
+
+def _migrate_jit():
+  fn = _JITS.get("migrate")
+  if fn is None:
+    import jax
+
+    def migrate(arena, src_ids, dst_ids):
+      # Gather-then-scatter inside one donated program: XLA aliases the
+      # arena in place, so a defrag pass costs one fused copy of the moved
+      # pages, never a second arena.
+      return {name: buf.at[:, dst_ids].set(buf[:, src_ids])
+              for name, buf in arena.items()}
+
+    fn = _JITS["migrate"] = jax.jit(migrate, donate_argnames=("arena",))
+  return fn
+
+
+def migrate_pages(arena: Dict[str, Any], src_ids, dst_ids) -> Dict[str, Any]:
+  """Copy pages `src_ids` over pages `dst_ids` (defrag compaction). The
+  caller rewrites the virtual maps + allocator state (PagePool.apply_moves)
+  once this returns; until then both copies are live and every in-flight
+  table still resolves. Returns the updated arena (input donated)."""
+  import jax.numpy as jnp
+  if int(np.asarray(src_ids).shape[0]) == 0:
+    return arena
+  return _migrate_jit()(arena, jnp.asarray(src_ids, jnp.int32),
+                        jnp.asarray(dst_ids, jnp.int32))
 
 
 def scatter_pages(arena: Dict[str, Any], host_kv: Dict[str, np.ndarray],

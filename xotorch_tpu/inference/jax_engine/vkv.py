@@ -1,0 +1,164 @@
+"""Virtual KV addressing: logical page handles over the physical arena.
+
+vTensor-style indirection (arXiv 2407.15309): requests hold a VirtualKV —
+an ordered list of LOGICAL page slots, each naming a physical page id in
+the PagePool arena — and compute never consumes physical ids directly.
+Every dispatch resolves handles into a [B, max_pages] int32 table with
+`resolve_page_table` (plain numpy, jit-free: the table is traced DATA, so
+remapping pages under a request — window release, defrag migration, host
+promotion into arbitrary free pages — never retraces an executable).
+
+Slot value 0 is the pool's reserved scratch page and doubles as the
+"released" sentinel: when a sliding window slides past a page, the slot is
+zeroed in place and the physical page decrefs back to the pool. Keeping
+released slots in the list (instead of popping them) preserves the
+engine's `len(handle) == pages_for(pos)` arithmetic everywhere — position
+p still lives at logical slot p // page_size — while the kernels' windowed
+`_kv_map` clamp guarantees dead slots are never DMA'd (padded clip rows
+read the scratch page, which is masked).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+def freeable_window(cfg, start_layer: int, n_layers: int) -> int:
+  """Largest window such that positions <= pos - w are dead for EVERY
+  layer of this shard — 0 when any layer attends globally (gemma2's
+  alternating layers: nothing frees, the kernels still bound DMA per
+  layer). Pages below this bound decref back to the pool as decode
+  advances."""
+  if not cfg.uses_sliding_window:
+    return 0
+  windows = [cfg.layer_window(start_layer + i) for i in range(n_layers)]
+  if any(w <= 0 for w in windows):
+    return 0
+  return max(windows)
+
+
+def dead_page_count(pos: int, window: int, page_size: int) -> int:
+  """Number of leading FULLY-dead logical pages once the next query sits
+  at absolute position `pos`: a page is dead when its last position is
+  <= pos - window (invisible to every query >= pos, and queries only
+  advance). Never reaches the page holding position `pos` itself, so the
+  current write page is always live."""
+  if window <= 0:
+    return 0
+  return max(0, int(pos) - int(window) + 1) // int(page_size)
+
+
+class VirtualKV:
+  """Logical block list + window base for one paged request.
+
+  blocks[i] is the physical page backing logical page i (0 = released).
+  `base` counts the leading released slots — the window-rotated view the
+  ISSUE's mapper exposes: everything below `base` resolves to scratch.
+  """
+
+  __slots__ = ("blocks", "base")
+
+  def __init__(self, blocks: Optional[Iterable[int]] = None, base: int = 0):
+    self.blocks: List[int] = [int(b) for b in blocks] if blocks is not None else []
+    self.base = int(base)
+
+  # -- list-compatible surface (engine arithmetic: len == pages_for(pos)) --
+  def __len__(self) -> int:
+    return len(self.blocks)
+
+  def __iter__(self) -> Iterator[int]:
+    return iter(self.blocks)
+
+  def __getitem__(self, idx):
+    return self.blocks[idx]
+
+  def __eq__(self, other) -> bool:
+    """Equal to another handle with the same slots+base, or to a plain
+    sequence with the same slots (the drop-in contract: code that snapshots
+    `list(state.pages)` must compare equal when nothing changed)."""
+    if isinstance(other, VirtualKV):
+      return self.blocks == other.blocks and self.base == other.base
+    if isinstance(other, (list, tuple)):
+      return self.blocks == [int(b) for b in other]
+    return NotImplemented
+
+  __hash__ = None  # mutable, like the list it replaces
+
+  def __repr__(self) -> str:
+    return f"VirtualKV(blocks={self.blocks!r}, base={self.base})"
+
+  def append(self, page_id: int) -> None:
+    self.blocks.append(int(page_id))
+
+  def extend(self, page_ids: Iterable[int]) -> None:
+    self.blocks.extend(int(p) for p in page_ids)
+
+  # -- virtual-addressing operations -------------------------------------
+  def live(self) -> List[int]:
+    """Physical ids this handle still holds a reference to."""
+    return [p for p in self.blocks if p != 0]
+
+  def trim_to(self, n_slots: int) -> List[int]:
+    """Drop logical slots past n_slots (speculative-overshoot rollback),
+    returning the live physical ids released. Tail slots are always live
+    (the window only kills the head)."""
+    if n_slots >= len(self.blocks):
+      return []
+    freed = [p for p in self.blocks[n_slots:] if p != 0]
+    del self.blocks[n_slots:]
+    return freed
+
+  def release_below(self, dead_slots: int) -> List[int]:
+    """Zero slots [base, dead_slots) — the window slid past them — and
+    return the physical ids to decref. Idempotent per slot."""
+    dead_slots = min(int(dead_slots), len(self.blocks))
+    if dead_slots <= self.base:
+      return []
+    freed = [p for p in self.blocks[self.base:dead_slots] if p != 0]
+    for i in range(self.base, dead_slots):
+      self.blocks[i] = 0
+    self.base = dead_slots
+    return freed
+
+  def prefix_ids(self, n_slots: int) -> Optional[List[int]]:
+    """First n logical pages as physical ids — None when the window has
+    already punched holes in that range (a windowed cache is not a
+    sharable prefix: its head pages are gone by construction)."""
+    if self.base > 0 or n_slots > len(self.blocks):
+      return None
+    ids = self.blocks[:n_slots]
+    return None if any(p == 0 for p in ids) else list(ids)
+
+  def remap(self, mapping: Dict[int, int]) -> int:
+    """Rewrite physical ids per a defrag migration map. Returns the number
+    of slots rewritten. Slot 0 (released) never remaps."""
+    n = 0
+    for i, p in enumerate(self.blocks):
+      if p != 0 and p in mapping:
+        self.blocks[i] = int(mapping[p])
+        n += 1
+    return n
+
+
+def as_handle(pages) -> VirtualKV:
+  """Adopt a plain id list (prefix snapshots, host promotion) as a handle."""
+  return pages if isinstance(pages, VirtualKV) else VirtualKV(pages)
+
+
+def remap_ids(ids: Sequence[int], mapping: Dict[int, int]) -> List[int]:
+  """Defrag-rewrite a plain physical id list (prefix entries, paged seeds)."""
+  return [int(mapping.get(int(p), int(p))) for p in ids]
+
+
+def resolve_page_table(handles: Sequence[Sequence[int]], width: int) -> np.ndarray:
+  """The once-per-dispatch physical resolution: [B, width] int32, one row
+  per handle, unused slots on the scratch page. Accepts VirtualKV handles
+  or plain id lists (released slots are already 0 in the handle)."""
+  table = np.zeros((len(handles), int(width)), np.int32)
+  for row, h in enumerate(handles):
+    blocks = h.blocks if isinstance(h, VirtualKV) else list(h)
+    n = min(len(blocks), table.shape[1])
+    if n:
+      table[row, :n] = np.asarray(blocks[:n], np.int32)
+  return table

@@ -456,8 +456,40 @@ def decode_chunk_ring_batched(
 
 @partial(
   jax.jit,
+  static_argnames=("cfg", "use_kernel", "moe_routed", "ragged", "start_layer", "tp_mesh"),
+  donate_argnames=("arena",),
+)
+def forward_paged(
+  params,
+  x: jnp.ndarray,  # [B, T] int32 tokens (T == 1 per-token decode, T > 1 segment)
+  arena: Dict[str, jnp.ndarray],  # shared page arena: [L, P, page, Hkv, D] leaves
+  page_table: jnp.ndarray,  # [B, max_pages] int32 physical page ids (0-padded)
+  start_pos: jnp.ndarray,  # scalar (or [B]) int32 position of x[:, 0]
+  cfg: ModelConfig,
+  use_kernel: bool = False,  # static: Pallas ragged kernel vs XLA gather
+  moe_routed: bool = True,
+  ragged: bool = True,  # static: kernel path reads pages natively (no gather)
+  start_layer: int = 0,
+  tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
+):
+  """Full-logits forward over the PAGED arena — the vkv-backed per-token
+  step. The contiguous per-token fallbacks (sampling extras mid-stream,
+  non-bucket chunk tails) used to un-page the whole cache just to run
+  forward_jit; this is the same forward with the K/V scattering into the
+  request's pages instead, so those paths stay paged (zero
+  xot_kv_unpage_total). Returns ([B, T, vocab] fp32 logits, updated
+  arena)."""
+  return forward_shard(params, x, arena, start_pos, cfg=cfg, is_first=True,
+                       is_last=True, moe_routed=moe_routed,
+                       start_layer=start_layer, page_table=page_table,
+                       paged_kernel=use_kernel, ragged_prefill=ragged,
+                       tp_mesh=tp_mesh)
+
+
+@partial(
+  jax.jit,
   static_argnames=("cfg", "num_tokens", "top_k", "top_p", "use_kernel", "pad_rows",
-                   "moe_routed", "tp_mesh"),
+                   "moe_routed", "top_lp", "tp_mesh"),
   donate_argnames=("arena",),
 )
 def decode_chunk_paged(
@@ -475,6 +507,12 @@ def decode_chunk_paged(
   use_kernel: bool = False,  # static: Pallas ragged kernel vs XLA gather
   pad_rows: int = 0,  # static: dummy rows padding B to a power of two
   moe_routed: bool = True,
+  bias: jnp.ndarray = None,  # [B, V] OpenAI logit_bias
+  counts: jnp.ndarray = None,  # [B, V] token counts; updated INSIDE the scan
+  presence: float = 0.0,
+  frequency: float = 0.0,
+  top_lp: int = -1,  # static: -1 = no logprob reporting; >=0 = report
+  min_p=None,  # min-p cutoff (traced; None = off) — ops/sampling
   tp_mesh=None,  # static Mesh: tensor-parallel activation constraints
 ):
   """Batched fused decode over the PAGED KV pool, ONE executable end to end.
@@ -486,33 +524,71 @@ def decode_chunk_paged(
   and reads stop at each row's own occupied pages (ops/paged_attention) —
   no per-chunk stack/split, no common-length growth, no grow-copies.
 
+  Sampling extras (logit bias, presence/frequency penalties with counts
+  riding the scan carry, min-p, logprob reporting) mirror decode_chunk's
+  contract exactly — they're what used to force an extras-bearing request
+  OFF its pages. All default off, so the plain executables are unchanged.
+
   Dummy pad rows carry an all-zero page table: their writes land in the
   pool's reserved scratch page 0 (never allocated to a request) and their
   outputs are discarded — same log2(max batch) executable bounding as the
   contiguous batched path, without donating a real buffer twice. Returns
-  ([B_real, num_tokens] int32 tokens, updated arena).
-  """
+  ([B_real, num_tokens] int32 tokens, updated arena) — plus the updated
+  counts when `counts` is passed, plus the logprob triple when
+  `top_lp >= 0` (decode_chunk's ordering)."""
   B = toks.shape[0]
+  track_counts = counts is not None
+  want_lp = top_lp >= 0
   if pad_rows:
     page_table = jnp.concatenate(
       [page_table, jnp.zeros((pad_rows, page_table.shape[1]), page_table.dtype)], axis=0)
     toks = jnp.concatenate([toks, jnp.broadcast_to(toks[:1], (pad_rows, 1))], axis=0)
     pos_vec = jnp.concatenate([pos_vec, jnp.zeros((pad_rows,), pos_vec.dtype)])
     temps = jnp.concatenate([temps, jnp.broadcast_to(temps[:1], (pad_rows,))])
+    if bias is not None:
+      bias = jnp.concatenate([bias, jnp.zeros((pad_rows, bias.shape[1]), bias.dtype)], axis=0)
+    if track_counts:
+      counts = jnp.concatenate(
+        [counts, jnp.zeros((pad_rows, counts.shape[1]), counts.dtype)], axis=0)
 
   def step(carry, _):
-    tok, arena, pos, key = carry
+    tok, arena, pos, key, counts = carry
     logits, arena = forward_shard(params, tok, arena, pos, cfg=cfg, is_first=True,
                                   is_last=True, moe_routed=moe_routed,
                                   page_table=page_table, paged_kernel=use_kernel,
                                   tp_mesh=tp_mesh)
     key, sub = jax.random.split(key)
-    nxt = sample_logits(logits[:, -1, :], sub, temp=temps, top_k=top_k, top_p=top_p)
-    return (nxt[:, None], arena, pos + 1, key), nxt
+    step_counts = counts if track_counts else None
+    if want_lp:
+      nxt, lp, top_ids, top_lps = sample_logits_logprobs(
+        logits[:, -1, :], sub, temp=temps, top_k=top_k, top_p=top_p,
+        bias=bias, counts=step_counts, presence=presence, frequency=frequency,
+        top_lp=top_lp, min_p=min_p)
+      ys = (nxt, lp, top_ids, top_lps)
+    else:
+      nxt = sample_logits(logits[:, -1, :], sub, temp=temps, top_k=top_k, top_p=top_p,
+                          bias=bias, counts=step_counts,
+                          presence=presence, frequency=frequency, min_p=min_p)
+      ys = nxt
+    if track_counts:
+      rows = jnp.arange(counts.shape[0], dtype=jnp.int32)
+      counts = counts.at[rows, nxt].add(1)
+    return (nxt[:, None], arena, pos + 1, key, counts), ys
 
-  init = (toks.astype(jnp.int32), arena, pos_vec.astype(jnp.int32), key)
-  (_, arena, _, _), out = jax.lax.scan(step, init, None, length=num_tokens)
-  return out.T[:B], arena
+  init = (toks.astype(jnp.int32), arena, pos_vec.astype(jnp.int32), key,
+          counts if track_counts else jnp.zeros((), jnp.int32))
+  (_, arena, _, _, counts_out), ys = jax.lax.scan(step, init, None, length=num_tokens)
+  if want_lp:
+    toks_out, lp, top_ids, top_lps = ys
+    aux = (lp.T[:B], top_ids.transpose(1, 0, 2)[:B], top_lps.transpose(1, 0, 2)[:B])
+  else:
+    toks_out, aux = ys, None
+  out = [toks_out.T[:B], arena]
+  if track_counts:
+    out.append(counts_out[:B])
+  if want_lp:
+    out.append(aux)
+  return tuple(out)
 
 
 @partial(

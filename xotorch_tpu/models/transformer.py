@@ -253,6 +253,14 @@ def _attention_block(
     from xotorch_tpu.ops.paged_attention import paged_decode_attention, paged_prefill_attention
     page = layer_cache["k"].shape[1]
     attn_scale_p = cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar else None
+    kv_quant_p = "k_scale" in layer_cache
+    if kv_quant_p:
+      # int8 arena: quantize the fresh K/V on the way in; payload AND
+      # per-(position, head) scales scatter into the SAME (page, slot) —
+      # scale pages are just one more arena leaf riding the scan.
+      qk, sk = _quantize_kv(k, layer_cache["k_scale"].dtype)
+      qv, sv = _quantize_kv(v, layer_cache["v_scale"].dtype)
+      k, v = qk, qv
     if T == 1:
       # Decode step: [B] per-row positions (scalar normalised — a 1-token
       # paged prefill is the same write).
@@ -265,14 +273,22 @@ def _attention_block(
       pidx = jnp.take_along_axis(page_table, (sp // page)[:, None], axis=1,
                                  mode="clip")[:, 0]
       off = sp % page
-      layer_cache = {
+      new_cache = {
         "k": layer_cache["k"].at[pidx, off].set(k[:, 0].astype(layer_cache["k"].dtype)),
         "v": layer_cache["v"].at[pidx, off].set(v[:, 0].astype(layer_cache["v"].dtype)),
       }
+      if kv_quant_p:
+        new_cache["k_scale"] = layer_cache["k_scale"].at[pidx, off].set(
+          sk[:, 0].astype(layer_cache["k_scale"].dtype))
+        new_cache["v_scale"] = layer_cache["v_scale"].at[pidx, off].set(
+          sv[:, 0].astype(layer_cache["v_scale"].dtype))
+      layer_cache = new_cache
       attn = paged_decode_attention(
         q, layer_cache["k"], layer_cache["v"], page_table, kv_valid_len,
         softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
-        use_kernel=paged_kernel, tp_mesh=tp_mesh)
+        use_kernel=paged_kernel, tp_mesh=tp_mesh, window=window,
+        k_scale_pages=layer_cache.get("k_scale"),
+        v_scale_pages=layer_cache.get("v_scale"))
     else:
       # Paged-native T>1 segment (prefill slice or draft-verify forward):
       # every position scatters into its own (page, slot). B == 1 by
@@ -285,14 +301,23 @@ def _attention_block(
       pos_vec = positions[0].astype(jnp.int32)  # [T] absolute positions
       pidx = jnp.take(page_table[0], pos_vec // page, mode="clip")
       off = pos_vec % page
-      layer_cache = {
+      new_cache = {
         "k": layer_cache["k"].at[pidx, off].set(k[0].astype(layer_cache["k"].dtype)),
         "v": layer_cache["v"].at[pidx, off].set(v[0].astype(layer_cache["v"].dtype)),
       }
+      if kv_quant_p:
+        new_cache["k_scale"] = layer_cache["k_scale"].at[pidx, off].set(
+          sk[0].astype(layer_cache["k_scale"].dtype))
+        new_cache["v_scale"] = layer_cache["v_scale"].at[pidx, off].set(
+          sv[0].astype(layer_cache["v_scale"].dtype))
+      layer_cache = new_cache
       attn = paged_prefill_attention(
         q, layer_cache["k"], layer_cache["v"], page_table, positions, kv_valid_len,
         softcap=cfg.attn_logit_softcap or 0.0, scale=attn_scale_p,
-        use_kernel=paged_kernel, ragged=ragged_prefill, tp_mesh=tp_mesh)
+        use_kernel=paged_kernel, ragged=ragged_prefill, tp_mesh=tp_mesh,
+        window=window,
+        k_scale_pages=layer_cache.get("k_scale"),
+        v_scale_pages=layer_cache.get("v_scale"))
     attn2d = _tp_constraint(
       attn.reshape(B, T, cfg.num_heads * cfg.head_dim), tp_mesh, 2)
     out = _maybe_lora(layer, "wo", attn2d, _linear(layer, "wo", attn2d))
@@ -531,11 +556,6 @@ def forward_shard(
     import numpy as _np
     windows = jnp.asarray(
       _np.array([cfg.layer_window(start_layer + i) for i in range(L)], _np.int32))
-  if page_table is not None and windows is not None:
-    # The engine gates windowed families off the paged path; keep the
-    # invariant loud if a future caller slips one through.
-    raise ValueError("paged KV does not support sliding-window configs")
-
   def layer_body(h, xs):
     if windows is None:
       layer, layer_cache = xs

@@ -22,6 +22,22 @@ Two implementations, one contract:
   DMA, so each row streams ceil(len_b / page) pages from HBM, not
   max_pages. Unallocated/padded table slots are never touched.
 
+Both kernels take two OPTIONAL operand families, threaded the same way
+flash_decode grew them (static flags select the executable; absent operands
+leave the original kernels byte-identical):
+
+- `window` ([1] int32 scalar-prefetch, one per-LAYER sliding window, 0 =
+  global): the kv index map clamps the page range to [lo, last] where lo is
+  the first page holding an in-window position, so out-of-window pages are
+  never DMA'd — the same bound the engine's VirtualKV handles use to decref
+  window-expired pages back to the pool (vkv.py). Dead table slots hold the
+  scratch page and sit below lo by construction.
+- `k_scale_pages`/`v_scale_pages` ([P, page, Hkv] per-layer SCALE pages,
+  int8-KV arenas): dequantized in-register between the int8 DMA and the MXU
+  dot, exactly `flash_decode._load_kv` — HBM streams int8 bytes, halving
+  paged KV bandwidth. A page id indexes payload and scale pages alike, so
+  the same `_kv_map` serves both BlockSpecs.
+
 `paged_decode_attention` is T == 1 only (the decode step).
 `paged_prefill_attention` serves T > 1 RAGGED segments — chunked-prefill
 slices and the draft-verify forward ([prev_token] + draft) — whose K/V were
@@ -69,45 +85,64 @@ def _tp_shards(tp_mesh, hq: int, hkv: int) -> int:
   return tp if tp > 1 and hq % tp == 0 and hkv % tp == 0 else 1
 
 
-def _tp_sharded_call(kernel, tp_mesh, q, k_pages, v_pages, page_table, rows):
+def _tp_sharded_call(kernel, tp_mesh, operands, specs):
   """Invoke a paged Pallas kernel PER TP SHARD via shard_map: q and the page
   arena are sliced on their head axes ([B,T,Hq,D] / [P,page,Hkv,D], heads at
-  index 2 — matching parallel.mesh.cache_spec), the table and row metadata
-  replicated. Each shard's kernel sees Hq/tp query heads over Hkv/tp arena
-  heads — same GQA group size, same grid shape, no cross-shard traffic (the
-  softmax is per head). This is how the kernels keep running under a tp
-  serving mesh: GSPMD has no partitioning rule for the custom call, so an
-  unwrapped kernel would make XLA all-gather the whole arena per step."""
-  from jax.sharding import PartitionSpec as P
-
+  index 2; scale pages [P,page,Hkv], heads at index 2 — matching
+  parallel.mesh.cache_spec), the table / row metadata / window replicated.
+  Each shard's kernel sees Hq/tp query heads over Hkv/tp arena heads — same
+  GQA group size, same grid shape, no cross-shard traffic (the softmax is
+  per head). This is how the kernels keep running under a tp serving mesh:
+  GSPMD has no partitioning rule for the custom call, so an unwrapped
+  kernel would make XLA all-gather the whole arena per step. The operand
+  list is VARIABLE (window / scale pages ride along when present), so the
+  caller supplies one spec per operand."""
   from xotorch_tpu.parallel.mesh import shard_map
+  from jax.sharding import PartitionSpec as P
   heads = P(None, None, "tp", None)
   per_shard = shard_map(
     kernel, mesh=tp_mesh,
-    in_specs=(heads, heads, heads, P(None, None), P(None)),
+    in_specs=tuple(specs),
     out_specs=heads, check_rep=False,
   )
-  return per_shard(q, k_pages, v_pages, page_table, rows)
+  return per_shard(*operands)
 
 
-def _logical_page_index(j, length, page_size: int):
+def _logical_page_index(j, length, page_size: int, window=None):
   """Logical kv-page index a grid step `j` should read for a row holding
   `length` tokens: j itself while occupied, else saturating at the row's
-  LAST occupied page. The saturation is the ragged skip — consecutive grid
-  steps mapping to the same page make Pallas elide the DMA, so a row's HBM
-  reads stop at ceil(length / page_size) pages regardless of the batch
-  maximum. Exposed for tests (per-row-read assertion without a TPU)."""
+  LAST occupied page — and, with a sliding `window`, at the FIRST page
+  holding an in-window position. The saturation is the ragged skip —
+  consecutive grid steps mapping to the same page make Pallas elide the
+  DMA, so a row's HBM reads stop at the occupied (and in-window) pages
+  regardless of the batch maximum. Exposed for tests (per-row-read
+  assertion without a TPU)."""
   last = jnp.maximum(length - 1, 0) // page_size
-  return jnp.minimum(j, last)
+  jj = jnp.minimum(j, last)
+  if window is not None:
+    lo = jnp.where(window > 0,
+                   jnp.maximum(length - window, 0) // page_size, 0)
+    jj = jnp.maximum(jj, lo)
+  return jj
 
 
-def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, page: int, groups: int,
-                  scale: float, softcap: float):
+def _paged_kernel(*refs, page: int, groups: int, scale: float, softcap: float,
+                  windowed: bool = False, quant: bool = False):
   """Grid = (B, Hkv, n_pages); the page axis innermost so VMEM scratch
   carries the online-softmax state across one (batch, kv-head)'s pages.
   Rows of a tile are the `groups` query heads sharing this kv head (the
-  T == 1 specialisation of flash_decode's GQA packing)."""
+  T == 1 specialisation of flash_decode's GQA packing). `windowed` threads
+  the per-layer sliding window in as one more scalar-prefetch operand;
+  `quant` threads int8 scale-page tiles in as two more kv operands — both
+  static, so configs without them compile the original kernel."""
+  n_sp = 3 if windowed else 2
+  pt_ref, len_ref = refs[0], refs[1]
+  win_ref = refs[2] if windowed else None
+  rest = refs[n_sp:]
+  if quant:
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+  else:
+    (q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref), ks_ref, vs_ref = rest, None, None
   b = pl.program_id(0)
   j = pl.program_id(2)
   n_j = pl.num_programs(2)
@@ -119,19 +154,38 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
 
-  @pl.when(j * page < length)
+  if windowed:
+    w = win_ref[0]
+    # First in-window position is length - w; pages wholly below it are
+    # clamped away by _kv_map, and the grid gate skips their compute too.
+    low = jnp.where(w > 0, jnp.maximum(length - w, 0), 0)
+    gate = jnp.logical_and(j * page < length, (j + 1) * page > low)
+  else:
+    gate = j * page < length
+
+  @pl.when(gate)
   def _compute():
     q = _mxu_operand(q_ref[0, 0])  # [groups, D]
-    k = _mxu_operand(k_ref[0, 0])  # [page, D]
-    v = _mxu_operand(v_ref[0, 0])
+    if quant:
+      # flash_decode._load_kv: per-(position, head) scale multiplies in
+      # registers between the int8 DMA and the MXU dot.
+      k = k_ref[0, 0].astype(q.dtype) * ks_ref[0, 0, 0].astype(q.dtype)[:, None]
+      v = v_ref[0, 0].astype(q.dtype) * vs_ref[0, 0, 0].astype(q.dtype)[:, None]
+    else:
+      k = _mxu_operand(k_ref[0, 0])  # [page, D]
+      v = _mxu_operand(v_ref[0, 0])
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [groups, page]
     s = _softcap(s, softcap)
     # The decode query sits at position length - 1: every occupied position
-    # is causally visible, so the mask is occupancy alone.
+    # is causally visible, so the mask is occupancy (plus the window).
     k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(k_pos < length, s, NEG_INF)
+    visible = k_pos < length
+    if windowed:
+      visible = jnp.logical_and(
+        visible, jnp.logical_or(w <= 0, k_pos >= length - w))
+    s = jnp.where(visible, s, NEG_INF)
 
     m_prev = m_ref[:, :1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -153,12 +207,16 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
-                            scale: float, softcap: float,
+                            window=None, k_scale_pages=None,
+                            v_scale_pages=None, *, scale: float,
+                            softcap: float,
                             interpret: bool | None) -> jnp.ndarray:
   B, T, Hq, D = q.shape
-  _, page, Hkv, _ = k_pages.shape
+  P_, page, Hkv, _ = k_pages.shape
   groups = Hq // Hkv
   maxp = page_table.shape[1]
+  windowed = window is not None
+  quant = k_scale_pages is not None
   if interpret is None:
     interpret = jax.default_backend() != "tpu"
 
@@ -168,16 +226,35 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
   pt = page_table.astype(jnp.int32)
   lens = lengths.astype(jnp.int32)
 
-  def _kv_map(b, h, j, pt_ref, len_ref):
-    jj = _logical_page_index(j, len_ref[b], page)
+  def _kv_map(b, h, j, pt_ref, len_ref, *rest):
+    # The window-rotated logical view: pages below the window clamp to the
+    # first in-window page (their DMA elides), pages past the last occupied
+    # one clamp to it. `rest[0]` is the window scalar-prefetch ref when the
+    # executable is windowed.
+    win = rest[0][0] if windowed else None
+    jj = _logical_page_index(j, len_ref[b], page, window=win)
     return (h, pt_ref[b, jj], 0, 0)
 
   q_block = pl.BlockSpec((1, 1, groups, D), lambda b, h, j, *_: (b, h, 0, 0))
   kv_block = pl.BlockSpec((1, 1, page, D), _kv_map)
+  in_specs = [q_block, kv_block, kv_block]
+  operands = [qt, kt, vt]
+  prefetch = [pt, lens]
+  if windowed:
+    prefetch.append(jnp.asarray(window, jnp.int32).reshape(1))
+  if quant:
+    # [P, page, Hkv] -> [Hkv, P, 1, page]: trailing (sublane=1, lane=page)
+    # keeps the scale block inside the Mosaic layout rule (flash_decode's
+    # transpose trick); the SAME _kv_map resolves its physical page.
+    kst = k_scale_pages.transpose(2, 0, 1).reshape(Hkv, P_, 1, page)
+    vst = v_scale_pages.transpose(2, 0, 1).reshape(Hkv, P_, 1, page)
+    sc_block = pl.BlockSpec((1, 1, 1, page), _kv_map)
+    in_specs += [sc_block, sc_block]
+    operands += [kst, vst]
   grid_spec = pltpu.PrefetchScalarGridSpec(
-    num_scalar_prefetch=2,
+    num_scalar_prefetch=len(prefetch),
     grid=(B, Hkv, maxp),
-    in_specs=[q_block, kv_block, kv_block],
+    in_specs=in_specs,
     out_specs=q_block,
     scratch_shapes=[
       pltpu.VMEM((groups, D), jnp.float32),
@@ -187,17 +264,18 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table, lengths,
   )
   out = pl.pallas_call(
     functools.partial(_paged_kernel, page=page, groups=groups,
-                      scale=scale, softcap=float(softcap)),
+                      scale=scale, softcap=float(softcap),
+                      windowed=windowed, quant=quant),
     grid_spec=grid_spec,
     out_shape=jax.ShapeDtypeStruct((B, Hkv, groups, D), q.dtype),
     interpret=interpret,
-  )(pt, lens, qt, kt, vt)
+  )(*prefetch, *operands)
   return out.reshape(B, 1, Hq, D)
 
 
-def _paged_ragged_kernel(pt_ref, qstart_ref, len_ref, q_ref, k_ref, v_ref,
-                         o_ref, acc_ref, m_ref, l_ref, *, page: int,
-                         groups: int, T: int, scale: float, softcap: float):
+def _paged_ragged_kernel(*refs, page: int, groups: int, T: int, scale: float,
+                         softcap: float, windowed: bool = False,
+                         quant: bool = False):
   """T > 1 generalisation of `_paged_kernel`: grid = (B, Hkv, n_pages), the
   page axis innermost so VMEM scratch carries the online-softmax state of
   ALL of one (batch, kv-head)'s query rows across its pages. A tile packs
@@ -205,8 +283,17 @@ def _paged_ragged_kernel(pt_ref, qstart_ref, len_ref, q_ref, k_ref, v_ref,
   positions as rows (row r = g*T + t), so one MXU dot scores a whole page
   against every query at once. Causality is per ROW: query t sits at
   absolute position q_start[b] + t and sees exactly the occupied positions
-  at or before it — the ragged mask that lets one kernel serve chunked
-  prefill slices and draft-verify forwards over a resident cache."""
+  at or before it (and, windowed, above its own position - window) — the
+  ragged mask that lets one kernel serve chunked prefill slices and
+  draft-verify forwards over a resident cache."""
+  n_sp = 4 if windowed else 3
+  pt_ref, qstart_ref, len_ref = refs[0], refs[1], refs[2]
+  win_ref = refs[3] if windowed else None
+  rest = refs[n_sp:]
+  if quant:
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+  else:
+    (q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref), ks_ref, vs_ref = rest, None, None
   b = pl.program_id(0)
   j = pl.program_id(2)
   n_j = pl.num_programs(2)
@@ -219,11 +306,24 @@ def _paged_ragged_kernel(pt_ref, qstart_ref, len_ref, q_ref, k_ref, v_ref,
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
 
-  @pl.when(j * page < length)
+  if windowed:
+    w = win_ref[0]
+    # Lowest position any query row of this batch can see: the EARLIEST
+    # row sits at q_start and sees k_pos > q_start - w.
+    low = jnp.where(w > 0, jnp.maximum(q_start - w + 1, 0), 0)
+    gate = jnp.logical_and(j * page < length, (j + 1) * page > low)
+  else:
+    gate = j * page < length
+
+  @pl.when(gate)
   def _compute():
     q = _mxu_operand(q_ref[0, 0])  # [groups*T, D]
-    k = _mxu_operand(k_ref[0, 0])  # [page, D]
-    v = _mxu_operand(v_ref[0, 0])
+    if quant:
+      k = k_ref[0, 0].astype(q.dtype) * ks_ref[0, 0, 0].astype(q.dtype)[:, None]
+      v = v_ref[0, 0].astype(q.dtype) * vs_ref[0, 0, 0].astype(q.dtype)[:, None]
+    else:
+      k = _mxu_operand(k_ref[0, 0])  # [page, D]
+      v = _mxu_operand(v_ref[0, 0])
     s = jax.lax.dot_general(
       q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [groups*T, page]
@@ -232,10 +332,17 @@ def _paged_ragged_kernel(pt_ref, qstart_ref, len_ref, q_ref, k_ref, v_ref,
     # attends key positions <= its own. Position 0 is visible to every row,
     # so m/l leave NEG_INF on the very first page — later fully-masked
     # pages then renormalise against a finite running max (exp(-inf - m)
-    # underflows to 0, never NaN).
+    # underflows to 0, never NaN). Windowed rows whose window starts past
+    # the first computed page accumulate garbage under an all-NEG_INF max
+    # the same way — and the first REAL score wipes it (alpha underflows
+    # to 0), so the invariant holds per row.
     k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % T
-    s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    visible = k_pos <= q_pos
+    if windowed:
+      visible = jnp.logical_and(
+        visible, jnp.logical_or(w <= 0, k_pos > q_pos - w))
+    s = jnp.where(visible, s, NEG_INF)
 
     m_prev = m_ref[:, :1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -257,16 +364,20 @@ def _paged_ragged_kernel(pt_ref, qstart_ref, len_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_attention_kernel(q, k_pages, v_pages, page_table, kv_valid_len,
-                             scale: float, softcap: float,
+                             window=None, k_scale_pages=None,
+                             v_scale_pages=None, *, scale: float,
+                             softcap: float,
                              interpret: bool | None) -> jnp.ndarray:
   """Pallas dispatch for the T>1 ragged kernel: queries [B, T, Hq, D] over
   page-table-indirected K/V. Query row t of batch b sits at absolute
   position kv_valid_len[b] - T + t (the engine's prefill/verify contract:
   contiguous positions ending at the last occupied one)."""
   B, T, Hq, D = q.shape
-  _, page, Hkv, _ = k_pages.shape
+  P_, page, Hkv, _ = k_pages.shape
   groups = Hq // Hkv
   maxp = page_table.shape[1]
+  windowed = window is not None
+  quant = k_scale_pages is not None
   if interpret is None:
     interpret = jax.default_backend() != "tpu"
 
@@ -278,16 +389,35 @@ def _ragged_attention_kernel(q, k_pages, v_pages, page_table, kv_valid_len,
   vt = v_pages.transpose(2, 0, 1, 3)
   pt = page_table.astype(jnp.int32)
 
-  def _kv_map(b, h, j, pt_ref, qstart_ref, len_ref):
+  def _kv_map(b, h, j, pt_ref, qstart_ref, len_ref, *rest):
+    win = None
+    if windowed:
+      # The earliest query row bounds the visible range from below.
+      w = rest[0][0]
+      lo = jnp.where(w > 0,
+                     jnp.maximum(qstart_ref[b] - w + 1, 0) // page, 0)
     jj = _logical_page_index(j, len_ref[b], page)
+    if windowed:
+      jj = jnp.maximum(jj, lo)
     return (h, pt_ref[b, jj], 0, 0)
 
   q_block = pl.BlockSpec((1, 1, groups * T, D), lambda b, h, j, *_: (b, h, 0, 0))
   kv_block = pl.BlockSpec((1, 1, page, D), _kv_map)
+  in_specs = [q_block, kv_block, kv_block]
+  operands = [qt, kt, vt]
+  prefetch = [pt, q_start, lens]
+  if windowed:
+    prefetch.append(jnp.asarray(window, jnp.int32).reshape(1))
+  if quant:
+    kst = k_scale_pages.transpose(2, 0, 1).reshape(Hkv, P_, 1, page)
+    vst = v_scale_pages.transpose(2, 0, 1).reshape(Hkv, P_, 1, page)
+    sc_block = pl.BlockSpec((1, 1, 1, page), _kv_map)
+    in_specs += [sc_block, sc_block]
+    operands += [kst, vst]
   grid_spec = pltpu.PrefetchScalarGridSpec(
-    num_scalar_prefetch=3,
+    num_scalar_prefetch=len(prefetch),
     grid=(B, Hkv, maxp),
-    in_specs=[q_block, kv_block, kv_block],
+    in_specs=in_specs,
     out_specs=q_block,
     scratch_shapes=[
       pltpu.VMEM((groups * T, D), jnp.float32),
@@ -297,31 +427,63 @@ def _ragged_attention_kernel(q, k_pages, v_pages, page_table, kv_valid_len,
   )
   out = pl.pallas_call(
     functools.partial(_paged_ragged_kernel, page=page, groups=groups, T=T,
-                      scale=scale, softcap=float(softcap)),
+                      scale=scale, softcap=float(softcap),
+                      windowed=windowed, quant=quant),
     grid_spec=grid_spec,
     out_shape=jax.ShapeDtypeStruct((B, Hkv, groups * T, D), q.dtype),
     interpret=interpret,
-  )(pt, q_start, lens, qt, kt, vt)
+  )(*prefetch, *operands)
   return (out.reshape(B, Hkv, groups, T, D)
           .transpose(0, 3, 1, 2, 4).reshape(B, T, Hq, D))
 
 
-def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                         scale: float, softcap: float) -> jnp.ndarray:
-  """`jnp.take`-based fallback: gather each row's pages into a per-row
-  contiguous view, then run the shared masked-softmax math. Padded table
-  slots gather the scratch page; their positions sit at or past the row's
-  length and mask out."""
-  from xotorch_tpu.ops.attention import gqa_attention
+def _gather_paged_view(q, k_pages, v_pages, page_table,
+                       k_scale_pages=None, v_scale_pages=None):
+  """`jnp.take` each row's pages into a contiguous [B, maxp*page, ...] view.
+  int8 arenas dequantize here (same math as transformer._cache_read) so the
+  caller sees compute-dtype K/V; scratch-page slots gather zeros and mask
+  out downstream."""
   B = q.shape[0]
   maxp, page = page_table.shape[1], k_pages.shape[1]
   k = jnp.take(k_pages, page_table, axis=0)  # [B, maxp, page, Hkv, D]
   v = jnp.take(v_pages, page_table, axis=0)
   k = k.reshape(B, maxp * page, *k.shape[3:])
   v = v.reshape(B, maxp * page, *v.shape[3:])
+  if k_scale_pages is not None:
+    ks = jnp.take(k_scale_pages, page_table, axis=0).reshape(B, maxp * page, -1)
+    vs = jnp.take(v_scale_pages, page_table, axis=0).reshape(B, maxp * page, -1)
+    k = k.astype(q.dtype) * ks.astype(q.dtype)[..., None]
+    v = v.astype(q.dtype) * vs.astype(q.dtype)[..., None]
+  return k, v
+
+
+def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                         scale: float, softcap: float, window=None,
+                         k_scale_pages=None, v_scale_pages=None) -> jnp.ndarray:
+  """`jnp.take`-based fallback: gather each row's pages into a per-row
+  contiguous view, then run the shared masked-softmax math. Padded table
+  slots gather the scratch page; their positions sit at or past the row's
+  length and mask out (released window slots likewise sit below the window
+  mask)."""
+  from xotorch_tpu.ops.attention import gqa_attention
+  k, v = _gather_paged_view(q, k_pages, v_pages, page_table,
+                            k_scale_pages, v_scale_pages)
   q_positions = (lengths.astype(jnp.int32) - 1)[:, None]  # [B, 1]
   return gqa_attention(q, k, v, q_positions, kv_valid_len=lengths.astype(jnp.int32),
-                       scale=scale, softcap=softcap)
+                       scale=scale, softcap=softcap, window=window)
+
+
+def _paged_operand_specs(window, k_scale_pages):
+  """Per-operand PartitionSpecs for `_tp_sharded_call`, mirroring the
+  operand order (q, k_pages, v_pages, table, rows[, window][, scales])."""
+  from jax.sharding import PartitionSpec as P
+  heads = P(None, None, "tp", None)
+  specs = [heads, heads, heads, P(None, None), P(None)]
+  if window is not None:
+    specs.append(P(None))
+  if k_scale_pages is not None:
+    specs += [P(None, None, "tp"), P(None, None, "tp")]
+  return specs
 
 
 def paged_prefill_attention(
@@ -337,6 +499,9 @@ def paged_prefill_attention(
   ragged: bool = True,  # static: kernel path reads pages NATIVELY (no gather)
   interpret: bool | None = None,
   tp_mesh=None,  # static Mesh: kernel runs per-tp-shard over sliced heads
+  window=None,  # traced per-layer sliding window scalar; None = global layer
+  k_scale_pages=None,  # [P, page, Hkv] int8-KV scale pages; None = bf16 arena
+  v_scale_pages=None,
 ) -> jnp.ndarray:
   """Causal GQA attention of a T>1 ragged segment over its row's occupied
   pages: chunked-prefill slices and draft-verify forwards share this op.
@@ -354,30 +519,53 @@ def paged_prefill_attention(
   at or past kv_valid_len and mask out. Returns [B, T, Hq, D].
   """
   T = q.shape[1]
+  win = None if window is None else jnp.asarray(window, jnp.int32).reshape(1)
   if use_kernel and ragged:
     D = q.shape[-1]
     k_scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
     kernel = functools.partial(_ragged_attention_kernel, scale=k_scale,
                                softcap=float(softcap), interpret=interpret)
+    operands = [q, k_pages, v_pages, page_table, kv_valid_len]
+    if win is not None:
+      operands.append(win)
+    if k_scale_pages is not None:
+      operands += [k_scale_pages, v_scale_pages]
     if _tp_shards(tp_mesh, q.shape[2], k_pages.shape[2]) > 1:
-      return _tp_sharded_call(kernel, tp_mesh, q, k_pages, v_pages,
-                              page_table, kv_valid_len)
-    return kernel(q, k_pages, v_pages, page_table, kv_valid_len)
-  from xotorch_tpu.ops.attention import gqa_attention
-  B = q.shape[0]
-  maxp, page = page_table.shape[1], k_pages.shape[1]
-  k = jnp.take(k_pages, page_table, axis=0)  # [B, maxp, page, Hkv, D]
-  v = jnp.take(v_pages, page_table, axis=0)
-  k = k.reshape(B, maxp * page, *k.shape[3:])
-  v = v.reshape(B, maxp * page, *v.shape[3:])
+      def shard_kernel(q_, kp, vp, pt, rows, *extra):
+        i = 0
+        w = None
+        if win is not None:
+          w, i = extra[0], 1
+        ks = vs = None
+        if k_scale_pages is not None:
+          ks, vs = extra[i], extra[i + 1]
+        return kernel(q_, kp, vp, pt, rows, w, ks, vs)
+      return _tp_sharded_call(shard_kernel, tp_mesh, operands,
+                              _paged_operand_specs(win, k_scale_pages))
+    return kernel(q, k_pages, v_pages, page_table, kv_valid_len, win,
+                  k_scale_pages, v_scale_pages)
   if use_kernel:
+    # Legacy gathered view: int8 arenas hand the RAW pages + gathered
+    # scales to flash_cached, which dequantizes in-kernel over the view.
     from xotorch_tpu.ops.flash_decode import flash_cached_attention
+    B = q.shape[0]
+    maxp, page = page_table.shape[1], k_pages.shape[1]
+    k = jnp.take(k_pages, page_table, axis=0).reshape(B, maxp * page, *k_pages.shape[2:])
+    v = jnp.take(v_pages, page_table, axis=0).reshape(B, maxp * page, *v_pages.shape[2:])
+    ks = vs = None
+    if k_scale_pages is not None:
+      ks = jnp.take(k_scale_pages, page_table, axis=0).reshape(B, maxp * page, -1)
+      vs = jnp.take(v_scale_pages, page_table, axis=0).reshape(B, maxp * page, -1)
     q_start = kv_valid_len.astype(jnp.int32) - T
-    return flash_cached_attention(q, k, v, q_start, softcap=softcap, scale=scale,
-                                  interpret=interpret)
+    return flash_cached_attention(q, k, v, q_start, window=window,
+                                  softcap=softcap, scale=scale,
+                                  k_scale=ks, v_scale=vs, interpret=interpret)
+  from xotorch_tpu.ops.attention import gqa_attention
+  k, v = _gather_paged_view(q, k_pages, v_pages, page_table,
+                            k_scale_pages, v_scale_pages)
   return gqa_attention(q, k, v, q_positions.astype(jnp.int32),
                        kv_valid_len=kv_valid_len.astype(jnp.int32),
-                       scale=scale, softcap=softcap)
+                       scale=scale, softcap=softcap, window=window)
 
 
 def paged_decode_attention(
@@ -391,22 +579,46 @@ def paged_decode_attention(
   use_kernel: bool = False,
   interpret: bool | None = None,
   tp_mesh=None,  # static Mesh: kernel runs per-tp-shard over sliced heads
+  window=None,  # traced per-layer sliding window scalar; None = global layer
+  k_scale_pages=None,  # [P, page, Hkv] int8-KV scale pages; None = bf16 arena
+  v_scale_pages=None,
 ) -> jnp.ndarray:
   """Causal GQA decode attention over each row's occupied pages.
 
   Row b's query (at absolute position lengths[b] - 1) attends positions
-  [0, lengths[b]) reached through page_table[b]. Returns [B, 1, Hq, D].
-  `use_kernel` (static) selects the Pallas path; the default XLA gather
-  path is the correctness reference and the off-TPU fallback.
+  [0, lengths[b]) reached through page_table[b] — windowed layers only the
+  last `window` of them, and the kernel's page range clamps to match (the
+  VirtualKV contract: released head slots are never DMA'd). Returns
+  [B, 1, Hq, D]. `use_kernel` (static) selects the Pallas path; the
+  default XLA gather path is the correctness reference and the off-TPU
+  fallback.
   """
   D = q.shape[-1]
   scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
   if use_kernel:
+    win = None if window is None else jnp.asarray(window, jnp.int32).reshape(1)
     kernel = functools.partial(_paged_attention_kernel, scale=scale,
                                softcap=float(softcap), interpret=interpret)
+    operands = [q, k_pages, v_pages, page_table, lengths]
+    if win is not None:
+      operands.append(win)
+    if k_scale_pages is not None:
+      operands += [k_scale_pages, v_scale_pages]
     if _tp_shards(tp_mesh, q.shape[2], k_pages.shape[2]) > 1:
-      return _tp_sharded_call(kernel, tp_mesh, q, k_pages, v_pages,
-                              page_table, lengths)
-    return kernel(q, k_pages, v_pages, page_table, lengths)
+      def shard_kernel(q_, kp, vp, pt, rows, *extra):
+        i = 0
+        w = None
+        if win is not None:
+          w, i = extra[0], 1
+        ks = vs = None
+        if k_scale_pages is not None:
+          ks, vs = extra[i], extra[i + 1]
+        return kernel(q_, kp, vp, pt, rows, w, ks, vs)
+      return _tp_sharded_call(shard_kernel, tp_mesh, operands,
+                              _paged_operand_specs(win, k_scale_pages))
+    return kernel(q, k_pages, v_pages, page_table, lengths, win,
+                  k_scale_pages, v_scale_pages)
   return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                              scale, float(softcap))
+                              scale, float(softcap), window=window,
+                              k_scale_pages=k_scale_pages,
+                              v_scale_pages=v_scale_pages)

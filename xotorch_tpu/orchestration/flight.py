@@ -63,6 +63,12 @@ EVENTS = (
   # paged KV pool
   "pool.alloc",
   "pool.pressure",
+  # virtual KV addressing (inference/jax_engine/vkv.py via engine): pages a
+  # sliding window released back to the pool mid-decode, and idle-slot
+  # defrag passes (moves + the fragmentation they left behind) — the two
+  # silent arena mutations a postmortem must be able to replay.
+  "vkv.window_free",
+  "vkv.defrag",
   # host KV tier
   "host.spill",
   "host.restore",

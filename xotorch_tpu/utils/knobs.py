@@ -66,6 +66,8 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_PAGED_PREFILL", "bool", "1", "Prefill straight into pool pages under XOT_PAGED_KV (no contiguous commit copy).", "Paged KV"),
   Knob("XOT_RAGGED_PREFILL", "bool", "1", "Kernel-path T>1 segments read pages natively via the ragged kernel (no gathered view); 0 restores the legacy gather+cached-kernel read.", "Paged KV"),
   Knob("XOT_PAGED_SPEC", "bool", "1", "Draft verification runs native to the page arena (ragged query over the request's page table); 0 restores unpage-then-verify.", "Paged KV"),
+  Knob("XOT_KV_DEFRAG", "bool", "1", "Background page-pool defragmentation in batcher-idle slots: migrate high pages into low free holes and rewrite only the virtual maps.", "Paged KV"),
+  Knob("XOT_KV_DEFRAG_MAX_MOVES", "int", "8", "Max page migrations per idle defrag pass (bounds the donated-copy burst).", "Paged KV"),
   Knob("XOT_PREFILL_COSCHED", "bool", "1", "Co-schedule chunked prefill slices through the decode batcher's drain cycle.", "Paged KV"),
   Knob("XOT_PREFILL_CHUNK_BUDGET", "int", "1", "Prefill segments admitted per decode drain cycle under co-scheduling.", "Paged KV"),
   Knob("XOT_KV_HOST_BYTES", "int", "268435456", "Host-RAM budget (bytes) for the spilled warm-prefix KV tier; 0 disables.", "Paged KV"),
