@@ -1343,6 +1343,129 @@ def _run_kv_host(model_id: str, prefill_len: int, decode_tokens: int,
         os.environ[k] = v
 
 
+def _run_fabric(model_id: str, prefill_len: int, decode_tokens: int,
+                progress_path: str) -> dict:
+  """Cold vs fabric-warm TTFT A/B (the `fabric` tpu_retry step): TWO
+  engines in one process stand in for two replicas — engine A prefills a
+  prompt and spills it to its host tier; engine B, whose fabric client is
+  wired straight to A's store through the REAL pack/serve/unpack/digest
+  path (no sockets — the serialize + verify + import + H2D restore cost is
+  what's measured; the wire itself is the soak's job), serves the same
+  prompt after an offer lands. The fabric-warm TTFT must beat B's cold
+  TTFT on an equal-length prompt, B's greedy stream must be byte-identical
+  to A's (a fabric that changes tokens is corrupting caches), and the
+  paged zero bars hold (the import rides the normal host-restore path)."""
+  import asyncio
+
+  import numpy as np
+
+  from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+  from xotorch_tpu.inference.shard import Shard
+  from xotorch_tpu.models.config import config_from_hf_dict
+  from xotorch_tpu.models.registry import model_cards
+
+  n_layers = config_from_hf_dict(model_cards[model_id]["synthetic_config"]).num_layers
+
+  # Token-level prompts for the same reason as the kvhost stage: the
+  # synthetic tokenizer collapses word-varied prompts onto one id stream.
+  def pattern(seed: int) -> np.ndarray:
+    return ((np.arange(prefill_len) * (seed * 2 + 3) + seed) % 200 + 3)[None, :].astype(np.int64)
+
+  def wire(eng_b, eng_a) -> None:
+    """B's fabric transport -> A's host store, through the real server
+    surface (fabric_server.match_response / serve_entry)."""
+    import json as _json
+
+    from xotorch_tpu.fabric import server as fabric_server
+    client = eng_b._fabric_client(create=True)
+
+    def post_json(url: str, body: dict) -> dict:
+      resp = fabric_server.match_response(
+        eng_a._host_kv, Shard(model_id, 0, n_layers - 1, n_layers),
+        np.asarray(body["toks"], dtype=np.int64), int(body["limit"]))
+      return _json.loads(_json.dumps(resp))
+
+    def get_bytes(url: str) -> bytes:
+      blob = fabric_server.serve_entry(eng_a._host_kv, url.rsplit("/", 1)[-1].split("?")[0])
+      if blob is None:
+        raise OSError(f"no entry for {url}")
+      return blob
+
+    client._post_json = post_json
+    client._get_bytes = get_bytes
+
+  async def run() -> dict:
+    shard = Shard(model_id, 0, n_layers - 1, n_layers)
+    eng_a = JAXShardInferenceEngine()
+    eng_b = JAXShardInferenceEngine()
+
+    async def generate(engine, rid: str, prompt: np.ndarray):
+      t0 = time.monotonic()
+      tok, _ = await engine.infer_sample_tensor(rid, shard, prompt, temp=0.0)
+      ttft = time.monotonic() - t0
+      toks = [int(tok)]
+      for _ in range(max(1, decode_tokens // 16)):
+        out = await engine.generate_chunk(rid, shard, toks[-1], 16, temp=0.0)
+        toks.extend(int(t) for t in out)
+      await engine.clear_request(rid)
+      return round(ttft, 3), toks
+
+    # Replica A: prefill the measured prompt, spill it to A's host tier.
+    _, a_toks = await generate(eng_a, "fabric-src", pattern(0))
+    eng_a._free_device_memory()
+    src_stats = eng_a.host_kv_stats() or {"bytes": 0, "entries": 0}
+    _record(progress_path, "fabric:spilled", **src_stats)
+
+    # Replica B: compile both shapes (cold prefill + prefix-hit suffix
+    # prefill) on a distinct prefix, then measure cold on ANOTHER distinct
+    # equal-length prompt — B must never have seen pattern(0) cold, or the
+    # warm run below would hit B's own prefix cache instead of the fabric.
+    await generate(eng_b, "fabric-warmexe", pattern(1))
+    await generate(eng_b, "fabric-warmexe2", pattern(1))
+    cold_ttft, _ = await generate(eng_b, "fabric-cold", pattern(2))
+    _record(progress_path, "fabric:cold", ttft_s=cold_ttft)
+
+    # The offer lands (router-chain shape), transport wired to A's store.
+    wire(eng_b, eng_a)
+    toks0 = [int(t) for t in pattern(0)[0]]
+    assert eng_b.fabric_offer(shard, toks0, len(toks0),
+                              int(src_stats["bytes"]), "http://bench-peer-a")
+    hits0, bytes0 = eng_b._fabric_hits, eng_b._fabric_bytes
+    warm_ttft, warm_toks = await generate(eng_b, "fabric-warm", pattern(0))
+    _record(progress_path, "fabric:warm", ttft_s=warm_ttft,
+            hits=eng_b._fabric_hits - hits0)
+
+    n_cmp = min(len(a_toks), len(warm_toks), 32)
+    verified = bool(n_cmp > 0 and a_toks[:n_cmp] == warm_toks[:n_cmp])
+    return {
+      "fabric_prefill_len": prefill_len,
+      "fabric_cold_ttft_s": cold_ttft,
+      "fabric_warm_ttft_s": warm_ttft,
+      # Recorded, not gated (CPU-fallback noise), same as kvhost_ordering.
+      "fabric_ordering_ok": bool(warm_ttft <= cold_ttft),
+      "fabric_speedup": round(cold_ttft / warm_ttft, 3) if warm_ttft else None,
+      "fabric_tokens_verified": verified,
+      "fabric_hits": int(eng_b._fabric_hits - hits0),
+      "fabric_fetch_bytes": int(eng_b._fabric_bytes - bytes0),
+      "fabric_errors": int(eng_b._fabric_errors),
+      "fabric_src_entries": int(src_stats["entries"]),
+    }
+
+  prev = {k: os.environ.get(k) for k in ("XOT_KV_HOST_BYTES", "XOT_PREFIX_CACHE")}
+  try:
+    if int(os.environ.get("XOT_KV_HOST_BYTES") or 0) <= 0:
+      os.environ["XOT_KV_HOST_BYTES"] = str(1 << 30)
+    if int(os.environ.get("XOT_PREFIX_CACHE") or 2) <= 0:
+      os.environ["XOT_PREFIX_CACHE"] = "2"
+    return asyncio.run(run())
+  finally:
+    for k, v in prev.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+
+
 def _find_real_model() -> "tuple[str, str] | None":
   """(model_id, dir) of a REAL downloaded checkpoint, if one exists on disk.
 
@@ -1565,6 +1688,24 @@ def child_main() -> None:
           "cold vs HBM-warm vs host-warm token streams disagree"]))
     except Exception as e:
       res["kvhost_error"] = repr(e)
+  # Cross-replica KV fabric stage (opt-in: BENCH_FABRIC=1 — the tpu_retry
+  # `fabric` step): cold vs fabric-warm TTFT with two engines standing in
+  # for two replicas, the warm run importing the prefix through the real
+  # pack/digest/import path from the sibling's host tier.
+  if os.getenv("BENCH_FABRIC", "0") == "1":
+    try:
+      fb_prefill = int(os.getenv("BENCH_FABRIC_PREFILL", "2048"))
+      res.update(_run_fabric(model_id, fb_prefill, min(decode_tokens, 64),
+                             progress_path))
+      # Measurement integrity, same contract as kvhost: a fabric transfer
+      # that changes the greedy stream corrupted the cache it moved.
+      if res.get("fabric_tokens_verified") is False:
+        res["implausible"] = True
+        res["diagnosis"] = "; ".join(filter(None, [
+          res.get("diagnosis"),
+          "source vs fabric-warm greedy token streams disagree"]))
+    except Exception as e:
+      res["fabric_error"] = repr(e)
   # Speculative-decoding stage (opt-in: a repeat-heavy prompt through the
   # Node loop with XOT_SPECULATE on vs off, streams cross-checked).
   if os.getenv("BENCH_SPEC", "0") == "1":

@@ -76,6 +76,14 @@ STEPS: list[tuple[str, dict, str]] = [
   ("kvhost", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "0",
               "XOT_PAGED_KV": "1", "BENCH_KVHOST": "1"},
    "kvhost_host_ttft_s"),
+  # Cross-replica KV fabric A/B (PR 18 `fabric`): cold vs fabric-warm TTFT
+  # with two in-process engines as the two replicas — the warm run imports
+  # the sibling's spilled prefix through the real pack/digest/import path,
+  # then restores it over the normal host-promote machinery. Measures what
+  # a disaggregated decode replica saves per chained prompt on chip.
+  ("fabric", {**SHORT, "BENCH_QUANT": "", "BENCH_CONCURRENT": "0",
+              "XOT_PAGED_KV": "1", "BENCH_FABRIC": "1"},
+   "fabric_warm_ttft_s"),
   # Fused scan-prefill headline (VERDICT r3 #5): prefill_mfu_pct with the
   # whole segment loop in one executable, vs the per-segment path.
   ("scan16k", LONG, "prefill_mfu_pct"),

@@ -1,0 +1,374 @@
+"""Fleet-wide KV fabric (xotorch_tpu/fabric, XOT_FABRIC_*).
+
+Correctness bars:
+- pure half: stable cross-process entry keys, wire-format round-trip
+  (bf16/int8-scale leaves included), every torn-blob malformation raises
+  ValueError, export→import verifies the content digest and a tampered
+  payload is rejected without touching the store;
+- offer directory: longest-usable-coverage wins, namespaces isolate, TTL
+  expires;
+- two-engine transfer: engines A and B share NOTHING but a (monkeypatched)
+  transport; a prefix computed on A, spilled to its host tier, and fetched
+  by B over the fabric streams BYTE-IDENTICALLY to a cold run on B — in
+  the contiguous, paged, and int8-KV layouts — with the import visible in
+  B's fabric counters, the hit attributed to source="fabric", and (paged)
+  zero unpage/commit-copy bytes;
+- failure semantics: an unreachable peer or a tampered transfer degrades
+  to a cold prefill with the SAME tokens — counted as a transfer error,
+  never an exception, never a wrong token;
+- disaggregation: `prefill_export` on A returns a handle whose offer on B
+  (`fabric_offer` + `prefetch_fabric_offer`) imports the KV before any
+  request runs, so B's request pays zero further fabric traffic.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from xotorch_tpu.download.shard_download import LocalShardDownloader
+from xotorch_tpu.fabric import (
+  OfferDirectory, entry_key, pack_entry, shard_key, unpack_entry,
+)
+from xotorch_tpu.fabric import server as fabric_server
+from xotorch_tpu.inference.jax_engine.engine import JAXShardInferenceEngine
+from xotorch_tpu.inference.jax_engine.kv_offload import HostKVStore, entry_digest
+from xotorch_tpu.inference.shard import Shard
+
+from tests.test_model_equivalence import TINY_LLAMA_CFG, make_hf_checkpoint
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+  return make_hf_checkpoint(tmp_path_factory.mktemp("fabric"), TINY_LLAMA_CFG, seed=3)
+
+
+def _full_shard():
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  return Shard("m", 0, n - 1, n)
+
+
+def _engine(model_dir):
+  return JAXShardInferenceEngine(LocalShardDownloader({"m": model_dir}), dtype="float32")
+
+
+def _env(monkeypatch, paged: bool, **extra):
+  monkeypatch.setenv("XOT_SEED", "7")
+  monkeypatch.setenv("XOT_CACHE_LEN", "16")
+  monkeypatch.setenv("XOT_PREFIX_CACHE_MIN", "16")
+  monkeypatch.setenv("XOT_KV_HOST_BYTES", str(64 << 20))
+  monkeypatch.setenv("XOT_PAGED_KV", "1" if paged else "0")
+  monkeypatch.setenv("XOT_KV_PAGE", "16")
+  monkeypatch.setenv("XOT_KV_POOL_TOKENS", "512")
+  for k, v in extra.items():
+    monkeypatch.setenv(k, v)
+
+
+PROMPT_A = np.array([np.arange(44) % 250 + 1], dtype=np.int64)
+PROMPT_B = np.concatenate([PROMPT_A, np.array([[99, 98, 97, 96]])], axis=1)
+
+
+async def _generate(eng, rid, prompt, chunks=2, chunk_size=8):
+  shard = _full_shard()
+  tok, _ = await eng.infer_sample_tensor(rid, shard, prompt, temp=0.0)
+  toks = [int(tok)]
+  for _ in range(chunks):
+    out = await eng.generate_chunk(rid, shard, toks[-1], chunk_size, temp=0.0)
+    toks.extend(int(t) for t in out)
+  return toks
+
+
+def _wire(client, src_store):
+  """Point a FabricClient's transport at a sibling's HostKVStore in-process:
+  the exact server surface the API wires up (match_response/serve_entry),
+  with the match response pushed through JSON like the real wire."""
+
+  def post_json(url, body):
+    assert url.endswith("/v1/kv/match")
+    resp = fabric_server.match_response(
+      src_store, body["shard"], np.asarray(body["toks"], np.int64), int(body["limit"]))
+    return json.loads(json.dumps(resp))
+
+  def get_bytes(url):
+    key = url.rsplit("/", 1)[1].split("?", 1)[0]
+    blob = fabric_server.serve_entry(src_store, key)
+    if blob is None:
+      raise ValueError(f"404: unknown KV entry {key}")
+    return blob
+
+  client._post_json = post_json
+  client._get_bytes = get_bytes
+
+
+async def _spilled_engine_a(model_dir):
+  """Engine A with PROMPT_A's prefix computed and spilled to its host tier."""
+  eng_a = _engine(model_dir)
+  await _generate(eng_a, "ra", PROMPT_A)
+  eng_a._free_device_memory()
+  assert eng_a._host_kv is not None and len(eng_a._host_kv) == 1
+  return eng_a
+
+
+# ---------------------------------------------------------------- pure half
+
+
+def test_entry_key_stable_and_namespaced():
+  toks = np.arange(8, dtype=np.int64)
+  shard = _full_shard()
+  assert entry_key(shard, toks) == entry_key(shard, toks.astype(np.int32))
+  assert entry_key(shard, toks) != entry_key(shard, toks + 1)
+  n = TINY_LLAMA_CFG["num_hidden_layers"]
+  assert entry_key(shard, toks) != entry_key(Shard("m", 0, 0, n), toks)
+  assert shard_key("ctx-a") == "ctx-a"  # plain keys stringify
+
+
+def test_pack_unpack_roundtrip_preserves_bytes():
+  import ml_dtypes
+  toks = np.arange(12, dtype=np.int64)
+  data = {
+    "k": np.arange(2 * 1 * 8 * 2 * 4, dtype=np.float32).reshape(2, 1, 8, 2, 4),
+    "v": np.ones((2, 1, 8, 2, 4), dtype=ml_dtypes.bfloat16),
+    "k_scale": np.full((2, 1, 8, 2, 1), 0.5, dtype=np.float32),
+  }
+  payload = {"toks": toks, "length": 8, "data": data,
+             "digest": entry_digest(toks, 8, data)}
+  out = unpack_entry(pack_entry(payload))
+  assert out["length"] == 8 and out["digest"] == payload["digest"]
+  np.testing.assert_array_equal(out["toks"], toks)
+  for name, arr in data.items():
+    assert out["data"][name].dtype == arr.dtype
+    np.testing.assert_array_equal(np.asarray(out["data"][name]), np.asarray(arr))
+  # The round-tripped digest re-verifies — the import gate would accept it.
+  assert entry_digest(out["toks"], out["length"], out["data"]) == payload["digest"]
+
+
+def test_unpack_rejects_torn_blobs():
+  toks = np.arange(4, dtype=np.int64)
+  data = {"k": np.ones((1, 1, 4, 1, 2), np.float32)}
+  blob = pack_entry({"toks": toks, "length": 4, "data": data,
+                     "digest": entry_digest(toks, 4, data)})
+  for torn in (b"NOTKV" + blob, blob[:6], blob[:16], blob[:-8]):
+    with pytest.raises(ValueError):
+      unpack_entry(torn)
+
+
+def test_export_import_verifies_digest():
+  toks = np.arange(16, dtype=np.int64)
+  data = {"k": np.full((2, 1, 16, 2, 4), 3.0, np.float32),
+          "v": np.full((2, 1, 16, 2, 4), 4.0, np.float32)}
+  a, b = HostKVStore(max_bytes=1 << 20), HostKVStore(max_bytes=1 << 20)
+  assert a.put("ctx", toks, data, 16) > 0
+  payload = a.export_entry("ctx", toks)
+  assert payload is not None and a.export_entry("ctx", toks + 1) is None
+
+  # Clean import: entry lands with source="fabric" and matches.
+  assert b.import_entry("ctx", payload, source="fabric") > 0
+  entry, common = b.match("ctx", np.arange(20, dtype=np.int64), 19)
+  assert common == 16 and entry.source == "fabric"
+
+  # Tampered bytes: digest mismatch, rejected, store untouched.
+  c = HostKVStore(max_bytes=1 << 20)
+  torn = dict(payload)
+  torn["data"] = dict(payload["data"])
+  torn["data"]["k"] = np.array(torn["data"]["k"])
+  torn["data"]["k"][0, 0, 0, 0, 0] += 1.0
+  assert c.import_entry("ctx", torn) == 0
+  assert len(c) == 0
+
+
+def test_offer_directory_coverage_ttl_and_namespaces():
+  d = OfferDirectory(ttl_s=120.0)
+  probe = np.arange(40, dtype=np.int64)
+  d.record("ctx", probe[:16], 16, 100, "http://p1")
+  d.record("ctx", probe[:32], 24, 200, "http://p2/")  # covers 24 of 32 matched
+  d.record("other", probe, 40, 300, "http://p3")
+  offer, usable = d.best("ctx", probe, limit=39)
+  assert offer.url == "http://p2" and usable == 24  # min(match, covered), no slash
+  assert d.best("missing", probe, 39) is None
+  # Expiry: force every offer past the TTL.
+  for o in d._offers.values():
+    o.at -= 121.0
+  assert d.best("ctx", probe, 39) is None and len(d) == 0
+
+
+# ----------------------------------------- two-engine cross-replica transfer
+
+
+async def _cross_replica_case(tiny_model_dir, monkeypatch, paged, saved,
+                              **extra_env):
+  """A computes + spills PROMPT_A; B fetches it over the fabric and must
+  stream PROMPT_B byte-identically to its own cold run."""
+  _env(monkeypatch, paged=paged, **extra_env)
+  want_b = await _generate(_engine(tiny_model_dir), "cold-ref", PROMPT_B)
+  eng_a = await _spilled_engine_a(tiny_model_dir)
+
+  monkeypatch.setenv("XOT_FABRIC_PEERS", "http://peer-a")
+  eng_b = _engine(tiny_model_dir)
+  _wire(eng_b._fabric_client(), eng_a._host_kv)
+
+  got_b = await _generate(eng_b, "rb", PROMPT_B)
+  assert got_b == want_b, f"fabric-warm {got_b} != cold {want_b}"
+  assert eng_b._fabric_hits == 1 and eng_b._fabric_errors == 0
+  assert eng_b._fabric_bytes > 0
+  assert eng_b._host_kv_hits == 1
+  assert eng_b._host_hits_by_source == {"fabric": 1}
+  assert eng_b._prefix_hits == 1 and eng_b._prefix_tokens_saved == saved
+  if paged:
+    # The remote hit took the native paged restore: fresh pool pages, no
+    # paged->contiguous gather, no contiguous commit copy.
+    assert eng_b._unpage_calls == 0 and eng_b._commit_copy_bytes == 0
+  return eng_a, eng_b
+
+
+async def test_cross_replica_fetch_contiguous(tiny_model_dir, monkeypatch):
+  await _cross_replica_case(tiny_model_dir, monkeypatch, paged=False, saved=44)
+
+
+async def test_cross_replica_fetch_paged(tiny_model_dir, monkeypatch):
+  eng_a, eng_b = await _cross_replica_case(
+    tiny_model_dir, monkeypatch, paged=True, saved=32)
+  # The imported entry is a first-class host entry on B: a SECOND engine-B
+  # request reuses it through the native HBM warm set with no new fetch.
+  fabric_bytes = eng_b._fabric_bytes
+  await _generate(eng_b, "rb2", PROMPT_B)
+  assert eng_b._fabric_bytes == fabric_bytes
+
+
+async def test_cross_replica_fetch_int8_kv(tiny_model_dir, monkeypatch):
+  """int8-KV: the scale leaves travel with K/V and the imported entry
+  restores under the quantized layout byte-identically."""
+  eng_a, eng_b = await _cross_replica_case(
+    tiny_model_dir, monkeypatch, paged=True, saved=32, XOT_KV_QUANT="int8")
+  entry, _ = eng_a._host_kv.match(_full_shard(), PROMPT_A.reshape(-1), 43)
+  assert {"k", "v", "k_scale", "v_scale"} <= set(entry.data)
+
+
+async def test_fetch_failure_degrades_to_cold_prefill(tiny_model_dir, monkeypatch):
+  """An unreachable serving peer (match answers, transfer dies) is a counted
+  transfer error and a cold prefill — same tokens, no exception."""
+  _env(monkeypatch, paged=True)
+  want_b = await _generate(_engine(tiny_model_dir), "cold-ref", PROMPT_B)
+  eng_a = await _spilled_engine_a(tiny_model_dir)
+
+  monkeypatch.setenv("XOT_FABRIC_PEERS", "http://peer-a")
+  eng_b = _engine(tiny_model_dir)
+  client = eng_b._fabric_client()
+  _wire(client, eng_a._host_kv)
+
+  def dead_transfer(url):
+    raise OSError("connection reset mid-transfer")
+
+  client._get_bytes = dead_transfer
+  got_b = await _generate(eng_b, "rb", PROMPT_B)
+  assert got_b == want_b
+  assert eng_b._fabric_errors >= 1 and eng_b._fabric_hits == 0
+  assert eng_b._host_kv_hits == 0 and eng_b._fabric_bytes == 0
+
+
+async def test_tampered_transfer_is_dropped_not_served(tiny_model_dir, monkeypatch):
+  """A transfer whose bytes were corrupted in flight parses but fails the
+  digest recheck at import: dropped like a torn host entry, cold prefill,
+  never a wrong token."""
+  _env(monkeypatch, paged=True)
+  want_b = await _generate(_engine(tiny_model_dir), "cold-ref", PROMPT_B)
+  eng_a = await _spilled_engine_a(tiny_model_dir)
+
+  monkeypatch.setenv("XOT_FABRIC_PEERS", "http://peer-a")
+  eng_b = _engine(tiny_model_dir)
+  client = eng_b._fabric_client()
+  _wire(client, eng_a._host_kv)
+  real_get = client._get_bytes
+
+  def bitflip(url):
+    blob = bytearray(real_get(url))
+    blob[-1] ^= 0xFF  # last KV byte: structure parses, content lies
+    return bytes(blob)
+
+  client._get_bytes = bitflip
+  got_b = await _generate(eng_b, "rb", PROMPT_B)
+  assert got_b == want_b
+  assert eng_b._fabric_errors == 1 and eng_b._fabric_hits == 0
+  assert eng_b._host_kv_hits == 0
+  assert len(eng_b._host_kv_store()) == 0  # the lie never entered the store
+
+
+# ------------------------------------------- offers + disaggregated prefill
+
+
+async def test_offer_path_fetches_without_probing(tiny_model_dir, monkeypatch):
+  """A recorded offer resolves coverage locally: the fetch GETs the entry
+  directly — zero match probes — and the anticipatory pull imports it
+  BEFORE any request, so the request itself pays no fabric traffic."""
+  _env(monkeypatch, paged=True)
+  want_b = await _generate(_engine(tiny_model_dir), "cold-ref", PROMPT_B)
+  eng_a = await _spilled_engine_a(tiny_model_dir)
+  entry, _ = eng_a._host_kv.match(_full_shard(), PROMPT_A.reshape(-1), 43)
+
+  eng_b = _engine(tiny_model_dir)
+  await eng_b._ensure_ctx(_full_shard())
+  # No static peers: the offer is the ONLY way B can find A.
+  shard = _full_shard()
+  assert eng_b.fabric_offer(shard, PROMPT_A.reshape(-1), entry.length,
+                            entry.nbytes, "http://peer-a") is True
+  client = eng_b._fabric_client()
+  _wire(client, eng_a._host_kv)
+
+  def no_probe(url, body):
+    raise AssertionError("offer-directory hit must not probe peers")
+
+  client._post_json = no_probe
+  assert await eng_b.prefetch_fabric_offer(shard, PROMPT_A.reshape(-1)) is True
+  assert eng_b._fabric_hits == 1 and len(eng_b._host_kv_store()) == 1
+  fabric_bytes = eng_b._fabric_bytes
+
+  got_b = await _generate(eng_b, "rb", PROMPT_B)
+  assert got_b == want_b
+  assert eng_b._fabric_bytes == fabric_bytes  # pull happened pre-request
+
+
+async def test_prefill_export_returns_servable_handle(tiny_model_dir, monkeypatch):
+  """Disaggregated prefill: `prefill_export` on A prefills the prompt into
+  A's HOST tier and returns a handle; offering that handle at B chains into
+  the same byte-identical decode — the full prefill/decode split minus the
+  HTTP hop (the wire is exercised by tools/soak --fabric-smoke)."""
+  _env(monkeypatch, paged=True)
+  want_b = await _generate(_engine(tiny_model_dir), "cold-ref", PROMPT_B)
+
+  eng_a = _engine(tiny_model_dir)
+  shard = _full_shard()
+  ctx_a = await eng_a._ensure_ctx(shard)
+
+  class _Tok:
+    eos_token_id = 0
+
+    def encode(self, prompt):
+      assert prompt == "prompt a"
+      return PROMPT_A.reshape(-1)
+
+  ctx_a.tokenizer = _Tok()
+  handle = await eng_a.prefill_export(shard, "prompt a")
+  assert handle is not None
+  assert handle["key"] == entry_key(shard, np.asarray(handle["tokens"], np.int64))
+  assert handle["length"] >= 32 and handle["nbytes"] > 0
+  assert len(eng_a._host_kv) == 1           # exported via the host tier
+  assert "fabric-prefill" not in str(eng_a._contexts[shard].states)  # rid cleaned
+
+  eng_b = _engine(tiny_model_dir)
+  await eng_b._ensure_ctx(shard)
+  assert eng_b.fabric_offer(shard, handle["tokens"], handle["length"],
+                            handle["nbytes"], "http://peer-a") is True
+  _wire(eng_b._fabric_client(), eng_a._host_kv)
+  assert await eng_b.prefetch_fabric_offer(shard, handle["tokens"]) is True
+
+  got_b = await _generate(eng_b, "rb", PROMPT_B)
+  assert got_b == want_b, f"disaggregated {got_b} != cold {want_b}"
+  assert eng_b._fabric_hits == 1 and eng_b._host_hits_by_source == {"fabric": 1}
+
+
+async def test_fabric_disabled_without_peers_or_offers(tiny_model_dir, monkeypatch):
+  """No XOT_FABRIC_PEERS and no offers: the fabric costs nothing — no
+  client is ever built and the miss path is the plain local one."""
+  _env(monkeypatch, paged=True)
+  eng = _engine(tiny_model_dir)
+  await _generate(eng, "ra", PROMPT_A)
+  assert eng._fabric_client() is None
+  assert eng._fabric_hits == 0 and eng._fabric_misses == 0

@@ -381,6 +381,123 @@ async def test_router_spills_on_replica_429(monkeypatch):
     await _teardown_router(router, rclient, clients)
 
 
+async def test_router_spill_preannounces_prefix_at_target(monkeypatch):
+  """A spill target is not the affinity owner of the request's prefix, so
+  the router must FORCE the /v1/prefetch pre-announce there even though the
+  target is idle (no queue wait) — the prefetch is what triggers the
+  target's cross-replica fabric pull."""
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "1")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "0")
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "session-9 hi"}]}
+    views = [r.view() for r in router.routable()]
+    from xotorch_tpu.router import prefix_key as pk, route as rt
+    target, _ = rt(pk(body), views, 0)
+    target_node = nodes[int(target[1:])]
+    other_node = nodes[1 - int(target[1:])]
+    announced = []
+
+    async def spy_prefetch(shard, prompt):
+      announced.append(prompt)
+      return False
+
+    other_node.prefetch_prompt = spy_prefetch
+    target_node.admission.admit("occupier")
+    assert router.prefetch_announced_total == 0
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    # The announce is fire-and-forget on both sides; give it a tick.
+    for _ in range(40):
+      if router.prefetch_announced_total and announced:
+        break
+      await asyncio.sleep(0.05)
+    # >= 1: the full affinity target may legitimately get its own (waiting)
+    # announce too; the spy proves the IDLE spill target got the forced one.
+    assert router.prefetch_announced_total >= 1
+    assert announced and "session-9 hi" in announced[0]
+    target_node.admission.release()
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+def test_router_prefill_role_excluded_from_routable():
+  """XOT_FABRIC_ROLE=prefill replicas (role polled off /v1/queue) never
+  enter the routable set — they answer with KV handles, not token streams
+  — but stay visible to the chaining path."""
+  from xotorch_tpu.router.app import RouterApp
+  router = RouterApp(["http://a", "http://b"])
+  for rep in router.replicas.values():
+    rep.reachable = True
+    rep.queue = {}
+  assert sorted(r.name for r in router.routable()) == ["r0", "r1"]
+  router.replicas["r0"].role = "prefill"
+  assert [r.name for r in router.routable()] == ["r1"]
+  assert [r.name for r in router.prefill_replicas()] == ["r0"]
+
+
+async def test_router_chain_degrades_to_plain_forward(monkeypatch):
+  """A prefill-role replica that cannot produce a KV handle (here: a dummy
+  replica serving a normal completion) costs one counted chain failure and
+  NOTHING else — the request is forwarded plainly and answers 200."""
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    router._poll_task.cancel()  # hold the role assignment still
+    router.replicas["r0"].role = "prefill"
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    assert (await resp.json())["object"] == "chat.completion"
+    assert router.fabric_chain_failures_total == 1
+    assert router.fabric_chained_total == 0
+    assert router.replicas["r1"].routed_total == 1  # decode leg, not r0
+    status = await (await rclient.get("/v1/router")).json()
+    assert status["prefill_replicas"] == ["r0"]
+    assert status["fabric_chain_failures_total"] == 1
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+async def test_queue_endpoint_reports_fabric_role(monkeypatch):
+  monkeypatch.setenv("XOT_FABRIC_ROLE", "decode")
+  client, node, _ = await _api_client()
+  try:
+    q = await (await client.get("/v1/queue")).json()
+    assert q["fabric_role"] == "decode"
+  finally:
+    await client.close()
+
+
+async def test_kv_fabric_endpoints_validate_and_miss_cleanly():
+  """The /v1/kv surface on a replica with no host tier: probes answer a
+  clean miss (never 500), unknown keys 404, malformed bodies 400, and an
+  offer to an engine without a fabric is acknowledged-but-declined."""
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.post("/v1/kv/match",
+                             json={"shard": "m:0:1:2", "toks": [1, 2, 3]})
+    assert resp.status == 200 and (await resp.json())["key"] is None
+    resp = await client.post("/v1/kv/match", json={"shard": "m", "toks": []})
+    assert resp.status == 400
+    resp = await client.post("/v1/kv/match", json={"toks": [1]})
+    assert resp.status == 400
+    resp = await client.post("/v1/kv/match", json=[1, 2])
+    assert resp.status == 400
+    resp = await client.get("/v1/kv/deadbeef")
+    assert resp.status == 404
+    resp = await client.get("/v1/kv/deadbeef?payload=1")
+    assert resp.status == 404
+    # The dummy engine has no fabric: the offer is declined, not an error.
+    resp = await client.post("/v1/kv/offer", json={
+      "model": "dummy", "tokens": [1, 2, 3], "length": 3, "nbytes": 10,
+      "url": "http://peer"})
+    assert resp.status == 202 and (await resp.json())["accepted"] is False
+    resp = await client.post("/v1/kv/offer", json={"model": "dummy", "url": "x"})
+    assert resp.status == 400
+  finally:
+    await client.close()
+
+
 def test_least_loaded_shared_helper():
   from xotorch_tpu.router import least_loaded
   assert least_loaded([]) is None

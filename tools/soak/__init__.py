@@ -513,6 +513,14 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
     out["router_routed_while_out"] = float(
       sum((router.get("routed_while_out") or {}).values()))
     out["router_prefetch_announced"] = float(router.get("prefetch_announced_total", 0))
+  fabric = report.get("fabric")
+  if fabric is not None:
+    out["kv_fabric_hits"] = float(fabric.get("hits") or 0)
+    out["kv_fabric_misses"] = float(fabric.get("misses") or 0)
+    out["kv_fabric_bytes"] = float(fabric.get("bytes") or 0)
+    out["fabric_transfer_failures"] = float(fabric.get("errors") or 0)
+    out["fabric_chained"] = float(fabric.get("router_chained") or 0)
+    out["fabric_chain_failures"] = float(fabric.get("router_chain_failures") or 0)
   aborts = report.get("aborts") or {}
   out["false_aborts"] = float(len(aborts.get("false") or ()))
   leaks = report.get("leaks") or {}
@@ -609,6 +617,23 @@ def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
         reasons.append("router: injected gray failure drove no replica to draining")
       if router.get("readmits_total", 0) < 1:
         reasons.append("router: no drained replica was readmitted after the fault cleared")
+  fabric = report.get("fabric")
+  if fabric is not None:
+    # The fabric green bar: zero dropped transfers (a torn/stale blob must
+    # degrade to cold prefill in unit tests; two healthy processes on
+    # localhost have no excuse to tear one), and — when the run expects a
+    # hit — the router actually chained through the prefill replica and at
+    # least one REAL cross-replica import landed in the load window. Chain
+    # FAILURES are informational (the documented degradation is a plain
+    # cold forward, not an error).
+    if float(fabric.get("errors") or 0) > 0:
+      reasons.append(f"fabric: {float(fabric.get('errors') or 0):g} transfer(s) "
+                     "dropped (peer error, torn blob, or digest mismatch)")
+    if fabric.get("expect_hit"):
+      if float(fabric.get("router_chained") or 0) < 1:
+        reasons.append("fabric: router chained no request through the prefill replica")
+      if float(fabric.get("hits") or 0) < 1:
+        reasons.append("fabric: no cross-replica KV import landed during the load window")
   report["reasons"] = reasons
   report["verdict"] = "green" if not reasons else "red"
   report["metrics"] = flatten_metrics(report)

@@ -64,6 +64,12 @@ def main(argv=None) -> int:
                            "above-capacity overload burst (shed as 429s, never "
                            "watchdog aborts) and an injected gray failure one "
                            "replica is drained for and readmitted after")
+  parser.add_argument("--fabric-smoke", action="store_true",
+                      help="disaggregated-serving smoke: router + a prefill "
+                           "replica (out of rotation) + a decode replica; every "
+                           "fresh prompt chains prefill -> KV offer -> decode "
+                           "and the verdict requires >= 1 real cross-replica "
+                           "KV import with zero dropped transfers")
   parser.add_argument("--seconds", type=float, default=None)
   parser.add_argument("--rps", type=float, default=None)
   parser.add_argument("--procs", type=int, default=None)
@@ -104,9 +110,11 @@ def main(argv=None) -> int:
     log_dir=args.log_dir,
   )
   cfg.tag = args.tag or ("smoke" if args.smoke
-                         else "router" if args.router_smoke else "run")
-  if args.smoke and args.router_smoke:
-    print("soak: --smoke and --router-smoke are mutually exclusive", file=sys.stderr)
+                         else "router" if args.router_smoke
+                         else "fabric" if args.fabric_smoke else "run")
+  if sum((args.smoke, args.router_smoke, args.fabric_smoke)) > 1:
+    print("soak: --smoke, --router-smoke and --fabric-smoke are mutually exclusive",
+          file=sys.stderr)
     return 2
   if args.router_smoke:
     # The front-door acceptance shape: two independent single-node replicas
@@ -135,6 +143,27 @@ def main(argv=None) -> int:
     # rate-shaped burst gets absorbed by a fast CI runner).
     cfg.overload = {"at_s": 8.0, "count": 24}
     cfg.gray = {"node": 1, "at_s": 24.0, "hold_s": 24.0, "delay_s": 10.0}
+  if args.fabric_smoke:
+    # The disaggregated-serving acceptance shape: replica 0 boots as a
+    # PREFILL replica (excluded from rotation, answers with kv.handles),
+    # replica 1 decodes, and the router awaits the prefill -> offer chain
+    # before every forward. No injected faults: the green bar here is the
+    # fabric itself — at least one real cross-replica KV import, zero
+    # dropped transfers — on top of the usual reconciliation / false-abort
+    # / leak rules. recon_tol_s is wide because the awaited chain (prefill
+    # compute + offer hop) is client-visible wall time the decode server's
+    # histograms structurally never see.
+    cfg.router = True
+    cfg.fabric = True
+    cfg.replicas = 2
+    if args.seconds is None:
+      cfg.seconds = 90.0
+    if args.rps is None:
+      cfg.rate_rps = 0.3
+    if args.max_tokens is None:
+      cfg.max_tokens = 6
+    if args.recon_tol_s is None:
+      cfg.recon_tol_s = 30.0
   if args.smoke:
     # The acceptance shape: one mid-run kill of the non-API node, load
     # sized so a laptop/CI runner finishes the whole arc in a few minutes.
@@ -196,6 +225,12 @@ def main(argv=None) -> int:
     print(f"  router: drains={rt.get('drains_total')} readmits={rt.get('readmits_total')} "
           f"routed_while_out={sum((rt.get('routed_while_out') or {}).values())} "
           f"prefetch_announced={rt.get('prefetch_announced_total')}")
+  fb = report.get("fabric")
+  if fb is not None:
+    print(f"  fabric: hits={fb.get('hits')} misses={fb.get('misses')} "
+          f"errors={fb.get('errors')} bytes={fb.get('bytes')} "
+          f"chained={fb.get('router_chained')} "
+          f"chain_failures={fb.get('router_chain_failures')}")
   for reason in report.get("reasons", []):
     print(f"  RED: {reason}")
   rc = 0 if report.get("verdict") == "green" else 1

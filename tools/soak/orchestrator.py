@@ -120,6 +120,15 @@ ROUTER_REPLICA_ENV = {
   "XOT_DRIFT_PENDING_S": "600",
 }
 
+# Extra child env for FABRIC-mode replicas (layered on the router env):
+# a low prefix floor so every loadgen prompt bucket — the 8-word head
+# included — clears the prefill-export / host-import minimum. The smoke
+# must chain and import on its REAL traffic mix, not only the long-prompt
+# tail; the host tier itself rides its default byte budget.
+FABRIC_REPLICA_ENV = {
+  "XOT_PREFIX_CACHE_MIN": "8",
+}
+
 # Router process env: CI-timescale cadences (1 s polls, 5 s minimum
 # out-time, 2 canaries) so drain -> probe -> readmit completes inside a
 # short smoke window.
@@ -171,6 +180,12 @@ class SoakConfig:
   # failure the router must drain and later readmit.
   router: bool = False
   replicas: int = 2
+  # fabric=True (implies router): disaggregated prefill/decode roles —
+  # replica 0 boots XOT_FABRIC_ROLE=prefill (out of rotation, serves
+  # kv.handles), the rest decode, peers cross-wired; the report gains a
+  # `fabric` section (cross-replica import deltas + router chain counters)
+  # with its own green bar (>= 1 real import, zero dropped transfers).
+  fabric: bool = False
   overload: Optional[dict] = None
   gray: Optional[dict] = None
   router_port: int = 53590
@@ -239,6 +254,15 @@ class SoakRing:
                **self.cfg.alert_env}
       if self.cfg.router:
         extra.update(self.cfg.replica_env)
+      if self.cfg.fabric:
+        # Disaggregated roles: replica 0 prefills and offers, the rest
+        # decode. Peers are cross-wired so an entry fetch resolves by URL
+        # even when the offer path is not what found it.
+        peers = ",".join(f"http://127.0.0.1:{self.cfg.api_base + j}"
+                         for j in range(len(self.names)) if j != i)
+        extra.update({"XOT_FABRIC_ROLE": "prefill" if i == 0 else "decode",
+                      "XOT_FABRIC_PEERS": peers,
+                      **FABRIC_REPLICA_ENV})
       self.procs[name] = spawn_node(
         name, self.cfg.api_base + i, udp, udp,
         self.cfg.grpc_base + i, self.logs[name], model=self.cfg.model,
@@ -271,11 +295,21 @@ class SoakRing:
                120, f"{name} sees {n}-node ring", proc=self.procs[name],
                log_path=self._log_path(name))
     if self.cfg.router:
+      # Fabric mode deliberately keeps the prefill replica OUT of rotation,
+      # so the router advertises one fewer routable replica — and the chain
+      # path needs it discovered AS prefill before any load arrives.
+      want = len(self.names) - (1 if self.cfg.fabric else 0)
       wait_for(lambda: http_get(self.cfg.router_port, "/healthcheck")
-               .get("routable") == len(self.names),
-               60, f"router routes all {len(self.names)} replicas",
+               .get("routable") == want,
+               60, f"router routes {want} of {len(self.names)} replicas",
                proc=self.router_proc,
                log_path=getattr(self.router_log, "name", None))
+      if self.cfg.fabric:
+        wait_for(lambda: len(http_get(self.cfg.router_port, "/v1/router")
+                             .get("prefill_replicas") or []) >= 1,
+                 60, "router discovers the prefill replica",
+                 proc=self.router_proc,
+                 log_path=getattr(self.router_log, "name", None))
 
   def _log_path(self, name: str):
     f = self.logs.get(name)
@@ -559,6 +593,10 @@ async def run_soak(cfg: SoakConfig) -> dict:
   import tempfile
   log_dir = Path(cfg.log_dir) if cfg.log_dir else Path(tempfile.mkdtemp(prefix="xot_soak_"))
   log_dir.mkdir(parents=True, exist_ok=True)
+  if cfg.fabric:
+    # Disaggregated roles only make sense behind the front door: the
+    # router is what chains prefill -> offer -> decode per request.
+    cfg.router = True
   if cfg.gray is not None:
     # The gray-failure drain phase: a timed ProcessPrompt delay on one
     # replica — requests there get slower (visible to ITS burn-rate rules
@@ -784,7 +822,7 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
       "model": cfg.model, "seed": cfg.seed, "recon_tol_s": cfg.recon_tol_s,
       "restarts": cfg.restarts,
       "router": cfg.router, "replicas": cfg.replicas if cfg.router else None,
-      "overload": cfg.overload, "gray": cfg.gray,
+      "fabric": cfg.fabric, "overload": cfg.overload, "gray": cfg.gray,
       "faults": [{"kind": p.kind, "node": p.node, "at_s": p.at_s,
                   "grace_s": p.grace_s} for p in cfg.faults],
     },
@@ -831,6 +869,30 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
     report["router"] = verdicts.summarize_router(
       ring.last_router, ring.router_track, expect_drain=cfg.gray is not None,
       baseline=base_router)
+  if cfg.fabric:
+    # Load-window deltas of the cross-replica KV fabric counters (summed
+    # over replicas — only the decode side imports, but the sum stays
+    # correct if roles ever mix) plus the router's chain bookkeeping.
+    rt, base_rt = (ring.last_router or {}), (base_router or {})
+
+    def fabric_delta(prom: str) -> float:
+      return (_sum_counter(ring.last_metrics, prom)
+              - _sum_counter(base_metrics, prom))
+
+    report["fabric"] = {
+      "hits": fabric_delta("xot_kv_fabric_hits_total"),
+      "misses": fabric_delta("xot_kv_fabric_misses_total"),
+      "errors": fabric_delta("xot_kv_fabric_errors_total"),
+      "bytes": fabric_delta("xot_kv_fabric_bytes_total"),
+      "router_chained": max(0, int(rt.get("fabric_chained_total") or 0)
+                            - int(base_rt.get("fabric_chained_total") or 0)),
+      "router_chain_failures": max(
+        0, int(rt.get("fabric_chain_failures_total") or 0)
+        - int(base_rt.get("fabric_chain_failures_total") or 0)),
+      # The smoke's whole point: a disaggregated ring that never imports
+      # KV is just a slow router, so the verdict requires a real hit.
+      "expect_hit": True,
+    }
   if not drained:
     leaked = report["leaks"]
     leaked["ok"] = False

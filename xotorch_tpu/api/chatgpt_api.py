@@ -175,6 +175,13 @@ class ChatGPTAPI:
     # the router names a queued request's prompt so the host-to-HBM warm
     # prefix restore starts while the request is still in flight to us.
     r.add_post("/v1/prefetch", self.handle_post_prefetch)
+    # Fleet-wide KV fabric surface (xotorch_tpu/fabric): content-addressed
+    # host-tier entry manifests + packed-entry streaming, sibling match
+    # probes, and offer announces (router chaining and spill pre-announce
+    # land offers here; a sibling's miss path fetches entries back out).
+    r.add_post("/v1/kv/match", self.handle_post_kv_match)
+    r.add_post("/v1/kv/offer", self.handle_post_kv_offer)
+    r.add_get("/v1/kv/{key}", self.handle_get_kv)
     r.add_post("/v1/trace/device/start", self.handle_device_trace_start)
     r.add_post("/v1/trace/device/stop", self.handle_device_trace_stop)
     r.add_get("/", self.handle_root)
@@ -490,6 +497,9 @@ class ChatGPTAPI:
       # Ring-visible in-flight work on THIS node: the router's drain
       # completion signal even when the gate itself is disabled.
       "active_requests": len(self.node.outstanding_requests),
+      # Disaggregated serving role (XOT_FABRIC_ROLE): the router keeps
+      # `prefill` replicas out of its routable set and chains through them.
+      "fabric_role": knobs.get_str("XOT_FABRIC_ROLE"),
       "admission": local, "cluster": cluster,
     })
 
@@ -531,6 +541,108 @@ class ChatGPTAPI:
     spawn_detached(self.node.prefetch_prompt(shard, prompt))
     return web.json_response({"accepted": True, "model": model}, status=202)
 
+  # ---------------------------------------------------------- KV fabric
+
+  def _host_kv_store(self):
+    """The engine's host KV tier, or None (non-JAX engine, tier disabled,
+    or nothing ever spilled). The fabric serves FROM this store only —
+    entries in HBM but never spilled are not yet exportable."""
+    return getattr(self.node.inference_engine, "_host_kv", None)
+
+  async def handle_post_kv_match(self, request):
+    """Fabric probe: the longest usable resident host-tier prefix for a
+    sibling's token ids. Body {shard, toks[, limit]}; a clean miss is
+    {"key": null} with HTTP 200 — the prober prefills cold, no error."""
+    try:
+      data = await request.json() if request.can_read_body else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": "body must be JSON"}}, status=400)
+    if (not isinstance(data, dict) or not isinstance(data.get("shard"), str)
+        or not isinstance(data.get("toks"), list) or not data["toks"]
+        or not all(isinstance(t, int) and not isinstance(t, bool) for t in data["toks"])):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "body must carry `shard` (string) and `toks` (list of ints)"}},
+        status=400)
+    store = self._host_kv_store()
+    if store is None or len(store) == 0:
+      return web.json_response({"key": None})
+    import numpy as np
+    from xotorch_tpu.fabric import server as fabric_server
+    toks = np.asarray(data["toks"], dtype=np.int64)
+    limit = int(data.get("limit") or max(0, toks.shape[0] - 1))
+    resp = await asyncio.get_running_loop().run_in_executor(
+      None, fabric_server.match_response, store, data["shard"], toks, limit)
+    return web.json_response(resp)
+
+  async def handle_get_kv(self, request):
+    """Fabric serve: one content-addressed host-tier entry — its manifest
+    (leaf table, covered length, digest), or with `?payload=1` the packed
+    wire blob in the canonical contiguous layout. 404 for any unknown key,
+    including one evicted between a peer's match and its fetch — the peer
+    treats that as a miss and prefills cold."""
+    key = request.match_info["key"]
+    store = self._host_kv_store()
+    if store is None or len(store) == 0:
+      return web.json_response({"detail": "no host KV tier resident"}, status=404)
+    from xotorch_tpu.fabric import server as fabric_server
+    loop = asyncio.get_running_loop()
+    if request.query.get("payload"):
+      t0 = time.monotonic()
+      # Packing is a pure host memcpy but can be tens of MB — off the loop.
+      blob = await loop.run_in_executor(None, fabric_server.serve_entry, store, key)
+      if blob is None:
+        return web.json_response({"detail": f"unknown KV entry {key}"}, status=404)
+      self.node.flight.record("fabric.serve", None, key=key[:16], bytes=len(blob),
+                              secs=round(time.monotonic() - t0, 4))
+      return web.Response(body=blob, content_type="application/octet-stream")
+    man = await loop.run_in_executor(None, fabric_server.manifest, store, key)
+    if man is None:
+      return web.json_response({"detail": f"unknown KV entry {key}"}, status=404)
+    return web.json_response(man)
+
+  async def handle_post_kv_offer(self, request):
+    """Fabric announce: peer `url` holds a host-tier entry covering
+    `tokens` for `model`'s shard. Records the offer in the engine's
+    directory and kicks the PRESERVE-style anticipatory pull so the KV is
+    importing while the chained request is still in flight to us. 202
+    means "recorded" — never "fetched"."""
+    try:
+      data = await request.json() if request.can_read_body else {}
+    except (json.JSONDecodeError, UnicodeDecodeError):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error", "message": "body must be JSON"}}, status=400)
+    if not isinstance(data, dict):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "body must be a JSON object"}}, status=400)
+    model = self._resolve_model(data.get("model"))
+    shard = build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"detail": f"Invalid model: {model}"}, status=400)
+    tokens = data.get("tokens")
+    url = data.get("url")
+    if (not isinstance(tokens, list) or not tokens
+        or not all(isinstance(t, int) and not isinstance(t, bool) for t in tokens)
+        or not isinstance(url, str) or not url):
+      return web.json_response(
+        {"error": {"type": "invalid_request_error",
+                   "message": "an offer must carry `tokens` (list of ints) and `url`"}},
+        status=400)
+    eng = self.node.inference_engine
+    offer_fn = getattr(eng, "fabric_offer", None)
+    if offer_fn is None:
+      return web.json_response({"accepted": False,
+                                "detail": "engine has no KV fabric"}, status=202)
+    cur_shard = self.node.get_current_shard(shard)
+    accepted = bool(offer_fn(cur_shard, tokens,
+                             int(data.get("length") or len(tokens)),
+                             int(data.get("nbytes") or 0), url))
+    if accepted:
+      spawn_detached(eng.prefetch_fabric_offer(cur_shard, tokens))
+    return web.json_response({"accepted": accepted, "model": model}, status=202)
+
   async def handle_get_metrics(self, request):
     body, content_type = self.node.metrics.exposition_with_content_type()
     # Engine-level serving counters (prefix cache, speculative decoding):
@@ -563,6 +675,14 @@ class ChatGPTAPI:
        "Bytes spilled D2H into the host KV tier by prefix evictions"),
       ("_host_fetch_bytes", "xot_kv_fetch_bytes_total",
        "Bytes restored H2D from the host KV tier on warm-prefix admission"),
+      ("_fabric_hits", "xot_kv_fabric_hits_total",
+       "Prefix entries imported from sibling replicas over the KV fabric"),
+      ("_fabric_misses", "xot_kv_fabric_misses_total",
+       "Fabric consults that found no usable sibling entry (cold prefill)"),
+      ("_fabric_errors", "xot_kv_fabric_errors_total",
+       "Fabric transfers dropped (peer error, torn blob, digest mismatch)"),
+      ("_fabric_bytes", "xot_kv_fabric_bytes_total",
+       "Host-tier bytes imported over the KV fabric from sibling replicas"),
       ("_jit_first_dispatches", "xot_jit_first_dispatch_total",
        "Device dispatches whose executable identity was first seen (jit cache miss: "
        "pays XLA compilation)"),
@@ -572,6 +692,13 @@ class ChatGPTAPI:
       val = getattr(eng, attr, None)
       if val is not None:
         extra.append(f"# HELP {name} {help_text}\n# TYPE {name} counter\n{name} {val}\n")
+    # Per-source breakdown of host-tier hits (local spill vs fabric import):
+    # labeled series under the family declared in the table above, so a
+    # dashboard can tell a replica warming itself from one warmed by a peer.
+    by_src = getattr(eng, "_host_hits_by_source", None)
+    if by_src:
+      for src in sorted(by_src):
+        extra.append(f'xot_kv_host_hits_total{{source="{src}"}} {by_src[src]}\n')
     # Page-pool occupancy gauges (XOT_PAGED_KV; absent until a pool exists).
     stats_fn = getattr(eng, "page_pool_stats", None)
     stats = stats_fn() if stats_fn is not None else None
@@ -955,6 +1082,24 @@ class ChatGPTAPI:
       )
 
     prompt, tokenizer = await self._request_prompt(model, shard, messages, tools)
+
+    # Disaggregated serving: a prefill-role replica runs the prompt, spills
+    # the KV to its host tier, and hands back a fabric handle instead of
+    # decoding — the router chains the handle to a decode replica. Any
+    # export failure falls through to normal serving: disaggregation is an
+    # optimization, never a new way for a request to fail.
+    if knobs.get_str("XOT_FABRIC_ROLE") == "prefill":
+      export_fn = getattr(self.node.inference_engine, "prefill_export", None)
+      if export_fn is not None:
+        try:
+          handle = await export_fn(self.node.get_current_shard(shard), prompt)
+        except Exception as e:
+          if DEBUG >= 1:
+            print(f"fabric prefill export failed (serving normally): {e!r}")
+          handle = None
+        if handle is not None:
+          return web.json_response({"object": "kv.handle", "model": model, **handle})
+
     request_id = str(uuid.uuid4())
     if self.on_chat_completion_request:
       try:

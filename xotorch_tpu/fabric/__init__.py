@@ -1,0 +1,216 @@
+"""Fleet-wide KV fabric: cross-replica prefix transfer (pure half).
+
+N replicas used to mean N private `HostKVStore` warm sets — the same hot
+prefix paid a cold prefill once per replica, and a router spill or
+readmission landed on a target that had never seen the session. The fabric
+turns those private tiers into one fleet-wide warm set: any replica can
+export a spilled prefix entry in the canonical contiguous [L, 1, T, Hkv, D]
+layout and any sibling can import it, verify the content digest, and then
+take the EXACT local host-warm restore path (engine._host_promote → fresh
+pool pages), so a remote hit is byte-identical to a local one and
+`xot_kv_unpage_total`/commit-copy bytes stay 0.
+
+This module is the transport-free half — everything here is numpy + JSON
+over bytes, unit-testable without a socket:
+
+- `shard_key` / `entry_key`: stable cross-process identities. Python's
+  `hash()` is per-process randomized, so the fabric content-addresses
+  entries by sha256 over the Shard's declared fields + the token ids.
+- `pack_entry` / `unpack_entry`: the wire format — a JSON header (leaf
+  names/dtypes/shapes, covered length, digest) followed by raw contiguous
+  buffers. dtype round-trips include the ml_dtypes families (bfloat16,
+  int8 KV scale leaves travel like any other leaf).
+- `OfferDirectory`: the peer directory. Offers carry the FULL token ids,
+  so the receiving replica answers "who covers my prompt?" with a local
+  longest-common-prefix scan (kv_offload.common_prefix_len — THE matching
+  rule, shared with the HBM scan and the host tier) and zero round-trips.
+
+Failure semantics everywhere: a fetch that fails — unreachable peer, torn
+transfer, digest mismatch, stale offer — degrades to a cold prefill. The
+fabric can only ever make a request faster, never wrong and never an
+error. The transport lives in fabric/client.py (sync urllib on the engine
+executor) and fabric/server.py (pure request handlers the API wires up).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.inference.jax_engine.kv_offload import common_prefix_len
+
+# Wire magic + version: a fabric endpoint must never misparse a foreign or
+# future blob as KV — unknown magic is a torn transfer, dropped.
+_MAGIC = b"XOTKV1\n"
+
+
+def shard_key(ctx_key: Any) -> str:
+  """Stable cross-process identity of a store namespace. Engine stores key
+  by `Shard` (frozen dataclass) — its declared fields name the namespace;
+  anything else (test stores key by plain strings) stringifies."""
+  to_dict = getattr(ctx_key, "to_dict", None)
+  if callable(to_dict):
+    d = to_dict()
+    return (f'{d.get("model_id")}:{d.get("start_layer")}'
+            f':{d.get("end_layer")}:{d.get("n_layers")}')
+  return str(ctx_key)
+
+
+def entry_key(ctx_key: Any, toks: np.ndarray) -> str:
+  """Content address of one host-tier entry: sha256 over the namespace and
+  the full token ids. Two replicas that spilled the same prefix of the same
+  shard compute the same key with no coordination."""
+  toks = np.ascontiguousarray(np.asarray(toks).reshape(-1).astype(np.int64))
+  h = hashlib.sha256()
+  h.update(shard_key(ctx_key).encode())
+  h.update(b"\x00")
+  h.update(toks.tobytes())
+  return h.hexdigest()
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+  """dtype by name, including the ml_dtypes families JAX cache leaves use
+  (bfloat16). Raises ValueError for anything unknown — a blob declaring a
+  dtype this build cannot represent is a torn transfer, not a crash."""
+  try:
+    return np.dtype(name)
+  except TypeError:
+    pass
+  try:
+    import ml_dtypes
+    return np.dtype(getattr(ml_dtypes, name))
+  except (ImportError, AttributeError, TypeError):
+    raise ValueError(f"unknown leaf dtype {name!r}")
+
+
+def pack_entry(payload: Dict[str, Any]) -> bytes:
+  """Serialize an `export_entry` payload to the wire: magic, a length-
+  prefixed JSON header (covered length, digest, token count, leaf
+  name/dtype/shape table), then the raw contiguous buffers — token ids
+  first, leaves in sorted-name order."""
+  toks = np.ascontiguousarray(np.asarray(payload["toks"]).reshape(-1).astype(np.int64))
+  names = sorted(payload["data"])
+  leaves = []
+  bufs = [toks.tobytes()]
+  for name in names:
+    arr = np.ascontiguousarray(payload["data"][name])
+    leaves.append({"name": name, "dtype": str(arr.dtype), "shape": list(arr.shape)})
+    bufs.append(arr.tobytes())
+  header = json.dumps({
+    "version": 1, "length": int(payload["length"]), "digest": payload["digest"],
+    "n_toks": int(toks.shape[0]), "leaves": leaves,
+  }).encode()
+  return b"".join([_MAGIC, struct.pack("<I", len(header)), header] + bufs)
+
+
+def unpack_entry(blob: bytes) -> Dict[str, Any]:
+  """Parse a `pack_entry` blob back into an import_entry payload. Every
+  malformation — bad magic, truncated header, short buffers, unknown
+  dtypes — raises ValueError; the caller treats it as a torn transfer and
+  falls back cold. The digest is NOT verified here: `import_entry`
+  recomputes it over the parsed arrays, so verification covers exactly the
+  bytes that would be restored."""
+  if not blob.startswith(_MAGIC):
+    raise ValueError("bad fabric blob magic")
+  off = len(_MAGIC)
+  if len(blob) < off + 4:
+    raise ValueError("truncated fabric header")
+  (hlen,) = struct.unpack_from("<I", blob, off)
+  off += 4
+  if len(blob) < off + hlen:
+    raise ValueError("truncated fabric header")
+  try:
+    header = json.loads(blob[off:off + hlen].decode())
+  except (UnicodeDecodeError, json.JSONDecodeError) as e:
+    raise ValueError(f"unparseable fabric header: {e}")
+  off += hlen
+  n_toks = int(header["n_toks"])
+  end = off + n_toks * 8
+  if len(blob) < end:
+    raise ValueError("truncated token buffer")
+  toks = np.frombuffer(blob, dtype=np.int64, count=n_toks, offset=off)
+  off = end
+  data: Dict[str, np.ndarray] = {}
+  for leaf in header["leaves"]:
+    dtype = _resolve_dtype(leaf["dtype"])
+    shape = tuple(int(s) for s in leaf["shape"])
+    count = int(np.prod(shape)) if shape else 1
+    end = off + count * dtype.itemsize
+    if len(blob) < end:
+      raise ValueError(f"truncated leaf buffer {leaf['name']!r}")
+    data[leaf["name"]] = np.frombuffer(
+      blob, dtype=dtype, count=count, offset=off).reshape(shape)
+    off = end
+  return {"toks": toks, "length": int(header["length"]), "data": data,
+          "digest": header.get("digest")}
+
+
+@dataclass
+class FabricOffer:
+  """One announced entry: which peer holds which prefix. `toks` rides the
+  offer so coverage is decided locally (longest common prefix) without a
+  probe round-trip."""
+  key: str
+  shard: str
+  toks: np.ndarray
+  length: int
+  nbytes: int
+  url: str
+  at: float
+
+
+class OfferDirectory:
+  """Bounded, TTL'd directory of peer offers (`POST /v1/kv/offer`
+  announces land here). Thread-safe: offers arrive on the event loop while
+  `best` runs on the engine executor during a prefix miss."""
+
+  def __init__(self, ttl_s: float = 120.0, cap: int = 256):
+    self.ttl_s = float(ttl_s)
+    self.cap = int(cap)
+    self._offers: "OrderedDict[str, FabricOffer]" = OrderedDict()
+    self._lock = threading.Lock()
+
+  def record(self, ctx_key: Any, toks: np.ndarray, length: int, nbytes: int,
+             url: str) -> str:
+    toks = np.ascontiguousarray(np.asarray(toks).reshape(-1).astype(np.int64))
+    key = entry_key(ctx_key, toks)
+    offer = FabricOffer(key=key, shard=shard_key(ctx_key), toks=toks,
+                        length=int(length), nbytes=int(nbytes),
+                        url=url.rstrip("/"), at=time.monotonic())
+    with self._lock:
+      self._offers.pop(key, None)
+      self._offers[key] = offer
+      while len(self._offers) > self.cap:
+        self._offers.popitem(last=False)
+    return key
+
+  def best(self, ctx_key: Any, toks: np.ndarray, limit: int) -> Optional[Tuple[FabricOffer, int]]:
+    """Freshest offer with the longest usable common prefix for `toks`
+    (same rule as every other tier), or None. Expired offers are dropped
+    in passing."""
+    toks = np.asarray(toks).reshape(-1).astype(np.int64)
+    skey = shard_key(ctx_key)
+    now = time.monotonic()
+    with self._lock:
+      dead = [k for k, o in self._offers.items() if now - o.at > self.ttl_s]
+      for k in dead:
+        del self._offers[k]
+      best, best_common = None, 0
+      for offer in self._offers.values():
+        if offer.shard != skey:
+          continue
+        common = common_prefix_len(offer.toks, toks, limit)
+        if min(common, offer.length) > best_common:
+          best, best_common = offer, min(common, offer.length)
+      return (best, best_common) if best is not None else None
+
+  def __len__(self) -> int:
+    with self._lock:
+      return len(self._offers)

@@ -1,0 +1,143 @@
+"""FabricClient: the pull side of the fleet-wide KV fabric.
+
+Runs SYNCHRONOUSLY on the engine executor thread — a fabric consult
+happens inside `_host_promote`, which already rides the co-scheduled
+prefill lane, so a peer round-trip never blocks the event loop or stalls
+resident decode. Transport is stdlib urllib with a hard timeout
+(XOT_FABRIC_TIMEOUT_S); there is deliberately no connection pool or async
+machinery — one small GET per cold prefix is the whole traffic pattern.
+
+Lookup order:
+1. The offer directory (zero network): offers carry full token ids, so
+   coverage is a local longest-common-prefix scan. Router chaining and
+   spill pre-announce land offers here ahead of the request.
+2. Static peers (XOT_FABRIC_PEERS): `POST /v1/kv/match` probes, best
+   usable coverage wins. Probe misses are negatively cached for a short
+   window and unreachable peers back off, so a fleet with nothing to offer
+   costs a cold prompt at most one probe round per window.
+
+Every failure — timeout, HTTP error, torn blob, short coverage — is
+reported as a miss or a counted transfer error, NEVER an exception: the
+caller's contract is that the fabric can only make a prefill warmer.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.fabric import OfferDirectory, shard_key, unpack_entry
+
+# Negative-cache window for static-peer probe misses and per-peer
+# unreachability backoff: a cold fleet must not pay a probe round-trip on
+# EVERY cold prompt.
+_MISS_TTL_S = 15.0
+_PEER_DOWN_S = 10.0
+
+
+@dataclass
+class FetchResult:
+  """Outcome of one fabric consult. `errors` counts failed transfer
+  attempts (reachability, torn blobs) — distinct from a clean miss, and
+  zero-toleranced by the soak verdict on green runs."""
+  payload: Optional[Dict[str, Any]] = None
+  url: str = ""
+  common: int = 0
+  errors: int = 0
+
+
+class FabricClient:
+
+  def __init__(self, peers: List[str], timeout_s: float = 2.0,
+               offer_ttl_s: float = 120.0):
+    self.peers = [p.rstrip("/") for p in peers if p]
+    self.timeout_s = float(timeout_s)
+    self.offers = OfferDirectory(ttl_s=offer_ttl_s)
+    self._miss_recent: "OrderedDict[Tuple[str, bytes], float]" = OrderedDict()
+    self._peer_down: Dict[str, float] = {}
+    self._lock = threading.Lock()
+
+  # ------------------------------------------------------------- transport
+
+  def _get_bytes(self, url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+      return resp.read()
+
+  def _post_json(self, url: str, obj: dict) -> dict:
+    req = urllib.request.Request(
+      url, data=json.dumps(obj).encode(),
+      headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+      return json.loads(resp.read().decode())
+
+  # ----------------------------------------------------------- negative cache
+
+  def _probe_key(self, skey: str, toks: np.ndarray) -> Tuple[str, bytes]:
+    return (skey, np.ascontiguousarray(toks[:64]).tobytes())
+
+  def _recently_missed(self, key: Tuple[str, bytes]) -> bool:
+    now = time.monotonic()
+    with self._lock:
+      at = self._miss_recent.get(key)
+      return at is not None and now - at < _MISS_TTL_S
+
+  def _note_miss(self, key: Tuple[str, bytes]) -> None:
+    with self._lock:
+      self._miss_recent[key] = time.monotonic()
+      self._miss_recent.move_to_end(key)
+      while len(self._miss_recent) > 256:
+        self._miss_recent.popitem(last=False)
+
+  def _peer_usable(self, url: str) -> bool:
+    at = self._peer_down.get(url)
+    return at is None or time.monotonic() - at > _PEER_DOWN_S
+
+  # ----------------------------------------------------------------- fetch
+
+  def fetch(self, ctx_key: Any, toks: np.ndarray, limit: int,
+            better_than: int = 0) -> FetchResult:
+    """Best sibling entry covering `toks` past `better_than` positions
+    (what the local tiers already cover — fetching less would be wasted
+    bytes). Returns the unpacked import payload, or a miss. Never raises."""
+    toks = np.ascontiguousarray(np.asarray(toks).reshape(-1).astype(np.int64))
+    skey = shard_key(ctx_key)
+    result = FetchResult()
+    candidates: List[Tuple[int, str, str]] = []  # (common, base_url, key)
+    offer = self.offers.best(ctx_key, toks, limit)
+    if offer is not None and offer[1] > better_than:
+      candidates.append((offer[1], offer[0].url, offer[0].key))
+    else:
+      probe_key = self._probe_key(skey, toks)
+      if self.peers and not self._recently_missed(probe_key):
+        body = {"shard": skey, "toks": toks.tolist(), "limit": int(limit)}
+        for peer in self.peers:
+          if not self._peer_usable(peer):
+            continue
+          try:
+            resp = self._post_json(peer + "/v1/kv/match", body)
+          except Exception:
+            self._peer_down[peer] = time.monotonic()
+            continue
+          if resp.get("key") and int(resp.get("common") or 0) > better_than:
+            candidates.append((int(resp["common"]), peer, resp["key"]))
+        if not candidates:
+          self._note_miss(probe_key)
+    for common, base_url, key in sorted(candidates, reverse=True):
+      try:
+        blob = self._get_bytes(f"{base_url}/v1/kv/{key}?payload=1")
+        payload = unpack_entry(blob)
+      except Exception:
+        # Unreachable mid-transfer or a torn blob: a counted transfer
+        # error, then the next-best candidate (or a clean cold prefill).
+        result.errors += 1
+        continue
+      result.payload, result.url, result.common = payload, base_url, common
+      return result
+    return result

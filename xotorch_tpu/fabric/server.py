@@ -1,0 +1,91 @@
+"""Fabric server surface (pure half): resolve and serve host-tier entries.
+
+These are the transport-free bodies of the `/v1/kv/*` endpoints the API
+wires up (api/chatgpt_api.py) — every function takes the `HostKVStore` and
+plain data, and returns plain data or packed bytes, so the whole serve
+path is unit-testable in-process and the aiohttp handlers stay thin.
+
+Serving is read-only and copy-free until pack time: `snapshot_keys` gives
+the stable (ctx_key, toks) identities without holding the store lock
+across an export, and `export_entry` hands back the store's own immutable
+arrays. A concurrent LRU eviction between resolve and export simply turns
+the request into a miss (404) — never a torn blob.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from xotorch_tpu.fabric import entry_key, pack_entry, shard_key
+from xotorch_tpu.inference.jax_engine.kv_offload import HostKVStore, common_prefix_len
+
+
+def resolve_key(store: HostKVStore, key: str) -> Optional[Tuple[Any, np.ndarray]]:
+  """The (ctx_key, toks) identity behind a content-addressed entry key, or
+  None when no resident entry hashes to it."""
+  for ctx_key, toks in store.snapshot_keys():
+    if entry_key(ctx_key, toks) == key:
+      return ctx_key, toks
+  return None
+
+
+def match_response(store: HostKVStore, shard: str, toks: np.ndarray,
+                   limit: int) -> Dict[str, Any]:
+  """Answer a sibling's `POST /v1/kv/match` probe: the resident entry with
+  the longest usable common prefix for `toks` in the `shard` namespace
+  (min of token match and covered KV length — an entry whose KV covers
+  fewer tokens than it matches is worth only what it covers). Shape:
+  {"key": None} on miss, else {"key", "common", "length", "nbytes"}."""
+  toks = np.asarray(toks).reshape(-1).astype(np.int64)
+  best: Optional[Tuple[Any, np.ndarray]] = None
+  best_common = 0
+  for ctx_key, etoks in store.snapshot_keys():
+    if shard_key(ctx_key) != shard:
+      continue
+    common = common_prefix_len(etoks, toks, limit)
+    if common > best_common:
+      best, best_common = (ctx_key, etoks), common
+  if best is None:
+    return {"key": None}
+  payload = store.export_entry(*best)
+  if payload is None:  # evicted between snapshot and export: an honest miss
+    return {"key": None}
+  usable = min(best_common, int(payload["length"]))
+  if usable <= 0:
+    return {"key": None}
+  nbytes = int(sum(int(a.nbytes) for a in payload["data"].values()))
+  return {"key": entry_key(*best), "common": usable,
+          "length": int(payload["length"]), "nbytes": nbytes}
+
+
+def manifest(store: HostKVStore, key: str) -> Optional[Dict[str, Any]]:
+  """`GET /v1/kv/{key}` without payload: the entry's manifest (covered
+  length, leaf table, digest, packed size) so a peer can size the transfer
+  before streaming it."""
+  ident = resolve_key(store, key)
+  if ident is None:
+    return None
+  payload = store.export_entry(*ident)
+  if payload is None:
+    return None
+  return {
+    "key": key, "length": int(payload["length"]),
+    "n_toks": int(np.asarray(payload["toks"]).shape[0]),
+    "digest": payload["digest"],
+    "leaves": [{"name": name, "dtype": str(arr.dtype), "shape": list(arr.shape),
+                "nbytes": int(arr.nbytes)}
+               for name, arr in sorted(payload["data"].items())],
+  }
+
+
+def serve_entry(store: HostKVStore, key: str) -> Optional[bytes]:
+  """`GET /v1/kv/{key}?payload=1`: the packed wire blob for one entry, or
+  None when it is (no longer) resident."""
+  ident = resolve_key(store, key)
+  if ident is None:
+    return None
+  payload = store.export_entry(*ident)
+  if payload is None:
+    return None
+  return pack_entry(payload)

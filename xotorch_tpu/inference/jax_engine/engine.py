@@ -416,6 +416,20 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._host_kv_hits = 0
     self._host_spill_bytes = 0
     self._host_fetch_bytes = 0
+    # Host hits split by the entry's origin tier ("local" spill vs "fabric"
+    # cross-replica import) — exported as labeled xot_kv_host_hits_total
+    # series next to the bare total.
+    self._host_hits_by_source: Dict[str, int] = {}
+    # Fleet-wide KV fabric (xotorch_tpu/fabric, XOT_FABRIC_PEERS): a prefix
+    # that misses HBM *and* the local host tier consults sibling replicas
+    # and imports the longest covering entry into the host store, then takes
+    # the ordinary _host_promote restore path. Lazy like the host store —
+    # engines with no peers and no offers never build a client.
+    self._fabric = None
+    self._fabric_hits = 0
+    self._fabric_misses = 0
+    self._fabric_errors = 0
+    self._fabric_bytes = 0
     # Speculative-decode observability: drafted vs model-confirmed tokens,
     # plus a live efficiency gauge — paired EWMAs of the proposed/accepted
     # token rates whose ratio is xot_spec_accept_rate (both decay with the
@@ -2004,8 +2018,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     # Host-tier consult: a prefix that was spilled (pool pressure, OOM
     # recovery) restores into the HBM cache here — after which the scan
     # below serves it exactly like a native warm hit (same incref/seed
-    # paths, same accounting).
-    self._host_promote(ctx, toks)
+    # paths, same accounting). A local miss consults the fleet-wide KV
+    # fabric inside the promote, so a sibling's warm prefix serves here
+    # too — byte-identical, via the same restore.
+    self._host_promote(ctx, toks, request_id=request_id)
     if not ctx.prefix_cache:
       return 0
     limit = toks.shape[0] - 1  # at least one token must still be forwarded
@@ -2198,6 +2214,152 @@ class JAXShardInferenceEngine(InferenceEngine):
       return None
     return {"bytes": store.total_bytes, "entries": len(store)}
 
+  # ------------------------------------------------- fleet-wide KV fabric
+  #
+  # Cross-replica prefix transfer (xotorch_tpu/fabric): a prefix that
+  # misses HBM *and* the local host tier consults sibling replicas — the
+  # offer directory first (router chaining and spill pre-announce land
+  # offers there), then static XOT_FABRIC_PEERS probes — and imports the
+  # longest covering entry into the local HostKVStore with its content
+  # digest verified. The import then takes the EXISTING _host_promote
+  # restore path (fresh pool pages, H2D scatter), so a remote hit is
+  # byte-identical to a local host-warm hit and unpage/commit-copy stay 0.
+  # Every failure mode — unreachable peer, torn transfer, digest mismatch
+  # — degrades to a cold prefill, never an error.
+
+  def _fabric_client(self, create: bool = False):
+    """The fabric pull client, or None while the fabric is idle. Built
+    lazily when XOT_FABRIC_PEERS names siblings, or on the first incoming
+    offer (`create=True`) — a single-replica deployment never pays for it."""
+    if self._fabric is None:
+      peers = [p.strip() for p in knobs.get_str("XOT_FABRIC_PEERS").split(",")
+               if p.strip()]
+      if not peers and not create:
+        return None
+      from xotorch_tpu.fabric.client import FabricClient
+      self._fabric = FabricClient(
+        peers, timeout_s=knobs.get_float("XOT_FABRIC_TIMEOUT_S"),
+        offer_ttl_s=knobs.get_float("XOT_FABRIC_OFFER_TTL_S"))
+    return self._fabric
+
+  def fabric_offer(self, shard: Shard, toks, length: int, nbytes: int,
+                   url: str) -> bool:
+    """Record a sibling's announce (`POST /v1/kv/offer`): peer `url` holds
+    a host-tier entry covering `toks`. The offer carries the full token
+    ids, so the next local miss resolves coverage with zero round-trips.
+    Returns False when the host tier is disabled (nowhere to import)."""
+    if self._host_kv_max_bytes() <= 0:
+      return False
+    client = self._fabric_client(create=True)
+    key = client.offers.record(shard, toks, length, nbytes, url)
+    if self.flight is not None:
+      self.flight.record("fabric.offer", None, key=key[:16], tokens=int(length),
+                         bytes=int(nbytes), peer=url)
+    return True
+
+  async def prefetch_fabric_offer(self, shard: Shard, toks) -> bool:
+    """Anticipatory pull for a just-offered prefix (PRESERVE discipline,
+    same contract as prefetch_host_prefix but keyed on token ids): start
+    the fabric fetch + host-to-HBM promote while the chained request is
+    still in flight to us. Resident contexts only; best-effort."""
+    ctx = self._contexts.get(shard)
+    if ctx is None or ctx.params is None:
+      return False
+    toks = np.asarray(toks, dtype=np.int64).reshape(-1)
+    if toks.shape[0] < 2:
+      return False
+    fetched_before = self._fabric_bytes
+    promote = partial(self._host_promote, ctx, toks)
+    if ctx.batcher is not None:
+      await ctx.batcher.submit_prefill(promote)
+    else:
+      await self._run(promote)
+    return self._fabric_bytes > fetched_before
+
+  def _fabric_consult(self, ctx: _ShardContext, toks: np.ndarray, limit: int,
+                      have: int, request_id: Optional[str] = None) -> bool:
+    """Fetch the best sibling entry covering `toks` past `have` (what the
+    local tiers already cover) and import it into the host store. Runs on
+    the engine executor inside _host_promote; the transfer is attributed
+    to the request's TTFT anatomy as its own stage (engine.fabric_fetch).
+    Returns True when an entry landed — the caller then re-matches."""
+    client = self._fabric_client()
+    if client is None:
+      return False
+    store = self._host_kv_store()
+    if store is None:
+      return False
+    t0 = time.monotonic()
+    with self._engine_span("engine.fabric_fetch", request_id):
+      res = client.fetch(ctx.shard, toks, limit, better_than=have)
+    if res.errors:
+      self._fabric_errors += res.errors
+    if res.payload is None:
+      self._fabric_misses += 1
+      return False
+    n = store.import_entry(ctx.shard, res.payload, source="fabric")
+    if n <= 0:
+      # Digest mismatch or over-budget payload: dropped exactly like a
+      # torn local host entry — cold prefill, never a wrong token.
+      self._fabric_errors += 1
+      self._fabric_misses += 1
+      if DEBUG >= 1:
+        print(f"fabric import rejected (torn/over-budget transfer from {res.url})")
+      return False
+    self._fabric_hits += 1
+    self._fabric_bytes += n
+    if self.flight is not None:
+      self.flight.record("fabric.fetch", request_id,
+                         tokens=int(res.payload["length"]), bytes=n, peer=res.url,
+                         secs=round(time.monotonic() - t0, 4))
+    if DEBUG >= 2:
+      print(f"fabric fetch: {res.payload['length']}-token prefix imported "
+            f"from {res.url} ({n} bytes)")
+    return True
+
+  async def prefill_export(self, shard: Shard, prompt: str) -> Optional[dict]:
+    """Disaggregated prefill (XOT_FABRIC_ROLE=prefill): run the prompt's
+    prefill on this replica, copy the resulting prefix entry into the host
+    tier (non-destructive copy-out), and return a transfer handle — the
+    router offers it at a decode replica, which imports the KV over the
+    fabric instead of paying the cold prefill. None when the prompt is too
+    short to cache or the host tier/prefix cache is off (the router then
+    degrades to plain forwarding)."""
+    if self._host_kv_max_bytes() <= 0 or self._prefix_cache_max() <= 0:
+      return None
+    import uuid
+    ctx = await self._ensure_ctx(shard)
+    tokenizer = await self._ensure_tokenizer(ctx)
+    toks = np.asarray(tokenizer.encode(prompt), dtype=np.int64).reshape(-1)
+    if toks.shape[0] < max(2, self._prefix_cache_min()):
+      return None
+    rid = f"fabric-prefill-{uuid.uuid4().hex[:12]}"
+    try:
+      await self.infer_sample_tensor(rid, shard, toks.reshape(1, -1), temp=0.0)
+      return await self._run(self._export_prefix_sync, ctx, toks)
+    finally:
+      await self.clear_request(rid)
+
+  def _export_prefix_sync(self, ctx: _ShardContext, toks: np.ndarray) -> Optional[dict]:
+    """Host-tier copy-out + handle for a just-prefilled prompt: spill the
+    HBM prefix entry (pure copy — live refs untouched) and describe the
+    resulting host entry for a fabric offer."""
+    store = self._host_kv_store()
+    if store is None:
+      return None
+    key = hash(np.ascontiguousarray(toks).tobytes())
+    hbm = ctx.prefix_cache.get(key)
+    if hbm is not None:
+      etoks, snap = hbm
+      self._spill_prefix_entry(ctx, etoks, snap)
+    entry, common = store.match(ctx.shard, toks, toks.shape[0])
+    if entry is None or entry.length <= 0:
+      return None
+    from xotorch_tpu.fabric import entry_key
+    return {"key": entry_key(ctx.shard, entry.toks), "length": int(entry.length),
+            "nbytes": int(entry.nbytes), "covered": int(min(common, entry.length)),
+            "tokens": [int(t) for t in entry.toks]}
+
   def _cache_leaf_names(self) -> set:
     """Leaf names a restored snapshot must carry to seed the CURRENT cache
     config (transformer.init_kv_cache): plain bf16/f32 K/V, or K/V + their
@@ -2248,21 +2410,31 @@ class JAXShardInferenceEngine(InferenceEngine):
         print(f"host KV spill failed (entry dropped): {e!r}")
       return False
 
-  def _host_promote(self, ctx: _ShardContext, toks: np.ndarray) -> None:
+  def _host_promote(self, ctx: _ShardContext, toks: np.ndarray,
+                    request_id: Optional[str] = None) -> None:
     """If the host tier holds a strictly longer usable prefix for `toks`
     than any resident HBM entry, stream it back and re-create the HBM
     entry: fresh pool pages + H2D scatter under XOT_PAGED_KV (the entry
     then shares pages with the request exactly like a native hit), or a
-    device_put snapshot on the contiguous path. Runs on the engine
-    executor; under co-scheduling the caller rides the _DecodeBatcher
-    prefill lane, so co-resident decode dispatches first and never stalls
-    on the copy. Every failure mode degrades to a cold prefill."""
+    device_put snapshot on the contiguous path. A local miss (or a shorter
+    local match) consults the fleet-wide fabric first — an imported
+    sibling entry lands in the host store and is restored by the very same
+    code below. Runs on the engine executor; under co-scheduling the
+    caller rides the _DecodeBatcher prefill lane, so co-resident decode
+    dispatches first and never stalls on the copy. Every failure mode
+    degrades to a cold prefill."""
     store = self._host_kv_store()
-    if store is None or len(store) == 0:
+    if store is None:
       return
     limit = toks.shape[0] - 1
+    if limit <= 0:
+      return
     _, hbm_best = self._best_hbm_prefix(ctx, toks, limit)
-    entry, common = store.match(ctx.shard, toks, limit)
+    entry, common = store.match(ctx.shard, toks, limit) if len(store) else (None, 0)
+    local_usable = min(common, entry.length) if entry is not None else 0
+    if local_usable < limit and self._fabric_consult(
+        ctx, toks, limit, max(local_usable, hbm_best), request_id=request_id):
+      entry, common = store.match(ctx.shard, toks, limit)
     if entry is None:
       return
     t0 = time.monotonic()
@@ -2342,10 +2514,13 @@ class JAXShardInferenceEngine(InferenceEngine):
       if ctx.page_pool is not None and isinstance(evicted, dict) and "pages" in evicted:
         ctx.page_pool.decref(evicted["pages"])
     self._host_kv_hits += 1
+    src = getattr(entry, "source", "local")
+    self._host_hits_by_source[src] = self._host_hits_by_source.get(src, 0) + 1
     self._host_fetch_bytes += entry.nbytes
     if self.flight is not None:
       self.flight.record("host.restore", None, tokens=entry.length,
-                         bytes=entry.nbytes, secs=round(time.monotonic() - t0, 4))
+                         bytes=entry.nbytes, source=src,
+                         secs=round(time.monotonic() - t0, 4))
     if DEBUG >= 2:
       print(f"host KV tier hit: {entry.length}-token prefix restored "
             f"({entry.nbytes} bytes H2D)")
@@ -2360,8 +2535,10 @@ class JAXShardInferenceEngine(InferenceEngine):
     the promote rides the co-scheduled prefill lane so resident decode
     never stalls on the H2D copy. Returns True when bytes were restored."""
     store = self._host_kv
-    if store is None or len(store) == 0:
+    if (store is None or len(store) == 0) and self._fabric_client() is None:
       return False
+    if self._host_kv_store() is None:
+      return False  # tier disabled: a fabric import would have nowhere to land
     ctx = self._contexts.get(shard)
     if ctx is None or ctx.params is None:
       return False

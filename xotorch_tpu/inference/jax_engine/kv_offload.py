@@ -37,12 +37,32 @@ entry and falls back to a cold prefill, never a wrong token.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+
+def entry_digest(toks: np.ndarray, length: int, data: Dict[str, np.ndarray]) -> str:
+  """Content digest of one entry: sha256 over the token ids, the covered
+  length, and every leaf's name/dtype/shape/bytes in sorted-name order.
+  THE integrity check for KV that crosses a process boundary (the fabric
+  transport) — a transfer whose digest does not match is torn/stale and is
+  dropped exactly like a torn host entry, never restored."""
+  h = hashlib.sha256()
+  toks = np.ascontiguousarray(np.asarray(toks).reshape(-1).astype(np.int64))
+  h.update(toks.tobytes())
+  h.update(str(int(length)).encode())
+  for name in sorted(data):
+    arr = np.ascontiguousarray(data[name])
+    h.update(name.encode())
+    h.update(str(arr.dtype).encode())
+    h.update(repr(tuple(arr.shape)).encode())
+    h.update(arr.tobytes())
+  return h.hexdigest()
 
 
 def common_prefix_len(stored: np.ndarray, probe: np.ndarray, limit: int) -> int:
@@ -62,11 +82,14 @@ class HostKVEntry:
   """One spilled prefix: `toks` is the full prompt that stored it, `data`
   the canonical [L, 1, T, ...] host copies of every cache leaf, `length`
   the token count actually covered (paged spills cover full pages only, so
-  length <= toks.shape[0])."""
+  length <= toks.shape[0]). `source` records which tier produced the bytes
+  ("local" spill vs "fabric" cross-replica import) — the engine splits its
+  host-hit counters by it."""
   toks: np.ndarray
   data: Dict[str, np.ndarray]
   length: int
   nbytes: int
+  source: str = "local"
 
 
 class HostKVStore:
@@ -104,7 +127,7 @@ class HostKVStore:
   # ------------------------------------------------------------------ write
 
   def put(self, ctx_key: Any, toks: np.ndarray, data: Dict[str, np.ndarray],
-          length: int) -> int:
+          length: int, source: str = "local") -> int:
     """Insert (or refresh) an entry; LRU-evict until the arena fits the
     budget. Returns the bytes newly stored (0 when the entry alone exceeds
     the budget and is rejected — a host tier that thrashes on one giant
@@ -113,7 +136,8 @@ class HostKVStore:
     nbytes = int(sum(int(a.nbytes) for a in data.values()) + toks.nbytes)
     if nbytes > self.max_bytes:
       return 0
-    entry = HostKVEntry(toks=toks, data=dict(data), length=int(length), nbytes=nbytes)
+    entry = HostKVEntry(toks=toks, data=dict(data), length=int(length), nbytes=nbytes,
+                        source=source)
     key = (ctx_key, hash(toks.tobytes()))
     dropped, dropped_bytes = 0, 0
     with self._lock:
@@ -154,6 +178,48 @@ class HostKVStore:
       if best_key is not None:
         self._entries.move_to_end(best_key)
       return best, best_len
+
+  # ------------------------------------------------------- fabric transfer
+
+  def snapshot_keys(self) -> List[Tuple[Any, np.ndarray]]:
+    """Stable (ctx_key, toks) identity of every resident entry — what the
+    fabric server surface enumerates to resolve a content-addressed entry
+    key without holding the lock across the export."""
+    with self._lock:
+      return [(k[0], e.toks) for k, e in self._entries.items()]
+
+  def export_entry(self, ctx_key: Any, toks: np.ndarray) -> Optional[Dict[str, Any]]:
+    """Serializable payload of one exact entry (None when absent): token
+    ids, covered length, every canonical-layout leaf, and a sha256 content
+    digest the importer verifies. The arrays are the store's own (entries
+    are immutable once inserted), so exporting copies nothing."""
+    key = (ctx_key, hash(np.ascontiguousarray(
+      np.asarray(toks).reshape(-1).astype(np.int64)).tobytes()))
+    with self._lock:
+      entry = self._entries.get(key)
+      if entry is None:
+        return None
+      toks, length, data = entry.toks, entry.length, dict(entry.data)
+    return {"toks": toks, "length": length, "data": data,
+            "digest": entry_digest(toks, length, data)}
+
+  def import_entry(self, ctx_key: Any, payload: Dict[str, Any],
+                   source: str = "fabric") -> int:
+    """Insert a payload produced by `export_entry` (possibly on another
+    replica, via the fabric wire format). The digest is recomputed over the
+    received bytes and MUST match the declared one — a torn or stale
+    transfer is rejected here (returns 0) and the caller falls back to a
+    cold prefill, never a wrong token. The insert itself is `put`: atomic
+    under the lock, LRU-evicting to budget."""
+    toks = np.ascontiguousarray(
+      np.asarray(payload["toks"]).reshape(-1).astype(np.int64))
+    length = int(payload["length"])
+    data = {name: np.ascontiguousarray(arr) for name, arr in payload["data"].items()}
+    if not data or length <= 0 or toks.shape[0] < length:
+      return 0
+    if entry_digest(toks, length, data) != payload.get("digest"):
+      return 0
+    return self.put(ctx_key, toks, data, length, source=source)
 
   # ------------------------------------------------------------- invalidate
 

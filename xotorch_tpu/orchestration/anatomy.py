@@ -63,6 +63,10 @@ CLOCK_KEY = "clock"
 # covers admission — time under a container that sits BETWEEN two work
 # spans on different nodes is hop transit, not container work.
 _STAGE_PRIORITY = (
+  # Fleet-wide KV fabric transfer (engine._fabric_consult): runs INSIDE
+  # the prefill path, so it must outrank "prefill" to carve the transfer
+  # out as its own TTFT stage — the disaggregated mode's honesty bar.
+  ("engine.fabric_fetch", "kv_transfer", 5),
   ("engine.prefill", "prefill", 4),
   ("process_tensor", "dispatch", 3),
   ("process_prompt.forwarded", "dispatch", 3),

@@ -73,6 +73,14 @@ EVENTS = (
   "host.spill",
   "host.restore",
   "host.evict",
+  # fleet-wide KV fabric (xotorch_tpu/fabric via engine + api): a sibling's
+  # announce landing in the offer directory, a cross-replica entry imported
+  # into the local host tier, and this node serving an entry to a peer —
+  # the three edges a cross-replica warm hit is made of, each with peer,
+  # token, and byte attribution for postmortems.
+  "fabric.offer",
+  "fabric.fetch",
+  "fabric.serve",
   # engine-level events
   "engine.compile",
   "engine.oom_recovery",

@@ -61,6 +61,10 @@ class _Replica:
     # poll — an unknown load must rank as heavy, never as idle.
     self.queue: Optional[dict] = None
     self.active_requests = 0       # latest ring-visible inflight
+    # Disaggregated serving role polled off /v1/queue (XOT_FABRIC_ROLE on
+    # the replica): `prefill` replicas never enter the routable set — they
+    # serve only as the prefill leg of a router-chained request.
+    self.role = "mixed"
     self.firing = 0                # latest cluster-wide firing alert count
     self.suspect: Optional[str] = None
     # Latest /v1/history trailing compact (None until the replica serves
@@ -95,7 +99,7 @@ class _Replica:
   def snapshot(self) -> dict:
     return {
       **self.lifecycle.snapshot(),
-      "url": self.url, "reachable": self.reachable,
+      "url": self.url, "reachable": self.reachable, "role": self.role,
       "firing": self.firing, "suspect": self.suspect,
       "drift": self.drift, "drift_hit": self.drift_hit,
       "drift_last": self.drift_last,
@@ -126,6 +130,8 @@ class RouterApp:
     self.proxied_total = 0
     self.no_replica_503_total = 0
     self.prefetch_announced_total = 0
+    self.fabric_chained_total = 0
+    self.fabric_chain_failures_total = 0
     self._session: Optional[ClientSession] = None
     self._poll_task = None
 
@@ -160,7 +166,15 @@ class RouterApp:
       self._session = None
 
   def routable(self) -> List[_Replica]:
-    return [r for r in self.replicas.values() if r.lifecycle.routable and r.reachable]
+    # Prefill-role replicas are deliberately excluded: they answer chat
+    # completions with KV handles, not token streams, so client traffic
+    # must never land on one directly.
+    return [r for r in self.replicas.values()
+            if r.lifecycle.routable and r.reachable and r.role != "prefill"]
+
+  def prefill_replicas(self) -> List[_Replica]:
+    return [r for r in self.replicas.values()
+            if r.lifecycle.routable and r.reachable and r.role == "prefill"]
 
   # ------------------------------------------------------------ poll + probe
 
@@ -180,6 +194,7 @@ class RouterApp:
         q = await resp.json()
       rep.queue = q.get("admission") or {}
       rep.active_requests = int(q.get("active_requests") or 0)
+      rep.role = str(q.get("fabric_role") or "mixed")
     except Exception as e:
       # Fail CLOSED (same policy as the alerts poll below): keep the last
       # observed load view — zeroing it would make the replica whose queue
@@ -372,6 +387,9 @@ class RouterApp:
       "proxied_total": self.proxied_total,
       "no_replica_503_total": self.no_replica_503_total,
       "prefetch_announced_total": self.prefetch_announced_total,
+      "fabric_chained_total": self.fabric_chained_total,
+      "fabric_chain_failures_total": self.fabric_chain_failures_total,
+      "prefill_replicas": [r.name for r in self.prefill_replicas()],
       "drains_total": sum(r.lifecycle.drains_total for r in self.replicas.values()),
       "readmits_total": sum(r.lifecycle.readmits_total for r in self.replicas.values()),
       "drift_named_total": sum(r.drift_named_total for r in self.replicas.values()),
@@ -398,18 +416,26 @@ class RouterApp:
       return web.json_response({"detail": f"replica {rep.name} failed: {e!r}"},
                                status=502)
 
-  def _announce_prefetch(self, rep: _Replica, body: dict) -> None:
+  def _announce_prefetch(self, rep: _Replica, body: dict,
+                         force: bool = False) -> None:
     """PRESERVE pre-announce: ship the request's messages to the target's
     /v1/prefetch so its host tier can start the warm-prefix restore while
     the request is queued (there, or still in flight here). Only fired
     when the target actually has a wait (inflight at cap or queue
     non-empty) — an immediately admitted request reuses its prefix through
-    the normal path at no extra cost."""
+    the normal path at no extra cost. `force` overrides the wait check for
+    targets whose local warm set is presumed NOT to cover this prefix: a
+    spill target (the affinity owner holds the warm KV, so the prefetch is
+    what triggers the cross-replica fabric fetch) and a freshly readmitted
+    replica (whatever it held pre-drain is stale or evicted)."""
     q = rep.queue or {}
     waiting = (int(q.get("queued") or 0) > 0
                or (int(q.get("max_inflight") or 0) > 0
                    and int(q.get("inflight") or 0) >= int(q.get("max_inflight") or 0)))
-    if not waiting or self._session is None:
+    readmit_at = rep.lifecycle.readmitted_at
+    fresh_readmit = (readmit_at is not None
+                     and time.monotonic() - readmit_at < 10.0 * self.poll_s)
+    if not (force or waiting or fresh_readmit) or self._session is None:
       return
 
     async def announce():
@@ -424,6 +450,43 @@ class RouterApp:
           print(f"router prefetch announce to {rep.name} failed: {e!r}")
 
     spawn_detached(announce())
+
+  async def _chain_prefill(self, rep: _Replica, body: dict) -> None:
+    """Disaggregated serving: run the prompt on a prefill-role replica
+    first, then pre-announce the resulting KV handle at the decode target
+    (`/v1/kv/offer`) so its fabric consult imports the finished prefill
+    instead of recomputing it. Awaited — the offer must land before the
+    decode forward's prefix probe runs, or the decode replica would race
+    its own cold prefill against the transfer. EVERY failure (no prefill
+    replica, prefill error, offer rejected) degrades to a plain forward:
+    the chain changes where prefill runs, never whether a request can."""
+    pre = next((r for r in self.prefill_replicas() if r is not rep), None)
+    if pre is None or self._session is None:
+      return
+    payload = {k: body[k] for k in ("model", "messages", "tools") if k in body}
+    payload["stream"] = False
+    try:
+      async with self._session.post(f"{pre.url}/v1/chat/completions",
+                                    json=payload,
+                                    timeout=self.proxy_timeout) as resp:
+        handle = await resp.json() if resp.status == 200 else None
+      if (not isinstance(handle, dict) or handle.get("object") != "kv.handle"
+          or not handle.get("tokens")):
+        raise ValueError(f"no kv.handle from {pre.name}")
+      pre.routed_total += 1
+      offer = {"model": body.get("model"), "tokens": handle["tokens"],
+               "length": handle.get("length"), "nbytes": handle.get("nbytes"),
+               "url": pre.url}
+      async with self._session.post(f"{rep.url}/v1/kv/offer", json=offer,
+                                    timeout=_POLL_TIMEOUT) as oresp:
+        if oresp.status != 202:
+          raise ValueError(f"offer to {rep.name} rejected ({oresp.status})")
+      self.fabric_chained_total += 1
+    except Exception as e:
+      self.fabric_chain_failures_total += 1
+      if DEBUG >= 1:
+        print(f"router: prefill chain via {pre.name} failed "
+              f"(decode target prefills cold): {e!r}")
 
   def _no_replica_503(self):
     self.no_replica_503_total += 1
@@ -453,7 +516,11 @@ class RouterApp:
     if spilled:
       rep.spilled_to_total += 1
     self.proxied_total += 1
-    self._announce_prefetch(rep, body)
+    # A spill target is, by construction, NOT the affinity owner of this
+    # prefix — force the pre-announce so its fabric consult pulls the warm
+    # KV from the sibling that is.
+    self._announce_prefetch(rep, body, force=spilled)
+    await self._chain_prefill(rep, body)
     resp = await self._forward(rep, body, request)
     if resp is None:
       # Replica shed it (429): one spill retry on the least-loaded OTHER
@@ -471,7 +538,7 @@ class RouterApp:
         alt_rep = self.replicas[str(least["name"])]
         alt_rep.routed_total += 1
         alt_rep.spilled_to_total += 1
-        self._announce_prefetch(alt_rep, body)
+        self._announce_prefetch(alt_rep, body, force=True)
         resp = await self._forward(alt_rep, body, request)
       if resp is None:
         # Final attempt, relaying the 429 if it still sheds — but a request

@@ -125,6 +125,11 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_ROUTER_TIMEOUT_S", "float", "300", "Router: total proxy timeout (s) for one forwarded request.", "Front door"),
   Knob("XOT_ROUTER_DRIFT", "bool", "1", "Router: compare each replica's /v1/history trailing gauges against the fleet median and treat a chronic drifter as a drain-eligible perf_drift suspect.", "Front door"),
   Knob("XOT_ROUTER_DRIFT_POLLS", "int", "3", "Router: consecutive poll ticks a replica must deviate from the fleet median before it is named perf_drift.", "Front door"),
+  # ------------------------------------------------------------ KV fabric
+  Knob("XOT_FABRIC_PEERS", "str", "", "Fleet-wide KV fabric: comma-separated sibling replica base URLs to probe on a host-tier prefix miss; empty disables static peer probing (router offers still work).", "KV fabric"),
+  Knob("XOT_FABRIC_ROLE", "str", "mixed", "Disaggregated serving role: `prefill` (compute KV, offer it, return a handle instead of streaming), `decode` (import offered KV, serve decode), or `mixed` (default: serve everything).", "KV fabric"),
+  Knob("XOT_FABRIC_TIMEOUT_S", "float", "2", "KV fabric: per-request transport timeout (s) for peer match probes and entry fetches; a timed-out fetch degrades to a cold prefill.", "KV fabric"),
+  Knob("XOT_FABRIC_OFFER_TTL_S", "float", "120", "KV fabric: seconds an announced peer offer stays usable in the local directory before it expires.", "KV fabric"),
   # ------------------------------------------------------------- topology
   Knob("XOT_COORDINATOR", "str", None, "JAX multi-host coordinator address (`host:port`); setting it implies multi-host.", "Topology"),
   Knob("XOT_MULTIHOST", "bool", "0", "Force JAX multi-host initialization.", "Topology"),
