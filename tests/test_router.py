@@ -627,3 +627,193 @@ async def test_prefetch_prompt_dedupes_router_and_gate_hooks():
   assert await node.prefetch_prompt(shard, "hello session") is False  # deduped
   assert await node.prefetch_prompt(shard, "другой prompt") is True   # distinct
   assert engine.prefetches == ["hello session", "другой prompt"]
+
+
+# ------------------------------------------------------------------- hedging
+
+def _sse_payloads(raw: str):
+  """Parsed SSE chunk objects minus the per-request fields (id, created):
+  the byte-identity comparison surface for hedged vs unhedged streams."""
+  out = []
+  for line in raw.split("\n"):
+    if not line.startswith("data: ") or line == "data: [DONE]":
+      continue
+    obj = json.loads(line[6:])
+    obj.pop("id", None)
+    obj.pop("created", None)
+    out.append(obj)
+  return out
+
+
+async def test_router_hedges_slow_primary_and_cleans_up_loser(monkeypatch):
+  """The tail-hedging arc end to end: the affinity primary produces no byte
+  past the hedge delay, the duplicate fires at the other replica and wins,
+  the loser is cancelled SERVER-side (zero leaked active requests on the
+  losing replica, a frozen `hedge.cancelled` flight snapshot on the
+  router), and the winner's stream is byte-identical to an unhedged run of
+  the same body modulo the per-request id/created fields."""
+  monkeypatch.setenv("XOT_ROUTER_HEDGE_PCT", "100")
+  monkeypatch.setenv("XOT_ROUTER_HEDGE_MIN_S", "0.2")
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "stream": True,
+            "messages": [{"role": "user", "content": "session-4 hello there"}]}
+    views = [r.view() for r in router.routable()]
+    from xotorch_tpu.router import prefix_key as pk, route as rt
+    target, _ = rt(pk(body), views, 0)
+    slow_node = nodes[int(target[1:])]
+    other = "r1" if target == "r0" else "r0"
+
+    # Baseline first, unhedged (pct forced to 0): the stream the hedged
+    # run must reproduce byte for byte.
+    router.hedge_pct = 0.0
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    baseline = _sse_payloads(await resp.text())
+    assert baseline and router.hedges_fired_total == 0
+
+    # Slow the primary BEFORE any byte: the delay sits ahead of
+    # process_prompt, so the replica has sent no response bytes when the
+    # hedge delay (0.2 s, cold-fleet floor) expires.
+    orig_process = slow_node.process_prompt
+    ran = []
+
+    async def delayed_process(*a, **kw):
+      ran.append(True)
+      await asyncio.sleep(1.2)
+      return await orig_process(*a, **kw)
+
+    slow_node.process_prompt = delayed_process
+    router.hedge_pct = 100.0
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200
+    hedged = _sse_payloads(await resp.text())
+    assert hedged == baseline  # winner-only tokens, identical stream
+
+    assert router.hedges_fired_total == 1
+    assert router.hedges_won_total == 1       # the alt beat the slow primary
+    assert router.hedge_cancelled_total == 1  # exactly one loser, cancelled
+    assert router.hedge_both_streamed_total == 0
+    assert router.replicas[other].routed_total >= 1
+    events = [e["event"] for e in router.flight.tail(0)]
+    assert "hedge.fired" in events and "hedge.won" in events
+
+    # The loser's cancel is server-side: once its delayed prompt runs, the
+    # replica's disconnect/abort path must clear every active request —
+    # nothing keeps decoding for a client that is gone.
+    for _ in range(100):
+      if ran and not slow_node.outstanding_requests:
+        break
+      await asyncio.sleep(0.1)
+    assert ran, "the losing replica never saw the duplicated request"
+    assert not slow_node.outstanding_requests  # zero leaked active requests
+
+    # The router froze the loser's timeline for post-mortems.
+    snaps = [s for s in router.flight.snapshots()
+             if s["reason"] == "hedge.cancelled"]
+    assert snaps
+    snap_events = [e["event"] for e in snaps[-1]["events"]]
+    assert "hedge.fired" in snap_events and "hedge.cancelled" in snap_events
+    slow_node.process_prompt = orig_process
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+async def test_router_hedge_settled_primary_never_hedges(monkeypatch):
+  """A primary that answers within the hedge delay never fires a hedge —
+  and non-streaming bodies ride the same attempt machinery."""
+  monkeypatch.setenv("XOT_ROUTER_HEDGE_PCT", "100")
+  monkeypatch.setenv("XOT_ROUTER_HEDGE_MIN_S", "5")
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "hello"}]}
+    for stream in (False, True):
+      resp = await rclient.post("/v1/chat/completions", json={**body, "stream": stream})
+      assert resp.status == 200
+      await resp.read()
+    assert router.hedges_fired_total == 0
+    assert router.hedge_cancelled_total == 0
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+async def test_router_hedge_relays_429_into_spill_retry(monkeypatch):
+  """A hedged-path primary that sheds (429) still degrades into the spill
+  retry — the attempt machinery returns None exactly like _forward."""
+  monkeypatch.setenv("XOT_ROUTER_HEDGE_PCT", "100")
+  monkeypatch.setenv("XOT_ROUTER_HEDGE_MIN_S", "5")
+  monkeypatch.setenv("XOT_MAX_INFLIGHT", "1")
+  monkeypatch.setenv("XOT_ADMIT_QUEUE_DEPTH", "0")
+  router, rclient, clients, nodes = await _router_over_two_replicas(monkeypatch)
+  try:
+    body = {"model": "dummy", "messages": [{"role": "user", "content": "session-9 hi"}]}
+    views = [r.view() for r in router.routable()]
+    from xotorch_tpu.router import prefix_key as pk, route as rt
+    target, _ = rt(pk(body), views, 0)
+    target_node = nodes[int(target[1:])]
+    target_node.admission.admit("occupier")
+    resp = await rclient.post("/v1/chat/completions", json=body)
+    assert resp.status == 200  # spilled to the free replica, not 429
+    other = "r1" if target == "r0" else "r0"
+    assert router.replicas[other].spilled_to_total >= 1
+    target_node.admission.release()
+  finally:
+    await _teardown_router(router, rclient, clients)
+
+
+# ------------------------------------------------- scrape-failure streak
+
+async def test_router_scrape_failure_feeds_down_streak(monkeypatch):
+  """A reachable replica whose metrics scrapes fail builds the SAME
+  down-streak an unreachable one does (observation loss is liveness loss
+  — the fleet dead-detector consumes one signal), with every failure
+  counted at /v1/router; one clean poll resets the streak but never the
+  counter."""
+  from aiohttp import web as aioweb
+  from aiohttp.test_utils import TestServer
+  from xotorch_tpu.router.app import RouterApp
+
+  monkeypatch.setenv("XOT_ROUTER_DRIFT", "0")
+  failing = {"on": True}
+
+  async def healthcheck(request):
+    return aioweb.json_response({"status": "ok"})
+
+  async def queue(request):
+    if failing["on"]:
+      return aioweb.Response(status=500, text="boom")
+    return aioweb.json_response({"admission": {"queued": 0, "est_wait_s": 0.0},
+                                 "active_requests": 0, "fabric_role": "mixed"})
+
+  async def alerts(request):
+    if failing["on"]:
+      return aioweb.Response(status=500, text="boom")
+    return aioweb.json_response({"cluster": {"firing": 0, "active": []}})
+
+  app = aioweb.Application()
+  app.router.add_get("/healthcheck", healthcheck)
+  app.router.add_get("/v1/queue", queue)
+  app.router.add_get("/v1/alerts", alerts)
+  server = TestServer(app)
+  await server.start_server()
+  router = RouterApp([f"http://127.0.0.1:{server.port}"])
+  await router.start()
+  try:
+    router._poll_task.cancel()  # drive the polls by hand
+    rep = router.replicas["r0"]
+    await router._poll_one(rep)
+    assert rep.reachable is True            # the healthcheck still answers
+    assert rep.scrape_failures_total == 2   # queue + alerts both failed
+    assert rep.down_streak == 1             # ...and feed ONE streak
+    await router._poll_one(rep)
+    assert rep.down_streak == 2 and rep.scrape_failures_total == 4
+    failing["on"] = False
+    await router._poll_one(rep)
+    assert rep.down_streak == 0             # clean poll: streak resets
+    assert rep.scrape_failures_total == 4   # the counter never does
+    status_rep = rep.snapshot()
+    assert status_rep["scrape_failures_total"] == 4
+    assert status_rep["down_streak"] == 0
+  finally:
+    await router.stop()
+    await server.close()

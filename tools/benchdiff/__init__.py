@@ -221,6 +221,13 @@ _SOAK_DOWN = frozenset({
   # broken, not degraded. A green verdict guarantees zero (tools/soak
   # evaluate reds on any), so the gate can never flag a green run.
   "fabric_transfer_failures",
+  # A fleet respawn that never came back healthy is the outage the elastic
+  # controller exists to prevent; a hedged request streaming tokens from
+  # BOTH legs is a double-billed response (the loser was not cancelled).
+  # A green verdict guarantees both are zero, so the gate can never flag a
+  # green run.
+  "fleet_respawn_failures",
+  "hedge_both_streamed",
 })
 _SOAK_INFO = frozenset({
   "requests_submitted", "requests_ok", "request_errors",
@@ -249,6 +256,12 @@ _SOAK_INFO = frozenset({
   # FAILURE's documented degradation is a plain cold forward — the soak
   # verdict owns the >= 1 hit bar; drift here is informational.
   "kv_fabric_misses", "fabric_chained", "fabric_chain_failures",
+  # Fleet actuation and hedge magnitudes are dictated by the injected
+  # fault schedule (a SIGKILL is SUPPOSED to respawn, a surge is SUPPOSED
+  # to scale up, a stall is SUPPOSED to hedge); the verdict owns the >= 1
+  # expectations and the zero bars above own the failure counters.
+  "fleet_respawns", "fleet_deaths", "fleet_scale_ups", "fleet_scale_downs",
+  "fleet_spawn_failures", "hedges_fired", "hedges_won", "hedge_cancelled",
 })
 
 # A committed green soak whose stage breakdowns leave more than this

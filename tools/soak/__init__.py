@@ -422,6 +422,68 @@ def summarize_router(router_status: Optional[dict], tracking: Optional[dict],
   }
 
 
+def summarize_fleet(statuses: Optional[Dict[str, dict]],
+                    baselines: Optional[Dict[str, dict]],
+                    load_router: Optional[dict],
+                    load_baseline: Optional[dict],
+                    holders: Optional[Iterable[str]] = None,
+                    expect: Optional[Dict[str, bool]] = None) -> Dict[str, Any]:
+  """The elastic-fleet verdict section. Controller counters are summed
+  across routers as load-window deltas — each actuation happens on exactly
+  one lease holder, and a since-killed router contributes through its
+  last-good scrape (the orchestrator keys scrapes by router id for exactly
+  this). Hedge counters come from the LOAD router alone: it is the only
+  process proxying client traffic, and the holder's idle hedge counters
+  would just dilute the delta. `holders` is every lease holder_id observed
+  since load start; two or more means actuation provably handed over."""
+  statuses = statuses or {}
+  baselines = baselines or {}
+  holder_list = [h for h in (holders or ()) if h]
+
+  def fleet_delta(key: str) -> int:
+    total = 0
+    for rid, status in statuses.items():
+      cur = ((status or {}).get("fleet") or {}).get(key) or 0
+      base = (((baselines.get(rid) or {}).get("fleet")) or {}).get(key) or 0
+      total += max(0, int(cur) - int(base))
+    return total
+
+  def router_delta(key: str) -> int:
+    total = 0
+    for rid, status in statuses.items():
+      cur = (status or {}).get(key) or 0
+      base = (baselines.get(rid) or {}).get(key) or 0
+      total += max(0, int(cur) - int(base))
+    return total
+
+  def hedge_delta(key: str) -> int:
+    return max(0, int((load_router or {}).get(key) or 0)
+               - int((load_baseline or {}).get(key) or 0))
+
+  return {
+    "routers": sorted(statuses),
+    "holders_seen": holder_list,
+    "holder_changed": len(holder_list) >= 2,
+    "respawns": fleet_delta("respawns_total"),
+    "respawn_failures": fleet_delta("respawn_failures_total"),
+    "deaths": fleet_delta("deaths_total"),
+    "scale_ups": fleet_delta("scale_ups_total"),
+    "scale_downs": fleet_delta("scale_downs_total"),
+    "retires": fleet_delta("retires_total"),
+    "adopted": fleet_delta("adopted_total"),
+    "spawn_failures": fleet_delta("spawn_failures_total"),
+    # Soft warm-start evidence: prefixes the holder pre-announced into a
+    # freshly (re)spawned replica. Reported, never gated — the hard warm
+    # guarantee (compile-cache reuse) is engine-level unit territory.
+    "warm_prefetch_announced": router_delta("prefetch_announced_total"),
+    "hedges_fired": hedge_delta("hedges_fired_total"),
+    "hedges_won": hedge_delta("hedges_won_total"),
+    "hedge_cancelled": hedge_delta("hedge_cancelled_total"),
+    "hedge_both_streamed": hedge_delta("hedge_both_streamed_total"),
+    "expect": dict(expect or {}),
+  }
+
+
 def classify_aborts(abort_events: Iterable[dict],
                     fault_windows: Iterable[dict]) -> Dict[str, list]:
   """Split watchdog/deadline abort evidence into injected (inside an active
@@ -521,6 +583,18 @@ def flatten_metrics(report: Dict[str, Any]) -> Dict[str, float]:
     out["fabric_transfer_failures"] = float(fabric.get("errors") or 0)
     out["fabric_chained"] = float(fabric.get("router_chained") or 0)
     out["fabric_chain_failures"] = float(fabric.get("router_chain_failures") or 0)
+  fleet = report.get("fleet")
+  if fleet is not None:
+    out["fleet_respawns"] = float(fleet.get("respawns") or 0)
+    out["fleet_respawn_failures"] = float(fleet.get("respawn_failures") or 0)
+    out["fleet_deaths"] = float(fleet.get("deaths") or 0)
+    out["fleet_scale_ups"] = float(fleet.get("scale_ups") or 0)
+    out["fleet_scale_downs"] = float(fleet.get("scale_downs") or 0)
+    out["fleet_spawn_failures"] = float(fleet.get("spawn_failures") or 0)
+    out["hedges_fired"] = float(fleet.get("hedges_fired") or 0)
+    out["hedges_won"] = float(fleet.get("hedges_won") or 0)
+    out["hedge_cancelled"] = float(fleet.get("hedge_cancelled") or 0)
+    out["hedge_both_streamed"] = float(fleet.get("hedge_both_streamed") or 0)
   aborts = report.get("aborts") or {}
   out["false_aborts"] = float(len(aborts.get("false") or ()))
   leaks = report.get("leaks") or {}
@@ -617,6 +691,41 @@ def evaluate(report: Dict[str, Any]) -> Dict[str, Any]:
         reasons.append("router: injected gray failure drove no replica to draining")
       if router.get("readmits_total", 0) < 1:
         reasons.append("router: no drained replica was readmitted after the fault cleared")
+  fleet = report.get("fleet")
+  if fleet is not None:
+    # The elastic-fleet green bar. Failure counters are zero-tolerance
+    # (a respawn or spawn that did not come up healthy is the exact outage
+    # the controller exists to prevent; both hedge legs streaming is a
+    # double-billed request). Each positive expectation is asserted only
+    # when the run staged its fault — and client errors red at ANY count,
+    # in-window or not: the fleet's whole contract is that every injected
+    # fault stays invisible to clients.
+    if float(fleet.get("respawn_failures") or 0) > 0:
+      reasons.append(f"fleet: {fleet.get('respawn_failures')} respawn(s) never "
+                     "came back healthy inside the boot timeout")
+    if float(fleet.get("spawn_failures") or 0) > 0:
+      reasons.append(f"fleet: {fleet.get('spawn_failures')} spawn attempt(s) "
+                     "failed outright (template argv/env is broken)")
+    if float(fleet.get("hedge_both_streamed") or 0) > 0:
+      reasons.append(f"fleet: {fleet.get('hedge_both_streamed')} hedged "
+                     "request(s) streamed from BOTH legs (loser not cancelled)")
+    exp = fleet.get("expect") or {}
+    if exp.get("respawn") and float(fleet.get("respawns") or 0) < 1:
+      reasons.append("fleet: a replica was SIGKILLed but no controller "
+                     "respawn landed")
+    if exp.get("scale_up") and float(fleet.get("scale_ups") or 0) < 1:
+      reasons.append("fleet: the surge never drove a scale-up into a "
+                     "latent slot")
+    if exp.get("hedge_win") and float(fleet.get("hedges_won") or 0) < 1:
+      reasons.append("fleet: the injected stall produced no won hedge "
+                     "(no alternate leg beat the slow primary)")
+    if exp.get("holder_change") and not fleet.get("holder_changed"):
+      reasons.append("fleet: the lease holder was killed but no surviving "
+                     f"router took over (holders seen: {fleet.get('holders_seen')})")
+    if client.get("errors"):
+      reasons.append(f"fleet: {client.get('errors')} client error(s) — the "
+                     "elastic-fleet bar is zero errors TOTAL, fault windows "
+                     "included")
   fabric = report.get("fabric")
   if fabric is not None:
     # The fabric green bar: zero dropped transfers (a torn/stale blob must

@@ -70,6 +70,15 @@ def main(argv=None) -> int:
                            "fresh prompt chains prefill -> KV offer -> decode "
                            "and the verdict requires >= 1 real cross-replica "
                            "KV import with zero dropped transfers")
+  parser.add_argument("--fleet-smoke", action="store_true",
+                      help="elastic-fleet smoke: TWO routers (lease-holder + "
+                           "load router) over a fleet template with a latent "
+                           "spare; SIGKILL a replica (controller respawn, warm "
+                           "via the shared compile cache), SIGKILL the holder "
+                           "router (survivor takes the lease), a surge burst "
+                           "(scale-up into the spare), and an injected stall "
+                           "(hedge fires and wins) — green requires all four "
+                           "AND zero client errors total")
   parser.add_argument("--seconds", type=float, default=None)
   parser.add_argument("--rps", type=float, default=None)
   parser.add_argument("--procs", type=int, default=None)
@@ -111,10 +120,11 @@ def main(argv=None) -> int:
   )
   cfg.tag = args.tag or ("smoke" if args.smoke
                          else "router" if args.router_smoke
-                         else "fabric" if args.fabric_smoke else "run")
-  if sum((args.smoke, args.router_smoke, args.fabric_smoke)) > 1:
-    print("soak: --smoke, --router-smoke and --fabric-smoke are mutually exclusive",
-          file=sys.stderr)
+                         else "fabric" if args.fabric_smoke
+                         else "fleet" if args.fleet_smoke else "run")
+  if sum((args.smoke, args.router_smoke, args.fabric_smoke, args.fleet_smoke)) > 1:
+    print("soak: --smoke, --router-smoke, --fabric-smoke and --fleet-smoke "
+          "are mutually exclusive", file=sys.stderr)
     return 2
   if args.router_smoke:
     # The front-door acceptance shape: two independent single-node replicas
@@ -164,6 +174,47 @@ def main(argv=None) -> int:
       cfg.max_tokens = 6
     if args.recon_tol_s is None:
       cfg.recon_tol_s = 30.0
+  if args.fleet_smoke:
+    # The elastic-fleet acceptance arc, on one 140 s clock:
+    #   t=18  SIGKILL rep1        -> the lease holder declares it dead after
+    #                                3 unclean polls and respawns it from the
+    #                                template (warm: same compile cache, and
+    #                                the holder pre-announces hot prefixes)
+    #   t=55  SIGKILL routerA     -> the lease expires (5 s TTL) and routerB
+    #                                takes over actuation without dropping a
+    #                                single proxied request
+    #   t=75  24-request burst    -> per-replica admission queues mark their
+    #                                high-water, three pressured ticks later
+    #                                the controller scales into latent rep2
+    #   t=100 4 s ProcessPrompt   -> slower than the 1.5 s hedge floor but
+    #         stall on rep0          inside the 6 s SLO: the hedge fires, the
+    #                                other replica wins, the loser is aborted
+    # Streaming is OFF by design: the zero-client-errors bar is structural
+    # only for non-streamed requests (a connect-refused or broken-mid-read
+    # body transparently retries on another replica; a stream past its
+    # first byte cannot). recon_tol_s is wide because queue waits, failover
+    # retries and hedge delays are client-visible wall time by design.
+    cfg.router = True
+    cfg.fleet = True
+    cfg.replicas = 2
+    if args.seconds is None:
+      cfg.seconds = 140.0
+    if args.rps is None:
+      cfg.rate_rps = 0.35
+    if args.max_tokens is None:
+      cfg.max_tokens = 6
+    if args.stream_fraction is None:
+      cfg.stream_fraction = 0.0
+    if args.recon_tol_s is None:
+      cfg.recon_tol_s = 30.0
+    cfg.overload = {"at_s": 75.0, "count": 24}
+    cfg.fleet_kill_router_at_s = 55.0
+    cfg.faults.append(_parse_kill("1@18+60"))
+    from tools.soak.orchestrator import FaultPhase
+    cfg.faults.append(FaultPhase(
+      kind="rules", node=0, at_s=100.0, until_s=128.0, grace_s=45.0,
+      rules=[{"rpc": "ProcessPrompt", "action": "delay", "nth": 1,
+              "times": 1000000, "delay_s": 4.0}]))
   if args.smoke:
     # The acceptance shape: one mid-run kill of the non-API node, load
     # sized so a laptop/CI runner finishes the whole arc in a few minutes.
@@ -183,6 +234,8 @@ def main(argv=None) -> int:
   cfg.faults.extend(_parse_rules(s) for s in args.rules)
   node_count = cfg.replicas if cfg.router else cfg.procs
   for phase in cfg.faults:
+    if phase.kind == "kill_router":
+      continue  # targets the holder router, not a ring node
     if not 0 <= phase.node < node_count:
       print(f"soak: fault names node {phase.node} but the run has {node_count} node(s)",
             file=sys.stderr)
@@ -231,13 +284,27 @@ def main(argv=None) -> int:
           f"errors={fb.get('errors')} bytes={fb.get('bytes')} "
           f"chained={fb.get('router_chained')} "
           f"chain_failures={fb.get('router_chain_failures')}")
+  fl = report.get("fleet")
+  if fl is not None:
+    print(f"  fleet: respawns={fl.get('respawns')} "
+          f"respawn_failures={fl.get('respawn_failures')} "
+          f"deaths={fl.get('deaths')} scale_ups={fl.get('scale_ups')} "
+          f"holders={','.join(fl.get('holders_seen') or ()) or '-'} "
+          f"warm_prefixes={fl.get('warm_prefetch_announced')}")
+    print(f"  hedge: won/fired={fl.get('hedges_won')}/{fl.get('hedges_fired')} "
+          f"cancelled={fl.get('hedge_cancelled')} "
+          f"both_streamed={fl.get('hedge_both_streamed')}")
   for reason in report.get("reasons", []):
     print(f"  RED: {reason}")
   rc = 0 if report.get("verdict") == "green" else 1
-  if rc == 0 and any(p.kind == "kill" for p in cfg.faults):
+  if rc == 0 and not cfg.fleet and any(p.kind == "kill" for p in cfg.faults):
     # A kill phase must PROVE the alert machine end to end: at least one
     # alert fired inside the kill window and resolved after the fault
-    # cleared. A green run with a silent alert engine is not green.
+    # cleared. A green run with a silent alert engine is not green. Fleet
+    # runs are exempt BY DESIGN: there the killed process is a whole
+    # single-node ring whose alert engine dies with it, the survivors see
+    # only failed-over traffic, and the end-to-end proof is the fleet
+    # section's own bar (respawn landed, zero client errors).
     if al.get("fired_and_resolved_in_window", 0) < 1:
       print("  RED: kill phase produced no fired-then-resolved alert "
             "(the burn-rate rules slept through an injected fault)")

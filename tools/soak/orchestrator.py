@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import re
 import signal
 import sys
@@ -86,8 +87,8 @@ SOAK_ALERT_ENV = {
 
 @dataclass
 class FaultPhase:
-  kind: str                      # "kill" | "rules"
-  node: int                      # ring index (0 = API node)
+  kind: str                      # "kill" | "rules" | "kill_router" (fleet holder)
+  node: int                      # ring index (0 = API node; unused for kill_router)
   at_s: float                    # seconds from load start
   grace_s: float = 45.0          # how long after the fault aborts are excused
   until_s: Optional[float] = None  # rules: uninstall time (default at_s+grace)
@@ -138,6 +139,28 @@ ROUTER_ENV = {
   "XOT_ROUTER_PROBES": "2",
   "XOT_ROUTER_SPILL_DEPTH": "1",
   "XOT_ROUTER_PROBE_TOKENS": "2",
+}
+
+# Extra router env for FLEET mode (layered on ROUTER_ENV): CI-timescale
+# elastic-controller cadences — a dead replica is declared after 3 s of
+# unclean polls, queue pressure must hold 3 ticks before a scale-up, the
+# actuation lease hands over 5 s after its holder dies, and spares are
+# never idle-retired inside a smoke (the retire path has its own unit
+# coverage; retiring mid-smoke would just shrink the fleet the hedge
+# phase needs). Hedging is fully open (pct=100) with a 1.5 s floor and a
+# 1x p99 factor so the injected 4 s ProcessPrompt stall provably out-waits
+# the hedge delay while healthy sub-second requests never reach it.
+FLEET_ROUTER_ENV = {
+  "XOT_FLEET_DEAD_POLLS": "3",
+  "XOT_FLEET_UP_QUEUE": "1",
+  "XOT_FLEET_UP_POLLS": "3",
+  "XOT_FLEET_IDLE_POLLS": "600",
+  "XOT_FLEET_COOLDOWN_S": "5",
+  "XOT_FLEET_LEASE_TTL_S": "5",
+  "XOT_FLEET_BOOT_TIMEOUT_S": "150",
+  "XOT_ROUTER_HEDGE_PCT": "100",
+  "XOT_ROUTER_HEDGE_FACTOR": "1",
+  "XOT_ROUTER_HEDGE_MIN_S": "1.5",
 }
 
 
@@ -191,6 +214,18 @@ class SoakConfig:
   router_port: int = 53590
   replica_env: Dict[str, str] = field(default_factory=lambda: dict(ROUTER_REPLICA_ENV))
   router_env: Dict[str, str] = field(default_factory=lambda: dict(ROUTER_ENV))
+  # fleet=True (implies router): the elastic-fleet smoke. The replicas
+  # spawn from a generated fleet TEMPLATE (plus `fleet_latent` latent
+  # spare slots) under TWO router processes sharing one actuation lease —
+  # routerA boots first and provably holds the lease, routerB carries the
+  # client load. `fleet_kill_router_at_s` SIGKILLs the holder mid-load so
+  # the survivor must take over actuation; the report gains a `fleet`
+  # section (respawns / scale-ups / lease holders / hedge outcomes) with
+  # its own green bar, including ZERO client errors total.
+  fleet: bool = False
+  fleet_latent: int = 1
+  fleet_kill_router_at_s: Optional[float] = None
+  fleet_env: Dict[str, str] = field(default_factory=lambda: dict(FLEET_ROUTER_ENV))
 
 
 class SoakRing:
@@ -206,9 +241,25 @@ class SoakRing:
     # id doubles as the replica id everywhere (metrics, cluster views).
     self.names: List[str] = ([f"rep{i}" for i in range(cfg.replicas)] if cfg.router
                              else [f"soak-{i}" for i in range(cfg.procs)])
+    # Fleet mode: latent template slots the controller may scale into.
+    # They are not harness children — everything that must also cover
+    # controller-spawned processes (scrapes, drain, leak check, teardown)
+    # iterates all_names and resolves liveness via the pid sidecar.
+    self.latent_names: List[str] = (
+      [f"rep{cfg.replicas + i}" for i in range(cfg.fleet_latent)]
+      if cfg.fleet else [])
+    self.all_names: List[str] = self.names + self.latent_names
     self.router_proc = None
     self.router_log = None
     self.last_router: Optional[dict] = None
+    # Fleet mode: the second (lease-holding) router process, the last-good
+    # /v1/router body PER router id (a dead holder's final counters must
+    # survive its death), and every lease holder_id ever observed.
+    self.fleet_router_proc = None
+    self.fleet_router_log = None
+    self.fleet_template: Optional[Path] = None
+    self.fleet_status: Dict[str, dict] = {}
+    self.fleet_holders: set = set()
     # Out-of-rotation routing tracker, per EPISODE: while the router
     # reports a replica draining/probing, its routed_total is baselined at
     # the episode's first scrape and any growth accumulates into `accum`
@@ -254,6 +305,12 @@ class SoakRing:
                **self.cfg.alert_env}
       if self.cfg.router:
         extra.update(self.cfg.replica_env)
+      if self.cfg.fleet:
+        # Persistent jit cache: the template slots carry the same knob, so
+        # a controller respawn lands on compiles this very warmup paid —
+        # the "warm cold-start" the fleet smoke soft-verifies.
+        extra["XOT_COMPILE_CACHE_DIR"] = os.environ.get(
+          "JAX_COMPILATION_CACHE_DIR", "/root/.cache/xot_jax_cache")
       if self.cfg.fabric:
         # Disaggregated roles: replica 0 prefills and offers, the rest
         # decode. Peers are cross-wired so an entry fetch resolves by URL
@@ -268,7 +325,12 @@ class SoakRing:
         self.cfg.grpc_base + i, self.logs[name], model=self.cfg.model,
         response_timeout=180, extra_env=extra,
       )
-    if self.cfg.router:
+    if self.cfg.fleet:
+      for j, name in enumerate(self.latent_names):
+        self.ports[name] = self.cfg.api_base + len(self.names) + j
+      self._write_fleet_template(log_dir, extra)
+      self._spawn_fleet_routers(log_dir)
+    elif self.cfg.router:
       self.router_log = open(log_dir / "router.log", "w")
       replica_flags = []
       for name in self.names:
@@ -278,6 +340,93 @@ class SoakRing:
          "--port", str(self.cfg.router_port), *replica_flags],
         env=node_env(**self.cfg.router_env), stdout=self.router_log,
         stderr=subprocess.STDOUT)
+
+  def _node_argv(self, name: str, i: int) -> List[str]:
+    """The exact argv spawn_node would use for slot i — a controller
+    respawn must reproduce the harness spawn bit-for-bit (same ports, same
+    discovery isolation) or the 'respawned' replica is a different ring."""
+    udp = self.cfg.udp_port + 2 * i
+    return [sys.executable, "-m", "xotorch_tpu.main",
+            "--node-id", name, "--disable-tui",
+            "--inference-engine", "jax",
+            "--default-model", self.cfg.model,
+            "--chatgpt-api-port", str(self.cfg.api_base + i),
+            "--listen-port", str(udp), "--broadcast-port", str(udp),
+            "--node-port", str(self.cfg.grpc_base + i),
+            "--discovery-timeout", "15",
+            "--chatgpt-api-response-timeout", "180"]
+
+  def _write_fleet_template(self, log_dir: Path, node_extra: Dict[str, str]) -> None:
+    """The slot universe both routers load: harness replicas as active
+    slots, spares as latent ones. Slot env is the FULL node environment
+    (not a delta) so a spawn from inside a router process cannot inherit
+    router-only knobs. The pid sidecar is pre-seeded with the harness
+    children's pids — that is how the controller SIGKILLs a half-dead
+    replica before respawning and how teardown finds controller spawns."""
+    from tests.xproc_harness import node_env
+    active = set(self.names)
+    slots = []
+    for i, name in enumerate(self.all_names):
+      slots.append({
+        "name": name,
+        "url": f"http://127.0.0.1:{self.ports[name]}",
+        "active": name in active,
+        "argv": self._node_argv(name, i),
+        "env": node_env(**node_extra),
+        "log": str(log_dir / f"{name}.log"),
+      })
+    self.fleet_template = log_dir / "fleet_template.json"
+    self.fleet_template.write_text(json.dumps({"slots": slots}, indent=1) + "\n")
+    Path(str(self.fleet_template) + ".pids").write_text(
+      json.dumps({name: self.procs[name].pid for name in self.names}) + "\n")
+
+  def _spawn_fleet_routers(self, log_dir: Path) -> None:
+    """routerA first, and it must HOLD the lease before routerB even
+    boots: the holder-kill phase then provably hands actuation over
+    instead of flaking on whichever router won the boot race."""
+    import subprocess
+    from tests.xproc_harness import node_env, wait_for
+    renv = node_env(**{**self.cfg.router_env, **self.cfg.fleet_env,
+                       "XOT_FLEET_LEASE_PATH": str(log_dir / "fleet.lease")})
+
+    def router(rid: str, port: int, log):
+      return subprocess.Popen(
+        [sys.executable, "-m", "xotorch_tpu.router",
+         "--port", str(port), "--fleet-template", str(self.fleet_template),
+         "--router-id", rid],
+        env=renv, stdout=log, stderr=subprocess.STDOUT)
+
+    self.fleet_router_log = open(log_dir / "routerA.log", "w")
+    self.fleet_router_proc = router(
+      "routerA", self.cfg.router_port + 1, self.fleet_router_log)
+
+    def a_holds() -> bool:
+      st = self.get_json_port(self.cfg.router_port + 1, "/v1/router")
+      lease = ((st or {}).get("fleet") or {}).get("lease") or {}
+      return bool(lease.get("held"))
+
+    wait_for(a_holds, 60, "routerA holds the fleet lease",
+             proc=self.fleet_router_proc,
+             log_path=getattr(self.fleet_router_log, "name", None))
+    self.router_log = open(log_dir / "routerB.log", "w")
+    self.router_proc = router("routerB", self.cfg.router_port, self.router_log)
+
+  def _fleet_pids(self) -> Dict[str, int]:
+    if not self.fleet_template:
+      return {}
+    try:
+      doc = json.loads(Path(str(self.fleet_template) + ".pids").read_text())
+    except (OSError, ValueError):
+      return {}
+    if not isinstance(doc, dict):
+      return {}
+    out = {}
+    for name, pid in doc.items():
+      try:
+        out[str(name)] = int(pid)
+      except (TypeError, ValueError):
+        continue
+    return out
 
   def wait_ready(self) -> None:
     from tests.xproc_harness import http_get, wait_for
@@ -310,6 +459,14 @@ class SoakRing:
                  60, "router discovers the prefill replica",
                  proc=self.router_proc,
                  log_path=getattr(self.router_log, "name", None))
+      if self.cfg.fleet:
+        # The holder router is warmed too (its recent-body ring feeds the
+        # respawn pre-announce), so it must also route everything first.
+        wait_for(lambda: http_get(self.cfg.router_port + 1, "/healthcheck")
+                 .get("routable") == want,
+                 60, f"routerA routes {want} of {len(self.names)} replicas",
+                 proc=self.fleet_router_proc,
+                 log_path=getattr(self.fleet_router_log, "name", None))
 
   def _log_path(self, name: str):
     f = self.logs.get(name)
@@ -317,7 +474,21 @@ class SoakRing:
 
   def alive(self, name: str) -> bool:
     proc = self.procs.get(name)
-    return proc is not None and proc.poll() is None and name not in self.killed
+    if proc is not None and proc.poll() is None and name not in self.killed:
+      return True
+    # Fleet mode: a respawned or scaled-up replica is the ROUTER's child,
+    # not ours — the spawner's pid sidecar is the only liveness truth. The
+    # poll() above has already reaped our own SIGKILLed child, so a stale
+    # sidecar pid answers ESRCH here rather than lingering as a zombie.
+    if self.cfg.fleet:
+      pid = self._fleet_pids().get(name)
+      if pid:
+        try:
+          os.kill(pid, 0)
+          return True
+        except OSError:
+          return False
+    return False
 
   def get_json(self, name: str, path: str, timeout: float = 5.0) -> Optional[dict]:
     return self.get_json_port(self.ports[name], path, timeout)
@@ -339,7 +510,7 @@ class SoakRing:
       return None
 
   def scrape_once(self) -> None:
-    for name in self.names:
+    for name in self.all_names:
       if not self.alive(name):
         continue
       text = self.get_text(name, "/metrics")
@@ -352,7 +523,7 @@ class SoakRing:
     # status bus; router-mode replicas are DISJOINT rings, so each head is
     # scraped and the node rows merged into one cluster/alert view (node
     # ids are unique across replicas by construction).
-    heads = [n for n in (self.names if self.cfg.router else self.names[:1])
+    heads = [n for n in (self.all_names if self.cfg.router else self.names[:1])
              if self.alive(n)]
     merged_cluster: Dict[str, dict] = {}
     merged_alert_nodes: Dict[str, dict] = {}
@@ -398,15 +569,37 @@ class SoakRing:
       status = self.get_json_port(self.cfg.router_port, "/v1/router")
       if status is not None:
         self.last_router = status
+        self._note_fleet(status)
         for name, row in (status.get("replicas") or {}).items():
-          self.note_router_row(name, str(row.get("state") or ""),
-                               int(row.get("routed_total") or 0))
+          # Fleet boot/retire phases are out-of-rotation too: routing to a
+          # replica the controller is still warming (or tearing down) is
+          # the same red as routing to a drained one.
+          state = ("retiring" if row.get("retiring")
+                   else "warming" if row.get("warming")
+                   else str(row.get("state") or ""))
+          self.note_router_row(name, state, int(row.get("routed_total") or 0))
+    if (self.cfg.fleet and self.fleet_router_proc is not None
+        and self.fleet_router_proc.poll() is None):
+      status = self.get_json_port(self.cfg.router_port + 1, "/v1/router")
+      if status is not None:
+        self._note_fleet(status)
+
+  def _note_fleet(self, status: dict) -> None:
+    """Last-good /v1/router per router id + the holder set. Keyed by the
+    router's own id so the holder's final pre-death counters (its respawn
+    actuations) keep contributing after it is SIGKILLed."""
+    if not isinstance(status.get("fleet"), dict):
+      return
+    self.fleet_status[str(status.get("router_id") or "?")] = status
+    lease = (status.get("fleet") or {}).get("lease") or {}
+    if lease.get("held") and lease.get("holder_id"):
+      self.fleet_holders.add(str(lease["holder_id"]))
 
   def scrape_history_full(self) -> None:
     """One full /v1/history fetch per reachable head (every retained row)
     — the settle-time artifact the CI step uploads; the continuous scrape
     deliberately fetches only the row-less summary."""
-    heads = [n for n in (self.names if self.cfg.router else self.names[:1])
+    heads = [n for n in (self.all_names if self.cfg.router else self.names[:1])
              if self.alive(n)]
     for head in heads:
       history = self.get_json(head, "/v1/history", timeout=10.0)
@@ -417,7 +610,7 @@ class SoakRing:
     """One router-scrape observation into the out-of-rotation tracker."""
     track = self.router_track.setdefault(
       name, {"accum": 0, "episode_start": None, "episode_last": None})
-    if state in ("draining", "probing"):
+    if state in ("draining", "probing", "warming", "retiring"):
       if track["episode_start"] is None:
         track["episode_start"] = routed
       track["episode_last"] = routed
@@ -435,6 +628,12 @@ class SoakRing:
       proc.send_signal(signal.SIGKILL)
     self.killed.add(name)
 
+  def kill_fleet_router(self) -> None:
+    """SIGKILL the holder router (routerA — spawn() serialized its lease
+    acquisition) so the surviving load router must take over actuation."""
+    if self.fleet_router_proc is not None and self.fleet_router_proc.poll() is None:
+      self.fleet_router_proc.send_signal(signal.SIGKILL)
+
   def teardown(self) -> None:
     from tests.xproc_harness import teardown_nodes
     procs = dict(self.procs)
@@ -443,7 +642,36 @@ class SoakRing:
       procs["router"] = self.router_proc
       if self.router_log is not None:
         logs["router"] = self.router_log
+    if self.fleet_router_proc is not None:
+      procs["routerA"] = self.fleet_router_proc
+      if self.fleet_router_log is not None:
+        logs["routerA"] = self.fleet_router_log
     teardown_nodes(procs, logs)
+    self._teardown_fleet_pids()
+
+  def _teardown_fleet_pids(self) -> None:
+    """Controller-spawned replicas (respawns, scale-ups) are children of a
+    ROUTER process, not ours; the routers are already down, so the pid
+    sidecar the spawner maintains is the handover. SIGTERM first so they
+    spool their flight rings (XOT_FLIGHT_DUMP_DIR is in the slot env),
+    SIGKILL whatever ignores it. Idempotent: dead pids answer ESRCH."""
+    ours = {proc.pid for proc in self.procs.values()}
+    pids = [pid for pid in self._fleet_pids().values() if pid not in ours]
+    for pid in pids:
+      try:
+        os.kill(pid, signal.SIGTERM)
+      except OSError:
+        pass
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+      if not any(_pid_alive(pid) for pid in pids):
+        return
+      time.sleep(0.2)
+    for pid in pids:
+      try:
+        os.kill(pid, signal.SIGKILL)
+      except OSError:
+        pass
 
   def collect_flight_dumps(self) -> Dict[str, dict]:
     """Parse the post-mortem spool: {node_id: dump} from every
@@ -451,6 +679,14 @@ class SoakRing:
     at teardown (and on any external SIGTERM); a SIGKILLed node can write
     nothing — its last-good scrape stays its only record."""
     return collect_flight_dumps(self.dump_dir)
+
+
+def _pid_alive(pid: int) -> bool:
+  try:
+    os.kill(pid, 0)
+    return True
+  except OSError:
+    return False
 
 
 def collect_flight_dumps(dump_dir: Optional[Path]) -> Dict[str, dict]:
@@ -528,6 +764,13 @@ async def _fault_driver(ring: SoakRing, t_load_start: float,
         ring.kill(phase.node)
         windows.append({"kind": "kill", "node": ring.names[phase.node],
                         "t0": now - 1.0, "t1": now + phase.grace_s})
+      elif phase.kind == "kill_router":
+        # HA handover: no client impact is EXPECTED (the load router
+        # survives), so the short grace window exists only to make the
+        # phase visible in the report's fault timeline.
+        ring.kill_fleet_router()
+        windows.append({"kind": "kill_router", "node": "routerA",
+                        "t0": now - 1.0, "t1": now + phase.grace_s})
       elif phase.kind == "rules":
         name = ring.names[phase.node]
         until = phase.until_s if phase.until_s is not None else phase.at_s + phase.grace_s
@@ -578,7 +821,7 @@ async def _drain(ring: SoakRing, timeout_s: float) -> bool:
   loop = asyncio.get_running_loop()
   while time.monotonic() < deadline:
     await loop.run_in_executor(None, ring.scrape_once)
-    busy = [n for n in ring.names if ring.alive(n)
+    busy = [n for n in ring.all_names if ring.alive(n)
             and float(ring.last_metrics.get(n, {}).get("xot_active_requests", 0.0)) > 0]
     if not busy:
       return True
@@ -597,6 +840,13 @@ async def run_soak(cfg: SoakConfig) -> dict:
     # Disaggregated roles only make sense behind the front door: the
     # router is what chains prefill -> offer -> decode per request.
     cfg.router = True
+  if cfg.fleet:
+    # The elastic fleet lives behind routers by construction.
+    cfg.router = True
+    if cfg.fleet_kill_router_at_s is not None:
+      cfg.faults.append(FaultPhase(
+        kind="kill_router", node=0,
+        at_s=float(cfg.fleet_kill_router_at_s), grace_s=10.0))
   if cfg.gray is not None:
     # The gray-failure drain phase: a timed ProcessPrompt delay on one
     # replica — requests there get slower (visible to ITS burn-rate rules
@@ -620,6 +870,10 @@ async def run_soak(cfg: SoakConfig) -> dict:
       # Pay every replica's cold jit directly, then prove the router path.
       for name in ring.names:
         await _chat_once(ring.ports[name], cfg.model)
+      if cfg.fleet:
+        # Warm the holder router too: its recent-body ring is what feeds
+        # a respawned replica's warm pre-announce.
+        await _chat_once(cfg.router_port + 1, cfg.model)
       api_port = cfg.router_port
     else:
       api_port = ring.ports[ring.names[0]]
@@ -636,6 +890,11 @@ async def run_soak(cfg: SoakConfig) -> dict:
     # and the routed-while-out tracker starts fresh for the same reason.
     base_router = dict(ring.last_router) if ring.last_router else None
     ring.router_track.clear()
+    # Fleet baselines at load start, same reasoning: boot-time lease churn
+    # and warmup-era actuations (none expected, but races exist) must not
+    # satisfy the measured window's respawn/scale-up/holder expectations.
+    base_fleet = {rid: st for rid, st in ring.fleet_status.items()}
+    ring.fleet_holders.clear()
 
     plan = LoadPlan(seconds=cfg.seconds, rate_rps=cfg.rate_rps, arrival=cfg.arrival,
                     stream_fraction=cfg.stream_fraction, session_reuse=cfg.session_reuse,
@@ -695,7 +954,7 @@ async def run_soak(cfg: SoakConfig) -> dict:
     report = _build_report(cfg, ring, records, windows, base_cluster, base_metrics,
                            settle_a, settle_b, drained, t_wall_start, dumps=dumps,
                            t_wall_load_start=t_wall_load_start,
-                           base_router=base_router)
+                           base_router=base_router, base_fleet=base_fleet)
     verdicts.evaluate(report)
     if cfg.out:
       verdicts.write_report(report, cfg.out)
@@ -709,7 +968,8 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
                   drained: bool, t_wall_start: float,
                   dumps: Optional[Dict[str, dict]] = None,
                   t_wall_load_start: Optional[float] = None,
-                  base_router: Optional[dict] = None) -> dict:
+                  base_router: Optional[dict] = None,
+                  base_fleet: Optional[Dict[str, dict]] = None) -> dict:
   ok_recs = [r for r in records if r.ok]
   rejected_recs = [r for r in records if getattr(r, "rejected", False)]
   # 429s are deliberate admission sheds, not failures: they never reached
@@ -755,7 +1015,7 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
   nodes_final = (ring.last_cluster or {}).get("nodes", {})
   # Node ids == spawn names; names[0] runs the API. Router runs have one
   # origin PER replica (each head node's first touch ≈ HTTP arrival there).
-  origin = set(ring.names) if cfg.router else ring.names[0]
+  origin = set(ring.all_names) if cfg.router else ring.names[0]
   server = {}
   for family, _client_key, mode in verdicts.RECONCILE_FAMILIES:
     # Two-sided families compare like with like: only the ORIGIN node's
@@ -823,6 +1083,9 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
       "restarts": cfg.restarts,
       "router": cfg.router, "replicas": cfg.replicas if cfg.router else None,
       "fabric": cfg.fabric, "overload": cfg.overload, "gray": cfg.gray,
+      "fleet": cfg.fleet,
+      "fleet_latent": cfg.fleet_latent if cfg.fleet else None,
+      "fleet_kill_router_at_s": cfg.fleet_kill_router_at_s,
       "faults": [{"kind": p.kind, "node": p.node, "at_s": p.at_s,
                   "grace_s": p.grace_s} for p in cfg.faults],
     },
@@ -893,6 +1156,22 @@ def _build_report(cfg: SoakConfig, ring: SoakRing, records, windows,
       # KV is just a slow router, so the verdict requires a real hit.
       "expect_hit": True,
     }
+  if cfg.fleet:
+    report["fleet"] = verdicts.summarize_fleet(
+      ring.fleet_status, base_fleet, ring.last_router, base_router,
+      holders=sorted(h for h in ring.fleet_holders if h),
+      expect={
+        # Each expectation is keyed on whether the run actually staged the
+        # fault that produces it — a custom fault schedule only has to
+        # clear the bars for what it injected.
+        "respawn": any(p.kind == "kill" for p in cfg.faults),
+        "scale_up": cfg.overload is not None,
+        "hedge_win": any(
+          p.kind == "rules" and any(str(r.get("action")) == "delay"
+                                    for r in (p.rules or []))
+          for p in cfg.faults),
+        "holder_change": any(p.kind == "kill_router" for p in cfg.faults),
+      })
   if not drained:
     leaked = report["leaks"]
     leaked["ok"] = False

@@ -23,6 +23,7 @@ caller's contract is that the fabric can only make a prefill warmer.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -40,6 +41,12 @@ from xotorch_tpu.fabric import OfferDirectory, shard_key, unpack_entry
 # EVERY cold prompt.
 _MISS_TTL_S = 15.0
 _PEER_DOWN_S = 10.0
+# One bounded probe retry before a peer is declared down: a single dropped
+# SYN (replica mid-respawn, listener backlog blip) must not cost a probe
+# round's worth of warm bytes. Same jittered-exponential shape as
+# networking.faults.with_hop_retries: base * 2**attempt * (0.5 + rand).
+_PROBE_RETRIES = 1
+_PROBE_BACKOFF_S = 0.05
 
 
 @dataclass
@@ -99,6 +106,23 @@ class FabricClient:
     at = self._peer_down.get(url)
     return at is None or time.monotonic() - at > _PEER_DOWN_S
 
+  def _probe_peer(self, peer: str, body: dict,
+                  result: "FetchResult") -> Optional[dict]:
+    """One static-peer /v1/kv/match probe with a bounded jittered retry.
+    Only when the retry ALSO fails does the peer enter backoff and the
+    failure count toward xot_kv_fabric_errors_total — a single dropped
+    connection is absorbed, a dead peer is still one counted error."""
+    for attempt in range(_PROBE_RETRIES + 1):
+      try:
+        return self._post_json(peer + "/v1/kv/match", body)
+      except Exception:
+        if attempt < _PROBE_RETRIES:
+          time.sleep(_PROBE_BACKOFF_S * (2 ** attempt) * (0.5 + random.random()))
+          continue
+        self._peer_down[peer] = time.monotonic()
+        result.errors += 1
+    return None
+
   # ----------------------------------------------------------------- fetch
 
   def fetch(self, ctx_key: Any, toks: np.ndarray, limit: int,
@@ -120,10 +144,8 @@ class FabricClient:
         for peer in self.peers:
           if not self._peer_usable(peer):
             continue
-          try:
-            resp = self._post_json(peer + "/v1/kv/match", body)
-          except Exception:
-            self._peer_down[peer] = time.monotonic()
+          resp = self._probe_peer(peer, body, result)
+          if resp is None:
             continue
           if resp.get("key") and int(resp.get("common") or 0) > better_than:
             candidates.append((int(resp["common"]), peer, resp["key"]))

@@ -489,6 +489,13 @@ class JAXShardInferenceEngine(InferenceEngine):
     self._exec_seen: set = set()
     self._jit_first_dispatches = 0
     self._jit_cached_dispatches = 0
+    # Persistent XLA compilation cache (XOT_COMPILE_CACHE_DIR): a respawned
+    # replica's first dispatches load executables from disk instead of
+    # paying the cold-jit stall — the fleet controller's warm cold-start
+    # path. Wired lazily in _jax() so import order can't matter; unset
+    # leaves the JAX default untouched.
+    self._compile_cache_dir = knobs.get_str("XOT_COMPILE_CACHE_DIR")
+    self._compile_cache_wired = False
     # Device computations currently on the executor (event-loop-thread
     # increments around _run): the stall watchdog's "actively computing,
     # not stalled" signal — a cold-jit compile shows up here for its whole
@@ -548,6 +555,24 @@ class JAXShardInferenceEngine(InferenceEngine):
 
   def _jax(self):
     import jax
+    if self._compile_cache_dir and not self._compile_cache_wired:
+      self._compile_cache_wired = True
+      try:
+        jax.config.update("jax_compilation_cache_dir", self._compile_cache_dir)
+        # Cache even fast compiles (a respawn replays dozens of small
+        # executables) and let XLA persist its own sub-caches where the
+        # installed jax supports it; each knob is best-effort because the
+        # names vary across jax versions.
+        for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                         ("jax_persistent_cache_min_entry_size_bytes", -1),
+                         ("jax_persistent_cache_enable_xla_caches", "all")):
+          try:
+            jax.config.update(opt, val)
+          except (AttributeError, ValueError):
+            pass
+      except (AttributeError, ValueError) as e:
+        if DEBUG >= 1:
+          print(f"compile cache not wired ({self._compile_cache_dir}): {e!r}")
     return jax
 
   def _dtype(self):

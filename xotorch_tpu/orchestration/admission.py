@@ -63,6 +63,15 @@ class AdmissionGate:
     self.admitted_total = 0
     self.queued_total = 0
     self.rejected_total = 0
+    # Queue-depth marks for the trailing high-water view: the fleet
+    # controller's scale-up signal polls /v1/queue on a cadence, and a
+    # burst that queued and drained BETWEEN two polls must still be
+    # visible — the instantaneous depth alone under-reports exactly the
+    # surges elasticity exists for. Time-windowed (not reset-on-read): the
+    # status-bus rollup and the router poll both read compact(), and a
+    # read-reset would let one consumer steal the other's burst.
+    self._hwm_marks: deque = deque()  # (monotonic ts, depth after append)
+    self.hwm_window_s = 30.0
 
   # -------------------------------------------------------------- admission
 
@@ -89,6 +98,7 @@ class AdmissionGate:
     fut: asyncio.Future = asyncio.get_running_loop().create_future()
     self._queue.append((fut, request_id))
     self.queued_total += 1
+    self._hwm_marks.append((time.monotonic(), len(self._queue)))
     self.node.metrics.admit_queue_depth.set(len(self._queue))
     self.node.flight.record("admission.queued", request_id,
                             position=len(self._queue), inflight=self.inflight)
@@ -186,6 +196,16 @@ class AdmissionGate:
 
   # ---------------------------------------------------------------- exports
 
+  def queued_hwm(self, now: Optional[float] = None) -> int:
+    """Deepest the queue has been over the trailing `hwm_window_s` seconds
+    (never less than the live depth). Idempotent — every reader sees the
+    same trailing burst."""
+    now = time.monotonic() if now is None else now
+    while self._hwm_marks and now - self._hwm_marks[0][0] > self.hwm_window_s:
+      self._hwm_marks.popleft()
+    peak = max((depth for _, depth in self._hwm_marks), default=0)
+    return max(peak, len(self._queue))
+
   def compact(self) -> dict:
     """The /v1/queue body's local half; also rides `metrics_summary()` over
     the status bus (only while enabled — defaults-off adds no wire bytes)
@@ -195,6 +215,7 @@ class AdmissionGate:
       "queue_limit": self.queue_limit,
       "inflight": self.inflight,
       "queued": len(self._queue),
+      "queued_hwm": self.queued_hwm(),
       "admitted_total": self.admitted_total,
       "queued_total": self.queued_total,
       "rejected_total": self.rejected_total,

@@ -53,6 +53,23 @@ EVENTS = (
   "replica.draining",
   "replica.probing",
   "replica.readmitted",
+  # request hedging (router/app.py): a duplicate fired at the least-loaded
+  # other replica after the p99-derived delay, the attempt that won the
+  # first-byte race, and the loser's server-side cancellation — the three
+  # edges a postmortem needs to prove no request was double-served.
+  "hedge.fired",
+  "hedge.won",
+  "hedge.cancelled",
+  # elastic fleet controller (fleet/controller.py, recorded in the owning
+  # router's flight recorder): spawn/respawn/retire actuations, a replica
+  # declared dead past the unreachable streak, and the TTL'd actuation
+  # lease changing hands — the controller's whole decision record.
+  "fleet.spawn",
+  "fleet.respawn",
+  "fleet.retire",
+  "fleet.dead",
+  "lease.acquired",
+  "lease.lost",
   # ring hops (peer handles send; node receives/dedups)
   "hop.send",
   "hop.recv",

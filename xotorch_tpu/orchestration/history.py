@@ -103,6 +103,18 @@ DRIFT_RULES: Tuple[DriftRule, ...] = (
   DriftRule(name="unattributed_share", metric="unattributed_share", worse="up", floor=0.05),
   DriftRule(name="ttft_p50_s", metric="ttft_p50_s", worse="up", floor=0.05),
   DriftRule(name="request_p50_s", metric="request_p50_s", worse="up", floor=0.05),
+  # The tail the router's hedge delay is derived from (fleet median of the
+  # trailing means over /v1/history compacts). Own-baseline only: a p99
+  # over a thin per-tick window is far noisier than the median, and a
+  # peer-median comparison on it would name healthy replicas on ordinary
+  # load imbalance.
+  DriftRule(name="request_p99_s", metric="request_p99_s", worse="up", floor=0.25,
+            differential=False),
+  # Admission-queue wait (the gate's live estimate): the chronic form of
+  # the fleet controller's scale-up signal. Own-baseline only — queue
+  # depth follows placement, which the router itself skews.
+  DriftRule(name="admit_wait_s", metric="admit_wait_s", worse="up", floor=1.0,
+            differential=False),
 )
 
 DRIFT_RULES_BY_METRIC: Dict[str, DriftRule] = {r.metric: r for r in DRIFT_RULES}
@@ -247,12 +259,13 @@ class MetricsHistory:
     return max(0.0, float((cur or {}).get(key) or 0.0)
                - float((prev or {}).get(key) or 0.0))
 
-  def _hist_p50(self, cur: dict, prev: Optional[dict], family: str) -> Optional[float]:
+  def _hist_quantile(self, cur: dict, prev: Optional[dict], family: str,
+                     q: float = 0.5) -> Optional[float]:
     from xotorch_tpu.orchestration.alerts import delta_hist
     d = delta_hist(cur.get(family), (prev or {}).get(family))
     if d["count"] <= 0:
       return None
-    return quantile_from_buckets(d["buckets"], 0.5)
+    return quantile_from_buckets(d["buckets"], q)
 
   def _gauges(self, summary: dict, prev: Optional[dict],
               engine: Optional[dict], prev_engine: Optional[dict]) -> Dict[str, float]:
@@ -266,9 +279,19 @@ class MetricsHistory:
                                 / requests, 6)
     for family, key in (("ttft_seconds", "ttft_p50_s"),
                         ("request_seconds", "request_p50_s")):
-      p50 = self._hist_p50(summary, prev, family)
+      p50 = self._hist_quantile(summary, prev, family)
       if p50 is not None:
         out[key] = round(float(p50), 6)
+    # The window's p99: what the router's hedge delay is derived from (the
+    # compact's trailing mean of these windows approximates the fleet tail
+    # without shipping raw buckets).
+    p99 = self._hist_quantile(summary, prev, "request_seconds", 0.99)
+    if p99 is not None:
+      out["request_p99_s"] = round(float(p99), 6)
+    gate = getattr(self.node, "admission", None)
+    if gate is not None and getattr(gate, "enabled", False):
+      # Live queue-wait estimate, not a delta: the scale-up trend signal.
+      out["admit_wait_s"] = round(float(gate.estimate_wait_s()), 6)
     rtts = []
     for p in list(getattr(self.node, "peers", []) or []):
       ewma = getattr(p, "hop_rtt", None)

@@ -104,6 +104,28 @@ def name_drift(own: Optional[dict], peer_medians: Dict[str, float],
   return worst
 
 
+def hedge_delay_s(compacts: Iterable[dict], factor: float, min_s: float) -> float:
+  """The hedge trigger delay: `factor` x the fleet's trailing p99 request
+  latency (median across the routable replicas' /v1/history compacts — a
+  single slow replica must not inflate the delay that exists to route
+  around it), floored at `min_s`. Falls back to the p50 when no replica
+  has served enough traffic for a p99, and to the bare floor on a cold
+  fleet — hedging never waits on data that does not exist."""
+  p99s, p50s = [], []
+  for c in compacts:
+    trailing = (c or {}).get("trailing")
+    if not isinstance(trailing, dict):
+      continue
+    if trailing.get("request_p99_s") is not None:
+      p99s.append(float(trailing["request_p99_s"]))
+    if trailing.get("request_p50_s") is not None:
+      p50s.append(float(trailing["request_p50_s"]))
+  m = median(p99s)
+  if m is None:
+    m = median(p50s)
+  return max(min_s, factor * m) if m is not None else max(0.0, min_s)
+
+
 def prefix_key(body: dict) -> str:
   """Stable session/prefix affinity key for an OpenAI chat body: the first
   user message's leading characters — exactly the shared session head a

@@ -5,10 +5,16 @@
 
 Each --replica is one independent ring's OpenAI API base URL (any node of
 that ring — every node serves the rolled-up /v1/alerts and /v1/queue).
-The router serves /v1/chat/completions with session/prefix-affinity
-placement, drains replicas on their own firing SLO alerts, probes them
-back to health with canary completions, and reports at /v1/router.
-Tunables are the XOT_ROUTER_* knobs (see the README knob reference).
+With --fleet-template the replica set instead comes from a fleet template
+file (see xotorch_tpu/fleet) and the router runs the elastic controller:
+crash respawn, queue-pressure scale-up, drain-based scale-down — with
+actuation gated behind the XOT_FLEET_LEASE_PATH lease so N routers can
+share one template (all route, one acts). The router serves
+/v1/chat/completions with session/prefix-affinity placement, drains
+replicas on their own firing SLO alerts, probes them back to health with
+canary completions, optionally hedges slow requests
+(XOT_ROUTER_HEDGE_PCT), and reports at /v1/router.
+Tunables are the XOT_ROUTER_* / XOT_FLEET_* knobs (README knob reference).
 """
 from __future__ import annotations
 
@@ -23,17 +29,27 @@ def main(argv=None) -> int:
     prog="python -m xotorch_tpu.router",
     description="OpenAI-compatible front door over N independent ring replicas: "
                 "affinity + load routing, admission-aware spill, alert-driven "
-                "replica drain/probe/readmit.")
-  parser.add_argument("--replica", action="append", required=True,
-                      help="replica API base URL (repeatable, one per ring)")
+                "replica drain/probe/readmit, elastic fleet control, hedging.")
+  parser.add_argument("--replica", action="append", default=None,
+                      help="replica API base URL (repeatable, one per ring); "
+                           "not needed with --fleet-template")
+  parser.add_argument("--fleet-template", default=None,
+                      help="fleet template JSON: the slot universe the elastic "
+                           "controller may spawn/retire (enables the controller)")
+  parser.add_argument("--router-id", default="router",
+                      help="this router's identity for the actuation lease and "
+                           "its flight recorder (unique per router in HA)")
   parser.add_argument("--host", default="0.0.0.0")
   parser.add_argument("--port", type=int, default=52400)
   args = parser.parse_args(argv)
+  if not args.replica and not args.fleet_template:
+    parser.error("need --replica (repeatable) or --fleet-template")
 
   from xotorch_tpu.router.app import RouterApp
 
   async def run():
-    router = RouterApp(args.replica)
+    router = RouterApp(args.replica or [], fleet_template=args.fleet_template,
+                       router_id=args.router_id)
     runner = await router.run(host=args.host, port=args.port)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -20,14 +20,15 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from aiohttp import ClientSession, ClientTimeout, web
 
 from xotorch_tpu.orchestration.flight import FlightRecorder
 from xotorch_tpu.router import (
-  ReplicaLifecycle, fleet_trailing_medians, least_loaded, name_drift,
-  prefix_key, replica_names, route,
+  ReplicaLifecycle, fleet_trailing_medians, hedge_delay_s, least_loaded,
+  name_drift, prefix_key, replica_names, route,
 )
 from xotorch_tpu.utils import knobs
 from xotorch_tpu.utils.helpers import DEBUG, spawn_detached
@@ -85,6 +86,18 @@ class _Replica:
     self.spilled_to_total = 0
     self.relayed_429_total = 0
     self.probe_inflight = False
+    # Unified liveness/observation streak: consecutive poll ticks where
+    # the replica was unreachable OR a scrape of a reachable replica
+    # failed. Observation loss and liveness loss are ONE signal — a
+    # replica the router cannot see is a replica the router cannot trust,
+    # and the fleet controller's dead-detector consumes exactly this.
+    self.down_streak = 0
+    self.scrape_failures_total = 0
+    # Fleet-controller gates: `warming` holds a freshly spawned replica
+    # out of rotation until its warm pre-announce lands; `retiring` holds
+    # a scale-down target out while its in-flight work drains.
+    self.warming = False
+    self.retiring = False
 
   def view(self) -> dict:
     """The placement view `router.route` consumes. A replica whose queue
@@ -109,13 +122,30 @@ class _Replica:
       "routed_total": self.routed_total,
       "spilled_to_total": self.spilled_to_total,
       "relayed_429_total": self.relayed_429_total,
+      "down_streak": self.down_streak,
+      "scrape_failures_total": self.scrape_failures_total,
+      "warming": self.warming,
+      "retiring": self.retiring,
     }
 
 
 class RouterApp:
-  def __init__(self, replica_urls: List[str]):
+  def __init__(self, replica_urls: List[str],
+               fleet_template: Optional[str] = None,
+               router_id: str = "router"):
+    self.router_id = router_id
+    if fleet_template:
+      # The template is the replica universe: active slots are expected
+      # to be running, latent ones exist only as spawn capacity — but
+      # every slot gets a table entry NOW, so scale-up never mutates the
+      # routing table's shape (a latent slot is simply never reachable).
+      from xotorch_tpu.fleet import load_template
+      slot_urls = {s["name"]: str(s["url"]).rstrip("/")
+                   for s in load_template(fleet_template)}
+    else:
+      slot_urls = replica_names(replica_urls)
     self.replicas: Dict[str, _Replica] = {
-      name: _Replica(name, url) for name, url in replica_names(replica_urls).items()
+      name: _Replica(name, url) for name, url in slot_urls.items()
     }
     self.poll_s = max(0.2, knobs.get_float("XOT_ROUTER_POLL_S"))
     self.spill_depth = max(0, knobs.get_int("XOT_ROUTER_SPILL_DEPTH"))
@@ -126,14 +156,36 @@ class RouterApp:
     self.drift_min_samples = max(1, knobs.get_int("XOT_DRIFT_MIN_SAMPLES"))
     self.proxy_timeout = ClientTimeout(
       total=max(5.0, knobs.get_float("XOT_ROUTER_TIMEOUT_S")))
-    self.flight = FlightRecorder(node_id="router")
+    # Request hedging: XOT_ROUTER_HEDGE_PCT=0 (the default) disables it
+    # entirely — the first forward is the plain _forward call, byte for
+    # byte. The budget caps hedges at pct% of proxied requests so a sick
+    # fleet can't hedge-storm itself into double load.
+    self.hedge_pct = max(0.0, knobs.get_float("XOT_ROUTER_HEDGE_PCT"))
+    self.hedge_factor = max(0.0, knobs.get_float("XOT_ROUTER_HEDGE_FACTOR"))
+    self.hedge_min_s = max(0.0, knobs.get_float("XOT_ROUTER_HEDGE_MIN_S"))
+    self.flight = FlightRecorder(node_id=router_id)
     self.proxied_total = 0
     self.no_replica_503_total = 0
     self.prefetch_announced_total = 0
     self.fabric_chained_total = 0
     self.fabric_chain_failures_total = 0
+    self.hedges_fired_total = 0
+    self.hedges_won_total = 0
+    self.hedge_cancelled_total = 0
+    # Invariant tripwire, zero by construction (exactly one attempt is
+    # ever relayed per request): a nonzero value means a refactor let two
+    # hedge attempts reach the client, and the soak verdict reds on it.
+    self.hedge_both_streamed_total = 0
+    # Recent prompt prefixes (the /v1/prefetch payload shape): what the
+    # fleet controller pre-announces at a freshly spawned replica so it
+    # enters rotation with its host tier already filling.
+    self.recent_bodies: deque = deque(maxlen=32)
     self._session: Optional[ClientSession] = None
     self._poll_task = None
+    self.fleet = None
+    if fleet_template:
+      from xotorch_tpu.fleet.controller import FleetController
+      self.fleet = FleetController(self, fleet_template, router_id)
 
     self.app = web.Application(client_max_size=100 * 1024 * 1024)
     r = self.app.router
@@ -164,13 +216,19 @@ class RouterApp:
     if self._session is not None:
       await self._session.close()
       self._session = None
+    if self.fleet is not None:
+      # Hand actuation to a surviving router NOW instead of after a TTL.
+      self.fleet.lease.release()
 
   def routable(self) -> List[_Replica]:
     # Prefill-role replicas are deliberately excluded: they answer chat
     # completions with KV handles, not token streams, so client traffic
-    # must never land on one directly.
+    # must never land on one directly. Warming (freshly spawned, warm
+    # pre-announce still landing) and retiring (scale-down draining)
+    # replicas are out of rotation by controller decree.
     return [r for r in self.replicas.values()
-            if r.lifecycle.routable and r.reachable and r.role != "prefill"]
+            if r.lifecycle.routable and r.reachable and r.role != "prefill"
+            and not r.warming and not r.retiring]
 
   def prefill_replicas(self) -> List[_Replica]:
     return [r for r in self.replicas.values()
@@ -179,7 +237,18 @@ class RouterApp:
   # ------------------------------------------------------------ poll + probe
 
   async def _poll_one(self, rep: _Replica) -> None:
+    """One replica's poll tick, plus the unified liveness/observation
+    streak: a tick is CLEAN only when the healthcheck answered and every
+    scrape of the reachable replica succeeded. Consecutive unclean ticks
+    feed `down_streak` — the same signal for a dead process and for one
+    that is alive but unobservable, which the fleet controller's
+    dead-detector treats identically."""
+    clean = await self._poll_endpoints(rep)
+    rep.down_streak = 0 if clean else rep.down_streak + 1
+
+  async def _poll_endpoints(self, rep: _Replica) -> bool:
     assert self._session is not None
+    clean = True
     try:
       async with self._session.get(f"{rep.url}/healthcheck",
                                    timeout=_POLL_TIMEOUT) as resp:
@@ -187,7 +256,7 @@ class RouterApp:
     except Exception:
       rep.reachable = False
     if not rep.reachable:
-      return
+      return False
     try:
       async with self._session.get(f"{rep.url}/v1/queue",
                                    timeout=_POLL_TIMEOUT) as resp:
@@ -200,6 +269,8 @@ class RouterApp:
       # observed load view — zeroing it would make the replica whose queue
       # endpoint just timed out look like the LEAST loaded one and attract
       # the spill traffic it can least afford.
+      clean = False
+      rep.scrape_failures_total += 1
       if DEBUG >= 2:
         print(f"router: /v1/queue poll of {rep.name} failed: {e!r}")
     try:
@@ -219,10 +290,12 @@ class RouterApp:
       # health check stays green keeps its LAST observed firing/suspect —
       # zeroing it here would promote a still-burning replica out of
       # draining (or never drain it) exactly when it is least trustworthy.
+      clean = False
+      rep.scrape_failures_total += 1
       if DEBUG >= 2:
         print(f"router: /v1/alerts poll of {rep.name} failed: {e!r}")
     if not self.drift_enabled:
-      return
+      return clean
     try:
       async with self._session.get(f"{rep.url}/v1/history?compact=1",
                                    timeout=_POLL_TIMEOUT) as resp:
@@ -234,8 +307,11 @@ class RouterApp:
       rep.history_at = time.monotonic()
     except Exception as e:
       # Fail CLOSED like the polls above: keep the last trailing view.
+      clean = False
+      rep.scrape_failures_total += 1
       if DEBUG >= 2:
         print(f"router: /v1/history poll of {rep.name} failed: {e!r}")
+    return clean
 
   async def _probe_one(self, rep: _Replica) -> None:
     """One synthetic canary completion against a probing replica. The model
@@ -370,6 +446,10 @@ class RouterApp:
                     f" ({ev.get('reason') or ''})")
           if rep.lifecycle.state == "probing" and rep.reachable and not rep.probe_inflight:
             spawn_detached(self._probe_one(rep))
+        if self.fleet is not None:
+          # After lifecycle: the controller consumes the streaks and
+          # lifecycle states this tick just settled. tick() never raises.
+          self.fleet.tick(now)
       except Exception as e:
         if DEBUG >= 1:
           print(f"router poll error: {e!r}")
@@ -382,6 +462,7 @@ class RouterApp:
 
   async def handle_router_status(self, request):
     return web.json_response({
+      "router_id": self.router_id,
       "replicas": {name: rep.snapshot() for name, rep in self.replicas.items()},
       "routable": [r.name for r in self.routable()],
       "proxied_total": self.proxied_total,
@@ -389,11 +470,18 @@ class RouterApp:
       "prefetch_announced_total": self.prefetch_announced_total,
       "fabric_chained_total": self.fabric_chained_total,
       "fabric_chain_failures_total": self.fabric_chain_failures_total,
+      "hedges_fired_total": self.hedges_fired_total,
+      "hedges_won_total": self.hedges_won_total,
+      "hedge_cancelled_total": self.hedge_cancelled_total,
+      "hedge_both_streamed_total": self.hedge_both_streamed_total,
+      "scrape_failures_total": sum(r.scrape_failures_total
+                                   for r in self.replicas.values()),
       "prefill_replicas": [r.name for r in self.prefill_replicas()],
       "drains_total": sum(r.lifecycle.drains_total for r in self.replicas.values()),
       "readmits_total": sum(r.lifecycle.readmits_total for r in self.replicas.values()),
       "drift_named_total": sum(r.drift_named_total for r in self.replicas.values()),
       "poll_s": self.poll_s, "spill_depth": self.spill_depth,
+      "fleet": self.fleet.status() if self.fleet is not None else None,
     })
 
   async def handle_flight(self, request):
@@ -450,6 +538,35 @@ class RouterApp:
           print(f"router prefetch announce to {rep.name} failed: {e!r}")
 
     spawn_detached(announce())
+
+  def spawn_warm_announce(self, rep: _Replica, n: int) -> None:
+    """The fleet controller's warm cold-start leg: post the last `n`
+    recent prompt prefixes to a freshly booted replica's /v1/prefetch
+    (each one chains into the host-tier restore and, where a sibling
+    holds the KV, the PR 18 fabric fetch) and only then clear `warming`
+    so the replica enters rotation with work already warming it. Every
+    failure is absorbed — the announce can only make the replica warmer,
+    never keep it out of rotation."""
+    bodies = list(self.recent_bodies)[-n:] if n > 0 else []
+
+    async def warm():
+      try:
+        for payload in bodies:
+          try:
+            async with self._session.post(f"{rep.url}/v1/prefetch", json=payload,
+                                          timeout=_POLL_TIMEOUT) as resp:
+              if resp.status == 202:
+                self.prefetch_announced_total += 1
+          except Exception as e:
+            if DEBUG >= 2:
+              print(f"router: warm announce to {rep.name} failed: {e!r}")
+      finally:
+        rep.warming = False
+
+    if self._session is None:
+      rep.warming = False
+      return
+    spawn_detached(warm())
 
   async def _chain_prefill(self, rep: _Replica, body: dict) -> None:
     """Disaggregated serving: run the prompt on a prefill-role replica
@@ -516,12 +633,17 @@ class RouterApp:
     if spilled:
       rep.spilled_to_total += 1
     self.proxied_total += 1
+    # Remember the prompt prefix for the fleet controller's warm
+    # cold-start pre-announce (a respawned replica gets the recent
+    # working set pushed at it before entering rotation).
+    self.recent_bodies.append(
+      {k: body[k] for k in ("model", "messages", "tools") if k in body})
     # A spill target is, by construction, NOT the affinity owner of this
     # prefix — force the pre-announce so its fabric consult pulls the warm
     # KV from the sibling that is.
     self._announce_prefetch(rep, body, force=spilled)
     await self._chain_prefill(rep, body)
-    resp = await self._forward(rep, body, request)
+    resp = await self._forward_hedged(rep, body, request)
     if resp is None:
       # Replica shed it (429): one spill retry on the least-loaded OTHER
       # routable replica before the 429 reaches the client — by queue
@@ -569,6 +691,191 @@ class RouterApp:
     if body.get("stream"):
       return await self._relay_stream(rep, body, request, allow_429=final)
     return await self._relay_json(rep, body, request, allow_429=final)
+
+  # ---------------------------------------------------------------- hedging
+
+  def _hedge_delay(self) -> float:
+    """The fleet-derived hedge trigger: XOT_ROUTER_HEDGE_FACTOR x the
+    median trailing p99 across routable replicas' /v1/history compacts,
+    floored at XOT_ROUTER_HEDGE_MIN_S."""
+    return hedge_delay_s((r.history for r in self.routable()
+                          if r.history is not None),
+                         self.hedge_factor, self.hedge_min_s)
+
+  async def _forward_hedged(self, rep: _Replica, body: dict, request):
+    """The FIRST forward attempt, with tail hedging. If the primary has
+    produced no byte (streaming: no SSE chunk; non-streaming: no response)
+    after the p99-derived delay, the request is duplicated at the
+    least-loaded OTHER routable replica; the first attempt to produce a
+    byte wins and the loser is cancelled server-side by closing its
+    upstream connection (the replica's handler `finally` aborts the
+    request — the existing disconnect path). Never hedges after the first
+    streamed byte BY CONSTRUCTION: an attempt only settles once its first
+    byte arrived, and the hedge only fires while the primary is
+    unsettled. XOT_ROUTER_HEDGE_PCT=0 (default) is the plain _forward,
+    byte for byte; the pct budget caps hedges against proxied requests."""
+    if self.hedge_pct <= 0:
+      return await self._forward(rep, body, request)
+    others = [r for r in self.routable() if r is not rep]
+    budget_ok = (self.hedges_fired_total + 1
+                 <= self.hedge_pct / 100.0 * max(1, self.proxied_total))
+    if not others or not budget_ok:
+      return await self._forward(rep, body, request)
+    streaming = bool(body.get("stream"))
+    delay = self._hedge_delay()
+    primary = spawn_detached(self._open_attempt(rep, body, streaming))
+    done, _ = await asyncio.wait({primary}, timeout=delay)
+    if done:  # settled (first byte, shed, or error) before the delay
+      return await self._settle_attempts(None, [(primary, rep)], request)
+    rid = f"hedge-{self.hedges_fired_total}-{int(time.time() * 1000) % 1000000}"
+    alt = self.replicas[str(least_loaded([r.view() for r in others])["name"])]
+    self.hedges_fired_total += 1
+    alt.routed_total += 1
+    self.flight.record("hedge.fired", rid, primary=rep.name, alt=alt.name,
+                       delay_s=round(delay, 3))
+    alt_task = spawn_detached(self._open_attempt(alt, body, streaming))
+    return await self._settle_attempts(rid, [(primary, rep), (alt_task, alt)],
+                                       request)
+
+  async def _open_attempt(self, rep: _Replica, body: dict, streaming: bool) -> dict:
+    """POST one attempt and wait for its FIRST byte without touching the
+    client response: a streaming 200 settles on its first SSE chunk,
+    everything else (JSON completions, 429s, error statuses) on the full
+    body — small by construction. The returned dict is relayed or aborted
+    by the caller; on error the upstream response is released here."""
+    assert self._session is not None
+    resp = await self._session.post(f"{rep.url}/v1/chat/completions", json=body,
+                                    timeout=self.proxy_timeout)
+    try:
+      if not streaming or resp.status != 200:
+        data = await resp.read()
+        return {"rep": rep, "resp": resp, "status": resp.status, "body": data,
+                "streaming": False}
+      first = await resp.content.readany()
+      return {"rep": rep, "resp": resp, "status": resp.status, "first": first,
+              "streaming": True}
+    except BaseException:
+      resp.close()
+      raise
+
+  async def _settle_attempts(self, rid: Optional[str], attempts, request):
+    """Race the attempts to the first usable winner (opened, not a 429),
+    abort every other attempt, and relay the winner. With a single
+    attempt this reduces exactly to _forward's semantics: 429 -> None
+    (spill retry), connect failure -> _connect_failed -> None, any other
+    status relayed."""
+    tasks = [t for t, _ in attempts]
+    rep_of = {id(t): r for t, r in attempts}
+    hedged = len(tasks) > 1
+    pending = {t for t in tasks if not t.done()}
+    settled = [t for t in tasks if t.done()]
+    winner = None
+    saw_429 = False
+    last_fail = None
+    while True:
+      for t in (t for t in tasks if t in settled):
+        if t.cancelled():
+          continue
+        if t.exception() is not None:
+          last_fail = (rep_of[id(t)], t.exception())
+          continue
+        att = t.result()
+        if winner is not None:
+          self._abort_attempt(rid, att, hedged)
+        elif att["status"] == 429:
+          saw_429 = True
+          att["resp"].release()
+        else:
+          winner = att
+      settled = []
+      if winner is not None or not pending:
+        break
+      done, pending = await asyncio.wait(pending,
+                                         return_when=asyncio.FIRST_COMPLETED)
+      settled = list(done)
+    for t in pending:
+      self._cancel_task(rid, t, hedged)
+    if winner is None:
+      if saw_429:
+        return None
+      rep, exc = last_fail if last_fail else (attempts[0][1],
+                                              RuntimeError("no attempt ran"))
+      return self._connect_failed(rep, exc, final=False)
+    if hedged and winner["rep"] is not attempts[0][1]:
+      self.hedges_won_total += 1
+      self.flight.record("hedge.won", rid, winner=winner["rep"].name,
+                         primary=attempts[0][1].name)
+    return await self._relay_attempt(winner, request)
+
+  def _abort_attempt(self, rid: Optional[str], att: dict, hedged: bool) -> None:
+    """Server-side cancel of a losing attempt: closing the upstream
+    connection mid-stream (or before the body is drained) trips the
+    replica handler's disconnect path, which aborts the request and frees
+    its device state — the same abort path a vanished client takes."""
+    try:
+      att["resp"].close()
+    except Exception:
+      pass
+    if hedged:
+      self.hedge_cancelled_total += 1
+      self.flight.record("hedge.cancelled", rid, loser=att["rep"].name)
+      self.flight.freeze(rid, reason="hedge.cancelled")
+
+  def _cancel_task(self, rid: Optional[str], task, hedged: bool) -> None:
+    """Cancel a still-unsettled attempt. The task owns its upstream
+    response until it returns, so cancellation closes the socket either
+    via the open_attempt error path or the done-callback below (for the
+    race where it settled between our check and the cancel)."""
+    if task.done():
+      if not task.cancelled() and task.exception() is None:
+        self._abort_attempt(rid, task.result(), hedged)
+      return
+    task.cancel()
+
+    def _reap(t):
+      try:
+        if not t.cancelled() and t.exception() is None:
+          t.result()["resp"].close()
+      except Exception:
+        pass
+
+    task.add_done_callback(_reap)
+    if hedged:
+      self.hedge_cancelled_total += 1
+      self.flight.record("hedge.cancelled", rid, loser="(unsettled)")
+      self.flight.freeze(rid, reason="hedge.cancelled")
+
+  async def _relay_attempt(self, att: dict, request):
+    """Relay the winning attempt to the client. Exactly one attempt per
+    request reaches this point; the guard counts (never silently drops)
+    any violation — hedge_both_streamed_total is zero-toleranced by the
+    fleet soak."""
+    if att.get("relayed"):
+      self.hedge_both_streamed_total += 1
+      return None
+    att["relayed"] = True
+    resp = att["resp"]
+    if not att["streaming"]:
+      resp.release()
+      return web.Response(body=att["body"], status=att["status"],
+                          content_type=resp.content_type,
+                          headers=_passthrough_headers(resp.headers))
+    try:
+      response = web.StreamResponse(status=200, headers={
+        "Content-Type": resp.headers.get("Content-Type", "text/event-stream"),
+        "Cache-Control": "no-cache",
+        "Access-Control-Allow-Origin": "*",
+        **_passthrough_headers(resp.headers),
+      })
+      await response.prepare(request)
+      if att.get("first"):
+        await response.write(att["first"])
+      async for chunk in resp.content.iter_any():
+        await response.write(chunk)
+      await response.write_eof()
+      return response
+    finally:
+      resp.release()
 
   def _connect_failed(self, rep: _Replica, e: Exception, final: bool):
     """A request that never reached the replica (connect refused/reset

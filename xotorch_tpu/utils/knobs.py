@@ -51,6 +51,7 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_MAX_RESIDENT_REQUESTS", "int", "8", "Max request states resident per shard context before LRU eviction.", "Engine"),
   Knob("XOT_MAX_RESIDENT_MODELS", "int", "2", "Max model shard contexts resident before LRU eviction of whole models.", "Engine"),
   Knob("XOT_PREFILL_CHUNK", "int", "4096", "Prefill chunk length (tokens): prompts longer than this prefill in chunks.", "Engine"),
+  Knob("XOT_COMPILE_CACHE_DIR", "path", None, "Persistent JAX compilation cache directory: a respawned replica's first request loads executables from disk instead of paying the cold-jit stall; unset leaves the JAX default.", "Engine"),
   Knob("XOT_SCAN_PREFILL", "bool", "1", "Use the lax.scan prefill over equal chunks (one compile for any chunk count).", "Engine"),
   Knob("XOT_DECODE_BATCH", "int", "8", "Max concurrent requests fused into one batched decode dispatch.", "Engine"),
   Knob("XOT_BATCH_WINDOW_MS", "float", "0", "Batching window (ms) the decode batcher waits to coalesce submitters; 0 = one event-loop tick.", "Engine"),
@@ -125,6 +126,21 @@ _DEFS: Tuple[Knob, ...] = (
   Knob("XOT_ROUTER_TIMEOUT_S", "float", "300", "Router: total proxy timeout (s) for one forwarded request.", "Front door"),
   Knob("XOT_ROUTER_DRIFT", "bool", "1", "Router: compare each replica's /v1/history trailing gauges against the fleet median and treat a chronic drifter as a drain-eligible perf_drift suspect.", "Front door"),
   Knob("XOT_ROUTER_DRIFT_POLLS", "int", "3", "Router: consecutive poll ticks a replica must deviate from the fleet median before it is named perf_drift.", "Front door"),
+  Knob("XOT_ROUTER_HEDGE_PCT", "float", "0", "Router: request-hedging budget as a percentage of proxied requests (a still-unstarted request is duplicated to the least-loaded other replica, first byte wins); 0 disables hedging.", "Front door"),
+  Knob("XOT_ROUTER_HEDGE_FACTOR", "float", "2", "Router: hedge delay as a multiple of the fleet's trailing request p99 (median of routable replicas' /v1/history compacts).", "Front door"),
+  Knob("XOT_ROUTER_HEDGE_MIN_S", "float", "0.5", "Router: hedge-delay floor (s); also the delay used while the fleet has no trailing p99 history yet.", "Front door"),
+  # ------------------------------------------------------------ elastic fleet
+  Knob("XOT_FLEET_MIN", "int", "1", "Fleet controller: minimum replica slots kept spawned (the template's initially-active set).", "Fleet"),
+  Knob("XOT_FLEET_MAX", "int", "0", "Fleet controller: maximum concurrently active replica slots; 0 means every slot in the template.", "Fleet"),
+  Knob("XOT_FLEET_UP_QUEUE", "int", "1", "Fleet controller: scale up when the fleet-wide admission-queue high-water mark is at least this deep for XOT_FLEET_UP_POLLS consecutive ticks.", "Fleet"),
+  Knob("XOT_FLEET_UP_POLLS", "int", "3", "Fleet controller: consecutive controller ticks the queue-depth signal must hold before a scale-up actuates.", "Fleet"),
+  Knob("XOT_FLEET_IDLE_POLLS", "int", "60", "Fleet controller: consecutive idle ticks (no queue, no inflight fleet-wide) before a controller-scaled spare replica is retired via the drain path.", "Fleet"),
+  Knob("XOT_FLEET_DEAD_POLLS", "int", "3", "Fleet controller: consecutive unreachable-or-scrape-failed polls before an ever-reachable replica is declared dead and respawned.", "Fleet"),
+  Knob("XOT_FLEET_COOLDOWN_S", "float", "20", "Fleet controller: minimum seconds between scaling actuations (respawns of dead replicas are exempt).", "Fleet"),
+  Knob("XOT_FLEET_BOOT_TIMEOUT_S", "float", "180", "Fleet controller: seconds a freshly spawned replica gets to answer its healthcheck before the spawn counts as a respawn failure.", "Fleet"),
+  Knob("XOT_FLEET_LEASE_TTL_S", "float", "15", "Fleet controller: TTL (s) of the actuation lease; a dead lease holder's lease expires and actuation hands over to a surviving router.", "Fleet"),
+  Knob("XOT_FLEET_LEASE_PATH", "path", None, "Fleet controller: path of the shared TTL'd lease file gating actuation to one router; unset runs the controller solo (always holds).", "Fleet"),
+  Knob("XOT_FLEET_WARM_PREFIXES", "int", "4", "Fleet controller: recent request prefixes pre-announced (/v1/prefetch) at a fresh spawn before it enters rotation (PRESERVE-style warm cold-start).", "Fleet"),
   # ------------------------------------------------------------ KV fabric
   Knob("XOT_FABRIC_PEERS", "str", "", "Fleet-wide KV fabric: comma-separated sibling replica base URLs to probe on a host-tier prefix miss; empty disables static peer probing (router offers still work).", "KV fabric"),
   Knob("XOT_FABRIC_ROLE", "str", "mixed", "Disaggregated serving role: `prefill` (compute KV, offer it, return a handle instead of streaming), `decode` (import offered KV, serve decode), or `mixed` (default: serve everything).", "KV fabric"),
