@@ -616,6 +616,8 @@ def test_real_tree_every_checker_ran():
     "async-safety", "knob-registry", "doc-drift",
     "metrics-consistency", "exception-hygiene",
     "hotpath-sync", "retrace-hazard", "donation-safety", "lock-discipline",
+    "endpoint-contract", "wire-schema", "bus-vocabulary",
+    "http-client-hygiene",
   }
 
 
@@ -664,6 +666,45 @@ def test_synthetic_violation_per_checker(tmp_path):
       "  def f(self):\n"
       "    with self._lock:\n"
       "      self.observer(1)\n")},
+    "endpoint-contract": {"xotorch_tpu/orchestration/bad_endpoint.py": (
+      "async def poll(session, base):\n"
+      "  try:\n"
+      "    async with session.get(f'{base}/v1/not/registered', timeout=5.0) as r:\n"
+      "      return await r.json()\n"
+      "  except Exception:\n"
+      "    return None\n")},
+    "wire-schema": {"xotorch_tpu/orchestration/bad_wire.py": (
+      "import json\n"
+      "import urllib.request\n"
+      "def read(url):\n"
+      "  try:\n"
+      "    with urllib.request.urlopen(url, timeout=2.0) as r:\n"
+      "      d = json.loads(r.read())\n"
+      "    return d.get('definitely_not_a_produced_key')\n"
+      "  except Exception:\n"
+      "    return None\n")},
+    "bus-vocabulary": {"xotorch_tpu/orchestration/bad_bus.py": (
+      "import json\n"
+      "class Node:\n"
+      "  def __init__(self, server):\n"
+      "    self.server = server\n"
+      "    self.on_opaque_status.register('node_status').on_next(self.on_node_status)\n"
+      "  async def announce(self):\n"
+      "    await self.server.broadcast_opaque_status('', json.dumps({'type': 'ghost_status'}))\n"
+      "  def on_node_status(self, rid, status):\n"
+      "    t = status.get('type', '')\n"
+      "    if t == 'ghost_status':\n"
+      "      return 1\n"
+      "    if t == 'phantom_thing':\n"
+      "      return 2\n")},
+    "http-client-hygiene": {"xotorch_tpu/orchestration/bad_http.py": (
+      "import urllib.request\n"
+      "def f(url):\n"
+      "  try:\n"
+      "    with urllib.request.urlopen(url) as r:\n"
+      "      return r.read()\n"
+      "  except Exception:\n"
+      "    return None\n")},
   }
   for checker, files in violations.items():
     root = tmp_path / checker.replace("-", "_")
@@ -1113,6 +1154,393 @@ def test_suppression_audit_skipped_on_partial_runs(tmp_path):
           if f.checker == "suppression-audit"] != []
 
 
+# ------------------------------------------------------------ wire contracts
+
+FIXTURE_WIRE_SERVER = '''
+from aiohttp import web
+
+class WireAPI:
+  def __init__(self, node):
+    self.node = node
+
+  async def handle_queue(self, request):
+    return web.json_response({"inflight": 1, "queued": 2, "est_wait_s": 0.5})
+
+  async def handle_kv(self, request):
+    return web.json_response({"payload": "x"})
+
+  def attach(self, app):
+    app.router.add_get("/v1/queue", self.handle_queue)
+    app.router.add_get("/v1/kv/{key}", self.handle_kv)
+    app.router.add_post("/v1/dead", self.handle_queue)
+'''
+
+FIXTURE_WIRE_CLIENT = '''
+import json
+import urllib.request
+
+async def poll(session, base):
+  try:
+    async with session.get(f"{base}/v1/queue", timeout=5.0) as resp:
+      q = await resp.json()
+    return q.get("queued")
+  except Exception:
+    return None
+
+def fetch_kv(base_url, key):
+  try:
+    with urllib.request.urlopen(f"{base_url}/v1/kv/{key}?payload=1", timeout=2.0) as r:
+      return json.loads(r.read()).get("payload")
+  except Exception:
+    return None
+'''
+
+
+def test_endpoint_contract_unknown_and_dead_routes(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/router/wire_client.py": FIXTURE_WIRE_CLIENT + (
+      "async def typo(session, base):\n"
+      "  try:\n"
+      "    async with session.get(f'{base}/v1/quue', timeout=5.0) as r:\n"
+      "      return await r.json()\n"
+      "  except Exception:\n"
+      "    return None\n"
+      "async def wrong_verb(session, base):\n"
+      "  try:\n"
+      "    async with session.post(f'{base}/v1/queue', timeout=5.0) as r:\n"
+      "      return await r.json()\n"
+      "  except Exception:\n"
+      "    return None\n"),
+  })
+  found = {(f.code, f.key) for f in findings_by(repo, "endpoint-contract")}
+  assert ("unknown-route", "GET /v1/quue") in found
+  assert ("unknown-route", "POST /v1/queue") in found       # verb mismatch
+  assert ("dead-route", "POST /v1/dead") in found           # zero consumers
+  # Consumed routes and {param} templates do NOT fire: /v1/queue is polled,
+  # /v1/kv/{key} is fetched with a different placeholder name.
+  keys = {k for _, k in found}
+  assert not any("/v1/kv" in k for k in keys)
+  assert ("unknown-route", "GET /v1/queue") not in found
+
+
+def test_endpoint_contract_ignores_external_urls(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/download/ext.py": (
+      "async def dl(session):\n"
+      "  try:\n"
+      "    async with session.get('https://huggingface.co/repo/resolve/main/f',\n"
+      "                           timeout=5.0) as r:\n"
+      "      return await r.read()\n"
+      "  except Exception:\n"
+      "    return None\n"),
+  })
+  assert [f for f in findings_by(repo, "endpoint-contract")
+          if f.code == "unknown-route"] == []
+
+
+def test_endpoint_allowlist_matches_real_tree_exactly():
+  """No dead allowlisting, same standard as hotpath-sync's SANCTIONED:
+  clearing ALLOWLIST makes the checker fire on the real tree EXACTLY the
+  identities the list names — every entry is load-bearing, and no
+  unlisted route is dead."""
+  from tools.xotlint import endpoint_contract
+  repo = Repo(str(ROOT))
+  orig = dict(endpoint_contract.ALLOWLIST)
+  try:
+    endpoint_contract.ALLOWLIST.clear()
+    found = [f for f in endpoint_contract.check(repo) if f.code == "dead-route"]
+  finally:
+    endpoint_contract.ALLOWLIST.update(orig)
+  fired = {tuple(f.key.split(" ", 1)) for f in found}
+  assert fired == set(endpoint_contract.ALLOWLIST), (
+    fired ^ set(endpoint_contract.ALLOWLIST))
+
+
+def test_endpoint_docs_generated_and_drift(tmp_path):
+  from tools.xotlint import endpoint_contract as ec
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/router/wire_client.py": FIXTURE_WIRE_CLIENT,
+  })
+  readme = tmp_path / "README.md"
+  # A tree WITH routes but no API section in the README:
+  assert any(f.code == "missing-api-section"
+             for f in findings_by(repo, "endpoint-contract"))
+  # Regenerating the section makes it clean...
+  section = ec.generated_section(repo)
+  assert "| `GET` | `/v1/queue` |" in section and "handle_queue" in section
+  readme.write_text(readme.read_text() + "\n" + section + "\n")
+  doc_codes = {"missing-api-section", "undocumented-route", "stale-api-doc",
+               "phantom-route-doc"}
+  clean = [f for f in findings_by(Repo(str(tmp_path)), "endpoint-contract")
+           if f.code in doc_codes]
+  assert clean == [], [f.render() for f in clean]
+  # ...and each drift direction fires its own per-route code.
+  lines = readme.read_text().splitlines()
+  mutated = []
+  for line in lines:
+    if "| `POST` | `/v1/dead` |" in line:
+      continue  # drop a documented row -> undocumented-route
+    if "| `/v1/queue` |" in line:
+      line = line.replace("handle_queue", "handle_renamed")  # -> stale-api-doc
+    if line.strip() == ec.END_MARK:  # phantom row INSIDE the marked section
+      mutated.append("| `GET` | `/v1/ghost` | `xotorch_tpu/api/wire_server.py` | `gone` |")
+    mutated.append(line)
+  readme.write_text("\n".join(mutated) + "\n")
+  found = {(f.code, f.key)
+           for f in findings_by(Repo(str(tmp_path)), "endpoint-contract")}
+  assert ("undocumented-route", "POST /v1/dead") in found
+  assert ("stale-api-doc", "GET /v1/queue") in found
+  assert ("phantom-route-doc", "GET /v1/ghost") in found
+
+
+def test_wire_schema_unproduced_key_and_suppression(tmp_path):
+  bad = (
+    "import json\n"
+    "import urllib.request\n"
+    "def read(url):\n"
+    "  try:\n"
+    "    with urllib.request.urlopen(url, timeout=2.0) as r:\n"
+    "      d = json.loads(r.read())\n"
+    "    return d.get('activ_requests')\n"
+    "  except Exception:\n"
+    "    return None\n")
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/router/wire_client.py": FIXTURE_WIRE_CLIENT,
+    "xotorch_tpu/fleet/bad_reader.py": bad,
+  })
+  found = findings_by(repo, "wire-schema")
+  assert [(f.code, f.key) for f in found] == \
+      [("unproduced-key", "read:activ_requests")]
+  # The same read with the key produced somewhere is clean; a suppression
+  # with a reason silences the finding.
+  repo2 = make_tree(tmp_path / "b", {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/fleet/bad_reader.py": bad.replace(
+      "return d.get('activ_requests')",
+      "return d.get('activ_requests')  "
+      "# xotlint: disable=wire-schema (peer ships it in v2)"),
+  })
+  assert findings_by(repo2, "wire-schema") == []
+
+
+def test_wire_schema_taint_through_wrapper_and_attr(tmp_path):
+  """Taint follows a local fetch wrapper's return value AND an attribute
+  store across files (the router -> fleet-controller seam)."""
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/router/probe.py": (
+      "import json\n"
+      "import urllib.request\n"
+      "def get_json(url):\n"
+      "  try:\n"
+      "    with urllib.request.urlopen(url, timeout=2.0) as r:\n"
+      "      return json.loads(r.read())\n"
+      "  except Exception:\n"
+      "    return None\n"
+      "class Router:\n"
+      "  def probe(self, rep):\n"
+      "    q = get_json(rep.url + '/v1/queue') or {}\n"
+      "    rep.queue_snapshot = q.get('inflight')\n"),
+    "xotorch_tpu/fleet/reader.py": (
+      "def plan(rep):\n"
+      "  return rep.queue_snapshot.get('no_such_wire_key')\n"),
+  })
+  found = findings_by(repo, "wire-schema")
+  assert [(f.code, f.key) for f in found] == \
+      [("unproduced-key", "plan:no_such_wire_key")]
+
+
+def test_wire_schema_untainted_dict_reads_are_ignored(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/localcfg.py": (
+      "def pick(cfg):\n"
+      "  return cfg.get('no_such_key_but_local')\n"),
+  })
+  assert findings_by(repo, "wire-schema") == []
+
+
+def test_bus_vocabulary_unheard_and_phantom(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/busnode.py": (
+      "import json\n"
+      "class Node:\n"
+      "  def __init__(self, server):\n"
+      "    self.server = server\n"
+      "    self.on_opaque_status.register('node_status').on_next(self.on_node_status)\n"
+      "  async def announce(self):\n"
+      "    await self.server.broadcast_opaque_status('', json.dumps(\n"
+      "      {'type': 'node_metrics', 'v': 1}))\n"
+      "    await self.server.broadcast_opaque_status('', json.dumps(\n"
+      "      {'type': 'ghost_status'}))\n"
+      "  def on_node_status(self, rid, status):\n"
+      "    t = status.get('type', '')\n"
+      "    if t == 'node_metrics':\n"
+      "      return 1\n"
+      "    if t == 'phantom_thing':\n"
+      "      return 2\n"),
+  })
+  found = {(f.code, f.key) for f in findings_by(repo, "bus-vocabulary")}
+  assert found == {("unheard-type", "ghost_status"),
+                   ("phantom-arm", "phantom_thing")}
+
+
+def test_bus_vocabulary_ignores_unregistered_dispatch(tmp_path):
+  """A `.get("type")` dispatch table NOT wired to the bus (UDP discovery)
+  contributes no arms, and a tree without a bus has no findings."""
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/orchestration/discovery.py": (
+      "def on_packet(msg):\n"
+      "  t = msg.get('type', '')\n"
+      "  if t == 'discovery':\n"
+      "    return 1\n"),
+  })
+  assert findings_by(repo, "bus-vocabulary") == []
+
+
+def test_http_client_hygiene_timeout_and_containment(tmp_path):
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/router/clients.py": (
+      "import urllib.request\n"
+      "def no_timeout(url):\n"
+      "  try:\n"
+      "    with urllib.request.urlopen(url) as r:\n"
+      "      return r.read()\n"
+      "  except Exception:\n"
+      "    return None\n"
+      "def no_try(url):\n"
+      "  with urllib.request.urlopen(url, timeout=2.0) as r:\n"
+      "    return r.read()\n"),
+  })
+  found = {(f.code, f.key) for f in findings_by(repo, "http-client-hygiene")}
+  assert found == {("missing-timeout", "no_timeout:dynamic-url"),
+                   ("uncontained-call", "no_try:dynamic-url")}
+
+
+def test_http_client_hygiene_containment_through_callers(tmp_path):
+  """A bare transport wrapper is fine when EVERY call site is wrapped —
+  including references handed to an executor — and flagged when any one
+  is not."""
+  wrapper = (
+    "import urllib.request\n"
+    "def fetch(url):\n"
+    "  with urllib.request.urlopen(url, timeout=2.0) as r:\n"
+    "    return r.read()\n")
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/router/wrapped.py": wrapper + (
+      "def a(url):\n"
+      "  try:\n"
+      "    return fetch(url)\n"
+      "  except Exception:\n"
+      "    return None\n"
+      "async def b(loop, url):\n"
+      "  try:\n"
+      "    return await loop.run_in_executor(None, fetch)\n"
+      "  except Exception:\n"
+      "    return None\n"),
+  })
+  assert findings_by(repo, "http-client-hygiene") == []
+  repo2 = make_tree(tmp_path / "b", {
+    "xotorch_tpu/router/leaky.py": wrapper + (
+      "def a(url):\n"
+      "  return fetch(url)\n"),  # one naked call site -> flagged
+  })
+  found = {(f.code, f.key) for f in findings_by(repo2, "http-client-hygiene")}
+  assert found == {("uncontained-call", "fetch:dynamic-url")}
+
+
+def test_http_client_hygiene_session_level_timeout_exempts(tmp_path):
+  body = (
+    "import aiohttp\n"
+    "def mk():\n"
+    "  return aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=5))\n"
+    "async def call(session, base):\n"
+    "  try:\n"
+    "    async with session.get(f'{base}/v1/queue') as r:\n"
+    "      return await r.json()\n"
+    "  except Exception:\n"
+    "    return None\n")
+  repo = make_tree(tmp_path, {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/router/sess.py": body,
+  })
+  assert [f for f in findings_by(repo, "http-client-hygiene")
+          if f.code == "missing-timeout"] == []
+  # Without the session-level timeout the same per-call-less get fires.
+  repo2 = make_tree(tmp_path / "b", {
+    "xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER,
+    "xotorch_tpu/router/sess.py": body.replace(
+      "aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=5))",
+      "aiohttp.ClientSession()"),
+  })
+  found = {(f.code, f.key)
+           for f in findings_by(repo2, "http-client-hygiene")}
+  assert ("missing-timeout", "call:/v1/queue") in found
+
+
+def test_suppression_audit_covers_wire_checkers_and_tool_files(tmp_path):
+  """A stale wire-schema suppression is flagged even in the CLI tool trees
+  (tools/anatomy etc.), which only the wire model loads — the audit runs
+  over every LOADED file, not just the package walk."""
+  repo = make_tree(tmp_path, {
+    "tools/anatomy/probe.py": (
+      "def quiet(d):\n"
+      "  return d.get('k')  # xotlint: disable=wire-schema (stale claim)\n"),
+  })
+  found = [f for f in run_checkers(repo) if f.checker == "suppression-audit"]
+  assert [(f.code, f.path) for f in found] == \
+      [("stale-suppression", "tools/anatomy/probe.py")]
+
+
+def test_cli_endpoint_docs_and_wire_info(tmp_path, capsys):
+  make_tree(tmp_path, {"xotorch_tpu/api/wire_server.py": FIXTURE_WIRE_SERVER})
+  assert xotlint_main.main(["--root", str(tmp_path), "--endpoint-docs"]) == 0
+  out = capsys.readouterr().out
+  assert out.startswith("<!-- BEGIN XOT HTTP API")
+  assert "| `GET` | `/v1/queue` |" in out
+  assert xotlint_main.main(["--root", str(tmp_path), "--wire-info"]) == 0
+  capsys.readouterr()
+
+
+async def test_dynamic_wire_keys_subset_of_static_closure():
+  """THE dynamic-static cross-check for the wire extractor: scrape
+  /v1/queue and /v1/alerts from a LIVE in-process app (aiohttp test
+  utils over a real node + dummy engine) and assert every key observed
+  on the real wire — top level plus the nested admission block — is in
+  the statically extracted produced-key closure of those routes'
+  registered handlers. An extractor that silently stopped seeing the
+  handlers' dict literals fails here, not in production."""
+  from tests.test_api import _api_client
+  from tools.xotlint.wire import wire_model
+  client, node, _ = await _api_client()
+  try:
+    resp = await client.get("/v1/queue")
+    assert resp.status == 200
+    q = await resp.json()
+    resp = await client.get("/v1/alerts")
+    assert resp.status == 200
+    a = await resp.json()
+  finally:
+    await client.close()
+  observed = set(q) | set(a)
+  if isinstance(q.get("admission"), dict):
+    observed |= set(q["admission"])
+  assert len(observed) >= 15, f"scrape looks degenerate: {sorted(observed)}"
+
+  wm = wire_model(Repo(str(ROOT)))
+  closure = set()
+  for route in wm.routes:
+    if route.path in ("/v1/queue", "/v1/alerts") and route.handler_qual:
+      closure |= wm.produced_closure(route.handler_qual)
+  assert closure, "no /v1/queue //v1/alerts handler closures resolved"
+  missing = sorted(k for k in observed if k not in closure)
+  assert missing == [], (
+    f"keys observed on the live wire that the static wire model cannot "
+    f"see being produced by the handlers: {missing}")
+
+
 # ------------------------------------------------------------- stats / perf
 
 def test_stats_cover_all_checkers_and_cli_writes_file(tmp_path, capsys):
@@ -1132,7 +1560,8 @@ def test_stats_cover_all_checkers_and_cli_writes_file(tmp_path, capsys):
 
 def test_real_tree_lint_completes_under_60s():
   """Tier-1 guard for the shared-AST-cache performance: the full
-  nine-checker run over the real tree stays an order of magnitude inside
+  thirteen-checker run over the real tree (callgraph + wire model each
+  built once, memoized on the Repo) stays an order of magnitude inside
   the CI budget. A regression to per-checker re-parsing/re-walking would
   blow well past this."""
   import time as _time

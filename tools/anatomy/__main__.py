@@ -50,7 +50,12 @@ def main(argv=None) -> int:
       query = {"format": "chrome"}
       if args.trace_id:
         query["trace_id"] = args.trace_id
-      payload = _fetch(f"{base}/v1/traces?{urllib.parse.urlencode(query)}")
+      url = f"{base}/v1/traces?{urllib.parse.urlencode(query)}"
+      try:
+        payload = _fetch(url)
+      except Exception as e:
+        print(f"fetch {url} failed: {e}", file=sys.stderr)
+        return 2
       Path(args.chrome).write_text(json.dumps(payload) + "\n")
       print(f"wrote {len(payload.get('traceEvents') or [])} trace events to {args.chrome} "
             "(load in https://ui.perfetto.dev or chrome://tracing)")

@@ -242,7 +242,12 @@ def main(argv=None) -> int:
       return 2
   cfg.out = args.out or f"SOAK_{cfg.tag}.json"
 
-  report = asyncio.run(run_soak(cfg))
+  try:
+    report = asyncio.run(run_soak(cfg))
+  except Exception as e:
+    # A dead ring or failed warmup is a soak verdict, not a traceback.
+    print(f"soak: run failed: {e!r}", file=sys.stderr)
+    return 2
   if args.json:
     print(json.dumps(report, indent=1))
   client = report.get("client", {})

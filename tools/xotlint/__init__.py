@@ -1,7 +1,7 @@
 """xotlint: repo-native static analysis for the xotorch_tpu runtime.
 
-Nine checkers, each a module exposing `check(repo) -> list[Finding]`. Five
-are per-function (PR 5):
+Thirteen checkers, each a module exposing `check(repo) -> list[Finding]`.
+Five are per-function (PR 5):
 
 - async-safety        blocking calls / sync locks / raw create_task in async code
 - knob-registry       every XOT_* env read routes through utils/knobs.py
@@ -16,12 +16,23 @@ Four are whole-program, built on the shared callgraph core (callgraph.py):
 - donation-safety     donated buffers are dead after the call
 - lock-discipline     nothing slow/foreign under a lock; consistent order
 
+Four analyze the cross-process wire contracts, built on the shared wire
+model (wire.py: routes, client URLs, JSON key flows, bus vocabulary):
+
+- endpoint-contract   client URL+method matches a registered route; no
+                      dead routes outside the external-surface allowlist
+- wire-schema         a key consumed across a process boundary is produced
+                      somewhere (the silent-`.get`-default bug class)
+- bus-vocabulary      broadcast status "type"s and dispatch arms agree
+- http-client-hygiene every cross-process HTTP call has a timeout and an
+                      exception barrier before its entry point
+
 The runner itself audits suppressions (`suppression-audit` findings): an
 `# xotlint: disable=<checker>` comment whose checker no longer fires on
 that line is stale and must be deleted; one without a parenthesized reason
 is incomplete. Run as `python -m tools.xotlint`; see `--help` for baseline
-management, `--stats` for per-checker timing, `--knob-docs` for README
-generation.
+management, `--stats` for per-checker timing, `--knob-docs` /
+`--endpoint-docs` for README generation.
 """
 from __future__ import annotations
 
@@ -31,14 +42,18 @@ from typing import Dict, List, Optional, Sequence
 from tools.xotlint.core import Finding, Repo
 from tools.xotlint import (  # noqa: E402  (registry of checker modules)
   async_safety,
+  bus_vocabulary,
   doc_drift,
   donation_safety,
+  endpoint_contract,
   exception_hygiene,
   hotpath_sync,
+  http_client_hygiene,
   knob_registry,
   lock_discipline,
   metrics_consistency,
   retrace_hazard,
+  wire_schema,
 )
 
 CHECKERS = {
@@ -51,6 +66,10 @@ CHECKERS = {
   retrace_hazard.CHECKER: retrace_hazard,
   donation_safety.CHECKER: donation_safety,
   lock_discipline.CHECKER: lock_discipline,
+  endpoint_contract.CHECKER: endpoint_contract,
+  wire_schema.CHECKER: wire_schema,
+  bus_vocabulary.CHECKER: bus_vocabulary,
+  http_client_hygiene.CHECKER: http_client_hygiene,
 }
 
 AUDIT = "suppression-audit"
@@ -60,9 +79,11 @@ def _audit_suppressions(repo: Repo) -> List[Finding]:
   """Runner-level pass (not a registered checker): every inline suppression
   must still be EARNED — its named checker queried that line and would
   have fired. Requires a full run (all checkers), so run_checkers only
-  calls this when none were filtered out."""
+  calls this when none were filtered out. Audits every LOADED file — the
+  package plus the tool trees the wire model pulled in — so suppressions
+  in tools/soak etc. rot-check like package ones."""
   findings: List[Finding] = []
-  for sf in repo.files():
+  for sf in repo.loaded_files():
     hits = sf.suppression_hits
     for line, names, has_reason in sf.suppression_sites():
       for name in names:

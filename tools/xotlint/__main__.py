@@ -1,7 +1,8 @@
 """CLI: `python -m tools.xotlint` — run all checkers, compare to baseline.
 
 Exit codes: 0 = no non-baselined findings, 1 = findings, 2 = usage/config
-error. `--knob-docs` prints the generated README knob section and exits.
+error. `--knob-docs` / `--endpoint-docs` print the generated README sections and
+exit. `--wire-info` prints the non-gating wire-schema observations.
 `--stats` prints per-checker wall time + finding counts; `--stats-file`
 writes them as JSON (the CI artifact guarding the shared-AST-cache perf).
 """
@@ -14,7 +15,7 @@ import sys
 import time
 
 from tools.xotlint import CHECKERS, run_checkers
-from tools.xotlint import doc_drift
+from tools.xotlint import doc_drift, endpoint_contract, wire_schema
 from tools.xotlint.core import Repo, load_baseline, write_baseline
 
 DEFAULT_BASELINE = os.path.join("tools", "xotlint", "baseline.json")
@@ -23,10 +24,12 @@ DEFAULT_BASELINE = os.path.join("tools", "xotlint", "baseline.json")
 def main(argv=None) -> int:
   parser = argparse.ArgumentParser(
     prog="python -m tools.xotlint",
-    description="Repo-native static analysis, nine checkers: async-safety, "
+    description="Repo-native static analysis, thirteen checkers: async-safety, "
                 "knob registry, doc drift, metrics consistency, exception "
-                "hygiene, plus the callgraph-driven hotpath-sync, "
-                "retrace-hazard, donation-safety and lock-discipline.",
+                "hygiene, the callgraph-driven hotpath-sync, retrace-hazard, "
+                "donation-safety and lock-discipline, plus the wire-contract "
+                "endpoint-contract, wire-schema, bus-vocabulary and "
+                "http-client-hygiene.",
   )
   parser.add_argument("--root", default=".", help="repo root (default: cwd)")
   parser.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -37,6 +40,10 @@ def main(argv=None) -> int:
                       help="ignore the baseline (report every finding)")
   parser.add_argument("--knob-docs", action="store_true",
                       help="print the generated README knob-reference section and exit")
+  parser.add_argument("--endpoint-docs", action="store_true",
+                      help="print the generated README HTTP-API section and exit")
+  parser.add_argument("--wire-info", action="store_true",
+                      help="print non-gating wire-schema observations and exit")
   parser.add_argument("--checker", action="append", default=None,
                       help="run only this checker (repeatable)")
   parser.add_argument("--stats", action="store_true",
@@ -48,6 +55,13 @@ def main(argv=None) -> int:
   repo = Repo(args.root)
   if args.knob_docs:
     print(doc_drift.generated_section(repo))
+    return 0
+  if args.endpoint_docs:
+    print(endpoint_contract.generated_section(repo))
+    return 0
+  if args.wire_info:
+    for f in wire_schema.info(repo):
+      print(f.render())
     return 0
 
   unknown = [c for c in (args.checker or []) if c not in CHECKERS]

@@ -1,0 +1,67 @@
+"""bus-vocabulary checker: the status-bus `"type"` vocabulary is closed.
+
+The opaque-status bus is stringly-typed gossip: producers call
+`broadcast_opaque_status(rid, json.dumps({"type": ..., ...}))`, and the
+single dispatch handler registered via `.register(...).on_next(...)`
+string-compares `status.get("type")`. Nothing ties the two vocabularies
+together — a renamed type silently drops every broadcast on the floor
+(the same drift class flight's closed EVENTS list exists to stop). From
+the shared wire model (wire.py):
+
+- **unheard-type**: a broadcast `"type"` literal no dispatch arm matches —
+  every one of those messages is paid for on the wire and then ignored.
+- **phantom-arm**: a dispatch arm for a `"type"` nothing broadcasts — dead
+  dispatch code, or the producer was renamed out from under it.
+
+Discovery is registration-based: only the handler actually wired to the
+bus contributes arms, so unrelated `.get("type")` dispatch tables (UDP
+discovery) never pollute the vocabulary.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tools.xotlint.core import Finding, Repo
+from tools.xotlint.wire import BusSite, wire_model
+
+CHECKER = "bus-vocabulary"
+
+
+def check(repo: Repo) -> List[Finding]:
+  wm = wire_model(repo)
+  if not wm.bus_producers and not wm.bus_arms:
+    return []
+  produced: Dict[str, BusSite] = {}
+  for site in wm.bus_producers:
+    produced.setdefault(site.type_, site)
+  heard: Dict[str, BusSite] = {}
+  for site in wm.bus_arms:
+    heard.setdefault(site.type_, site)
+
+  findings: List[Finding] = []
+  seen: set = set()
+  for type_, site in sorted(produced.items()):
+    if type_ in heard:
+      continue
+    f = Finding(
+      CHECKER, "unheard-type", site.sf.relpath, site.line, key=type_,
+      message=f"status-bus type `{type_}` is broadcast but no dispatch arm "
+              "handles it — every such message is ignored on arrival; add "
+              "an arm or delete the producer",
+    )
+    if f.identity not in seen and not site.sf.suppressed(site.line, CHECKER):
+      seen.add(f.identity)
+      findings.append(f)
+  for type_, site in sorted(heard.items()):
+    if type_ in produced:
+      continue
+    f = Finding(
+      CHECKER, "phantom-arm", site.sf.relpath, site.line, key=type_,
+      message=f"dispatch arm for status-bus type `{type_}` but nothing "
+              "broadcasts it — dead dispatch code, or the producer was "
+              "renamed out from under it",
+    )
+    if f.identity not in seen and not site.sf.suppressed(site.line, CHECKER):
+      seen.add(f.identity)
+      findings.append(f)
+  return findings

@@ -232,6 +232,14 @@ class Repo:
       self._by_path[relpath] = sf
     return sf
 
+  def loaded_files(self) -> List[SourceFile]:
+    """Every SourceFile this run touched: the py_roots walk PLUS files
+    loaded on demand via `file()` (the wire model pulls in tools/soak
+    etc.). The suppression audit iterates this so tool-file suppressions
+    rot-check like package ones. Sorted for deterministic output."""
+    self.files()
+    return sorted(self._by_path.values(), key=lambda sf: sf.relpath)
+
   def read_text(self, relpath: str) -> Optional[str]:
     path = os.path.join(self.root, relpath)
     if not os.path.isfile(path):

@@ -1,0 +1,197 @@
+"""endpoint-contract checker: client URLs and registered routes agree.
+
+Both directions of the HTTP seam, from the shared wire model (wire.py):
+
+- **unknown-route**: a client builds a URL (transport call or loose
+  f-string/literal) whose path+method matches no registered route — a
+  typo'd path, a stale client after a route rename, or a route that was
+  never wired. Only in-scope paths are checked (`/v1/...` or an exact
+  registered path), so external URLs (HuggingFace downloads) never match.
+- **dead-route**: a registered route no in-repo client references.
+  `ALLOWLIST` is the explicit external surface — OpenAI-compatible
+  endpoints, the tinychat UI's fetches, operator/debug endpoints driven
+  by curl — kept EXACT: tests assert that clearing it makes the checker
+  fire precisely these identities on the real tree (no dead allowlisting).
+
+Also owns the generated README "HTTP API reference" section
+(`python -m tools.xotlint --endpoint-docs`, BEGIN/END markers like the
+knob table) and its drift findings: missing/stale/phantom rows fail CI
+with a per-route message instead of a wall of diff.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.xotlint.core import Finding, Repo
+from tools.xotlint.wire import path_match, wire_model
+
+CHECKER = "endpoint-contract"
+
+BEGIN_MARK = "<!-- BEGIN XOT HTTP API (generated: python -m tools.xotlint --endpoint-docs) -->"
+END_MARK = "<!-- END XOT HTTP API -->"
+
+_ROW_RE = re.compile(
+  r"^\|\s*`(GET|POST|DELETE|PUT)`\s*\|\s*`([^`]+)`\s*\|\s*`([^`]+)`\s*\|\s*`([^`]+)`\s*\|$")
+
+# (method, path) -> why this route is legitimately consumed by nothing in
+# the repo: the OpenAI-compatible API surface, the tinychat UI's fetch()
+# calls (tinychat/index.html is not Python, so the extractor cannot see
+# them), and operator endpoints driven by curl/browser. Kept exact — the
+# sanctioned-list cross-check test clears this dict and asserts the
+# checker fires precisely these identities on the real tree.
+ALLOWLIST: Dict[Tuple[str, str], str] = {
+  ("POST", "/chat/completions"): "OpenAI-compat alias (clients hit /v1/...)",
+  ("POST", "/v1/chat/token/encode"): "tinychat UI fetch (index.html)",
+  ("POST", "/chat/token/encode"): "OpenAI-compat alias of the above",
+  ("GET", "/topology"): "un-versioned alias for external dashboards",
+  ("GET", "/v1/download/progress"): "tinychat UI download progress poll",
+  ("DELETE", "/models/{model_name}"): "un-versioned alias (curl surface)",
+  ("DELETE", "/v1/models/{model_name}"): "tinychat UI model delete",
+  ("POST", "/download"): "un-versioned alias (curl surface)",
+  ("POST", "/v1/download"): "tinychat UI model download",
+  ("GET", "/initial_models"): "tinychat UI boot fetch",
+  ("GET", "/quit"): "operator curl shutdown",
+  ("POST", "/quit"): "reference parity verb for /quit",
+  ("POST", "/v1/image/generations"): "OpenAI-compat image surface",
+  ("POST", "/v1/trace/device/start"): "operator curl (device profiler)",
+  ("POST", "/v1/trace/device/stop"): "operator curl (device profiler)",
+  ("GET", "/"): "browser landing page (tinychat)",
+}
+
+
+def _doc_rows(repo: Repo) -> List[Tuple[str, str, str, str]]:
+  wm = wire_model(repo)
+  rows = set()
+  for r in wm.routes:
+    handler = r.handler[5:] if r.handler.startswith("self.") else r.handler
+    rows.add((r.path, r.method, r.sf.relpath, handler))
+  return [(m, p, s, h) for (p, m, s, h) in sorted(rows)]
+
+
+def generated_section(repo: Repo) -> str:
+  """The full replacement text between (and including) the markers."""
+  lines = [BEGIN_MARK, "",
+           "| Method | Path | Surface | Handler |",
+           "|---|---|---|---|"]
+  for method, path, surface, handler in _doc_rows(repo):
+    lines.append(f"| `{method}` | `{path}` | `{surface}` | `{handler}` |")
+  lines.append("")
+  lines.append(END_MARK)
+  return "\n".join(lines)
+
+
+def _parse_rows(section: str) -> Dict[Tuple[str, str], Tuple[str, str]]:
+  rows: Dict[Tuple[str, str], Tuple[str, str]] = {}
+  for line in section.splitlines():
+    m = _ROW_RE.match(line.strip())
+    if m:
+      rows[(m.group(1), m.group(2))] = (m.group(3), m.group(4))
+  return rows
+
+
+def _find_section(text: str) -> Optional[str]:
+  start = text.find(BEGIN_MARK)
+  end = text.find(END_MARK)
+  if start < 0 or end < 0 or end < start:
+    return None
+  return text[start:end + len(END_MARK)]
+
+
+def _doc_findings(repo: Repo) -> List[Finding]:
+  wm = wire_model(repo)
+  if not wm.routes:
+    return []  # no HTTP surface (fixture trees) -> nothing to document
+  readme = repo.read_text(repo.readme_path)
+  if readme is None:
+    return []  # doc-drift already reports the missing README
+  section = _find_section(readme)
+  if section is None:
+    return [Finding(
+      CHECKER, "missing-api-section", repo.readme_path, 1,
+      f"{repo.readme_path} has no `{BEGIN_MARK}` ... `{END_MARK}` block — "
+      "add one and fill it with `python -m tools.xotlint --endpoint-docs`",
+      key="section",
+    )]
+  documented = _parse_rows(section)
+  expected = _parse_rows(generated_section(repo))
+  findings: List[Finding] = []
+  line_of = {key: i + 1 for i, line in enumerate(readme.splitlines())
+             for key in documented if f"`{key[0]}` | `{key[1]}`" in line}
+  for key, row in expected.items():
+    if key not in documented:
+      findings.append(Finding(
+        CHECKER, "undocumented-route", repo.readme_path, 1,
+        key=f"{key[0]} {key[1]}",
+        message=f"route `{key[0]} {key[1]}` is registered but missing from the "
+                "README HTTP API table — regenerate with "
+                "`python -m tools.xotlint --endpoint-docs`",
+      ))
+    elif documented[key] != row:
+      findings.append(Finding(
+        CHECKER, "stale-api-doc", repo.readme_path, line_of.get(key, 1),
+        key=f"{key[0]} {key[1]}",
+        message=f"README row for `{key[0]} {key[1]}` (surface/handler) differs "
+                "from the registration — regenerate with "
+                "`python -m tools.xotlint --endpoint-docs`",
+      ))
+  for key in documented:
+    if key not in expected:
+      findings.append(Finding(
+        CHECKER, "phantom-route-doc", repo.readme_path, line_of.get(key, 1),
+        key=f"{key[0]} {key[1]}",
+        message=f"README documents `{key[0]} {key[1]}` but no such route is "
+                "registered — remove the row or register the route",
+      ))
+  return findings
+
+
+def check(repo: Repo) -> List[Finding]:
+  wm = wire_model(repo)
+  findings: List[Finding] = []
+  seen: set = set()
+
+  # Client -> server: every in-scope client path must hit a real route.
+  for ref in wm.client_refs:
+    if wm.routes_matching(ref.path, ref.method):
+      continue
+    if wm.routes_matching(ref.path):
+      # Path exists but under a different verb: name the verb mismatch.
+      msg = (f"client calls `{ref.method} {ref.path}` but the route is "
+             f"registered under a different method")
+    else:
+      msg = (f"client references `{ref.path}` but no route registers it — "
+             "typo'd path, or the server side was never wired")
+    f = Finding(CHECKER, "unknown-route", ref.sf.relpath, ref.line,
+                key=f"{ref.method or 'ANY'} {ref.path}", message=msg)
+    if f.identity in seen or ref.sf.suppressed(ref.line, CHECKER):
+      continue
+    seen.add(f.identity)
+    findings.append(f)
+
+  # Server -> client: a route nothing in the repo consumes is dead surface
+  # unless the allowlist names its external consumer.
+  for route in wm.routes:
+    consumed = any(path_ok for path_ok in (
+      (path_match(ref.path, route.path) and
+       (ref.method is None or ref.method == route.method))
+      for ref in wm.client_refs))
+    if consumed:
+      continue
+    if (route.method, route.path) in ALLOWLIST:
+      continue
+    f = Finding(
+      CHECKER, "dead-route", route.sf.relpath, route.line,
+      key=f"{route.method} {route.path}",
+      message=f"route `{route.method} {route.path}` has no in-repo consumer "
+              "and is not in the external-surface ALLOWLIST — delete the "
+              "route or add it to tools/xotlint/endpoint_contract.py with "
+              "its external consumer",
+    )
+    if f.identity in seen or route.sf.suppressed(route.line, CHECKER):
+      continue
+    seen.add(f.identity)
+    findings.append(f)
+
+  findings.extend(_doc_findings(repo))
+  return findings

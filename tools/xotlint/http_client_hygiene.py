@@ -1,0 +1,144 @@
+"""http-client-hygiene checker: cross-process HTTP calls degrade, not crash.
+
+The fabric/router guarantee is "always degrade to cold forward": a peer
+that is down, slow, or mid-respawn must cost a miss, never an unbounded
+hang or an unhandled exception on a serving path. Statically enforced on
+every transport site the wire model (wire.py) found:
+
+- **missing-timeout**: the call can hang forever. urllib `urlopen` must
+  carry `timeout=` at the call; an aiohttp session call must carry a
+  per-call `timeout=` UNLESS every `ClientSession(...)` constructed in
+  the same module carries a session-level timeout (then per-call
+  timeouts are redundant by construction).
+- **uncontained-call**: no `try`/`except` stands between the call and its
+  entry point. Containment may live in the caller (a transport helper
+  whose every call site is wrapped) — the check walks in-repo call sites
+  (including function references handed to executors) up to three hops.
+  A deliberate fire-and-forget whose failure is consumed elsewhere
+  (e.g. `task.exception()`) earns an inline suppression with its reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.xotlint.core import Finding, Repo, SourceFile, dotted_name
+from tools.xotlint.wire import WireModel, wire_model
+
+CHECKER = "http-client-hygiene"
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _in_try(sf: SourceFile, node: ast.AST) -> bool:
+  """The node sits in the BODY of a try with at least one except handler,
+  within its own function (a finally-only try contains nothing)."""
+  child: ast.AST = node
+  parent = sf.parent(child)
+  while parent is not None and not isinstance(parent, _FUNC):
+    if isinstance(parent, ast.Try) and parent.handlers \
+        and any(child is stmt for stmt in parent.body):
+      return True
+    child = parent
+    parent = sf.parent(parent)
+  return False
+
+
+def _caller_index(wm: WireModel) -> Dict[str, List[Tuple[SourceFile, ast.AST, bool]]]:
+  """Bare function name -> (file, call site, via-attribute) across the
+  scanned tree. References in argument position count
+  (`run_in_executor(None, post)`, `spawn_detached(self._open_attempt(...))`
+  both reach the body). The via-attribute flag lets _contained ignore
+  `session.post(...)` when resolving a PLAIN function named `post` — an
+  attribute call targets another object's method, never a local def."""
+  idx: Dict[str, List[Tuple[SourceFile, ast.AST, bool]]] = {}
+  for sf in wm.files:
+    for node in sf.nodes():
+      if not isinstance(node, ast.Call):
+        continue
+      name = dotted_name(node.func)
+      if name:
+        idx.setdefault(name.rsplit(".", 1)[-1], []).append(
+          (sf, node, isinstance(node.func, ast.Attribute)))
+      for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        rname = dotted_name(arg)
+        if rname:
+          idx.setdefault(rname.rsplit(".", 1)[-1], []).append(
+            (sf, node, isinstance(arg, ast.Attribute)))
+  return idx
+
+
+def _contained(wm: WireModel, idx, sf: SourceFile, node: ast.AST,
+               seen: Set[Tuple[str, str]], done: Dict[Tuple[str, str], bool],
+               depth: int = 0) -> bool:
+  if _in_try(sf, node):
+    return True
+  if depth >= 3:
+    return False
+  fn = sf.enclosing_func(node)
+  if fn is None or isinstance(fn, ast.Lambda):
+    return False  # module level / lambda: nothing upstream can be credited
+  key = (sf.relpath, sf.qual(fn))
+  if key in done:
+    # Two call sites climbing to the same function share its verdict
+    # (three `_chat_once(...)` calls all resolve through `run_soak`).
+    return done[key]
+  if key in seen:
+    return False  # recursion cycle: nothing upstream resolved yet
+  seen.add(key)
+  # A plain (non-method) function is only ever called/referenced by bare
+  # name; attribute sites (`session.post`) are some OTHER object's method.
+  is_method = sf.class_scope(fn) is not None
+  sites = [(s, n) for s, n, via_attr in idx.get(fn.name, [])
+           if is_method or not via_attr]
+  # Prefer same-file call sites: cross-file name collisions (two CLIs each
+  # defining `_fetch`) must not let one file's wrapping excuse the other's.
+  local = [(s, n) for s, n in sites if s is sf]
+  sites = local or sites
+  # Exclude recursive self-references from within the function itself.
+  sites = [(s, n) for s, n in sites
+           if not (s is sf and sf.enclosing_func(n) is fn)]
+  verdict = bool(sites) and \
+      all(_contained(wm, idx, s, n, seen, done, depth + 1) for s, n in sites)
+  done[key] = verdict
+  seen.discard(key)
+  return verdict
+
+
+def check(repo: Repo) -> List[Finding]:
+  wm = wire_model(repo)
+  findings: List[Finding] = []
+  seen_ids: set = set()
+  idx: Optional[dict] = None
+
+  def emit(f: Finding, sf: SourceFile, line: int) -> None:
+    if f.identity not in seen_ids and not sf.suppressed(line, CHECKER):
+      seen_ids.add(f.identity)
+      findings.append(f)
+
+  for t in wm.transports:
+    where = t.path or "dynamic-url"
+    if not t.has_timeout and not (
+        t.kind == "session" and wm.session_module_timeout.get(t.sf.relpath)):
+      hint = ("pass `timeout=` to the call" if t.kind == "urllib" else
+              "pass `timeout=` here or construct every ClientSession in "
+              "this module with a session-level timeout")
+      emit(Finding(
+        CHECKER, "missing-timeout", t.sf.relpath, t.line,
+        key=f"{t.scope}:{where}",
+        message=f"cross-process `{t.kind}` call to `{where}` has no timeout "
+                f"and can hang forever — {hint}",
+      ), t.sf, t.line)
+    if idx is None:
+      idx = _caller_index(wm)
+    if not _contained(wm, idx, t.sf, t.call, set(), {}):
+      emit(Finding(
+        CHECKER, "uncontained-call", t.sf.relpath, t.line,
+        key=f"{t.scope}:{where}",
+        message=f"cross-process `{t.kind}` call to `{where}` has no "
+                "try/except between it and its entry point (checked three "
+                "caller hops) — a dead peer must degrade, not raise; wrap "
+                "it, or suppress with the reason failures are consumed "
+                "elsewhere",
+      ), t.sf, t.line)
+  return findings

@@ -1,0 +1,715 @@
+"""Wire-model extractor: the repo's cross-process string contracts.
+
+PRs 14-19 moved correctness into strings that cross process boundaries —
+HTTP routes, JSON payload keys, status-bus `"type"` literals. This module
+builds the ONE shared model of those seams that the four wire checkers
+(endpoint-contract, wire-schema, bus-vocabulary, http-client-hygiene)
+consume, memoized on the Repo like `callgraph.program`:
+
+- **routes**: every `add_get/add_post/add_delete/add_put` registration in
+  the package, including paths bound by a `for path in ("/a", "/b"):`
+  loop (router/app.py's proxy fan-in). Handlers resolve to callgraph
+  quals so produced-key closures can start from them.
+- **client refs**: every URL a client builds — f-strings and string
+  concatenation feeding `session.get/post` / `urllib.request.urlopen`,
+  plus LOOSE references (a path literal handed to a fetch helper, an
+  f-string assigned to a variable). Dynamic segments render as `{x}` and
+  match any route segment; query strings are stripped.
+- **transports**: the raw HTTP call sites with their timeout/containment
+  facts — http-client-hygiene's work list.
+- **consumptions**: `.get("k")` / `["k"]` reads on names tainted by a
+  response-JSON root (`await resp.json()`, `json.loads(r.read())` under
+  `urlopen`, or a call to a local fetch wrapper). Taint follows simple
+  assignment, `x or {}`, subscripts, and attribute stores (`rep.queue =
+  q.get("admission")` taints `.queue` reads repo-wide — the router ->
+  fleet-controller seam).
+- **produced keys**: every constant dict key in the scanned tree (the
+  global universe a consumed key must exist in), plus per-handler BFS
+  closures over the callgraph with a bounded same-method-name fallback
+  for calls the import resolver punts on (`gate.compact()` through an
+  untyped `self.node`).
+- **bus vocabulary**: `"type"` literals in `broadcast_opaque_status`
+  payloads vs the dispatch arms of the handler registered via
+  `.register(...).on_next(self.<handler>)`.
+
+The scan covers `repo.files()` (the package) plus the CLI tool roots that
+speak the node API (tools/anatomy, tools/history, tools/soak) — loaded
+through `repo.file()` so they share the AST cache and suppression
+bookkeeping but are NOT subjected to the per-function package checkers.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.xotlint.core import Repo, SourceFile, dotted_name, str_arg
+from tools.xotlint.callgraph import Program, program
+
+# CLI tool trees scanned for client sites (package checkers skip these).
+TOOL_ROOTS = ("tools/anatomy", "tools/history", "tools/soak")
+
+_ROUTE_REG = {"add_get": "GET", "add_post": "POST",
+              "add_delete": "DELETE", "add_put": "PUT"}
+
+# A rendered URL path: absolute, segments of name-ish chars or `{param}`.
+_PATH_RE = re.compile(r"^/[A-Za-z0-9_{}./-]*$")
+
+# Unresolved-call fallback for produced-key closures: a dotted call the
+# import resolver punted on expands to every same-named def in the program
+# unless the name is hopelessly generic (dict/list/logging vocabulary) or
+# the candidate set is too wide to mean anything.
+_FALLBACK_STOP = {
+  "get", "items", "keys", "values", "append", "add", "update", "pop",
+  "join", "split", "format", "encode", "decode", "strip", "startswith",
+  "endswith", "record", "register", "info", "debug", "warning", "error",
+  "put", "extend", "copy", "sort", "close", "send", "write", "read",
+}
+_FALLBACK_MAX_CANDIDATES = 12
+
+
+@dataclass
+class Route:
+  """One registered server route."""
+  method: str                    # GET/POST/DELETE/PUT
+  path: str                      # template, e.g. "/v1/kv/{key}"
+  handler: str                   # as written, e.g. "self.handle_get_kv"
+  handler_qual: Optional[str]    # resolved callgraph qual, when known
+  sf: SourceFile
+  line: int
+
+
+@dataclass
+class ClientRef:
+  """One client-side reference to a server path (transport arg or loose)."""
+  path: str                      # template, query stripped
+  method: Optional[str]          # None for loose references
+  sf: SourceFile
+  line: int
+  scope: str
+  kind: str                      # "session" | "urllib" | "loose"
+
+
+@dataclass
+class Transport:
+  """One raw HTTP call site (http-client-hygiene's unit of work)."""
+  kind: str                      # "session" | "urllib"
+  method: Optional[str]
+  path: Optional[str]            # rendered template, when the URL renders
+  sf: SourceFile
+  call: ast.Call
+  line: int
+  scope: str
+  has_timeout: bool
+
+
+@dataclass
+class Consumption:
+  """One `.get("k")` / `["k"]` read on response-JSON-tainted data."""
+  key: str
+  route: Optional[str]           # path template the taint came from
+  sf: SourceFile
+  line: int
+  scope: str
+
+
+@dataclass
+class BusSite:
+  """One status-bus `"type"` literal (producer or dispatch arm)."""
+  type_: str
+  sf: SourceFile
+  line: int
+
+
+def _path_of(urlish: str) -> Optional[str]:
+  """Rendered URL template -> server path template, or None.
+
+  `http://h:{p}/v1/queue?x=1` -> `/v1/queue`; `{base}/v1/kv/{key}?payload=1`
+  -> `/v1/kv/{key}`; a bare `/healthcheck` passes through."""
+  s = urlish.split("?", 1)[0]
+  if s.startswith(("http://", "https://")):
+    rest = s.split("://", 1)[1]
+    slash = rest.find("/")
+    if slash < 0:
+      # `http://host:{port}{path}`: the whole path is a runtime argument —
+      # unknown, NOT the root route. A literal slashless URL is "/".
+      return None if "{" in rest else "/"
+    s = rest[slash:]
+  elif not s.startswith("/"):
+    # `{base}/v1/anatomy`, `{x}/healthcheck`: drop the host-ish prefix.
+    slash = s.find("/")
+    if slash < 0 or not s.startswith("{"):
+      return None
+    s = s[slash:]
+  if s != "/" and s.endswith("/"):
+    s = s.rstrip("/")
+  return s if _PATH_RE.match(s) else None
+
+
+def path_match(client: str, route: str) -> bool:
+  """Template match with `{param}` wildcards on either side."""
+  a, b = client.split("/"), route.split("/")
+  if len(a) != len(b):
+    return False
+  return all(x == y or (x.startswith("{") and x.endswith("}"))
+             or (y.startswith("{") and y.endswith("}"))
+             for x, y in zip(a, b))
+
+
+def _collect_keys(root: ast.AST) -> Set[str]:
+  """Constant JSON-ish keys a subtree can produce: dict literals,
+  `dict(k=...)` kwargs, `d["k"] = v` stores, `.setdefault("k", ...)`."""
+  keys: Set[str] = set()
+  for node in ast.walk(root):
+    if isinstance(node, ast.Dict):
+      for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+          keys.add(k.value)
+    elif isinstance(node, ast.Call):
+      name = dotted_name(node.func)
+      if name == "dict":
+        keys.update(kw.arg for kw in node.keywords if kw.arg)
+      elif name.endswith(".setdefault"):
+        k = str_arg(node)
+        if k is not None:
+          keys.add(k)
+    elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+      targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+      for tgt in targets:
+        if isinstance(tgt, ast.Subscript) and isinstance(tgt.slice, ast.Constant) \
+            and isinstance(tgt.slice.value, str):
+          keys.add(tgt.slice.value)
+  return keys
+
+
+class _Renderer:
+  """URL-ish expression -> template string. Dynamic parts become `{x}`
+  (or the placeholder's own name, so `/v1/kv/{key}` reads naturally)."""
+
+  def __init__(self, env: Dict[str, ast.AST]):
+    self.env = env
+
+  def render(self, node: ast.AST, depth: int = 0) -> Optional[str]:
+    if depth > 4:
+      return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+      return node.value
+    if isinstance(node, ast.JoinedStr):
+      parts: List[str] = []
+      for v in node.values:
+        if isinstance(v, ast.Constant):
+          parts.append(str(v.value))
+        elif isinstance(v, ast.FormattedValue):
+          name = dotted_name(v.value)
+          parts.append("{" + (name.rsplit(".", 1)[-1] if name else "x") + "}")
+      return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+      left = self.render(node.left, depth + 1) or "{x}"
+      right = self.render(node.right, depth + 1) or "{x}"
+      if left == "{x}" and right == "{x}":
+        return None
+      return left + right
+    if isinstance(node, ast.IfExp):
+      # A conditional query-string suffix (`f"?{...}" if query else ""`)
+      # ends the path either way; any other conditional stays dynamic.
+      branches = (self.render(node.body, depth + 1),
+                  self.render(node.orelse, depth + 1))
+      if all(b is not None and (b == "" or b.startswith("?")) for b in branches):
+        return "?"
+      return None
+    if isinstance(node, ast.Name):
+      bound = self.env.get(node.id)
+      if bound is not None:
+        return self.render(bound, depth + 1)
+    return None
+
+
+def _transport_of(call: ast.Call, env: Dict[str, ast.AST]) -> Optional[Tuple[str, Optional[str], Optional[str], bool]]:
+  """Classify a Call as an HTTP transport: (kind, method, path, timeout).
+
+  Session transports are `<...session>.get/post/delete/put(url, ...)` —
+  the receiver's final name must contain "session" so `dict.get` never
+  matches. Urllib transports are any `...urlopen(url_or_request, ...)`."""
+  if not isinstance(call.func, ast.Attribute):
+    return None
+  rend = _Renderer(env)
+  attr = call.func.attr
+  recv = dotted_name(call.func.value)
+  if attr in ("get", "post", "delete", "put") \
+      and "session" in recv.rsplit(".", 1)[-1].lower():
+    url = rend.render(call.args[0]) if call.args else None
+    path = _path_of(url) if url else None
+    timeout = any(kw.arg == "timeout" for kw in call.keywords)
+    return ("session", attr.upper(), path, timeout)
+  name = dotted_name(call.func)
+  if name.endswith("urlopen"):
+    method: Optional[str] = "GET"
+    url_node: Optional[ast.AST] = call.args[0] if call.args else None
+    if isinstance(url_node, ast.Name) and isinstance(env.get(url_node.id), ast.Call):
+      url_node = env[url_node.id]
+    if isinstance(url_node, ast.Call) and dotted_name(url_node.func).endswith("Request"):
+      req = url_node
+      method = None
+      for kw in req.keywords:
+        if kw.arg == "method" and isinstance(kw.value, ast.Constant):
+          method = str(kw.value.value).upper()
+        elif kw.arg == "data":
+          method = method or "POST"
+      method = method or "GET"
+      url_node = req.args[0] if req.args else None
+    url = rend.render(url_node) if url_node is not None else None
+    path = _path_of(url) if url else None
+    timeout = any(kw.arg == "timeout" for kw in call.keywords)
+    return ("urllib", method, path, timeout)
+  return None
+
+
+class WireModel:
+  """The full wire model; build once per repo via `wire_model(repo)`."""
+
+  def __init__(self, repo: Repo):
+    self.repo = repo
+    self.prog: Program = program(repo)
+    self.files: List[SourceFile] = self._scan_files()
+    self.routes: List[Route] = []
+    self.client_refs: List[ClientRef] = []
+    self.transports: List[Transport] = []
+    self.consumptions: List[Consumption] = []
+    self.produced_global: Set[str] = set()
+    self.bus_producers: List[BusSite] = []
+    self.bus_arms: List[BusSite] = []
+    # relpath -> True when every ClientSession(...) ctor in the module
+    # carries timeout= (and at least one exists): per-call timeouts are
+    # then redundant and not required.
+    self.session_module_timeout: Dict[str, bool] = {}
+    # Cross-file taint: attribute name -> route it was tainted from
+    # (`rep.queue = q.get("admission")` makes every `.queue` read tainted).
+    self.attr_taint: Dict[str, Optional[str]] = {}
+    # Local fetch wrappers: bare name -> fixed route (or None when the
+    # route varies per call and must render from the call's arguments).
+    self.fetchers: Dict[str, Optional[str]] = {}
+    self._closures: Dict[str, Set[str]] = {}
+    self._method_index: Optional[Dict[str, List[str]]] = None
+    self._build()
+
+  # ------------------------------------------------------------------ scan
+
+  def _scan_files(self) -> List[SourceFile]:
+    files = [sf for sf in self.repo.files() if sf.tree is not None]
+    in_pkg = {sf.relpath for sf in files}
+    for root in TOOL_ROOTS:
+      base = os.path.join(self.repo.root, root)
+      if not os.path.isdir(base):
+        continue
+      for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+          if not name.endswith(".py"):
+            continue
+          rel = os.path.relpath(os.path.join(dirpath, name), self.repo.root)
+          rel = rel.replace(os.sep, "/")
+          if rel in in_pkg:
+            continue
+          sf = self.repo.file(rel)
+          if sf is not None and sf.tree is not None:
+            files.append(sf)
+    return files
+
+  def _build(self) -> None:
+    dispatch_funcs: List[Tuple[SourceFile, str, Optional[str]]] = []
+    for sf in self.files:
+      self.produced_global |= _collect_keys(sf.tree)
+      self._scan_static(sf, dispatch_funcs)
+    route_paths = {r.path for r in self.routes}
+    for sf in self.files:
+      self._scan_loose(sf, route_paths)
+    self._scan_taint()
+    self._scan_bus_arms(dispatch_funcs)
+
+  def _scan_static(self, sf: SourceFile,
+                   dispatch_funcs: List[Tuple[SourceFile, str, Optional[str]]]) -> None:
+    """Routes, ClientSession ctor policy, bus producers, dispatch handlers."""
+    sessions: List[bool] = []
+    for node in sf.nodes():
+      if not isinstance(node, ast.Call):
+        continue
+      name = dotted_name(node.func)
+      if isinstance(node.func, ast.Attribute) and node.func.attr in _ROUTE_REG \
+          and node.args and len(node.args) >= 2:
+        self._add_routes(sf, node)
+      elif name.endswith("ClientSession"):
+        sessions.append(any(kw.arg == "timeout" for kw in node.keywords))
+      elif name.endswith("broadcast_opaque_status"):
+        self._add_bus_producer(sf, node)
+      elif isinstance(node.func, ast.Attribute) and node.func.attr == "on_next" \
+          and isinstance(node.func.value, ast.Call) \
+          and isinstance(node.func.value.func, ast.Attribute) \
+          and node.func.value.func.attr == "register" and node.args:
+        handler = dotted_name(node.args[0])
+        if handler:
+          dispatch_funcs.append(
+            (sf, handler.rsplit(".", 1)[-1], sf.class_scope(node)))
+    self.session_module_timeout[sf.relpath] = bool(sessions) and all(sessions)
+
+  def _add_routes(self, sf: SourceFile, call: ast.Call) -> None:
+    method = _ROUTE_REG[call.func.attr]
+    paths: List[str] = []
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+      paths = [arg.value]
+    elif isinstance(arg, ast.Name):
+      # `for path in ("/v1/models", ...): r.add_get(path, handler)`
+      anc = sf.parent(call)
+      while anc is not None:
+        if isinstance(anc, ast.For) and isinstance(anc.target, ast.Name) \
+            and anc.target.id == arg.id \
+            and isinstance(anc.iter, (ast.Tuple, ast.List)):
+          paths = [e.value for e in anc.iter.elts
+                   if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+          break
+        anc = sf.parent(anc)
+    handler = dotted_name(call.args[1])
+    qual = None
+    encl = sf.enclosing_func(call)
+    if handler and encl is not None:
+      info = self.prog.funcs.get(f"{sf.relpath}::{sf.qual(encl)}")
+      if info is not None:
+        qual = self.prog._resolve_name(info, handler)
+    for path in paths:
+      if _PATH_RE.match(path):
+        self.routes.append(Route(method=method, path=path, handler=handler,
+                                 handler_qual=qual, sf=sf, line=call.lineno))
+
+  def _add_bus_producer(self, sf: SourceFile, call: ast.Call) -> None:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+      if isinstance(arg, ast.Call) and dotted_name(arg.func).endswith("dumps") \
+          and arg.args and isinstance(arg.args[0], ast.Dict):
+        d = arg.args[0]
+        for k, v in zip(d.keys, d.values):
+          if isinstance(k, ast.Constant) and k.value == "type" \
+              and isinstance(v, ast.Constant) and isinstance(v.value, str):
+            self.bus_producers.append(BusSite(v.value, sf, call.lineno))
+
+  def _scan_bus_arms(self, dispatch_funcs: List[Tuple[SourceFile, str, Optional[str]]]) -> None:
+    for sf, fname, cls in dispatch_funcs:
+      fn = None
+      for node in sf.nodes():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            and node.name == fname and sf.class_scope(node) == cls:
+          fn = node
+          break
+      if fn is None:
+        continue
+      # Names bound from `<x>.get("type", ...)` / `<x>["type"]`.
+      type_names: Set[str] = set()
+      for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+            and isinstance(node.targets[0], ast.Name):
+          v = node.value
+          if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+              and v.func.attr == "get" and str_arg(v) == "type") or \
+             (isinstance(v, ast.Subscript) and isinstance(v.slice, ast.Constant)
+              and v.slice.value == "type"):
+            type_names.add(node.targets[0].id)
+      for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+          continue
+        left = node.left
+        is_type = (isinstance(left, ast.Name) and left.id in type_names) or \
+                  (isinstance(left, ast.Call) and isinstance(left.func, ast.Attribute)
+                   and left.func.attr == "get" and str_arg(left) == "type")
+        if not is_type:
+          continue
+        for op, comp in zip(node.ops, node.comparators):
+          if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(comp, ast.Constant) \
+              and isinstance(comp.value, str):
+            self.bus_arms.append(BusSite(comp.value, sf, node.lineno))
+          elif isinstance(op, ast.In) and isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+            for e in comp.elts:
+              if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                self.bus_arms.append(BusSite(e.value, sf, node.lineno))
+
+  # ----------------------------------------------------------- client refs
+
+  def _func_env(self, sf: SourceFile, fn: ast.AST) -> Dict[str, ast.AST]:
+    """Single-assignment name bindings inside a function (URL rendering)."""
+    env: Dict[str, ast.AST] = {}
+    multi: Set[str] = set()
+    for node in ast.walk(fn):
+      if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+          if isinstance(tgt, ast.Name):
+            if tgt.id in env:
+              multi.add(tgt.id)
+            env[tgt.id] = node.value
+    for name in multi:
+      env.pop(name, None)
+    return env
+
+  def _scan_loose(self, sf: SourceFile, route_paths: Set[str]) -> None:
+    """Transports + loose path references, per function/module scope."""
+    envs: Dict[int, Dict[str, ast.AST]] = {}
+
+    def env_for(node: ast.AST) -> Dict[str, ast.AST]:
+      fn = sf.enclosing_func(node)
+      key = id(fn)
+      if key not in envs:
+        envs[key] = self._func_env(sf, fn if fn is not None else sf.tree)
+      return envs[key]
+
+    def in_scope(path: str) -> bool:
+      return path.startswith("/v1/") or path in route_paths
+
+    url_args: Set[int] = set()
+    for node in sf.nodes():
+      if not isinstance(node, ast.Call):
+        continue
+      t = _transport_of(node, env_for(node))
+      if t is None:
+        continue
+      kind, method, path, has_timeout = t
+      scope = sf.func_scope(node)
+      self.transports.append(Transport(
+        kind=kind, method=method, path=path, sf=sf, call=node,
+        line=node.lineno, scope=scope, has_timeout=has_timeout))
+      if path is not None and in_scope(path):
+        self.client_refs.append(ClientRef(
+          path=path, method=method, sf=sf, line=node.lineno,
+          scope=scope, kind=kind))
+      for arg in ast.walk(node):
+        url_args.add(id(arg))
+
+    rend_cache: Dict[int, Optional[str]] = {}
+    for node in sf.nodes():
+      if id(node) in url_args:
+        continue
+      urlish: Optional[str] = None
+      parent = sf.parent(node)
+      if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # Fragments of f-strings/concats render with their whole expression;
+        # route REGISTRATIONS are servers, not clients.
+        if isinstance(parent, (ast.JoinedStr, ast.BinOp)):
+          continue
+        if isinstance(parent, ast.Call) and isinstance(parent.func, ast.Attribute) \
+            and parent.func.attr in _ROUTE_REG and parent.args \
+            and parent.args[0] is node:
+          continue
+        urlish = node.value
+      elif isinstance(node, ast.JoinedStr) and not isinstance(parent, ast.JoinedStr):
+        urlish = _Renderer(env_for(node)).render(node)
+      elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add) \
+          and not isinstance(parent, (ast.BinOp, ast.JoinedStr)):
+        urlish = _Renderer(env_for(node)).render(node)
+      if not urlish:
+        continue
+      path = _path_of(urlish)
+      # A bare "/" is string-manipulation vocabulary (`split("/")`,
+      # `rstrip("/")`), never a root-route reference — transports only.
+      if path is None or path == "/" or not in_scope(path):
+        continue
+      self.client_refs.append(ClientRef(
+        path=path, method=None, sf=sf, line=node.lineno,
+        scope=sf.func_scope(node), kind="loose"))
+
+  # ----------------------------------------------------------------- taint
+
+  def _scan_taint(self) -> None:
+    """Fixpoint over fetch wrappers + tainted attributes, then one
+    recording pass that emits consumptions."""
+    fns: List[Tuple[SourceFile, ast.AST]] = []
+    for sf in self.files:
+      for node in sf.nodes():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+          fns.append((sf, node))
+    for _ in range(4):
+      before = (len(self.fetchers), len(self.attr_taint))
+      for sf, fn in fns:
+        _FnTaint(self, sf, fn).run(record=False)
+      if (len(self.fetchers), len(self.attr_taint)) == before:
+        break
+    for sf, fn in fns:
+      _FnTaint(self, sf, fn).run(record=True)
+
+  # ------------------------------------------------------ produced closures
+
+  def method_index(self) -> Dict[str, List[str]]:
+    if self._method_index is None:
+      idx: Dict[str, List[str]] = {}
+      for qual in self.prog.funcs:
+        name = qual.rsplit("::", 1)[1].rsplit(".", 1)[-1]
+        idx.setdefault(name, []).append(qual)
+      self._method_index = idx
+    return self._method_index
+
+  def produced_closure(self, handler_qual: str) -> Set[str]:
+    """Every constant key a handler can put on the wire: BFS over resolved
+    call/ref edges, widened by the bounded same-name fallback for calls
+    resolution punts on (the `self.node.<subsystem>.<method>()` seam)."""
+    memo = self._closures.get(handler_qual)
+    if memo is not None:
+      return memo
+    keys: Set[str] = set()
+    seen: Set[str] = set()
+    frontier = [handler_qual]
+    idx = self.method_index()
+    while frontier:
+      q = frontier.pop()
+      if q in seen:
+        continue
+      seen.add(q)
+      info = self.prog.funcs.get(q)
+      if info is None:
+        continue
+      keys |= _collect_keys(info.node)
+      nxt = list(info.edges)
+      for unresolved in info.unresolved:
+        name = unresolved.rsplit(".", 1)[-1]
+        if name in _FALLBACK_STOP or name.startswith("__"):
+          continue
+        cands = idx.get(name, ())
+        if 0 < len(cands) <= _FALLBACK_MAX_CANDIDATES:
+          nxt.extend(cands)
+      frontier.extend(n for n in nxt if n not in seen)
+    self._closures[handler_qual] = keys
+    return keys
+
+  def routes_matching(self, path: str, method: Optional[str] = None) -> List[Route]:
+    return [r for r in self.routes
+            if path_match(path, r.path) and (method is None or r.method == method)]
+
+
+class _FnTaint:
+  """Per-function response-JSON taint: roots, propagation, consumption."""
+
+  def __init__(self, wm: WireModel, sf: SourceFile, fn: ast.AST):
+    self.wm = wm
+    self.sf = sf
+    self.fn = fn
+    self.env = wm._func_env(sf, fn)
+    self.rend = _Renderer(self.env)
+    # with/async-with bindings: name -> (kind, route) for transport ctxs.
+    self.resp: Dict[str, Tuple[str, Optional[str]]] = {}
+    for node in ast.walk(fn):
+      if isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+          ctx = item.context_expr
+          if isinstance(ctx, ast.Call) and isinstance(item.optional_vars, ast.Name):
+            t = _transport_of(ctx, self.env)
+            if t is not None:
+              self.resp[item.optional_vars.id] = (t[0], t[2])
+    self.tainted: Dict[str, Optional[str]] = {}
+
+  def _route_of_call(self, call: ast.Call, fixed: Optional[str]) -> Optional[str]:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+      urlish = self.rend.render(arg)
+      if urlish:
+        path = _path_of(urlish)
+        if path is not None:
+          return path
+    return fixed
+
+  def taint(self, node: ast.AST, depth: int = 0):
+    """None = untainted; else a 1-tuple (route,) so a None route still
+    reads as a hit."""
+    if depth > 8 or node is None:
+      return None
+    if isinstance(node, ast.Await):
+      return self.taint(node.value, depth + 1)
+    if isinstance(node, ast.NamedExpr):
+      return self.taint(node.value, depth + 1)
+    if isinstance(node, ast.Call):
+      func = node.func
+      name = dotted_name(func)
+      if isinstance(func, ast.Attribute):
+        if func.attr == "json" and isinstance(func.value, ast.Name):
+          bound = self.resp.get(func.value.id)
+          if bound is not None and bound[0] == "session":
+            return (bound[1],)
+        if func.attr == "get":
+          base = self.taint(func.value, depth + 1)
+          if base is not None:
+            return base
+      if name.endswith("loads"):
+        for sub in ast.walk(node):
+          if isinstance(sub, ast.Name):
+            bound = self.resp.get(sub.id)
+            if bound is not None and bound[0] == "urllib":
+              return (bound[1],)
+      short = name.rsplit(".", 1)[-1]
+      if short in self.wm.fetchers:
+        return (self._route_of_call(node, self.wm.fetchers[short]),)
+      return None
+    if isinstance(node, ast.Name):
+      if node.id in self.tainted:
+        return (self.tainted[node.id],)
+      return None
+    if isinstance(node, ast.Attribute):
+      if node.attr in self.wm.attr_taint and not isinstance(node.ctx, ast.Store):
+        return (self.wm.attr_taint[node.attr],)
+      return None
+    if isinstance(node, ast.Subscript):
+      return self.taint(node.value, depth + 1)
+    if isinstance(node, ast.BoolOp):
+      for v in node.values:
+        hit = self.taint(v, depth + 1)
+        if hit is not None:
+          return hit
+      return None
+    if isinstance(node, ast.IfExp):
+      return self.taint(node.body, depth + 1) or self.taint(node.orelse, depth + 1)
+    return None
+
+  def run(self, record: bool) -> None:
+    # Propagate through assignments; two passes cover use-before-bind
+    # orderings inside loops.
+    for _ in range(2):
+      for node in ast.walk(self.fn):
+        if not isinstance(node, ast.Assign):
+          continue
+        hit = self.taint(node.value)
+        if hit is None:
+          continue
+        for tgt in node.targets:
+          if isinstance(tgt, ast.Name):
+            self.tainted[tgt.id] = hit[0]
+          elif isinstance(tgt, ast.Attribute):
+            self.wm.attr_taint.setdefault(tgt.attr, hit[0])
+    # Fetch-wrapper detection: the function RETURNS tainted data.
+    short = self.fn.name
+    for node in ast.walk(self.fn):
+      if isinstance(node, ast.Return) and node.value is not None:
+        hit = self.taint(node.value)
+        if hit is not None and short not in self.wm.fetchers:
+          self.wm.fetchers[short] = hit[0]
+    if not record:
+      return
+    for node in ast.walk(self.fn):
+      key: Optional[str] = None
+      base: Optional[ast.AST] = None
+      if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+          and node.func.attr == "get":
+        key = str_arg(node)
+        base = node.func.value
+      elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
+          and isinstance(node.slice, ast.Constant) and isinstance(node.slice.value, str):
+        key = node.slice.value
+        base = node.value
+      if key is None or base is None:
+        continue
+      hit = self.taint(base)
+      if hit is None:
+        continue
+      self.wm.consumptions.append(Consumption(
+        key=key, route=hit[0], sf=self.sf, line=node.lineno,
+        scope=self.sf.func_scope(node)))
+
+
+def wire_model(repo: Repo) -> WireModel:
+  """The memoized wire model (one build shared by the four checkers)."""
+  wm = getattr(repo, "_xotlint_wire", None)
+  if wm is None:
+    wm = WireModel(repo)
+    repo._xotlint_wire = wm
+  return wm

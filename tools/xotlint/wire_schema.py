@@ -1,0 +1,113 @@
+"""wire-schema checker: consumed JSON keys must exist on the producing side.
+
+The silent-`.get`-default bug class: `q.get("activ_requests") or 0` steers
+the fleet on zeros forever, because a typo'd key read across a process
+boundary fails OPEN. From the shared wire model (wire.py):
+
+- **unproduced-key** (error): a key consumed from response JSON (taint
+  from `await resp.json()` / `json.loads` under `urlopen` / a fetch
+  wrapper, followed through assignments and attribute stores) that NO
+  constant dict key in the scanned tree produces. When the consumption's
+  route is known, the message names the handler whose reachable closure
+  was searched first.
+- **unreachable-key** (info, `--wire-info`): the key exists somewhere in
+  the tree but not in the matched handler's produced-key closure — worth
+  a look, not a gate (closures are over-approximate but still miss
+  data-driven producers).
+- **unconsumed-key** (info, `--wire-info`): a top-level literal key of a
+  `web.json_response({...})` body nothing in the repo reads. Most are the
+  OpenAI-compatible surface consumed by external clients, which is
+  exactly why this is info, not error.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.xotlint.core import Finding, Repo, dotted_name
+from tools.xotlint.wire import wire_model
+
+CHECKER = "wire-schema"
+
+# Consumed keys that are request-body/bookkeeping vocabulary rather than
+# response-schema reads are still checked — they must simply exist as a
+# produced key somewhere, which request builders guarantee.
+
+
+def _closure_for(wm, route_path: str) -> Optional[Set[str]]:
+  keys: Optional[Set[str]] = None
+  for route in wm.routes:
+    if route.handler_qual and route.path == route_path:
+      cl = wm.produced_closure(route.handler_qual)
+      keys = cl if keys is None else (keys | cl)
+  return keys
+
+
+def check(repo: Repo) -> List[Finding]:
+  wm = wire_model(repo)
+  findings: List[Finding] = []
+  seen: set = set()
+  for c in wm.consumptions:
+    if c.key in wm.produced_global:
+      continue
+    where = f" of `{c.route}` responses" if c.route else ""
+    f = Finding(
+      CHECKER, "unproduced-key", c.sf.relpath, c.line,
+      key=f"{c.scope}:{c.key}",
+      message=f"`{c.key}` is read from cross-process JSON{where} but no "
+              "producer in the tree ever emits that key — a typo'd or stale "
+              "read that fails open to its `.get` default",
+    )
+    if f.identity in seen or c.sf.suppressed(c.line, CHECKER):
+      continue
+    seen.add(f.identity)
+    findings.append(f)
+  return findings
+
+
+def info(repo: Repo) -> List[Finding]:
+  """Non-gating wire observations, printed by `--wire-info` only."""
+  wm = wire_model(repo)
+  out: List[Finding] = []
+  seen: set = set()
+  consumed_all = {c.key for c in wm.consumptions}
+
+  for c in wm.consumptions:
+    if c.route is None or c.key not in wm.produced_global:
+      continue
+    closure = _closure_for(wm, c.route)
+    if closure is None or c.key in closure:
+      continue
+    f = Finding(
+      CHECKER, "unreachable-key", c.sf.relpath, c.line,
+      key=f"{c.scope}:{c.key}",
+      message=f"`{c.key}` is read from `{c.route}` responses but is not in "
+              "the registered handler's produced-key closure — produced "
+              "elsewhere in the tree, so likely fine, but worth a look",
+    )
+    if f.identity not in seen:
+      seen.add(f.identity)
+      out.append(f)
+
+  # Top-level literal response keys nothing in the repo reads.
+  for sf in wm.files:
+    for node in sf.nodes():
+      if not (isinstance(node, ast.Call)
+              and dotted_name(node.func).endswith("json_response")
+              and node.args and isinstance(node.args[0], ast.Dict)):
+        continue
+      for k in node.args[0].keys:
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+          continue
+        if k.value in consumed_all:
+          continue
+        f = Finding(
+          CHECKER, "unconsumed-key", sf.relpath, node.lineno,
+          key=f"{sf.func_scope(node)}:{k.value}",
+          message=f"response key `{k.value}` has no in-repo consumer "
+                  "(external clients may still read it — informational)",
+        )
+        if f.identity not in seen:
+          seen.add(f.identity)
+          out.append(f)
+  return out

@@ -744,7 +744,7 @@ class RouterApp:
     body — small by construction. The returned dict is relayed or aborted
     by the caller; on error the upstream response is released here."""
     assert self._session is not None
-    resp = await self._session.post(f"{rep.url}/v1/chat/completions", json=body,
+    resp = await self._session.post(f"{rep.url}/v1/chat/completions", json=body,  # xotlint: disable=http-client-hygiene (attempt failures are consumed by _settle_attempts via task.exception, never raised to the client)
                                     timeout=self.proxy_timeout)
     try:
       if not streaming or resp.status != 200:
